@@ -1,0 +1,27 @@
+"""determined_tpu: a TPU-native deep-learning training platform.
+
+A ground-up rebuild of the capabilities of the Determined AI platform
+(reference: sirredbeard/determined @ 2024-11-08) designed TPU-first:
+
+- Compute is JAX/XLA: training steps are ``jit``-compiled over a
+  ``jax.sharding.Mesh`` with data/fsdp/tensor/sequence/expert/pipeline axes
+  (subsuming the reference's DDP/Horovod/DeepSpeed/MPU zoo,
+  reference ``harness/determined/pytorch/``).
+- The Core API (``determined_tpu.core``) mirrors the reference's
+  ``harness/determined/core/`` contexts (distributed, checkpoint, train,
+  preempt, profiler, metrics) with a dummy/real split so everything runs
+  locally with zero services.
+- Hyperparameter search (``determined_tpu.searcher``) re-implements the
+  event-driven SearchMethod family from ``master/pkg/searcher/``.
+
+Public surface is re-exported here for ergonomic access.
+"""
+
+__version__ = "0.1.0"
+
+from determined_tpu.utils.errors import (  # noqa: F401
+    DeterminedTPUError,
+    InvalidConfigError,
+    CheckpointNotFoundError,
+    PreemptedError,
+)

@@ -1,0 +1,16 @@
+from determined_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    MeshAxes,
+    make_mesh,
+    make_virtual_mesh,
+    local_mesh_devices,
+)
+from determined_tpu.parallel.sharding import (  # noqa: F401
+    LogicalAxisRules,
+    DEFAULT_RULES,
+    logical_to_mesh_spec,
+    shard_params,
+    named_sharding,
+    with_sharding_constraint,
+    batch_sharding,
+)

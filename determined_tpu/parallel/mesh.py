@@ -1,0 +1,157 @@
+"""Device-mesh construction: the single mechanism for all parallelism.
+
+The reference expresses parallelism operationally — torchrun + NCCL DDP
+(``harness/determined/launch/torch_distributed.py``), Horovod
+(``launch/horovod.py``), DeepSpeed ZeRO/pipeline (``pytorch/deepspeed/``),
+and an interface-level ``ModelParallelUnit`` (``deepspeed/_mpu.py:9-50``).
+On TPU all of those collapse into ONE abstraction: a ``jax.sharding.Mesh``
+whose named axes carry data (dp), fully-sharded-data (fsdp), tensor (tp),
+sequence/context (sp), expert (ep), and pipeline (pp) parallelism.  XLA
+inserts the collectives (psum / all_gather / reduce_scatter / ppermute)
+over ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class MeshAxes:
+    """Canonical mesh-axis names used across the framework."""
+
+    DATA = "data"        # pure data parallelism (gradients psum'd)
+    FSDP = "fsdp"        # data parallelism with sharded params/opt-state
+    TENSOR = "tensor"    # tensor (megatron-style) parallelism
+    SEQUENCE = "seq"     # sequence / context parallelism (ring attention)
+    EXPERT = "expert"    # MoE expert parallelism
+    PIPELINE = "pipe"    # pipeline stages
+
+    ALL = (DATA, FSDP, TENSOR, SEQUENCE, EXPERT, PIPELINE)
+    # Axes over which a batch is split (used to compute per-shard batch).
+    BATCH_AXES = (DATA, FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative parallelism topology for one trial.
+
+    This is the TPU analog of the reference's ``slots_per_trial`` plus the
+    launcher choice: instead of "8 slots + torch_distributed launcher" a
+    trial says ``MeshConfig(data=2, fsdp=2, tensor=2)``.
+
+    A size of -1 for exactly one axis means "absorb all remaining devices".
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+    pipe: int = 1
+
+    def sizes(self) -> Tuple[int, ...]:
+        return (self.data, self.fsdp, self.tensor, self.seq, self.expert, self.pipe)
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for s in self.sizes():
+            if s != -1:
+                n *= s
+        return n
+
+    def resolve(self, total_devices: int) -> "MeshConfig":
+        """Fill in a single -1 axis from the total device count."""
+        sizes = list(self.sizes())
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if wild:
+            fixed = math.prod(s for s in sizes if s != -1)
+            if total_devices % fixed:
+                raise ValueError(
+                    f"{total_devices} devices not divisible by fixed axes {fixed}"
+                )
+            sizes[wild[0]] = total_devices // fixed
+        resolved = MeshConfig(*sizes)
+        if resolved.num_devices != total_devices:
+            raise ValueError(
+                f"mesh {resolved.sizes()} needs {resolved.num_devices} devices, "
+                f"got {total_devices}"
+            )
+        return resolved
+
+    @classmethod
+    def data_parallel(cls, n: int = -1) -> "MeshConfig":
+        return cls(data=n)
+
+    @classmethod
+    def fsdp_parallel(cls, n: int = -1) -> "MeshConfig":
+        return cls(fsdp=n)
+
+
+def _mesh_device_array(devices: Sequence[jax.Device], shape: Tuple[int, ...]) -> np.ndarray:
+    """Arrange devices for the mesh.
+
+    Axis order is chosen so the fastest-varying (innermost) axes are the
+    ones with the heaviest communication (tensor, then sequence), which maps
+    them onto the tightest ICI neighborhoods in the default device order —
+    the analog of NCCL ring placement in the reference's DDP launcher.
+    """
+    if len(devices) < math.prod(shape):
+        raise ValueError(f"need {math.prod(shape)} devices, have {len(devices)}")
+    devs = np.asarray(devices[: math.prod(shape)], dtype=object)
+    return devs.reshape(shape)
+
+
+def make_mesh(
+    config: MeshConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` from a MeshConfig.
+
+    Mesh axis order: (pipe, data, fsdp, expert, seq, tensor) — outermost
+    axes communicate least (pipeline p2p, DP gradient psum once per step),
+    innermost communicate most (TP collectives inside every layer), so the
+    innermost axes land on contiguous ICI neighbors.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = config.resolve(len(devices)) if -1 in config.sizes() else config
+    if config.num_devices > len(devices):
+        raise ValueError(
+            f"MeshConfig wants {config.num_devices} devices, only {len(devices)} present"
+        )
+    shape = (config.pipe, config.data, config.fsdp, config.expert, config.seq, config.tensor)
+    axis_names = (
+        MeshAxes.PIPELINE,
+        MeshAxes.DATA,
+        MeshAxes.FSDP,
+        MeshAxes.EXPERT,
+        MeshAxes.SEQUENCE,
+        MeshAxes.TENSOR,
+    )
+    return Mesh(_mesh_device_array(devices, shape), axis_names)
+
+
+def make_virtual_mesh(n: int, config: Optional[MeshConfig] = None) -> Mesh:
+    """Mesh over the first ``n`` visible devices (driver dry-run path).
+
+    Under ``--xla_force_host_platform_device_count=N`` this builds the
+    multi-chip mesh on CPU so shardings compile without TPU hardware — the
+    analog of the reference's artificial agent slots
+    (``agent/internal/detect/detect.go:40-57``).
+    """
+    config = config or MeshConfig(data=-1)
+    return make_mesh(config, jax.devices()[:n])
+
+
+def local_mesh_devices(mesh: Mesh) -> list:
+    """Devices of this mesh addressable by the current process."""
+    local = set(d.id for d in jax.local_devices())
+    return [d for d in mesh.devices.flat if d.id in local]

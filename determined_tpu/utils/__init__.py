@@ -1,0 +1,6 @@
+from determined_tpu.utils.errors import (  # noqa: F401
+    DeterminedTPUError,
+    InvalidConfigError,
+    CheckpointNotFoundError,
+    PreemptedError,
+)

@@ -1,0 +1,32 @@
+"""Exception hierarchy for determined_tpu.
+
+The reference scatters errors across packages (e.g. ``det.errors`` in
+harness); we centralise them.
+"""
+
+
+class DeterminedTPUError(Exception):
+    """Base class for all determined_tpu errors."""
+
+
+class InvalidConfigError(DeterminedTPUError):
+    """An experiment / cluster config failed validation."""
+
+
+class CheckpointNotFoundError(DeterminedTPUError):
+    """Requested checkpoint does not exist in storage."""
+
+
+class PreemptedError(DeterminedTPUError):
+    """Raised inside a trial when preemption was requested and the
+    training loop chose to unwind via exception."""
+
+
+class ShardMergeConflictError(DeterminedTPUError):
+    """Two ranks uploaded conflicting files/metadata for one sharded
+    checkpoint (analog of the reference's md5 conflict detection in
+    ``core/_checkpoint.py`` merge_resources/merge_metadata)."""
+
+
+class StoppedError(DeterminedTPUError):
+    """The searcher / master requested this trial stop early."""

@@ -1,0 +1,34 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is the analog of the reference's artificial agent slots
+(``agent/internal/detect/detect.go:40-57``) + thread-rank simulator
+(``harness/tests/parallel.py``): all sharding/mesh tests run on CPU with 8
+virtual devices, no TPU required.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU PJRT plugin ignores the JAX_PLATFORMS env var; the config
+# flag takes precedence, so force CPU explicitly.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture()
+def tmp_storage(tmp_path):
+    return str(tmp_path / "storage")

@@ -1,0 +1,75 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from determined_tpu.parallel import (
+    DEFAULT_RULES,
+    MeshAxes,
+    MeshConfig,
+    batch_sharding,
+    logical_to_mesh_spec,
+    make_mesh,
+    make_virtual_mesh,
+    shard_params,
+)
+
+
+def test_mesh_config_resolve():
+    cfg = MeshConfig(data=-1, tensor=2).resolve(8)
+    assert cfg.data == 4 and cfg.tensor == 2
+    assert cfg.num_devices == 8
+
+
+def test_mesh_config_resolve_errors():
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, fsdp=-1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=3).resolve(8)
+
+
+def test_make_mesh_axes(devices8):
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2), devices8)
+    assert mesh.shape[MeshAxes.DATA] == 2
+    assert mesh.shape[MeshAxes.FSDP] == 2
+    assert mesh.shape[MeshAxes.TENSOR] == 2
+    assert mesh.devices.size == 8
+
+
+def test_logical_to_mesh_spec_drops_trivial_axes(devices8):
+    mesh = make_mesh(MeshConfig(data=8), devices8)
+    # tensor axis has size 1 -> "mlp" resolves to nothing
+    spec = logical_to_mesh_spec(("embed", "mlp"), DEFAULT_RULES, mesh)
+    assert spec == P(None, None)
+    spec = logical_to_mesh_spec(("batch", None), DEFAULT_RULES, mesh)
+    assert spec == P(MeshAxes.DATA, None)
+
+
+def test_logical_to_mesh_spec_no_duplicate_axes(devices8):
+    mesh = make_mesh(MeshConfig(tensor=8), devices8)
+    spec = logical_to_mesh_spec(("heads", "mlp"), DEFAULT_RULES, mesh)
+    # both map to tensor; only first kept
+    assert spec == P(MeshAxes.TENSOR, None)
+
+
+def test_shard_params_places_arrays(devices8):
+    mesh = make_mesh(MeshConfig(fsdp=4, tensor=2), devices8)
+    params = {"w": jnp.zeros((16, 32)), "b": jnp.zeros((32,))}
+    specs = {"w": ("fsdp_shard", "mlp"), "b": ("mlp",)}
+    sharded = shard_params(params, specs, mesh)
+    assert sharded["w"].sharding.spec == P(MeshAxes.FSDP, MeshAxes.TENSOR)
+    np.testing.assert_array_equal(np.asarray(sharded["w"]), np.zeros((16, 32)))
+
+
+def test_batch_sharding_matmul_runs(devices8):
+    mesh = make_mesh(MeshConfig(data=8), devices8)
+    x = jnp.ones((16, 4))
+    xs = jax.device_put(x, batch_sharding(mesh))
+    out = jax.jit(lambda a: a @ jnp.ones((4, 3)))(xs)
+    assert out.shape == (16, 3)
+
+
+def test_virtual_mesh():
+    mesh = make_virtual_mesh(8, MeshConfig(data=2, seq=4))
+    assert mesh.shape[MeshAxes.SEQUENCE] == 4
