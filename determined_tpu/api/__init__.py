@@ -1,0 +1,1 @@
+from determined_tpu.api.session import Session, login, APIError, NotFoundError  # noqa: F401

@@ -1,0 +1,114 @@
+"""HTTP session to the master (reference: ``common/api/_session.py``).
+
+requests-based with bounded retries, bearer-token auth, and base-url
+joining.  This is the single transport used by the Core API contexts, the
+SDK, and the CLI.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import requests
+
+logger = logging.getLogger("determined_tpu.api")
+
+
+class APIError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class NotFoundError(APIError):
+    pass
+
+
+class Session:
+    RETRIES = 5
+    BACKOFF = 0.5
+
+    def __init__(
+        self,
+        master_url: str,
+        token: Optional[str] = None,
+        cert_path: Optional[str] = None,
+        timeout: float = 30.0,
+    ) -> None:
+        self.master_url = master_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+        self._http = requests.Session()
+        if cert_path:
+            self._http.verify = cert_path
+
+    def _headers(self) -> Dict[str, str]:
+        h = {"Content-Type": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        json: Optional[Any] = None,
+        params: Optional[Dict[str, Any]] = None,
+        stream: bool = False,
+        timeout: Optional[float] = None,
+    ) -> requests.Response:
+        url = self.master_url + (path if path.startswith("/") else "/" + path)
+        last: Optional[Exception] = None
+        for attempt in range(self.RETRIES):
+            try:
+                resp = self._http.request(
+                    method,
+                    url,
+                    json=json,
+                    params=params,
+                    headers=self._headers(),
+                    timeout=timeout or self.timeout,
+                    stream=stream,
+                )
+            except requests.ConnectionError as e:
+                last = e
+                if attempt < self.RETRIES - 1:
+                    time.sleep(self.BACKOFF * (2**attempt))
+                continue
+            if resp.status_code == 404:
+                raise NotFoundError(404, resp.text)
+            if resp.status_code >= 500:
+                last = APIError(resp.status_code, resp.text)
+                if attempt < self.RETRIES - 1:
+                    time.sleep(self.BACKOFF * (2**attempt))
+                continue
+            if resp.status_code >= 400:
+                raise APIError(resp.status_code, resp.text)
+            return resp
+        raise last if last is not None else APIError(0, "request failed")
+
+    def get(self, path: str, **kw) -> requests.Response:
+        return self.request("GET", path, **kw)
+
+    def post(self, path: str, **kw) -> requests.Response:
+        return self.request("POST", path, **kw)
+
+    def patch(self, path: str, **kw) -> requests.Response:
+        return self.request("PATCH", path, **kw)
+
+    def put(self, path: str, **kw) -> requests.Response:
+        return self.request("PUT", path, **kw)
+
+    def delete(self, path: str, **kw) -> requests.Response:
+        return self.request("DELETE", path, **kw)
+
+
+def login(master_url: str, username: str = "determined", password: str = "") -> Session:
+    """Authenticate and return a token-carrying Session."""
+    s = Session(master_url)
+    resp = s.post("/api/v1/auth/login", json={"username": username, "password": password})
+    token = resp.json().get("token")
+    return Session(master_url, token=token)

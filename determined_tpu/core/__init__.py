@@ -1,0 +1,18 @@
+from determined_tpu.core._cluster_info import ClusterInfo, get_cluster_info  # noqa: F401
+from determined_tpu.core._distributed import (  # noqa: F401
+    DistributedContext,
+    DummyDistributedContext,
+    allocate_port,
+)
+from determined_tpu.core._checkpoint import (  # noqa: F401
+    CheckpointContext,
+    DummyCheckpointContext,
+    merge_metadata,
+    merge_resources,
+)
+from determined_tpu.core._metrics import MetricsContext  # noqa: F401
+from determined_tpu.core._train import TrainContext, EarlyExitReason  # noqa: F401
+from determined_tpu.core._preempt import PreemptContext, PreemptMode  # noqa: F401
+from determined_tpu.core._profiler import ProfilerContext  # noqa: F401
+from determined_tpu.core._heartbeat import HeartbeatReporter, LogShipper  # noqa: F401
+from determined_tpu.core._context import Context, init, _dummy_init  # noqa: F401

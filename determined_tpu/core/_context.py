@@ -1,0 +1,213 @@
+"""core.Context: the composed in-training client of the platform.
+
+Reference: ``harness/determined/core/_context.py:231-398`` (``init``) and
+``:188-224`` (``_dummy_init``).  The same dummy/real split is preserved:
+``init()`` returns a fully functional Context whether or not a master
+exists, so any trial runs unchanged on a laptop, a single TPU VM, or a
+scheduled multi-host allocation.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+from determined_tpu.core._checkpoint import CheckpointContext, DummyCheckpointContext
+from determined_tpu.core._cluster_info import ClusterInfo, get_cluster_info
+from determined_tpu.core._distributed import DistributedContext, DummyDistributedContext
+from determined_tpu.core._heartbeat import HeartbeatReporter, LogShipper
+from determined_tpu.core._metrics import MetricsContext
+from determined_tpu.core._preempt import PreemptContext, PreemptMode
+from determined_tpu.core._profiler import ProfilerContext
+from determined_tpu.core._train import TrainContext
+from determined_tpu.storage.base import StorageManager, from_string
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+class Context:
+    """Composed handle: ``.distributed``, ``.checkpoint``, ``.train``,
+    ``.preempt``, ``.profiler``, ``.info``."""
+
+    def __init__(
+        self,
+        distributed: DistributedContext,
+        checkpoint: CheckpointContext,
+        train: TrainContext,
+        preempt: PreemptContext,
+        profiler: ProfilerContext,
+        metrics: MetricsContext,
+        info: Optional[ClusterInfo] = None,
+        session: Optional[Any] = None,
+        heartbeat: Optional[HeartbeatReporter] = None,
+        log_shipper: Optional[LogShipper] = None,
+    ) -> None:
+        self.distributed = distributed
+        self.checkpoint = checkpoint
+        self.train = train
+        self.preempt = preempt
+        self.profiler = profiler
+        self._metrics = metrics
+        self.info = info
+        self._session = session
+        self._heartbeat = heartbeat
+        self._log_shipper = log_shipper
+
+    def alert(
+        self,
+        title: Optional[str] = None,
+        description: Optional[str] = None,
+        level: str = "info",
+    ) -> None:
+        """Post a custom webhook event (reference ``_context.py:86-115``)."""
+        if self._session is None:
+            logger.log(
+                logging.getLevelName(level.upper()) if isinstance(level, str) else logging.INFO,
+                "ALERT: %s — %s",
+                title,
+                description,
+            )
+            return
+        try:
+            self._session.post(
+                "/api/v1/webhooks/custom",
+                json={"title": title, "description": description, "level": level},
+            )
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to post alert")
+
+    def start(self) -> "Context":
+        self._metrics.start()
+        self.preempt.start()
+        if self._heartbeat:
+            self._heartbeat.start()
+        if self._log_shipper:
+            self._log_shipper.start()
+        return self
+
+    def close(self) -> None:
+        self.profiler.off()
+        self.preempt.close()
+        self._metrics.close()
+        if self._heartbeat:
+            self._heartbeat.close()
+        if self._log_shipper:
+            self._log_shipper.close()
+        self.distributed.close()
+
+    def __enter__(self) -> "Context":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+def init(
+    *,
+    distributed: Optional[DistributedContext] = None,
+    storage_manager: Optional[StorageManager] = None,
+    checkpoint_storage: Optional[str] = None,
+    preempt_mode: PreemptMode = PreemptMode.WorkersAskChief,
+    session: Optional[Any] = None,
+    metrics_path: Optional[str] = None,
+) -> Context:
+    """Build a Context from cluster info when present, dummies otherwise."""
+    info = get_cluster_info()
+
+    if session is None and info is not None and info.master_url:
+        from determined_tpu.api.session import Session
+
+        session = Session(info.master_url, token=info.session_token or None)
+
+    if distributed is None:
+        if info is not None and info.rendezvous:
+            distributed = DistributedContext.from_jax()
+        else:
+            distributed = DummyDistributedContext()
+
+    if storage_manager is None:
+        url = checkpoint_storage
+        if url is None and info is not None:
+            url = (info.exp_config or {}).get("checkpoint_storage")
+        if url is None:
+            url = os.path.join(os.getcwd(), "checkpoints")
+        storage_manager = from_string(url) if isinstance(url, str) else url
+
+    checkpoint = CheckpointContext(
+        distributed,
+        storage_manager,
+        session=session,
+        trial_id=info.trial_id if info else None,
+        staging_dir=tempfile.mkdtemp(prefix="dtpu-ckpt-"),
+    )
+    metrics = MetricsContext(
+        session=session,
+        trial_id=info.trial_id if info else None,
+        run_id=info.trial_run_id if info else 0,
+        local_path=metrics_path
+        or (None if session else os.path.join(os.getcwd(), "metrics.jsonl")),
+    )
+    train = TrainContext(
+        distributed,
+        metrics,
+        session=session,
+        trial_id=info.trial_id if info else None,
+        experiment_id=info.experiment_id if info else None,
+    )
+    preempt = PreemptContext(
+        distributed,
+        session=session,
+        allocation_id=info.allocation_id if info else None,
+        mode=preempt_mode,
+    )
+    profiler = ProfilerContext(distributed, metrics)
+    heartbeat = (
+        HeartbeatReporter(session, info.trial_id)
+        if session is not None and info is not None and info.trial_id is not None
+        else None
+    )
+    log_shipper = (
+        LogShipper(session, info.task_id)
+        if session is not None and info is not None and info.task_id
+        else None
+    )
+    ctx = Context(
+        distributed=distributed,
+        checkpoint=checkpoint,
+        train=train,
+        preempt=preempt,
+        profiler=profiler,
+        metrics=metrics,
+        info=info,
+        session=session,
+        heartbeat=heartbeat,
+        log_shipper=log_shipper,
+    )
+    return ctx.start()
+
+
+def _dummy_init(
+    *,
+    distributed: Optional[DistributedContext] = None,
+    checkpoint_dir: Optional[str] = None,
+    metrics_path: Optional[str] = None,
+) -> Context:
+    """Fully local Context with zero services (reference ``_dummy_init``)."""
+    distributed = distributed or DummyDistributedContext()
+    checkpoint_dir = checkpoint_dir or tempfile.mkdtemp(prefix="dtpu-dummy-ckpt-")
+    checkpoint = DummyCheckpointContext(distributed, checkpoint_dir)
+    metrics = MetricsContext(local_path=metrics_path)
+    train = TrainContext(distributed, metrics)
+    preempt = PreemptContext(distributed, register_signal_handler=False)
+    profiler = ProfilerContext(distributed, metrics)
+    ctx = Context(
+        distributed=distributed,
+        checkpoint=checkpoint,
+        train=train,
+        preempt=preempt,
+        profiler=profiler,
+        metrics=metrics,
+    )
+    return ctx.start()

@@ -1,0 +1,378 @@
+"""Control-plane distributed context.
+
+TPU-native split of responsibilities (reference: ``core/_distributed.py`` +
+``ipc.py``):
+
+- **Tensor-plane** collectives (gradient psums, all_gathers) are XLA's job,
+  compiled into the jitted step over ICI/DCN.  They never appear here.
+- **Control-plane** collectives (checkpoint shard-list merge, preemption
+  broadcast, rendezvous of non-tensor facts) are tiny, rare, and
+  host-side: a chief-rooted star over TCP sockets (the reference used a
+  ZMQ pub-sub + push-pull star, ``ipc.py:34-246``).
+
+One DistributedContext per process.  Rank structure mirrors the
+reference (``_distributed.py:16-120``): ``rank``/``size`` are global,
+``local_rank``/``local_size`` within a host, ``cross_rank``/``cross_size``
+across hosts.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("determined_tpu.core.distributed")
+
+_LEN = struct.Struct(">Q")
+
+
+def allocate_port(host: str = "127.0.0.1") -> int:
+    """Bind-and-release to find a free TCP port (test/rendezvous helper)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, length))
+
+
+class _StarServer:
+    """Chief side of the star: accepts ``n_workers`` identified connections."""
+
+    def __init__(self, port: int, n_workers: int, host: str = "0.0.0.0") -> None:
+        self.n_workers = n_workers
+        self._conns: Dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._ready = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self.port = self._listener.getsockname()[1]
+        self._listener.listen(max(n_workers, 1))
+        if n_workers == 0:
+            self._ready.set()
+        else:
+            self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+            self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    if len(self._conns) >= self.n_workers:
+                        break
+                conn, _ = self._listener.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                hello = _recv_msg(conn)
+                with self._lock:
+                    self._conns[hello["rank"]] = conn
+                    done = len(self._conns) >= self.n_workers
+                if done:
+                    break
+        except OSError:
+            return  # listener closed during shutdown
+        self._ready.set()
+
+    def wait_ready(self, timeout: float) -> None:
+        if not self._ready.wait(timeout):
+            with self._lock:
+                have = sorted(self._conns)
+            raise TimeoutError(
+                f"star rendezvous timed out: {len(have)}/{self.n_workers} workers "
+                f"connected (ranks {have})"
+            )
+
+    def gather(self, own: Any, timeout: float) -> List[Any]:
+        self.wait_ready(timeout)
+        out: Dict[int, Any] = {0: own} if 0 not in self._conns else {}
+        for rank, conn in self._conns.items():
+            out[rank] = _recv_msg(conn)
+        # ranks of workers + chief's own slot; caller supplies ordering map
+        return [out[k] for k in sorted(out)]
+
+    def scatter_same(self, value: Any, timeout: float) -> None:
+        self.wait_ready(timeout)
+        for conn in self._conns.values():
+            _send_msg(conn, value)
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._listener.close()
+
+
+class _StarClient:
+    """Worker side: one persistent framed-pickle connection to the chief."""
+
+    def __init__(self, addr: str, port: int, rank: int, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection((addr, port), timeout=timeout)
+                break
+            except OSError as e:
+                last_err = e
+                time.sleep(0.1)
+        else:
+            raise ConnectionError(f"could not reach chief at {addr}:{port}: {last_err}")
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(self._sock, {"rank": rank})
+
+    def send(self, obj: Any) -> None:
+        _send_msg(self._sock, obj)
+
+    def recv(self) -> Any:
+        return _recv_msg(self._sock)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _Star:
+    """A gather/allgather/broadcast group of ``size`` ranks rooted at 0."""
+
+    def __init__(
+        self,
+        group_rank: int,
+        size: int,
+        chief_addr: str,
+        chief_port: int,
+        timeout: float = 600.0,
+        bind_host: str = "0.0.0.0",
+    ) -> None:
+        self.group_rank = group_rank
+        self.size = size
+        self.timeout = timeout
+        self.server: Optional[_StarServer] = None
+        self.client: Optional[_StarClient] = None
+        self._addr = (chief_addr, chief_port, bind_host)
+        # The chief binds eagerly (workers must have something to retry
+        # against); workers connect lazily on first collective so ranks
+        # that never communicate need no live chief.
+        if size > 1 and group_rank == 0:
+            self.server = _StarServer(chief_port, size - 1, host=bind_host)
+
+    def _ensure_connected(self) -> None:
+        if self.size <= 1 or self.group_rank == 0 or self.client is not None:
+            return
+        addr, port, _ = self._addr
+        self.client = _StarClient(addr, port, self.group_rank, self.timeout)
+
+    def gather(self, obj: Any) -> Optional[List[Any]]:
+        if self.size <= 1:
+            return [obj]
+        self._ensure_connected()
+        if self.server is not None:
+            return self.server.gather(obj, self.timeout)
+        assert self.client is not None
+        self.client.send(obj)
+        return None
+
+    def allgather(self, obj: Any) -> List[Any]:
+        if self.size <= 1:
+            return [obj]
+        self._ensure_connected()
+        if self.server is not None:
+            result = self.server.gather(obj, self.timeout)
+            self.server.scatter_same(result, self.timeout)
+            return result
+        assert self.client is not None
+        self.client.send(obj)
+        return self.client.recv()
+
+    def broadcast(self, obj: Any) -> Any:
+        if self.size <= 1:
+            return obj
+        self._ensure_connected()
+        if self.server is not None:
+            self.server.scatter_same(obj, self.timeout)
+            return obj
+        assert self.client is not None
+        return self.client.recv()
+
+    def barrier(self) -> None:
+        self.allgather(None)
+
+    def close(self) -> None:
+        if self.server:
+            self.server.close()
+        if self.client:
+            self.client.close()
+
+
+class DistributedContext:
+    """Rank bookkeeping + control-plane collectives.
+
+    Two stars, like the reference (``_distributed.py:91-168``): a global
+    star rooted at rank 0 (the chief) and a per-host star rooted at each
+    host's local chief.
+    """
+
+    def __init__(
+        self,
+        *,
+        rank: int,
+        size: int,
+        local_rank: Optional[int] = None,
+        local_size: int = 1,
+        cross_rank: Optional[int] = None,
+        cross_size: Optional[int] = None,
+        chief_addr: Optional[str] = None,
+        chief_port: Optional[int] = None,
+        local_chief_port: Optional[int] = None,
+        timeout: float = 600.0,
+    ) -> None:
+        if size > 1 and (chief_addr is None or chief_port is None):
+            raise ValueError("multi-rank DistributedContext requires chief_addr/chief_port")
+        # Infer the node topology when not given: one process per node by
+        # default (local_size=1), so cross follows from rank/local_size.
+        if local_rank is None:
+            local_rank = rank % local_size
+        if cross_size is None:
+            cross_size = size // local_size
+        if cross_rank is None:
+            cross_rank = rank // local_size
+        if local_size * cross_size != size:
+            raise ValueError(
+                f"local_size ({local_size}) x cross_size ({cross_size}) != size ({size})"
+            )
+        self.rank = rank
+        self.size = size
+        self.local_rank = local_rank
+        self.local_size = local_size
+        self.cross_rank = cross_rank
+        self.cross_size = cross_size
+        self._closed = False
+
+        self._global = _Star(rank, size, chief_addr or "127.0.0.1", chief_port or 0, timeout)
+        if local_size > 1:
+            lport = local_chief_port if local_chief_port is not None else (chief_port or 0) + 1
+            self._local = _Star(
+                local_rank, local_size, "127.0.0.1", lport, timeout, bind_host="127.0.0.1"
+            )
+        else:
+            self._local = _Star(0, 1, "127.0.0.1", 0, timeout)
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_jax(cls, timeout: float = 600.0) -> "DistributedContext":
+        """Build from an initialized ``jax.distributed`` runtime plus the
+        DTPU_* rendezvous env vars written by the launch layer."""
+        import jax
+
+        size = jax.process_count()
+        rank = jax.process_index()
+        chief_addr = os.environ.get("DTPU_CHIEF_ADDR", "127.0.0.1")
+        chief_port = int(os.environ.get("DTPU_CHIEF_PORT", "0") or 0)
+        local_size = int(os.environ.get("DTPU_LOCAL_SIZE", "1"))
+        local_rank = int(os.environ.get("DTPU_LOCAL_RANK", "0"))
+        return cls(
+            rank=rank,
+            size=size,
+            local_rank=local_rank,
+            local_size=local_size,
+            cross_rank=rank // max(local_size, 1),
+            cross_size=max(size // max(local_size, 1), 1),
+            chief_addr=chief_addr,
+            chief_port=chief_port or None,
+            timeout=timeout,
+        )
+
+    @classmethod
+    def single(cls) -> "DistributedContext":
+        return cls(rank=0, size=1)
+
+    # -- predicates --------------------------------------------------------
+
+    def get_rank(self) -> int:
+        return self.rank
+
+    def get_size(self) -> int:
+        return self.size
+
+    @property
+    def is_chief(self) -> bool:
+        return self.rank == 0
+
+    @property
+    def is_local_chief(self) -> bool:
+        return self.local_rank == 0
+
+    # -- collectives -------------------------------------------------------
+
+    def gather(self, obj: Any) -> Optional[List[Any]]:
+        """Chief returns [rank0_obj, rank1_obj, ...]; workers return None."""
+        return self._global.gather(obj)
+
+    def allgather(self, obj: Any) -> List[Any]:
+        return self._global.allgather(obj)
+
+    def broadcast(self, obj: Any) -> Any:
+        """Chief's ``obj`` is returned on every rank."""
+        return self._global.broadcast(obj)
+
+    def gather_local(self, obj: Any) -> Optional[List[Any]]:
+        return self._local.gather(obj)
+
+    def allgather_local(self, obj: Any) -> List[Any]:
+        return self._local.allgather(obj)
+
+    def broadcast_local(self, obj: Any = None) -> Any:
+        return self._local.broadcast(obj)
+
+    def barrier(self) -> None:
+        self._global.barrier()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._global.close()
+        self._local.close()
+
+    def __enter__(self) -> "DistributedContext":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class DummyDistributedContext(DistributedContext):
+    """Single-rank context for off-cluster runs (reference ``_dummy_init``)."""
+
+    def __init__(self) -> None:
+        super().__init__(rank=0, size=1)

@@ -1,0 +1,138 @@
+"""Heartbeat + log shipping for unmanaged / managed trials.
+
+Reference: ``core/_heartbeat.py`` (liveness POSTs so the master can mark
+dead unmanaged runs) and ``core/_log_shipper.py`` (stdout/stderr
+interceptor shipping log batches to the task-logs API).
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import queue
+import sys
+import threading
+import time
+from typing import Any, List, Optional
+
+logger = logging.getLogger("determined_tpu.core.heartbeat")
+
+
+class HeartbeatReporter:
+    INTERVAL = 30.0
+
+    def __init__(self, session: Any, trial_id: int) -> None:
+        self._session = session
+        self._trial_id = trial_id
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True, name="heartbeat")
+
+    def start(self) -> "HeartbeatReporter":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.INTERVAL):
+            try:
+                self._session.post(f"/api/v1/trials/{self._trial_id}/heartbeat")
+            except Exception:  # noqa: BLE001
+                logger.debug("heartbeat failed", exc_info=True)
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class _Interceptor(io.TextIOBase):
+    """Tee for a text stream that also enqueues lines for shipping
+    (reference ``_log_shipper.py _Interceptor:62``)."""
+
+    def __init__(self, underlying, sink: "queue.Queue[Optional[str]]", stream_name: str) -> None:
+        self._underlying = underlying
+        self._sink = sink
+        self._name = stream_name
+        self._buf = ""
+
+    def write(self, s: str) -> int:
+        n = self._underlying.write(s)
+        self._buf += s
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            self._sink.put(f"[{self._name}] {line}")
+        return n
+
+    def flush(self) -> None:
+        self._underlying.flush()
+
+    @property
+    def underlying(self):
+        return self._underlying
+
+
+class LogShipper:
+    """Intercepts stdout/stderr and ships batched log lines to the master
+    task-logs API (or drops them off-cluster)."""
+
+    FLUSH_INTERVAL = 1.0
+    MAX_BATCH = 500
+
+    def __init__(self, session: Optional[Any], task_id: Optional[str]) -> None:
+        self._session = session
+        self._task_id = task_id
+        self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True, name="log-shipper")
+        self._installed = False
+
+    def start(self) -> "LogShipper":
+        if self._session is None:
+            return self  # nothing to ship to
+        sys.stdout = _Interceptor(sys.stdout, self._queue, "stdout")
+        sys.stderr = _Interceptor(sys.stderr, self._queue, "stderr")
+        self._installed = True
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        done = False
+        while not done:
+            batch: List[str] = []
+            try:
+                item = self._queue.get(timeout=self.FLUSH_INTERVAL)
+                if item is None:
+                    done = True
+                else:
+                    batch.append(item)
+            except queue.Empty:
+                pass
+            while len(batch) < self.MAX_BATCH:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:
+                    done = True
+                    break
+                batch.append(item)
+            if batch and self._session is not None:
+                try:
+                    self._session.post(
+                        "/api/v1/task_logs",
+                        json={
+                            "task_id": self._task_id,
+                            "logs": [
+                                {"log": line, "timestamp": time.time()} for line in batch
+                            ],
+                        },
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def close(self) -> None:
+        if not self._installed:
+            return
+        if isinstance(sys.stdout, _Interceptor):
+            sys.stdout = sys.stdout.underlying
+        if isinstance(sys.stderr, _Interceptor):
+            sys.stderr = sys.stderr.underlying
+        self._queue.put(None)
+        self._thread.join(timeout=10)
+        self._installed = False

@@ -1,0 +1,133 @@
+"""Background metric shipper (reference: ``core/_metrics.py:13-206``).
+
+Training code must never block on metric I/O (on TPU a host sync in the
+hot loop stalls the device pipeline), so reports are enqueued and a
+daemon thread batches them to the sink: the master's metrics API when a
+session exists, else a local JSONL file.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("determined_tpu.core.metrics")
+
+SHIP_INTERVAL = 1.0  # seconds between batch flushes
+MAX_BATCH = 1000
+
+
+class MetricsContext:
+    def __init__(
+        self,
+        session: Optional[Any] = None,
+        trial_id: Optional[int] = None,
+        run_id: int = 0,
+        local_path: Optional[str] = None,
+    ) -> None:
+        self._session = session
+        self._trial_id = trial_id
+        self._run_id = run_id
+        self._local_path = local_path
+        self._queue: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True, name="metrics-shipper")
+        self._started = False
+
+    def start(self) -> "MetricsContext":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def report(
+        self,
+        group: str,
+        steps_completed: Optional[int],
+        metrics: Dict[str, Any],
+        report_time: Optional[float] = None,
+    ) -> None:
+        if self._error is not None:
+            raise RuntimeError("metrics shipper thread died") from self._error
+        self._queue.put(
+            {
+                "group": group,
+                "steps_completed": steps_completed,
+                "metrics": metrics,
+                "report_time": report_time if report_time is not None else time.time(),
+                "trial_id": self._trial_id,
+                "trial_run_id": self._run_id,
+            }
+        )
+
+    def close(self) -> None:
+        if not self._started:
+            return
+        self._queue.put(None)
+        self._thread.join(timeout=30)
+        self._started = False
+
+    # -- shipper thread ----------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            done = False
+            while not done:
+                batch: List[Dict[str, Any]] = []
+                try:
+                    item = self._queue.get(timeout=SHIP_INTERVAL)
+                    if item is None:
+                        done = True
+                    else:
+                        batch.append(item)
+                except queue.Empty:
+                    pass
+                while len(batch) < MAX_BATCH:
+                    try:
+                        item = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        done = True
+                        break
+                    batch.append(item)
+                if batch:
+                    try:
+                        self._ship(batch)
+                    except Exception:  # noqa: BLE001
+                        # Metric shipping must never kill training: drop the
+                        # batch, keep the thread alive for the next one.
+                        logger.exception("failed to ship %d metrics; dropped", len(batch))
+        except BaseException as e:  # noqa: BLE001
+            self._error = e
+            logger.exception("metrics shipper thread failed")
+
+    def _ship(self, batch: List[Dict[str, Any]]) -> None:
+        if self._session is not None:
+            self._session.post("/api/v1/trials/metrics", json={"metrics": batch})
+            return
+        if self._local_path is not None:
+            os.makedirs(os.path.dirname(self._local_path) or ".", exist_ok=True)
+            with open(self._local_path, "a") as f:
+                for m in batch:
+                    f.write(json.dumps(m, default=_json_default) + "\n")
+
+
+def _json_default(o: Any) -> Any:
+    try:
+        import numpy as np
+
+        if isinstance(o, (np.integer,)):
+            return int(o)
+        if isinstance(o, (np.floating,)):
+            return float(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except ImportError:
+        pass
+    return str(o)

@@ -1,0 +1,172 @@
+"""PreemptContext: cooperative preemption for trials.
+
+Reference: ``core/_preempt.py:15-313`` — a watcher thread long-polls the
+master's preemption-signal endpoint; the chief decides, workers learn the
+decision via a control-plane broadcast at batch boundaries; ack on exit.
+
+TPU-native addition: Cloud TPU VMs receive maintenance/preemption as a
+**SIGTERM** on the host, so the watcher also latches OS signals — the
+analog of the reference's Slurm SIGTERM -> pending_preemption path
+(``exec/launch.py:18-55``).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import signal
+import threading
+import time
+from typing import Any, Optional
+
+from determined_tpu.core._distributed import DistributedContext
+
+logger = logging.getLogger("determined_tpu.core.preempt")
+
+
+class PreemptMode(enum.Enum):
+    """Who talks to the master, who syncs with whom
+    (reference ``_preempt.py:124-146``)."""
+
+    WorkersAskChief = "workers_ask_chief"
+    ChiefOnly = "chief_only"
+    WorkersAskMaster = "workers_ask_master"
+
+
+class _PreemptionWatcher(threading.Thread):
+    """Polls the master for the preemption flag (long-poll in the
+    reference, ``_preempt.py:54-98``); also latched by signal handler."""
+
+    def __init__(self, session: Any, allocation_id: str, poll_interval: float = 5.0) -> None:
+        super().__init__(daemon=True, name="preemption-watcher")
+        self._session = session
+        self._allocation_id = allocation_id
+        self._poll_interval = poll_interval
+        self._flag = threading.Event()
+        self._stop = threading.Event()
+
+    @property
+    def preempted(self) -> bool:
+        return self._flag.is_set()
+
+    def latch(self) -> None:
+        self._flag.set()
+
+    def run(self) -> None:
+        while not self._stop.is_set() and not self._flag.is_set():
+            try:
+                resp = self._session.get(
+                    f"/api/v1/allocations/{self._allocation_id}/signals/preemption",
+                    params={"timeout_seconds": 60},
+                )
+                if resp.json().get("preempt"):
+                    self._flag.set()
+                    return
+            except Exception:  # noqa: BLE001
+                logger.debug("preemption poll failed; retrying", exc_info=True)
+            self._stop.wait(self._poll_interval)
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class PreemptContext:
+    def __init__(
+        self,
+        dist: DistributedContext,
+        session: Optional[Any] = None,
+        allocation_id: Optional[str] = None,
+        mode: PreemptMode = PreemptMode.WorkersAskChief,
+        register_signal_handler: bool = True,
+    ) -> None:
+        self._dist = dist
+        self._session = session
+        self._allocation_id = allocation_id
+        self._mode = mode
+        self._watcher: Optional[_PreemptionWatcher] = None
+        self._local_flag = threading.Event()
+        self._acked = False
+        self._started = False
+        self._register_signal_handler = register_signal_handler
+        self._prev_sigterm: Any = None
+
+    def start(self) -> "PreemptContext":
+        if self._started:
+            return self
+        self._started = True
+        watch_master = (
+            self._session is not None
+            and bool(self._allocation_id)
+            and (self._mode == PreemptMode.WorkersAskMaster or self._dist.is_chief)
+        )
+        if watch_master:
+            self._watcher = _PreemptionWatcher(self._session, self._allocation_id or "")
+            self._watcher.start()
+        if self._register_signal_handler and threading.current_thread() is threading.main_thread():
+            self._prev_sigterm = signal.getsignal(signal.SIGTERM)
+            signal.signal(signal.SIGTERM, self._on_sigterm)
+        return self
+
+    def _on_sigterm(self, signum, frame) -> None:
+        logger.warning("SIGTERM received: latching preemption flag")
+        self._local_flag.set()
+        if self._watcher is not None:
+            self._watcher.latch()
+        if callable(self._prev_sigterm):
+            self._prev_sigterm(signum, frame)
+
+    def _flag(self) -> bool:
+        if self._local_flag.is_set():
+            return True
+        return self._watcher.preempted if self._watcher is not None else False
+
+    def should_preempt(self, auto_ack: bool = True) -> bool:
+        """Collective at batch boundaries under WorkersAskChief: the chief
+        reads the flag and broadcasts so every rank acts in the same step."""
+        if not self._started:
+            raise RuntimeError("PreemptContext not started")
+        if self._mode == PreemptMode.WorkersAskChief:
+            # allgather (not chief broadcast) so a SIGTERM delivered to ANY
+            # host — TPU maintenance events hit individual hosts — triggers
+            # a coordinated checkpoint+exit on every rank.
+            out = any(self._dist.allgather(self._flag()))
+        elif self._mode == PreemptMode.ChiefOnly:
+            if not self._dist.is_chief:
+                raise RuntimeError("ChiefOnly mode: only the chief may call should_preempt")
+            out = self._flag()
+        else:
+            out = self._flag()
+        if out and auto_ack:
+            self.acknowledge_preemption_signal()
+        return out
+
+    def simulate(self) -> None:
+        """Programmatically trigger preemption (tests / local orchestrator)."""
+        self._local_flag.set()
+
+    def acknowledge_preemption_signal(self) -> None:
+        """Tell the master we saw the signal and will checkpoint+exit
+        (reference ``_preempt.py:257``)."""
+        if self._acked or not self._dist.is_chief:
+            return
+        self._acked = True
+        if self._session is not None and self._allocation_id:
+            try:
+                self._session.post(
+                    f"/api/v1/allocations/{self._allocation_id}/signals/ack_preemption"
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("failed to ack preemption")
+
+    def close(self) -> None:
+        if self._watcher is not None:
+            self._watcher.close()
+        if (
+            self._register_signal_handler
+            and self._prev_sigterm is not None
+            and threading.current_thread() is threading.main_thread()
+        ):
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except (ValueError, TypeError):
+                pass

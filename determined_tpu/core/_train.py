@@ -1,0 +1,100 @@
+"""TrainContext: metric/progress/status reporting (reference ``core/_train.py:20-344``)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from determined_tpu.core._distributed import DistributedContext
+from determined_tpu.core._metrics import MetricsContext
+
+logger = logging.getLogger("determined_tpu.core.train")
+
+TRAINING = "training"
+VALIDATION = "validation"
+
+
+class EarlyExitReason:
+    INVALID_HP = "EXITED_REASON_INVALID_HP"
+    USER_REQUESTED_STOP = "EXITED_REASON_USER_REQUESTED_STOP"
+
+
+class TrainContext:
+    def __init__(
+        self,
+        dist: DistributedContext,
+        metrics: MetricsContext,
+        session: Optional[Any] = None,
+        trial_id: Optional[int] = None,
+        experiment_id: Optional[int] = None,
+    ) -> None:
+        self._dist = dist
+        self._metrics = metrics
+        self._session = session
+        self._trial_id = trial_id
+        self._experiment_id = experiment_id
+        self.searcher_metric_name: Optional[str] = None
+        self._last_progress: Optional[float] = None
+
+    # -- reporting ---------------------------------------------------------
+
+    def report_training_metrics(
+        self, steps_completed: int, metrics: Dict[str, Any],
+        batch_metrics: Optional[list] = None,
+    ) -> None:
+        body = dict(metrics)
+        if batch_metrics is not None:
+            body["batch_metrics"] = batch_metrics
+        self.report_metrics(TRAINING, steps_completed, body)
+
+    def report_validation_metrics(self, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        self.report_metrics(VALIDATION, steps_completed, metrics)
+
+    def report_metrics(self, group: str, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        """Arbitrary metric groups, like the reference's generic
+        ``report_metrics`` (``_train.py:167``)."""
+        if not self._dist.is_chief:
+            raise RuntimeError("report_metrics must only be called on the chief")
+        self._metrics.report(group, steps_completed, metrics)
+
+    def report_progress(self, progress: float) -> None:
+        if not self._dist.is_chief:
+            return
+        self._last_progress = progress
+        if self._session is not None and self._trial_id is not None:
+            try:
+                self._session.post(
+                    f"/api/v1/trials/{self._trial_id}/progress", json={"progress": progress}
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("failed to report progress")
+
+    def report_early_exit(self, reason: str) -> None:
+        if self._session is not None and self._trial_id is not None:
+            try:
+                self._session.post(
+                    f"/api/v1/trials/{self._trial_id}/early_exit", json={"reason": reason}
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("failed to report early exit")
+
+    def set_status(self, status: str) -> None:
+        if self._session is not None and self._trial_id is not None:
+            try:
+                self._session.post(
+                    f"/api/v1/trials/{self._trial_id}/runner_metadata",
+                    json={"state": status},
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+    def get_experiment_best_validation(self) -> Optional[float]:
+        if self._session is None or self._experiment_id is None:
+            return None
+        try:
+            resp = self._session.get(
+                f"/api/v1/experiments/{self._experiment_id}/searcher_metric_best"
+            )
+            return resp.json().get("best")
+        except Exception:  # noqa: BLE001
+            return None

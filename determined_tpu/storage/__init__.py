@@ -1,0 +1,10 @@
+from determined_tpu.storage.base import (  # noqa: F401
+    StorageManager,
+    from_string,
+    file_md5,
+    list_directory,
+)
+from determined_tpu.storage.shared_fs import (  # noqa: F401
+    SharedFSStorageManager,
+    DirectoryStorageManager,
+)

@@ -1,0 +1,125 @@
+"""Storage manager interface (reference: harness/determined/common/storage/).
+
+A StorageManager moves checkpoint directories between a local staging path
+and durable storage.  Backends: shared_fs, directory (bind-mounted),
+s3/gcs/azure (gated on their SDKs).  ``from_string`` parses
+"s3://bucket/prefix"-style URLs like the reference's
+``storage/__init__.py from_string``.
+"""
+
+from __future__ import annotations
+
+import abc
+import contextlib
+import hashlib
+import os
+import shutil
+from typing import Callable, Dict, Iterator, List, Optional
+
+
+def file_md5(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def list_directory(root: str) -> Dict[str, int]:
+    """Relative-path -> size map of every file under root (dirs get size 0,
+    trailing slash), matching the reference's resources dict shape."""
+    out: Dict[str, int] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel = os.path.relpath(dirpath, root)
+        if rel != ".":
+            out[rel + "/"] = 0
+        for fn in filenames:
+            full = os.path.join(dirpath, fn)
+            out[os.path.join("" if rel == "." else rel, fn)] = os.path.getsize(full)
+    return out
+
+
+class StorageManager(abc.ABC):
+    """Upload/download whole checkpoint directories keyed by storage_id."""
+
+    @abc.abstractmethod
+    def upload(
+        self,
+        src: str,
+        storage_id: str,
+        paths: Optional[List[str]] = None,
+        progress: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        ...
+
+    @abc.abstractmethod
+    def download(
+        self,
+        storage_id: str,
+        dst: str,
+        selector: Optional[Callable[[str], bool]] = None,
+    ) -> None:
+        ...
+
+    @abc.abstractmethod
+    def delete(self, storage_id: str, globs: Optional[List[str]] = None) -> Dict[str, int]:
+        """Delete (all or glob-matched) files; returns remaining resources."""
+
+    @abc.abstractmethod
+    def list_files(self, storage_id: str) -> Dict[str, int]:
+        ...
+
+    @contextlib.contextmanager
+    def restore_path(self, storage_id: str, staging_dir: str) -> Iterator[str]:
+        """Download into a staging dir, yield it, clean up after."""
+        dst = os.path.join(staging_dir, storage_id)
+        os.makedirs(dst, exist_ok=True)
+        self.download(storage_id, dst)
+        try:
+            yield dst
+        finally:
+            shutil.rmtree(dst, ignore_errors=True)
+
+    # Backends that expose checkpoints as plain paths (shared_fs) override
+    # store_path to avoid the copy; default stages then uploads.
+    @contextlib.contextmanager
+    def store_path(self, storage_id: str, staging_dir: str) -> Iterator[str]:
+        src = os.path.join(staging_dir, storage_id)
+        os.makedirs(src, exist_ok=True)
+        yield src
+        self.upload(src, storage_id)
+        shutil.rmtree(src, ignore_errors=True)
+
+
+def from_string(url: str, **kwargs) -> StorageManager:
+    """Build a StorageManager from a URL-ish string.
+
+    - "/abs/path" or "shared_fs:///abs/path" -> SharedFSStorageManager
+    - "directory:///abs/path" -> DirectoryStorageManager
+    - "s3://bucket/prefix", "gs://...", "azure://..." -> cloud backends
+      (raise if their SDK is unavailable in this image).
+    """
+    from determined_tpu.storage.shared_fs import SharedFSStorageManager, DirectoryStorageManager
+
+    if url.startswith("shared_fs://"):
+        return SharedFSStorageManager(url[len("shared_fs://"):], **kwargs)
+    if url.startswith("directory://"):
+        return DirectoryStorageManager(url[len("directory://"):], **kwargs)
+    if url.startswith("s3://"):
+        from determined_tpu.storage.cloud import S3StorageManager
+
+        return S3StorageManager.from_url(url, **kwargs)
+    if url.startswith(("gs://", "gcs://")):
+        from determined_tpu.storage.cloud import GCSStorageManager
+
+        return GCSStorageManager.from_url(url, **kwargs)
+    if url.startswith("azure://"):
+        from determined_tpu.storage.cloud import AzureStorageManager
+
+        return AzureStorageManager.from_url(url, **kwargs)
+    if "://" in url:
+        raise ValueError(f"unknown storage scheme: {url}")
+    return SharedFSStorageManager(url, **kwargs)

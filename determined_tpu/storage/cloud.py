@@ -1,0 +1,182 @@
+"""Cloud storage backends: S3 / GCS / Azure (reference: common/storage/{s3,gcs,azure}.py).
+
+The runtime image does not bake boto3 / google-cloud-storage / azure SDKs;
+these managers import lazily and raise a clear error when unavailable, so
+`from_string("s3://...")` still parses and the rest of the platform is
+unaffected.
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+from typing import Callable, Dict, List, Optional
+
+from determined_tpu.storage.base import StorageManager, list_directory
+from determined_tpu.utils.errors import CheckpointNotFoundError
+
+
+class _BlobStorageManager(StorageManager):
+    """Shared logic over a minimal blob client interface."""
+
+    def __init__(self, bucket: str, prefix: str = "") -> None:
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+
+    @classmethod
+    def from_url(cls, url: str, **kwargs) -> "_BlobStorageManager":
+        rest = url.split("://", 1)[1]
+        bucket, _, prefix = rest.partition("/")
+        return cls(bucket, prefix, **kwargs)
+
+    def _key(self, storage_id: str, rel: str = "") -> str:
+        parts = [p for p in (self.prefix, storage_id, rel) if p]
+        return posixpath.join(*parts)
+
+    # blob primitives supplied by subclasses
+    def _put(self, key: str, local_path: str) -> None:
+        raise NotImplementedError
+
+    def _get(self, key: str, local_path: str) -> None:
+        raise NotImplementedError
+
+    def _list(self, key_prefix: str) -> Dict[str, int]:
+        raise NotImplementedError
+
+    def _delete(self, keys: List[str]) -> None:
+        raise NotImplementedError
+
+    def upload(self, src, storage_id, paths=None, progress=None) -> None:
+        names = paths if paths is not None else list(list_directory(src))
+        done = 0
+        for rel in names:
+            if rel.endswith("/"):
+                continue
+            self._put(self._key(storage_id, rel), os.path.join(src, rel))
+            done += 1
+            if progress:
+                progress(done)
+
+    def download(self, storage_id, dst, selector=None) -> None:
+        base = self._key(storage_id)
+        files = self._list(base)
+        if not files:
+            raise CheckpointNotFoundError(f"checkpoint {storage_id} not found in {self.bucket}")
+        for rel in files:
+            if selector is not None and not selector(rel):
+                continue
+            local = os.path.join(dst, rel)
+            os.makedirs(os.path.dirname(local) or dst, exist_ok=True)
+            self._get(posixpath.join(base, rel), local)
+
+    def delete(self, storage_id, globs=None) -> Dict[str, int]:
+        import fnmatch
+
+        base = self._key(storage_id)
+        files = self._list(base)
+        if globs is None:
+            self._delete([posixpath.join(base, rel) for rel in files])
+            return {}
+        doomed = [
+            rel
+            for rel in files
+            if any(fnmatch.fnmatch(rel, g) or fnmatch.fnmatch("/" + rel, g) for g in globs)
+        ]
+        self._delete([posixpath.join(base, rel) for rel in doomed])
+        return {rel: sz for rel, sz in files.items() if rel not in set(doomed)}
+
+    def list_files(self, storage_id) -> Dict[str, int]:
+        return self._list(self._key(storage_id))
+
+
+class S3StorageManager(_BlobStorageManager):
+    def __init__(self, bucket: str, prefix: str = "", endpoint_url: Optional[str] = None) -> None:
+        super().__init__(bucket, prefix)
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:
+            raise ImportError(
+                "s3:// storage requires boto3, which is not installed in this image"
+            ) from e
+        self._client = boto3.client("s3", endpoint_url=endpoint_url)
+
+    def _put(self, key, local_path):
+        self._client.upload_file(local_path, self.bucket, key)
+
+    def _get(self, key, local_path):
+        self._client.download_file(self.bucket, key, local_path)
+
+    def _list(self, key_prefix):
+        out: Dict[str, int] = {}
+        paginator = self._client.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=self.bucket, Prefix=key_prefix + "/"):
+            for obj in page.get("Contents", []):
+                out[posixpath.relpath(obj["Key"], key_prefix)] = obj["Size"]
+        return out
+
+    def _delete(self, keys):
+        for i in range(0, len(keys), 1000):
+            self._client.delete_objects(
+                Bucket=self.bucket,
+                Delete={"Objects": [{"Key": k} for k in keys[i : i + 1000]]},
+            )
+
+
+class GCSStorageManager(_BlobStorageManager):
+    def __init__(self, bucket: str, prefix: str = "") -> None:
+        super().__init__(bucket, prefix)
+        try:
+            from google.cloud import storage  # type: ignore
+        except ImportError as e:
+            raise ImportError(
+                "gs:// storage requires google-cloud-storage, not installed in this image"
+            ) from e
+        self._bucket = storage.Client().bucket(bucket)
+
+    def _put(self, key, local_path):
+        self._bucket.blob(key).upload_from_filename(local_path)
+
+    def _get(self, key, local_path):
+        self._bucket.blob(key).download_to_filename(local_path)
+
+    def _list(self, key_prefix):
+        return {
+            posixpath.relpath(b.name, key_prefix): b.size
+            for b in self._bucket.list_blobs(prefix=key_prefix + "/")
+        }
+
+    def _delete(self, keys):
+        for k in keys:
+            self._bucket.blob(k).delete()
+
+
+class AzureStorageManager(_BlobStorageManager):
+    def __init__(self, container: str, prefix: str = "", connection_string: Optional[str] = None) -> None:
+        super().__init__(container, prefix)
+        try:
+            from azure.storage.blob import BlobServiceClient  # type: ignore
+        except ImportError as e:
+            raise ImportError(
+                "azure:// storage requires azure-storage-blob, not installed in this image"
+            ) from e
+        conn = connection_string or os.environ.get("AZURE_STORAGE_CONNECTION_STRING", "")
+        svc = BlobServiceClient.from_connection_string(conn)
+        self._container = svc.get_container_client(container)
+
+    def _put(self, key, local_path):
+        with open(local_path, "rb") as f:
+            self._container.upload_blob(key, f, overwrite=True)
+
+    def _get(self, key, local_path):
+        with open(local_path, "wb") as f:
+            f.write(self._container.download_blob(key).readall())
+
+    def _list(self, key_prefix):
+        return {
+            posixpath.relpath(b.name, key_prefix): b.size
+            for b in self._container.list_blobs(name_starts_with=key_prefix + "/")
+        }
+
+    def _delete(self, keys):
+        for k in keys:
+            self._container.delete_blob(k)

@@ -1,0 +1,64 @@
+"""Thread-rank simulator (reference: harness/tests/parallel.py Execution).
+
+Runs N threads, each holding a REAL DistributedContext wired over
+localhost TCP, so collective logic (checkpoint shard merges, preemption
+broadcast) is exercised without multiple processes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from determined_tpu.core import DistributedContext, allocate_port
+
+
+class Execution:
+    def __init__(self, size: int, local_size: Optional[int] = None, timeout: float = 30.0) -> None:
+        self.size = size
+        self.local_size = local_size if local_size is not None else size
+        assert size % self.local_size == 0
+        self.timeout = timeout
+
+    def run(self, fn: Callable[[DistributedContext, int], Any]) -> List[Any]:
+        chief_port = allocate_port()
+        # one local star per "node"; preallocate a port for each
+        n_nodes = self.size // self.local_size
+        local_ports = [allocate_port() for _ in range(n_nodes)]
+        results: List[Any] = [None] * self.size
+        errors: List[Optional[BaseException]] = [None] * self.size
+
+        def worker(rank: int) -> None:
+            cross_rank, local_rank = divmod(rank, self.local_size)
+            ctx = None
+            try:
+                ctx = DistributedContext(
+                    rank=rank,
+                    size=self.size,
+                    local_rank=local_rank,
+                    local_size=self.local_size,
+                    cross_rank=cross_rank,
+                    cross_size=n_nodes,
+                    chief_addr="127.0.0.1",
+                    chief_port=chief_port,
+                    local_chief_port=local_ports[cross_rank],
+                    timeout=self.timeout,
+                )
+                results[rank] = fn(ctx, rank)
+            except BaseException as e:  # noqa: BLE001
+                errors[rank] = e
+            finally:
+                if ctx is not None:
+                    ctx.close()
+
+        threads = [threading.Thread(target=worker, args=(r,)) for r in range(self.size)]
+        # start chief (rank 0) first so its server is likely bound early;
+        # clients retry-connect anyway.
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.timeout + 10)
+        for rank, e in enumerate(errors):
+            if e is not None:
+                raise AssertionError(f"rank {rank} failed: {e!r}") from e
+        return results

@@ -1,0 +1,124 @@
+import json
+import os
+
+import pytest
+
+from determined_tpu.core import (
+    CheckpointContext,
+    DummyDistributedContext,
+    merge_metadata,
+    merge_resources,
+)
+from determined_tpu.storage import SharedFSStorageManager
+from determined_tpu.utils.errors import CheckpointNotFoundError, ShardMergeConflictError
+from tests.parallel_utils import Execution
+
+
+def _write(path, content):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(content)
+
+
+def test_merge_resources_conflict():
+    res = [{"a.txt": 3}, {"a.txt": 3}]
+    digs = [{"a.txt": "aaa"}, {"a.txt": "bbb"}]
+    with pytest.raises(ShardMergeConflictError):
+        merge_resources(res, digs)
+    # identical digests are fine
+    merged = merge_resources(res, [{"a.txt": "x"}, {"a.txt": "x"}])
+    assert merged == {"a.txt": 3}
+
+
+def test_merge_metadata_conflict():
+    with pytest.raises(ShardMergeConflictError):
+        merge_metadata([{"k": 1}, {"k": 2}])
+    assert merge_metadata([{"k": 1}, {"j": 2}, None]) == {"k": 1, "j": 2}
+
+
+def test_upload_download_roundtrip(tmp_path):
+    dist = DummyDistributedContext()
+    ctx = CheckpointContext(dist, SharedFSStorageManager(str(tmp_path / "store")))
+    src = tmp_path / "src"
+    _write(str(src / "model.bin"), "weights")
+    _write(str(src / "sub" / "opt.bin"), "optstate")
+    uuid = ctx.upload(str(src), metadata={"steps_completed": 7})
+
+    dst = tmp_path / "dst"
+    ctx.download(uuid, str(dst))
+    assert (dst / "model.bin").read_text() == "weights"
+    assert (dst / "sub" / "opt.bin").read_text() == "optstate"
+    md = json.loads((dst / "metadata.json").read_text())
+    assert md["steps_completed"] == 7
+    assert ctx.get_metadata(uuid)["steps_completed"] == 7
+
+
+def test_restore_path_shared_fs_no_copy(tmp_path):
+    dist = DummyDistributedContext()
+    ctx = CheckpointContext(dist, SharedFSStorageManager(str(tmp_path)))
+    src = tmp_path / "stage"
+    _write(str(src / "f.txt"), "hi")
+    uuid = ctx.upload(str(src))
+    with ctx.restore_path(uuid) as path:
+        assert open(os.path.join(path, "f.txt")).read() == "hi"
+
+
+def test_delete_and_globs(tmp_path):
+    dist = DummyDistributedContext()
+    ctx = CheckpointContext(dist, SharedFSStorageManager(str(tmp_path / "store")))
+    src = tmp_path / "src"
+    _write(str(src / "keep.txt"), "k")
+    _write(str(src / "drop.log"), "d")
+    uuid = ctx.upload(str(src))
+    remaining = ctx.delete(uuid, globs=["*.log"])
+    assert "drop.log" not in remaining and "keep.txt" in remaining
+    ctx.delete(uuid)
+    with pytest.raises(CheckpointNotFoundError):
+        ctx.download(uuid, str(tmp_path / "x"))
+
+
+def test_sharded_upload_merges_ranks(tmp_path):
+    store = str(tmp_path / "store")
+
+    def fn(dist, rank):
+        ctx = CheckpointContext(dist, SharedFSStorageManager(store))
+        src = tmp_path / f"rank{rank}"
+        _write(str(src / f"shard-{rank}.bin"), f"data{rank}")
+        return ctx.upload(str(src), metadata={f"rank{rank}": rank}, shard=True)
+
+    uuids = Execution(3).run(fn)
+    assert len(set(uuids)) == 1
+    uuid = uuids[0]
+    dist = DummyDistributedContext()
+    ctx = CheckpointContext(dist, SharedFSStorageManager(store))
+    files = ctx._storage.list_files(uuid)
+    assert {"shard-0.bin", "shard-1.bin", "shard-2.bin"} <= set(files)
+    md = ctx.get_metadata(uuid)
+    assert md["rank0"] == 0 and md["rank2"] == 2
+
+
+def test_sharded_store_path(tmp_path):
+    store = str(tmp_path / "store")
+
+    def fn(dist, rank):
+        ctx = CheckpointContext(dist, SharedFSStorageManager(store))
+        with ctx.store_path(metadata={"steps_completed": 3}, shard=True) as (path, uuid):
+            _write(os.path.join(path, f"part-{rank}"), str(rank))
+        return uuid
+
+    uuids = Execution(2).run(fn)
+    assert len(set(uuids)) == 1
+    mgr = SharedFSStorageManager(store)
+    files = mgr.list_files(uuids[0])
+    assert {"part-0", "part-1", "metadata.json"} <= set(files)
+
+
+def test_non_chief_plain_upload_raises(tmp_path):
+    def fn(dist, rank):
+        ctx = CheckpointContext(dist, SharedFSStorageManager(str(tmp_path / "s")))
+        if not dist.is_chief:
+            with pytest.raises(RuntimeError):
+                ctx.upload(str(tmp_path), shard=False)
+        return True
+
+    assert Execution(2).run(fn) == [True, True]

@@ -1,0 +1,62 @@
+import json
+import os
+import time
+
+from determined_tpu import core
+
+
+def test_dummy_init_full_flow(tmp_path):
+    metrics_path = str(tmp_path / "metrics.jsonl")
+    ctx = core._dummy_init(checkpoint_dir=str(tmp_path / "ckpts"), metrics_path=metrics_path)
+    try:
+        assert ctx.distributed.get_rank() == 0
+        assert not ctx.preempt.should_preempt()
+
+        ctx.train.report_training_metrics(steps_completed=1, metrics={"loss": 1.5})
+        ctx.train.report_validation_metrics(steps_completed=1, metrics={"acc": 0.9})
+        ctx.train.report_metrics("custom_group", 1, {"x": 2})
+        ctx.train.report_progress(0.5)
+
+        with ctx.checkpoint.store_path(metadata={"steps_completed": 1}) as (path, uuid):
+            with open(os.path.join(path, "state.txt"), "w") as f:
+                f.write("s")
+        assert ctx.checkpoint.get_metadata(uuid)["steps_completed"] == 1
+    finally:
+        ctx.close()
+
+    # shipper flushed on close
+    lines = [json.loads(l) for l in open(metrics_path)]
+    groups = {l["group"] for l in lines}
+    assert {"training", "validation", "custom_group"} <= groups
+
+
+def test_preempt_simulate(tmp_path):
+    ctx = core._dummy_init(checkpoint_dir=str(tmp_path))
+    try:
+        assert ctx.preempt.should_preempt() is False
+        ctx.preempt.simulate()
+        assert ctx.preempt.should_preempt() is True
+    finally:
+        ctx.close()
+
+
+def test_cluster_info_env_roundtrip(monkeypatch):
+    from determined_tpu.core._cluster_info import ClusterInfo, _reset_cluster_info_cache
+
+    info = ClusterInfo(
+        master_url="http://localhost:8080",
+        trial_id=3,
+        experiment_id=9,
+        hparams={"lr": 0.1},
+        latest_checkpoint="abc",
+        num_slots=8,
+    )
+    env = info.to_env()
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    _reset_cluster_info_cache()
+    loaded = core.get_cluster_info()
+    assert loaded is not None
+    assert loaded.trial_id == 3 and loaded.hparams == {"lr": 0.1}
+    assert loaded.latest_checkpoint == "abc" and loaded.num_slots == 8
+    _reset_cluster_info_cache()

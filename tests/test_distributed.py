@@ -1,0 +1,62 @@
+import pytest
+
+from tests.parallel_utils import Execution
+
+
+def test_allgather_orders_by_rank():
+    results = Execution(4).run(lambda ctx, rank: ctx.allgather(f"r{rank}"))
+    for r in results:
+        assert r == ["r0", "r1", "r2", "r3"]
+
+
+def test_gather_chief_only():
+    results = Execution(3).run(lambda ctx, rank: ctx.gather(rank * 10))
+    assert results[0] == [0, 10, 20]
+    assert results[1] is None and results[2] is None
+
+
+def test_broadcast_from_chief():
+    def fn(ctx, rank):
+        return ctx.broadcast("payload" if ctx.is_chief else None)
+
+    assert Execution(4).run(fn) == ["payload"] * 4
+
+
+def test_local_collectives_two_nodes():
+    def fn(ctx, rank):
+        return ctx.allgather_local(("node", ctx.cross_rank, ctx.local_rank))
+
+    results = Execution(4, local_size=2).run(fn)
+    assert results[0] == [("node", 0, 0), ("node", 0, 1)]
+    assert results[2] == [("node", 1, 0), ("node", 1, 1)]
+
+
+def test_multiple_rounds_stay_in_lockstep():
+    def fn(ctx, rank):
+        out = []
+        for i in range(5):
+            out.append(ctx.allgather(rank + i * 100))
+        return out
+
+    results = Execution(3).run(fn)
+    for r in results:
+        assert r[0] == [0, 1, 2]
+        assert r[4] == [400, 401, 402]
+
+
+def test_single_rank_no_sockets():
+    from determined_tpu.core import DummyDistributedContext
+
+    ctx = DummyDistributedContext()
+    assert ctx.allgather("x") == ["x"]
+    assert ctx.gather("x") == ["x"]
+    assert ctx.broadcast("y") == "y"
+    ctx.close()
+
+
+def test_size_mismatch_raises():
+    from determined_tpu.core import DistributedContext
+
+    with pytest.raises(ValueError):
+        DistributedContext(rank=0, size=4, local_size=3, cross_size=2,
+                           chief_addr="127.0.0.1", chief_port=1)
