@@ -1,0 +1,47 @@
+"""Experiment configuration (expconf analog) + hyperparameter search space."""
+
+from determined_tpu.config.experiment import (
+    CheckpointStorageConfig,
+    ExperimentConfig,
+    InvalidExperimentConfig,
+    Length,
+    ReproducibilityConfig,
+    ResourcesConfig,
+    SearcherConfig,
+    merge_configs,
+)
+from determined_tpu.config.hyperparameters import (
+    Categorical,
+    Const,
+    Double,
+    Int,
+    InvalidHyperparameter,
+    Log,
+    grid_points,
+    grid_size,
+    parse_hyperparameter,
+    parse_hyperparameters,
+    sample_hyperparameters,
+)
+
+__all__ = [
+    "CheckpointStorageConfig",
+    "ExperimentConfig",
+    "InvalidExperimentConfig",
+    "Length",
+    "ReproducibilityConfig",
+    "ResourcesConfig",
+    "SearcherConfig",
+    "merge_configs",
+    "Categorical",
+    "Const",
+    "Double",
+    "Int",
+    "InvalidHyperparameter",
+    "Log",
+    "grid_points",
+    "grid_size",
+    "parse_hyperparameter",
+    "parse_hyperparameters",
+    "sample_hyperparameters",
+]

@@ -1,0 +1,290 @@
+"""Experiment configuration: the expconf analog, TPU-first.
+
+The reference validates a versioned YAML "expconf" against JSON schemas
+(``master/pkg/schemas/expconf``, ``schemas/expconf/v0/experiment.json``) with
+cluster-side defaulting and merging.  Here the same contract is expressed as
+typed dataclasses with explicit validation and ``merge``/defaulting, which is
+both the schema and the parser (no codegen step).
+
+Key TPU-first divergence: the reference's ``resources.slots_per_trial`` +
+launcher choice (torch_distributed/horovod/deepspeed) collapses into a
+``resources.mesh`` MeshConfig — the single declaration of dp/fsdp/tp/sp/ep/pp
+topology (see ``determined_tpu/parallel/mesh.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from determined_tpu.config.hyperparameters import parse_hyperparameters
+from determined_tpu.parallel.mesh import MeshConfig
+
+
+class InvalidExperimentConfig(ValueError):
+    pass
+
+
+_LENGTH_UNITS = ("batches", "epochs", "records")
+
+
+@dataclasses.dataclass(frozen=True)
+class Length:
+    """Training length in batches/epochs/records — reference TrainUnit
+    (``harness/determined/pytorch/_trainer_utils.py:9-151``)."""
+
+    units: int
+    unit: str = "batches"
+
+    def __post_init__(self):
+        if self.unit not in _LENGTH_UNITS:
+            raise InvalidExperimentConfig(f"length unit {self.unit!r} not in {_LENGTH_UNITS}")
+        if self.units < 0:
+            raise InvalidExperimentConfig(f"length must be >= 0, got {self.units}")
+
+    @classmethod
+    def parse(cls, raw: Any, default_unit: str = "batches") -> "Length":
+        if isinstance(raw, Length):
+            return raw
+        if isinstance(raw, int):
+            return cls(raw, default_unit)
+        if isinstance(raw, dict):
+            if len(raw) != 1:
+                raise InvalidExperimentConfig(f"length must have one key, got {raw}")
+            (unit, units), = raw.items()
+            return cls(int(units), unit)
+        raise InvalidExperimentConfig(f"cannot parse length {raw!r}")
+
+    @classmethod
+    def batches(cls, n: int) -> "Length":
+        return cls(n, "batches")
+
+    @classmethod
+    def epochs(cls, n: int) -> "Length":
+        return cls(n, "epochs")
+
+    @classmethod
+    def records(cls, n: int) -> "Length":
+        return cls(n, "records")
+
+
+@dataclasses.dataclass(frozen=True)
+class SearcherConfig:
+    """Searcher section — reference ``schemas/expconf/v0/searcher.json``.
+
+    name: single | random | grid | asha | adaptive_asha
+    """
+
+    name: str = "single"
+    metric: str = "validation_loss"
+    smaller_is_better: bool = True
+    max_trials: int = 1
+    max_length: Optional[Length] = None          # per-trial budget
+    max_concurrent_trials: int = 16
+    # ASHA knobs (reference asha_stopping.go / adaptive_asha.go)
+    num_rungs: int = 5
+    divisor: int = 4
+    mode: str = "standard"                        # conservative|standard|aggressive
+    max_time: Optional[int] = None                # asha max resource units per trial
+    time_metric: Optional[str] = None
+    bracket_rungs: Optional[List[int]] = None
+    source_trial_id: Optional[int] = None
+
+    def __post_init__(self):
+        if self.name not in ("single", "random", "grid", "asha", "adaptive_asha"):
+            raise InvalidExperimentConfig(f"unknown searcher {self.name!r}")
+        if self.mode not in ("conservative", "standard", "aggressive"):
+            raise InvalidExperimentConfig(f"unknown adaptive mode {self.mode!r}")
+        if self.max_trials < 1:
+            raise InvalidExperimentConfig("searcher.max_trials must be >= 1")
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "SearcherConfig":
+        raw = dict(raw or {})
+        if "max_length" in raw and raw["max_length"] is not None:
+            raw["max_length"] = Length.parse(raw["max_length"])
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise InvalidExperimentConfig(f"unknown searcher fields: {sorted(unknown)}")
+        return cls(**raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourcesConfig:
+    """Resources — replaces reference ``slots_per_trial`` with a mesh.
+
+    ``mesh`` axes multiply to the chip count of the trial; ``slots_per_trial``
+    is still accepted as sugar for ``mesh: {data: N}``.
+    """
+
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    resource_pool: str = "default"
+    priority: int = 42                            # reference default priority
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "ResourcesConfig":
+        raw = dict(raw or {})
+        slots = raw.pop("slots_per_trial", None)
+        mesh_raw = raw.pop("mesh", None)
+        if mesh_raw is not None and slots is not None:
+            raise InvalidExperimentConfig(
+                "resources.slots_per_trial and resources.mesh are mutually exclusive"
+            )
+        if mesh_raw is not None:
+            mesh = MeshConfig(**mesh_raw)
+        elif slots is not None:
+            mesh = MeshConfig(data=int(slots))
+        else:
+            mesh = MeshConfig()
+        known = {f.name for f in dataclasses.fields(cls)} - {"mesh"}
+        unknown = set(raw) - known
+        if unknown:
+            raise InvalidExperimentConfig(f"unknown resources fields: {sorted(unknown)}")
+        return cls(mesh=mesh, **raw)
+
+    @property
+    def slots_per_trial(self) -> int:
+        return self.mesh.num_devices
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointStorageConfig:
+    """Checkpoint storage — reference ``schemas/expconf/v0/checkpoint-storage.json``.
+
+    type: shared_fs | directory | s3 | gcs | azure
+    """
+
+    type: str = "shared_fs"
+    host_path: Optional[str] = None               # shared_fs
+    storage_path: Optional[str] = None
+    container_path: Optional[str] = None          # directory
+    bucket: Optional[str] = None                  # s3/gcs
+    prefix: Optional[str] = None
+    save_experiment_best: int = 0
+    save_trial_best: int = 1
+    save_trial_latest: int = 1
+
+    def to_url(self) -> str:
+        if self.type in ("shared_fs", "directory"):
+            base = self.host_path or self.container_path or "/tmp/determined_tpu/checkpoints"
+            if self.storage_path:
+                base = f"{base.rstrip('/')}/{self.storage_path}"
+            return base
+        if self.type in ("s3", "gcs"):
+            if not self.bucket:
+                raise InvalidExperimentConfig(f"{self.type} storage requires `bucket`")
+            url = f"{self.type}://{self.bucket}"
+            if self.prefix:
+                url += f"/{self.prefix}"
+            return url
+        raise InvalidExperimentConfig(f"unknown checkpoint storage type {self.type!r}")
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "CheckpointStorageConfig":
+        raw = dict(raw or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise InvalidExperimentConfig(f"unknown checkpoint_storage fields: {sorted(unknown)}")
+        return cls(**raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReproducibilityConfig:
+    experiment_seed: int = 0
+
+
+_CHECKPOINT_POLICIES = ("best", "all", "none")
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """Top-level experiment config — reference ``expconf/v0/experiment.json``."""
+
+    name: str = "unnamed"
+    entrypoint: Optional[str] = None
+    description: str = ""
+    labels: List[str] = dataclasses.field(default_factory=list)
+    workspace: str = "Uncategorized"
+    project: str = "Uncategorized"
+    hyperparameters: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    searcher: SearcherConfig = dataclasses.field(default_factory=SearcherConfig)
+    resources: ResourcesConfig = dataclasses.field(default_factory=ResourcesConfig)
+    checkpoint_storage: CheckpointStorageConfig = dataclasses.field(
+        default_factory=CheckpointStorageConfig
+    )
+    checkpoint_policy: str = "best"
+    min_validation_period: Optional[Length] = None
+    min_checkpoint_period: Optional[Length] = None
+    records_per_epoch: int = 0
+    max_restarts: int = 5
+    reproducibility: ReproducibilityConfig = dataclasses.field(
+        default_factory=ReproducibilityConfig
+    )
+    environment: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    profiling: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    raw: Dict[str, Any] = dataclasses.field(default_factory=dict, compare=False)
+
+    def __post_init__(self):
+        if self.checkpoint_policy not in _CHECKPOINT_POLICIES:
+            raise InvalidExperimentConfig(
+                f"checkpoint_policy {self.checkpoint_policy!r} not in {_CHECKPOINT_POLICIES}"
+            )
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "ExperimentConfig":
+        raw = dict(raw or {})
+        kwargs: Dict[str, Any] = {"raw": dict(raw)}
+        if "hyperparameters" in raw:
+            kwargs["hyperparameters"] = parse_hyperparameters(raw.pop("hyperparameters"))
+        if "searcher" in raw:
+            kwargs["searcher"] = SearcherConfig.parse(raw.pop("searcher"))
+        if "resources" in raw:
+            kwargs["resources"] = ResourcesConfig.parse(raw.pop("resources"))
+        if "checkpoint_storage" in raw:
+            kwargs["checkpoint_storage"] = CheckpointStorageConfig.parse(
+                raw.pop("checkpoint_storage")
+            )
+        if "reproducibility" in raw:
+            kwargs["reproducibility"] = ReproducibilityConfig(**raw.pop("reproducibility"))
+        for period in ("min_validation_period", "min_checkpoint_period"):
+            if raw.get(period) is not None:
+                kwargs[period] = Length.parse(raw.pop(period))
+        known = {f.name for f in dataclasses.fields(cls)}
+        for k in list(raw):
+            if k in known and k != "raw":
+                kwargs[k] = raw.pop(k)
+        if raw:
+            raise InvalidExperimentConfig(f"unknown experiment config fields: {sorted(raw)}")
+        return cls(**kwargs)
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ExperimentConfig":
+        with open(path) as f:
+            return cls.parse(yaml.safe_load(f) or {})
+
+    @classmethod
+    def from_yaml_str(cls, text: str) -> "ExperimentConfig":
+        return cls.parse(yaml.safe_load(text) or {})
+
+    def with_hyperparameters(self, hparams: Dict[str, Any]) -> "ExperimentConfig":
+        """A copy whose hp space is collapsed to concrete Const values
+        (what a trial sees after the searcher samples)."""
+        const = parse_hyperparameters(hparams)
+        return dataclasses.replace(self, hyperparameters=const)
+
+
+def merge_configs(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursive dict merge, override wins — reference ``schemas.Merge``
+    (``master/pkg/schemas/merge.go``) semantics for template application."""
+    out = dict(base)
+    for k, v in override.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = merge_configs(out[k], v)
+        else:
+            out[k] = v
+    return out

@@ -258,18 +258,29 @@ class CheckpointContext:
         for shared_fs), others wait on the local star.
         """
         if self._dist.is_local_chief:
-            cm = self._storage.restore_path(storage_id, self._staging_dir)
-            with cm as path:
-                self._dist.broadcast_local(path)
+            try:
+                cm = self._storage.restore_path(storage_id, self._staging_dir)
+                path = cm.__enter__()
+            except Exception as e:
+                # Unblock local peers with an error sentinel instead of
+                # leaving them hanging on the local star until timeout.
+                self._dist.broadcast_local(("error", f"{type(e).__name__}: {e}"))
+                raise
+            try:
+                self._dist.broadcast_local(("ok", path))
                 try:
                     yield path
                 finally:
                     # hold the staging dir until every local rank is done
                     self._dist.allgather_local(None)
+            finally:
+                cm.__exit__(None, None, None)
         else:
-            path = self._dist.broadcast_local(None)
+            status, payload = self._dist.broadcast_local(None)
+            if status == "error":
+                raise RuntimeError(f"local chief failed to restore checkpoint: {payload}")
             try:
-                yield path
+                yield payload
             finally:
                 self._dist.allgather_local(None)
 

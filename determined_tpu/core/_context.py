@@ -133,6 +133,11 @@ def init(
             url = (info.exp_config or {}).get("checkpoint_storage")
         if url is None:
             url = os.path.join(os.getcwd(), "checkpoints")
+        if isinstance(url, dict):
+            # expconf dict form ({"type": "shared_fs", "host_path": ...}).
+            from determined_tpu.config.experiment import CheckpointStorageConfig
+
+            url = CheckpointStorageConfig.parse(url).to_url()
         storage_manager = from_string(url) if isinstance(url, str) else url
 
     checkpoint = CheckpointContext(

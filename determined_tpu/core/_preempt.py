@@ -55,9 +55,11 @@ class _PreemptionWatcher(threading.Thread):
     def run(self) -> None:
         while not self._stop.is_set() and not self._flag.is_set():
             try:
+                # read timeout must exceed the server-side long-poll hold
                 resp = self._session.get(
                     f"/api/v1/allocations/{self._allocation_id}/signals/preemption",
                     params={"timeout_seconds": 60},
+                    timeout=70,
                 )
                 if resp.json().get("preempt"):
                     self._flag.set()

@@ -14,6 +14,7 @@ import contextlib
 import hashlib
 import os
 import shutil
+import tempfile
 from typing import Callable, Dict, Iterator, List, Optional
 
 
@@ -87,11 +88,15 @@ class StorageManager(abc.ABC):
     # store_path to avoid the copy; default stages then uploads.
     @contextlib.contextmanager
     def store_path(self, storage_id: str, staging_dir: str) -> Iterator[str]:
-        src = os.path.join(staging_dir, storage_id)
-        os.makedirs(src, exist_ok=True)
-        yield src
-        self.upload(src, storage_id)
-        shutil.rmtree(src, ignore_errors=True)
+        # Stage in a per-process unique dir: storage_id is broadcast, so
+        # multiple local ranks sharing staging_dir must not collide.
+        os.makedirs(staging_dir, exist_ok=True)
+        src = tempfile.mkdtemp(prefix=f"{storage_id}-", dir=staging_dir)
+        try:
+            yield src
+            self.upload(src, storage_id)
+        finally:
+            shutil.rmtree(src, ignore_errors=True)
 
 
 def from_string(url: str, **kwargs) -> StorageManager:

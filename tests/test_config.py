@@ -1,0 +1,130 @@
+"""Config system tests: hp parsing/sampling/grid + experiment config parse."""
+
+import numpy as np
+import pytest
+
+from determined_tpu.config import (
+    Categorical,
+    Const,
+    ExperimentConfig,
+    Int,
+    InvalidExperimentConfig,
+    InvalidHyperparameter,
+    Length,
+    Log,
+    grid_points,
+    grid_size,
+    parse_hyperparameters,
+    sample_hyperparameters,
+)
+from determined_tpu.parallel.mesh import MeshConfig
+
+
+SPACE_YAML = {
+    "lr": {"type": "log", "minval": -5, "maxval": -1, "base": 10, "count": 3},
+    "hidden": {"type": "int", "minval": 32, "maxval": 64, "count": 2},
+    "act": {"type": "categorical", "vals": ["relu", "gelu"]},
+    "layers": 4,
+    "opt": {"adam": {"b1": {"type": "double", "minval": 0.8, "maxval": 0.99, "count": 2}}},
+}
+
+
+def test_parse_space_types():
+    space = parse_hyperparameters(SPACE_YAML)
+    assert isinstance(space["lr"], Log)
+    assert isinstance(space["hidden"], Int)
+    assert isinstance(space["act"], Categorical)
+    assert isinstance(space["layers"], Const)
+    assert isinstance(space["opt"]["adam"]["b1"].minval, float)
+
+
+def test_sampling_in_bounds_and_deterministic():
+    space = parse_hyperparameters(SPACE_YAML)
+    s1 = sample_hyperparameters(space, np.random.default_rng(7))
+    s2 = sample_hyperparameters(space, np.random.default_rng(7))
+    assert s1 == s2
+    assert 1e-5 <= s1["lr"] <= 1e-1
+    assert 32 <= s1["hidden"] <= 64
+    assert s1["act"] in ("relu", "gelu")
+    assert s1["layers"] == 4
+    assert 0.8 <= s1["opt"]["adam"]["b1"] <= 0.99
+
+
+def test_grid_expansion():
+    space = parse_hyperparameters(SPACE_YAML)
+    pts = grid_points(space)
+    assert len(pts) == grid_size(space) == 3 * 2 * 2 * 1 * 2
+    lrs = sorted({p["lr"] for p in pts})
+    assert lrs == pytest.approx([1e-5, 1e-3, 1e-1])
+    assert all(p["layers"] == 4 for p in pts)
+
+
+def test_grid_int_caps_at_span():
+    space = parse_hyperparameters({"n": {"type": "int", "minval": 1, "maxval": 3, "count": 10}})
+    assert grid_points(space) == [{"n": 1}, {"n": 2}, {"n": 3}]
+
+
+def test_invalid_hp():
+    with pytest.raises(InvalidHyperparameter):
+        parse_hyperparameters({"x": {"type": "int", "minval": 5, "maxval": 1}})
+    with pytest.raises(InvalidHyperparameter):
+        parse_hyperparameters({"x": {"type": "nope"}})
+
+
+def test_experiment_config_parse_full():
+    cfg = ExperimentConfig.from_yaml_str(
+        """
+name: mnist
+hyperparameters:
+  lr: {type: log, minval: -4, maxval: -2}
+  batch: 64
+searcher:
+  name: adaptive_asha
+  metric: accuracy
+  smaller_is_better: false
+  max_trials: 16
+  max_length: {batches: 500}
+resources:
+  mesh: {data: 2, tensor: 4}
+checkpoint_storage:
+  type: shared_fs
+  host_path: /tmp/ckpts
+min_validation_period: {batches: 100}
+"""
+    )
+    assert cfg.name == "mnist"
+    assert cfg.searcher.name == "adaptive_asha"
+    assert cfg.searcher.max_length == Length.batches(500)
+    assert not cfg.searcher.smaller_is_better
+    assert cfg.resources.mesh == MeshConfig(data=2, tensor=4)
+    assert cfg.resources.slots_per_trial == 8
+    assert cfg.checkpoint_storage.to_url() == "/tmp/ckpts"
+    assert cfg.min_validation_period == Length.batches(100)
+
+
+def test_slots_per_trial_sugar():
+    cfg = ExperimentConfig.parse({"resources": {"slots_per_trial": 4}})
+    assert cfg.resources.mesh == MeshConfig(data=4)
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(InvalidExperimentConfig):
+        ExperimentConfig.parse({"bogus_field": 1})
+    with pytest.raises(InvalidExperimentConfig):
+        ExperimentConfig.parse({"searcher": {"nope": 2}})
+
+
+def test_with_hyperparameters_collapses_to_const():
+    cfg = ExperimentConfig.parse(
+        {"hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -2}}}
+    )
+    trial_cfg = cfg.with_hyperparameters({"lr": 0.001})
+    assert isinstance(trial_cfg.hyperparameters["lr"], Const)
+    assert trial_cfg.hyperparameters["lr"].val == 0.001
+
+
+def test_length_parse_forms():
+    assert Length.parse(10) == Length.batches(10)
+    assert Length.parse({"epochs": 3}) == Length.epochs(3)
+    with pytest.raises(InvalidExperimentConfig):
+        Length.parse({"batches": 1, "epochs": 2})
