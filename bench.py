@@ -1,0 +1,76 @@
+"""Benchmark: prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Runs the MNIST MLP trial (the reference's tutorial workload,
+``examples/tutorials/mnist_pytorch``) on the real chip and reports training
+throughput.  Baseline: the reference publishes no in-repo numbers
+(BASELINE.md); the driver-set north star is GPU-parity samples/sec/chip.
+We compare against a fixed reference point of 100k samples/s (an A100-class
+mnist-MLP DDP throughput) so vs_baseline > 1.0 means beating GPU parity.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+BASELINE_SAMPLES_PER_SEC = 100_000.0
+
+
+def main() -> None:
+    from determined_tpu import core, train
+    from determined_tpu.config import Length
+    from determined_tpu.models.mnist import MnistTrial
+    from determined_tpu.parallel.mesh import MeshConfig
+    import jax
+
+    n = len(jax.devices())
+    hparams = {
+        "lr": 1e-3,
+        "hidden": 128,
+        "global_batch_size": 2048 * n,
+        "dataset_size": 65536,
+        "model": "mlp",
+    }
+    ctx = train.init(
+        hparams=hparams,
+        mesh_config=MeshConfig(data=n),
+        core_context=core._dummy_init(),
+        seed=0,
+    )
+    trainer = train.Trainer(MnistTrial(ctx))
+
+    warmup = 5
+    measured = 30
+    gbs = hparams["global_batch_size"]
+
+    trainer._setup()
+    it = iter(trainer.train_loader)
+    from determined_tpu.data import to_global
+
+    # warmup (compile + cache)
+    for _ in range(warmup):
+        trainer.state = trainer._train_step(trainer.state, to_global(next(it), trainer.mesh))
+    jax.block_until_ready(trainer.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(measured):
+        trainer.state = trainer._train_step(trainer.state, to_global(next(it), trainer.mesh))
+    jax.block_until_ready(trainer.state.params)
+    dt = time.perf_counter() - t0
+
+    sps = measured * gbs / dt
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_mlp_train_samples_per_sec",
+                "value": round(sps, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

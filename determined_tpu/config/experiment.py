@@ -134,7 +134,14 @@ class ResourcesConfig:
                 "resources.slots_per_trial and resources.mesh are mutually exclusive"
             )
         if mesh_raw is not None:
-            mesh = MeshConfig(**mesh_raw)
+            try:
+                mesh = MeshConfig(**mesh_raw)
+            except TypeError:
+                known_axes = [f.name for f in dataclasses.fields(MeshConfig)]
+                raise InvalidExperimentConfig(
+                    f"unknown mesh axes {sorted(set(mesh_raw) - set(known_axes))}; "
+                    f"valid axes: {known_axes}"
+                ) from None
         elif slots is not None:
             mesh = MeshConfig(data=int(slots))
         else:

@@ -1,0 +1,22 @@
+"""Data pipeline: datasets, deterministic samplers, shard-aware loader."""
+
+from determined_tpu.data._dataset import (
+    Dataset,
+    InMemoryDataset,
+    SyntheticDataset,
+    mnist_like,
+)
+from determined_tpu.data._loader import DataLoader, batch_spec, to_global
+from determined_tpu.data._sampler import IndexSampler, SamplerState
+
+__all__ = [
+    "Dataset",
+    "InMemoryDataset",
+    "SyntheticDataset",
+    "mnist_like",
+    "DataLoader",
+    "batch_spec",
+    "to_global",
+    "IndexSampler",
+    "SamplerState",
+]

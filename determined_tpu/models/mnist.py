@@ -1,0 +1,126 @@
+"""MNIST models + trial: the minimum end-to-end slice.
+
+Reference: ``examples/tutorials/mnist_pytorch/model_def.py`` (conv net under
+PyTorchTrial).  Here: flax modules with logical-axis partitioning metadata
+so the SAME model runs DP, FSDP, or TP by changing only the MeshConfig.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from determined_tpu.data import DataLoader, mnist_like
+from determined_tpu.train._trial import JaxTrial
+
+
+class MnistMLP(nn.Module):
+    """Two-layer MLP; hidden dim carries the "mlp" logical axis so a tensor
+    mesh axis shards it (Megatron-style column/row split, XLA-inserted
+    collectives)."""
+
+    hidden: int = 128
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(
+            self.hidden,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "mlp")
+            ),
+            name="fc1",
+        )(x)
+        x = nn.relu(x)
+        x = nn.Dense(
+            self.num_classes,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("mlp", None)
+            ),
+            name="fc2",
+        )(x)
+        return x
+
+
+class MnistCNN(nn.Module):
+    """Conv net matching the reference tutorial's shape (2 conv + 2 dense)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Conv(32, (3, 3), name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(
+            128,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), (None, "mlp")
+            ),
+            name="fc1",
+        )(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, name="fc2")(x)
+        return x
+
+
+class MnistTrial(JaxTrial):
+    """The flagship "tutorial" trial — hyperparameters mirror the reference
+    mnist example (lr, hidden size, global_batch_size)."""
+
+    def build_model(self) -> nn.Module:
+        kind = self.context.get_hparam("model", "mlp")
+        if kind == "cnn":
+            return MnistCNN()
+        return MnistMLP(hidden=int(self.context.get_hparam("hidden", 128)))
+
+    def build_optimizer(self) -> optax.GradientTransformation:
+        lr = float(self.context.get_hparam("lr", 1e-3))
+        return optax.adam(lr)
+
+    def _dataset(self, train: bool):
+        size = int(self.context.get_hparam("dataset_size", 4096))
+        return mnist_like(size=size, seed=0 if train else 1)
+
+    def build_training_data_loader(self) -> DataLoader:
+        return DataLoader(
+            self._dataset(train=True),
+            self.context.get_global_batch_size(),
+            shuffle=True,
+            seed=self.context.seed,
+        )
+
+    def build_validation_data_loader(self) -> DataLoader:
+        return DataLoader(
+            self._dataset(train=False),
+            self.context.get_global_batch_size(),
+            shuffle=False,
+            seed=self.context.seed,
+        )
+
+    def model_inputs(self, batch: Dict[str, Any]) -> Tuple[Any, ...]:
+        return (batch["image"],)
+
+    def loss(
+        self, model: nn.Module, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        logits = model.apply(params, batch["image"])
+        labels = batch["label"]
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+        acc = (logits.argmax(-1) == labels).mean()
+        return loss, {"accuracy": acc}
+
+    def evaluate_batch(
+        self, model: nn.Module, params: Any, batch: Dict[str, jax.Array]
+    ) -> Dict[str, jax.Array]:
+        loss, metrics = self.loss(model, params, batch, jax.random.key(0))
+        return {"validation_loss": loss, "validation_accuracy": metrics["accuracy"]}

@@ -89,6 +89,17 @@ def named_sharding(
     return NamedSharding(mesh, logical_to_mesh_spec(logical, rules, mesh))
 
 
+# In spec pytrees the LEAVES are logical specs: tuples of names (or None, or
+# a PartitionSpec).  Without this is_leaf, tree.map would descend into the
+# tuples and iterate the axis-name strings character by character.
+def _is_spec_leaf(x: Any) -> bool:
+    return (
+        x is None
+        or isinstance(x, P)
+        or (isinstance(x, tuple) and all(n is None or isinstance(n, (str, tuple)) for n in x))
+    )
+
+
 def shard_params(params: Any, specs: Any, mesh: Mesh, rules: Optional[LogicalAxisRules] = None) -> Any:
     """Device-put a param pytree according to its logical-spec pytree."""
     rules = rules if rules is not None else DEFAULT_RULES
@@ -96,7 +107,7 @@ def shard_params(params: Any, specs: Any, mesh: Mesh, rules: Optional[LogicalAxi
         lambda p, s: jax.device_put(p, named_sharding(mesh, s, rules)),
         params,
         specs,
-        is_leaf=lambda x: x is None,
+        is_leaf=_is_spec_leaf,
     )
 
 
@@ -104,7 +115,7 @@ def param_shardings(specs: Any, mesh: Mesh, rules: Optional[LogicalAxisRules] = 
     """NamedSharding pytree matching a logical-spec pytree (for jit in/out)."""
     rules = rules if rules is not None else DEFAULT_RULES
     return jax.tree.map(
-        lambda s: named_sharding(mesh, s, rules), specs, is_leaf=lambda x: x is None
+        lambda s: named_sharding(mesh, s, rules), specs, is_leaf=_is_spec_leaf
     )
 
 

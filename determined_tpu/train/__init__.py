@@ -1,0 +1,16 @@
+"""Training engine: JaxTrial + Trainer boundary loop + serialization."""
+
+from determined_tpu.train._state import TrainState
+from determined_tpu.train._trainer import Trainer, init
+from determined_tpu.train._trial import Callback, JaxTrial, TrialContext
+from determined_tpu.train import serialization
+
+__all__ = [
+    "Callback",
+    "JaxTrial",
+    "TrainState",
+    "Trainer",
+    "TrialContext",
+    "init",
+    "serialization",
+]

@@ -1,0 +1,502 @@
+"""Trainer: the boundary-driven training loop engine.
+
+Reference: ``_PyTorchTrialController`` (``harness/determined/pytorch/
+_pytorch_trial.py:398-1088``) + ``Trainer``/``init`` (``_trainer.py:18-386``).
+Same contract — fit(max_length, periods, latest_checkpoint) with
+TRAIN/VALIDATE/CHECKPOINT/REPORT boundaries, preemption-safe, resumable —
+redesigned for XLA:
+
+- ONE jitted train step (forward+backward+update+metric-accumulate) with
+  buffer donation; gradients are globally correct because the batch is a
+  mesh-sharded global array (no DDP/allreduce calls to orchestrate).
+- the hot loop never syncs the host: boundary arithmetic is pure Python on
+  step counters; metrics are fetched once per REPORT boundary.
+- checkpoints write each process's addressable array shards (orbax) inside
+  a CheckpointContext-managed directory; loader/callback state rides along.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax.core import meta as flax_meta
+
+from determined_tpu.config.experiment import ExperimentConfig, Length
+from determined_tpu.core import _context as core_context_mod
+from determined_tpu.data._loader import DataLoader, to_global
+from determined_tpu.parallel.mesh import MeshAxes, MeshConfig, make_mesh
+from determined_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    param_shardings,
+)
+from determined_tpu.train._state import TrainState
+from determined_tpu.train._trial import Callback, JaxTrial, TrialContext
+from determined_tpu.train import serialization
+
+logger = logging.getLogger("determined_tpu.train")
+
+
+def init(
+    *,
+    hparams: Optional[Dict[str, Any]] = None,
+    mesh_config: Optional[MeshConfig] = None,
+    exp_config: Optional[ExperimentConfig] = None,
+    core_context: Optional[Any] = None,
+    seed: Optional[int] = None,
+    rules: Optional[Dict[str, Any]] = None,
+) -> TrialContext:
+    """Build a TrialContext — reference ``pytorch.init`` (``_trainer.py:282``).
+
+    Off-cluster this produces a fully local context (dummy core services);
+    on-cluster the same call picks up rendezvous + master connection.
+    """
+    if exp_config is not None:
+        if hparams is None:
+            hparams = {
+                k: getattr(v, "val", v)
+                for k, v in exp_config.hyperparameters.items()
+                if not isinstance(v, dict)
+            }
+            # nested hp dicts pass through with Consts collapsed
+            for k, v in exp_config.hyperparameters.items():
+                if isinstance(v, dict):
+                    hparams[k] = _collapse(v)
+        mesh_config = mesh_config or exp_config.resources.mesh
+        if seed is None:
+            seed = exp_config.reproducibility.experiment_seed
+    core = core_context or core_context_mod.init()
+    mesh = make_mesh(mesh_config or MeshConfig.data_parallel(-1))
+    return TrialContext(
+        core=core,
+        mesh=mesh,
+        hparams=hparams,
+        rules=rules,
+        seed=seed or 0,
+        exp_config=exp_config,
+    )
+
+
+def _collapse(tree: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        k: _collapse(v) if isinstance(v, dict) else getattr(v, "val", v)
+        for k, v in tree.items()
+    }
+
+
+def _infer_fsdp_specs(params_abstract: Any, mesh) -> Any:
+    """Auto-FSDP: shard each param's largest dim divisible by the fsdp axis.
+
+    Zero-annotation data-parallel-sharded params — the analog of ZeRO-3 via
+    DeepSpeed in the reference, but done by the compiler from a spec.
+    """
+    fsdp = mesh.shape.get(MeshAxes.FSDP, 1)
+
+    def spec(leaf):
+        shape = leaf.shape
+        if fsdp <= 1 or not shape:
+            return None
+        divisible = [d for d in range(len(shape)) if shape[d] % fsdp == 0 and shape[d] >= fsdp]
+        if not divisible:
+            return None
+        d = max(divisible, key=lambda i: shape[i])
+        out = [None] * len(shape)
+        out[d] = "fsdp_shard"
+        return tuple(out)
+
+    return jax.tree.map(spec, params_abstract)
+
+
+def _specs_from_flax_metadata(abstract_boxed: Any) -> Optional[Any]:
+    """Extract logical specs from flax ``with_partitioning`` metadata."""
+    leaves = jax.tree.leaves(abstract_boxed, is_leaf=lambda x: isinstance(x, flax_meta.Partitioned))
+    if not any(isinstance(l, flax_meta.Partitioned) for l in leaves):
+        return None
+    spec_tree = nn.get_partition_spec(abstract_boxed)
+    return jax.tree.map(
+        lambda s: tuple(s) if s is not None and len(tuple(s)) else None,
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+    )
+
+
+class _BoundarySchedule:
+    """Next-boundary arithmetic over a step counter (host-side ints only)."""
+
+    def __init__(self, period: Optional[int], max_steps: int) -> None:
+        self.period = period if period and period > 0 else None
+        self.max_steps = max_steps
+
+    def next_after(self, step: int) -> int:
+        if self.period is None:
+            return self.max_steps
+        return min(((step // self.period) + 1) * self.period, self.max_steps)
+
+    def is_boundary(self, step: int) -> bool:
+        return step >= self.max_steps or (
+            self.period is not None and step % self.period == 0
+        )
+
+
+class Trainer:
+    """Drives a JaxTrial — reference ``Trainer`` + controller in one."""
+
+    def __init__(self, trial: JaxTrial, context: Optional[TrialContext] = None) -> None:
+        self.trial = trial
+        self.context = context or trial.context
+        self.core = self.context.core
+        self.mesh = self.context.mesh
+        self._compiled = False
+        # populated by _setup
+        self.model: Any = None
+        self.tx: Any = None
+        self.train_loader: Optional[DataLoader] = None
+        self.val_loader: Optional[DataLoader] = None
+        self.state: Optional[TrainState] = None
+        self.callbacks: Dict[str, Callback] = {}
+        self.steps_completed = 0
+        self.best_validation: Optional[float] = None
+        self._searcher_metric: Optional[str] = None
+        self._smaller_is_better = True
+
+    # -- setup -------------------------------------------------------------
+
+    def _setup(self) -> None:
+        ctx = self.context
+        self.model = self.trial.build_model()
+        self.tx = self.trial.build_optimizer()
+        self.train_loader = self.trial.build_training_data_loader()
+        self.val_loader = self.trial.build_validation_data_loader()
+        self.callbacks = dict(self.trial.build_callbacks())
+        cfg = ctx.exp_config
+        if cfg is not None:
+            self._searcher_metric = cfg.searcher.metric
+            self._smaller_is_better = cfg.searcher.smaller_is_better
+
+        rng = jax.random.key(ctx.seed)
+        init_rng, state_rng = jax.random.split(rng)
+
+        sample = next(self.train_loader.iter_epoch(0))
+        self._sample_host_batch = sample
+
+        # ---- parameter shapes + logical specs (no real init yet) --------
+        abstract_boxed = jax.eval_shape(
+            lambda r: self.trial.init_params(self.model, r, sample), init_rng
+        )
+        specs = self.trial.param_logical_specs(abstract_boxed)
+        if specs is None:
+            specs = _specs_from_flax_metadata(abstract_boxed)
+        abstract = flax_meta.unbox(abstract_boxed)
+        if specs is None:
+            specs = _infer_fsdp_specs(abstract, self.mesh)
+        self._param_specs = specs
+        shardings = param_shardings(specs, self.mesh, ctx.rules)
+
+        # ---- metric structure from an abstract trace ---------------------
+        global_sample = to_global(sample, self.mesh)
+        metrics_shape = jax.eval_shape(
+            lambda p, b, r: self.trial.loss(self.model, p, b, r)[1],
+            abstract,
+            global_sample,
+            state_rng,
+        )
+        metric_keys = tuple(sorted(metrics_shape.keys())) + ("loss",)
+
+        # ---- sharded init --------------------------------------------------
+        # 1. init params, then commit them to their planned mesh shardings;
+        # 2. build opt_state under jit from the *committed* params so XLA
+        #    propagates the param shardings into mirror leaves (adam mu/nu);
+        # 3. replicate every remaining leaf (scalars, rng) over the mesh so
+        #    the whole TrainState lives on one consistent device set.
+        with self.mesh:
+            params = jax.jit(
+                lambda r: flax_meta.unbox(self.trial.init_params(self.model, r, sample)),
+                out_shardings=shardings,  # init directly sharded: no single-
+            )(init_rng)                   # device materialization at FSDP scale
+        with self.mesh:
+            opt_state = jax.jit(self.tx.init)(params)
+        self.state = TrainState.create(params, opt_state, state_rng, metric_keys)
+        self.state = self._place_on_mesh(self.state)
+
+        # ---- jitted steps -------------------------------------------------
+        trial, model, tx = self.trial, self.model, self.tx
+
+        def train_step(state: TrainState, batch):
+            step_rng = jax.random.fold_in(state.rng, state.step)
+
+            def loss_fn(p):
+                loss, m = trial.loss(model, p, batch, step_rng)
+                return loss, m
+
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = dict(metrics)
+            metrics["loss"] = loss
+            acc = {
+                k: state.metric_acc[k] + metrics[k].astype(jnp.float32)
+                for k in state.metric_acc
+            }
+            return state.replace(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt,
+                metric_acc=acc,
+                metric_count=state.metric_count + 1.0,
+            )
+
+        def eval_step(params, batch, acc, count):
+            metrics = trial.evaluate_batch(model, params, batch)
+            new_acc = {
+                k: acc.get(k, jnp.zeros((), jnp.float32)) + metrics[k].astype(jnp.float32)
+                for k in metrics
+            }
+            return new_acc, count + 1.0
+
+        self._train_step = jax.jit(train_step, donate_argnums=0)
+        self._eval_step = jax.jit(eval_step, donate_argnums=2)
+
+    def _place_on_mesh(self, tree: Any) -> Any:
+        """Replicate any leaf not already sharded over THIS mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(self.mesh, PartitionSpec())
+
+        def fix(x):
+            if not isinstance(x, jax.Array):
+                return x
+            s = x.sharding
+            if isinstance(s, NamedSharding) and s.mesh.devices.size == self.mesh.devices.size \
+                    and set(d.id for d in s.mesh.devices.flat) == set(d.id for d in self.mesh.devices.flat):
+                return x
+            return jax.device_put(x, repl)
+
+        return jax.tree.map(fix, tree)
+
+    # -- length arithmetic -------------------------------------------------
+
+    def _to_batches(self, length: Optional[Length]) -> Optional[int]:
+        if length is None:
+            return None
+        length = Length.parse(length)
+        if length.unit == "batches":
+            return length.units
+        if length.unit == "epochs":
+            return length.units * self.train_loader.batches_per_epoch
+        # records
+        gbs = self.train_loader.sampler.global_batch
+        return max(1, length.units // gbs)
+
+    # -- checkpoint --------------------------------------------------------
+
+    def _save_checkpoint(self) -> str:
+        dist = self.core.distributed
+        shard = dist.size > 1
+        array_state = {
+            "step": self.state.step,
+            "params": self.state.params,
+            "opt_state": self.state.opt_state,
+            "rng": self.state.rng,
+        }
+        trainer_state = {
+            "steps_completed": self.steps_completed,
+            "train_loader": self.train_loader.state_dict(),
+            "callbacks": {k: cb.state_dict() for k, cb in self.callbacks.items()},
+            "best_validation": self.best_validation,
+        }
+        metadata = {
+            "steps_completed": self.steps_completed,
+            "framework": "determined_tpu",
+        }
+        with self.core.checkpoint.store_path(metadata, shard=shard) as (path, sid):
+            for cb in self.callbacks.values():
+                cb.on_checkpoint_write_start(path)
+            serialization.save_arrays(path, array_state)
+            if dist.is_chief:
+                serialization.save_trainer_state(path, trainer_state)
+        for cb in self.callbacks.values():
+            cb.on_checkpoint_write_end(sid)
+        logger.info("checkpoint %s at step %d", sid, self.steps_completed)
+        return sid
+
+    def _restore_checkpoint(self, storage_id: str) -> None:
+        with self.core.checkpoint.restore_path(storage_id) as path:
+            abstract = serialization.abstract_like(
+                {
+                    "step": self.state.step,
+                    "params": self.state.params,
+                    "opt_state": self.state.opt_state,
+                    "rng": self.state.rng,
+                }
+            )
+            restored = serialization.restore_arrays(path, abstract)
+            self.state = self.state.replace(**restored).reset_metrics()
+            tstate = serialization.load_trainer_state(path)
+            self.steps_completed = int(tstate["steps_completed"])
+            self.train_loader.load_state_dict(tstate["train_loader"])
+            for k, cb in self.callbacks.items():
+                cb.load_state_dict(tstate.get("callbacks", {}).get(k, {}))
+            self.best_validation = tstate.get("best_validation")
+            for cb in self.callbacks.values():
+                cb.on_checkpoint_load(path)
+        logger.info("restored checkpoint %s at step %d", storage_id, self.steps_completed)
+
+    # -- validation --------------------------------------------------------
+
+    def _validate(self) -> Dict[str, float]:
+        for cb in self.callbacks.values():
+            cb.on_validation_start()
+        acc: Dict[str, jax.Array] = {}
+        count = jnp.zeros((), jnp.float32)
+        for host_batch in self.val_loader.iter_epoch(0):
+            batch = to_global(host_batch, self.mesh)
+            acc, count = self._eval_step(self.state.params, batch, acc, count)
+        acc_host, n = jax.device_get((acc, count))
+        metrics = {k: float(v) / float(n) for k, v in acc_host.items()} if n else {}
+        self.core.train.report_validation_metrics(self.steps_completed, metrics)
+        for cb in self.callbacks.values():
+            cb.on_validation_end(metrics)
+        return metrics
+
+    def _is_best(self, metrics: Dict[str, float]) -> bool:
+        name = self._searcher_metric or "validation_loss"
+        if name not in metrics:
+            return True  # nothing to compare on; treat as best
+        val = metrics[name]
+        if self.best_validation is None:
+            self.best_validation = val
+            return True
+        better = val < self.best_validation if self._smaller_is_better else val > self.best_validation
+        if better:
+            self.best_validation = val
+        return better
+
+    # -- the loop ----------------------------------------------------------
+
+    def fit(
+        self,
+        max_length: Any,
+        *,
+        validation_period: Optional[Any] = None,
+        checkpoint_period: Optional[Any] = None,
+        report_period: Optional[Any] = None,
+        latest_checkpoint: Optional[str] = None,
+        checkpoint_policy: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Train until ``max_length``; returns a summary dict."""
+        self._setup()
+        if checkpoint_policy is None:
+            cfg = self.context.exp_config
+            checkpoint_policy = cfg.checkpoint_policy if cfg is not None else "best"
+
+        max_steps = self._to_batches(Length.parse(max_length))
+        val_sched = _BoundarySchedule(self._to_batches(validation_period), max_steps)
+        ckpt_sched = _BoundarySchedule(self._to_batches(checkpoint_period), max_steps)
+        rep_period = self._to_batches(report_period)
+        if rep_period is None:
+            rep_period = min(100, max(1, max_steps // 10))
+        rep_sched = _BoundarySchedule(rep_period, max_steps)
+
+        if latest_checkpoint:
+            self._restore_checkpoint(latest_checkpoint)
+
+        for cb in self.callbacks.values():
+            cb.on_training_start(self)
+
+        train_iter = iter(self.train_loader)
+        gbs = self.train_loader.sampler.global_batch
+        hot_time = 0.0  # train-segment wall time since last report (excludes
+        # validation/checkpoint so samples_per_second tracks training only)
+        steps_since_report = 0
+        last_ckpt_sid: Optional[str] = None
+        last_val_metrics: Dict[str, float] = {}
+        stopped_early = False
+        epoch_seen = self.train_loader.epoch
+
+        while self.steps_completed < max_steps:
+            next_stop = min(
+                val_sched.next_after(self.steps_completed),
+                ckpt_sched.next_after(self.steps_completed),
+                rep_sched.next_after(self.steps_completed),
+                max_steps,
+            )
+            # ---- hot segment: no host syncs ------------------------------
+            seg_t0 = time.monotonic()
+            while self.steps_completed < next_stop:
+                host_batch = next(train_iter)
+                batch = to_global(host_batch, self.mesh)
+                self.state = self._train_step(self.state, batch)
+                self.steps_completed += 1
+                steps_since_report += 1
+            hot_time += time.monotonic() - seg_t0
+            if self.train_loader.epoch != epoch_seen:
+                for e in range(epoch_seen, self.train_loader.epoch):
+                    for cb in self.callbacks.values():
+                        cb.on_epoch_end(e)
+                epoch_seen = self.train_loader.epoch
+
+            at_end = self.steps_completed >= max_steps
+
+            # ---- REPORT ---------------------------------------------------
+            if rep_sched.is_boundary(self.steps_completed) or at_end:
+                sync_t0 = time.monotonic()
+                metrics = self.state.fetch_metrics()  # one host sync
+                hot_time += time.monotonic() - sync_t0
+                self.state = self.state.reset_metrics()
+                metrics["samples_per_second"] = steps_since_report * gbs / max(hot_time, 1e-9)
+                hot_time = 0.0
+                steps_since_report = 0
+                self.core.train.report_training_metrics(self.steps_completed, metrics)
+                self.core.train.report_progress(self.steps_completed / max_steps)
+                for cb in self.callbacks.values():
+                    cb.on_training_workload_end(self.steps_completed, metrics)
+
+            # ---- VALIDATE -------------------------------------------------
+            validated = False
+            if val_sched.period is not None and (
+                val_sched.is_boundary(self.steps_completed) or at_end
+            ):
+                last_val_metrics = self._validate()
+                validated = True
+
+            # ---- CHECKPOINT ----------------------------------------------
+            want_ckpt = ckpt_sched.period is not None and ckpt_sched.is_boundary(
+                self.steps_completed
+            )
+            if validated and checkpoint_policy == "all":
+                want_ckpt = True
+            if validated and checkpoint_policy == "best" and self._is_best(last_val_metrics):
+                want_ckpt = True
+            # ---- PREEMPT --------------------------------------------------
+            preempted = self.core.preempt.should_preempt()
+            if preempted:
+                want_ckpt = True
+            if want_ckpt:
+                last_ckpt_sid = self._save_checkpoint()
+            if preempted:
+                logger.info("preempted at step %d; exiting cleanly", self.steps_completed)
+                stopped_early = True
+                break
+
+        # final: always leave at least one checkpoint unless policy is none
+        if checkpoint_policy != "none" and last_ckpt_sid is None:
+            last_ckpt_sid = self._save_checkpoint()
+
+        for cb in self.callbacks.values():
+            cb.on_trial_shutdown()
+
+        return {
+            "steps_completed": self.steps_completed,
+            "latest_checkpoint": last_ckpt_sid,
+            "validation_metrics": last_val_metrics,
+            "stopped_early": stopped_early,
+            "best_validation": self.best_validation,
+        }

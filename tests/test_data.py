@@ -1,0 +1,113 @@
+"""Data layer tests: determinism, sharding, resume, global array assembly."""
+
+import numpy as np
+import pytest
+import jax
+
+from determined_tpu.data import (
+    DataLoader,
+    InMemoryDataset,
+    IndexSampler,
+    SamplerState,
+    SyntheticDataset,
+    mnist_like,
+    to_global,
+)
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def make_ds(n=100):
+    return InMemoryDataset({"x": np.arange(n, dtype=np.float32), "y": np.arange(n) % 3})
+
+
+def test_inmemory_dataset_basics():
+    ds = make_ds(10)
+    assert len(ds) == 10
+    item = ds[3]
+    assert item["x"] == 3.0 and item["y"] == 0
+    batch = ds.gather(np.array([1, 4]))
+    assert batch["x"].tolist() == [1.0, 4.0]
+
+
+def test_column_length_mismatch():
+    with pytest.raises(ValueError):
+        InMemoryDataset({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_sampler_shards_partition_global_batch():
+    # Union of all shards' batch b == global batch b, disjoint.
+    samplers = [
+        IndexSampler(100, 20, shard_rank=r, num_shards=4, seed=5) for r in range(4)
+    ]
+    full = IndexSampler(100, 20, seed=5)
+    for epoch in (0, 1):
+        global_batches = full.epoch_batches(epoch)
+        shard_batches = [s.epoch_batches(epoch) for s in samplers]
+        for b in range(full.batches_per_epoch):
+            union = np.concatenate([sb[b] for sb in shard_batches])
+            assert sorted(union.tolist()) == sorted(global_batches[b].tolist())
+            assert len(set(union.tolist())) == 20
+
+
+def test_sampler_epochs_reshuffle_deterministically():
+    s = IndexSampler(50, 10, seed=1)
+    e0a, e0b = s.epoch_indices(0), s.epoch_indices(0)
+    assert (e0a == e0b).all()
+    assert not (s.epoch_indices(0) == s.epoch_indices(1)).all()
+
+
+def test_sampler_validation():
+    with pytest.raises(ValueError):
+        IndexSampler(100, 21, num_shards=4)  # not divisible
+    with pytest.raises(ValueError):
+        IndexSampler(5, 10)  # dataset smaller than one batch
+
+
+def test_loader_resume_matches_uninterrupted():
+    ds = make_ds(64)
+    ref_loader = DataLoader(ds, 8, seed=3, shard_rank=0, num_shards=1)
+    ref = [b["x"].tolist() for _, b in zip(range(20), iter(ref_loader))]
+
+    # consume 7 batches, snapshot, resume fresh loader
+    loader = DataLoader(ds, 8, seed=3, shard_rank=0, num_shards=1)
+    it = iter(loader)
+    for _ in range(7):
+        next(it)
+    state = loader.state_dict()
+    resumed = DataLoader(ds, 8, seed=3, shard_rank=0, num_shards=1)
+    resumed.load_state_dict(state)
+    out = [b["x"].tolist() for _, b in zip(range(13), iter(resumed))]
+    assert out == ref[7:20]
+
+
+def test_loader_crosses_epoch_boundary():
+    ds = make_ds(16)
+    loader = DataLoader(ds, 8, seed=0, shard_rank=0, num_shards=1)
+    it = iter(loader)
+    seen = [next(it) for _ in range(5)]  # 2 batches/epoch -> epoch 2 reached
+    assert loader.state_dict() == {"epoch": 2, "batches_in_epoch": 1}
+    assert all(len(b["x"]) == 8 for b in seen)
+
+
+def test_to_global_sharded_over_mesh(devices8):
+    mesh = make_mesh(MeshConfig(data=4, tensor=2), devices8)
+    batch = {"x": np.arange(32, dtype=np.float32).reshape(8, 4)}
+    g = to_global(batch, mesh)
+    assert g["x"].shape == (8, 4)
+    assert g["x"].sharding.spec[0] in ("data", ("data",))
+    np.testing.assert_array_equal(np.asarray(g["x"]), batch["x"])
+
+
+def test_to_global_replicated_when_no_batch_axis(devices8):
+    mesh = make_mesh(MeshConfig(tensor=8), devices8)
+    g = to_global({"x": np.ones((4, 2), np.float32)}, mesh)
+    assert g["x"].sharding.spec == jax.sharding.PartitionSpec(None, None)
+
+
+def test_synthetic_and_mnist_like():
+    ds = SyntheticDataset({"x": ((3,), np.float32), "y": ((), np.int32, 7)}, size=20, seed=1)
+    assert ds.columns["x"].shape == (20, 3)
+    assert ds.columns["y"].max() < 7
+    m = mnist_like(size=32)
+    assert m.columns["image"].shape == (32, 28, 28, 1)
+    assert 0 <= m.columns["label"].min() and m.columns["label"].max() < 10
