@@ -1,0 +1,17 @@
+"""Model zoo: MNIST tutorials + flagship transformer LM."""
+
+from determined_tpu.models.mnist import MnistCNN, MnistMLP, MnistTrial
+from determined_tpu.models.transformer import (
+    LMTrial,
+    TransformerConfig,
+    TransformerLM,
+)
+
+__all__ = [
+    "MnistCNN",
+    "MnistMLP",
+    "MnistTrial",
+    "LMTrial",
+    "TransformerConfig",
+    "TransformerLM",
+]

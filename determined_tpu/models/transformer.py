@@ -1,0 +1,296 @@
+"""Flagship model: decoder-only transformer LM, TPU-first.
+
+The reference platform ships no model internals (its deepest model hooks
+are DeepSpeed pipeline/MPU passthrough, ``deepspeed/_mpu.py``).  This is
+the framework's flagship: one module that runs DP / FSDP / TP / SP by
+MeshConfig alone, with:
+
+- logical-axis partitioning on every kernel (embed/heads/kv/mlp/vocab),
+  resolved by LogicalAxisRules -> XLA inserts the collectives;
+- activation sharding constraints (batch over dp/fsdp, seq over sp);
+- rotary position embeddings, GQA, RMSNorm, SwiGLU;
+- attention dispatch: ring attention when the mesh has a "seq" axis,
+  Pallas flash attention on TPU otherwise, reference for tiny seqs;
+- bf16 compute with f32 params, per-block remat for long-context memory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from determined_tpu.data import DataLoader, SyntheticDataset
+from determined_tpu.ops.attention import dot_product_attention
+from determined_tpu.ops.ring_attention import ring_attention
+from determined_tpu.parallel.mesh import MeshAxes
+from determined_tpu.parallel.sharding import with_sharding_constraint
+from determined_tpu.train._trial import JaxTrial
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None          # None -> n_heads (MHA)
+    d_ff: Optional[int] = None                # None -> 4 * d_model
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16                 # activation/compute dtype
+    attention_impl: str = "auto"              # auto|reference|flash|ring
+    remat: bool = False
+    rope_theta: float = 10000.0
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings on [b, h, s, d]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [s, d/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x1 * sin + x2 * cos
+    return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param(
+            "scale", nn.with_partitioning(nn.initializers.ones, ("embed",)), (x.shape[-1],)
+        )
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + self.eps).astype(x.dtype)) * scale.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    mesh: Any = None  # jax.sharding.Mesh when ring attention is in play
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        b, s, _ = x.shape
+        hd = cfg.head_dim
+        dense = lambda feats, logical, name: nn.DenseGeneral(  # noqa: E731
+            feats,
+            axis=-1,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), logical),
+            name=name,
+        )
+        q = dense((cfg.n_heads, hd), ("embed", "heads", "head_dim"), "wq")(x)
+        k = dense((cfg.kv_heads, hd), ("embed", "kv", "head_dim"), "wk")(x)
+        v = dense((cfg.kv_heads, hd), ("embed", "kv", "head_dim"), "wv")(x)
+        # [b, s, h, d] -> [b, h, s, d]
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+
+        positions = jnp.arange(s)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        impl = cfg.attention_impl
+        use_ring = (
+            impl == "ring"
+            or (
+                impl == "auto"
+                and self.mesh is not None
+                and self.mesh.shape.get(MeshAxes.SEQUENCE, 1) > 1
+            )
+        )
+        if use_ring:
+            if self.mesh is None:
+                raise ValueError("ring attention requires the mesh")
+            out = ring_attention(q, k, v, self.mesh, causal=True)
+        else:
+            out = dot_product_attention(q, k, v, causal=True, impl=impl)
+        out = out.transpose(0, 2, 1, 3)  # [b, s, h, d]
+        out = nn.DenseGeneral(
+            cfg.d_model,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("heads", "head_dim", "embed")
+            ),
+            name="wo",
+        )(out)
+        return out
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dense = lambda feats, logical, name: nn.Dense(  # noqa: E731
+            feats,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), logical),
+            name=name,
+        )
+        gate = dense(cfg.ff_dim, ("embed", "mlp"), "w_gate")(x)
+        up = dense(cfg.ff_dim, ("embed", "mlp"), "w_up")(x)
+        h = nn.silu(gate) * up
+        h = with_sharding_constraint(h, ("batch", "length", "mlp"), mesh=self.mesh)
+        return dense(cfg.d_model, ("mlp", "embed"), "w_down")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x + Attention(self.cfg, self.mesh, name="attn")(RMSNorm(name="ln1")(x))
+        x = x + MLP(self.cfg, self.mesh, name="mlp")(RMSNorm(name="ln2")(x))
+        return with_sharding_constraint(x, ("batch", "length", "embed"), mesh=self.mesh)
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            embedding_init=nn.with_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            name="embed",
+        )
+        x = embed(tokens)
+        x = with_sharding_constraint(x, ("batch", "length", "embed"), mesh=self.mesh)
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.n_layers):
+            x = block_cls(cfg, self.mesh, name=f"block_{i}")(x)
+        x = RMSNorm(name="ln_f")(x)
+        logits = nn.Dense(
+            cfg.vocab_size,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+class LMTrial(JaxTrial):
+    """Language-model trial over synthetic (or user-supplied) token data.
+
+    Hyperparameters: lr, global_batch_size, seq_len, vocab_size, d_model,
+    n_layers, n_heads, n_kv_heads, d_ff, attention (auto/flash/ring/
+    reference), remat, warmup_steps, dataset_size.
+    """
+
+    def _cfg(self) -> TransformerConfig:
+        g = self.context.get_hparam
+        return TransformerConfig(
+            vocab_size=int(g("vocab_size", 2048)),
+            d_model=int(g("d_model", 256)),
+            n_layers=int(g("n_layers", 2)),
+            n_heads=int(g("n_heads", 8)),
+            n_kv_heads=g("n_kv_heads", None),
+            d_ff=g("d_ff", None),
+            max_seq_len=int(g("seq_len", 512)),
+            attention_impl=str(g("attention", "auto")),
+            remat=bool(g("remat", False)),
+            dtype=jnp.bfloat16 if bool(g("bf16", True)) else jnp.float32,
+        )
+
+    def build_model(self) -> TransformerLM:
+        return TransformerLM(self._cfg(), mesh=self.context.mesh)
+
+    def build_optimizer(self) -> optax.GradientTransformation:
+        g = self.context.get_hparam
+        lr = float(g("lr", 3e-4))
+        warmup = int(g("warmup_steps", 100))
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, lr, warmup, int(g("decay_steps", 10000))
+        )
+        return optax.chain(
+            optax.clip_by_global_norm(float(g("grad_clip", 1.0))),
+            optax.adamw(schedule, weight_decay=float(g("weight_decay", 0.01))),
+        )
+
+    def _dataset(self, seed: int) -> SyntheticDataset:
+        g = self.context.get_hparam
+        seq = int(g("seq_len", 512))
+        size = int(g("dataset_size", 2048))
+        return SyntheticDataset(
+            {"tokens": ((seq + 1,), np.int32, int(g("vocab_size", 2048)))},
+            size=size,
+            seed=seed,
+        )
+
+    def build_training_data_loader(self) -> DataLoader:
+        return DataLoader(
+            self._dataset(0),
+            self.context.get_global_batch_size(),
+            shuffle=True,
+            seed=self.context.seed,
+        )
+
+    def build_validation_data_loader(self) -> DataLoader:
+        return DataLoader(
+            self._dataset(1),
+            self.context.get_global_batch_size(),
+            shuffle=False,
+            seed=self.context.seed,
+        )
+
+    def model_inputs(self, batch: Dict[str, Any]) -> Tuple[Any, ...]:
+        return (jnp.asarray(batch["tokens"])[:, :-1],)
+
+    def loss(
+        self, model: TransformerLM, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply(params, inputs)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+        return loss, {"perplexity": jnp.exp(loss)}
+
+    def evaluate_batch(
+        self, model: TransformerLM, params: Any, batch: Dict[str, jax.Array]
+    ) -> Dict[str, jax.Array]:
+        loss, metrics = self.loss(model, params, batch, jax.random.key(0))
+        return {"validation_loss": loss, "validation_perplexity": metrics["perplexity"]}

@@ -1,0 +1,12 @@
+"""TPU compute ops: attention family (reference / Pallas flash / ring)."""
+
+from determined_tpu.ops.attention import dot_product_attention, reference_attention
+from determined_tpu.ops.flash_attention import flash_attention
+from determined_tpu.ops.ring_attention import ring_attention
+
+__all__ = [
+    "dot_product_attention",
+    "reference_attention",
+    "flash_attention",
+    "ring_attention",
+]

@@ -1,0 +1,85 @@
+"""Attention ops: reference implementation + dispatcher.
+
+The reference platform has NO in-repo attention/kernels (SURVEY.md §2.10 —
+all math lives in torch/DeepSpeed).  On TPU the attention kernel IS the
+performance story, so this framework ships its own:
+
+- ``reference_attention``: pure-jnp softmax attention (correctness anchor,
+  small-seq fallback; XLA already fuses it well for short sequences).
+- ``flash_attention``: Pallas blockwise kernel (ops/flash_attention.py),
+  O(seq) memory, MXU-tiled.
+- ``ring_attention``: sequence-parallel blockwise attention over the mesh
+  "seq" axis (ops/ring_attention.py) for long-context.
+
+All take [batch, heads, q_len, head_dim] q and [batch, kv_heads, kv_len,
+head_dim] k/v (GQA when kv_heads < heads).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """Expand kv heads for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, h, s, d = k.shape
+    return jnp.broadcast_to(k[:, :, None], (b, h, n_rep, s, d)).reshape(b, h * n_rep, s, d)
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_offset: int = 0,
+) -> jax.Array:
+    """Plain softmax attention; the semantics every other impl must match.
+
+    ``q_offset``: global position of q[0] relative to k[0] (used by ring
+    attention shards and KV-cache decoding).
+    """
+    *_, q_len, head_dim = q.shape
+    kv_len = k.shape[-2]
+    n_rep = q.shape[-3] // k.shape[-3]
+    k, v = _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
+    scale = scale if scale is not None else head_dim ** -0.5
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q_len)[:, None]
+        k_pos = jnp.arange(kv_len)[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Dispatcher: 'auto' picks flash on TPU for seqs worth tiling."""
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "flash" if on_tpu and q.shape[-2] >= 256 else "reference"
+    if impl == "reference":
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "flash":
+        from determined_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")

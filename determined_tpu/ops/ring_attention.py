@@ -1,0 +1,196 @@
+"""Ring attention: sequence-parallel attention over the mesh "seq" axis.
+
+Long-context machinery the reference platform lacks entirely (SURVEY.md
+§2.10: "SP / CP / ring attention ... not present").  Design follows the
+blockwise-parallel / ring-attention construction: q, k, v are sharded along
+the sequence dim across the "seq" mesh axis; each device computes blockwise
+attention of its local queries against the k/v shard it currently holds,
+maintaining a running (m, l, acc) softmax state, then passes the k/v shard
+to its ring neighbor with ``lax.ppermute`` (XLA lowers this to ICI
+neighbor exchanges that overlap with the block compute).
+
+Memory per device is O(S/N) in BOTH directions: the backward is a custom
+VJP that re-runs the ring, rotating (k, v, dk, dv) together so no per-step
+k/v residuals are stored (a plain autodiff through the scan would stash
+every rotated shard = O(S) per device).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 moved shard_map to jax.shard_map
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from determined_tpu.ops.attention import _repeat_kv
+from determined_tpu.parallel.mesh import MeshAxes
+
+NEG_INF = -1e30
+
+
+def _block_logits(q, k, scale, causal, q_start, k_start, sl):
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        q_pos = q_start + jnp.arange(sl)[:, None]
+        k_pos = k_start + jnp.arange(sl)[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s
+
+
+def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
+    """Forward ring sweep; returns (out, lse) with local seq shards."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, sl, d = q.shape
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    m = jnp.full((b, h, sl, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sl, 1), jnp.float32)
+    acc = jnp.zeros((b, h, sl, d), jnp.float32)
+
+    def step_fn(carry, step):
+        m, l, acc, k_cur, v_cur = carry
+        src = (idx - step) % n
+        s = _block_logits(qf, k_cur, scale, causal, idx * sl, src * sl, sl)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l, acc, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(step_fn, (m, l, acc, k, v), jnp.arange(n))
+    l = jnp.maximum(l, 1e-30)
+    out = (acc / l).astype(q.dtype)
+    lse = m + jnp.log(l)  # [b, h, sl, 1]
+    return out, lse
+
+
+def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale):
+    """Backward ring sweep: dk/dv rotate WITH their k/v shards, arriving
+    home after n steps; no per-step residuals are kept."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, sl, d = q.shape
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1, keepdims=True)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    dq = jnp.zeros((b, h, sl, d), jnp.float32)
+    dk = jnp.zeros_like(k, dtype=jnp.float32)
+    dv = jnp.zeros_like(v, dtype=jnp.float32)
+
+    def step_fn(carry, step):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (idx - step) % n
+        s = _block_logits(qf, k_cur, scale, causal, idx * sl, src * sl, sl)
+        p = jnp.exp(s - lse)                                  # [b,h,ql,kl]
+        dp = jnp.einsum(
+            "bhqd,bhkd->bhqk", dof, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dq = dq + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        dk_cur = dk_cur + jnp.einsum(
+            "bhqk,bhqd->bhkd", ds, qf, preferred_element_type=jnp.float32
+        )
+        dv_cur = dv_cur + jnp.einsum(
+            "bhqk,bhqd->bhkd", p, dof, preferred_element_type=jnp.float32
+        )
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return (dq, k_nxt, v_nxt, dk_nxt, dv_nxt), None
+
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step_fn, (dq, k, v, dk, dv), jnp.arange(n)
+    )
+    # after n rotations dk/dv have completed a full loop and are home
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_local(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_fwd_local(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
+    return out
+
+
+def _ring_local_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_fwd_local(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_local_bwd(axis_name, causal, scale, res, g):
+    q, k, v, out, lse = res
+    return _ring_bwd_local(
+        q, k, v, out, lse, g, axis_name=axis_name, causal=causal, scale=scale
+    )
+
+
+_ring_local.defvjp(_ring_local_fwd, _ring_local_bwd)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    seq_axis: str = MeshAxes.SEQUENCE,
+) -> jax.Array:
+    """Sequence-parallel attention over global [b, h, S, d] arrays.
+
+    Batch dim may additionally be sharded over data/fsdp axes and heads over
+    the tensor axis; the seq dim is sharded over ``seq_axis``.  GQA kv heads
+    are expanded before the ring (gradient re-reduction over the group comes
+    from the broadcast's transpose).  Falls back to single-shard blockwise
+    attention when the mesh has no seq axis.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    n_rep = q.shape[1] // k.shape[1]
+    k, v = _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
+
+    if mesh.shape.get(seq_axis, 1) <= 1:
+        from determined_tpu.ops.attention import reference_attention
+
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+
+    batch_axes = tuple(
+        a for a in (MeshAxes.DATA, MeshAxes.FSDP) if mesh.shape.get(a, 1) > 1
+    )
+    head_axis = MeshAxes.TENSOR if mesh.shape.get(MeshAxes.TENSOR, 1) > 1 else None
+    spec = P(batch_axes or None, head_axis, seq_axis, None)
+
+    fn = shard_map(
+        lambda q, k, v: _ring_local(q, k, v, seq_axis, causal, scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -53,7 +53,8 @@ def logical_to_mesh_spec(
     """
     if logical is None:
         return P()
-    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh is not None else None
+    # mesh.shape works for both concrete Mesh and AbstractMesh
+    axis_sizes = dict(mesh.shape) if mesh is not None else None
 
     def resolve(name: Optional[str]):
         if name is None:
@@ -136,7 +137,6 @@ def with_sharding_constraint(
 
 
 def _current_mesh() -> Optional[Mesh]:
-    mesh = jax.sharding.get_abstract_mesh() if hasattr(jax.sharding, "get_abstract_mesh") else None
     try:
         from jax._src.mesh import thread_resources
 
@@ -145,6 +145,10 @@ def _current_mesh() -> Optional[Mesh]:
             return env_mesh
     except Exception:
         pass
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is not None and getattr(mesh, "axis_names", ()):
+            return mesh
     return None
 
 

@@ -1,0 +1,42 @@
+"""Hyperparameter search: single/random/grid/ASHA/adaptive-ASHA + simulation."""
+
+from determined_tpu.searcher._base import (
+    Action,
+    Create,
+    ExitedReason,
+    RequestID,
+    SearcherContext,
+    SearchMethod,
+    Shutdown,
+    Stop,
+)
+from determined_tpu.searcher._searcher import (
+    Searcher,
+    TrialRecord,
+    method_from_config,
+    simulate,
+)
+from determined_tpu.searcher.adaptive import TournamentSearch, make_adaptive_asha
+from determined_tpu.searcher.asha import ASHASearch
+from determined_tpu.searcher.methods import GridSearch, RandomSearch, SingleSearch
+
+__all__ = [
+    "Action",
+    "Create",
+    "ExitedReason",
+    "RequestID",
+    "SearcherContext",
+    "SearchMethod",
+    "Shutdown",
+    "Stop",
+    "Searcher",
+    "TrialRecord",
+    "method_from_config",
+    "simulate",
+    "TournamentSearch",
+    "make_adaptive_asha",
+    "ASHASearch",
+    "GridSearch",
+    "RandomSearch",
+    "SingleSearch",
+]

@@ -1,0 +1,227 @@
+"""Searcher driver: event dispatch, state tracking, simulation harness.
+
+Reference: ``master/pkg/searcher/searcher.go:45,226`` (the stateful wrapper
+the experiment engine talks to) and ``simulate.go:65`` (dry-run preview of
+what a search method will do — used for tests and `det preview-search`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+from determined_tpu.config.experiment import ExperimentConfig, SearcherConfig
+from determined_tpu.searcher._base import (
+    Action,
+    Create,
+    RequestID,
+    SearcherContext,
+    SearchMethod,
+    Shutdown,
+    Stop,
+)
+from determined_tpu.searcher.adaptive import make_adaptive_asha
+from determined_tpu.searcher.asha import ASHASearch
+from determined_tpu.searcher.methods import GridSearch, RandomSearch, SingleSearch
+
+
+def method_from_config(
+    cfg: SearcherConfig, hparams: Dict[str, Any]
+) -> SearchMethod:
+    """Build the SearchMethod an experiment config asks for."""
+    max_time = cfg.max_time
+    if max_time is None and cfg.max_length is not None:
+        max_time = cfg.max_length.units
+    if cfg.name == "single":
+        return SingleSearch()
+    if cfg.name == "random":
+        return RandomSearch(cfg.max_trials, cfg.max_concurrent_trials)
+    if cfg.name == "grid":
+        return GridSearch(hparams, cfg.max_concurrent_trials)
+    if cfg.name == "asha":
+        return ASHASearch(
+            metric=cfg.metric,
+            smaller_is_better=cfg.smaller_is_better,
+            max_time=max_time or 100,
+            time_metric=cfg.time_metric or "batches",
+            num_rungs=cfg.num_rungs,
+            divisor=cfg.divisor,
+            max_trials=cfg.max_trials,
+            max_concurrent_trials=cfg.max_concurrent_trials,
+        )
+    if cfg.name == "adaptive_asha":
+        return make_adaptive_asha(
+            metric=cfg.metric,
+            smaller_is_better=cfg.smaller_is_better,
+            max_time=max_time or 100,
+            time_metric=cfg.time_metric or "batches",
+            max_trials=cfg.max_trials,
+            max_rungs=cfg.num_rungs,
+            divisor=cfg.divisor,
+            mode=cfg.mode,
+            max_concurrent_trials=cfg.max_concurrent_trials,
+            bracket_rungs=cfg.bracket_rungs,
+        )
+    raise ValueError(f"unknown searcher {cfg.name!r}")
+
+
+@dataclasses.dataclass
+class TrialRecord:
+    request_id: RequestID
+    hparams: Dict[str, Any]
+    running: bool = True
+    stopped_by_searcher: bool = False
+    exited: bool = False
+    metrics: Optional[Dict[str, Any]] = None  # last validation
+
+
+class Searcher:
+    """Stateful wrapper the experiment engine drives."""
+
+    def __init__(
+        self, method: SearchMethod, hparams: Dict[str, Any], seed: int = 0
+    ) -> None:
+        self.method = method
+        self.ctx = SearcherContext(hparams, seed)
+        self.trials: Dict[RequestID, TrialRecord] = {}
+        self.shutdown: Optional[Shutdown] = None
+        self._trial_progress: Dict[RequestID, float] = {}
+
+    # -- event entry points (called by the experiment engine) --------------
+
+    def _absorb(self, actions: List[Action]) -> List[Action]:
+        for a in actions:
+            if isinstance(a, Create):
+                self.trials[a.request_id] = TrialRecord(a.request_id, a.hparams)
+            elif isinstance(a, Stop):
+                if a.request_id in self.trials:
+                    self.trials[a.request_id].stopped_by_searcher = True
+            elif isinstance(a, Shutdown):
+                self.shutdown = a
+        # trial_created events fire for newly absorbed creates
+        extra: List[Action] = []
+        for a in actions:
+            if isinstance(a, Create):
+                extra.extend(self.method.trial_created(self.ctx, a.request_id))
+        if extra:
+            actions = actions + self._absorb(extra)
+        return actions
+
+    def start(self) -> List[Action]:
+        return self._absorb(self.method.initial_trials(self.ctx))
+
+    def on_validation(
+        self, request_id: RequestID, metrics: Dict[str, Any]
+    ) -> List[Action]:
+        if request_id in self.trials:
+            self.trials[request_id].metrics = dict(metrics)
+        return self._absorb(
+            self.method.validation_completed(self.ctx, request_id, metrics)
+        )
+
+    def on_trial_exited(self, request_id: RequestID) -> List[Action]:
+        if request_id in self.trials:
+            rec = self.trials[request_id]
+            rec.running = False
+            rec.exited = True
+        return self._absorb(self.method.trial_exited(self.ctx, request_id))
+
+    def on_trial_exited_early(self, request_id: RequestID, reason: str) -> List[Action]:
+        if request_id in self.trials:
+            self.trials[request_id].running = False
+            self.trials[request_id].exited = True
+        return self._absorb(
+            self.method.trial_exited_early(self.ctx, request_id, reason)
+        )
+
+    def set_trial_progress(self, request_id: RequestID, progress: float) -> None:
+        self._trial_progress[request_id] = progress
+
+    def progress(self) -> float:
+        closed = {rid: t.exited for rid, t in self.trials.items()}
+        return self.method.progress(self._trial_progress, closed)
+
+    # -- snapshot ----------------------------------------------------------
+
+    def state_json(self) -> str:
+        return json.dumps(
+            {
+                "method": self.method.state_dict(),
+                "ctx": self.ctx.state_dict(),
+                "trials": {
+                    str(rid): dataclasses.asdict(t) for rid, t in self.trials.items()
+                },
+                "shutdown": self.shutdown is not None,
+            }
+        )
+
+    def restore_json(self, text: str) -> None:
+        state = json.loads(text)
+        self.method.load_state_dict(state["method"])
+        if "ctx" in state:
+            self.ctx.load_state_dict(state["ctx"])
+        self.trials = {
+            int(rid): TrialRecord(**t) for rid, t in state["trials"].items()
+        }
+        if state["shutdown"]:
+            self.shutdown = Shutdown()
+
+
+def simulate(
+    config: ExperimentConfig,
+    trial_fn: Callable[[Dict[str, Any], int], float],
+    *,
+    seed: int = 0,
+    report_period: int = 0,
+) -> Dict[str, Any]:
+    """Run a whole search synchronously against a synthetic trial function.
+
+    ``trial_fn(hparams, time_step) -> metric`` models a trial's validation
+    metric at a given step.  Trials validate every ``report_period`` units
+    (default: each rung boundary granularity = max_time / divisor**k).
+    Returns summary: trials created, units spent, best metric.
+
+    Reference: ``master/pkg/searcher/simulate.go:65``.
+    """
+    scfg = config.searcher
+    method = method_from_config(scfg, config.hyperparameters)
+    searcher = Searcher(method, config.hyperparameters, seed)
+    max_time = scfg.max_time or (scfg.max_length.units if scfg.max_length else 100)
+    period = report_period or max(max_time // (scfg.divisor ** (scfg.num_rungs - 1)), 1)
+    period = int(period)
+
+    searcher.start()
+    total_units = 0
+    best: Optional[float] = None
+    better = (lambda a, b: a < b) if scfg.smaller_is_better else (lambda a, b: a > b)
+    # round-robin: each running trial advances one period per pass
+    trial_steps: Dict[RequestID, int] = {}
+    guard = 0
+    while searcher.shutdown is None and guard < 100_000:
+        guard += 1
+        running = [t for t in searcher.trials.values() if t.running]
+        if not running:
+            break
+        for rec in running:
+            if searcher.shutdown is not None:
+                break
+            step = trial_steps.get(rec.request_id, 0) + period
+            trial_steps[rec.request_id] = step
+            total_units += period
+            metric = trial_fn(rec.hparams, step)
+            if best is None or better(metric, best):
+                best = metric
+            searcher.on_validation(
+                rec.request_id,
+                {scfg.metric: metric, scfg.time_metric or "batches": step},
+            )
+            if rec.stopped_by_searcher or step >= max_time:
+                searcher.on_trial_exited(rec.request_id)
+    return {
+        "trials_created": len(searcher.trials),
+        "total_units": total_units,
+        "best_metric": best,
+        "max_time": max_time,
+        "trial_units": dict(trial_steps),
+    }

@@ -356,9 +356,10 @@ class Trainer:
             cb.on_validation_start()
         acc: Dict[str, jax.Array] = {}
         count = jnp.zeros((), jnp.float32)
-        for host_batch in self.val_loader.iter_epoch(0):
-            batch = to_global(host_batch, self.mesh)
-            acc, count = self._eval_step(self.state.params, batch, acc, count)
+        with self.mesh:
+            for host_batch in self.val_loader.iter_epoch(0):
+                batch = to_global(host_batch, self.mesh)
+                acc, count = self._eval_step(self.state.params, batch, acc, count)
         acc_host, n = jax.device_get((acc, count))
         metrics = {k: float(v) / float(n) for k, v in acc_host.items()} if n else {}
         self.core.train.report_validation_metrics(self.steps_completed, metrics)
@@ -430,12 +431,15 @@ class Trainer:
             )
             # ---- hot segment: no host syncs ------------------------------
             seg_t0 = time.monotonic()
-            while self.steps_completed < next_stop:
-                host_batch = next(train_iter)
-                batch = to_global(host_batch, self.mesh)
-                self.state = self._train_step(self.state, batch)
-                self.steps_completed += 1
-                steps_since_report += 1
+            # the mesh context makes trace-time sharding constraints resolve
+            # for models that annotate activations without an explicit mesh
+            with self.mesh:
+                while self.steps_completed < next_stop:
+                    host_batch = next(train_iter)
+                    batch = to_global(host_batch, self.mesh)
+                    self.state = self._train_step(self.state, batch)
+                    self.steps_completed += 1
+                    steps_since_report += 1
             hot_time += time.monotonic() - seg_t0
             if self.train_loader.epoch != epoch_seen:
                 for e in range(epoch_seen, self.train_loader.epoch):

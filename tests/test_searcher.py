@@ -1,0 +1,217 @@
+"""Searcher tests: method semantics + end-to-end simulation.
+
+Modeled on the reference's searcher unit tests + ``simulate.go`` harness
+(``master/pkg/searcher/*_test.go``).
+"""
+
+import numpy as np
+import pytest
+
+from determined_tpu.config import ExperimentConfig
+from determined_tpu.searcher import (
+    ASHASearch,
+    Create,
+    Searcher,
+    SearcherContext,
+    Shutdown,
+    Stop,
+    make_adaptive_asha,
+    method_from_config,
+    simulate,
+)
+from determined_tpu.searcher.adaptive import (
+    bracket_max_trials,
+    bracket_rungs_for_mode,
+)
+
+HPARAMS = {"lr": {"type": "log", "minval": -4, "maxval": -1}, "units": 64}
+
+
+def parse_space():
+    from determined_tpu.config import parse_hyperparameters
+
+    return parse_hyperparameters(HPARAMS)
+
+
+def test_single_search_lifecycle():
+    cfg = ExperimentConfig.parse(
+        {"hyperparameters": HPARAMS, "searcher": {"name": "single", "metric": "loss"}}
+    )
+    searcher = Searcher(method_from_config(cfg.searcher, cfg.hyperparameters), cfg.hyperparameters)
+    actions = searcher.start()
+    assert len([a for a in actions if isinstance(a, Create)]) == 1
+    rid = actions[0].request_id
+    searcher.on_validation(rid, {"loss": 1.0, "batches": 10})
+    out = searcher.on_trial_exited(rid)
+    assert any(isinstance(a, Shutdown) for a in out)
+    assert searcher.progress() == 1.0
+
+
+def test_random_search_creates_max_trials():
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": HPARAMS,
+            "searcher": {"name": "random", "metric": "loss", "max_trials": 5,
+                         "max_concurrent_trials": 2},
+        }
+    )
+    searcher = Searcher(method_from_config(cfg.searcher, cfg.hyperparameters), cfg.hyperparameters)
+    searcher.start()
+    assert len(searcher.trials) == 2
+    # drive trials to completion; new ones replace them up to max_trials
+    while searcher.shutdown is None:
+        running = [t for t in searcher.trials.values() if t.running]
+        assert running, "deadlock"
+        searcher.on_trial_exited(running[0].request_id)
+    assert len(searcher.trials) == 5
+    # all sampled hparams in bounds
+    for t in searcher.trials.values():
+        assert 1e-4 <= t.hparams["lr"] <= 1e-1
+        assert t.hparams["units"] == 64
+
+
+def test_grid_search_covers_all_points():
+    hp = {"a": {"type": "categorical", "vals": [1, 2, 3]}, "b": {"type": "int", "minval": 0, "maxval": 1}}
+    cfg = ExperimentConfig.parse(
+        {"hyperparameters": hp, "searcher": {"name": "grid", "metric": "loss"}}
+    )
+    searcher = Searcher(method_from_config(cfg.searcher, cfg.hyperparameters), cfg.hyperparameters)
+    searcher.start()
+    while searcher.shutdown is None:
+        running = [t for t in searcher.trials.values() if t.running]
+        searcher.on_trial_exited(running[0].request_id)
+    combos = {(t.hparams["a"], t.hparams["b"]) for t in searcher.trials.values()}
+    assert len(combos) == 6
+
+
+def test_asha_rungs_and_stopping():
+    method = ASHASearch(
+        metric="loss", max_time=64, num_rungs=3, divisor=4, max_trials=8,
+        max_concurrent_trials=4,
+    )
+    assert [r.units_needed for r in method.rungs] == [4, 16, 64]
+    ctx = SearcherContext(parse_space(), seed=0)
+    searcher = Searcher(method, HPARAMS)
+    searcher.ctx = ctx
+    creates = searcher.start()
+    assert len(creates) == 4
+    rids = [a.request_id for a in creates if isinstance(a, Create)]
+    # first trial reports a bad metric at rung 0 -> survives (best so far)
+    out = searcher.on_validation(rids[0], {"loss": 10.0, "batches": 4})
+    assert not any(isinstance(a, Stop) for a in out)
+    # second reports better -> survives; first's 10.0 is now bottom but
+    # already recorded: third reports mid -> with 3 entries, top 1/4 -> only
+    # best continues
+    out = searcher.on_validation(rids[1], {"loss": 1.0, "batches": 4})
+    assert not any(isinstance(a, Stop) for a in out)
+    out = searcher.on_validation(rids[2], {"loss": 5.0, "batches": 4})
+    assert any(isinstance(a, Stop) for a in out)
+    # a stop triggers a replacement create while under max_trials
+    assert any(isinstance(a, Create) for a in out)
+
+
+def test_asha_top_rung_stops_trial():
+    method = ASHASearch(
+        metric="loss", max_time=16, num_rungs=2, divisor=4, max_trials=2,
+        max_concurrent_trials=1,
+    )
+    searcher = Searcher(method, parse_space())
+    creates = searcher.start()
+    rid = creates[0].request_id
+    out = searcher.on_validation(rid, {"loss": 0.5, "batches": 16})
+    assert any(isinstance(a, Stop) for a in out)
+
+
+def test_adaptive_modes():
+    assert bracket_rungs_for_mode("conservative", 4) == [1, 2, 3, 4]
+    assert bracket_rungs_for_mode("standard", 4) == [2, 3, 4]
+    assert bracket_rungs_for_mode("aggressive", 4) == [4]
+    trials = bracket_max_trials(20, 4.0, [3, 2])
+    assert sum(trials) == 20 and trials[0] > trials[1]
+
+
+def test_adaptive_asha_tournament_routing():
+    method = make_adaptive_asha(
+        metric="loss", max_time=64, max_trials=8, max_rungs=3, divisor=4,
+        mode="standard",
+    )
+    assert len(method.subs) >= 2
+    searcher = Searcher(method, parse_space())
+    creates = searcher.start()
+    assert creates
+    owners = {method.owner[a.request_id] for a in creates if isinstance(a, Create)}
+    assert len(owners) == len(method.subs)  # every bracket got trials
+
+
+def test_simulation_asha_budget_below_uniform():
+    """ASHA must spend far fewer units than running every trial to max."""
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": HPARAMS,
+            "searcher": {
+                "name": "asha",
+                "metric": "loss",
+                "max_trials": 16,
+                "max_length": {"batches": 64},
+                "num_rungs": 3,
+                "divisor": 4,
+                "max_concurrent_trials": 8,
+            },
+        }
+    )
+
+    def trial_fn(hparams, step):
+        # better lr -> lower loss; improves with steps
+        return abs(np.log10(hparams["lr"]) + 2.5) + 10.0 / step
+
+    result = simulate(cfg, trial_fn, seed=3)
+    assert result["trials_created"] >= 16
+    uniform_budget = result["trials_created"] * 64
+    assert result["total_units"] < 0.6 * uniform_budget, result
+    assert result["best_metric"] < 1.5
+
+
+def test_simulation_adaptive_asha_end_to_end():
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": HPARAMS,
+            "searcher": {
+                "name": "adaptive_asha",
+                "metric": "loss",
+                "max_trials": 16,
+                "max_length": {"batches": 64},
+                "num_rungs": 3,
+                "divisor": 4,
+            },
+        }
+    )
+    result = simulate(cfg, lambda hp, step: abs(np.log10(hp["lr"]) + 2.5) + 1.0 / step)
+    assert result["trials_created"] >= 16
+    assert result["best_metric"] is not None
+
+
+def test_searcher_snapshot_restore_mid_search():
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": HPARAMS,
+            "searcher": {
+                "name": "asha", "metric": "loss", "max_trials": 8,
+                "max_length": {"batches": 64}, "num_rungs": 3, "divisor": 4,
+                "max_concurrent_trials": 4,
+            },
+        }
+    )
+    s1 = Searcher(method_from_config(cfg.searcher, cfg.hyperparameters), cfg.hyperparameters)
+    creates = s1.start()
+    rids = [a.request_id for a in creates]
+    s1.on_validation(rids[0], {"loss": 3.0, "batches": 4})
+    s1.on_validation(rids[1], {"loss": 1.0, "batches": 4})
+    snap = s1.state_json()
+
+    s2 = Searcher(method_from_config(cfg.searcher, cfg.hyperparameters), cfg.hyperparameters)
+    s2.restore_json(snap)
+    # same rung state: a mid metric must now be stopped in both
+    out1 = s1.on_validation(rids[2], {"loss": 2.0, "batches": 4})
+    out2 = s2.on_validation(rids[2], {"loss": 2.0, "batches": 4})
+    assert [type(a).__name__ for a in out1] == [type(a).__name__ for a in out2]
+    assert any(isinstance(a, Stop) for a in out2)

@@ -1,0 +1,99 @@
+"""Flagship transformer tests: sharded training across mesh topologies."""
+
+import jax
+import numpy as np
+import pytest
+
+from determined_tpu import core, train
+from determined_tpu.config import Length
+from determined_tpu.models.transformer import LMTrial, TransformerConfig, TransformerLM
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+HPARAMS = {
+    "lr": 1e-3,
+    "global_batch_size": 8,
+    "seq_len": 64,
+    "vocab_size": 256,
+    "d_model": 64,
+    "n_layers": 2,
+    "n_heads": 4,
+    "dataset_size": 64,
+    "bf16": False,
+    "warmup_steps": 2,
+    "attention": "reference",
+}
+
+
+def make_trainer(tmp_path, mesh_config, **hp_over):
+    hp = {**HPARAMS, **hp_over}
+    ctx = train.init(
+        hparams=hp,
+        mesh_config=mesh_config,
+        core_context=core._dummy_init(checkpoint_dir=str(tmp_path / "ckpts")),
+        seed=11,
+    )
+    return train.Trainer(LMTrial(ctx))
+
+
+def test_forward_shapes():
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, max_seq_len=32,
+        dtype=jax.numpy.float32, attention_impl="reference",
+    )
+    model = TransformerLM(cfg)
+    tokens = jax.numpy.zeros((2, 32), jax.numpy.int32)
+    params = model.init(jax.random.key(0), tokens)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, 32, 128)
+    assert logits.dtype == jax.numpy.float32
+
+
+@pytest.mark.parametrize(
+    "mesh_config",
+    [
+        MeshConfig(data=8),
+        MeshConfig(fsdp=2, tensor=4),
+        MeshConfig(data=2, tensor=2, seq=2),
+    ],
+    ids=["dp8", "fsdp2-tp4", "dp2-tp2-sp2"],
+)
+def test_lm_trains_under_parallelism(tmp_path, mesh_config):
+    attention = "auto" if mesh_config.seq > 1 else "reference"
+    trainer = make_trainer(tmp_path, mesh_config, attention=attention)
+    reported = []
+    result = None
+    try:
+        ctx = trainer.context
+        orig = ctx.core.train.report_training_metrics
+        ctx.core.train.report_training_metrics = lambda s, m: (
+            reported.append((s, dict(m))),
+            orig(s, m),
+        )
+        result = trainer.fit(Length.batches(20), report_period=Length.batches(5))
+    finally:
+        ctx.core.train.report_training_metrics = orig
+    assert result["steps_completed"] == 20
+    first, last = reported[0][1]["loss"], reported[-1][1]["loss"]
+    assert last < first, (first, last)
+
+
+def test_tp_weights_actually_sharded(tmp_path):
+    trainer = make_trainer(tmp_path, MeshConfig(fsdp=2, tensor=4))
+    trainer._setup()
+    flat = jax.tree_util.tree_flatten_with_path(trainer.state.params)[0]
+    mlp_kernels = [
+        (str(path), leaf) for path, leaf in flat if "w_gate" in str(path)
+    ]
+    assert mlp_kernels
+    for path, leaf in mlp_kernels:
+        spec = leaf.sharding.spec
+        assert "tensor" in str(spec), f"{path} not tensor-sharded: {spec}"
+
+
+def test_gqa_and_remat_variants(tmp_path):
+    trainer = make_trainer(
+        tmp_path, MeshConfig(data=2), n_kv_heads=2, remat=True
+    )
+    result = trainer.fit(Length.batches(4), report_period=Length.batches(4))
+    assert result["steps_completed"] == 4
