@@ -1,5 +1,5 @@
-"""dtpu CLI package."""
+import sys
 
 from determined_tpu.cli.main import main
 
-__all__ = ["main"]
+sys.exit(main())

@@ -1,0 +1,280 @@
+"""dtpu CLI: the ``det`` command-line equivalent.
+
+Reference: ``harness/determined/cli/`` (declarative argparse per noun:
+experiment/trial/agent/checkpoint/master).  Talks to the master REST API
+via the same Session the harness uses; ``run-local`` drives the in-process
+LocalExperiment runner for masterless single-host searches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _session(args):
+    from determined_tpu.api.session import Session
+
+    url = args.master or os.environ.get("DTPU_MASTER", "http://127.0.0.1:8080")
+    return Session(url)
+
+
+def _print_json(obj: Any) -> None:
+    print(json.dumps(obj, indent=2, sort_keys=True, default=str))
+
+
+def _table(rows: List[Dict[str, Any]], cols: List[str]) -> None:
+    if not rows:
+        print("(none)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows)) for c in cols}
+    print("  ".join(c.upper().ljust(widths[c]) for c in cols))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
+
+
+# ---- experiment ------------------------------------------------------------
+
+
+def exp_create(args) -> int:
+    import yaml
+
+    with open(args.config) as f:
+        config = yaml.safe_load(f)
+    # config validation before submit (reference validates cluster-side too)
+    from determined_tpu.config.experiment import ExperimentConfig
+
+    ExperimentConfig.parse(dict(config))
+    resp = _session(args).post("/api/v1/experiments", json={"config": config})
+    exp_id = resp.json()["id"]
+    print(f"Created experiment {exp_id}")
+    if args.follow:
+        return exp_wait(args, exp_id)
+    return 0
+
+
+def exp_wait(args, exp_id: int) -> int:
+    s = _session(args)
+    last_state = None
+    while True:
+        exp = s.get(f"/api/v1/experiments/{exp_id}").json()
+        if exp["state"] != last_state:
+            print(f"state: {exp['state']} (progress {exp.get('progress', 0):.0%})")
+            last_state = exp["state"]
+        if exp["state"] in ("COMPLETED", "CANCELED", "ERROR"):
+            return 0 if exp["state"] == "COMPLETED" else 1
+        time.sleep(2)
+
+
+def exp_list(args) -> int:
+    exps = _session(args).get("/api/v1/experiments").json()
+    _table(
+        [
+            {
+                "id": e["id"],
+                "name": e.get("name", ""),
+                "state": e["state"],
+                "progress": f"{e.get('progress', 0):.0%}",
+                "trials": len(e.get("trials", [])),
+            }
+            for e in exps
+        ],
+        ["id", "name", "state", "progress", "trials"],
+    )
+    return 0
+
+
+def exp_describe(args) -> int:
+    _print_json(_session(args).get(f"/api/v1/experiments/{args.id}").json())
+    return 0
+
+
+def exp_signal(args) -> int:
+    resp = _session(args).post(f"/api/v1/experiments/{args.id}/{args.verb}")
+    print(f"experiment {args.id}: {resp.json()['state']}")
+    return 0
+
+
+# ---- trial -----------------------------------------------------------------
+
+
+def trial_describe(args) -> int:
+    _print_json(_session(args).get(f"/api/v1/trials/{args.id}").json())
+    return 0
+
+
+def trial_logs(args) -> int:
+    s = _session(args)
+    offset = 0
+    while True:
+        lines = s.get(f"/api/v1/trials/{args.id}/logs", params={"offset": offset}).json()
+        for line in lines:
+            print(line)
+        offset += len(lines)
+        if not args.follow:
+            return 0
+        trial = s.get(f"/api/v1/trials/{args.id}").json()
+        if trial["state"] not in ("PENDING", "RUNNING"):
+            return 0
+        time.sleep(1)
+
+
+def trial_metrics(args) -> int:
+    params = {"group": args.group} if args.group else None
+    _print_json(
+        _session(args).get(f"/api/v1/trials/{args.id}/metrics", params=params).json()
+    )
+    return 0
+
+
+# ---- agents / checkpoints / master ----------------------------------------
+
+
+def agent_list(args) -> int:
+    _table(
+        _session(args).get("/api/v1/agents").json(),
+        ["id", "host", "slots", "used_slots"],
+    )
+    return 0
+
+
+def checkpoint_list(args) -> int:
+    cps = _session(args).get("/api/v1/checkpoints").json()
+    _table(
+        [
+            {"uuid": c["uuid"], "trial_id": c.get("trial_id"),
+             "steps": (c.get("metadata") or {}).get("steps_completed")}
+            for c in cps
+        ],
+        ["uuid", "trial_id", "steps"],
+    )
+    return 0
+
+
+def master_info(args) -> int:
+    _print_json(_session(args).get("/api/v1/master").json())
+    return 0
+
+
+# ---- search preview + local run -------------------------------------------
+
+
+def preview_search(args) -> int:
+    import yaml
+
+    from determined_tpu.config.experiment import ExperimentConfig
+    from determined_tpu.searcher import simulate
+
+    with open(args.config) as f:
+        cfg = ExperimentConfig.parse(yaml.safe_load(f))
+
+    # synthetic smooth trial: improves with budget, hp-independent
+    out = simulate(cfg, lambda hp, step: 1.0 / (1 + step), seed=0)
+    smaller = cfg.searcher.smaller_is_better
+    print(f"searcher: {cfg.searcher.name} (metric {cfg.searcher.metric}, "
+          f"{'min' if smaller else 'max'})")
+    print(f"trials created:   {out['trials_created']}")
+    print(f"total units:      {out['total_units']}")
+    units = sorted(out["trial_units"].values())
+    print(f"units per trial:  min {units[0]}, median {units[len(units)//2]}, "
+          f"max {units[-1]}")
+    return 0
+
+
+def run_local(args) -> int:
+    import yaml
+
+    from determined_tpu.config.experiment import ExperimentConfig
+    from determined_tpu.experiment import LocalExperiment
+
+    with open(args.config) as f:
+        cfg = ExperimentConfig.parse(yaml.safe_load(f))
+    module_name, _, class_name = args.entrypoint.partition(":")
+    sys.path.insert(0, os.getcwd())
+    trial_cls = getattr(importlib.import_module(module_name), class_name)
+    exp = LocalExperiment(cfg, trial_cls, checkpoint_dir=args.checkpoint_dir)
+    summary = exp.run()
+    _print_json(summary)
+    return 0
+
+
+# ---- parser ----------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dtpu", description="determined-tpu CLI")
+    p.add_argument("-m", "--master", help="master url (default $DTPU_MASTER)")
+    sub = p.add_subparsers(dest="noun", required=True)
+
+    exp = sub.add_parser("experiment", aliases=["e"]).add_subparsers(
+        dest="verb", required=True
+    )
+    c = exp.add_parser("create")
+    c.add_argument("config")
+    c.add_argument("-f", "--follow", action="store_true")
+    c.set_defaults(fn=exp_create)
+    exp.add_parser("list").set_defaults(fn=exp_list)
+    d = exp.add_parser("describe")
+    d.add_argument("id", type=int)
+    d.set_defaults(fn=exp_describe)
+    for verb in ("pause", "activate", "cancel", "kill"):
+        v = exp.add_parser(verb)
+        v.add_argument("id", type=int)
+        v.set_defaults(fn=exp_signal, verb=verb)
+
+    trial = sub.add_parser("trial", aliases=["t"]).add_subparsers(
+        dest="verb", required=True
+    )
+    d = trial.add_parser("describe")
+    d.add_argument("id", type=int)
+    d.set_defaults(fn=trial_describe)
+    lg = trial.add_parser("logs")
+    lg.add_argument("id", type=int)
+    lg.add_argument("-f", "--follow", action="store_true")
+    lg.set_defaults(fn=trial_logs)
+    mt = trial.add_parser("metrics")
+    mt.add_argument("id", type=int)
+    mt.add_argument("--group")
+    mt.set_defaults(fn=trial_metrics)
+
+    agent = sub.add_parser("agent", aliases=["a"]).add_subparsers(
+        dest="verb", required=True
+    )
+    agent.add_parser("list").set_defaults(fn=agent_list)
+
+    ckpt = sub.add_parser("checkpoint", aliases=["c"]).add_subparsers(
+        dest="verb", required=True
+    )
+    ckpt.add_parser("list").set_defaults(fn=checkpoint_list)
+
+    master = sub.add_parser("master").add_subparsers(dest="verb", required=True)
+    master.add_parser("info").set_defaults(fn=master_info)
+
+    ps = sub.add_parser("preview-search")
+    ps.add_argument("config")
+    ps.set_defaults(fn=preview_search)
+
+    rl = sub.add_parser("run-local")
+    rl.add_argument("config")
+    rl.add_argument("entrypoint", help="pkg.module:TrialClass")
+    rl.add_argument("--checkpoint-dir", default=None)
+    rl.set_defaults(fn=run_local)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

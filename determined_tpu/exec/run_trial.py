@@ -1,0 +1,109 @@
+"""Trial process entrypoint: what the agent execs for each allocation group.
+
+Reference: the container chain ``entrypoint.sh -> exec.prep_container ->
+exec.launch -> launch/torch_distributed.py -> exec.harness``
+(``master/static/srv/entrypoint.sh``, ``harness/determined/exec/``).  On a
+TPU VM there is no container/launcher sandwich: the agent execs THIS module
+directly; it applies the experiment's env, joins the jax.distributed
+rendezvous when the allocation spans hosts, builds the Trial from the
+``package.module:ClassName`` entrypoint, and drives ``Trainer.fit``.
+
+Usage:  python -m determined_tpu.exec.run_trial "pkg.module:TrialClass"
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import os
+import sys
+
+
+def _apply_environment_early() -> None:
+    """Env vars from exp config must land BEFORE jax is imported
+    (XLA_FLAGS, JAX_PLATFORMS and friends are read at import time)."""
+    raw = os.environ.get("DTPU_EXP_CONFIG")
+    if not raw:
+        return
+    try:
+        env = (json.loads(raw).get("environment") or {}).get("env") or {}
+    except Exception:
+        return
+    for k, v in env.items():
+        os.environ.setdefault(str(k), str(v))
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+    )
+    logger = logging.getLogger("determined_tpu.exec")
+    if len(sys.argv) < 2 or ":" not in sys.argv[1]:
+        print("usage: python -m determined_tpu.exec.run_trial pkg.module:TrialClass")
+        return 2
+
+    _apply_environment_early()
+
+    import jax
+
+    # some TPU PJRT plugins ignore the JAX_PLATFORMS env var; the config
+    # flag always wins (same workaround as tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    # join the multi-host rendezvous before touching devices
+    rdzv = os.environ.get("DTPU_RENDEZVOUS")
+    if rdzv:
+        info = json.loads(rdzv)
+        if int(info.get("num_nodes", 1)) > 1:
+            jax.distributed.initialize(
+                coordinator_address=info["coordinator"],
+                num_processes=int(info["num_nodes"]),
+                process_id=int(info["node_rank"]),
+            )
+
+    from determined_tpu import core, train
+    from determined_tpu.config.experiment import ExperimentConfig
+    from determined_tpu.core._cluster_info import get_cluster_info
+
+    cluster = get_cluster_info()
+    if cluster is None:
+        print("run_trial requires DTPU_* env (set by the agent)")
+        return 2
+
+    exp_config = ExperimentConfig.parse(cluster.exp_config or {})
+    module_name, _, class_name = sys.argv[1].partition(":")
+    sys.path.insert(0, os.getcwd())
+    trial_cls = getattr(importlib.import_module(module_name), class_name)
+
+    core_ctx = core.init()
+    try:
+        ctx = train.init(
+            hparams=cluster.hparams,
+            exp_config=exp_config,
+            core_context=core_ctx,
+            seed=cluster.trial_seed,
+        )
+        trainer = train.Trainer(trial_cls(ctx))
+        scfg = exp_config.searcher
+        max_length = scfg.max_length or exp_config.min_validation_period
+        if max_length is None:
+            from determined_tpu.config.experiment import Length
+
+            max_length = Length.batches(scfg.max_time or 100)
+        summary = trainer.fit(
+            max_length,
+            validation_period=exp_config.min_validation_period,
+            checkpoint_period=exp_config.min_checkpoint_period,
+            latest_checkpoint=cluster.latest_checkpoint,
+            checkpoint_policy=exp_config.checkpoint_policy,
+        )
+        logger.info("trial finished: %s", summary)
+        return 0
+    finally:
+        core_ctx.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""Experiment orchestration: local searcher-driven runner."""
+
+from determined_tpu.experiment.local import LocalExperiment, TrialResult, run_experiment
+
+__all__ = ["LocalExperiment", "TrialResult", "run_experiment"]

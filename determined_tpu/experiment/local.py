@@ -1,0 +1,192 @@
+"""Local experiment runner: searcher-driven multi-trial training on one host.
+
+The reference can only run searches through the master
+(``master/internal/experiment.go`` drives ``searcher``); off-cluster users
+get single trials.  On a TPU VM the single-host case is common enough that
+the search loop itself is part of the harness: this runner drives the SAME
+``Searcher``/``SearchMethod`` machinery the master uses, executing trials
+sequentially (or a caller-supplied executor) with checkpoint/metrics flowing
+through the normal Core API dummy contexts.
+
+It is also the reference implementation the C++ master's experiment engine
+mirrors (same event order: create -> validations -> stop/exit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Callable, Dict, List, Optional, Type
+
+from determined_tpu import core
+from determined_tpu.config.experiment import ExperimentConfig, Length
+from determined_tpu.searcher import (
+    Create,
+    Searcher,
+    Stop,
+    method_from_config,
+)
+from determined_tpu.train import Trainer, TrialContext
+from determined_tpu.train._trial import JaxTrial
+
+logger = logging.getLogger("determined_tpu.experiment")
+
+
+@dataclasses.dataclass
+class TrialResult:
+    request_id: int
+    hparams: Dict[str, Any]
+    steps_completed: int
+    metrics: Dict[str, float]
+    checkpoint: Optional[str]
+    stopped_early: bool
+
+
+class LocalExperiment:
+    """Runs an ExperimentConfig's full search against a JaxTrial class."""
+
+    def __init__(
+        self,
+        config: ExperimentConfig,
+        trial_cls: Type[JaxTrial],
+        *,
+        checkpoint_dir: Optional[str] = None,
+        seed: Optional[int] = None,
+    ) -> None:
+        self.config = config
+        self.trial_cls = trial_cls
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            os.getcwd(), "local_experiment_checkpoints"
+        )
+        self.seed = seed if seed is not None else config.reproducibility.experiment_seed
+        self.searcher = Searcher(
+            method_from_config(config.searcher, config.hyperparameters),
+            config.hyperparameters,
+            seed=self.seed,
+        )
+        self.results: Dict[int, TrialResult] = {}
+
+    # -- single-trial execution -------------------------------------------
+
+    def _run_trial(self, create: Create) -> TrialResult:
+        """Train one trial; report validations into the searcher as they
+        happen so ASHA can stop it between validation boundaries."""
+        from determined_tpu import train as train_mod
+
+        cfg = self.config
+        scfg = cfg.searcher
+        max_length = scfg.max_length or Length.batches(scfg.max_time or 100)
+        core_ctx = core._dummy_init(checkpoint_dir=self.checkpoint_dir)
+        ctx = train_mod.init(
+            hparams=create.hparams,
+            mesh_config=cfg.resources.mesh,
+            core_context=core_ctx,
+            exp_config=cfg,
+            seed=self.seed + create.request_id,
+        )
+        trial = self.trial_cls(ctx)
+        trainer = Trainer(trial)
+
+        rid = create.request_id
+        searcher = self.searcher
+        runner = self
+
+        orig_report = core_ctx.train.report_validation_metrics
+
+        def report_validation(steps_completed: int, metrics: Dict[str, Any]) -> None:
+            orig_report(steps_completed, metrics)
+            payload = dict(metrics)
+            payload.setdefault(scfg.time_metric or "batches", steps_completed)
+            searcher.on_validation(rid, payload)
+            rec = searcher.trials.get(rid)
+            if rec is not None and rec.stopped_by_searcher:
+                # cooperative stop through the preemption path: the trainer
+                # checkpoints and exits at the next boundary
+                core_ctx.preempt.simulate()
+            searcher.set_trial_progress(
+                rid, min(steps_completed / runner._max_steps(trainer, max_length), 1.0)
+            )
+
+        core_ctx.train.report_validation_metrics = report_validation
+
+        validation_period = cfg.min_validation_period or Length.batches(
+            max(1, (max_length.units if max_length.unit == "batches" else 100) // 4)
+        )
+        summary = trainer.fit(
+            max_length,
+            validation_period=validation_period,
+            checkpoint_period=cfg.min_checkpoint_period,
+            report_period=validation_period,
+            checkpoint_policy=cfg.checkpoint_policy,
+        )
+        return TrialResult(
+            request_id=rid,
+            hparams=create.hparams,
+            steps_completed=summary["steps_completed"],
+            metrics=summary["validation_metrics"],
+            checkpoint=summary["latest_checkpoint"],
+            stopped_early=summary["stopped_early"],
+        )
+
+    def _max_steps(self, trainer: Trainer, max_length: Length) -> int:
+        try:
+            return trainer._to_batches(max_length) or 1
+        except Exception:
+            return max(max_length.units, 1)
+
+    # -- the search loop ---------------------------------------------------
+
+    def run(self, max_trials: Optional[int] = None) -> Dict[str, Any]:
+        """Run the search to completion (sequential execution)."""
+        self.searcher.start()
+        executed = 0
+        while self.searcher.shutdown is None:
+            pending = [
+                t
+                for t in self.searcher.trials.values()
+                if t.running and t.request_id not in self.results
+            ]
+            if not pending:
+                break
+            rec = pending[0]
+            if max_trials is not None and executed >= max_trials:
+                break
+            logger.info(
+                "trial %d starting with hparams %s", rec.request_id, rec.hparams
+            )
+            result = self._run_trial(Create(rec.request_id, rec.hparams))
+            self.results[rec.request_id] = result
+            executed += 1
+            self.searcher.on_trial_exited(rec.request_id)
+        return self.summary()
+
+    def summary(self) -> Dict[str, Any]:
+        scfg = self.config.searcher
+        best: Optional[TrialResult] = None
+        for r in self.results.values():
+            val = (r.metrics or {}).get(scfg.metric)
+            if val is None:
+                continue
+            if best is None:
+                best = r
+                continue
+            bval = best.metrics.get(scfg.metric)
+            if (val < bval) == scfg.smaller_is_better:
+                best = r
+        return {
+            "trials": len(self.results),
+            "best_trial": best.request_id if best else None,
+            "best_hparams": best.hparams if best else None,
+            "best_metrics": best.metrics if best else None,
+            "total_steps": sum(r.steps_completed for r in self.results.values()),
+            "progress": self.searcher.progress(),
+        }
+
+
+def run_experiment(
+    config: ExperimentConfig,
+    trial_cls: Type[JaxTrial],
+    **kwargs: Any,
+) -> Dict[str, Any]:
+    return LocalExperiment(config, trial_cls, **kwargs).run()

@@ -152,7 +152,12 @@ class Searcher:
                 "trials": {
                     str(rid): dataclasses.asdict(t) for rid, t in self.trials.items()
                 },
-                "shutdown": self.shutdown is not None,
+                "trial_progress": {str(k): v for k, v in self._trial_progress.items()},
+                "shutdown": (
+                    None
+                    if self.shutdown is None
+                    else {"cancel": self.shutdown.cancel, "failure": self.shutdown.failure}
+                ),
             }
         )
 
@@ -164,8 +169,12 @@ class Searcher:
         self.trials = {
             int(rid): TrialRecord(**t) for rid, t in state["trials"].items()
         }
-        if state["shutdown"]:
-            self.shutdown = Shutdown()
+        self._trial_progress = {
+            int(k): v for k, v in state.get("trial_progress", {}).items()
+        }
+        sd = state["shutdown"]
+        if sd:
+            self.shutdown = Shutdown(**sd) if isinstance(sd, dict) else Shutdown()
 
 
 def simulate(

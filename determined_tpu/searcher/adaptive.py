@@ -38,6 +38,15 @@ def bracket_max_trials(max_trials: int, divisor: float, brackets: List[int]) -> 
     total = sum(weights)
     out = [max(int(w / total * max_trials), 1) for w in weights]
     out[0] += max(max_trials - sum(out), 0)
+    # the per-bracket minimum of 1 can overshoot when max_trials < #brackets:
+    # trim from the least-aggressive (last) brackets down to the cap
+    excess = sum(out) - max_trials
+    for i in range(len(out) - 1, 0, -1):
+        if excess <= 0:
+            break
+        take = min(excess, out[i])
+        out[i] -= take
+        excess -= take
     return out
 
 
@@ -168,5 +177,6 @@ def make_adaptive_asha(
             max_concurrent_trials=nc,
         )
         for nr, nt, nc in zip(bracket_rungs, trials, concurrent)
+        if nt > 0  # brackets trimmed to honor a small max_trials cap
     ]
     return TournamentSearch(subs)

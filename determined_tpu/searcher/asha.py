@@ -72,6 +72,7 @@ class ASHASearch(SearchMethod):
         self.rungs = make_rungs(num_rungs, divisor, max_time)
         self.trial_rungs: Dict[RequestID, int] = {}
         self.early_exit_trials: Dict[RequestID, bool] = {}
+        self.stopped_trials: set = set()
         self.trials_completed = 0
         self.invalid_trials = 0
 
@@ -107,8 +108,15 @@ class ASHASearch(SearchMethod):
         return int(step), float(value)
 
     def validation_completed(self, ctx, request_id, metrics) -> List[Action]:
+        if request_id in self.stopped_trials:
+            # a stopped trial may report one or two more validations before
+            # teardown; re-inserting would duplicate rung entries and burn
+            # the trial budget on spurious replacement creates
+            return []
         time_step, value = self._get_metric(metrics)
         actions = self._do_early_stopping(request_id, time_step, value)
+        if any(isinstance(a, Stop) for a in actions):
+            self.stopped_trials.add(request_id)
         all_trials = len(self.trial_rungs) - self.invalid_trials
         if actions and all_trials < self.max_trials:
             actions.append(ctx.create())
@@ -170,6 +178,7 @@ class ASHASearch(SearchMethod):
             "early_exit_trials": dict(self.early_exit_trials),
             "trials_completed": self.trials_completed,
             "invalid_trials": self.invalid_trials,
+            "stopped_trials": sorted(self.stopped_trials),
         }
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
@@ -184,3 +193,4 @@ class ASHASearch(SearchMethod):
         }
         self.trials_completed = state["trials_completed"]
         self.invalid_trials = state["invalid_trials"]
+        self.stopped_trials = set(state.get("stopped_trials", []))

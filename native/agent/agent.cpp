@@ -1,0 +1,212 @@
+// dtpu-agent: per-host daemon that runs trial processes.
+//
+// Native equivalent of the reference's Go agent (agent/internal/agent.go):
+// registers its slots with the master, long-polls for work, launches trial
+// processes with the platform env, ships their stdout/stderr to the master
+// task-log API, and reports exits.  Differences from the reference are
+// deliberate TPU redesigns:
+//   - slots are TPU chips (or artificial slots via --slots for tests /
+//     CPU hosts), not nvidia-smi GPUs;
+//   - transport is HTTP long-poll against the master REST API instead of a
+//     bespoke websocket protocol (one port, one protocol end to end);
+//   - processes are plain fork/exec of the harness (TPU VMs run training
+//     directly on the host), not Docker containers.
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../common/http.hpp"
+#include "../common/json.hpp"
+
+namespace dtpu {
+
+struct Options {
+  std::string master_host = "127.0.0.1";
+  int master_port = 8080;
+  std::string id = "agent-1";
+  std::string advertised_host = "127.0.0.1";
+  int slots = 1;
+  std::string python = "python";
+};
+
+class Agent {
+ public:
+  explicit Agent(Options opts) : opts_(std::move(opts)) {}
+
+  int run() {
+    if (!register_agent()) {
+      fprintf(stderr, "agent %s: cannot reach master\n", opts_.id.c_str());
+      return 1;
+    }
+    printf("dtpu-agent %s registered (%d slots)\n", opts_.id.c_str(), opts_.slots);
+    fflush(stdout);
+    while (true) {
+      auto resp = http_request(opts_.master_host, opts_.master_port, "GET",
+                               "/api/v1/agents/" + opts_.id + "/work?timeout_seconds=30",
+                               "", 45);
+      if (!resp.ok()) {
+        // master gone or restarting: re-register with backoff
+        std::this_thread::sleep_for(std::chrono::seconds(2));
+        register_agent();
+        continue;
+      }
+      Json work;
+      if (!Json::try_parse(resp.body, &work) || !work.is_array()) continue;
+      for (const auto& item : work.elements()) {
+        const std::string& type = item["type"].as_string();
+        if (type == "launch") {
+          launch(item);
+        } else if (type == "kill") {
+          kill_allocation(item["allocation_id"].as_string());
+        }
+      }
+    }
+  }
+
+ private:
+  bool register_agent() {
+    Json body = Json::object();
+    body.set("id", opts_.id);
+    body.set("host", opts_.advertised_host);
+    body.set("slots", Json(opts_.slots));
+    auto resp = http_request(opts_.master_host, opts_.master_port, "POST",
+                             "/api/v1/agents", body.dump(), 10);
+    return resp.ok();
+  }
+
+  void launch(const Json& work) {
+    int64_t trial_id = work["trial_id"].as_int();
+    const std::string alloc_id = work["allocation_id"].as_string();
+    int out_pipe[2];
+    if (pipe(out_pipe) != 0) return;
+
+    pid_t pid = fork();
+    if (pid == 0) {
+      // child: own process group so kill() reaches workers too
+      setpgid(0, 0);
+      dup2(out_pipe[1], STDOUT_FILENO);
+      dup2(out_pipe[1], STDERR_FILENO);
+      close(out_pipe[0]);
+      close(out_pipe[1]);
+      // platform env
+      setenv("DTPU_MASTER_URL",
+             ("http://" + opts_.master_host + ":" + std::to_string(opts_.master_port)).c_str(), 1);
+      setenv("DTPU_AGENT_ID", opts_.id.c_str(), 1);
+      for (const auto& [k, v] : work["env"].items()) {
+        setenv(k.c_str(), v.as_string().c_str(), 1);
+      }
+      std::string entry = work["entrypoint"].as_string();
+      execlp(opts_.python.c_str(), opts_.python.c_str(), "-m",
+             "determined_tpu.exec.run_trial", entry.c_str(), (char*)nullptr);
+      _exit(127);
+    }
+    close(out_pipe[1]);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      running_[alloc_id] = pid;
+    }
+    // reader thread: ship logs, then wait + report exit
+    std::thread([this, pid, trial_id, alloc_id, fd = out_pipe[0]] {
+      ship_logs_and_wait(fd, pid, trial_id, alloc_id);
+    }).detach();
+  }
+
+  void ship_logs_and_wait(int fd, pid_t pid, int64_t trial_id,
+                          const std::string& alloc_id) {
+    std::string partial;
+    std::vector<std::string> batch;
+    char buf[8192];
+    auto flush = [&]() {
+      if (batch.empty()) return;
+      Json body = Json::object();
+      body.set("trial_id", Json(trial_id));
+      Json lines = Json::array();
+      for (auto& l : batch) lines.push_back(l);
+      body.set("lines", lines);
+      http_request(opts_.master_host, opts_.master_port, "POST", "/api/v1/logs",
+                   body.dump(), 10);
+      batch.clear();
+    };
+    ssize_t n;
+    while ((n = read(fd, buf, sizeof(buf))) > 0) {
+      partial.append(buf, static_cast<size_t>(n));
+      size_t pos;
+      while ((pos = partial.find('\n')) != std::string::npos) {
+        batch.push_back(partial.substr(0, pos));
+        partial.erase(0, pos + 1);
+        if (batch.size() >= 64) flush();
+      }
+      flush();
+    }
+    if (!partial.empty()) batch.push_back(partial);
+    flush();
+    close(fd);
+
+    int status = 0;
+    waitpid(pid, &status, 0);
+    int exit_code = WIFEXITED(status) ? WEXITSTATUS(status)
+                                      : 128 + (WIFSIGNALED(status) ? WTERMSIG(status) : 1);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      running_.erase(alloc_id);
+    }
+    Json body = Json::object();
+    body.set("exit_code", Json(exit_code));
+    body.set("allocation_id", alloc_id);
+    http_request(opts_.master_host, opts_.master_port, "POST",
+                 "/api/v1/trials/" + std::to_string(trial_id) + "/exit", body.dump(), 10);
+  }
+
+  void kill_allocation(const std::string& alloc_id) {
+    pid_t pid = 0;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = running_.find(alloc_id);
+      if (it == running_.end()) return;
+      pid = it->second;
+    }
+    // graceful SIGTERM (harness checkpoints on it), SIGKILL after grace
+    ::kill(-pid, SIGTERM);
+    std::thread([pid] {
+      std::this_thread::sleep_for(std::chrono::seconds(15));
+      ::kill(-pid, SIGKILL);
+    }).detach();
+  }
+
+  Options opts_;
+  std::mutex mu_;
+  std::map<std::string, pid_t> running_;
+};
+
+}  // namespace dtpu
+
+int main(int argc, char** argv) {
+  signal(SIGPIPE, SIG_IGN);
+  dtpu::Options opts;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto next = [&](const char* name) -> std::string {
+      if (i + 1 >= argc) { fprintf(stderr, "missing value for %s\n", name); exit(2); }
+      return argv[++i];
+    };
+    if (arg == "--master-host") opts.master_host = next("--master-host");
+    else if (arg == "--master-port") opts.master_port = std::atoi(next("--master-port").c_str());
+    else if (arg == "--id") opts.id = next("--id");
+    else if (arg == "--host") opts.advertised_host = next("--host");
+    else if (arg == "--slots") opts.slots = std::atoi(next("--slots").c_str());
+    else if (arg == "--python") opts.python = next("--python");
+    else { fprintf(stderr, "unknown arg %s\n", arg.c_str()); return 2; }
+  }
+  return dtpu::Agent(opts).run();
+}

@@ -1,0 +1,332 @@
+// Minimal HTTP/1.1 server + client for the dtpu master and agent.
+//
+// Reference: the Go master serves REST+gRPC via cmux/echo
+// (master/internal/core.go:694-799).  This build needs exactly the subset a
+// control plane uses: keep-it-simple thread-per-connection server with
+// keep-alive, path routing with {param} captures, query strings, JSON
+// bodies, and long-poll friendly handlers (handlers may block).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace dtpu {
+
+struct HttpRequest {
+  std::string method;
+  std::string path;                          // without query string
+  std::map<std::string, std::string> query;  // decoded query params
+  std::map<std::string, std::string> headers;
+  std::map<std::string, std::string> params;  // {captures} from route
+  std::string body;
+};
+
+struct HttpResponse {
+  int status = 200;
+  std::string content_type = "application/json";
+  std::string body;
+
+  static HttpResponse json(const std::string& body, int status = 200) {
+    HttpResponse r;
+    r.status = status;
+    r.body = body;
+    return r;
+  }
+  static HttpResponse error(int status, const std::string& msg) {
+    HttpResponse r;
+    r.status = status;
+    r.body = "{\"error\":\"" + msg + "\"}";
+    return r;
+  }
+};
+
+using Handler = std::function<HttpResponse(const HttpRequest&)>;
+
+inline std::string url_decode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      out += static_cast<char>(std::stoi(s.substr(i + 1, 2), nullptr, 16));
+      i += 2;
+    } else if (s[i] == '+') {
+      out += ' ';
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+class HttpServer {
+ public:
+  HttpServer() = default;
+  ~HttpServer() { stop(); }
+
+  // route pattern: "/api/v1/experiments/{id}/kill"
+  void route(const std::string& method, const std::string& pattern, Handler h) {
+    routes_.push_back({method, split_path(pattern), std::move(h)});
+  }
+
+  // returns the bound port (pass port=0 for ephemeral)
+  int listen(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int opt = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &opt, sizeof(opt));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+    if (bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) return -1;
+    if (::listen(fd_, 128) != 0) return -1;
+    socklen_t len = sizeof(addr);
+    getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    running_ = true;
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    return port_;
+  }
+
+  void stop() {
+    if (!running_.exchange(false)) return;
+    ::shutdown(fd_, SHUT_RDWR);
+    ::close(fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    // connection threads are detached and exit on socket close/error
+  }
+
+  int port() const { return port_; }
+
+ private:
+  struct Route {
+    std::string method;
+    std::vector<std::string> parts;
+    Handler handler;
+  };
+
+  static std::vector<std::string> split_path(const std::string& p) {
+    std::vector<std::string> out;
+    std::stringstream ss(p);
+    std::string part;
+    while (std::getline(ss, part, '/')) {
+      if (!part.empty()) out.push_back(part);
+    }
+    return out;
+  }
+
+  void accept_loop() {
+    while (running_) {
+      int client = ::accept(fd_, nullptr, nullptr);
+      if (client < 0) {
+        if (!running_) break;
+        continue;
+      }
+      std::thread([this, client] { serve_connection(client); }).detach();
+    }
+  }
+
+  void serve_connection(int client) {
+    int opt = 1;
+    setsockopt(client, IPPROTO_TCP, TCP_NODELAY, &opt, sizeof(opt));
+    std::string buffer;
+    while (running_) {
+      HttpRequest req;
+      if (!read_request(client, buffer, &req)) break;
+      HttpResponse resp;
+      try {
+        resp = dispatch(req);
+      } catch (const std::exception& e) {
+        resp = HttpResponse::error(500, e.what());
+      }
+      if (!write_response(client, resp)) break;
+      auto conn = req.headers.find("connection");
+      if (conn != req.headers.end() && conn->second == "close") break;
+    }
+    ::close(client);
+  }
+
+  bool read_request(int client, std::string& buffer, HttpRequest* req) {
+    // read until header terminator
+    size_t header_end;
+    while ((header_end = buffer.find("\r\n\r\n")) == std::string::npos) {
+      char chunk[8192];
+      ssize_t n = ::recv(client, chunk, sizeof(chunk), 0);
+      if (n <= 0) return false;
+      buffer.append(chunk, static_cast<size_t>(n));
+      if (buffer.size() > (16u << 20)) return false;  // 16MB header+body cap
+    }
+    std::string head = buffer.substr(0, header_end);
+    std::istringstream hs(head);
+    std::string line;
+    std::getline(hs, line);
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    {
+      std::istringstream rl(line);
+      std::string target, version;
+      rl >> req->method >> target >> version;
+      auto qpos = target.find('?');
+      req->path = qpos == std::string::npos ? target : target.substr(0, qpos);
+      if (qpos != std::string::npos) {
+        std::stringstream qs(target.substr(qpos + 1));
+        std::string pair;
+        while (std::getline(qs, pair, '&')) {
+          auto eq = pair.find('=');
+          if (eq == std::string::npos) {
+            req->query[url_decode(pair)] = "";
+          } else {
+            req->query[url_decode(pair.substr(0, eq))] = url_decode(pair.substr(eq + 1));
+          }
+        }
+      }
+    }
+    while (std::getline(hs, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      auto colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = line.substr(0, colon);
+      for (auto& c : key) c = static_cast<char>(tolower(c));
+      std::string val = line.substr(colon + 1);
+      while (!val.empty() && val.front() == ' ') val.erase(val.begin());
+      req->headers[key] = val;
+    }
+    size_t body_len = 0;
+    auto cl = req->headers.find("content-length");
+    if (cl != req->headers.end()) body_len = std::stoul(cl->second);
+    size_t total = header_end + 4 + body_len;
+    while (buffer.size() < total) {
+      char chunk[16384];
+      ssize_t n = ::recv(client, chunk, sizeof(chunk), 0);
+      if (n <= 0) return false;
+      buffer.append(chunk, static_cast<size_t>(n));
+    }
+    req->body = buffer.substr(header_end + 4, body_len);
+    buffer.erase(0, total);
+    return true;
+  }
+
+  bool write_response(int client, const HttpResponse& resp) {
+    std::ostringstream out;
+    out << "HTTP/1.1 " << resp.status << " " << reason(resp.status) << "\r\n"
+        << "Content-Type: " << resp.content_type << "\r\n"
+        << "Content-Length: " << resp.body.size() << "\r\n"
+        << "Connection: keep-alive\r\n\r\n"
+        << resp.body;
+    std::string data = out.str();
+    size_t sent = 0;
+    while (sent < data.size()) {
+      ssize_t n = ::send(client, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
+      if (n <= 0) return false;
+      sent += static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  static const char* reason(int status) {
+    switch (status) {
+      case 200: return "OK";
+      case 201: return "Created";
+      case 204: return "No Content";
+      case 400: return "Bad Request";
+      case 401: return "Unauthorized";
+      case 404: return "Not Found";
+      case 409: return "Conflict";
+      default: return status >= 500 ? "Internal Server Error" : "Unknown";
+    }
+  }
+
+  HttpResponse dispatch(const HttpRequest& req) {
+    auto parts = split_path(req.path);
+    for (const auto& r : routes_) {
+      if (r.method != req.method || r.parts.size() != parts.size()) continue;
+      std::map<std::string, std::string> params;
+      bool match = true;
+      for (size_t i = 0; i < parts.size(); ++i) {
+        const std::string& pat = r.parts[i];
+        if (pat.size() > 2 && pat.front() == '{' && pat.back() == '}') {
+          params[pat.substr(1, pat.size() - 2)] = parts[i];
+        } else if (pat != parts[i]) {
+          match = false;
+          break;
+        }
+      }
+      if (match) {
+        HttpRequest req_copy = req;
+        req_copy.params = std::move(params);
+        return r.handler(req_copy);
+      }
+    }
+    return HttpResponse::error(404, "not found: " + req.method + " " + req.path);
+  }
+
+  int fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::vector<Route> routes_;
+};
+
+// ---- tiny blocking client (used by the agent) ------------------------------
+
+struct ClientResponse {
+  int status = 0;
+  std::string body;
+  bool ok() const { return status >= 200 && status < 300; }
+};
+
+inline ClientResponse http_request(const std::string& host, int port,
+                                   const std::string& method, const std::string& target,
+                                   const std::string& body = "",
+                                   int timeout_sec = 75) {
+  ClientResponse out;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return out;
+  timeval tv{timeout_sec, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return out;
+  }
+  std::ostringstream req;
+  req << method << " " << target << " HTTP/1.1\r\n"
+      << "Host: " << host << "\r\n"
+      << "Content-Type: application/json\r\n"
+      << "Content-Length: " << body.size() << "\r\n"
+      << "Connection: close\r\n\r\n"
+      << body;
+  std::string data = req.str();
+  size_t sent = 0;
+  while (sent < data.size()) {
+    ssize_t n = ::send(fd, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
+    if (n <= 0) { ::close(fd); return out; }
+    sent += static_cast<size_t>(n);
+  }
+  std::string resp;
+  char chunk[16384];
+  ssize_t n;
+  while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0) resp.append(chunk, static_cast<size_t>(n));
+  ::close(fd);
+  auto sp = resp.find(' ');
+  if (sp == std::string::npos) return out;
+  out.status = std::atoi(resp.c_str() + sp + 1);
+  auto he = resp.find("\r\n\r\n");
+  if (he != std::string::npos) out.body = resp.substr(he + 4);
+  return out;
+}
+
+}  // namespace dtpu

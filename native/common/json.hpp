@@ -1,0 +1,302 @@
+// Minimal JSON value/parser/serializer for the dtpu master + agent.
+//
+// The reference master (Go) gets JSON from encoding/json; this build has no
+// third-party C++ deps baked in, so the master carries its own ~300-line
+// implementation.  Supports the full JSON grammar; numbers are doubles
+// (ints round-trip losslessly to 2^53, far beyond any id this system mints).
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace dtpu {
+
+class Json;
+using JsonArray = std::vector<Json>;
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Number), num_(v) {}
+  Json(long v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Json(long long v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Json(double v) : type_(Type::Number), num_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  static Json array() { return Json(JsonArray{}); }
+  static Json object() { return Json(JsonObject{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_bool() const { return type_ == Type::Bool; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_object() const { return type_ == Type::Object; }
+
+  bool as_bool(bool dflt = false) const { return is_bool() ? bool_ : dflt; }
+  double as_double(double dflt = 0) const { return is_number() ? num_ : dflt; }
+  int64_t as_int(int64_t dflt = 0) const {
+    return is_number() ? static_cast<int64_t>(num_) : dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return is_string() ? str_ : empty;
+  }
+
+  // object access
+  const Json& operator[](const std::string& key) const {
+    static const Json null_json;
+    if (!is_object()) return null_json;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_json : it->second;
+  }
+  Json& set(const std::string& key, Json v) {
+    if (!is_object()) { type_ = Type::Object; obj_.clear(); }
+    obj_[key] = std::move(v);
+    return *this;
+  }
+  bool contains(const std::string& key) const {
+    return is_object() && obj_.count(key) > 0;
+  }
+  const JsonObject& items() const { static const JsonObject e; return is_object() ? obj_ : e; }
+
+  // array access
+  const JsonArray& elements() const { static const JsonArray e; return is_array() ? arr_ : e; }
+  void push_back(Json v) {
+    if (!is_array()) { type_ = Type::Array; arr_.clear(); }
+    arr_.push_back(std::move(v));
+  }
+  size_t size() const {
+    if (is_array()) return arr_.size();
+    if (is_object()) return obj_.size();
+    return 0;
+  }
+
+  // ---- serialize ----
+  std::string dump() const {
+    std::ostringstream out;
+    write(out);
+    return out.str();
+  }
+
+  // ---- parse ----
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("trailing JSON content");
+    return v;
+  }
+  static bool try_parse(const std::string& text, Json* out) {
+    try { *out = parse(text); return true; } catch (...) { return false; }
+  }
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+
+  void write(std::ostringstream& out) const {
+    switch (type_) {
+      case Type::Null: out << "null"; break;
+      case Type::Bool: out << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::fabs(num_) < 9.007199254740992e15) {
+          out << static_cast<int64_t>(num_);
+        } else if (std::isfinite(num_)) {
+          std::ostringstream tmp;
+          tmp.precision(17);
+          tmp << num_;
+          out << tmp.str();
+        } else {
+          out << "null";  // JSON has no inf/nan
+        }
+        break;
+      }
+      case Type::String: write_string(out, str_); break;
+      case Type::Array: {
+        out << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) out << ',';
+          arr_[i].write(out);
+        }
+        out << ']';
+        break;
+      }
+      case Type::Object: {
+        out << '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) out << ',';
+          first = false;
+          write_string(out, k);
+          out << ':';
+          v.write(out);
+        }
+        out << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& out, const std::string& s) {
+    out << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out << "\\\""; break;
+        case '\\': out << "\\\\"; break;
+        case '\n': out << "\\n"; break;
+        case '\r': out << "\\r"; break;
+        case '\t': out << "\\t"; break;
+        case '\b': out << "\\b"; break;
+        case '\f': out << "\\f"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out << buf;
+          } else {
+            out << c;
+          }
+      }
+    }
+    out << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& p) {
+    while (p < t.size() && (t[p] == ' ' || t[p] == '\t' || t[p] == '\n' || t[p] == '\r')) ++p;
+  }
+
+  static Json parse_value(const std::string& t, size_t& p) {
+    skip_ws(t, p);
+    if (p >= t.size()) throw std::runtime_error("unexpected end of JSON");
+    char c = t[p];
+    if (c == '{') return parse_object(t, p);
+    if (c == '[') return parse_array(t, p);
+    if (c == '"') return Json(parse_string(t, p));
+    if (c == 't') { expect(t, p, "true"); return Json(true); }
+    if (c == 'f') { expect(t, p, "false"); return Json(false); }
+    if (c == 'n') { expect(t, p, "null"); return Json(); }
+    return parse_number(t, p);
+  }
+
+  static void expect(const std::string& t, size_t& p, const char* word) {
+    size_t n = strlen(word);
+    if (t.compare(p, n, word) != 0) throw std::runtime_error("bad JSON literal");
+    p += n;
+  }
+
+  static Json parse_number(const std::string& t, size_t& p) {
+    size_t start = p;
+    if (p < t.size() && (t[p] == '-' || t[p] == '+')) ++p;
+    while (p < t.size() && (isdigit(t[p]) || t[p] == '.' || t[p] == 'e' || t[p] == 'E' ||
+                            t[p] == '-' || t[p] == '+')) ++p;
+    if (p == start) throw std::runtime_error("bad JSON number");
+    return Json(std::stod(t.substr(start, p - start)));
+  }
+
+  static std::string parse_string(const std::string& t, size_t& p) {
+    if (t[p] != '"') throw std::runtime_error("expected string");
+    ++p;
+    std::string out;
+    while (p < t.size() && t[p] != '"') {
+      char c = t[p];
+      if (c == '\\') {
+        ++p;
+        if (p >= t.size()) throw std::runtime_error("bad escape");
+        char e = t[p];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            if (p + 4 >= t.size()) throw std::runtime_error("bad \\u escape");
+            unsigned code = std::stoul(t.substr(p + 1, 4), nullptr, 16);
+            p += 4;
+            // encode UTF-8 (surrogate pairs: keep simple, encode BMP only)
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("bad escape char");
+        }
+        ++p;
+      } else {
+        out += c;
+        ++p;
+      }
+    }
+    if (p >= t.size()) throw std::runtime_error("unterminated string");
+    ++p;  // closing quote
+    return out;
+  }
+
+  static Json parse_array(const std::string& t, size_t& p) {
+    ++p;  // [
+    Json out = Json::array();
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == ']') { ++p; return out; }
+    while (true) {
+      out.push_back(parse_value(t, p));
+      skip_ws(t, p);
+      if (p >= t.size()) throw std::runtime_error("unterminated array");
+      if (t[p] == ',') { ++p; continue; }
+      if (t[p] == ']') { ++p; return out; }
+      throw std::runtime_error("bad array separator");
+    }
+  }
+
+  static Json parse_object(const std::string& t, size_t& p) {
+    ++p;  // {
+    Json out = Json::object();
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == '}') { ++p; return out; }
+    while (true) {
+      skip_ws(t, p);
+      std::string key = parse_string(t, p);
+      skip_ws(t, p);
+      if (p >= t.size() || t[p] != ':') throw std::runtime_error("expected :");
+      ++p;
+      out.set(key, parse_value(t, p));
+      skip_ws(t, p);
+      if (p >= t.size()) throw std::runtime_error("unterminated object");
+      if (t[p] == ',') { ++p; continue; }
+      if (t[p] == '}') { ++p; return out; }
+      throw std::runtime_error("bad object separator");
+    }
+  }
+};
+
+}  // namespace dtpu

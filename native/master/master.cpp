@@ -1,0 +1,844 @@
+// dtpu-master: the control-plane daemon.
+//
+// Native equivalent of the reference's Go master (master/internal/: core.go,
+// experiment.go, trial.go, task/allocation.go, rm/agentrm/) redesigned for
+// TPU scheduling:
+//   - experiments own a searcher (searcher.hpp) and spawn trials;
+//   - trials request allocations; the scheduler gang-fits them onto agent
+//     slots (a TPU trial's slot count = its mesh size; slices are the
+//     allocation unit, so gangs prefer one agent/host and otherwise split
+//     into per-agent process groups wired together via jax.distributed
+//     rendezvous env);
+//   - agents long-poll for work (launch/kill) and push logs/exits back;
+//   - preemption is a long-polled flag the harness checkpoints against
+//     (same contract as reference /allocations/{id}/signals/preemption);
+//   - durability is an event journal: every mutation appends a JSON line,
+//     and boot replays the journal through the same event handlers,
+//     rebuilding experiment + searcher state exactly (event sourcing
+//     replaces the reference's Postgres snapshot/restore).
+//
+// Build: see native/CMakeLists.txt.  No third-party dependencies.
+
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../common/http.hpp"
+#include "../common/json.hpp"
+#include "searcher.hpp"
+
+namespace dtpu {
+
+static int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+
+struct AgentState {
+  std::string id;
+  std::string host;
+  int slots = 0;
+  int used_slots = 0;
+  int64_t last_seen_ms = 0;
+  std::deque<Json> work;  // pending launch/kill commands
+};
+
+struct AllocationState {
+  std::string id;
+  int64_t trial_id = 0;
+  // process groups: agent_id -> {node_rank, num_slots}
+  std::vector<std::pair<std::string, int>> groups;
+  bool preempt = false;
+  bool acked = false;
+  bool ended = false;
+};
+
+struct TrialState {
+  int64_t id = 0;
+  int64_t experiment_id = 0;
+  int64_t request_id = 0;  // searcher id
+  Json hparams;
+  std::string state = "PENDING";  // PENDING/RUNNING/COMPLETED/ERROR/STOPPED
+  int restarts = 0;
+  std::string latest_checkpoint;
+  std::string allocation_id;
+  int64_t run_id = 0;
+  bool stop_requested = false;  // searcher decided to stop it
+};
+
+struct ExperimentState {
+  int64_t id = 0;
+  std::string name;
+  Json config;
+  std::string state = "ACTIVE";  // ACTIVE/PAUSED/COMPLETED/CANCELED/ERROR
+  std::unique_ptr<SearchCtx> ctx;
+  std::unique_ptr<SearchMethod> method;
+  bool searcher_shutdown = false;
+  std::map<int64_t, int64_t> rid_to_trial;
+  int slots_per_trial = 1;
+  int max_restarts = 5;
+  std::string metric = "validation_loss";
+  bool smaller_is_better = true;
+  std::string time_metric = "batches";
+};
+
+class Master {
+ public:
+  Master(std::string state_dir, std::string checkpoint_dir)
+      : state_dir_(std::move(state_dir)), checkpoint_dir_(std::move(checkpoint_dir)) {
+    journal_path_ = state_dir_ + "/journal.jsonl";
+  }
+
+  void boot() {
+    std::ifstream in(journal_path_);
+    std::string line;
+    replaying_ = true;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      Json ev;
+      if (!Json::try_parse(line, &ev)) continue;
+      apply_event(ev);
+    }
+    replaying_ = false;
+    journal_out_.open(journal_path_, std::ios::app);
+    // trials that were mid-flight when the master died go back to PENDING
+    for (auto& [tid, t] : trials_) {
+      if (t.state == "RUNNING") {
+        t.state = "PENDING";
+        t.allocation_id.clear();
+      }
+    }
+  }
+
+  void install_routes(HttpServer& srv);
+
+ private:
+  // ---- event sourcing ----------------------------------------------------
+
+  void record(Json ev) {
+    if (replaying_) return;
+    ev.set("ts", Json(now_ms()));
+    journal_out_ << ev.dump() << "\n";
+    journal_out_.flush();
+  }
+
+  void apply_event(const Json& ev) {
+    const std::string& type = ev["type"].as_string();
+    if (type == "exp_created") {
+      do_create_experiment(ev["config"], ev["id"].as_int());
+    } else if (type == "exp_state") {
+      auto it = experiments_.find(ev["id"].as_int());
+      if (it != experiments_.end()) it->second.state = ev["state"].as_string();
+    } else if (type == "validation") {
+      do_validation(ev["trial_id"].as_int(), ev["metric"].as_double(),
+                    ev["step"].as_int(), /*from_replay=*/true);
+    } else if (type == "trial_exited") {
+      do_trial_exited(ev["trial_id"].as_int(), static_cast<int>(ev["exit_code"].as_int()),
+                      /*from_replay=*/true);
+    } else if (type == "checkpoint") {
+      checkpoints_[ev["uuid"].as_string()] = ev;
+      auto it = trials_.find(ev["trial_id"].as_int());
+      if (it != trials_.end()) it->second.latest_checkpoint = ev["uuid"].as_string();
+    } else if (type == "metrics") {
+      metrics_.push_back(ev);
+    }
+  }
+
+  // ---- experiment engine -------------------------------------------------
+
+  int64_t do_create_experiment(const Json& config, int64_t forced_id = 0) {
+    int64_t id = forced_id ? forced_id : next_experiment_id_++;
+    if (forced_id) next_experiment_id_ = std::max(next_experiment_id_, forced_id + 1);
+    ExperimentState exp;
+    exp.id = id;
+    exp.config = config;
+    exp.name = config["name"].as_string();
+    const Json& scfg = config["searcher"];
+    exp.metric = scfg.contains("metric") ? scfg["metric"].as_string() : "validation_loss";
+    exp.smaller_is_better =
+        scfg.contains("smaller_is_better") ? scfg["smaller_is_better"].as_bool(true) : true;
+    exp.time_metric =
+        scfg.contains("time_metric") && scfg["time_metric"].is_string()
+            ? scfg["time_metric"].as_string() : "batches";
+    exp.max_restarts = static_cast<int>(config["max_restarts"].as_int(5));
+    // slots = product of mesh axes (resources.mesh) or slots_per_trial
+    const Json& res = config["resources"];
+    if (res.contains("mesh")) {
+      int64_t slots = 1;
+      for (const auto& [axis, size] : res["mesh"].items()) {
+        (void)axis;
+        slots *= std::max<int64_t>(size.as_int(1), 1);
+      }
+      exp.slots_per_trial = static_cast<int>(slots);
+    } else {
+      exp.slots_per_trial = static_cast<int>(res["slots_per_trial"].as_int(1));
+    }
+    uint64_t seed = static_cast<uint64_t>(config["reproducibility"]["experiment_seed"].as_int(0));
+    exp.ctx = std::make_unique<SearchCtx>(config["hyperparameters"],
+                                          seed ^ static_cast<uint64_t>(id));
+    exp.method = make_search_method(scfg, config["hyperparameters"]);
+    auto actions = exp.method->initial_trials(*exp.ctx);
+    experiments_[id] = std::move(exp);
+    handle_actions(experiments_[id], actions);
+    return id;
+  }
+
+  void handle_actions(ExperimentState& exp, std::vector<SearchAction>& actions) {
+    for (auto& a : actions) {
+      switch (a.kind) {
+        case SearchAction::Kind::Create: {
+          if (exp.state != "ACTIVE" && !replaying_) continue;
+          int64_t tid = next_trial_id_++;
+          TrialState t;
+          t.id = tid;
+          t.experiment_id = exp.id;
+          t.request_id = a.request_id;
+          t.hparams = a.hparams;
+          trials_[tid] = t;
+          exp.rid_to_trial[a.request_id] = tid;
+          auto created = exp.method->trial_created(*exp.ctx, a.request_id);
+          handle_actions(exp, created);
+          break;
+        }
+        case SearchAction::Kind::Stop: {
+          auto it = exp.rid_to_trial.find(a.request_id);
+          if (it == exp.rid_to_trial.end()) break;
+          auto tit = trials_.find(it->second);
+          if (tit == trials_.end()) break;
+          tit->second.stop_requested = true;
+          signal_preempt(tit->second.allocation_id);
+          break;
+        }
+        case SearchAction::Kind::Shutdown:
+          exp.searcher_shutdown = true;
+          break;
+      }
+    }
+    maybe_complete(exp);
+  }
+
+  void maybe_complete(ExperimentState& exp) {
+    if (!exp.searcher_shutdown || exp.state != "ACTIVE") return;
+    for (const auto& [rid, tid] : exp.rid_to_trial) {
+      const auto& t = trials_[tid];
+      if (t.state == "PENDING" || t.state == "RUNNING") return;
+    }
+    set_exp_state(exp, "COMPLETED");
+  }
+
+  void set_exp_state(ExperimentState& exp, const std::string& state) {
+    exp.state = state;
+    record(Json::object().set("type", "exp_state").set("id", Json(exp.id)).set("state", state));
+  }
+
+  void do_validation(int64_t trial_id, double metric, int64_t step, bool from_replay) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return;
+    TrialState& t = tit->second;
+    auto eit = experiments_.find(t.experiment_id);
+    if (eit == experiments_.end()) return;
+    ExperimentState& exp = eit->second;
+    double oriented = exp.smaller_is_better ? metric : -metric;
+    auto actions = exp.method->validation_completed(*exp.ctx, t.request_id, oriented, step);
+    if (!from_replay) {
+      record(Json::object()
+                 .set("type", "validation")
+                 .set("trial_id", Json(trial_id))
+                 .set("metric", Json(metric))
+                 .set("step", Json(step)));
+    }
+    handle_actions(exp, actions);
+  }
+
+  void do_trial_exited(int64_t trial_id, int exit_code, bool from_replay) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return;
+    TrialState& t = tit->second;
+    auto eit = experiments_.find(t.experiment_id);
+    ExperimentState& exp = eit->second;
+
+    if (!from_replay) {
+      record(Json::object()
+                 .set("type", "trial_exited")
+                 .set("trial_id", Json(trial_id))
+                 .set("exit_code", Json(exit_code)));
+    }
+    end_allocation(t.allocation_id);
+
+    if (exit_code == 0) {
+      t.state = t.stop_requested ? "STOPPED" : "COMPLETED";
+      auto actions = exp.method->trial_exited(*exp.ctx, t.request_id);
+      handle_actions(exp, actions);
+    } else if (exp.state == "PAUSED") {
+      // preempted by pause: back to pending, resumed on activate
+      t.state = "PENDING";
+      t.allocation_id.clear();
+    } else if (t.restarts < exp.max_restarts && !from_replay) {
+      ++t.restarts;
+      ++t.run_id;
+      t.state = "PENDING";
+      t.allocation_id.clear();
+    } else {
+      t.state = "ERROR";
+      auto actions = exp.method->trial_exited(*exp.ctx, t.request_id);
+      handle_actions(exp, actions);
+    }
+    if (!replaying_) schedule();
+  }
+
+  // ---- scheduler (priority FIFO + gang fitting) --------------------------
+
+  void schedule() {
+    // pending trials of active experiments, FIFO by trial id
+    for (auto& [tid, t] : trials_) {
+      if (t.state != "PENDING") continue;
+      auto eit = experiments_.find(t.experiment_id);
+      if (eit == experiments_.end() || eit->second.state != "ACTIVE") continue;
+      ExperimentState& exp = eit->second;
+      int needed = exp.slots_per_trial;
+
+      // best fit: the single agent with the fewest free slots that still
+      // fits the whole gang (reference fitting.go BestFit); else split the
+      // gang over multiple agents (largest-free first)
+      AgentState* best = nullptr;
+      for (auto& [aid, ag] : agents_) {
+        int free = ag.slots - ag.used_slots;
+        if (free >= needed && (best == nullptr ||
+                               free < best->slots - best->used_slots)) {
+          best = &ag;
+        }
+      }
+      std::vector<std::pair<std::string, int>> groups;
+      if (best != nullptr) {
+        groups.push_back({best->id, needed});
+      } else {
+        int remaining = needed;
+        std::vector<AgentState*> by_free;
+        for (auto& [aid, ag] : agents_) by_free.push_back(&ag);
+        std::sort(by_free.begin(), by_free.end(), [](AgentState* a, AgentState* b) {
+          return (a->slots - a->used_slots) > (b->slots - b->used_slots);
+        });
+        for (auto* ag : by_free) {
+          int free = ag->slots - ag->used_slots;
+          if (free <= 0) continue;
+          int take = std::min(free, remaining);
+          groups.push_back({ag->id, take});
+          remaining -= take;
+          if (remaining == 0) break;
+        }
+        if (remaining > 0) continue;  // gang does not fit anywhere yet
+      }
+
+      // place the gang
+      std::string alloc_id = "alloc-" + std::to_string(next_allocation_id_++);
+      AllocationState alloc;
+      alloc.id = alloc_id;
+      alloc.trial_id = tid;
+      alloc.groups = groups;
+      allocations_[alloc_id] = alloc;
+      t.allocation_id = alloc_id;
+      t.state = "RUNNING";
+
+      int num_nodes = static_cast<int>(groups.size());
+      const std::string& coord_host =
+          agents_[groups[0].first].host.empty() ? "127.0.0.1" : agents_[groups[0].first].host;
+      int coord_port = 17000 + static_cast<int>(tid % 2000);
+      int node_rank = 0;
+      for (auto& [aid, slots] : groups) {
+        AgentState& ag = agents_[aid];
+        ag.used_slots += slots;
+        Json env = Json::object();
+        env.set("DTPU_TRIAL_ID", std::to_string(tid));
+        env.set("DTPU_EXPERIMENT_ID", std::to_string(t.experiment_id));
+        env.set("DTPU_ALLOCATION_ID", alloc_id);
+        env.set("DTPU_HPARAMS", t.hparams.dump());
+        env.set("DTPU_EXP_CONFIG", exp.config.dump());
+        env.set("DTPU_TRIAL_SEED", std::to_string(
+            exp.config["reproducibility"]["experiment_seed"].as_int(0) + tid));
+        env.set("DTPU_TRIAL_RUN_ID", std::to_string(t.run_id));
+        env.set("DTPU_NUM_SLOTS", std::to_string(slots));
+        if (!t.latest_checkpoint.empty()) {
+          env.set("DTPU_LATEST_CHECKPOINT", t.latest_checkpoint);
+        }
+        Json rendezvous = Json::object();
+        rendezvous.set("coordinator", coord_host + ":" + std::to_string(coord_port));
+        rendezvous.set("num_nodes", Json(num_nodes));
+        rendezvous.set("node_rank", Json(node_rank));
+        env.set("DTPU_RENDEZVOUS", rendezvous.dump());
+
+        Json work = Json::object();
+        work.set("type", "launch");
+        work.set("allocation_id", alloc_id);
+        work.set("trial_id", Json(tid));
+        work.set("entrypoint", exp.config["entrypoint"]);
+        work.set("env", env);
+        work.set("checkpoint_dir", checkpoint_dir_);
+        ag.work.push_back(work);
+        ++node_rank;
+      }
+      work_cv_.notify_all();
+    }
+  }
+
+  void signal_preempt(const std::string& alloc_id) {
+    if (alloc_id.empty()) return;
+    auto it = allocations_.find(alloc_id);
+    if (it == allocations_.end()) return;
+    it->second.preempt = true;
+    preempt_cv_.notify_all();
+  }
+
+  void end_allocation(const std::string& alloc_id) {
+    auto it = allocations_.find(alloc_id);
+    if (it == allocations_.end()) return;
+    if (it->second.ended) return;
+    it->second.ended = true;
+    for (auto& [aid, slots] : it->second.groups) {
+      auto ait = agents_.find(aid);
+      if (ait != agents_.end()) {
+        ait->second.used_slots = std::max(0, ait->second.used_slots - slots);
+      }
+    }
+  }
+
+  void kill_allocation(AllocationState& alloc) {
+    for (auto& [aid, slots] : alloc.groups) {
+      auto ait = agents_.find(aid);
+      if (ait == agents_.end()) continue;
+      Json work = Json::object();
+      work.set("type", "kill");
+      work.set("allocation_id", alloc.id);
+      ait->second.work.push_back(work);
+    }
+    work_cv_.notify_all();
+  }
+
+  // ---- route helpers -----------------------------------------------------
+
+  Json trial_json(const TrialState& t) const {
+    Json j = Json::object();
+    j.set("id", Json(t.id));
+    j.set("experiment_id", Json(t.experiment_id));
+    j.set("request_id", Json(t.request_id));
+    j.set("hparams", t.hparams);
+    j.set("state", t.state);
+    j.set("restarts", Json(t.restarts));
+    j.set("latest_checkpoint", t.latest_checkpoint);
+    j.set("allocation_id", t.allocation_id);
+    return j;
+  }
+
+  Json experiment_json(const ExperimentState& e) const {
+    Json j = Json::object();
+    j.set("id", Json(e.id));
+    j.set("name", e.name);
+    j.set("state", e.state);
+    j.set("config", e.config);
+    j.set("progress", Json(e.method ? e.method->progress() : 0.0));
+    Json trials = Json::array();
+    for (const auto& [rid, tid] : e.rid_to_trial) {
+      auto it = trials_.find(tid);
+      if (it != trials_.end()) trials.push_back(trial_json(it->second));
+    }
+    j.set("trials", trials);
+    return j;
+  }
+
+ public:
+  // exposed for routes
+  std::mutex mu_;
+  std::condition_variable work_cv_;
+  std::condition_variable preempt_cv_;
+
+ private:
+  std::string state_dir_;
+  std::string checkpoint_dir_;
+  std::string journal_path_;
+  std::ofstream journal_out_;
+  bool replaying_ = false;
+
+  int64_t next_experiment_id_ = 1;
+  int64_t next_trial_id_ = 1;
+  int64_t next_allocation_id_ = 1;
+
+  std::map<int64_t, ExperimentState> experiments_;
+  std::map<int64_t, TrialState> trials_;
+  std::map<std::string, AllocationState> allocations_;
+  std::map<std::string, AgentState> agents_;
+  std::map<std::string, Json> checkpoints_;
+  std::vector<Json> metrics_;
+  std::map<int64_t, std::vector<Json>> logs_;  // trial_id -> lines
+
+  friend void install_routes_impl(Master&, HttpServer&);
+};
+
+// ---------------------------------------------------------------------------
+// routes
+
+void install_routes_impl(Master& m, HttpServer& srv) {
+  using R = HttpResponse;
+
+  srv.route("POST", "/api/v1/auth/login", [](const HttpRequest&) {
+    return R::json("{\"token\":\"dev\"}");
+  });
+
+  srv.route("GET", "/api/v1/master", [&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    Json j = Json::object();
+    j.set("version", "0.1.0");
+    j.set("cluster_name", "dtpu");
+    j.set("agents", Json(static_cast<int64_t>(m.agents_.size())));
+    return R::json(j.dump());
+  });
+
+  // ---- experiments ----
+  srv.route("POST", "/api/v1/experiments", [&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    const Json& config = body.contains("config") ? body["config"] : body;
+    std::lock_guard<std::mutex> lk(m.mu_);
+    int64_t id = m.do_create_experiment(config);
+    m.record(Json::object().set("type", "exp_created").set("id", Json(id)).set("config", config));
+    m.schedule();
+    Json out = Json::object();
+    out.set("id", Json(id));
+    return R::json(out.dump(), 201);
+  });
+
+  srv.route("GET", "/api/v1/experiments", [&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    Json out = Json::array();
+    for (const auto& [id, e] : m.experiments_) out.push_back(m.experiment_json(e));
+    return R::json(out.dump());
+  });
+
+  srv.route("GET", "/api/v1/experiments/{id}", [&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.experiments_.find(std::stoll(req.params.at("id")));
+    if (it == m.experiments_.end()) return R::error(404, "no such experiment");
+    return R::json(m.experiment_json(it->second).dump());
+  });
+
+  auto exp_signal = [&m](const HttpRequest& req, const std::string& verb) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.experiments_.find(std::stoll(req.params.at("id")));
+    if (it == m.experiments_.end()) return R::error(404, "no such experiment");
+    auto& exp = it->second;
+    if (verb == "pause" && exp.state == "ACTIVE") {
+      m.set_exp_state(exp, "PAUSED");
+      for (auto& [rid, tid] : exp.rid_to_trial) {
+        m.signal_preempt(m.trials_[tid].allocation_id);
+      }
+    } else if (verb == "activate" && exp.state == "PAUSED") {
+      m.set_exp_state(exp, "ACTIVE");
+      m.schedule();
+    } else if (verb == "cancel" || verb == "kill") {
+      if (exp.state == "ACTIVE" || exp.state == "PAUSED") {
+        m.set_exp_state(exp, "CANCELED");
+        for (auto& [rid, tid] : exp.rid_to_trial) {
+          auto& t = m.trials_[tid];
+          if (t.state == "RUNNING") {
+            if (verb == "kill") {
+              auto ait = m.allocations_.find(t.allocation_id);
+              if (ait != m.allocations_.end()) m.kill_allocation(ait->second);
+            } else {
+              m.signal_preempt(t.allocation_id);
+            }
+          } else if (t.state == "PENDING") {
+            t.state = "STOPPED";
+          }
+        }
+      }
+    }
+    return R::json(m.experiment_json(exp).dump());
+  };
+  srv.route("POST", "/api/v1/experiments/{id}/pause",
+            [exp_signal](const HttpRequest& r) { return exp_signal(r, "pause"); });
+  srv.route("POST", "/api/v1/experiments/{id}/activate",
+            [exp_signal](const HttpRequest& r) { return exp_signal(r, "activate"); });
+  srv.route("POST", "/api/v1/experiments/{id}/cancel",
+            [exp_signal](const HttpRequest& r) { return exp_signal(r, "cancel"); });
+  srv.route("POST", "/api/v1/experiments/{id}/kill",
+            [exp_signal](const HttpRequest& r) { return exp_signal(r, "kill"); });
+
+  // ---- trials ----
+  srv.route("GET", "/api/v1/trials/{id}", [&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.trials_.find(std::stoll(req.params.at("id")));
+    if (it == m.trials_.end()) return R::error(404, "no such trial");
+    return R::json(m.trial_json(it->second).dump());
+  });
+
+  // ---- metrics ingest + query ----
+  srv.route("POST", "/api/v1/metrics", [&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    m.metrics_.push_back(body);
+    m.record(Json::object()
+                 .set("type", "metrics")
+                 .set("trial_id", body["trial_id"])
+                 .set("group", body["group"])
+                 .set("steps_completed", body["steps_completed"])
+                 .set("metrics", body["metrics"]));
+    if (body["group"].as_string() == "validation") {
+      int64_t tid = body["trial_id"].as_int();
+      auto tit = m.trials_.find(tid);
+      if (tit != m.trials_.end()) {
+        auto& exp = m.experiments_[tit->second.experiment_id];
+        const Json& metric = body["metrics"][exp.metric];
+        if (metric.is_number()) {
+          m.do_validation(tid, metric.as_double(), body["steps_completed"].as_int(), false);
+          m.schedule();
+        }
+      }
+    }
+    return R::json("{}");
+  });
+
+  // batched form used by the harness metrics shipper (core/_metrics.py)
+  srv.route("POST", "/api/v1/trials/metrics", [&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    for (const auto& rec : body["metrics"].elements()) {
+      m.metrics_.push_back(rec);
+      m.record(Json::object()
+                   .set("type", "metrics")
+                   .set("trial_id", rec["trial_id"])
+                   .set("group", rec["group"])
+                   .set("steps_completed", rec["steps_completed"])
+                   .set("metrics", rec["metrics"]));
+      if (rec["group"].as_string() == "validation") {
+        int64_t tid = rec["trial_id"].as_int();
+        auto tit = m.trials_.find(tid);
+        if (tit != m.trials_.end()) {
+          auto& exp = m.experiments_[tit->second.experiment_id];
+          const Json& metric = rec["metrics"][exp.metric];
+          if (metric.is_number()) {
+            m.do_validation(tid, metric.as_double(), rec["steps_completed"].as_int(),
+                            false);
+          }
+        }
+      }
+    }
+    m.schedule();
+    return R::json("{}");
+  });
+
+  srv.route("GET", "/api/v1/trials/{id}/metrics", [&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    int64_t tid = std::stoll(req.params.at("id"));
+    std::string group;
+    auto g = req.query.find("group");
+    if (g != req.query.end()) group = g->second;
+    Json out = Json::array();
+    for (const auto& rec : m.metrics_) {
+      if (rec["trial_id"].as_int() != tid) continue;
+      if (!group.empty() && rec["group"].as_string() != group) continue;
+      out.push_back(rec);
+    }
+    return R::json(out.dump());
+  });
+
+  // ---- checkpoints ----
+  srv.route("POST", "/api/v1/checkpoints", [&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    body.set("type", "checkpoint");
+    m.checkpoints_[body["uuid"].as_string()] = body;
+    auto it = m.trials_.find(body["trial_id"].as_int());
+    if (it != m.trials_.end()) it->second.latest_checkpoint = body["uuid"].as_string();
+    m.record(body);
+    return R::json("{}");
+  });
+
+  srv.route("GET", "/api/v1/checkpoints", [&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    Json out = Json::array();
+    for (const auto& [uuid, c] : m.checkpoints_) out.push_back(c);
+    return R::json(out.dump());
+  });
+
+  // ---- allocations: preemption long-poll + ack ----
+  srv.route("GET", "/api/v1/allocations/{id}/signals/preemption",
+            [&m](const HttpRequest& req) {
+    int timeout_s = 60;
+    auto t = req.query.find("timeout_seconds");
+    if (t != req.query.end()) timeout_s = std::max(0, std::atoi(t->second.c_str()));
+    std::unique_lock<std::mutex> lk(m.mu_);
+    const std::string& id = req.params.at("id");
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(timeout_s);
+    while (true) {
+      auto it = m.allocations_.find(id);
+      if (it == m.allocations_.end()) return R::error(404, "no such allocation");
+      if (it->second.preempt) return R::json("{\"preempt\":true}");
+      if (m.preempt_cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+        return R::json("{\"preempt\":false}");
+      }
+    }
+  });
+
+  srv.route("POST", "/api/v1/allocations/{id}/signals/ack_preemption",
+            [&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.allocations_.find(req.params.at("id"));
+    if (it != m.allocations_.end()) it->second.acked = true;
+    return R::json("{}");
+  });
+
+  // ---- agents ----
+  srv.route("POST", "/api/v1/agents", [&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    const std::string& id = body["id"].as_string();
+    auto& ag = m.agents_[id];
+    bool fresh = ag.id.empty();
+    ag.id = id;
+    ag.host = body["host"].as_string();
+    ag.slots = static_cast<int>(body["slots"].as_int(1));
+    if (fresh) ag.used_slots = 0;
+    ag.last_seen_ms = now_ms();
+    m.schedule();
+    return R::json("{\"registered\":true}");
+  });
+
+  srv.route("GET", "/api/v1/agents", [&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    Json out = Json::array();
+    for (const auto& [id, ag] : m.agents_) {
+      Json j = Json::object();
+      j.set("id", ag.id);
+      j.set("host", ag.host);
+      j.set("slots", Json(ag.slots));
+      j.set("used_slots", Json(ag.used_slots));
+      out.push_back(j);
+    }
+    return R::json(out.dump());
+  });
+
+  // agent work long-poll
+  srv.route("GET", "/api/v1/agents/{id}/work", [&m](const HttpRequest& req) {
+    int timeout_s = 30;
+    auto t = req.query.find("timeout_seconds");
+    if (t != req.query.end()) timeout_s = std::max(0, std::atoi(t->second.c_str()));
+    std::unique_lock<std::mutex> lk(m.mu_);
+    const std::string& id = req.params.at("id");
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(timeout_s);
+    while (true) {
+      auto it = m.agents_.find(id);
+      if (it == m.agents_.end()) return R::error(404, "agent not registered");
+      it->second.last_seen_ms = now_ms();
+      if (!it->second.work.empty()) {
+        Json out = Json::array();
+        while (!it->second.work.empty()) {
+          out.push_back(it->second.work.front());
+          it->second.work.pop_front();
+        }
+        return R::json(out.dump());
+      }
+      if (m.work_cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+        return R::json("[]");
+      }
+    }
+  });
+
+  // trial exit reported by agent
+  srv.route("POST", "/api/v1/trials/{id}/exit", [&m](const HttpRequest& req) {
+    Json body;
+    Json::try_parse(req.body, &body);
+    std::lock_guard<std::mutex> lk(m.mu_);
+    int64_t tid = std::stoll(req.params.at("id"));
+    // ignore exits from allocations this master no longer tracks (process
+    // from before a master restart; the trial was already rescheduled)
+    auto it = m.trials_.find(tid);
+    if (it != m.trials_.end() && body["allocation_id"].is_string() &&
+        body["allocation_id"].as_string() != it->second.allocation_id) {
+      return R::json("{\"stale\":true}");
+    }
+    m.do_trial_exited(tid, static_cast<int>(body["exit_code"].as_int(0)), false);
+    return R::json("{}");
+  });
+
+  // ---- task logs ----
+  srv.route("POST", "/api/v1/logs", [&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    int64_t tid = body["trial_id"].as_int();
+    for (const auto& line : body["lines"].elements()) {
+      m.logs_[tid].push_back(line);
+    }
+    return R::json("{}");
+  });
+
+  srv.route("GET", "/api/v1/trials/{id}/logs", [&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    int64_t tid = std::stoll(req.params.at("id"));
+    size_t offset = 0;
+    auto o = req.query.find("offset");
+    if (o != req.query.end()) offset = std::stoul(o->second);
+    Json out = Json::array();
+    auto it = m.logs_.find(tid);
+    if (it != m.logs_.end()) {
+      for (size_t i = offset; i < it->second.size(); ++i) out.push_back(it->second[i]);
+    }
+    return R::json(out.dump());
+  });
+}
+
+void Master::install_routes(HttpServer& srv) { install_routes_impl(*this, srv); }
+
+}  // namespace dtpu
+
+// ---------------------------------------------------------------------------
+
+int main(int argc, char** argv) {
+  std::string host = "0.0.0.0";
+  int port = 8080;
+  std::string state_dir = "/tmp/dtpu-master";
+  std::string checkpoint_dir = "/tmp/dtpu-checkpoints";
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto next = [&](const char* name) -> std::string {
+      if (i + 1 >= argc) { fprintf(stderr, "missing value for %s\n", name); exit(2); }
+      return argv[++i];
+    };
+    if (arg == "--port") port = std::atoi(next("--port").c_str());
+    else if (arg == "--host") host = next("--host");
+    else if (arg == "--state-dir") state_dir = next("--state-dir");
+    else if (arg == "--checkpoint-dir") checkpoint_dir = next("--checkpoint-dir");
+    else { fprintf(stderr, "unknown arg %s\n", arg.c_str()); return 2; }
+  }
+  std::string mk = "mkdir -p '" + state_dir + "' '" + checkpoint_dir + "'";
+  if (system(mk.c_str()) != 0) return 1;
+
+  dtpu::Master master(state_dir, checkpoint_dir);
+  master.boot();
+  dtpu::HttpServer srv;
+  master.install_routes(srv);
+  int bound = srv.listen(host, port);
+  if (bound < 0) {
+    fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);
+    return 1;
+  }
+  printf("dtpu-master listening on %s:%d (state: %s)\n", host.c_str(), bound,
+         state_dir.c_str());
+  fflush(stdout);
+  // serve forever
+  while (true) std::this_thread::sleep_for(std::chrono::seconds(3600));
+}

@@ -1,0 +1,486 @@
+// Searcher engine for the dtpu master: hp sampling + search methods.
+//
+// Mirrors the Python harness implementation (determined_tpu/searcher/) and
+// the reference semantics it was built from (master/pkg/searcher/
+// asha_stopping.go, adaptive_asha.go, tournament.go, grid.go).  The two
+// implementations are kept behavior-compatible: the ASHA stopping rule is
+// "insert into rung; stop unless in top 1/divisor (or best when fewer than
+// divisor entries); top rung always stops".
+#pragma once
+
+#include <algorithm>
+#include <cmath>
+#include <map>
+#include <memory>
+#include <random>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "../common/json.hpp"
+
+namespace dtpu {
+
+// ---- hyperparameter sampling ----------------------------------------------
+
+inline Json sample_hp(const Json& decl, std::mt19937_64& rng) {
+  if (!decl.is_object() || !decl.contains("type")) return decl;  // bare const
+  const std::string& t = decl["type"].as_string();
+  if (t == "const") return decl["val"];
+  if (t == "int") {
+    int64_t lo = decl["minval"].as_int(), hi = decl["maxval"].as_int();
+    std::uniform_int_distribution<int64_t> d(lo, hi);
+    return Json(static_cast<double>(d(rng)));
+  }
+  if (t == "double") {
+    std::uniform_real_distribution<double> d(decl["minval"].as_double(),
+                                             decl["maxval"].as_double());
+    return Json(d(rng));
+  }
+  if (t == "log") {
+    double base = decl.contains("base") ? decl["base"].as_double() : 10.0;
+    std::uniform_real_distribution<double> d(decl["minval"].as_double(),
+                                             decl["maxval"].as_double());
+    return Json(std::pow(base, d(rng)));
+  }
+  if (t == "categorical") {
+    const auto& vals = decl["vals"].elements();
+    std::uniform_int_distribution<size_t> d(0, vals.empty() ? 0 : vals.size() - 1);
+    return vals.empty() ? Json() : vals[d(rng)];
+  }
+  return decl;
+}
+
+inline Json sample_hparams(const Json& space, std::mt19937_64& rng) {
+  Json out = Json::object();
+  for (const auto& [k, v] : space.items()) {
+    if (v.is_object() && !v.contains("type")) {
+      out.set(k, sample_hparams(v, rng));  // nested namespace
+    } else {
+      out.set(k, sample_hp(v, rng));
+    }
+  }
+  return out;
+}
+
+inline std::vector<Json> grid_axis(const Json& decl) {
+  std::vector<Json> out;
+  if (!decl.is_object() || !decl.contains("type")) { out.push_back(decl); return out; }
+  const std::string& t = decl["type"].as_string();
+  if (t == "const") { out.push_back(decl["val"]); return out; }
+  int64_t count = decl.contains("count") ? decl["count"].as_int() : 0;
+  if (t == "categorical") {
+    for (const auto& v : decl["vals"].elements()) out.push_back(v);
+    return out;
+  }
+  if (t == "int") {
+    int64_t lo = decl["minval"].as_int(), hi = decl["maxval"].as_int();
+    int64_t span = hi - lo + 1;
+    int64_t n = count > 0 ? std::min(count, span) : span;
+    if (n <= 1) { out.push_back(Json(static_cast<double>(lo))); return out; }
+    std::vector<int64_t> vals;
+    for (int64_t i = 0; i < n; ++i) {
+      vals.push_back(lo + static_cast<int64_t>(std::llround(
+          static_cast<double>(hi - lo) * i / (n - 1))));
+    }
+    std::sort(vals.begin(), vals.end());
+    vals.erase(std::unique(vals.begin(), vals.end()), vals.end());
+    for (auto v : vals) out.push_back(Json(static_cast<double>(v)));
+    return out;
+  }
+  // double / log need explicit count
+  double lo = decl["minval"].as_double(), hi = decl["maxval"].as_double();
+  int64_t n = std::max<int64_t>(count, 1);
+  for (int64_t i = 0; i < n; ++i) {
+    double u = n == 1 ? lo : lo + (hi - lo) * i / (n - 1);
+    out.push_back(Json(t == "log"
+        ? std::pow(decl.contains("base") ? decl["base"].as_double() : 10.0, u)
+        : u));
+  }
+  return out;
+}
+
+inline void grid_points_rec(const Json& space, JsonObject current,
+                            std::vector<Json>* out) {
+  // find first unexpanded key (walk in map order)
+  for (const auto& [k, v] : space.items()) {
+    if (current.count(k)) continue;
+    if (v.is_object() && !v.contains("type")) {
+      // nested namespace: expand its own grid, then continue with the rest
+      std::vector<Json> subs;
+      grid_points_rec(v, {}, &subs);
+      for (auto& sub : subs) {
+        JsonObject next = current;
+        next[k] = sub;
+        grid_points_rec(space, next, out);
+      }
+      return;
+    }
+    for (const auto& val : grid_axis(v)) {
+      JsonObject next = current;
+      next[k] = val;
+      grid_points_rec(space, next, out);
+    }
+    return;
+  }
+  out->push_back(Json(current));
+}
+
+inline std::vector<Json> grid_points(const Json& space) {
+  std::vector<Json> out;
+  grid_points_rec(space, {}, &out);
+  return out;
+}
+
+// ---- search methods --------------------------------------------------------
+
+struct SearchAction {
+  enum class Kind { Create, Stop, Shutdown } kind;
+  int64_t request_id = 0;  // Create/Stop
+  Json hparams;            // Create
+};
+
+class SearchCtx {
+ public:
+  SearchCtx(Json space, uint64_t seed) : space_(std::move(space)), rng_(seed) {}
+  int64_t next_id() { return next_id_++; }
+  Json sample() { return sample_hparams(space_, rng_); }
+  SearchAction create() { return {SearchAction::Kind::Create, next_id(), sample()}; }
+  SearchAction create_with(Json hp) { return {SearchAction::Kind::Create, next_id(), std::move(hp)}; }
+  const Json& space() const { return space_; }
+
+ private:
+  Json space_;
+  std::mt19937_64 rng_;
+  int64_t next_id_ = 1;
+};
+
+class SearchMethod {
+ public:
+  virtual ~SearchMethod() = default;
+  virtual std::vector<SearchAction> initial_trials(SearchCtx& ctx) = 0;
+  virtual std::vector<SearchAction> trial_created(SearchCtx&, int64_t) { return {}; }
+  virtual std::vector<SearchAction> validation_completed(SearchCtx& ctx, int64_t rid,
+                                                         double metric, int64_t step) = 0;
+  virtual std::vector<SearchAction> trial_exited(SearchCtx& ctx, int64_t rid) = 0;
+  virtual double progress() const = 0;
+};
+
+class SingleSearch : public SearchMethod {
+ public:
+  std::vector<SearchAction> initial_trials(SearchCtx& ctx) override {
+    return {ctx.create()};
+  }
+  std::vector<SearchAction> validation_completed(SearchCtx&, int64_t, double, int64_t) override {
+    return {};
+  }
+  std::vector<SearchAction> trial_exited(SearchCtx&, int64_t) override {
+    closed_ = true;
+    return {{SearchAction::Kind::Shutdown}};
+  }
+  double progress() const override { return closed_ ? 1.0 : 0.0; }
+
+ private:
+  bool closed_ = false;
+};
+
+class RandomSearch : public SearchMethod {
+ public:
+  RandomSearch(int max_trials, int max_concurrent)
+      : max_trials_(max_trials),
+        max_concurrent_(std::max(1, std::min(max_concurrent, max_trials))) {}
+
+  std::vector<SearchAction> initial_trials(SearchCtx& ctx) override {
+    std::vector<SearchAction> out;
+    for (int i = 0; i < max_concurrent_; ++i) out.push_back(ctx.create());
+    created_ = max_concurrent_;
+    return out;
+  }
+  std::vector<SearchAction> validation_completed(SearchCtx&, int64_t, double, int64_t) override {
+    return {};
+  }
+  std::vector<SearchAction> trial_exited(SearchCtx& ctx, int64_t) override {
+    ++closed_;
+    if (created_ < max_trials_) {
+      ++created_;
+      return {ctx.create()};
+    }
+    if (closed_ >= max_trials_) return {{SearchAction::Kind::Shutdown}};
+    return {};
+  }
+  double progress() const override {
+    return std::min(1.0, static_cast<double>(closed_) / max_trials_);
+  }
+
+ private:
+  int max_trials_, max_concurrent_, created_ = 0, closed_ = 0;
+};
+
+class GridSearch : public SearchMethod {
+ public:
+  GridSearch(const Json& space, int max_concurrent)
+      : points_(grid_points(space)), max_concurrent_(std::max(1, max_concurrent)) {}
+
+  std::vector<SearchAction> initial_trials(SearchCtx& ctx) override {
+    std::vector<SearchAction> out;
+    size_t n = std::min<size_t>(max_concurrent_, points_.size());
+    for (size_t i = 0; i < n; ++i) out.push_back(ctx.create_with(points_[next_++]));
+    return out;
+  }
+  std::vector<SearchAction> validation_completed(SearchCtx&, int64_t, double, int64_t) override {
+    return {};
+  }
+  std::vector<SearchAction> trial_exited(SearchCtx& ctx, int64_t) override {
+    ++closed_;
+    if (next_ < points_.size()) return {ctx.create_with(points_[next_++])};
+    if (closed_ >= points_.size()) return {{SearchAction::Kind::Shutdown}};
+    return {};
+  }
+  double progress() const override {
+    return points_.empty() ? 1.0
+                           : std::min(1.0, static_cast<double>(closed_) / points_.size());
+  }
+
+ private:
+  std::vector<Json> points_;
+  size_t max_concurrent_, next_ = 0, closed_ = 0;
+};
+
+// ASHA early-stopping bracket (reference asha_stopping.go semantics).
+class AshaSearch : public SearchMethod {
+ public:
+  AshaSearch(int num_rungs, double divisor, int64_t max_time, int max_trials,
+             int max_concurrent)
+      : num_rungs_(num_rungs),
+        divisor_(divisor),
+        max_trials_(max_trials),
+        max_concurrent_(max_concurrent) {
+    for (int i = 0; i < num_rungs; ++i) {
+      int64_t units = std::max<int64_t>(
+          static_cast<int64_t>(max_time / std::pow(divisor, num_rungs - i - 1)), 1);
+      rungs_.push_back({units, {}});
+    }
+  }
+
+  std::vector<SearchAction> initial_trials(SearchCtx& ctx) override {
+    int n = max_concurrent_ > 0
+                ? std::min(max_concurrent_, max_trials_)
+                : std::max(1, std::min(static_cast<int>(std::pow(divisor_, num_rungs_ - 1)),
+                                       max_trials_));
+    std::vector<SearchAction> out;
+    for (int i = 0; i < n; ++i) out.push_back(ctx.create());
+    return out;
+  }
+
+  std::vector<SearchAction> trial_created(SearchCtx&, int64_t rid) override {
+    trial_rungs_[rid] = 0;
+    return {};
+  }
+
+  std::vector<SearchAction> validation_completed(SearchCtx& ctx, int64_t rid,
+                                                 double metric, int64_t step) override {
+    // a stopped trial may report again before teardown: ignore, or rung
+    // entries duplicate and the budget burns on spurious replacements
+    if (stopped_.count(rid)) return {};
+    auto out = do_early_stopping(rid, step, metric);
+    for (const auto& a : out) {
+      if (a.kind == SearchAction::Kind::Stop) stopped_.insert(rid);
+    }
+    int64_t all = static_cast<int64_t>(trial_rungs_.size());
+    if (!out.empty() && all < max_trials_) out.push_back(ctx.create());
+    return out;
+  }
+
+  std::vector<SearchAction> trial_exited(SearchCtx&, int64_t) override {
+    ++completed_;
+    if (completed_ >= max_trials_) return {{SearchAction::Kind::Shutdown}};
+    return {};
+  }
+
+  double progress() const override {
+    double all = static_cast<double>(rungs_.empty() ? 0 : rungs_[0].metrics.size());
+    double p = all / (1.2 * max_trials_);
+    if (static_cast<int>(all) >= max_trials_) {
+      p = std::max(p, static_cast<double>(completed_) / max_trials_);
+    }
+    return std::min(p, 1.0);
+  }
+
+ private:
+  struct Rung {
+    int64_t units_needed;
+    std::vector<std::pair<double, int64_t>> metrics;  // sorted (metric, rid)
+
+    size_t insert(int64_t rid, double metric) {
+      auto it = std::lower_bound(
+          metrics.begin(), metrics.end(), std::make_pair(metric, INT64_MIN));
+      size_t idx = static_cast<size_t>(it - metrics.begin());
+      metrics.insert(it, {metric, rid});
+      return idx;
+    }
+  };
+
+  std::vector<SearchAction> do_early_stopping(int64_t rid, int64_t step, double metric) {
+    std::vector<SearchAction> out;
+    for (int r = trial_rungs_[rid]; r < num_rungs_; ++r) {
+      Rung& rung = rungs_[static_cast<size_t>(r)];
+      trial_rungs_[rid] = r;
+      if (step < rung.units_needed) return out;
+      size_t idx = rung.insert(rid, metric);
+      if (r == num_rungs_ - 1) {
+        out.push_back({SearchAction::Kind::Stop, rid});
+        return out;
+      }
+      size_t num_continue =
+          std::max<size_t>(static_cast<size_t>(rung.metrics.size() / divisor_), 1);
+      if (idx >= num_continue) {
+        out.push_back({SearchAction::Kind::Stop, rid});
+        return out;
+      }
+    }
+    return out;
+  }
+
+  int num_rungs_;
+  double divisor_;
+  int max_trials_, max_concurrent_;
+  int completed_ = 0;
+  std::vector<Rung> rungs_;
+  std::map<int64_t, int> trial_rungs_;
+  std::set<int64_t> stopped_;
+};
+
+// Tournament of ASHA brackets (reference adaptive_asha.go + tournament.go).
+class TournamentSearch : public SearchMethod {
+ public:
+  explicit TournamentSearch(std::vector<std::unique_ptr<SearchMethod>> subs)
+      : subs_(std::move(subs)), closed_(subs_.size(), false) {}
+
+  std::vector<SearchAction> initial_trials(SearchCtx& ctx) override {
+    std::vector<SearchAction> out;
+    for (size_t i = 0; i < subs_.size(); ++i) {
+      mark(i, subs_[i]->initial_trials(ctx), &out);
+    }
+    return out;
+  }
+  std::vector<SearchAction> trial_created(SearchCtx& ctx, int64_t rid) override {
+    std::vector<SearchAction> out;
+    mark(owner_[rid], subs_[owner_[rid]]->trial_created(ctx, rid), &out);
+    return out;
+  }
+  std::vector<SearchAction> validation_completed(SearchCtx& ctx, int64_t rid,
+                                                 double metric, int64_t step) override {
+    std::vector<SearchAction> out;
+    mark(owner_[rid], subs_[owner_[rid]]->validation_completed(ctx, rid, metric, step), &out);
+    return out;
+  }
+  std::vector<SearchAction> trial_exited(SearchCtx& ctx, int64_t rid) override {
+    std::vector<SearchAction> out;
+    mark(owner_[rid], subs_[owner_[rid]]->trial_exited(ctx, rid), &out);
+    return out;
+  }
+  double progress() const override {
+    if (subs_.empty()) return 1.0;
+    double sum = 0;
+    for (const auto& s : subs_) sum += s->progress();
+    return sum / subs_.size();
+  }
+
+ private:
+  void mark(size_t sub, std::vector<SearchAction> actions,
+            std::vector<SearchAction>* out) {
+    for (auto& a : actions) {
+      if (a.kind == SearchAction::Kind::Create) {
+        owner_[a.request_id] = sub;
+        out->push_back(std::move(a));
+      } else if (a.kind == SearchAction::Kind::Shutdown) {
+        closed_[sub] = true;
+        if (std::all_of(closed_.begin(), closed_.end(), [](bool b) { return b; })) {
+          out->push_back(std::move(a));
+        }
+      } else {
+        out->push_back(std::move(a));
+      }
+    }
+  }
+
+  std::vector<std::unique_ptr<SearchMethod>> subs_;
+  std::map<int64_t, size_t> owner_;
+  std::vector<bool> closed_;
+};
+
+inline std::unique_ptr<SearchMethod> make_search_method(const Json& scfg,
+                                                        const Json& hparams) {
+  std::string name = scfg.contains("name") ? scfg["name"].as_string() : "single";
+  int max_trials = static_cast<int>(scfg["max_trials"].as_int(1));
+  int max_conc = static_cast<int>(scfg["max_concurrent_trials"].as_int(0));
+  int64_t max_time = scfg["max_time"].as_int(0);
+  if (max_time == 0 && scfg.contains("max_length")) {
+    const Json& ml = scfg["max_length"];
+    max_time = ml.is_number() ? ml.as_int()
+                              : (ml.contains("batches") ? ml["batches"].as_int()
+                                                        : ml["epochs"].as_int(100));
+  }
+  if (max_time == 0) max_time = 100;
+  int num_rungs = static_cast<int>(scfg["num_rungs"].as_int(5));
+  double divisor = scfg["divisor"].as_double(4.0);
+
+  if (name == "single") return std::make_unique<SingleSearch>();
+  if (name == "random") return std::make_unique<RandomSearch>(max_trials, max_conc ? max_conc : 16);
+  if (name == "grid") return std::make_unique<GridSearch>(hparams, max_conc ? max_conc : 16);
+  if (name == "asha") {
+    return std::make_unique<AshaSearch>(num_rungs, divisor, max_time, max_trials, max_conc);
+  }
+  if (name == "adaptive_asha") {
+    std::string mode = scfg.contains("mode") ? scfg["mode"].as_string() : "standard";
+    int capped = std::min({num_rungs,
+                           static_cast<int>(std::log(std::max<double>(max_time, 2)) /
+                                            std::log(divisor)) + 1,
+                           static_cast<int>(std::log(std::max<double>(max_trials, 2)) /
+                                            std::log(divisor)) + 1});
+    capped = std::max(capped, 1);
+    std::vector<int> bracket_rungs;
+    if (mode == "conservative") {
+      for (int i = 1; i <= capped; ++i) bracket_rungs.push_back(i);
+    } else if (mode == "aggressive") {
+      bracket_rungs.push_back(capped);
+    } else {
+      for (int i = (capped - 1) / 2 + 1; i <= capped; ++i) bracket_rungs.push_back(i);
+    }
+    std::sort(bracket_rungs.rbegin(), bracket_rungs.rend());
+    // budget-weighted trial split (adaptive_asha.go getBracketMaxTrials)
+    std::vector<double> weights;
+    double total = 0;
+    for (int nr : bracket_rungs) {
+      weights.push_back(std::pow(divisor, nr - 1) / nr);
+      total += weights.back();
+    }
+    std::vector<int> bracket_trials;
+    int allocated = 0;
+    for (double w : weights) {
+      bracket_trials.push_back(std::max(static_cast<int>(w / total * max_trials), 1));
+      allocated += bracket_trials.back();
+    }
+    bracket_trials[0] += std::max(max_trials - allocated, 0);
+    // concurrency split
+    size_t nb = bracket_rungs.size();
+    std::vector<int> bracket_conc(nb, 0);
+    if (max_conc == 0) {
+      int base = std::max(bracket_trials.back(), static_cast<int>(divisor));
+      for (auto& c : bracket_conc) c = base;
+    } else {
+      int mc = std::max<int>(max_conc, static_cast<int>(nb));
+      for (size_t i = 0; i < nb; ++i) bracket_conc[i] = mc / static_cast<int>(nb);
+      for (size_t i = 0; i < static_cast<size_t>(mc % static_cast<int>(nb)); ++i) ++bracket_conc[i];
+    }
+    std::vector<std::unique_ptr<SearchMethod>> subs;
+    for (size_t i = 0; i < nb; ++i) {
+      subs.push_back(std::make_unique<AshaSearch>(bracket_rungs[i], divisor, max_time,
+                                                  bracket_trials[i], bracket_conc[i]));
+    }
+    return std::make_unique<TournamentSearch>(std::move(subs));
+  }
+  return std::make_unique<SingleSearch>();
+}
+
+}  // namespace dtpu

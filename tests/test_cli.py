@@ -1,0 +1,74 @@
+"""CLI tests against a live devcluster (reference: harness/tests/cli)."""
+
+import os
+
+import pytest
+import yaml
+
+from determined_tpu.cli.main import main as cli_main
+
+from tests.test_devcluster import (  # noqa: F401  (fixture reuse)
+    AGENT_BIN,
+    MASTER_BIN,
+    DevCluster,
+    cluster,
+    exp_config,
+)
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(MASTER_BIN) and os.path.exists(AGENT_BIN)),
+    reason="native binaries not built",
+)
+
+
+def run_cli(*argv) -> int:
+    return cli_main(list(argv))
+
+
+def test_cli_experiment_lifecycle(cluster, tmp_path, capsys):
+    cfg_path = tmp_path / "exp.yaml"
+    cfg_path.write_text(yaml.safe_dump(exp_config(cluster.ckpt_dir)))
+    rc = run_cli("-m", cluster.url, "experiment", "create", str(cfg_path), "-f")
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Created experiment" in out and "COMPLETED" in out
+
+    rc = run_cli("-m", cluster.url, "experiment", "list")
+    assert rc == 0
+    assert "COMPLETED" in capsys.readouterr().out
+
+    rc = run_cli("-m", cluster.url, "trial", "logs", "1")
+    assert rc == 0
+    assert "trial finished" in capsys.readouterr().out
+
+    rc = run_cli("-m", cluster.url, "trial", "metrics", "1", "--group", "validation")
+    assert rc == 0
+    assert "validation_accuracy" in capsys.readouterr().out
+
+    rc = run_cli("-m", cluster.url, "agent", "list")
+    assert rc == 0
+    assert "agent-0" in capsys.readouterr().out
+
+    rc = run_cli("-m", cluster.url, "checkpoint", "list")
+    assert rc == 0
+    assert "UUID" in capsys.readouterr().out
+
+
+def test_cli_preview_search(tmp_path, capsys):
+    cfg = {
+        "hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -1}},
+        "searcher": {
+            "name": "adaptive_asha",
+            "metric": "loss",
+            "max_trials": 8,
+            "max_length": {"batches": 32},
+            "num_rungs": 3,
+            "divisor": 4,
+        },
+    }
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    rc = run_cli("preview-search", str(p))
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "trials created" in out and "adaptive_asha" in out

@@ -1,0 +1,64 @@
+"""Local experiment runner: ASHA search over real (tiny) training runs."""
+
+from determined_tpu.config import ExperimentConfig
+from determined_tpu.experiment import LocalExperiment
+from determined_tpu.models.mnist import MnistTrial
+
+
+def test_asha_search_over_mnist(tmp_path):
+    cfg = ExperimentConfig.parse(
+        {
+            "name": "asha-local",
+            "hyperparameters": {
+                "lr": {"type": "log", "minval": -4, "maxval": -1},
+                "hidden": 16,
+                "global_batch_size": 32,
+                "dataset_size": 128,
+            },
+            "searcher": {
+                "name": "asha",
+                "metric": "validation_accuracy",
+                "smaller_is_better": False,
+                "max_trials": 4,
+                "max_length": {"batches": 16},
+                "num_rungs": 2,
+                "divisor": 4,
+                "max_concurrent_trials": 2,
+            },
+            "resources": {"mesh": {"data": 2}},
+            "checkpoint_policy": "none",
+        }
+    )
+    exp = LocalExperiment(cfg, MnistTrial, checkpoint_dir=str(tmp_path / "ck"))
+    summary = exp.run()
+    assert summary["trials"] >= 4
+    assert summary["best_trial"] is not None
+    assert summary["best_metrics"]["validation_accuracy"] > 0.3
+    # at least one trial must have been early-stopped by ASHA (ran < 16 steps)
+    steps = [r.steps_completed for r in exp.results.values()]
+    assert min(steps) < 16 or len(steps) > 4
+
+
+def test_single_search_runs_one_trial(tmp_path):
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": {
+                "lr": 0.01,
+                "hidden": 16,
+                "global_batch_size": 32,
+                "dataset_size": 128,
+            },
+            "searcher": {
+                "name": "single",
+                "metric": "validation_accuracy",
+                "smaller_is_better": False,
+                "max_length": {"batches": 8},
+            },
+            "resources": {"mesh": {"data": 2}},
+            "checkpoint_policy": "none",
+        }
+    )
+    exp = LocalExperiment(cfg, MnistTrial, checkpoint_dir=str(tmp_path / "ck"))
+    summary = exp.run()
+    assert summary["trials"] == 1
+    assert exp.searcher.shutdown is not None
