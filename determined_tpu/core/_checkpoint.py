@@ -19,6 +19,7 @@ import contextlib
 import json
 import logging
 import os
+import shutil
 import tempfile
 import uuid as uuid_mod
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
@@ -214,18 +215,41 @@ class CheckpointContext:
         storage_id = self._dist.broadcast(
             str(uuid_mod.uuid4()) if self._dist.is_chief else None
         )
-        with self._storage.store_path(storage_id, self._staging_dir) as path:
-            yield path, storage_id
-            # On a shared fs every rank sees the same directory; wait until
-            # all ranks finished writing before listing/digesting, or one
-            # rank may hash another's half-written file.
-            self._dist.barrier()
-            resources = list_directory(path)
-            digests = {
-                p: file_md5(os.path.join(path, p))
-                for p in resources
-                if not p.endswith("/") and p != METADATA_FILE
-            }
+        if self._storage.direct_store:
+            # shared fs: every rank writes straight into the one durable dir
+            with self._storage.store_path(storage_id, self._staging_dir) as path:
+                yield path, storage_id
+                # All ranks see the same directory; wait until everyone
+                # finished writing before listing/digesting, or one rank may
+                # hash another's half-written file.  One reporter per host is
+                # enough — the dir holds every rank's files.
+                self._dist.barrier()
+                resources, digests = (
+                    self._list_and_digest(path)
+                    if self._dist.is_local_chief
+                    else ({}, {})
+                )
+        else:
+            # staged backend (cloud): all local ranks stage into ONE
+            # deterministic per-storage_id dir — collective array writers
+            # (orbax) require a single directory per host — then only the
+            # local chief lists/digests/uploads and cleans up, once per host.
+            path = self._storage.stage_path(storage_id, self._staging_dir)
+            try:
+                yield path, storage_id
+                self._dist.barrier()
+                resources, digests = (
+                    self._list_and_digest(path)
+                    if self._dist.is_local_chief
+                    else ({}, {})
+                )
+                if self._dist.is_local_chief:
+                    self._storage.upload(path, storage_id)
+                # uploads on every host must complete before any rank returns
+                self._dist.barrier()
+            finally:
+                if self._dist.is_local_chief:
+                    shutil.rmtree(path, ignore_errors=True)
         gathered = self._dist.gather((resources, digests, dict(metadata or {})))
         if self._dist.is_chief:
             assert gathered is not None
@@ -235,6 +259,19 @@ class CheckpointContext:
             merged_md = merge_metadata([g[2] for g in gathered])
             self._finalize(storage_id, merged, merged_md)
         self._dist.barrier()
+
+    def _list_and_digest(self, path: str):
+        # Called by local chiefs only: every rank on a host shares the
+        # directory, so one lister/digester per host avoids local_size×
+        # re-hashing of the full checkpoint; cross-host md5 conflict
+        # detection is preserved because each host still reports.
+        resources = list_directory(path)
+        digests = {
+            p: file_md5(os.path.join(path, p))
+            for p in resources
+            if not p.endswith("/") and p != METADATA_FILE
+        }
+        return resources, digests
 
     # -- read path ---------------------------------------------------------
 

@@ -10,6 +10,7 @@ stopped, survivors continue toward the next rung.  Rung r needs
 from __future__ import annotations
 
 import bisect
+import logging
 import math
 from typing import Any, Dict, List, Optional
 
@@ -22,6 +23,8 @@ from determined_tpu.searcher._base import (
     Stop,
     ExitedReason,
 )
+
+logger = logging.getLogger(__name__)
 
 ASHA_EXITED_METRIC = math.inf
 
@@ -113,7 +116,15 @@ class ASHASearch(SearchMethod):
             # teardown; re-inserting would duplicate rung entries and burn
             # the trial budget on spurious replacement creates
             return []
-        time_step, value = self._get_metric(metrics)
+        try:
+            time_step, value = self._get_metric(metrics)
+        except ValueError as e:
+            # A malformed report (missing searcher/time metric) must not
+            # abort the whole search; ignore it and let the trial keep
+            # running — matching the reference's graceful degradation.
+            logger.warning("ignoring unusable validation report for trial %s: %s",
+                           request_id, e)
+            return []
         actions = self._do_early_stopping(request_id, time_step, value)
         if any(isinstance(a, Stop) for a in actions):
             self.stopped_trials.add(request_id)

@@ -14,7 +14,6 @@ import contextlib
 import hashlib
 import os
 import shutil
-import tempfile
 from typing import Callable, Dict, Iterator, List, Optional
 
 
@@ -45,6 +44,10 @@ def list_directory(root: str) -> Dict[str, int]:
 
 class StorageManager(abc.ABC):
     """Upload/download whole checkpoint directories keyed by storage_id."""
+
+    # True when store_path/restore_path expose the durable directory itself
+    # (shared_fs): no staging copy, and every rank may use the same path.
+    direct_store = False
 
     @abc.abstractmethod
     def upload(
@@ -84,14 +87,30 @@ class StorageManager(abc.ABC):
         finally:
             shutil.rmtree(dst, ignore_errors=True)
 
+    def stage_path(self, storage_id: str, staging_dir: str) -> str:
+        """Deterministic per-storage_id staging dir.
+
+        Every local rank of a sharded checkpoint must stage into the SAME
+        directory (collective array writers like orbax assume one directory
+        per host); storage_id is a fresh uuid so ids never collide.  The
+        caller owns upload and cleanup coordination across ranks —
+        CheckpointContext.store_path(shard=True) does that.
+        """
+        path = os.path.join(staging_dir, storage_id)
+        os.makedirs(path, exist_ok=True)
+        return path
+
     # Backends that expose checkpoints as plain paths (shared_fs) override
     # store_path to avoid the copy; default stages then uploads.
     @contextlib.contextmanager
     def store_path(self, storage_id: str, staging_dir: str) -> Iterator[str]:
-        # Stage in a per-process unique dir: storage_id is broadcast, so
-        # multiple local ranks sharing staging_dir must not collide.
-        os.makedirs(staging_dir, exist_ok=True)
-        src = tempfile.mkdtemp(prefix=f"{storage_id}-", dir=staging_dir)
+        """Single-process staging: stage, upload on success, clean up.
+
+        Only one process may use this per storage_id; multi-rank sharded
+        staging goes through CheckpointContext, which sequences upload and
+        cleanup across ranks on top of stage_path().
+        """
+        src = self.stage_path(storage_id, staging_dir)
         try:
             yield src
             self.upload(src, storage_id)

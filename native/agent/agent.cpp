@@ -178,9 +178,14 @@ class Agent {
     }
     // graceful SIGTERM (harness checkpoints on it), SIGKILL after grace
     ::kill(-pid, SIGTERM);
-    std::thread([pid] {
+    std::thread([this, alloc_id, pid] {
       std::this_thread::sleep_for(std::chrono::seconds(15));
-      ::kill(-pid, SIGKILL);
+      // only escalate if this exact allocation/pid is still running; the pid
+      // may have been reaped (and even reused by the OS) during the grace
+      // period, in which case SIGKILL could hit an unrelated process group
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = running_.find(alloc_id);
+      if (it != running_.end() && it->second == pid) ::kill(-pid, SIGKILL);
     }).detach();
   }
 

@@ -145,8 +145,13 @@ class Master {
       do_validation(ev["trial_id"].as_int(), ev["metric"].as_double(),
                     ev["step"].as_int(), /*from_replay=*/true);
     } else if (type == "trial_exited") {
-      do_trial_exited(ev["trial_id"].as_int(), static_cast<int>(ev["exit_code"].as_int()),
-                      /*from_replay=*/true);
+      // Journal compat: journals written before trial_restarted existed
+      // recorded restart-exits as trial_exited too; replaying those marks
+      // the trial ERROR instead of restarting it.  Journals are not
+      // portable across that format change (pre-release; no migration).
+      do_trial_exited(ev["trial_id"].as_int(), static_cast<int>(ev["exit_code"].as_int()));
+    } else if (type == "trial_restarted") {
+      do_trial_restarted(ev["trial_id"].as_int());
     } else if (type == "checkpoint") {
       checkpoints_[ev["uuid"].as_string()] = ev;
       auto it = trials_.find(ev["trial_id"].as_int());
@@ -262,19 +267,54 @@ class Master {
     handle_actions(exp, actions);
   }
 
-  void do_trial_exited(int64_t trial_id, int exit_code, bool from_replay) {
+  // Live entry point for a trial process exit.  The restart-vs-terminal
+  // decision is recorded as its own journal event so that replay follows the
+  // exact same code path as live execution and searcher callbacks fire
+  // exactly once per logical trial exit (no double-counted closures after a
+  // master restart).
+  void on_trial_exit(int64_t trial_id, int exit_code) {
     auto tit = trials_.find(trial_id);
     if (tit == trials_.end()) return;
     TrialState& t = tit->second;
     auto eit = experiments_.find(t.experiment_id);
+    if (eit == experiments_.end()) return;
     ExperimentState& exp = eit->second;
-
-    if (!from_replay) {
+    bool restart =
+        exit_code != 0 && exp.state != "PAUSED" && t.restarts < exp.max_restarts;
+    if (restart) {
+      record(Json::object()
+                 .set("type", "trial_restarted")
+                 .set("trial_id", Json(trial_id))
+                 .set("exit_code", Json(exit_code)));
+      do_trial_restarted(trial_id);
+    } else {
       record(Json::object()
                  .set("type", "trial_exited")
                  .set("trial_id", Json(trial_id))
                  .set("exit_code", Json(exit_code)));
+      do_trial_exited(trial_id, exit_code);
     }
+    if (!replaying_) schedule();
+  }
+
+  void do_trial_restarted(int64_t trial_id) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return;
+    TrialState& t = tit->second;
+    end_allocation(t.allocation_id);
+    ++t.restarts;
+    ++t.run_id;
+    t.state = "PENDING";
+    t.allocation_id.clear();
+  }
+
+  void do_trial_exited(int64_t trial_id, int exit_code) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return;
+    TrialState& t = tit->second;
+    auto eit = experiments_.find(t.experiment_id);
+    if (eit == experiments_.end()) return;
+    ExperimentState& exp = eit->second;
     end_allocation(t.allocation_id);
 
     if (exit_code == 0) {
@@ -285,17 +325,11 @@ class Master {
       // preempted by pause: back to pending, resumed on activate
       t.state = "PENDING";
       t.allocation_id.clear();
-    } else if (t.restarts < exp.max_restarts && !from_replay) {
-      ++t.restarts;
-      ++t.run_id;
-      t.state = "PENDING";
-      t.allocation_id.clear();
     } else {
       t.state = "ERROR";
       auto actions = exp.method->trial_exited(*exp.ctx, t.request_id);
       handle_actions(exp, actions);
     }
-    if (!replaying_) schedule();
   }
 
   // ---- scheduler (priority FIFO + gang fitting) --------------------------
@@ -770,7 +804,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         body["allocation_id"].as_string() != it->second.allocation_id) {
       return R::json("{\"stale\":true}");
     }
-    m.do_trial_exited(tid, static_cast<int>(body["exit_code"].as_int(0)), false);
+    m.on_trial_exit(tid, static_cast<int>(body["exit_code"].as_int(0)));
     return R::json("{}");
   });
 

@@ -113,6 +113,42 @@ def test_sharded_store_path(tmp_path):
     assert {"part-0", "part-1", "metadata.json"} <= set(files)
 
 
+class _StagedStorageManager(SharedFSStorageManager):
+    """Blob-store stand-in: same file layout but staged (no direct paths)."""
+
+    direct_store = False
+
+
+def test_sharded_store_path_staged_backend(tmp_path):
+    """Cloud-style backends stage all local ranks into ONE deterministic
+    per-storage_id dir (collective writers like orbax need a single dir per
+    host); only the local chief uploads, and staging is cleaned up."""
+    store = str(tmp_path / "store")
+    stage = str(tmp_path / "stage")
+
+    def fn(dist, rank):
+        ctx = CheckpointContext(
+            dist, _StagedStorageManager(store), staging_dir=stage
+        )
+        with ctx.store_path(metadata={"rank": rank} if rank == 0 else None,
+                            shard=True) as (path, uuid):
+            # both local ranks must see the same staging directory
+            _write(os.path.join(path, f"part-{rank}"), str(rank))
+            assert os.path.basename(path) == uuid
+        return uuid, path
+
+    results = Execution(2, local_size=2).run(fn)
+    uuids = {u for u, _ in results}
+    paths = {p for _, p in results}
+    assert len(uuids) == 1 and len(paths) == 1
+    uuid = uuids.pop()
+    mgr = _StagedStorageManager(store)
+    files = mgr.list_files(uuid)
+    assert {"part-0", "part-1", "metadata.json"} <= set(files)
+    # staging dir was cleaned up by the local chief
+    assert not os.path.exists(paths.pop())
+
+
 def test_non_chief_plain_upload_raises(tmp_path):
     def fn(dist, rank):
         ctx = CheckpointContext(dist, SharedFSStorageManager(str(tmp_path / "s")))

@@ -283,3 +283,16 @@ def test_trial_restart_after_kill(cluster, tmp_path):
     final = cluster.wait_for_state(exp_id, timeout=240)
     assert final["state"] == "COMPLETED"
     assert final["trials"][0]["restarts"] >= 1
+
+    # Replay fidelity: the restart decision is its own journal event
+    # (trial_restarted), so a fresh master replaying the journal must
+    # reconstruct the same trial state as live execution — same restart
+    # count, same terminal state, no double-fired searcher closures.
+    restarts_live = final["trials"][0]["restarts"]
+    cluster.procs["master"].send_signal(signal.SIGKILL)
+    cluster.procs["master"].wait(timeout=5)
+    cluster.start_master()
+    replayed = requests.get(f"{cluster.url}/api/v1/experiments/{exp_id}").json()
+    assert replayed["state"] == "COMPLETED"
+    assert replayed["trials"][0]["state"] == "COMPLETED"
+    assert replayed["trials"][0]["restarts"] == restarts_live
