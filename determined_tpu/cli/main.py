@@ -50,7 +50,16 @@ def exp_create(args) -> int:
     from determined_tpu.config.experiment import ExperimentConfig
 
     ExperimentConfig.parse(dict(config))
-    resp = _session(args).post("/api/v1/experiments", json={"config": config})
+    body: Dict[str, Any] = {"config": config}
+    if getattr(args, "context_dir", None):
+        import base64
+
+        from determined_tpu.common import build_context
+
+        data = build_context(args.context_dir)
+        body["context"] = base64.b64encode(data).decode("ascii")
+        print(f"context: {args.context_dir} ({len(data)} bytes packed)")
+    resp = _session(args).post("/api/v1/experiments", json=body)
     exp_id = resp.json()["id"]
     print(f"Created experiment {exp_id}")
     if args.follow:
@@ -216,6 +225,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     c = exp.add_parser("create")
     c.add_argument("config")
+    c.add_argument(
+        "context_dir",
+        nargs="?",
+        help="model-code directory shipped to the cluster (.detignore honored)",
+    )
     c.add_argument("-f", "--follow", action="store_true")
     c.set_defaults(fn=exp_create)
     exp.add_parser("list").set_defaults(fn=exp_list)

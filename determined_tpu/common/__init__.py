@@ -1,0 +1,13 @@
+from determined_tpu.common.context import (
+    build_context,
+    extract_context,
+    read_detignore,
+    ContextTooLargeError,
+)
+
+__all__ = [
+    "build_context",
+    "extract_context",
+    "read_detignore",
+    "ContextTooLargeError",
+]

@@ -34,6 +34,44 @@ def _apply_environment_early() -> None:
         os.environ.setdefault(str(k), str(v))
 
 
+def _prepare_context(logger) -> None:
+    """Download + unpack the experiment's context directory, then chdir in.
+
+    The analog of the reference's ``prep_container
+    --download_context_directory`` (``exec/prep_container.py:28-46``): user
+    code submitted with the experiment becomes the working directory of the
+    trial process, so the entrypoint import resolves against it.
+    """
+    ctx_url = os.environ.get("DTPU_CONTEXT_URL")
+    master = os.environ.get("DTPU_MASTER_URL")
+    if not ctx_url or not master:
+        return
+    import tempfile
+    import time
+    import urllib.request
+
+    from determined_tpu.common import extract_context
+
+    url = master.rstrip("/") + ctx_url
+    data = None
+    for attempt in range(4):
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                data = resp.read()
+            break
+        except Exception as e:  # noqa: BLE001 - transient master hiccups
+            if attempt == 3:
+                raise RuntimeError(f"context download failed from {url}: {e}") from e
+            logger.warning("context download attempt %d failed (%s); retrying", attempt + 1, e)
+            time.sleep(2 * (attempt + 1))
+    workdir = tempfile.mkdtemp(
+        prefix=f"dtpu-ctx-{os.environ.get('DTPU_ALLOCATION_ID', 'alloc')}-"
+    )
+    extract_context(data, workdir)
+    os.chdir(workdir)
+    logger.info("context: unpacked %d bytes into %s", len(data), workdir)
+
+
 def main() -> int:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s [%(levelname)s] %(name)s: %(message)s"
@@ -74,6 +112,7 @@ def main() -> int:
 
     exp_config = ExperimentConfig.parse(cluster.exp_config or {})
     module_name, _, class_name = sys.argv[1].partition(":")
+    _prepare_context(logger)
     sys.path.insert(0, os.getcwd())
     trial_cls = getattr(importlib.import_module(module_name), class_name)
 

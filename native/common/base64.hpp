@@ -1,0 +1,82 @@
+// Minimal base64 encode/decode (RFC 4648, no line wrapping).
+// Used to carry the experiment context tarball inside the JSON create
+// request (one protocol end to end instead of multipart).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace dtpu {
+
+inline const char* b64_alphabet() {
+  return "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+}
+
+inline std::string base64_encode(const std::string& in) {
+  const char* tbl = b64_alphabet();
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    uint32_t v = (static_cast<uint8_t>(in[i]) << 16) |
+                 (static_cast<uint8_t>(in[i + 1]) << 8) |
+                 static_cast<uint8_t>(in[i + 2]);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += tbl[v & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    uint32_t v = static_cast<uint8_t>(in[i]) << 16;
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    uint32_t v = (static_cast<uint8_t>(in[i]) << 16) |
+                 (static_cast<uint8_t>(in[i + 1]) << 8);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
+// returns false on any non-base64 or truncated input (whitespace skipped).
+// Strict: symbol count mod 4 must not be 1 and leftover bits must be zero,
+// so a payload truncated in transit is rejected instead of silently
+// decoding to corrupt bytes.
+inline bool base64_decode(const std::string& in, std::string* out) {
+  struct RevTable {
+    int8_t rev[256];
+    RevTable() {
+      for (int i = 0; i < 256; ++i) rev[i] = -1;
+      const char* tbl = b64_alphabet();
+      for (int i = 0; i < 64; ++i) rev[static_cast<uint8_t>(tbl[i])] = static_cast<int8_t>(i);
+    }
+  };
+  static const RevTable table;  // magic static: thread-safe init
+  out->clear();
+  out->reserve(in.size() / 4 * 3);
+  uint32_t acc = 0;
+  int bits = 0;
+  size_t symbols = 0;
+  for (char c : in) {
+    if (c == '=' || c == '\n' || c == '\r' || c == ' ') continue;
+    int8_t v = table.rev[static_cast<uint8_t>(c)];
+    if (v < 0) return false;
+    ++symbols;
+    acc = (acc << 6) | static_cast<uint32_t>(v);
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out->push_back(static_cast<char>((acc >> bits) & 0xFF));
+    }
+  }
+  if (symbols % 4 == 1) return false;                       // impossible length
+  if (bits > 0 && (acc & ((1u << bits) - 1)) != 0) return false;  // dirty tail
+  return true;
+}
+
+}  // namespace dtpu

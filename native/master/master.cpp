@@ -22,6 +22,7 @@
 #include <condition_variable>
 #include <cstdio>
 #include <deque>
+#include <filesystem>
 #include <fstream>
 #include <iostream>
 #include <map>
@@ -32,6 +33,7 @@
 #include <string>
 #include <vector>
 
+#include "../common/base64.hpp"
 #include "../common/http.hpp"
 #include "../common/json.hpp"
 #include "searcher.hpp"
@@ -63,6 +65,9 @@ struct AllocationState {
   bool preempt = false;
   bool acked = false;
   bool ended = false;
+  // jax.distributed coordinator endpoint, released with the allocation
+  std::string coord_host;
+  int coord_port = 0;
 };
 
 struct TrialState {
@@ -388,7 +393,17 @@ class Master {
       int num_nodes = static_cast<int>(groups.size());
       const std::string& coord_host =
           agents_[groups[0].first].host.empty() ? "127.0.0.1" : agents_[groups[0].first].host;
-      int coord_port = 17000 + static_cast<int>(tid % 2000);
+      // lowest free coordinator port on that host, held until the
+      // allocation ends (the old tid-mod scheme collided for concurrent
+      // trials 2000 ids apart / long-lived clusters)
+      int coord_port = 17000;
+      {
+        auto& used = coord_ports_in_use_[coord_host];
+        while (used.count(coord_port)) ++coord_port;
+        used.insert(coord_port);
+        allocations_[alloc_id].coord_host = coord_host;
+        allocations_[alloc_id].coord_port = coord_port;
+      }
       int node_rank = 0;
       for (auto& [aid, slots] : groups) {
         AgentState& ag = agents_[aid];
@@ -411,6 +426,11 @@ class Master {
         rendezvous.set("num_nodes", Json(num_nodes));
         rendezvous.set("node_rank", Json(node_rank));
         env.set("DTPU_RENDEZVOUS", rendezvous.dump());
+
+        if (std::filesystem::exists(context_path(exp.id))) {
+          env.set("DTPU_CONTEXT_URL",
+                  "/api/v1/experiments/" + std::to_string(exp.id) + "/context");
+        }
 
         Json work = Json::object();
         work.set("type", "launch");
@@ -444,6 +464,9 @@ class Master {
       if (ait != agents_.end()) {
         ait->second.used_slots = std::max(0, ait->second.used_slots - slots);
       }
+    }
+    if (it->second.coord_port) {
+      coord_ports_in_use_[it->second.coord_host].erase(it->second.coord_port);
     }
   }
 
@@ -514,6 +537,33 @@ class Master {
   std::map<std::string, Json> checkpoints_;
   std::vector<Json> metrics_;
   std::map<int64_t, std::vector<Json>> logs_;  // trial_id -> lines
+  std::map<std::string, std::set<int>> coord_ports_in_use_;  // host -> ports
+
+  // experiment context tarballs live on disk next to the journal; they
+  // survive master restarts without bloating the event journal
+  std::string context_path(int64_t exp_id) const {
+    return state_dir_ + "/contexts/exp_" + std::to_string(exp_id) + ".tgz";
+  }
+
+  // write the tarball to contexts/tmp-<n>.tgz; the caller renames it to its
+  // experiment id once the experiment exists.  Lock-free (atomic counter).
+  bool stage_context(const std::string& data, std::string* tmp_path) {
+    static std::atomic<uint64_t> stage_counter{0};
+    std::error_code ec;
+    std::filesystem::create_directories(state_dir_ + "/contexts", ec);
+    *tmp_path = state_dir_ + "/contexts/tmp-" +
+                std::to_string(stage_counter.fetch_add(1)) + "-" +
+                std::to_string(::getpid()) + ".tgz";
+    std::ofstream out(*tmp_path, std::ios::binary | std::ios::trunc);
+    if (!out) return false;
+    out.write(data.data(), static_cast<std::streamsize>(data.size()));
+    out.close();
+    if (!out) {
+      std::filesystem::remove(*tmp_path, ec);
+      return false;
+    }
+    return true;
+  }
 
   friend void install_routes_impl(Master&, HttpServer&);
 };
@@ -542,13 +592,53 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     const Json& config = body.contains("config") ? body["config"] : body;
+    // decode + write the context tarball to a temp file BEFORE creating the
+    // experiment and WITHOUT the master lock: disk errors fail the request
+    // cleanly (no ghost experiment), and a 64MB write never stalls agent
+    // polls/scheduling.  The per-id rename under the lock is trivial.
+    std::string context_tmp;
+    if (body.contains("context") && body["context"].is_string()) {
+      std::string context_bytes;
+      if (!base64_decode(body["context"].as_string(), &context_bytes)) {
+        return R::error(400, "context is not valid base64");
+      }
+      if (!m.stage_context(context_bytes, &context_tmp)) {
+        return R::error(500, "failed to store context");
+      }
+    }
     std::lock_guard<std::mutex> lk(m.mu_);
     int64_t id = m.do_create_experiment(config);
+    if (!context_tmp.empty()) {
+      std::error_code ec;
+      std::filesystem::rename(context_tmp, m.context_path(id), ec);
+      if (ec) {
+        // same-directory rename after a successful staged write: effectively
+        // unreachable, but don't leave a half-created experiment journaled
+        std::filesystem::remove(context_tmp, ec);
+        return R::error(500, "failed to finalize context");
+      }
+    }
     m.record(Json::object().set("type", "exp_created").set("id", Json(id)).set("config", config));
     m.schedule();
     Json out = Json::object();
     out.set("id", Json(id));
     return R::json(out.dump(), 201);
+  });
+
+  srv.route("GET", "/api/v1/experiments/{id}/context", [&m](const HttpRequest& req) {
+    std::string path;
+    {
+      std::lock_guard<std::mutex> lk(m.mu_);
+      path = m.context_path(std::stoll(req.params.at("id")));
+    }
+    std::ifstream in(path, std::ios::binary);
+    if (!in) return R::error(404, "no context for experiment");
+    std::ostringstream data;
+    data << in.rdbuf();
+    HttpResponse resp;
+    resp.content_type = "application/gzip";
+    resp.body = data.str();
+    return resp;
   });
 
   srv.route("GET", "/api/v1/experiments", [&m](const HttpRequest&) {

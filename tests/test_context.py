@@ -1,0 +1,113 @@
+"""Context-directory packaging tests (reference: common/context.py,
+detignore.py, prep_container context download)."""
+
+import io
+import os
+import tarfile
+
+import pytest
+
+from determined_tpu.common import (
+    ContextTooLargeError,
+    build_context,
+    extract_context,
+    read_detignore,
+)
+
+
+def _write(path, content="x"):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(content)
+
+
+def _names(data):
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+        return sorted(m.name for m in tar.getmembers())
+
+
+def test_build_and_extract_roundtrip(tmp_path):
+    root = tmp_path / "ctx"
+    _write(str(root / "model.py"), "MODEL = 1")
+    _write(str(root / "pkg" / "__init__.py"), "")
+    _write(str(root / "pkg" / "data.py"), "D = 2")
+    data = build_context(str(root))
+    dst = tmp_path / "out"
+    extract_context(data, str(dst))
+    assert (dst / "model.py").read_text() == "MODEL = 1"
+    assert (dst / "pkg" / "data.py").read_text() == "D = 2"
+
+
+def test_detignore_patterns(tmp_path):
+    root = tmp_path / "ctx"
+    _write(str(root / "keep.py"))
+    _write(str(root / "secret.env"))
+    _write(str(root / "data" / "big.bin"))
+    _write(str(root / "logs" / "x.log"))
+    _write(str(root / ".detignore"), "*.env\ndata/\n*.log\n# comment\n\n")
+    names = _names(build_context(str(root)))
+    assert "keep.py" in names
+    assert "secret.env" not in names
+    assert not any(n.startswith("data") for n in names)
+    assert "logs/x.log" not in names
+    assert ".detignore" not in names
+
+
+def test_default_ignores(tmp_path):
+    root = tmp_path / "ctx"
+    _write(str(root / "a.py"))
+    _write(str(root / "__pycache__" / "a.cpython-313.pyc"))
+    _write(str(root / ".git" / "HEAD"))
+    _write(str(root / "b.pyc"))
+    names = _names(build_context(str(root)))
+    assert names == ["a.py"]
+
+
+def test_deterministic_bytes(tmp_path):
+    root = tmp_path / "ctx"
+    _write(str(root / "m.py"), "x = 1")
+    assert build_context(str(root)) == build_context(str(root))
+
+
+def test_size_cap(tmp_path):
+    root = tmp_path / "ctx"
+    _write(str(root / "big.txt"), os.urandom(64).hex() * 100)
+    with pytest.raises(ContextTooLargeError):
+        build_context(str(root), max_size=64)
+
+
+def test_extract_rejects_traversal(tmp_path):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        info = tarfile.TarInfo("../evil.txt")
+        payload = b"evil"
+        info.size = len(payload)
+        tar.addfile(info, io.BytesIO(payload))
+    with pytest.raises(RuntimeError, match="escapes"):
+        extract_context(buf.getvalue(), str(tmp_path / "dst"))
+    assert not (tmp_path / "evil.txt").exists()
+
+
+def test_read_detignore_missing(tmp_path):
+    assert read_detignore(str(tmp_path)) == []
+
+
+def test_in_tree_symlink_dir_roundtrips(tmp_path):
+    root = tmp_path / "ctx"
+    _write(str(root / "real" / "mod.py"), "M = 3")
+    os.symlink("real", str(root / "alias"))
+    data = build_context(str(root))
+    dst = tmp_path / "out"
+    extract_context(data, str(dst))
+    assert (dst / "alias" / "mod.py").read_text() == "M = 3"
+
+
+def test_out_of_tree_symlink_dir_warns(tmp_path):
+    ext = tmp_path / "shared"
+    _write(str(ext / "mod.py"), "M = 4")
+    root = tmp_path / "ctx"
+    _write(str(root / "keep.py"))
+    os.symlink(str(ext), str(root / "shared_pkg"))
+    with pytest.warns(UserWarning, match="outside"):
+        data = build_context(str(root))
+    assert "shared_pkg" not in _names(data)

@@ -257,6 +257,48 @@ def test_gang_spans_agents(tmp_path):
         c.stop()
 
 
+def test_context_directory_ships_user_code(cluster, tmp_path):
+    """Submit an experiment whose Trial class exists ONLY in a local context
+    dir (not importable on the agent's default path): the master stores the
+    tarball, the trial process downloads/unpacks it, and training runs the
+    user's code (reference: context.py upload + prep_container download)."""
+    import base64
+
+    from determined_tpu.common import build_context
+
+    ctx_dir = tmp_path / "user-code"
+    ctx_dir.mkdir()
+    (ctx_dir / "my_custom_model.py").write_text(
+        "from determined_tpu.models.mnist import MnistTrial\n"
+        "class UserTrial(MnistTrial):\n"
+        "    MARKER = 'user-context-code'\n"
+    )
+    (ctx_dir / ".detignore").write_text("*.secret\n")
+    (ctx_dir / "creds.secret").write_text("do-not-ship")
+
+    cfg = exp_config(cluster.ckpt_dir)
+    cfg["entrypoint"] = "my_custom_model:UserTrial"
+    payload = base64.b64encode(build_context(str(ctx_dir))).decode()
+    r = requests.post(
+        cluster.url + "/api/v1/experiments", json={"config": cfg, "context": payload}
+    )
+    assert r.status_code == 201, r.text
+    exp_id = r.json()["id"]
+
+    # master serves the stored context back, minus detignored files
+    ctx = requests.get(f"{cluster.url}/api/v1/experiments/{exp_id}/context")
+    assert ctx.status_code == 200
+    import io
+    import tarfile
+
+    names = {m.name for m in tarfile.open(fileobj=io.BytesIO(ctx.content)).getmembers()}
+    assert "my_custom_model.py" in names and "creds.secret" not in names
+
+    final = cluster.wait_for_state(exp_id)
+    assert final["state"] == "COMPLETED"
+    assert final["trials"][0]["state"] == "COMPLETED"
+
+
 def test_trial_restart_after_kill(cluster, tmp_path):
     """Kill the trial process mid-run: master must reschedule (max_restarts)."""
     cfg = exp_config(cluster.ckpt_dir)
