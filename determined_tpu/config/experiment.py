@@ -123,6 +123,7 @@ class ResourcesConfig:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     resource_pool: str = "default"
     priority: int = 42                            # reference default priority
+    single_slice: bool = False                    # refuse DCN-spanning gang splits
 
     @classmethod
     def parse(cls, raw: Dict[str, Any]) -> "ResourcesConfig":

@@ -36,6 +36,7 @@ struct Options {
   int master_port = 8080;
   std::string id = "agent-1";
   std::string advertised_host = "127.0.0.1";
+  std::string pool = "default";
   int slots = 1;
   std::string python = "python";
 };
@@ -79,6 +80,7 @@ class Agent {
     Json body = Json::object();
     body.set("id", opts_.id);
     body.set("host", opts_.advertised_host);
+    body.set("pool", opts_.pool);
     body.set("slots", Json(opts_.slots));
     auto resp = http_request(opts_.master_host, opts_.master_port, "POST",
                              "/api/v1/agents", body.dump(), 10);
@@ -209,6 +211,7 @@ int main(int argc, char** argv) {
     else if (arg == "--master-port") opts.master_port = std::atoi(next("--master-port").c_str());
     else if (arg == "--id") opts.id = next("--id");
     else if (arg == "--host") opts.advertised_host = next("--host");
+    else if (arg == "--pool") opts.pool = next("--pool");
     else if (arg == "--slots") opts.slots = std::atoi(next("--slots").c_str());
     else if (arg == "--python") opts.python = next("--python");
     else { fprintf(stderr, "unknown arg %s\n", arg.c_str()); return 2; }

@@ -51,6 +51,7 @@ static int64_t now_ms() {
 struct AgentState {
   std::string id;
   std::string host;
+  std::string pool = "default";  // resource pool membership
   int slots = 0;
   int used_slots = 0;
   int64_t last_seen_ms = 0;
@@ -80,7 +81,8 @@ struct TrialState {
   std::string latest_checkpoint;
   std::string allocation_id;
   int64_t run_id = 0;
-  bool stop_requested = false;  // searcher decided to stop it
+  bool stop_requested = false;   // searcher decided to stop it
+  bool sched_preempted = false;  // scheduler preempted it for a higher-pri gang
 };
 
 struct ExperimentState {
@@ -93,6 +95,9 @@ struct ExperimentState {
   bool searcher_shutdown = false;
   std::map<int64_t, int64_t> rid_to_trial;
   int slots_per_trial = 1;
+  int priority = 42;                    // lower number = higher priority
+  std::string resource_pool = "default";
+  bool single_slice = false;            // refuse DCN-spanning gang splits
   int max_restarts = 5;
   std::string metric = "validation_loss";
   bool smaller_is_better = true;
@@ -157,6 +162,8 @@ class Master {
       do_trial_exited(ev["trial_id"].as_int(), static_cast<int>(ev["exit_code"].as_int()));
     } else if (type == "trial_restarted") {
       do_trial_restarted(ev["trial_id"].as_int());
+    } else if (type == "trial_yielded") {
+      do_trial_yielded(ev["trial_id"].as_int());
     } else if (type == "checkpoint") {
       checkpoints_[ev["uuid"].as_string()] = ev;
       auto it = trials_.find(ev["trial_id"].as_int());
@@ -195,6 +202,11 @@ class Master {
     } else {
       exp.slots_per_trial = static_cast<int>(res["slots_per_trial"].as_int(1));
     }
+    exp.priority = static_cast<int>(res["priority"].as_int(42));
+    if (res.contains("resource_pool") && res["resource_pool"].is_string()) {
+      exp.resource_pool = res["resource_pool"].as_string();
+    }
+    exp.single_slice = res["single_slice"].as_bool(false);
     uint64_t seed = static_cast<uint64_t>(config["reproducibility"]["experiment_seed"].as_int(0));
     exp.ctx = std::make_unique<SearchCtx>(config["hyperparameters"],
                                           seed ^ static_cast<uint64_t>(id));
@@ -284,9 +296,17 @@ class Master {
     auto eit = experiments_.find(t.experiment_id);
     if (eit == experiments_.end()) return;
     ExperimentState& exp = eit->second;
+    bool yielded = t.sched_preempted && exit_code == 0 && !t.stop_requested;
     bool restart =
         exit_code != 0 && exp.state != "PAUSED" && t.restarts < exp.max_restarts;
-    if (restart) {
+    if (yielded) {
+      // preempted by the scheduler for a higher-priority gang: the harness
+      // checkpointed and exited cleanly; back to PENDING, no restart burned
+      record(Json::object()
+                 .set("type", "trial_yielded")
+                 .set("trial_id", Json(trial_id)));
+      do_trial_yielded(trial_id);
+    } else if (restart) {
       record(Json::object()
                  .set("type", "trial_restarted")
                  .set("trial_id", Json(trial_id))
@@ -311,6 +331,18 @@ class Master {
     ++t.run_id;
     t.state = "PENDING";
     t.allocation_id.clear();
+    t.sched_preempted = false;
+  }
+
+  void do_trial_yielded(int64_t trial_id) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return;
+    TrialState& t = tit->second;
+    end_allocation(t.allocation_id);
+    ++t.run_id;
+    t.state = "PENDING";
+    t.allocation_id.clear();
+    t.sched_preempted = false;
   }
 
   void do_trial_exited(int64_t trial_id, int exit_code) {
@@ -322,6 +354,7 @@ class Master {
     ExperimentState& exp = eit->second;
     end_allocation(t.allocation_id);
 
+    t.sched_preempted = false;
     if (exit_code == 0) {
       t.state = t.stop_requested ? "STOPPED" : "COMPLETED";
       auto actions = exp.method->trial_exited(*exp.ctx, t.request_id);
@@ -339,48 +372,120 @@ class Master {
 
   // ---- scheduler (priority FIFO + gang fitting) --------------------------
 
+  // Gang fitting for TPU topology (reference fitting.go, redesigned):
+  // slots on ONE agent are an ICI-connected slice, so a single-agent
+  // best-fit (fewest leftover slots) is always preferred; spanning agents
+  // means the gang's collectives ride DCN, allowed only when the trial
+  // does not require a single slice, splitting over the fewest agents
+  // (largest-free first).  ``extra_free`` overlays hypothetical capacity
+  // (slots of preemption victims that have not exited yet) so preemption
+  // decisions can test feasibility without mutating agent state.
+  std::vector<std::pair<std::string, int>> find_fit(
+      const std::string& pool, int needed, bool single_slice,
+      const std::map<std::string, int>& extra_free) {
+    auto free_of = [&](const AgentState& ag) {
+      int extra = 0;
+      auto it = extra_free.find(ag.id);
+      if (it != extra_free.end()) extra = it->second;
+      return ag.slots - ag.used_slots + extra;
+    };
+    AgentState* best = nullptr;
+    for (auto& [aid, ag] : agents_) {
+      if (ag.pool != pool) continue;
+      int free = free_of(ag);
+      if (free >= needed && (best == nullptr || free < free_of(*best))) {
+        best = &ag;
+      }
+    }
+    if (best != nullptr) return {{best->id, needed}};
+    if (single_slice) return {};
+    int remaining = needed;
+    std::vector<AgentState*> by_free;
+    for (auto& [aid, ag] : agents_) {
+      if (ag.pool == pool) by_free.push_back(&ag);
+    }
+    std::sort(by_free.begin(), by_free.end(),
+              [&](AgentState* a, AgentState* b) { return free_of(*a) > free_of(*b); });
+    std::vector<std::pair<std::string, int>> groups;
+    for (auto* ag : by_free) {
+      int free = free_of(*ag);
+      if (free <= 0) continue;
+      int take = std::min(free, remaining);
+      groups.push_back({ag->id, take});
+      remaining -= take;
+      if (remaining == 0) break;
+    }
+    if (remaining > 0) return {};
+    return groups;
+  }
+
+  // Priority scheduler with preemption (reference priority.go:18-359,
+  // redesigned event-driven): pending trials sorted by (priority, id) —
+  // lower number is higher priority, default 42 — are placed per resource
+  // pool; when a higher-priority trial cannot fit, the cheapest set of
+  // strictly-lower-priority running trials whose slots make it fit is
+  // preempted gracefully (the harness checkpoints and yields; the victim
+  // returns to PENDING without burning a restart and resumes later from
+  // its checkpoint).
   void schedule() {
-    // pending trials of active experiments, FIFO by trial id
+    std::vector<std::pair<int, int64_t>> pending;  // (priority, trial id)
     for (auto& [tid, t] : trials_) {
       if (t.state != "PENDING") continue;
       auto eit = experiments_.find(t.experiment_id);
       if (eit == experiments_.end() || eit->second.state != "ACTIVE") continue;
-      ExperimentState& exp = eit->second;
+      pending.push_back({eit->second.priority, tid});
+    }
+    std::sort(pending.begin(), pending.end());
+    for (auto& [pri, tid] : pending) {
+      TrialState& t = trials_[tid];
+      ExperimentState& exp = experiments_[t.experiment_id];
       int needed = exp.slots_per_trial;
-
-      // best fit: the single agent with the fewest free slots that still
-      // fits the whole gang (reference fitting.go BestFit); else split the
-      // gang over multiple agents (largest-free first)
-      AgentState* best = nullptr;
-      for (auto& [aid, ag] : agents_) {
-        int free = ag.slots - ag.used_slots;
-        if (free >= needed && (best == nullptr ||
-                               free < best->slots - best->used_slots)) {
-          best = &ag;
-        }
+      auto groups = find_fit(exp.resource_pool, needed, exp.single_slice, {});
+      if (groups.empty()) {
+        maybe_preempt_for(exp, needed);
+        continue;  // slots free when victims exit; re-scheduled then
       }
-      std::vector<std::pair<std::string, int>> groups;
-      if (best != nullptr) {
-        groups.push_back({best->id, needed});
-      } else {
-        int remaining = needed;
-        std::vector<AgentState*> by_free;
-        for (auto& [aid, ag] : agents_) by_free.push_back(&ag);
-        std::sort(by_free.begin(), by_free.end(), [](AgentState* a, AgentState* b) {
-          return (a->slots - a->used_slots) > (b->slots - b->used_slots);
-        });
-        for (auto* ag : by_free) {
-          int free = ag->slots - ag->used_slots;
-          if (free <= 0) continue;
-          int take = std::min(free, remaining);
-          groups.push_back({ag->id, take});
-          remaining -= take;
-          if (remaining == 0) break;
-        }
-        if (remaining > 0) continue;  // gang does not fit anywhere yet
-      }
+      place_gang(tid, t, exp, groups);
+    }
+  }
 
-      // place the gang
+  void maybe_preempt_for(ExperimentState& exp, int needed) {
+    // victims: running trials in the same pool with strictly lower
+    // priority (higher number), lowest priority and newest first
+    std::vector<std::tuple<int, int64_t>> victims;  // (-priority, -tid)
+    for (auto& [vtid, vt] : trials_) {
+      if (vt.state != "RUNNING" || vt.sched_preempted || vt.stop_requested) continue;
+      auto veit = experiments_.find(vt.experiment_id);
+      if (veit == experiments_.end()) continue;
+      if (veit->second.resource_pool != exp.resource_pool) continue;
+      if (veit->second.priority <= exp.priority) continue;
+      victims.push_back({-veit->second.priority, -vtid});
+    }
+    std::sort(victims.begin(), victims.end());
+    std::map<std::string, int> extra;
+    std::vector<int64_t> chosen;
+    bool feasible = false;
+    for (auto& [negpri, negtid] : victims) {
+      int64_t vtid = -negtid;
+      auto ait = allocations_.find(trials_[vtid].allocation_id);
+      if (ait == allocations_.end()) continue;
+      for (auto& [aid, slots] : ait->second.groups) extra[aid] += slots;
+      chosen.push_back(vtid);
+      if (!find_fit(exp.resource_pool, needed, exp.single_slice, extra).empty()) {
+        feasible = true;
+        break;
+      }
+    }
+    if (!feasible) return;  // preempting everyone still wouldn't fit
+    for (int64_t vtid : chosen) {
+      TrialState& vt = trials_[vtid];
+      vt.sched_preempted = true;
+      signal_preempt(vt.allocation_id);
+    }
+  }
+
+  void place_gang(int64_t tid, TrialState& t, ExperimentState& exp,
+                  const std::vector<std::pair<std::string, int>>& groups) {
       std::string alloc_id = "alloc-" + std::to_string(next_allocation_id_++);
       AllocationState alloc;
       alloc.id = alloc_id;
@@ -443,7 +548,6 @@ class Master {
         ++node_rank;
       }
       work_cv_.notify_all();
-    }
   }
 
   void signal_preempt(const std::string& alloc_id) {
@@ -834,6 +938,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     bool fresh = ag.id.empty();
     ag.id = id;
     ag.host = body["host"].as_string();
+    if (body.contains("pool") && body["pool"].is_string() &&
+        !body["pool"].as_string().empty()) {
+      ag.pool = body["pool"].as_string();
+    }
     ag.slots = static_cast<int>(body["slots"].as_int(1));
     if (fresh) ag.used_slots = 0;
     ag.last_seen_ms = now_ms();
@@ -848,8 +956,38 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       Json j = Json::object();
       j.set("id", ag.id);
       j.set("host", ag.host);
+      j.set("pool", ag.pool);
       j.set("slots", Json(ag.slots));
       j.set("used_slots", Json(ag.used_slots));
+      out.push_back(j);
+    }
+    return R::json(out.dump());
+  });
+
+  // job-queue introspection: trials in scheduler order with their pool,
+  // priority and placement state (reference api_job.go / job queue UI)
+  srv.route("GET", "/api/v1/job-queue", [&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    std::vector<std::tuple<int, int64_t>> order;
+    for (const auto& [tid, t] : m.trials_) {
+      if (t.state != "PENDING" && t.state != "RUNNING") continue;
+      auto eit = m.experiments_.find(t.experiment_id);
+      if (eit == m.experiments_.end()) continue;
+      order.push_back({eit->second.priority, tid});
+    }
+    std::sort(order.begin(), order.end());
+    Json out = Json::array();
+    for (auto& [pri, tid] : order) {
+      const TrialState& t = m.trials_[tid];
+      const ExperimentState& e = m.experiments_[t.experiment_id];
+      Json j = Json::object();
+      j.set("trial_id", Json(tid));
+      j.set("experiment_id", Json(t.experiment_id));
+      j.set("state", t.state);
+      j.set("priority", Json(static_cast<int64_t>(pri)));
+      j.set("resource_pool", e.resource_pool);
+      j.set("slots", Json(static_cast<int64_t>(e.slots_per_trial)));
+      j.set("sched_preempted", Json(t.sched_preempted));
       out.push_back(j);
     }
     return R::json(out.dump());

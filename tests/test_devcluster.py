@@ -257,6 +257,119 @@ def test_gang_spans_agents(tmp_path):
         c.stop()
 
 
+def test_priority_preemption_yields_and_resumes(cluster):
+    """A high-priority experiment preempts a running low-priority trial:
+    the victim checkpoints, yields back to PENDING without burning a
+    restart, the high-priority trial runs, and the victim later resumes
+    from its checkpoint and completes (reference priority.go semantics)."""
+    low = exp_config(cluster.ckpt_dir, slots=2)
+    low["name"] = "low-pri"
+    low["resources"]["priority"] = 60
+    low["searcher"]["max_length"] = {"batches": 40}
+    low["min_validation_period"] = {"batches": 4}
+    low["min_checkpoint_period"] = {"batches": 4}
+    low_id = cluster.submit(low)
+
+    # wait until the low-pri trial is running and has checkpointed once
+    deadline = time.time() + 90
+    low_tid = None
+    while time.time() < deadline:
+        exp = requests.get(f"{cluster.url}/api/v1/experiments/{low_id}").json()
+        if exp["trials"] and exp["trials"][0]["state"] == "RUNNING":
+            low_tid = exp["trials"][0]["id"]
+            if exp["trials"][0]["latest_checkpoint"]:
+                break
+        time.sleep(0.5)
+    assert low_tid is not None
+
+    high = exp_config(cluster.ckpt_dir, slots=2)
+    high["name"] = "high-pri"
+    high["resources"]["priority"] = 10
+    high["searcher"]["max_length"] = {"batches": 4}
+    high_id = cluster.submit(high)
+
+    # the low-pri trial must yield (PENDING, restarts unchanged) and the
+    # high-pri trial must get the slots
+    deadline = time.time() + 120
+    saw_yield = False
+    while time.time() < deadline:
+        lo = requests.get(f"{cluster.url}/api/v1/experiments/{low_id}").json()
+        hi = requests.get(f"{cluster.url}/api/v1/experiments/{high_id}").json()
+        lo_t = lo["trials"][0]
+        if lo_t["state"] == "PENDING" and hi["trials"] and (
+            hi["trials"][0]["state"] in ("RUNNING", "COMPLETED")
+        ):
+            saw_yield = True
+            assert lo_t["restarts"] == 0, "yield must not burn a restart"
+            break
+        time.sleep(0.5)
+    assert saw_yield, "low-priority trial never yielded to the high-priority gang"
+
+    # both must finish: high first, then low resumes from its checkpoint
+    assert cluster.wait_for_state(high_id, timeout=180)["state"] == "COMPLETED"
+    final = cluster.wait_for_state(low_id, timeout=240)
+    assert final["state"] == "COMPLETED"
+    assert final["trials"][0]["restarts"] == 0
+
+
+def test_resource_pools_isolate_agents(tmp_path):
+    """An experiment bound to pool 'other' must not run on 'default' agents;
+    once an 'other'-pool agent registers, it schedules there."""
+    c = DevCluster(tmp_path, agents=1, slots=2)
+    c.start()
+    try:
+        cfg = exp_config(c.ckpt_dir)
+        cfg["searcher"]["max_length"] = {"batches": 2}
+        cfg["resources"]["resource_pool"] = "other"
+        exp_id = c.submit(cfg)
+        time.sleep(3)
+        exp = requests.get(f"{c.url}/api/v1/experiments/{exp_id}").json()
+        assert all(t["state"] == "PENDING" for t in exp["trials"]), exp["trials"]
+        # job queue shows it waiting in its pool
+        q = requests.get(c.url + "/api/v1/job-queue").json()
+        assert any(
+            j["resource_pool"] == "other" and j["state"] == "PENDING" for j in q
+        )
+        # register an agent in the right pool -> experiment completes
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        c.procs["agent-other"] = subprocess.Popen(
+            [
+                AGENT_BIN,
+                "--master-host", "127.0.0.1",
+                "--master-port", str(c.port),
+                "--id", "agent-other",
+                "--pool", "other",
+                "--slots", "2",
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        assert c.wait_for_state(exp_id, timeout=180)["state"] == "COMPLETED"
+    finally:
+        c.stop()
+
+
+def test_single_slice_refuses_dcn_split(tmp_path):
+    """resources.single_slice: a 4-slot gang over two 2-slot agents must NOT
+    be split across hosts; it waits instead (ICI-only constraint)."""
+    c = DevCluster(tmp_path, agents=2, slots=2)
+    c.start()
+    try:
+        cfg = exp_config(c.ckpt_dir, slots=4)
+        cfg["resources"]["single_slice"] = True
+        cfg["searcher"]["max_length"] = {"batches": 2}
+        exp_id = c.submit(cfg)
+        time.sleep(3)
+        exp = requests.get(f"{c.url}/api/v1/experiments/{exp_id}").json()
+        assert all(t["state"] == "PENDING" for t in exp["trials"])
+        agents = requests.get(c.url + "/api/v1/agents").json()
+        assert all(a["used_slots"] == 0 for a in agents)
+    finally:
+        c.stop()
+
+
 def test_context_directory_ships_user_code(cluster, tmp_path):
     """Submit an experiment whose Trial class exists ONLY in a local context
     dir (not importable on the agent's default path): the master stores the
