@@ -1,0 +1,47 @@
+"""Checkpoint-GC task body (reference ``harness/determined/exec/gc_checkpoints.py``).
+
+The master marks checkpoints DELETED and dispatches a ``gc`` work item to an
+agent; the agent runs this module with the work item in ``DTPU_GC_SPEC``.
+Deletion goes through the same StorageManager family the harness saves with,
+so every backend (shared_fs/directory/s3/gcs/azure) is covered.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+logger = logging.getLogger("determined_tpu.gc")
+
+
+def storage_manager_from_spec(storage: dict, fallback_dir: str):
+    from determined_tpu.config.experiment import CheckpointStorageConfig
+    from determined_tpu.storage import from_string
+
+    if storage:
+        cfg = CheckpointStorageConfig.parse(dict(storage))
+        return from_string(cfg.to_url())
+    return from_string(fallback_dir)
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s: %(message)s")
+    spec = json.loads(os.environ["DTPU_GC_SPEC"])
+    manager = storage_manager_from_spec(
+        spec.get("storage") or {}, spec.get("checkpoint_dir") or "/tmp/dtpu-checkpoints"
+    )
+    failed = 0
+    for uuid in spec.get("uuids", []):
+        try:
+            deleted = manager.delete(uuid)
+            logger.info("gc: deleted checkpoint %s (%d files)", uuid, len(deleted))
+        except Exception:  # noqa: BLE001 - keep deleting the rest
+            logger.exception("gc: failed to delete checkpoint %s", uuid)
+            failed += 1
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,8 @@ struct Options {
   std::string pool = "default";
   int slots = 1;
   std::string python = "python";
+  std::string user = "determined";
+  std::string password;
 };
 
 class Agent {
@@ -46,19 +48,20 @@ class Agent {
   explicit Agent(Options opts) : opts_(std::move(opts)) {}
 
   int run() {
-    if (!register_agent()) {
+    if (!login() || !register_agent()) {
       fprintf(stderr, "agent %s: cannot reach master\n", opts_.id.c_str());
       return 1;
     }
     printf("dtpu-agent %s registered (%d slots)\n", opts_.id.c_str(), opts_.slots);
     fflush(stdout);
     while (true) {
-      auto resp = http_request(opts_.master_host, opts_.master_port, "GET",
-                               "/api/v1/agents/" + opts_.id + "/work?timeout_seconds=30",
-                               "", 45);
+      auto resp = master_req("GET",
+                             "/api/v1/agents/" + opts_.id + "/work?timeout_seconds=30",
+                             "", 45);
       if (!resp.ok()) {
-        // master gone or restarting: re-register with backoff
+        // master gone or restarting: re-login + re-register with backoff
         std::this_thread::sleep_for(std::chrono::seconds(2));
+        login();
         register_agent();
         continue;
       }
@@ -70,21 +73,68 @@ class Agent {
           launch(item);
         } else if (type == "kill") {
           kill_allocation(item["allocation_id"].as_string());
+        } else if (type == "gc") {
+          run_gc(item);
         }
       }
     }
   }
 
  private:
+  // authenticated request to the master; the token is refreshed by the
+  // re-login path in run() when the master restarts with fresh state
+  ClientResponse master_req(const std::string& method, const std::string& target,
+                            const std::string& body = "", int timeout_sec = 10) {
+    std::string tok;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      tok = token_;
+    }
+    return http_request(opts_.master_host, opts_.master_port, method, target, body,
+                        timeout_sec, {{"Authorization", "Bearer " + tok}});
+  }
+
+  bool login() {
+    Json body = Json::object();
+    body.set("username", opts_.user);
+    body.set("password", opts_.password);
+    auto resp = http_request(opts_.master_host, opts_.master_port, "POST",
+                             "/api/v1/auth/login", body.dump(), 10);
+    if (!resp.ok()) return false;
+    Json out;
+    if (!Json::try_parse(resp.body, &out)) return false;
+    std::lock_guard<std::mutex> lk(mu_);
+    token_ = out["token"].as_string();
+    return !token_.empty();
+  }
+
   bool register_agent() {
     Json body = Json::object();
     body.set("id", opts_.id);
     body.set("host", opts_.advertised_host);
     body.set("pool", opts_.pool);
     body.set("slots", Json(opts_.slots));
-    auto resp = http_request(opts_.master_host, opts_.master_port, "POST",
-                             "/api/v1/agents", body.dump(), 10);
+    auto resp = master_req("POST", "/api/v1/agents", body.dump(), 10);
     return resp.ok();
+  }
+
+  // checkpoint-GC task: delete storage contents through the harness
+  // StorageManager (reference exec/gc_checkpoints.py run as a task)
+  void run_gc(const Json& work) {
+    pid_t pid = fork();
+    if (pid == 0) {
+      setpgid(0, 0);
+      setenv("DTPU_GC_SPEC", work.dump().c_str(), 1);
+      execlp(opts_.python.c_str(), opts_.python.c_str(), "-m",
+             "determined_tpu.exec.gc_checkpoints", (char*)nullptr);
+      _exit(127);
+    }
+    if (pid > 0) {
+      std::thread([pid] {
+        int status = 0;
+        waitpid(pid, &status, 0);
+      }).detach();
+    }
   }
 
   void launch(const Json& work) {
@@ -136,8 +186,7 @@ class Agent {
       Json lines = Json::array();
       for (auto& l : batch) lines.push_back(l);
       body.set("lines", lines);
-      http_request(opts_.master_host, opts_.master_port, "POST", "/api/v1/logs",
-                   body.dump(), 10);
+      master_req("POST", "/api/v1/logs", body.dump(), 10);
       batch.clear();
     };
     ssize_t n;
@@ -166,8 +215,8 @@ class Agent {
     Json body = Json::object();
     body.set("exit_code", Json(exit_code));
     body.set("allocation_id", alloc_id);
-    http_request(opts_.master_host, opts_.master_port, "POST",
-                 "/api/v1/trials/" + std::to_string(trial_id) + "/exit", body.dump(), 10);
+    master_req("POST", "/api/v1/trials/" + std::to_string(trial_id) + "/exit",
+               body.dump(), 10);
   }
 
   void kill_allocation(const std::string& alloc_id) {
@@ -193,6 +242,7 @@ class Agent {
 
   Options opts_;
   std::mutex mu_;
+  std::string token_;
   std::map<std::string, pid_t> running_;
 };
 
@@ -214,6 +264,8 @@ int main(int argc, char** argv) {
     else if (arg == "--pool") opts.pool = next("--pool");
     else if (arg == "--slots") opts.slots = std::atoi(next("--slots").c_str());
     else if (arg == "--python") opts.python = next("--python");
+    else if (arg == "--user") opts.user = next("--user");
+    else if (arg == "--password") opts.password = next("--password");
     else { fprintf(stderr, "unknown arg %s\n", arg.c_str()); return 2; }
   }
   return dtpu::Agent(opts).run();

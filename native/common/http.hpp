@@ -287,7 +287,9 @@ struct ClientResponse {
 inline ClientResponse http_request(const std::string& host, int port,
                                    const std::string& method, const std::string& target,
                                    const std::string& body = "",
-                                   int timeout_sec = 75) {
+                                   int timeout_sec = 75,
+                                   const std::vector<std::pair<std::string, std::string>>&
+                                       extra_headers = {}) {
   ClientResponse out;
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return out;
@@ -306,9 +308,9 @@ inline ClientResponse http_request(const std::string& host, int port,
   req << method << " " << target << " HTTP/1.1\r\n"
       << "Host: " << host << "\r\n"
       << "Content-Type: application/json\r\n"
-      << "Content-Length: " << body.size() << "\r\n"
-      << "Connection: close\r\n\r\n"
-      << body;
+      << "Content-Length: " << body.size() << "\r\n";
+  for (const auto& [k, v] : extra_headers) req << k << ": " << v << "\r\n";
+  req << "Connection: close\r\n\r\n" << body;
   std::string data = req.str();
   size_t sent = 0;
   while (sent < data.size()) {

@@ -77,9 +77,10 @@ class Json {
 
   // array access
   const JsonArray& elements() const { static const JsonArray e; return is_array() ? arr_ : e; }
-  void push_back(Json v) {
+  Json& push_back(Json v) {
     if (!is_array()) { type_ = Type::Array; arr_.clear(); }
     arr_.push_back(std::move(v));
+    return *this;
   }
   size_t size() const {
     if (is_array()) return arr_.size();

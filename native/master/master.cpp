@@ -33,9 +33,12 @@
 #include <string>
 #include <vector>
 
+#include <random>
+
 #include "../common/base64.hpp"
 #include "../common/http.hpp"
 #include "../common/json.hpp"
+#include "../common/sha256.hpp"
 #include "searcher.hpp"
 
 namespace dtpu {
@@ -83,6 +86,15 @@ struct TrialState {
   int64_t run_id = 0;
   bool stop_requested = false;   // searcher decided to stop it
   bool sched_preempted = false;  // scheduler preempted it for a higher-pri gang
+  // validation metric per steps_completed, for checkpoint-GC best ranking
+  // (one entry per validation report; bounded by validation count)
+  std::map<int64_t, double> val_by_step;
+};
+
+struct UserState {
+  std::string salt;
+  std::string pwhash;  // sha256(salt + password)
+  bool admin = false;
 };
 
 struct ExperimentState {
@@ -102,33 +114,75 @@ struct ExperimentState {
   std::string metric = "validation_loss";
   bool smaller_is_better = true;
   std::string time_metric = "batches";
+  std::string owner = "determined";
 };
 
 class Master {
  public:
-  Master(std::string state_dir, std::string checkpoint_dir)
-      : state_dir_(std::move(state_dir)), checkpoint_dir_(std::move(checkpoint_dir)) {
+  Master(std::string state_dir, std::string checkpoint_dir,
+         int journal_limit = 4096, int log_retention_days = 0)
+      : state_dir_(std::move(state_dir)),
+        checkpoint_dir_(std::move(checkpoint_dir)),
+        journal_limit_(journal_limit),
+        log_retention_days_(log_retention_days) {
     journal_path_ = state_dir_ + "/journal.jsonl";
+    snapshot_path_ = state_dir_ + "/snapshot.json";
   }
 
+  // Durability = snapshot + journal tail: compaction (maybe_compact) writes
+  // the full state to snapshot.json and truncates the journal, so boot cost
+  // and disk use stay bounded no matter how long the cluster lives
+  // (reference: Postgres; here event sourcing with compaction).
   void boot() {
+    replaying_ = true;
+    {
+      std::ifstream snap(snapshot_path_);
+      if (snap) {
+        std::ostringstream data;
+        data << snap.rdbuf();
+        Json s;
+        if (Json::try_parse(data.str(), &s)) restore_snapshot(s);
+      }
+    }
     std::ifstream in(journal_path_);
     std::string line;
-    replaying_ = true;
     while (std::getline(in, line)) {
       if (line.empty()) continue;
+      ++journal_lines_;
       Json ev;
       if (!Json::try_parse(line, &ev)) continue;
       apply_event(ev);
     }
     replaying_ = false;
     journal_out_.open(journal_path_, std::ios::app);
+    // first boot: bootstrap the default users (reference: "determined" and
+    // "admin", blank passwords, created by migration)
+    if (users_.empty()) {
+      set_user("determined", "", true);
+      set_user("admin", "", true);
+    }
     // trials that were mid-flight when the master died go back to PENDING
     for (auto& [tid, t] : trials_) {
       if (t.state == "RUNNING") {
         t.state = "PENDING";
         t.allocation_id.clear();
       }
+    }
+    retention_sweep();
+  }
+
+  // delete per-trial log files whose last write predates the retention
+  // window (reference logretention/: scheduled deletion by days)
+  void retention_sweep() {
+    if (log_retention_days_ <= 0) return;
+    std::error_code ec;
+    auto cutoff = std::filesystem::file_time_type::clock::now() -
+                  std::chrono::hours(24 * log_retention_days_);
+    for (const auto& entry :
+         std::filesystem::directory_iterator(state_dir_ + "/logs", ec)) {
+      if (ec) break;
+      auto mtime = std::filesystem::last_write_time(entry.path(), ec);
+      if (!ec && mtime < cutoff) std::filesystem::remove(entry.path(), ec);
     }
   }
 
@@ -142,12 +196,34 @@ class Master {
     ev.set("ts", Json(now_ms()));
     journal_out_ << ev.dump() << "\n";
     journal_out_.flush();
+    if (++journal_lines_ >= journal_limit_) compact();
+  }
+
+  // snapshot full state atomically, then truncate the journal
+  void compact() {
+    Json snap = snapshot_state();
+    std::string tmp = snapshot_path_ + ".tmp";
+    {
+      std::ofstream out(tmp, std::ios::trunc);
+      if (!out) return;
+      out << snap.dump();
+      out.close();
+      if (!out) return;
+    }
+    std::error_code ec;
+    std::filesystem::rename(tmp, snapshot_path_, ec);
+    if (ec) return;
+    journal_out_.close();
+    journal_out_.open(journal_path_, std::ios::trunc);
+    journal_lines_ = 0;
   }
 
   void apply_event(const Json& ev) {
     const std::string& type = ev["type"].as_string();
     if (type == "exp_created") {
-      do_create_experiment(ev["config"], ev["id"].as_int());
+      do_create_experiment(
+          ev["config"], ev["id"].as_int(),
+          ev.contains("owner") ? ev["owner"].as_string() : "determined");
     } else if (type == "exp_state") {
       auto it = experiments_.find(ev["id"].as_int());
       if (it != experiments_.end()) it->second.state = ev["state"].as_string();
@@ -168,16 +244,36 @@ class Master {
       checkpoints_[ev["uuid"].as_string()] = ev;
       auto it = trials_.find(ev["trial_id"].as_int());
       if (it != trials_.end()) it->second.latest_checkpoint = ev["uuid"].as_string();
-    } else if (type == "metrics") {
-      metrics_.push_back(ev);
+    } else if (type == "ckpt_deleted") {
+      auto it = checkpoints_.find(ev["uuid"].as_string());
+      if (it != checkpoints_.end()) it->second.set("state", "DELETED");
+    } else if (type == "user_set") {
+      UserState u;
+      u.salt = ev["salt"].as_string();
+      u.pwhash = ev["pwhash"].as_string();
+      u.admin = ev["admin"].as_bool(false);
+      users_[ev["username"].as_string()] = u;
+    } else if (type == "token_issued") {
+      tokens_[ev["token"].as_string()] = ev["username"].as_string();
+    } else if (type == "model_created") {
+      models_[ev["name"].as_string()] = ev["model"];
+    } else if (type == "model_version") {
+      auto it = models_.find(ev["name"].as_string());
+      if (it != models_.end()) {
+        Json versions = it->second["versions"];
+        versions.push_back(ev["version"]);
+        it->second.set("versions", versions);
+      }
     }
+    // "metrics" events from pre-compaction journals are ignored: metric
+    // records now live in per-trial jsonl files, not the journal
   }
 
   // ---- experiment engine -------------------------------------------------
 
-  int64_t do_create_experiment(const Json& config, int64_t forced_id = 0) {
-    int64_t id = forced_id ? forced_id : next_experiment_id_++;
-    if (forced_id) next_experiment_id_ = std::max(next_experiment_id_, forced_id + 1);
+  // build every config-derived field + a fresh searcher, without running
+  // the searcher; shared by experiment creation and snapshot restore
+  ExperimentState build_experiment(const Json& config, int64_t id) {
     ExperimentState exp;
     exp.id = id;
     exp.config = config;
@@ -211,10 +307,180 @@ class Master {
     exp.ctx = std::make_unique<SearchCtx>(config["hyperparameters"],
                                           seed ^ static_cast<uint64_t>(id));
     exp.method = make_search_method(scfg, config["hyperparameters"]);
+    return exp;
+  }
+
+  int64_t do_create_experiment(const Json& config, int64_t forced_id = 0,
+                               const std::string& owner = "determined") {
+    int64_t id = forced_id ? forced_id : next_experiment_id_++;
+    if (forced_id) next_experiment_id_ = std::max(next_experiment_id_, forced_id + 1);
+    ExperimentState exp = build_experiment(config, id);
+    exp.owner = owner;
     auto actions = exp.method->initial_trials(*exp.ctx);
     experiments_[id] = std::move(exp);
     handle_actions(experiments_[id], actions);
     return id;
+  }
+
+  // ---- snapshot (journal compaction) -------------------------------------
+
+  Json snapshot_state() const {
+    Json snap = Json::object();
+    snap.set("next_experiment_id", Json(next_experiment_id_));
+    snap.set("next_trial_id", Json(next_trial_id_));
+    snap.set("next_allocation_id", Json(next_allocation_id_));
+    Json users = Json::object();
+    for (const auto& [name, u] : users_) {
+      users.set(name, Json::object()
+                          .set("salt", u.salt)
+                          .set("pwhash", u.pwhash)
+                          .set("admin", Json(u.admin)));
+    }
+    snap.set("users", users);
+    Json tokens = Json::object();
+    for (const auto& [tok, user] : tokens_) tokens.set(tok, user);
+    snap.set("tokens", tokens);
+    Json models = Json::object();
+    for (const auto& [name, model] : models_) models.set(name, model);
+    snap.set("models", models);
+    Json checkpoints = Json::object();
+    for (const auto& [uuid, c] : checkpoints_) checkpoints.set(uuid, c);
+    snap.set("checkpoints", checkpoints);
+    Json exps = Json::array();
+    for (const auto& [id, e] : experiments_) {
+      Json j = Json::object();
+      j.set("id", Json(e.id));
+      j.set("config", e.config);
+      j.set("state", e.state);
+      j.set("owner", e.owner);
+      j.set("searcher_shutdown", Json(e.searcher_shutdown));
+      Json rid_map = Json::object();
+      for (const auto& [rid, tid] : e.rid_to_trial) {
+        rid_map.set(std::to_string(rid), Json(tid));
+      }
+      j.set("rid_to_trial", rid_map);
+      j.set("ctx", e.ctx->snapshot());
+      j.set("method", e.method->snapshot());
+      exps.push_back(j);
+    }
+    snap.set("experiments", exps);
+    Json trials = Json::array();
+    for (const auto& [tid, t] : trials_) {
+      Json j = Json::object();
+      j.set("id", Json(t.id));
+      j.set("experiment_id", Json(t.experiment_id));
+      j.set("request_id", Json(t.request_id));
+      j.set("hparams", t.hparams);
+      j.set("state", t.state);
+      j.set("restarts", Json(static_cast<int64_t>(t.restarts)));
+      j.set("latest_checkpoint", t.latest_checkpoint);
+      j.set("run_id", Json(t.run_id));
+      j.set("stop_requested", Json(t.stop_requested));
+      Json vals = Json::object();
+      for (const auto& [step, metric] : t.val_by_step) {
+        vals.set(std::to_string(step), Json(metric));
+      }
+      j.set("val_by_step", vals);
+      trials.push_back(j);
+    }
+    snap.set("trials", trials);
+    return snap;
+  }
+
+  void restore_snapshot(const Json& s) {
+    next_experiment_id_ = s["next_experiment_id"].as_int(1);
+    next_trial_id_ = s["next_trial_id"].as_int(1);
+    next_allocation_id_ = s["next_allocation_id"].as_int(1);
+    for (const auto& [name, u] : s["users"].items()) {
+      UserState user;
+      user.salt = u["salt"].as_string();
+      user.pwhash = u["pwhash"].as_string();
+      user.admin = u["admin"].as_bool(false);
+      users_[name] = user;
+    }
+    for (const auto& [tok, user] : s["tokens"].items()) {
+      tokens_[tok] = user.as_string();
+    }
+    for (const auto& [name, model] : s["models"].items()) models_[name] = model;
+    for (const auto& [uuid, c] : s["checkpoints"].items()) checkpoints_[uuid] = c;
+    for (const auto& e : s["experiments"].elements()) {
+      int64_t id = e["id"].as_int();
+      ExperimentState exp = build_experiment(e["config"], id);
+      exp.state = e["state"].as_string();
+      exp.owner = e.contains("owner") ? e["owner"].as_string() : "determined";
+      exp.searcher_shutdown = e["searcher_shutdown"].as_bool(false);
+      for (const auto& [rid, tid] : e["rid_to_trial"].items()) {
+        exp.rid_to_trial[std::stoll(rid)] = tid.as_int();
+      }
+      exp.ctx->restore(e["ctx"]);
+      exp.method->restore(e["method"]);
+      experiments_[id] = std::move(exp);
+    }
+    for (const auto& tj : s["trials"].elements()) {
+      TrialState t;
+      t.id = tj["id"].as_int();
+      t.experiment_id = tj["experiment_id"].as_int();
+      t.request_id = tj["request_id"].as_int();
+      t.hparams = tj["hparams"];
+      t.state = tj["state"].as_string();
+      t.restarts = static_cast<int>(tj["restarts"].as_int(0));
+      t.latest_checkpoint = tj["latest_checkpoint"].as_string();
+      t.run_id = tj["run_id"].as_int(0);
+      t.stop_requested = tj["stop_requested"].as_bool(false);
+      for (const auto& [step, metric] : tj["val_by_step"].items()) {
+        t.val_by_step[std::stoll(step)] = metric.as_double();
+      }
+      trials_[t.id] = t;
+    }
+  }
+
+  // ---- users + tokens ----------------------------------------------------
+
+  static std::string random_hex(int nbytes) {
+    static std::random_device rd;
+    static const char* hex = "0123456789abcdef";
+    std::string out;
+    out.reserve(static_cast<size_t>(nbytes) * 2);
+    for (int i = 0; i < nbytes; ++i) {
+      unsigned byte = rd() & 0xff;
+      out += hex[byte >> 4];
+      out += hex[byte & 0xf];
+    }
+    return out;
+  }
+
+  void set_user(const std::string& name, const std::string& password, bool admin) {
+    UserState u;
+    u.salt = random_hex(8);
+    u.pwhash = sha256_hex(u.salt + password);
+    u.admin = admin;
+    users_[name] = u;
+    record(Json::object()
+               .set("type", "user_set")
+               .set("username", name)
+               .set("salt", u.salt)
+               .set("pwhash", u.pwhash)
+               .set("admin", Json(admin)));
+  }
+
+  std::string issue_token(const std::string& username) {
+    std::string tok = random_hex(16);
+    tokens_[tok] = username;
+    record(Json::object()
+               .set("type", "token_issued")
+               .set("token", tok)
+               .set("username", username));
+    return tok;
+  }
+
+  // returns the authenticated username, or "" (caller holds mu_)
+  std::string authenticate(const HttpRequest& req) const {
+    auto it = req.headers.find("authorization");
+    if (it == req.headers.end()) return "";
+    const std::string& v = it->second;
+    if (v.rfind("Bearer ", 0) != 0) return "";
+    auto tok = tokens_.find(v.substr(7));
+    return tok == tokens_.end() ? "" : tok->second;
   }
 
   void handle_actions(ExperimentState& exp, std::vector<SearchAction>& actions) {
@@ -263,6 +529,118 @@ class Master {
   void set_exp_state(ExperimentState& exp, const std::string& state) {
     exp.state = state;
     record(Json::object().set("type", "exp_state").set("id", Json(exp.id)).set("state", state));
+    if (!replaying_ &&
+        (state == "COMPLETED" || state == "CANCELED" || state == "ERROR")) {
+      gc_experiment(exp);
+    }
+  }
+
+  // ---- checkpoint GC (reference checkpoint_gc.go:31) ----------------------
+  //
+  // On experiment completion, rank the experiment's checkpoints by their
+  // validation metric (trial.val_by_step at the checkpoint's
+  // steps_completed) and keep the union of: top save_experiment_best
+  // across the experiment, top save_trial_best per trial, and newest
+  // save_trial_latest per trial.  The rest are marked DELETED and a gc
+  // task (exec/gc_checkpoints.py) is dispatched to an agent to remove the
+  // files through the StorageManager.
+  void gc_experiment(ExperimentState& exp) {
+    const Json& cs = exp.config["checkpoint_storage"];
+    int64_t keep_exp_best = cs["save_experiment_best"].as_int(0);
+    int64_t keep_trial_best = cs["save_trial_best"].as_int(1);
+    int64_t keep_trial_latest = cs["save_trial_latest"].as_int(1);
+
+    struct Ck {
+      std::string uuid;
+      int64_t trial_id;
+      int64_t step;
+      double oriented;  // smaller is always better after orientation
+      bool has_metric;
+    };
+    std::set<int64_t> exp_trials;
+    for (const auto& [rid, tid] : exp.rid_to_trial) exp_trials.insert(tid);
+    std::vector<Ck> cks;
+    for (const auto& [uuid, c] : checkpoints_) {
+      int64_t tid = c["trial_id"].as_int();
+      if (!exp_trials.count(tid)) continue;
+      if (c.contains("state") && c["state"].as_string() == "DELETED") continue;
+      Ck ck;
+      ck.uuid = uuid;
+      ck.trial_id = tid;
+      ck.step = c["metadata"]["steps_completed"].as_int(0);
+      const auto& vals = trials_[tid].val_by_step;
+      auto vit = vals.find(ck.step);
+      ck.has_metric = vit != vals.end();
+      ck.oriented = ck.has_metric
+                        ? (exp.smaller_is_better ? vit->second : -vit->second)
+                        : 0.0;
+      cks.push_back(ck);
+    }
+    std::set<std::string> keep;
+    {  // experiment best
+      std::vector<const Ck*> with_metric;
+      for (const auto& ck : cks) {
+        if (ck.has_metric) with_metric.push_back(&ck);
+      }
+      std::sort(with_metric.begin(), with_metric.end(),
+                [](const Ck* a, const Ck* b) { return a->oriented < b->oriented; });
+      for (int64_t i = 0; i < keep_exp_best && i < static_cast<int64_t>(with_metric.size()); ++i) {
+        keep.insert(with_metric[static_cast<size_t>(i)]->uuid);
+      }
+    }
+    for (int64_t tid : exp_trials) {  // per-trial best + latest
+      std::vector<const Ck*> mine, mine_metric;
+      for (const auto& ck : cks) {
+        if (ck.trial_id != tid) continue;
+        mine.push_back(&ck);
+        if (ck.has_metric) mine_metric.push_back(&ck);
+      }
+      std::sort(mine.begin(), mine.end(),
+                [](const Ck* a, const Ck* b) { return a->step > b->step; });
+      for (int64_t i = 0; i < keep_trial_latest && i < static_cast<int64_t>(mine.size()); ++i) {
+        keep.insert(mine[static_cast<size_t>(i)]->uuid);
+      }
+      std::sort(mine_metric.begin(), mine_metric.end(),
+                [](const Ck* a, const Ck* b) { return a->oriented < b->oriented; });
+      for (int64_t i = 0; i < keep_trial_best && i < static_cast<int64_t>(mine_metric.size()); ++i) {
+        keep.insert(mine_metric[static_cast<size_t>(i)]->uuid);
+      }
+    }
+    std::vector<std::string> to_delete;
+    for (const auto& ck : cks) {
+      if (!keep.count(ck.uuid)) to_delete.push_back(ck.uuid);
+    }
+    if (!to_delete.empty()) delete_checkpoints(exp.resource_pool, cs, to_delete);
+  }
+
+  // mark DELETED + journal, then dispatch a gc task to an agent in the pool
+  void delete_checkpoints(const std::string& pool, const Json& storage,
+                          const std::vector<std::string>& uuids) {
+    Json uuid_arr = Json::array();
+    for (const auto& uuid : uuids) {
+      auto it = checkpoints_.find(uuid);
+      if (it == checkpoints_.end()) continue;
+      it->second.set("state", "DELETED");
+      record(Json::object().set("type", "ckpt_deleted").set("uuid", uuid));
+      uuid_arr.push_back(uuid);
+    }
+    if (uuid_arr.size() == 0) return;
+    AgentState* target = nullptr;
+    for (auto& [aid, ag] : agents_) {
+      if (target == nullptr) target = &ag;
+      if (ag.pool == pool) {
+        target = &ag;
+        break;
+      }
+    }
+    if (target == nullptr) return;  // no agent: files linger, records say DELETED
+    Json work = Json::object();
+    work.set("type", "gc");
+    work.set("uuids", uuid_arr);
+    work.set("storage", storage);
+    work.set("checkpoint_dir", checkpoint_dir_);
+    target->work.push_back(work);
+    work_cv_.notify_all();
   }
 
   void do_validation(int64_t trial_id, double metric, int64_t step, bool from_replay) {
@@ -272,6 +650,7 @@ class Master {
     auto eit = experiments_.find(t.experiment_id);
     if (eit == experiments_.end()) return;
     ExperimentState& exp = eit->second;
+    t.val_by_step[step] = metric;
     double oriented = exp.smaller_is_better ? metric : -metric;
     auto actions = exp.method->validation_completed(*exp.ctx, t.request_id, oriented, step);
     if (!from_replay) {
@@ -509,11 +888,15 @@ class Master {
         allocations_[alloc_id].coord_host = coord_host;
         allocations_[alloc_id].coord_port = coord_port;
       }
+      // allocation-scoped session token so in-trial Core API calls pass
+      // auth (reference injects DET_SESSION_TOKEN into the task spec)
+      std::string session_token = issue_token(exp.owner);
       int node_rank = 0;
       for (auto& [aid, slots] : groups) {
         AgentState& ag = agents_[aid];
         ag.used_slots += slots;
         Json env = Json::object();
+        env.set("DTPU_SESSION_TOKEN", session_token);
         env.set("DTPU_TRIAL_ID", std::to_string(tid));
         env.set("DTPU_EXPERIMENT_ID", std::to_string(t.experiment_id));
         env.set("DTPU_ALLOCATION_ID", alloc_id);
@@ -605,6 +988,7 @@ class Master {
     Json j = Json::object();
     j.set("id", Json(e.id));
     j.set("name", e.name);
+    j.set("owner", e.owner);
     j.set("state", e.state);
     j.set("config", e.config);
     j.set("progress", Json(e.method ? e.method->progress() : 0.0));
@@ -627,8 +1011,12 @@ class Master {
   std::string state_dir_;
   std::string checkpoint_dir_;
   std::string journal_path_;
+  std::string snapshot_path_;
   std::ofstream journal_out_;
   bool replaying_ = false;
+  int journal_limit_ = 4096;
+  int journal_lines_ = 0;
+  int log_retention_days_ = 0;
 
   int64_t next_experiment_id_ = 1;
   int64_t next_trial_id_ = 1;
@@ -639,9 +1027,46 @@ class Master {
   std::map<std::string, AllocationState> allocations_;
   std::map<std::string, AgentState> agents_;
   std::map<std::string, Json> checkpoints_;
-  std::vector<Json> metrics_;
-  std::map<int64_t, std::vector<Json>> logs_;  // trial_id -> lines
+  std::map<std::string, UserState> users_;
+  std::map<std::string, std::string> tokens_;  // token -> username
+  std::map<std::string, Json> models_;         // registry: name -> model
   std::map<std::string, std::set<int>> coord_ports_in_use_;  // host -> ports
+
+  // metric and log records live in per-trial jsonl files under state_dir,
+  // NOT in master memory or the journal: master RSS stays bounded no
+  // matter how many metrics an experiment reports, and queries page
+  // straight off disk (reference keeps these in Postgres)
+  std::string metrics_path(int64_t tid) const {
+    return state_dir_ + "/metrics/trial_" + std::to_string(tid) + ".jsonl";
+  }
+  std::string logs_path(int64_t tid) const {
+    return state_dir_ + "/logs/trial_" + std::to_string(tid) + ".jsonl";
+  }
+  void append_jsonl(const std::string& path, const Json& rec) {
+    std::error_code ec;
+    std::filesystem::create_directories(
+        std::filesystem::path(path).parent_path(), ec);
+    std::ofstream out(path, std::ios::app);
+    out << rec.dump() << "\n";
+  }
+  // stream matching records from a jsonl file with offset/limit paging;
+  // pred filters BEFORE offset counting so paging is stable per filter
+  static Json read_jsonl(const std::string& path, size_t offset, size_t limit,
+                         const std::function<bool(const Json&)>& pred) {
+    Json out = Json::array();
+    std::ifstream in(path);
+    std::string line;
+    size_t matched = 0;
+    while (std::getline(in, line) && out.size() < limit) {
+      if (line.empty()) continue;
+      Json rec;
+      if (!Json::try_parse(line, &rec)) continue;  // torn concurrent append
+      if (pred && !pred(rec)) continue;
+      if (matched++ < offset) continue;
+      out.push_back(rec);
+    }
+    return out;
+  }
 
   // experiment context tarballs live on disk next to the journal; they
   // survive master restarts without bloating the event journal
@@ -678,9 +1103,85 @@ class Master {
 void install_routes_impl(Master& m, HttpServer& srv) {
   using R = HttpResponse;
 
-  srv.route("POST", "/api/v1/auth/login", [](const HttpRequest&) {
-    return R::json("{\"token\":\"dev\"}");
+  // every route except login + master-info requires a bearer token
+  // (reference: per-request token validation in master/internal/api.go;
+  // unauthenticated requests get 401)
+  auto authed = [&m](Handler h) -> Handler {
+    return [&m, h](const HttpRequest& req) {
+      {
+        std::lock_guard<std::mutex> lk(m.mu_);
+        if (m.authenticate(req).empty()) {
+          return R::error(401, "unauthenticated: missing or invalid token");
+        }
+      }
+      return h(req);
+    };
+  };
+  auto admin_only = [&m](Handler h) -> Handler {
+    return [&m, h](const HttpRequest& req) {
+      {
+        std::lock_guard<std::mutex> lk(m.mu_);
+        std::string user = m.authenticate(req);
+        if (user.empty()) return R::error(401, "unauthenticated");
+        auto it = m.users_.find(user);
+        if (it == m.users_.end() || !it->second.admin) {
+          return R::error(403, "admin required");
+        }
+      }
+      return h(req);
+    };
+  };
+
+  srv.route("POST", "/api/v1/auth/login", [&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::string username = body["username"].as_string();
+    std::string password =
+        body.contains("password") ? body["password"].as_string() : "";
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.users_.find(username);
+    if (it == m.users_.end() ||
+        sha256_hex(it->second.salt + password) != it->second.pwhash) {
+      return R::error(401, "invalid credentials");
+    }
+    Json out = Json::object();
+    out.set("token", m.issue_token(username));
+    out.set("username", username);
+    out.set("admin", Json(it->second.admin));
+    return R::json(out.dump());
   });
+
+  srv.route("GET", "/api/v1/auth/whoami", [&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    std::string user = m.authenticate(req);
+    if (user.empty()) return R::error(401, "unauthenticated");
+    Json out = Json::object();
+    out.set("username", user);
+    out.set("admin", Json(m.users_[user].admin));
+    return R::json(out.dump());
+  });
+
+  // admin user management (reference internal/user/; minimal analog)
+  srv.route("POST", "/api/v1/users", admin_only([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::string username = body["username"].as_string();
+    if (username.empty()) return R::error(400, "username required");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    m.set_user(username,
+               body.contains("password") ? body["password"].as_string() : "",
+               body["admin"].as_bool(false));
+    return R::json("{\"created\":true}", 201);
+  }));
+
+  srv.route("GET", "/api/v1/users", authed([&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    Json out = Json::array();
+    for (const auto& [name, u] : m.users_) {
+      out.push_back(Json::object().set("username", name).set("admin", Json(u.admin)));
+    }
+    return R::json(out.dump());
+  }));
 
   srv.route("GET", "/api/v1/master", [&m](const HttpRequest&) {
     std::lock_guard<std::mutex> lk(m.mu_);
@@ -692,7 +1193,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
   });
 
   // ---- experiments ----
-  srv.route("POST", "/api/v1/experiments", [&m](const HttpRequest& req) {
+  srv.route("POST", "/api/v1/experiments", authed([&m](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     const Json& config = body.contains("config") ? body["config"] : body;
@@ -711,7 +1212,8 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       }
     }
     std::lock_guard<std::mutex> lk(m.mu_);
-    int64_t id = m.do_create_experiment(config);
+    std::string owner = m.authenticate(req);
+    int64_t id = m.do_create_experiment(config, 0, owner);
     if (!context_tmp.empty()) {
       std::error_code ec;
       std::filesystem::rename(context_tmp, m.context_path(id), ec);
@@ -722,14 +1224,18 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         return R::error(500, "failed to finalize context");
       }
     }
-    m.record(Json::object().set("type", "exp_created").set("id", Json(id)).set("config", config));
+    m.record(Json::object()
+                 .set("type", "exp_created")
+                 .set("id", Json(id))
+                 .set("owner", owner)
+                 .set("config", config));
     m.schedule();
     Json out = Json::object();
     out.set("id", Json(id));
     return R::json(out.dump(), 201);
-  });
+  }));
 
-  srv.route("GET", "/api/v1/experiments/{id}/context", [&m](const HttpRequest& req) {
+  srv.route("GET", "/api/v1/experiments/{id}/context", authed([&m](const HttpRequest& req) {
     std::string path;
     {
       std::lock_guard<std::mutex> lk(m.mu_);
@@ -743,21 +1249,21 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     resp.content_type = "application/gzip";
     resp.body = data.str();
     return resp;
-  });
+  }));
 
-  srv.route("GET", "/api/v1/experiments", [&m](const HttpRequest&) {
+  srv.route("GET", "/api/v1/experiments", authed([&m](const HttpRequest&) {
     std::lock_guard<std::mutex> lk(m.mu_);
     Json out = Json::array();
     for (const auto& [id, e] : m.experiments_) out.push_back(m.experiment_json(e));
     return R::json(out.dump());
-  });
+  }));
 
-  srv.route("GET", "/api/v1/experiments/{id}", [&m](const HttpRequest& req) {
+  srv.route("GET", "/api/v1/experiments/{id}", authed([&m](const HttpRequest& req) {
     std::lock_guard<std::mutex> lk(m.mu_);
     auto it = m.experiments_.find(std::stoll(req.params.at("id")));
     if (it == m.experiments_.end()) return R::error(404, "no such experiment");
     return R::json(m.experiment_json(it->second).dump());
-  });
+  }));
 
   auto exp_signal = [&m](const HttpRequest& req, const std::string& verb) {
     std::lock_guard<std::mutex> lk(m.mu_);
@@ -793,117 +1299,201 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     return R::json(m.experiment_json(exp).dump());
   };
   srv.route("POST", "/api/v1/experiments/{id}/pause",
-            [exp_signal](const HttpRequest& r) { return exp_signal(r, "pause"); });
+            authed([exp_signal](const HttpRequest& r) { return exp_signal(r, "pause"); }));
   srv.route("POST", "/api/v1/experiments/{id}/activate",
-            [exp_signal](const HttpRequest& r) { return exp_signal(r, "activate"); });
+            authed([exp_signal](const HttpRequest& r) { return exp_signal(r, "activate"); }));
   srv.route("POST", "/api/v1/experiments/{id}/cancel",
-            [exp_signal](const HttpRequest& r) { return exp_signal(r, "cancel"); });
+            authed([exp_signal](const HttpRequest& r) { return exp_signal(r, "cancel"); }));
   srv.route("POST", "/api/v1/experiments/{id}/kill",
-            [exp_signal](const HttpRequest& r) { return exp_signal(r, "kill"); });
+            authed([exp_signal](const HttpRequest& r) { return exp_signal(r, "kill"); }));
 
   // ---- trials ----
-  srv.route("GET", "/api/v1/trials/{id}", [&m](const HttpRequest& req) {
+  srv.route("GET", "/api/v1/trials/{id}", authed([&m](const HttpRequest& req) {
     std::lock_guard<std::mutex> lk(m.mu_);
     auto it = m.trials_.find(std::stoll(req.params.at("id")));
     if (it == m.trials_.end()) return R::error(404, "no such trial");
     return R::json(m.trial_json(it->second).dump());
-  });
+  }));
 
   // ---- metrics ingest + query ----
-  srv.route("POST", "/api/v1/metrics", [&m](const HttpRequest& req) {
-    Json body;
-    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
-    std::lock_guard<std::mutex> lk(m.mu_);
-    m.metrics_.push_back(body);
-    m.record(Json::object()
-                 .set("type", "metrics")
-                 .set("trial_id", body["trial_id"])
-                 .set("group", body["group"])
-                 .set("steps_completed", body["steps_completed"])
-                 .set("metrics", body["metrics"]));
-    if (body["group"].as_string() == "validation") {
-      int64_t tid = body["trial_id"].as_int();
+  // ingest appends to the trial's jsonl metric file (durable, bounded
+  // master RSS); validation records additionally drive the searcher via
+  // the journal ("validation" event) so search state replays exactly
+  auto ingest_metric = [&m](const Json& rec) {
+    int64_t tid = rec["trial_id"].as_int();
+    m.append_jsonl(m.metrics_path(tid), rec);
+    if (rec["group"].as_string() == "validation") {
       auto tit = m.trials_.find(tid);
       if (tit != m.trials_.end()) {
         auto& exp = m.experiments_[tit->second.experiment_id];
-        const Json& metric = body["metrics"][exp.metric];
+        const Json& metric = rec["metrics"][exp.metric];
         if (metric.is_number()) {
-          m.do_validation(tid, metric.as_double(), body["steps_completed"].as_int(), false);
-          m.schedule();
+          m.do_validation(tid, metric.as_double(),
+                          rec["steps_completed"].as_int(), false);
         }
       }
     }
-    return R::json("{}");
-  });
+  };
 
-  // batched form used by the harness metrics shipper (core/_metrics.py)
-  srv.route("POST", "/api/v1/trials/metrics", [&m](const HttpRequest& req) {
+  srv.route("POST", "/api/v1/metrics", authed([&m, ingest_metric](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     std::lock_guard<std::mutex> lk(m.mu_);
-    for (const auto& rec : body["metrics"].elements()) {
-      m.metrics_.push_back(rec);
-      m.record(Json::object()
-                   .set("type", "metrics")
-                   .set("trial_id", rec["trial_id"])
-                   .set("group", rec["group"])
-                   .set("steps_completed", rec["steps_completed"])
-                   .set("metrics", rec["metrics"]));
-      if (rec["group"].as_string() == "validation") {
-        int64_t tid = rec["trial_id"].as_int();
-        auto tit = m.trials_.find(tid);
-        if (tit != m.trials_.end()) {
-          auto& exp = m.experiments_[tit->second.experiment_id];
-          const Json& metric = rec["metrics"][exp.metric];
-          if (metric.is_number()) {
-            m.do_validation(tid, metric.as_double(), rec["steps_completed"].as_int(),
-                            false);
-          }
-        }
-      }
-    }
+    ingest_metric(body);
     m.schedule();
     return R::json("{}");
-  });
+  }));
 
-  srv.route("GET", "/api/v1/trials/{id}/metrics", [&m](const HttpRequest& req) {
+  // batched form used by the harness metrics shipper (core/_metrics.py)
+  srv.route("POST", "/api/v1/trials/metrics", authed([&m, ingest_metric](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     std::lock_guard<std::mutex> lk(m.mu_);
+    for (const auto& rec : body["metrics"].elements()) ingest_metric(rec);
+    m.schedule();
+    return R::json("{}");
+  }));
+
+  srv.route("GET", "/api/v1/trials/{id}/metrics", authed([&m](const HttpRequest& req) {
     int64_t tid = std::stoll(req.params.at("id"));
     std::string group;
     auto g = req.query.find("group");
     if (g != req.query.end()) group = g->second;
-    Json out = Json::array();
-    for (const auto& rec : m.metrics_) {
-      if (rec["trial_id"].as_int() != tid) continue;
-      if (!group.empty() && rec["group"].as_string() != group) continue;
-      out.push_back(rec);
+    size_t offset = 0, limit = 1000;
+    auto o = req.query.find("offset");
+    if (o != req.query.end()) offset = std::stoul(o->second);
+    auto l = req.query.find("limit");
+    if (l != req.query.end()) limit = std::min(std::stoul(l->second), 10000ul);
+    std::string path;
+    {
+      std::lock_guard<std::mutex> lk(m.mu_);
+      path = m.metrics_path(tid);
     }
+    // read off disk without the master lock: appends are whole-line and a
+    // torn tail line is skipped by the parser, not mis-served
+    Json out = Master::read_jsonl(path, offset, limit, [&group](const Json& rec) {
+      return group.empty() || rec["group"].as_string() == group;
+    });
     return R::json(out.dump());
-  });
+  }));
 
   // ---- checkpoints ----
-  srv.route("POST", "/api/v1/checkpoints", [&m](const HttpRequest& req) {
+  srv.route("POST", "/api/v1/checkpoints", authed([&m](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     std::lock_guard<std::mutex> lk(m.mu_);
     body.set("type", "checkpoint");
+    body.set("state", "ACTIVE");
     m.checkpoints_[body["uuid"].as_string()] = body;
     auto it = m.trials_.find(body["trial_id"].as_int());
     if (it != m.trials_.end()) it->second.latest_checkpoint = body["uuid"].as_string();
     m.record(body);
     return R::json("{}");
-  });
+  }));
 
-  srv.route("GET", "/api/v1/checkpoints", [&m](const HttpRequest&) {
+  srv.route("GET", "/api/v1/checkpoints", authed([&m](const HttpRequest&) {
     std::lock_guard<std::mutex> lk(m.mu_);
     Json out = Json::array();
     for (const auto& [uuid, c] : m.checkpoints_) out.push_back(c);
     return R::json(out.dump());
-  });
+  }));
+
+  srv.route("GET", "/api/v1/checkpoints/{uuid}", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.checkpoints_.find(req.params.at("uuid"));
+    if (it == m.checkpoints_.end()) return R::error(404, "no such checkpoint");
+    return R::json(it->second.dump());
+  }));
+
+  // manual deletion (reference api_checkpoint.go DeleteCheckpoints)
+  srv.route("DELETE", "/api/v1/checkpoints/{uuid}", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.checkpoints_.find(req.params.at("uuid"));
+    if (it == m.checkpoints_.end()) return R::error(404, "no such checkpoint");
+    auto tit = m.trials_.find(it->second["trial_id"].as_int());
+    std::string pool = "default";
+    Json storage;
+    if (tit != m.trials_.end()) {
+      auto eit = m.experiments_.find(tit->second.experiment_id);
+      if (eit != m.experiments_.end()) {
+        pool = eit->second.resource_pool;
+        storage = eit->second.config["checkpoint_storage"];
+      }
+    }
+    m.delete_checkpoints(pool, storage, {req.params.at("uuid")});
+    return R::json("{\"deleted\":true}");
+  }));
+
+  // ---- model registry (reference api_model.go, internal/model/) ----
+  srv.route("POST", "/api/v1/models", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::string name = body["name"].as_string();
+    if (name.empty()) return R::error(400, "name required");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    if (m.models_.count(name)) return R::error(409, "model exists");
+    Json model = Json::object();
+    model.set("name", name);
+    model.set("description",
+              body.contains("description") ? body["description"] : Json(""));
+    model.set("labels", body.contains("labels") ? body["labels"] : Json::array());
+    model.set("metadata",
+              body.contains("metadata") ? body["metadata"] : Json::object());
+    model.set("creation_time", Json(now_ms()));
+    model.set("versions", Json::array());
+    m.models_[name] = model;
+    m.record(Json::object().set("type", "model_created").set("name", name).set("model", model));
+    return R::json(model.dump(), 201);
+  }));
+
+  srv.route("GET", "/api/v1/models", authed([&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    Json out = Json::array();
+    for (const auto& [name, model] : m.models_) out.push_back(model);
+    return R::json(out.dump());
+  }));
+
+  srv.route("GET", "/api/v1/models/{name}", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.models_.find(req.params.at("name"));
+    if (it == m.models_.end()) return R::error(404, "no such model");
+    return R::json(it->second.dump());
+  }));
+
+  srv.route("POST", "/api/v1/models/{name}/versions", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::string uuid = body["checkpoint_uuid"].as_string();
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.models_.find(req.params.at("name"));
+    if (it == m.models_.end()) return R::error(404, "no such model");
+    if (!m.checkpoints_.count(uuid)) return R::error(404, "no such checkpoint");
+    Json version = Json::object();
+    version.set("version", Json(static_cast<int64_t>(it->second["versions"].size()) + 1));
+    version.set("checkpoint_uuid", uuid);
+    version.set("name", body.contains("name") ? body["name"] : Json(""));
+    version.set("notes", body.contains("notes") ? body["notes"] : Json(""));
+    version.set("creation_time", Json(now_ms()));
+    Json versions = it->second["versions"];
+    versions.push_back(version);
+    it->second.set("versions", versions);
+    m.record(Json::object()
+                 .set("type", "model_version")
+                 .set("name", req.params.at("name"))
+                 .set("version", version));
+    return R::json(version.dump(), 201);
+  }));
+
+  srv.route("GET", "/api/v1/models/{name}/versions", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.models_.find(req.params.at("name"));
+    if (it == m.models_.end()) return R::error(404, "no such model");
+    return R::json(it->second["versions"].dump());
+  }));
 
   // ---- allocations: preemption long-poll + ack ----
   srv.route("GET", "/api/v1/allocations/{id}/signals/preemption",
-            [&m](const HttpRequest& req) {
+            authed([&m](const HttpRequest& req) {
     int timeout_s = 60;
     auto t = req.query.find("timeout_seconds");
     if (t != req.query.end()) timeout_s = std::max(0, std::atoi(t->second.c_str()));
@@ -918,18 +1508,18 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         return R::json("{\"preempt\":false}");
       }
     }
-  });
+  }));
 
   srv.route("POST", "/api/v1/allocations/{id}/signals/ack_preemption",
-            [&m](const HttpRequest& req) {
+            authed([&m](const HttpRequest& req) {
     std::lock_guard<std::mutex> lk(m.mu_);
     auto it = m.allocations_.find(req.params.at("id"));
     if (it != m.allocations_.end()) it->second.acked = true;
     return R::json("{}");
-  });
+  }));
 
   // ---- agents ----
-  srv.route("POST", "/api/v1/agents", [&m](const HttpRequest& req) {
+  srv.route("POST", "/api/v1/agents", authed([&m](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     std::lock_guard<std::mutex> lk(m.mu_);
@@ -947,9 +1537,9 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     ag.last_seen_ms = now_ms();
     m.schedule();
     return R::json("{\"registered\":true}");
-  });
+  }));
 
-  srv.route("GET", "/api/v1/agents", [&m](const HttpRequest&) {
+  srv.route("GET", "/api/v1/agents", authed([&m](const HttpRequest&) {
     std::lock_guard<std::mutex> lk(m.mu_);
     Json out = Json::array();
     for (const auto& [id, ag] : m.agents_) {
@@ -962,11 +1552,11 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       out.push_back(j);
     }
     return R::json(out.dump());
-  });
+  }));
 
   // job-queue introspection: trials in scheduler order with their pool,
   // priority and placement state (reference api_job.go / job queue UI)
-  srv.route("GET", "/api/v1/job-queue", [&m](const HttpRequest&) {
+  srv.route("GET", "/api/v1/job-queue", authed([&m](const HttpRequest&) {
     std::lock_guard<std::mutex> lk(m.mu_);
     std::vector<std::tuple<int, int64_t>> order;
     for (const auto& [tid, t] : m.trials_) {
@@ -991,10 +1581,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       out.push_back(j);
     }
     return R::json(out.dump());
-  });
+  }));
 
   // agent work long-poll
-  srv.route("GET", "/api/v1/agents/{id}/work", [&m](const HttpRequest& req) {
+  srv.route("GET", "/api/v1/agents/{id}/work", authed([&m](const HttpRequest& req) {
     int timeout_s = 30;
     auto t = req.query.find("timeout_seconds");
     if (t != req.query.end()) timeout_s = std::max(0, std::atoi(t->second.c_str()));
@@ -1017,10 +1607,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         return R::json("[]");
       }
     }
-  });
+  }));
 
   // trial exit reported by agent
-  srv.route("POST", "/api/v1/trials/{id}/exit", [&m](const HttpRequest& req) {
+  srv.route("POST", "/api/v1/trials/{id}/exit", authed([&m](const HttpRequest& req) {
     Json body;
     Json::try_parse(req.body, &body);
     std::lock_guard<std::mutex> lk(m.mu_);
@@ -1034,33 +1624,35 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     }
     m.on_trial_exit(tid, static_cast<int>(body["exit_code"].as_int(0)));
     return R::json("{}");
-  });
+  }));
 
-  // ---- task logs ----
-  srv.route("POST", "/api/v1/logs", [&m](const HttpRequest& req) {
+  // ---- task logs (per-trial jsonl files, paged like metrics) ----
+  srv.route("POST", "/api/v1/logs", authed([&m](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
-    std::lock_guard<std::mutex> lk(m.mu_);
     int64_t tid = body["trial_id"].as_int();
+    std::lock_guard<std::mutex> lk(m.mu_);
     for (const auto& line : body["lines"].elements()) {
-      m.logs_[tid].push_back(line);
+      m.append_jsonl(m.logs_path(tid), line);
     }
     return R::json("{}");
-  });
+  }));
 
-  srv.route("GET", "/api/v1/trials/{id}/logs", [&m](const HttpRequest& req) {
-    std::lock_guard<std::mutex> lk(m.mu_);
+  srv.route("GET", "/api/v1/trials/{id}/logs", authed([&m](const HttpRequest& req) {
     int64_t tid = std::stoll(req.params.at("id"));
-    size_t offset = 0;
+    size_t offset = 0, limit = 1000;
     auto o = req.query.find("offset");
     if (o != req.query.end()) offset = std::stoul(o->second);
-    Json out = Json::array();
-    auto it = m.logs_.find(tid);
-    if (it != m.logs_.end()) {
-      for (size_t i = offset; i < it->second.size(); ++i) out.push_back(it->second[i]);
+    auto l = req.query.find("limit");
+    if (l != req.query.end()) limit = std::min(std::stoul(l->second), 10000ul);
+    std::string path;
+    {
+      std::lock_guard<std::mutex> lk(m.mu_);
+      path = m.logs_path(tid);
     }
+    Json out = Master::read_jsonl(path, offset, limit, nullptr);
     return R::json(out.dump());
-  });
+  }));
 }
 
 void Master::install_routes(HttpServer& srv) { install_routes_impl(*this, srv); }
@@ -1074,6 +1666,8 @@ int main(int argc, char** argv) {
   int port = 8080;
   std::string state_dir = "/tmp/dtpu-master";
   std::string checkpoint_dir = "/tmp/dtpu-checkpoints";
+  int journal_limit = 4096;
+  int log_retention_days = 0;
   for (int i = 1; i < argc; ++i) {
     std::string arg = argv[i];
     auto next = [&](const char* name) -> std::string {
@@ -1084,12 +1678,15 @@ int main(int argc, char** argv) {
     else if (arg == "--host") host = next("--host");
     else if (arg == "--state-dir") state_dir = next("--state-dir");
     else if (arg == "--checkpoint-dir") checkpoint_dir = next("--checkpoint-dir");
+    else if (arg == "--journal-limit") journal_limit = std::atoi(next("--journal-limit").c_str());
+    else if (arg == "--log-retention-days")
+      log_retention_days = std::atoi(next("--log-retention-days").c_str());
     else { fprintf(stderr, "unknown arg %s\n", arg.c_str()); return 2; }
   }
   std::string mk = "mkdir -p '" + state_dir + "' '" + checkpoint_dir + "'";
   if (system(mk.c_str()) != 0) return 1;
 
-  dtpu::Master master(state_dir, checkpoint_dir);
+  dtpu::Master master(state_dir, checkpoint_dir, journal_limit, log_retention_days);
   master.boot();
   dtpu::HttpServer srv;
   master.install_routes(srv);
@@ -1101,6 +1698,10 @@ int main(int argc, char** argv) {
   printf("dtpu-master listening on %s:%d (state: %s)\n", host.c_str(), bound,
          state_dir.c_str());
   fflush(stdout);
-  // serve forever
-  while (true) std::this_thread::sleep_for(std::chrono::seconds(3600));
+  // serve forever; hourly housekeeping (log retention)
+  while (true) {
+    std::this_thread::sleep_for(std::chrono::seconds(3600));
+    std::lock_guard<std::mutex> lk(master.mu_);
+    master.retention_sweep();
+  }
 }

@@ -149,6 +149,19 @@ class SearchCtx {
   SearchAction create_with(Json hp) { return {SearchAction::Kind::Create, next_id(), std::move(hp)}; }
   const Json& space() const { return space_; }
 
+  // mt19937_64 round-trips exactly through its stream operators, so a
+  // restored searcher draws the same hparam sequence it would have live
+  Json snapshot() const {
+    std::ostringstream ss;
+    ss << rng_;
+    return Json::object().set("next_id", Json(next_id_)).set("rng", ss.str());
+  }
+  void restore(const Json& s) {
+    next_id_ = s["next_id"].as_int(1);
+    std::istringstream ss(s["rng"].as_string());
+    ss >> rng_;
+  }
+
  private:
   Json space_;
   std::mt19937_64 rng_;
@@ -164,6 +177,11 @@ class SearchMethod {
                                                          double metric, int64_t step) = 0;
   virtual std::vector<SearchAction> trial_exited(SearchCtx& ctx, int64_t rid) = 0;
   virtual double progress() const = 0;
+  // full method state for journal compaction (reference searcher.go:226
+  // Snapshot/Restore); restore() is called on a freshly-constructed method
+  // built from the same experiment config
+  virtual Json snapshot() const = 0;
+  virtual void restore(const Json& s) = 0;
 };
 
 class SingleSearch : public SearchMethod {
@@ -179,6 +197,8 @@ class SingleSearch : public SearchMethod {
     return {{SearchAction::Kind::Shutdown}};
   }
   double progress() const override { return closed_ ? 1.0 : 0.0; }
+  Json snapshot() const override { return Json::object().set("closed", Json(closed_)); }
+  void restore(const Json& s) override { closed_ = s["closed"].as_bool(false); }
 
  private:
   bool closed_ = false;
@@ -211,6 +231,15 @@ class RandomSearch : public SearchMethod {
   double progress() const override {
     return std::min(1.0, static_cast<double>(closed_) / max_trials_);
   }
+  Json snapshot() const override {
+    return Json::object()
+        .set("created", Json(static_cast<int64_t>(created_)))
+        .set("closed", Json(static_cast<int64_t>(closed_)));
+  }
+  void restore(const Json& s) override {
+    created_ = static_cast<int>(s["created"].as_int(0));
+    closed_ = static_cast<int>(s["closed"].as_int(0));
+  }
 
  private:
   int max_trials_, max_concurrent_, created_ = 0, closed_ = 0;
@@ -239,6 +268,16 @@ class GridSearch : public SearchMethod {
   double progress() const override {
     return points_.empty() ? 1.0
                            : std::min(1.0, static_cast<double>(closed_) / points_.size());
+  }
+  // points_ re-derives deterministically from the hp space at construction
+  Json snapshot() const override {
+    return Json::object()
+        .set("next", Json(static_cast<int64_t>(next_)))
+        .set("closed", Json(static_cast<int64_t>(closed_)));
+  }
+  void restore(const Json& s) override {
+    next_ = static_cast<size_t>(s["next"].as_int(0));
+    closed_ = static_cast<size_t>(s["closed"].as_int(0));
   }
 
  private:
@@ -304,6 +343,46 @@ class AshaSearch : public SearchMethod {
       p = std::max(p, static_cast<double>(completed_) / max_trials_);
     }
     return std::min(p, 1.0);
+  }
+
+  Json snapshot() const override {
+    Json rungs = Json::array();
+    for (const auto& r : rungs_) {
+      Json entries = Json::array();
+      for (const auto& [metric, rid] : r.metrics) {
+        entries.push_back(Json::array().push_back(Json(metric)).push_back(Json(rid)));
+      }
+      rungs.push_back(entries);
+    }
+    Json trial_rungs = Json::object();
+    for (const auto& [rid, rung] : trial_rungs_) {
+      trial_rungs.set(std::to_string(rid), Json(static_cast<int64_t>(rung)));
+    }
+    Json stopped = Json::array();
+    for (int64_t rid : stopped_) stopped.push_back(Json(rid));
+    return Json::object()
+        .set("completed", Json(static_cast<int64_t>(completed_)))
+        .set("rungs", rungs)
+        .set("trial_rungs", trial_rungs)
+        .set("stopped", stopped);
+  }
+
+  void restore(const Json& s) override {
+    completed_ = static_cast<int>(s["completed"].as_int(0));
+    const auto& rungs = s["rungs"].elements();
+    for (size_t i = 0; i < rungs.size() && i < rungs_.size(); ++i) {
+      rungs_[i].metrics.clear();
+      for (const auto& e : rungs[i].elements()) {
+        rungs_[i].metrics.push_back({e.elements()[0].as_double(),
+                                     e.elements()[1].as_int()});
+      }
+    }
+    trial_rungs_.clear();
+    for (const auto& [rid, rung] : s["trial_rungs"].items()) {
+      trial_rungs_[std::stoll(rid)] = static_cast<int>(rung.as_int(0));
+    }
+    stopped_.clear();
+    for (const auto& rid : s["stopped"].elements()) stopped_.insert(rid.as_int());
   }
 
  private:
@@ -384,6 +463,33 @@ class TournamentSearch : public SearchMethod {
     double sum = 0;
     for (const auto& s : subs_) sum += s->progress();
     return sum / subs_.size();
+  }
+
+  Json snapshot() const override {
+    Json subs = Json::array();
+    for (const auto& s : subs_) subs.push_back(s->snapshot());
+    Json owner = Json::object();
+    for (const auto& [rid, sub] : owner_) {
+      owner.set(std::to_string(rid), Json(static_cast<int64_t>(sub)));
+    }
+    Json closed = Json::array();
+    for (bool b : closed_) closed.push_back(Json(b));
+    return Json::object().set("subs", subs).set("owner", owner).set("closed", closed);
+  }
+
+  void restore(const Json& s) override {
+    const auto& subs = s["subs"].elements();
+    for (size_t i = 0; i < subs.size() && i < subs_.size(); ++i) {
+      subs_[i]->restore(subs[i]);
+    }
+    owner_.clear();
+    for (const auto& [rid, sub] : s["owner"].items()) {
+      owner_[std::stoll(rid)] = static_cast<size_t>(sub.as_int(0));
+    }
+    const auto& closed = s["closed"].elements();
+    for (size_t i = 0; i < closed.size() && i < closed_.size(); ++i) {
+      closed_[i] = closed[i].as_bool(false);
+    }
   }
 
  private:
