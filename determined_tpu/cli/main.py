@@ -1,9 +1,13 @@
 """dtpu CLI: the ``det`` command-line equivalent.
 
 Reference: ``harness/determined/cli/`` (declarative argparse per noun:
-experiment/trial/agent/checkpoint/master).  Talks to the master REST API
-via the same Session the harness uses; ``run-local`` drives the in-process
-LocalExperiment runner for masterless single-host searches.
+experiment/trial/agent/checkpoint/master/user).  Built on the Python SDK
+(``determined_tpu.client``) the way the reference CLI sits on
+``experimental/client.py``; authentication follows the reference contract
+(token cache in ``~/.dtpu/auth.json``, auto-login as the ``determined``
+user when no credentials are given; ``common/api/authentication.py``).
+``run-local`` drives the in-process LocalExperiment runner for masterless
+single-host searches.
 """
 
 from __future__ import annotations
@@ -17,11 +21,11 @@ import time
 from typing import Any, Dict, List, Optional
 
 
-def _session(args):
-    from determined_tpu.api.session import Session
+def _client(args):
+    from determined_tpu.client import Determined
 
     url = args.master or os.environ.get("DTPU_MASTER", "http://127.0.0.1:8080")
-    return Session(url)
+    return Determined(url, user=getattr(args, "user", None) or None)
 
 
 def _print_json(obj: Any) -> None:
@@ -38,60 +42,89 @@ def _table(rows: List[Dict[str, Any]], cols: List[str]) -> None:
         print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
 
 
+# ---- auth ------------------------------------------------------------------
+
+
+def do_login(args) -> int:
+    from determined_tpu import client
+
+    url = args.master or os.environ.get("DTPU_MASTER", "http://127.0.0.1:8080")
+    username = args.user or "determined"
+    password = args.password
+    if password is None:
+        if sys.stdin.isatty():
+            import getpass
+
+            password = getpass.getpass(f"password for {username}: ")
+        else:
+            password = ""
+    d = client.login(url, user=username, password=password)
+    who = d.whoami()
+    print(f"logged in as {who['username']} (admin={who['admin']}) at {url}")
+    return 0
+
+
+def do_whoami(args) -> int:
+    _print_json(_client(args).whoami())
+    return 0
+
+
+def user_create(args) -> int:
+    _client(args).create_user(args.username, args.password or "", args.admin)
+    print(f"created user {args.username}")
+    return 0
+
+
+def user_list(args) -> int:
+    rows = _client(args).session.get("/api/v1/users").json()
+    _table(rows, ["username", "admin"])
+    return 0
+
+
 # ---- experiment ------------------------------------------------------------
 
 
 def exp_create(args) -> int:
-    import yaml
-
-    with open(args.config) as f:
-        config = yaml.safe_load(f)
-    # config validation before submit (reference validates cluster-side too)
-    from determined_tpu.config.experiment import ExperimentConfig
-
-    ExperimentConfig.parse(dict(config))
-    body: Dict[str, Any] = {"config": config}
+    d = _client(args)
+    context_bytes = None
     if getattr(args, "context_dir", None):
-        import base64
-
         from determined_tpu.common import build_context
 
-        data = build_context(args.context_dir)
-        body["context"] = base64.b64encode(data).decode("ascii")
-        print(f"context: {args.context_dir} ({len(data)} bytes packed)")
-    resp = _session(args).post("/api/v1/experiments", json=body)
-    exp_id = resp.json()["id"]
-    print(f"Created experiment {exp_id}")
+        context_bytes = build_context(args.context_dir)
+        print(f"context: {args.context_dir} ({len(context_bytes)} bytes packed)")
+    exp = d.create_experiment(
+        args.config, context_dir=args.context_dir, context_bytes=context_bytes
+    )
+    print(f"Created experiment {exp.id}")
     if args.follow:
-        return exp_wait(args, exp_id)
+        return exp_wait(args, exp.id)
     return 0
 
 
 def exp_wait(args, exp_id: int) -> int:
-    s = _session(args)
+    exp = _client(args).get_experiment(exp_id)
     last_state = None
     while True:
-        exp = s.get(f"/api/v1/experiments/{exp_id}").json()
-        if exp["state"] != last_state:
-            print(f"state: {exp['state']} (progress {exp.get('progress', 0):.0%})")
-            last_state = exp["state"]
-        if exp["state"] in ("COMPLETED", "CANCELED", "ERROR"):
-            return 0 if exp["state"] == "COMPLETED" else 1
+        exp.reload()
+        if exp.state != last_state:
+            print(f"state: {exp.state} (progress {exp.progress:.0%})")
+            last_state = exp.state
+        if exp.state in ("COMPLETED", "CANCELED", "ERROR"):
+            return 0 if exp.state == "COMPLETED" else 1
         time.sleep(2)
 
 
 def exp_list(args) -> int:
-    exps = _session(args).get("/api/v1/experiments").json()
     _table(
         [
             {
-                "id": e["id"],
+                "id": e.id,
                 "name": e.get("name", ""),
-                "state": e["state"],
-                "progress": f"{e.get('progress', 0):.0%}",
+                "state": e.state,
+                "progress": f"{e.progress:.0%}",
                 "trials": len(e.get("trials", [])),
             }
-            for e in exps
+            for e in _client(args).list_experiments()
         ],
         ["id", "name", "state", "progress", "trials"],
     )
@@ -99,13 +132,14 @@ def exp_list(args) -> int:
 
 
 def exp_describe(args) -> int:
-    _print_json(_session(args).get(f"/api/v1/experiments/{args.id}").json())
+    _print_json(_client(args).get_experiment(args.id).to_dict())
     return 0
 
 
 def exp_signal(args) -> int:
-    resp = _session(args).post(f"/api/v1/experiments/{args.id}/{args.verb}")
-    print(f"experiment {args.id}: {resp.json()['state']}")
+    exp = _client(args).get_experiment(args.id)
+    exp = getattr(exp, args.verb)()
+    print(f"experiment {args.id}: {exp.state}")
     return 0
 
 
@@ -113,61 +147,169 @@ def exp_signal(args) -> int:
 
 
 def trial_describe(args) -> int:
-    _print_json(_session(args).get(f"/api/v1/trials/{args.id}").json())
+    _print_json(_client(args).get_trial(args.id).to_dict())
     return 0
 
 
 def trial_logs(args) -> int:
-    s = _session(args)
-    offset = 0
-    while True:
-        lines = s.get(f"/api/v1/trials/{args.id}/logs", params={"offset": offset}).json()
-        for line in lines:
-            print(line)
-        offset += len(lines)
-        if not args.follow:
-            return 0
-        trial = s.get(f"/api/v1/trials/{args.id}").json()
-        if trial["state"] not in ("PENDING", "RUNNING"):
-            return 0
-        time.sleep(1)
-
-
-def trial_metrics(args) -> int:
-    params = {"group": args.group} if args.group else None
-    _print_json(
-        _session(args).get(f"/api/v1/trials/{args.id}/metrics", params=params).json()
-    )
+    for line in _client(args).get_trial(args.id).logs(follow=args.follow):
+        print(line)
     return 0
 
 
-# ---- agents / checkpoints / master ----------------------------------------
+def trial_metrics(args) -> int:
+    _print_json(list(_client(args).get_trial(args.id).iter_metrics(group=args.group)))
+    return 0
+
+
+# ---- agents / checkpoints / models / master --------------------------------
 
 
 def agent_list(args) -> int:
-    _table(
-        _session(args).get("/api/v1/agents").json(),
-        ["id", "host", "slots", "used_slots"],
-    )
+    _table(_client(args).list_agents(), ["id", "host", "slots", "used_slots"])
     return 0
 
 
 def checkpoint_list(args) -> int:
-    cps = _session(args).get("/api/v1/checkpoints").json()
     _table(
         [
-            {"uuid": c["uuid"], "trial_id": c.get("trial_id"),
-             "steps": (c.get("metadata") or {}).get("steps_completed")}
-            for c in cps
+            {
+                "uuid": c.uuid,
+                "trial_id": c.trial_id,
+                "steps": c.metadata.get("steps_completed"),
+            }
+            for c in _client(args).list_checkpoints()
         ],
         ["uuid", "trial_id", "steps"],
     )
     return 0
 
 
-def master_info(args) -> int:
-    _print_json(_session(args).get("/api/v1/master").json())
+def model_create(args) -> int:
+    m = _client(args).create_model(args.name, description=args.description or "")
+    print(f"created model {m.name}")
     return 0
+
+
+def model_list(args) -> int:
+    _table(
+        [{"name": m.name, "versions": m.get("num_versions", "")} for m in _client(args).get_models()],
+        ["name", "versions"],
+    )
+    return 0
+
+
+def model_register_version(args) -> int:
+    v = _client(args).get_model(args.name).register_version(args.checkpoint_uuid)
+    print(f"registered {args.name} version {v.version}")
+    return 0
+
+
+def master_info(args) -> int:
+    _print_json(_client(args).master_info())
+    return 0
+
+
+# ---- tensorboard / tasks ---------------------------------------------------
+
+
+def tensorboard_start(args) -> int:
+    d = _client(args)
+    info = d.start_tensorboard(experiment_ids=args.experiment_ids or [])
+    info = d.wait_task_ready(info["id"], timeout=args.timeout)
+    url = d.master + info["proxy_url"]
+    print(f"tensorboard {info['id']} ready: {url}")
+    return 0
+
+
+def task_list(args) -> int:
+    _table(_client(args).list_tasks(), ["id", "type", "state", "ready", "agent_id"])
+    return 0
+
+
+def task_kill(args) -> int:
+    _client(args).kill_task(args.id)
+    print(f"killed {args.id}")
+    return 0
+
+
+# ---- devcluster (det deploy local analog) ----------------------------------
+
+
+def _find_binary(name: str) -> str:
+    import shutil
+
+    env = os.environ.get(f"DTPU_{name.upper().replace('-', '_')}_BIN")
+    if env and os.path.exists(env):
+        return env
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    candidate = os.path.join(here, "native", "build", name)
+    if os.path.exists(candidate):
+        return candidate
+    found = shutil.which(name)
+    if found:
+        return found
+    raise SystemExit(
+        f"{name} not found: build with `cmake -S native -B native/build && "
+        f"cmake --build native/build` or set DTPU_{name.upper().replace('-', '_')}_BIN"
+    )
+
+
+def cluster_up(args) -> int:
+    """Start a local master + N agents (reference: `det deploy local
+    cluster-up`, minus docker — TPU VMs run processes directly)."""
+    import signal as _signal
+    import subprocess
+
+    master_bin = _find_binary("dtpu-master")
+    agent_bin = _find_binary("dtpu-agent")
+    os.makedirs(args.state_dir, exist_ok=True)
+    os.makedirs(args.checkpoint_dir, exist_ok=True)
+    procs = [
+        subprocess.Popen(
+            [
+                master_bin,
+                "--host", "127.0.0.1",
+                "--port", str(args.port),
+                "--state-dir", args.state_dir,
+                "--checkpoint-dir", args.checkpoint_dir,
+                "--scheduler", args.scheduler,
+            ]
+        )
+    ]
+    import time as _time
+
+    url = f"http://127.0.0.1:{args.port}"
+    for i in range(args.agents):
+        procs.append(
+            subprocess.Popen(
+                [
+                    agent_bin,
+                    "--master-host", "127.0.0.1",
+                    "--master-port", str(args.port),
+                    "--id", f"agent-{i}",
+                    "--slots", str(args.slots),
+                ]
+            )
+        )
+    print(f"devcluster up: master {url}, {args.agents} agent(s) x {args.slots} slots")
+    print("Ctrl-C to tear down")
+    try:
+        while all(p.poll() is None for p in procs):
+            _time.sleep(1)
+        print("a devcluster process exited; tearing down", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(_signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                p.kill()
 
 
 # ---- search preview + local run -------------------------------------------
@@ -218,7 +360,21 @@ def run_local(args) -> int:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dtpu", description="determined-tpu CLI")
     p.add_argument("-m", "--master", help="master url (default $DTPU_MASTER)")
+    p.add_argument("-u", "--user", help="username (default: cached or 'determined')")
     sub = p.add_subparsers(dest="noun", required=True)
+
+    lg = sub.add_parser("login")
+    lg.add_argument("-p", "--password")
+    lg.set_defaults(fn=do_login)
+    sub.add_parser("whoami").set_defaults(fn=do_whoami)
+
+    user = sub.add_parser("user").add_subparsers(dest="verb", required=True)
+    uc = user.add_parser("create")
+    uc.add_argument("username")
+    uc.add_argument("-p", "--password")
+    uc.add_argument("--admin", action="store_true")
+    uc.set_defaults(fn=user_create)
+    user.add_parser("list").set_defaults(fn=user_list)
 
     exp = sub.add_parser("experiment", aliases=["e"]).add_subparsers(
         dest="verb", required=True
@@ -266,8 +422,42 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ckpt.add_parser("list").set_defaults(fn=checkpoint_list)
 
+    model = sub.add_parser("model").add_subparsers(dest="verb", required=True)
+    mc = model.add_parser("create")
+    mc.add_argument("name")
+    mc.add_argument("--description")
+    mc.set_defaults(fn=model_create)
+    model.add_parser("list").set_defaults(fn=model_list)
+    mr = model.add_parser("register-version")
+    mr.add_argument("name")
+    mr.add_argument("checkpoint_uuid")
+    mr.set_defaults(fn=model_register_version)
+
     master = sub.add_parser("master").add_subparsers(dest="verb", required=True)
     master.add_parser("info").set_defaults(fn=master_info)
+
+    tb = sub.add_parser("tensorboard").add_subparsers(dest="verb", required=True)
+    ts = tb.add_parser("start")
+    ts.add_argument("experiment_ids", nargs="*", type=int)
+    ts.add_argument("--timeout", type=float, default=60.0)
+    ts.set_defaults(fn=tensorboard_start)
+
+    task = sub.add_parser("task").add_subparsers(dest="verb", required=True)
+    task.add_parser("list").set_defaults(fn=task_list)
+    tk = task.add_parser("kill")
+    tk.add_argument("id")
+    tk.set_defaults(fn=task_kill)
+
+    cl = sub.add_parser("cluster").add_subparsers(dest="verb", required=True)
+    cu = cl.add_parser("up")
+    cu.add_argument("--port", type=int, default=8080)
+    cu.add_argument("--agents", type=int, default=1)
+    cu.add_argument("--slots", type=int, default=4)
+    cu.add_argument("--scheduler", default="priority",
+                    choices=["priority", "fair_share"])
+    cu.add_argument("--state-dir", default="/tmp/dtpu-master")
+    cu.add_argument("--checkpoint-dir", default="/tmp/dtpu-checkpoints")
+    cu.set_defaults(fn=cluster_up)
 
     ps = sub.add_parser("preview-search")
     ps.add_argument("config")
@@ -283,11 +473,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from determined_tpu.api.session import APIError
+
     args = build_parser().parse_args(argv)
     try:
         return args.fn(args)
     except KeyboardInterrupt:
         return 130
+    except APIError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

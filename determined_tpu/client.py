@@ -1,0 +1,456 @@
+"""Python SDK: ORM-ish client objects over the master REST API.
+
+Reference: ``harness/determined/experimental/client.py:107-623`` —
+``Determined`` entry object with ``create_experiment`` / ``get_experiment``
+/ ``get_trial`` / checkpoint + model registry objects, and module-level
+convenience functions bound to a default client.  The CLI is built on this
+SDK, so every CLI verb is scriptable.
+
+Usage::
+
+    from determined_tpu import client
+    d = client.Determined("http://master:8080")
+    exp = d.create_experiment("exp.yaml", context_dir="./model")
+    exp.wait()
+    best = exp.best_trial(metric="validation_accuracy", smaller_is_better=False)
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+from determined_tpu.api.authentication import ensure_session, login as _auth_login
+from determined_tpu.api.session import Session
+
+TERMINAL_STATES = ("COMPLETED", "CANCELED", "ERROR")
+
+
+class _Resource:
+    """Base for API-backed objects: a Session + a raw dict snapshot."""
+
+    def __init__(self, session: Session, data: Dict[str, Any]) -> None:
+        self._session = session
+        self._data = dict(data)
+
+    def __getitem__(self, key: str) -> Any:
+        return self._data[key]
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._data.get(key, default)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._data)
+
+
+class Experiment(_Resource):
+    @property
+    def id(self) -> int:
+        return int(self._data["id"])
+
+    @property
+    def state(self) -> str:
+        return self._data["state"]
+
+    @property
+    def progress(self) -> float:
+        return float(self._data.get("progress", 0.0))
+
+    @property
+    def config(self) -> Dict[str, Any]:
+        return self._data.get("config") or {}
+
+    def reload(self) -> "Experiment":
+        self._data = self._session.get(f"/api/v1/experiments/{self.id}").json()
+        return self
+
+    def _signal(self, verb: str) -> "Experiment":
+        self._session.post(f"/api/v1/experiments/{self.id}/{verb}")
+        return self.reload()
+
+    def pause(self) -> "Experiment":
+        return self._signal("pause")
+
+    def activate(self) -> "Experiment":
+        return self._signal("activate")
+
+    def cancel(self) -> "Experiment":
+        return self._signal("cancel")
+
+    def kill(self) -> "Experiment":
+        return self._signal("kill")
+
+    def wait(self, timeout: Optional[float] = None, interval: float = 1.0) -> str:
+        """Poll until the experiment reaches a terminal state; returns it."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            self.reload()
+            if self.state in TERMINAL_STATES:
+                return self.state
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(
+                    f"experiment {self.id} still {self.state} after {timeout}s"
+                )
+            time.sleep(interval)
+
+    def get_trials(self) -> List["Trial"]:
+        self.reload()
+        return [
+            Trial(self._session, t if isinstance(t, dict) else {"id": t})
+            for t in self._data.get("trials", [])
+        ]
+
+    def best_trial(
+        self, metric: Optional[str] = None, smaller_is_better: Optional[bool] = None
+    ) -> Optional["Trial"]:
+        """Trial with the best reported searcher metric (reference:
+        client.py Experiment top_checkpoint / ordering semantics)."""
+        scfg = (self.config.get("searcher") or {})
+        metric = metric or scfg.get("metric", "loss")
+        if smaller_is_better is None:
+            smaller_is_better = bool(scfg.get("smaller_is_better", True))
+        best, best_val = None, None
+        for t in self.get_trials():
+            val = t.reload().summary_metric(metric)
+            if val is None:
+                continue
+            if (
+                best_val is None
+                or (smaller_is_better and val < best_val)
+                or (not smaller_is_better and val > best_val)
+            ):
+                best, best_val = t, val
+        return best
+
+
+class Trial(_Resource):
+    @property
+    def id(self) -> int:
+        return int(self._data["id"])
+
+    @property
+    def state(self) -> str:
+        return self._data.get("state", "")
+
+    def reload(self) -> "Trial":
+        self._data = self._session.get(f"/api/v1/trials/{self.id}").json()
+        return self
+
+    def iter_metrics(self, group: Optional[str] = None) -> Iterator[Dict[str, Any]]:
+        """Yield reported metric records, oldest first (reference:
+        client.py Trial.iter_metrics / stream_trials_metrics)."""
+        params = {"group": group} if group else None
+        rows = self._session.get(
+            f"/api/v1/trials/{self.id}/metrics", params=params
+        ).json()
+        yield from rows
+
+    def summary_metric(self, name: str, group: str = "validation") -> Optional[float]:
+        """Latest reported value of one validation metric."""
+        last = None
+        for row in self.iter_metrics(group=group):
+            metrics = row.get("metrics", row)
+            if name in metrics:
+                last = metrics[name]
+        return None if last is None else float(last)
+
+    def logs(
+        self, follow: bool = False, timeout: Optional[float] = None
+    ) -> Iterator[str]:
+        """Yield log lines; ``follow=True`` streams until the trial leaves
+        PENDING/RUNNING (or ``timeout`` seconds elapse, if given)."""
+        offset = 0
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            lines = self._session.get(
+                f"/api/v1/trials/{self.id}/logs", params={"offset": offset}
+            ).json()
+            yield from lines
+            offset += len(lines)
+            if not follow:
+                return
+            self.reload()
+            if self.state not in ("PENDING", "RUNNING"):
+                return
+            if deadline is not None and time.time() > deadline:
+                return
+            time.sleep(0.5)
+
+    def list_checkpoints(self) -> List["Checkpoint"]:
+        cps = self._session.get("/api/v1/checkpoints").json()
+        return [
+            Checkpoint(self._session, c) for c in cps if c.get("trial_id") == self.id
+        ]
+
+
+class Checkpoint(_Resource):
+    @property
+    def uuid(self) -> str:
+        return self._data["uuid"]
+
+    @property
+    def trial_id(self) -> Optional[int]:
+        tid = self._data.get("trial_id")
+        return None if tid is None else int(tid)
+
+    @property
+    def metadata(self) -> Dict[str, Any]:
+        return self._data.get("metadata") or {}
+
+    def reload(self) -> "Checkpoint":
+        self._data = self._session.get(f"/api/v1/checkpoints/{self.uuid}").json()
+        return self
+
+    def delete(self) -> None:
+        self._session.delete(f"/api/v1/checkpoints/{self.uuid}")
+
+
+class ModelVersion(_Resource):
+    @property
+    def version(self) -> int:
+        return int(self._data["version"])
+
+    @property
+    def checkpoint_uuid(self) -> str:
+        return self._data.get("checkpoint_uuid", "")
+
+
+class Model(_Resource):
+    @property
+    def name(self) -> str:
+        return self._data["name"]
+
+    def reload(self) -> "Model":
+        self._data = self._session.get(f"/api/v1/models/{self.name}").json()
+        return self
+
+    def register_version(
+        self, checkpoint_uuid: str, metadata: Optional[Dict[str, Any]] = None
+    ) -> ModelVersion:
+        resp = self._session.post(
+            f"/api/v1/models/{self.name}/versions",
+            json={"checkpoint_uuid": checkpoint_uuid, "metadata": metadata or {}},
+        )
+        return ModelVersion(self._session, resp.json())
+
+    def get_versions(self) -> List[ModelVersion]:
+        rows = self._session.get(f"/api/v1/models/{self.name}/versions").json()
+        return [ModelVersion(self._session, r) for r in rows]
+
+
+class Determined:
+    """SDK entry point (reference: ``determined.experimental.Determined``)."""
+
+    def __init__(
+        self,
+        master: Optional[str] = None,
+        user: Optional[str] = None,
+        password: Optional[str] = None,
+        session: Optional[Session] = None,
+    ) -> None:
+        self.master = (
+            master or os.environ.get("DTPU_MASTER") or "http://127.0.0.1:8080"
+        )
+        self._session = session or ensure_session(self.master, user, password)
+
+    @property
+    def session(self) -> Session:
+        return self._session
+
+    # -- experiments --
+    def create_experiment(
+        self,
+        config: Union[str, Dict[str, Any]],
+        context_dir: Optional[str] = None,
+        context_bytes: Optional[bytes] = None,
+    ) -> Experiment:
+        """Submit an experiment; ``config`` is a yaml path or a dict.
+        ``context_dir`` is packed (honoring .detignore) and shipped;
+        pass ``context_bytes`` instead if you already packed it."""
+        if isinstance(config, str):
+            import yaml
+
+            with open(config) as f:
+                config = yaml.safe_load(f)
+        from determined_tpu.config.experiment import ExperimentConfig
+
+        ExperimentConfig.parse(dict(config))  # client-side validation
+        body: Dict[str, Any] = {"config": config}
+        if context_bytes is None and context_dir:
+            from determined_tpu.common import build_context
+
+            context_bytes = build_context(context_dir)
+        if context_bytes is not None:
+            body["context"] = base64.b64encode(context_bytes).decode()
+        resp = self._session.post("/api/v1/experiments", json=body)
+        return Experiment(self._session, resp.json())
+
+    def get_experiment(self, experiment_id: int) -> Experiment:
+        return Experiment(
+            self._session,
+            self._session.get(f"/api/v1/experiments/{experiment_id}").json(),
+        )
+
+    def list_experiments(self) -> List[Experiment]:
+        rows = self._session.get("/api/v1/experiments").json()
+        return [Experiment(self._session, r) for r in rows]
+
+    # -- trials / checkpoints --
+    def get_trial(self, trial_id: int) -> Trial:
+        return Trial(
+            self._session, self._session.get(f"/api/v1/trials/{trial_id}").json()
+        )
+
+    def get_checkpoint(self, uuid: str) -> Checkpoint:
+        return Checkpoint(
+            self._session, self._session.get(f"/api/v1/checkpoints/{uuid}").json()
+        )
+
+    def list_checkpoints(self) -> List[Checkpoint]:
+        rows = self._session.get("/api/v1/checkpoints").json()
+        return [Checkpoint(self._session, r) for r in rows]
+
+    # -- model registry --
+    def create_model(
+        self, name: str, description: str = "", metadata: Optional[Dict] = None
+    ) -> Model:
+        resp = self._session.post(
+            "/api/v1/models",
+            json={"name": name, "description": description, "metadata": metadata or {}},
+        )
+        return Model(self._session, resp.json())
+
+    def get_model(self, name: str) -> Model:
+        return Model(self._session, self._session.get(f"/api/v1/models/{name}").json())
+
+    def get_models(self) -> List[Model]:
+        rows = self._session.get("/api/v1/models").json()
+        return [Model(self._session, r) for r in rows]
+
+    # -- generic tasks (NTSC: tensorboard viewer behind the proxy) --
+    def start_tensorboard(
+        self, experiment_ids: Optional[List[int]] = None
+    ) -> Dict[str, Any]:
+        """Launch a tensorboard/metrics-viewer task; returns task info with
+        ``proxy_url`` (reference: ``det tensorboard start``)."""
+        resp = self._session.post(
+            "/api/v1/tasks",
+            json={
+                "type": "tensorboard",
+                "config": {"experiment_ids": experiment_ids or []},
+            },
+        )
+        return resp.json()
+
+    def get_task(self, task_id: str) -> Dict[str, Any]:
+        return self._session.get(f"/api/v1/tasks/{task_id}").json()
+
+    def list_tasks(self) -> List[Dict[str, Any]]:
+        return self._session.get("/api/v1/tasks").json()
+
+    def kill_task(self, task_id: str) -> None:
+        self._session.delete(f"/api/v1/tasks/{task_id}")
+
+    def wait_task_ready(self, task_id: str, timeout: float = 60.0) -> Dict[str, Any]:
+        deadline = time.time() + timeout
+        while True:
+            info = self.get_task(task_id)
+            if info.get("ready"):
+                return info
+            if info.get("state") == "TERMINATED":
+                raise RuntimeError(f"task {task_id} terminated before ready")
+            if time.time() > deadline:
+                raise TimeoutError(f"task {task_id} not ready after {timeout}s")
+            time.sleep(0.5)
+
+    # -- streaming updates --
+    def stream_events(
+        self, since: int = 0, poll_timeout: int = 30
+    ) -> Iterator[Dict[str, Any]]:
+        """Follow the master's seq-ordered event feed (reference:
+        streams client over internal/stream/ websocket deltas; here a
+        long-polled journal tail).  Yields events forever; track
+        ``event["seq"]`` to resume."""
+        while True:
+            rows = self._session.get(
+                "/api/v1/events",
+                params={"since": since, "timeout_seconds": poll_timeout},
+                timeout=poll_timeout + 15,
+            ).json()
+            for ev in rows:
+                since = max(since, int(ev.get("seq", 0)))
+                yield ev
+
+    def get_events(self, since: int = 0) -> List[Dict[str, Any]]:
+        return self._session.get("/api/v1/events", params={"since": since}).json()
+
+    # -- cluster --
+    def list_agents(self) -> List[Dict[str, Any]]:
+        return self._session.get("/api/v1/agents").json()
+
+    def master_info(self) -> Dict[str, Any]:
+        return self._session.get("/api/v1/master").json()
+
+    def whoami(self) -> Dict[str, Any]:
+        return self._session.get("/api/v1/auth/whoami").json()
+
+    def create_user(
+        self, username: str, password: str = "", admin: bool = False
+    ) -> Dict[str, Any]:
+        return self._session.post(
+            "/api/v1/users",
+            json={"username": username, "password": password, "admin": admin},
+        ).json()
+
+
+# -- module-level convenience (reference: client.py module functions bound to
+#    a lazily-created default Determined) --
+
+_default_client: Optional[Determined] = None
+
+
+def login(
+    master: Optional[str] = None,
+    user: Optional[str] = None,
+    password: Optional[str] = None,
+) -> Determined:
+    """Authenticate (caching the token) and set the default client."""
+    global _default_client
+    master = master or os.environ.get("DTPU_MASTER") or "http://127.0.0.1:8080"
+    if user is not None:
+        session = _auth_login(master, user, password or "")
+        _default_client = Determined(master, session=session)
+    else:
+        _default_client = Determined(master)
+    return _default_client
+
+
+def _require_client() -> Determined:
+    global _default_client
+    if _default_client is None:
+        _default_client = Determined()
+    return _default_client
+
+
+def create_experiment(
+    config: Union[str, Dict[str, Any]], context_dir: Optional[str] = None
+) -> Experiment:
+    return _require_client().create_experiment(config, context_dir)
+
+
+def get_experiment(experiment_id: int) -> Experiment:
+    return _require_client().get_experiment(experiment_id)
+
+
+def get_trial(trial_id: int) -> Trial:
+    return _require_client().get_trial(trial_id)
+
+
+def get_checkpoint(uuid: str) -> Checkpoint:
+    return _require_client().get_checkpoint(uuid)
+
+
+def get_model(name: str) -> Model:
+    return _require_client().get_model(name)

@@ -29,6 +29,12 @@ def devices8():
     return devs[:8]
 
 
+@pytest.fixture(autouse=True)
+def _isolated_auth_cache(tmp_path, monkeypatch):
+    """Keep CLI/SDK token caches out of the real ~/.dtpu."""
+    monkeypatch.setenv("DTPU_AUTH_PATH", str(tmp_path / "auth.json"))
+
+
 @pytest.fixture()
 def tmp_storage(tmp_path):
     return str(tmp_path / "storage")

@@ -1,0 +1,86 @@
+"""Python SDK tests against a live devcluster (reference: experimental
+client.py tests / e2e_tests experiment helpers)."""
+
+import os
+
+import pytest
+
+from tests.test_devcluster import (  # noqa: F401  (fixture reuse)
+    AGENT_BIN,
+    MASTER_BIN,
+    DevCluster,
+    cluster,
+    exp_config,
+)
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(MASTER_BIN) and os.path.exists(AGENT_BIN)),
+    reason="native binaries not built",
+)
+
+
+def test_sdk_experiment_lifecycle(cluster, tmp_path):
+    from determined_tpu import client
+
+    d = client.Determined(cluster.url)  # auto-login as determined/blank
+    assert d.whoami()["username"] == "determined"
+
+    exp = d.create_experiment(exp_config(cluster.ckpt_dir))
+    assert exp.id >= 1
+    state = exp.wait(timeout=240)
+    assert state == "COMPLETED"
+
+    # trials + metrics through the ORM-ish objects
+    trials = exp.get_trials()
+    assert len(trials) == 1
+    trial = trials[0].reload()
+    assert trial.state == "COMPLETED"
+    rows = list(trial.iter_metrics(group="validation"))
+    assert rows and "validation_accuracy" in rows[-1]["metrics"]
+    assert trial.summary_metric("validation_accuracy") is not None
+
+    best = exp.best_trial()
+    assert best is not None and best.id == trial.id
+
+    # checkpoints + model registry round trip
+    cps = trial.list_checkpoints()
+    assert cps, "no checkpoints via SDK"
+    model = d.create_model("sdk-model", description="from sdk test")
+    v = model.register_version(cps[-1].uuid)
+    assert v.version == 1
+    assert model.get_versions()[0].checkpoint_uuid == cps[-1].uuid
+    assert any(m.name == "sdk-model" for m in d.get_models())
+
+    # logs stream through the SDK
+    logs = list(trial.logs())
+    assert any("trial finished" in str(l) for l in logs)
+
+    # agents visible
+    assert any(a["id"] == "agent-0" for a in d.list_agents())
+
+
+def test_sdk_explicit_login_and_users(cluster, tmp_path):
+    from determined_tpu import client
+    from determined_tpu.api.session import APIError
+
+    admin = client.login(cluster.url, user="admin", password="")
+    admin.create_user("alice", password="wonder", admin=False)
+    alice = client.Determined(cluster.url, user="alice", password="wonder")
+    assert alice.whoami() == {"username": "alice", "admin": False}
+    # non-admin cannot create users
+    with pytest.raises(APIError):
+        alice.create_user("bob")
+
+
+def test_sdk_pause_activate(cluster, tmp_path):
+    from determined_tpu import client
+
+    d = client.Determined(cluster.url)
+    cfg = exp_config(cluster.ckpt_dir)
+    cfg["searcher"]["max_length"] = {"batches": 40}
+    exp = d.create_experiment(cfg)
+    exp.pause()
+    assert exp.state == "PAUSED"
+    exp.activate()
+    assert exp.state == "ACTIVE"
+    assert exp.wait(timeout=300) == "COMPLETED"
