@@ -123,6 +123,7 @@ class ResourcesConfig:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     resource_pool: str = "default"
     priority: int = 42                            # reference default priority
+    weight: float = 1.0                           # fair-share weight
     single_slice: bool = False                    # refuse DCN-spanning gang splits
 
     @classmethod
@@ -205,6 +206,72 @@ class ReproducibilityConfig:
     experiment_seed: int = 0
 
 
+@dataclasses.dataclass(frozen=True)
+class OptimizationsConfig:
+    """Gradient accumulation — reference ``optimizations.aggregation_frequency``
+    (``_pytorch_context.py:708-914``).  Each optimizer step consumes
+    ``aggregation_frequency`` microbatches of ``global_batch_size`` via an
+    on-device ``lax.scan`` (no host round-trips between microbatches)."""
+
+    aggregation_frequency: int = 1
+    average_aggregated_gradients: bool = True
+
+    def __post_init__(self):
+        if self.aggregation_frequency < 1:
+            raise InvalidExperimentConfig(
+                "optimizations.aggregation_frequency must be >= 1"
+            )
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "OptimizationsConfig":
+        raw = dict(raw or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise InvalidExperimentConfig(f"unknown optimizations fields: {sorted(unknown)}")
+        return cls(**raw)
+
+
+_LOG_POLICY_ACTIONS = ("cancel_retries", "exclude_node")
+
+
+@dataclasses.dataclass(frozen=True)
+class LogPolicy:
+    """Regex monitor on task logs — reference ``logpattern.go:27-247`` and
+    ``expconf log_policies``.  ``cancel_retries``: a later trial failure is
+    terminal (no restarts); ``exclude_node``: restarts avoid the agent whose
+    logs matched."""
+
+    pattern: str
+    action: str
+    name: Optional[str] = None
+
+    def __post_init__(self):
+        if not self.pattern:
+            raise InvalidExperimentConfig("log_policies entries require a `pattern`")
+        if self.action not in _LOG_POLICY_ACTIONS:
+            raise InvalidExperimentConfig(
+                f"log_policies action {self.action!r} not in {_LOG_POLICY_ACTIONS}"
+            )
+        import re
+
+        try:
+            re.compile(self.pattern)
+        except re.error as e:
+            raise InvalidExperimentConfig(
+                f"log_policies pattern {self.pattern!r} is not a valid regex: {e}"
+            ) from None
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "LogPolicy":
+        raw = dict(raw or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise InvalidExperimentConfig(f"unknown log_policies fields: {sorted(unknown)}")
+        return cls(**raw)
+
+
 _CHECKPOINT_POLICIES = ("best", "all", "none")
 
 
@@ -232,9 +299,14 @@ class ExperimentConfig:
     reproducibility: ReproducibilityConfig = dataclasses.field(
         default_factory=ReproducibilityConfig
     )
+    optimizations: OptimizationsConfig = dataclasses.field(
+        default_factory=OptimizationsConfig
+    )
     environment: Dict[str, Any] = dataclasses.field(default_factory=dict)
     data: Dict[str, Any] = dataclasses.field(default_factory=dict)
     profiling: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    log_policies: List[LogPolicy] = dataclasses.field(default_factory=list)
+    unmanaged: bool = False
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict, compare=False)
 
     def __post_init__(self):
@@ -242,6 +314,23 @@ class ExperimentConfig:
             raise InvalidExperimentConfig(
                 f"checkpoint_policy {self.checkpoint_policy!r} not in {_CHECKPOINT_POLICIES}"
             )
+        if self.searcher.name == "grid":
+            # a grid over a continuous axis without `count` would silently
+            # collapse to one point; reject at parse time (master re-checks
+            # at submit: master.cpp validate_config)
+            from determined_tpu.config.hyperparameters import Double, Log
+
+            def walk(hp: Any, path: str) -> None:
+                if isinstance(hp, dict):
+                    for k, v in hp.items():
+                        walk(v, f"{path}.{k}" if path else str(k))
+                elif isinstance(hp, (Double, Log)) and hp.count is None:
+                    raise InvalidExperimentConfig(
+                        f"grid search over continuous hyperparameter {path!r} "
+                        "requires an explicit `count`"
+                    )
+
+            walk(self.hyperparameters, "")
 
     @classmethod
     def parse(cls, raw: Dict[str, Any]) -> "ExperimentConfig":
@@ -259,6 +348,13 @@ class ExperimentConfig:
             )
         if "reproducibility" in raw:
             kwargs["reproducibility"] = ReproducibilityConfig(**raw.pop("reproducibility"))
+        if "optimizations" in raw:
+            kwargs["optimizations"] = OptimizationsConfig.parse(raw.pop("optimizations"))
+        if "log_policies" in raw:
+            policies = raw.pop("log_policies") or []
+            if not isinstance(policies, list):
+                raise InvalidExperimentConfig("log_policies must be a list")
+            kwargs["log_policies"] = [LogPolicy.parse(p) for p in policies]
         for period in ("min_validation_period", "min_checkpoint_period"):
             if raw.get(period) is not None:
                 kwargs[period] = Length.parse(raw.pop(period))

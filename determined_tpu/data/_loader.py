@@ -104,15 +104,20 @@ def batch_spec(mesh: Mesh, ndim: int) -> PartitionSpec:
 
 
 def to_global(
-    batch: Dict[str, np.ndarray], mesh: Mesh
+    batch: Dict[str, np.ndarray], mesh: Mesh, micro_dim: bool = False
 ) -> Dict[str, jax.Array]:
     """Assemble per-process local batches into global, batch-sharded arrays.
 
     Single-process (incl. the 8-virtual-device CPU mesh): the local batch IS
     the global batch; multi-host: each process contributes its shard.
+    ``micro_dim``: leaves are stacked microbatches ``[agg, batch, ...]``
+    (gradient accumulation) — the batch axes shard dim 1, dim 0 replicates.
     """
     out: Dict[str, jax.Array] = {}
     for k, v in batch.items():
-        sharding = NamedSharding(mesh, batch_spec(mesh, v.ndim))
+        spec = batch_spec(mesh, v.ndim - 1 if micro_dim else v.ndim)
+        if micro_dim:
+            spec = PartitionSpec(None, *spec)
+        sharding = NamedSharding(mesh, spec)
         out[k] = jax.make_array_from_process_local_data(sharding, v)
     return out

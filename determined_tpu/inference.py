@@ -1,0 +1,126 @@
+"""Batch inference over a sharded dataset with checkpointed progress.
+
+Reference: ``harness/determined/pytorch/experimental/_torch_batch_process.py``
+(``TorchBatchProcessor``: each worker processes its dataset shard batch by
+batch; progress is checkpointed so a preempted job resumes where it left
+off).  TPU-first redesign: the processor's ``process_batch`` gets host
+numpy batches from this process's shard — model calls inside it are
+ordinary jitted functions, so the MXU path needs no special plumbing — and
+progress/preemption run through the same Core API contexts as training
+(dummy variants off-cluster).
+
+Usage::
+
+    class Embedder(inference.BatchProcessor):
+        def setup(self):
+            _, self.trainer = train.load_trial_from_checkpoint(path)
+        def process_batch(self, batch, batch_idx):
+            out = my_jitted_embed(self.trainer.state.params, batch["x"])
+            np.save(self.output_dir / f"part-{batch_idx}.npy", out)
+
+    inference.run_batch_inference(Embedder, dataset, batch_size=256)
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+from determined_tpu.data._loader import DataLoader
+
+logger = logging.getLogger("determined_tpu.inference")
+
+
+class BatchProcessor(abc.ABC):
+    """User hook object; one instance per worker process."""
+
+    def __init__(self, core_context: Any, rank: int, size: int) -> None:
+        self.core = core_context
+        self.rank = rank
+        self.size = size
+        self.setup()
+
+    def setup(self) -> None:
+        """Build models/outputs; runs once before the first batch."""
+
+    @abc.abstractmethod
+    def process_batch(self, batch: Dict[str, np.ndarray], batch_idx: int) -> None:
+        """Handle one host batch from this worker's shard."""
+
+    def on_finish(self) -> None:
+        """Runs after the shard is exhausted (chief and workers)."""
+
+
+def run_batch_inference(
+    processor_cls: Type[BatchProcessor],
+    dataset: Any,
+    batch_size: int,
+    core_context: Optional[Any] = None,
+    checkpoint_interval: int = 50,
+) -> int:
+    """Process the dataset once; returns batches processed by this worker.
+
+    - the dataset shards over the job's processes (same reproducible
+      sampler as training);
+    - every ``checkpoint_interval`` batches the chief records progress via
+      ``core.checkpoint`` metadata, and the preemption flag is polled —
+      a preempted run resumes from the recorded batch index.
+    """
+    from determined_tpu import core as core_mod
+
+    ctx = core_context or core_mod.init()
+    dist = ctx.distributed
+    loader = DataLoader(
+        dataset,
+        batch_size,
+        shuffle=False,
+        num_shards=dist.size,
+        shard_rank=dist.rank,
+    )
+
+    start_batch = 0
+    info = getattr(ctx, "info", None)
+    latest = getattr(info, "latest_checkpoint", None) if info else None
+    if latest:
+        with ctx.checkpoint.restore_path(latest) as path:
+            import json
+            import os
+
+            marker = os.path.join(path, "inference_progress.json")
+            if os.path.exists(marker):
+                with open(marker) as f:
+                    start_batch = int(json.load(f)["batches_done"])
+        logger.info("resuming batch inference at batch %d", start_batch)
+
+    proc = processor_cls(ctx, dist.rank, dist.size)
+    done = 0
+    batches = loader.sampler.epoch_batches(0)
+    total = loader.sampler.batches_per_epoch
+    for idx in range(start_batch, total):
+        from determined_tpu.data._loader import _fetch
+
+        batch = _fetch(dataset, batches[idx])
+        proc.process_batch(batch, idx)
+        done += 1
+        if done % checkpoint_interval == 0:
+            _record_progress(ctx, dist, idx + 1)
+            if ctx.preempt.should_preempt():
+                logger.info("preempted at batch %d; progress checkpointed", idx + 1)
+                return done
+    proc.on_finish()
+    return done
+
+
+def _record_progress(ctx: Any, dist: Any, batches_done: int) -> None:
+    import json
+    import os
+
+    if dist.is_chief:
+        with ctx.checkpoint.store_path({"batches_done": batches_done}) as (path, _sid):
+            with open(os.path.join(path, "inference_progress.json"), "w") as f:
+                json.dump({"batches_done": batches_done}, f)
+    if dist.size > 1:
+        dist.barrier()

@@ -180,7 +180,7 @@ class TransformerLM(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
+    def __call__(self, tokens: jax.Array, return_hidden: bool = False) -> jax.Array:
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size,
@@ -200,7 +200,7 @@ class TransformerLM(nn.Module):
         for i in range(cfg.n_layers):
             x = block_cls(cfg, self.mesh, name=f"block_{i}")(x)
         x = RMSNorm(name="ln_f")(x)
-        logits = nn.Dense(
+        lm_head = nn.Dense(
             cfg.vocab_size,
             use_bias=False,
             dtype=cfg.dtype,
@@ -209,8 +209,14 @@ class TransformerLM(nn.Module):
                 nn.initializers.lecun_normal(), ("embed", "vocab")
             ),
             name="lm_head",
-        )(x)
-        return logits.astype(jnp.float32)
+        )
+        if return_hidden:
+            # fused-CE path: the caller contracts x with lm_head's kernel
+            # chunk-by-chunk (ops/cross_entropy.py) so [b, s, vocab] logits
+            # never hit HBM.  Init always takes the logits path, so the
+            # param tree includes lm_head either way.
+            return x
+        return lm_head(x).astype(jnp.float32)
 
 
 class LMTrial(JaxTrial):
@@ -285,8 +291,27 @@ class LMTrial(JaxTrial):
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = model.apply(params, inputs)
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+        g = self.context.get_hparam
+        fused = g("fused_ce", "auto")
+        if fused == "auto":
+            fused = model.cfg.vocab_size >= 8192
+        if fused:
+            from flax.core import meta as flax_meta
+
+            from determined_tpu.ops.cross_entropy import fused_cross_entropy
+
+            hidden = model.apply(params, inputs, return_hidden=True)
+            kernel = flax_meta.unbox(params["params"]["lm_head"]["kernel"])
+            loss = fused_cross_entropy(
+                hidden,
+                kernel,
+                targets,
+                chunk_size=int(g("ce_chunk", 512)),
+                compute_dtype=model.cfg.dtype,
+            )
+        else:
+            logits = model.apply(params, inputs)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
         return loss, {"perplexity": jnp.exp(loss)}
 
     def evaluate_batch(

@@ -1,6 +1,7 @@
 """TPU compute ops: attention family (reference / Pallas flash / ring)."""
 
 from determined_tpu.ops.attention import dot_product_attention, reference_attention
+from determined_tpu.ops.cross_entropy import fused_cross_entropy
 from determined_tpu.ops.flash_attention import flash_attention
 from determined_tpu.ops.ring_attention import ring_attention
 
@@ -8,5 +9,6 @@ __all__ = [
     "dot_product_attention",
     "reference_attention",
     "flash_attention",
+    "fused_cross_entropy",
     "ring_attention",
 ]

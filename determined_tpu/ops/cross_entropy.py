@@ -1,0 +1,108 @@
+"""Fused blocked cross-entropy: lm_head matmul + softmax-CE without ever
+materializing the full ``[tokens, vocab]`` logits in HBM.
+
+Motivation (TPU): with V=32k vocab and f32 logits, the standard
+``logits = x @ W; softmax_xent(logits)`` pattern writes B*S*V*4 bytes to HBM
+and reads them back in the backward pass — ~1 GiB per step at d2048/s1024/b8
+— which is pure bandwidth waste on a bandwidth-bound chip (BASELINE.md: the
+bs16 step *regresses* because of it).  Here the token dimension is scanned
+in chunks: each chunk computes its logits tile in bf16 on the MXU, reduces
+to per-token loss in f32, and the tile dies in VMEM/registers.
+``jax.checkpoint`` on the chunk body makes the backward pass recompute the
+tile instead of storing it, so the only HBM traffic is x, W, and the scan
+carry.  The extra recompute is one lm_head matmul (<5% of model FLOPs); the
+saved traffic is the whole logits tensor, twice.
+
+The reference has no analog (loss math lives in user pytorch code); this is
+TPU-native design per SURVEY §7 hard-part (e).
+
+Sharding: hidden is batch-sharded (dp/fsdp), the kernel may be
+vocab-sharded (tp).  Everything here is plain jnp under jit, so XLA inserts
+the psum for the vocab-sharded logsumexp per chunk.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_loss(
+    x_chunk: jax.Array,       # [chunk, d]
+    kernel: jax.Array,        # [d, vocab]
+    tgt_chunk: jax.Array,     # [chunk] int; < 0 = ignore
+    compute_dtype,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sum of token losses + valid-token count for one chunk."""
+    logits = jnp.dot(
+        x_chunk.astype(compute_dtype),
+        kernel.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )  # [chunk, vocab] f32 accumulate on the MXU, lives only inside the chunk
+    valid = tgt_chunk >= 0
+    safe_tgt = jnp.where(valid, tgt_chunk, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)                     # [chunk]
+    tgt_logit = jnp.take_along_axis(
+        logits, safe_tgt[:, None], axis=-1
+    )[:, 0]                                                     # [chunk]
+    token_loss = jnp.where(valid, lse - tgt_logit, 0.0)
+    return token_loss.sum(), valid.sum().astype(jnp.float32)
+
+
+def fused_cross_entropy(
+    hidden: jax.Array,            # [batch, seq, d] (or [tokens, d])
+    kernel: jax.Array,            # [d, vocab]
+    targets: jax.Array,           # [batch, seq] (or [tokens]) int; < 0 ignored
+    *,
+    chunk_size: int = 512,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Mean softmax cross-entropy over valid tokens, logits never stored.
+
+    Equivalent to
+    ``optax.softmax_cross_entropy_with_integer_labels(hidden @ kernel, targets)``
+    masked-mean'd, to f32 accuracy of the bf16 matmul.
+    """
+    d = hidden.shape[-1]
+    x = hidden.reshape(-1, d)
+    tgt = targets.reshape(-1)
+    n = x.shape[0]
+
+    pad = (-n) % chunk_size
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)], axis=0)
+        tgt = jnp.concatenate([tgt, jnp.full((pad,), -1, tgt.dtype)], axis=0)
+    num_chunks = x.shape[0] // chunk_size
+    x = x.reshape(num_chunks, chunk_size, d)
+    tgt = tgt.reshape(num_chunks, chunk_size)
+
+    body = jax.checkpoint(
+        partial(_chunk_loss, compute_dtype=compute_dtype), prevent_cse=False
+    )
+
+    def scan_step(carry, chunk):
+        loss_sum, count = carry
+        xs, ts = chunk
+        s, c = body(xs, kernel, ts)
+        return (loss_sum + s, count + c), None
+
+    (loss_sum, count), _ = jax.lax.scan(
+        scan_step, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (x, tgt)
+    )
+    return loss_sum / jnp.maximum(count, 1.0)
+
+
+def naive_cross_entropy(
+    hidden: jax.Array, kernel: jax.Array, targets: jax.Array
+) -> jax.Array:
+    """Reference implementation (materializes logits); used by tests."""
+    logits = jnp.dot(hidden, kernel).astype(jnp.float32)
+    valid = targets >= 0
+    safe = jnp.where(valid, targets, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    per_tok = jnp.where(valid, lse - tgt, 0.0)
+    return per_tok.sum() / jnp.maximum(valid.sum().astype(jnp.float32), 1.0)

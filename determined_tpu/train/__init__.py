@@ -1,5 +1,7 @@
 """Training engine: JaxTrial + Trainer boundary loop + serialization."""
 
+from determined_tpu.train._load import load_trial_from_checkpoint
+from determined_tpu.train._reducer import MetricReducer, get_reducer
 from determined_tpu.train._state import TrainState
 from determined_tpu.train._trainer import Trainer, init
 from determined_tpu.train._trial import Callback, JaxTrial, TrialContext
@@ -8,9 +10,12 @@ from determined_tpu.train import serialization
 __all__ = [
     "Callback",
     "JaxTrial",
+    "MetricReducer",
     "TrainState",
     "Trainer",
     "TrialContext",
+    "get_reducer",
     "init",
+    "load_trial_from_checkpoint",
     "serialization",
 ]

@@ -1,0 +1,69 @@
+"""Validation-metric reducers: how per-batch metrics combine across the
+validation sweep.
+
+Reference: ``harness/determined/pytorch/_reducer.py`` (518 LoC) — there,
+reduction happens across *slots* via distributed gather.  TPU-first
+redesign: every per-batch metric is already a global scalar (computed from
+mesh-global arrays inside the jitted eval step), so cross-chip reduction is
+XLA's job; what the user controls is the across-batch combine.  A reducer
+is a (init, accumulate, finalize) triple that runs inside the jitted eval
+step, so custom reducers cost no extra host syncs.
+
+Built-ins match the reference's ``pytorch.Reducer`` enum: AVG/SUM/MIN/MAX
+(+ LAST).  Custom reducers subclass nothing — construct ``MetricReducer``
+with jit-able callables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricReducer:
+    """across-batch combine for one validation metric.
+
+    ``accumulate(carry, value) -> carry`` runs inside the jitted eval step
+    per batch; ``finalize(carry, n_batches) -> value`` runs once, host-side.
+    """
+
+    init: float
+    accumulate: Callable[[jax.Array, jax.Array], jax.Array]
+    finalize: Callable[[float, float], float] = lambda carry, n: carry
+
+
+MEAN = MetricReducer(
+    init=0.0,
+    accumulate=lambda c, v: c + v,
+    finalize=lambda c, n: c / max(n, 1.0),
+)
+SUM = MetricReducer(init=0.0, accumulate=lambda c, v: c + v)
+MIN = MetricReducer(init=float("inf"), accumulate=jnp.minimum)
+MAX = MetricReducer(init=float("-inf"), accumulate=jnp.maximum)
+LAST = MetricReducer(init=0.0, accumulate=lambda c, v: v)
+
+_BUILTINS: Dict[str, MetricReducer] = {
+    "mean": MEAN,
+    "avg": MEAN,
+    "sum": SUM,
+    "min": MIN,
+    "max": MAX,
+    "last": LAST,
+}
+
+ReducerSpec = Union[str, MetricReducer]
+
+
+def get_reducer(spec: ReducerSpec) -> MetricReducer:
+    if isinstance(spec, MetricReducer):
+        return spec
+    try:
+        return _BUILTINS[str(spec).lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown reducer {spec!r}; builtins: {sorted(_BUILTINS)}"
+        ) from None

@@ -164,6 +164,7 @@ class Trainer:
         self.best_validation: Optional[float] = None
         self._searcher_metric: Optional[str] = None
         self._smaller_is_better = True
+        self.agg = 1  # aggregation_frequency, set from exp config in _setup
 
     # -- setup -------------------------------------------------------------
 
@@ -226,17 +227,49 @@ class Trainer:
 
         # ---- jitted steps -------------------------------------------------
         trial, model, tx = self.trial, self.model, self.tx
+        opt = ctx.exp_config.optimizations if ctx.exp_config is not None else None
+        agg = opt.aggregation_frequency if opt else 1
+        average_grads = opt.average_aggregated_gradients if opt else True
+        self.agg = agg
 
         def train_step(state: TrainState, batch):
             step_rng = jax.random.fold_in(state.rng, state.step)
 
-            def loss_fn(p):
-                loss, m = trial.loss(model, p, batch, step_rng)
+            def loss_fn(p, mb):
+                loss, m = trial.loss(model, p, mb, step_rng)
                 return loss, m
 
-            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params
-            )
+            if agg == 1:
+                (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    state.params, batch
+                )
+            else:
+                # gradient accumulation: scan over stacked microbatches
+                # [agg, batch, ...] accumulating grads on device — the
+                # reference's aggregation_frequency loop
+                # (_pytorch_context.py:708-914) without host round-trips
+                def micro(carry, mb):
+                    gacc, lacc, macc = carry
+                    (l, m), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                        state.params, mb
+                    )
+                    gacc = jax.tree.map(jnp.add, gacc, g)
+                    macc = {k: macc[k] + m[k].astype(jnp.float32) for k in macc}
+                    return (gacc, lacc + l, macc), None
+
+                g0 = jax.tree.map(jnp.zeros_like, state.params)
+                m0 = {
+                    k: jnp.zeros((), jnp.float32)
+                    for k in state.metric_acc
+                    if k != "loss"
+                }
+                (grads, loss, metrics), _ = jax.lax.scan(
+                    micro, (g0, jnp.zeros((), jnp.float32), m0), batch
+                )
+                loss = loss / agg
+                metrics = {k: v / agg for k, v in metrics.items()}
+                if average_grads:
+                    grads = jax.tree.map(lambda g: g / agg, grads)
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             metrics = dict(metrics)
@@ -253,22 +286,34 @@ class Trainer:
                 metric_count=state.metric_count + 1.0,
             )
 
+        from determined_tpu.train._reducer import MEAN, get_reducer
+
+        reducers = {k: get_reducer(v) for k, v in trial.evaluation_reducers().items()}
+        self._reducers = reducers
+
         def eval_step(params, batch, acc, count):
             metrics = trial.evaluate_batch(model, params, batch)
-            new_acc = {
-                k: acc.get(k, jnp.zeros((), jnp.float32)) + metrics[k].astype(jnp.float32)
-                for k in metrics
-            }
+            new_acc = {}
+            for k, v in metrics.items():
+                red = reducers.get(k, MEAN)
+                carry = acc.get(k, jnp.asarray(red.init, jnp.float32))
+                new_acc[k] = red.accumulate(carry, v.astype(jnp.float32))
             return new_acc, count + 1.0
 
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step, donate_argnums=2)
 
     def _place_on_mesh(self, tree: Any) -> Any:
-        """Replicate any leaf not already sharded over THIS mesh."""
+        """Replicate any leaf not already sharded over THIS mesh.
+
+        Multi-process: ``device_put`` refuses non-addressable shardings, so
+        replication goes through ``make_array_from_callback`` (every process
+        supplies its addressable replicas from the host value).
+        """
         from jax.sharding import NamedSharding, PartitionSpec
 
         repl = NamedSharding(self.mesh, PartitionSpec())
+        multiprocess = jax.process_count() > 1
 
         def fix(x):
             if not isinstance(x, jax.Array):
@@ -277,6 +322,15 @@ class Trainer:
             if isinstance(s, NamedSharding) and s.mesh.devices.size == self.mesh.devices.size \
                     and set(d.id for d in s.mesh.devices.flat) == set(d.id for d in self.mesh.devices.flat):
                 return x
+            if multiprocess:
+                if jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+                    data = np.asarray(jax.random.key_data(x))
+                    garr = jax.make_array_from_callback(
+                        data.shape, repl, lambda idx: data[idx]
+                    )
+                    return jax.random.wrap_key_data(garr, impl=jax.random.key_impl(x))
+                host = np.asarray(x)
+                return jax.make_array_from_callback(host.shape, repl, lambda idx: host[idx])
             return jax.device_put(x, repl)
 
         return jax.tree.map(fix, tree)
@@ -284,15 +338,20 @@ class Trainer:
     # -- length arithmetic -------------------------------------------------
 
     def _to_batches(self, length: Optional[Length]) -> Optional[int]:
+        """Convert a Length to OPTIMIZER steps.  With gradient accumulation
+        each step consumes ``agg`` loader batches, so epoch/record lengths
+        divide by it (a 1-epoch run is one data pass regardless of agg)."""
         if length is None:
             return None
         length = Length.parse(length)
         if length.unit == "batches":
             return length.units
         if length.unit == "epochs":
-            return length.units * self.train_loader.batches_per_epoch
+            return max(
+                1, length.units * self.train_loader.batches_per_epoch // self.agg
+            )
         # records
-        gbs = self.train_loader.sampler.global_batch
+        gbs = self.train_loader.sampler.global_batch * self.agg
         return max(1, length.units // gbs)
 
     # -- checkpoint --------------------------------------------------------
@@ -311,6 +370,12 @@ class Trainer:
             "train_loader": self.train_loader.state_dict(),
             "callbacks": {k: cb.state_dict() for k, cb in self.callbacks.items()},
             "best_validation": self.best_validation,
+            # rebuild-from-checkpoint info (reference pytorch/_load.py):
+            # enough to reconstruct the Trial without the experiment
+            "trial_class": f"{type(self.trial).__module__}:{type(self.trial).__qualname__}",
+            "hparams": dict(self.context.hparams),
+            "exp_config": self.context.exp_config.raw if self.context.exp_config else None,
+            "seed": self.context.seed,
         }
         metadata = {
             "steps_completed": self.steps_completed,
@@ -329,25 +394,31 @@ class Trainer:
 
     def _restore_checkpoint(self, storage_id: str) -> None:
         with self.core.checkpoint.restore_path(storage_id) as path:
-            abstract = serialization.abstract_like(
-                {
-                    "step": self.state.step,
-                    "params": self.state.params,
-                    "opt_state": self.state.opt_state,
-                    "rng": self.state.rng,
-                }
-            )
-            restored = serialization.restore_arrays(path, abstract)
-            self.state = self.state.replace(**restored).reset_metrics()
-            tstate = serialization.load_trainer_state(path)
-            self.steps_completed = int(tstate["steps_completed"])
-            self.train_loader.load_state_dict(tstate["train_loader"])
-            for k, cb in self.callbacks.items():
-                cb.load_state_dict(tstate.get("callbacks", {}).get(k, {}))
-            self.best_validation = tstate.get("best_validation")
-            for cb in self.callbacks.values():
-                cb.on_checkpoint_load(path)
+            self.restore_from_path(path)
         logger.info("restored checkpoint %s at step %d", storage_id, self.steps_completed)
+
+    def restore_from_path(self, path: str) -> None:
+        """Load arrays + trainer state from an already-local checkpoint dir
+        (``_restore_checkpoint`` handles storage download; this is the shared
+        tail, also used by ``train.load_trial_from_checkpoint``)."""
+        abstract = serialization.abstract_like(
+            {
+                "step": self.state.step,
+                "params": self.state.params,
+                "opt_state": self.state.opt_state,
+                "rng": self.state.rng,
+            }
+        )
+        restored = serialization.restore_arrays(path, abstract)
+        self.state = self.state.replace(**restored).reset_metrics()
+        tstate = serialization.load_trainer_state(path)
+        self.steps_completed = int(tstate["steps_completed"])
+        self.train_loader.load_state_dict(tstate["train_loader"])
+        for k, cb in self.callbacks.items():
+            cb.load_state_dict(tstate.get("callbacks", {}).get(k, {}))
+        self.best_validation = tstate.get("best_validation")
+        for cb in self.callbacks.values():
+            cb.on_checkpoint_load(path)
 
     # -- validation --------------------------------------------------------
 
@@ -360,9 +431,19 @@ class Trainer:
             for host_batch in self.val_loader.iter_epoch(0):
                 batch = to_global(host_batch, self.mesh)
                 acc, count = self._eval_step(self.state.params, batch, acc, count)
+        from determined_tpu.train._reducer import MEAN
+
         acc_host, n = jax.device_get((acc, count))
-        metrics = {k: float(v) / float(n) for k, v in acc_host.items()} if n else {}
-        self.core.train.report_validation_metrics(self.steps_completed, metrics)
+        metrics = (
+            {
+                k: float(self._reducers.get(k, MEAN).finalize(float(v), float(n)))
+                for k, v in acc_host.items()
+            }
+            if n
+            else {}
+        )
+        if self.core.distributed.is_chief:
+            self.core.train.report_validation_metrics(self.steps_completed, metrics)
         for cb in self.callbacks.values():
             cb.on_validation_end(metrics)
         return metrics
@@ -413,7 +494,7 @@ class Trainer:
             cb.on_training_start(self)
 
         train_iter = iter(self.train_loader)
-        gbs = self.train_loader.sampler.global_batch
+        gbs = self.train_loader.sampler.global_batch * self.agg
         hot_time = 0.0  # train-segment wall time since last report (excludes
         # validation/checkpoint so samples_per_second tracks training only)
         steps_since_report = 0
@@ -435,8 +516,15 @@ class Trainer:
             # for models that annotate activations without an explicit mesh
             with self.mesh:
                 while self.steps_completed < next_stop:
-                    host_batch = next(train_iter)
-                    batch = to_global(host_batch, self.mesh)
+                    if self.agg > 1:
+                        micros = [next(train_iter) for _ in range(self.agg)]
+                        host_batch = {
+                            k: np.stack([m[k] for m in micros]) for k in micros[0]
+                        }
+                        batch = to_global(host_batch, self.mesh, micro_dim=True)
+                    else:
+                        host_batch = next(train_iter)
+                        batch = to_global(host_batch, self.mesh)
                     self.state = self._train_step(self.state, batch)
                     self.steps_completed += 1
                     steps_since_report += 1
@@ -458,8 +546,11 @@ class Trainer:
                 metrics["samples_per_second"] = steps_since_report * gbs / max(hot_time, 1e-9)
                 hot_time = 0.0
                 steps_since_report = 0
-                self.core.train.report_training_metrics(self.steps_completed, metrics)
-                self.core.train.report_progress(self.steps_completed / max_steps)
+                # metrics are identical on every rank (global-array math);
+                # only the chief reports (reference: chief-only report_*)
+                if self.core.distributed.is_chief:
+                    self.core.train.report_training_metrics(self.steps_completed, metrics)
+                    self.core.train.report_progress(self.steps_completed / max_steps)
                 for cb in self.callbacks.values():
                     cb.on_training_workload_end(self.steps_completed, metrics)
 

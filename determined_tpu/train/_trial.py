@@ -176,6 +176,14 @@ class JaxTrial(abc.ABC):
         loss, metrics = self.loss(model, params, batch, jax.random.key(0))
         return {"validation_loss": loss, **{f"val_{k}": v for k, v in metrics.items()}}
 
+    def evaluation_reducers(self) -> Dict[str, Any]:
+        """Per-metric across-batch reducers (reference
+        ``evaluation_reducer``, ``pytorch/_reducer.py``).  Keys are metric
+        names from ``evaluate_batch``; values are builtin names
+        ("mean"/"sum"/"min"/"max"/"last") or ``train.MetricReducer``
+        instances.  Unlisted metrics reduce by mean."""
+        return {}
+
     # -- initialization ----------------------------------------------------
 
     def init_params(self, model: Any, rng: jax.Array, sample_batch: Dict[str, Any]) -> Any:

@@ -128,3 +128,21 @@ def test_length_parse_forms():
     assert Length.parse({"epochs": 3}) == Length.epochs(3)
     with pytest.raises(InvalidExperimentConfig):
         Length.parse({"batches": 1, "epochs": 2})
+
+
+def test_example_configs_parse():
+    """Every yaml in examples/ must pass config validation."""
+    import glob
+    import os
+
+    import yaml
+
+    from determined_tpu.config.experiment import ExperimentConfig
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = glob.glob(os.path.join(repo, "examples", "**", "*.yaml"), recursive=True)
+    assert len(paths) >= 5
+    for p in paths:
+        with open(p) as f:
+            cfg = ExperimentConfig.parse(yaml.safe_load(f))
+        assert cfg.entrypoint, p

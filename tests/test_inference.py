@@ -1,0 +1,104 @@
+"""Batch inference + load_trial_from_checkpoint (reference:
+_torch_batch_process.py tests + pytorch/_load.py)."""
+
+import numpy as np
+import pytest
+
+from determined_tpu import core, inference, train
+from determined_tpu.config import Length
+from determined_tpu.data import mnist_like
+from determined_tpu.models.mnist import MnistTrial
+from determined_tpu.parallel.mesh import MeshConfig
+
+HPARAMS = {"lr": 1e-2, "hidden": 16, "global_batch_size": 16, "dataset_size": 64}
+
+
+def _trained_checkpoint(tmp_path):
+    ctx = train.init(
+        hparams=dict(HPARAMS),
+        mesh_config=MeshConfig(data=2),
+        core_context=core._dummy_init(checkpoint_dir=str(tmp_path / "ckpts")),
+        seed=3,
+    )
+    trainer = train.Trainer(MnistTrial(ctx))
+    result = trainer.fit(Length.batches(4))
+    assert result["latest_checkpoint"]
+    return str(tmp_path / "ckpts" / result["latest_checkpoint"]), trainer
+
+
+def test_load_trial_from_checkpoint(tmp_path):
+    path, orig = _trained_checkpoint(tmp_path)
+    trial, trainer = train.load_trial_from_checkpoint(
+        path, mesh_config=MeshConfig(data=2)
+    )
+    assert isinstance(trial, MnistTrial)
+    assert trainer.steps_completed == 4
+    # params match the training run exactly
+    import jax
+
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(trainer.state.params)),
+        jax.tree.leaves(jax.device_get(orig.state.params)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_load_trial_records_hparams(tmp_path):
+    path, _ = _trained_checkpoint(tmp_path)
+    trial, _trainer = train.load_trial_from_checkpoint(
+        path, mesh_config=MeshConfig(data=2)
+    )
+    assert trial.context.get_hparam("hidden") == 16
+
+
+def test_batch_inference_processes_whole_shard(tmp_path):
+    seen = []
+
+    class Collector(inference.BatchProcessor):
+        def process_batch(self, batch, batch_idx):
+            seen.append((batch_idx, batch["image"].shape[0]))
+
+        def on_finish(self):
+            seen.append("done")
+
+    ds = mnist_like(size=64, seed=0)
+    ctx = core._dummy_init(checkpoint_dir=str(tmp_path / "ck"))
+    n = inference.run_batch_inference(Collector, ds, batch_size=16, core_context=ctx)
+    assert n == 4
+    assert seen[-1] == "done"
+    assert [s[0] for s in seen[:-1]] == [0, 1, 2, 3]
+    assert all(s[1] == 16 for s in seen[:-1])
+
+
+def test_batch_inference_resumes_from_progress(tmp_path):
+    """A second run with latest_checkpoint resumes at the recorded batch."""
+    processed = []
+
+    class Collector(inference.BatchProcessor):
+        def process_batch(self, batch, batch_idx):
+            processed.append(batch_idx)
+
+    ds = mnist_like(size=128, seed=0)
+    ctx = core._dummy_init(checkpoint_dir=str(tmp_path / "ck"))
+    n = inference.run_batch_inference(
+        Collector, ds, batch_size=16, core_context=ctx, checkpoint_interval=5
+    )
+    assert n == 8 and processed == list(range(8))
+
+    # find the recorded progress checkpoint and resume from it
+    import os
+
+    ckpts = os.listdir(tmp_path / "ck")
+    assert ckpts, "no progress checkpoint written"
+    processed.clear()
+
+    class Info:
+        latest_checkpoint = ckpts[-1]
+
+    ctx2 = core._dummy_init(checkpoint_dir=str(tmp_path / "ck"))
+    ctx2.info = Info()
+    n2 = inference.run_batch_inference(
+        Collector, ds, batch_size=16, core_context=ctx2, checkpoint_interval=100
+    )
+    assert processed and processed[0] == 5  # resumed after the marker
+    assert n2 == 3

@@ -162,3 +162,92 @@ def test_epoch_units(tmp_path):
     result = trainer.fit(Length.epochs(2), report_period=Length.batches(100))
     # 256 records / 32 batch = 8 batches/epoch -> 16 steps
     assert result["steps_completed"] == 16
+
+
+def test_gradient_accumulation_matches_large_batch(tmp_path):
+    """aggregation_frequency=N over batch B must produce the same params as
+    one step over batch N*B (same records, same order, averaged grads) — the
+    onevar-style equivalence proof (reference _pytorch_context.py
+    aggregation_frequency)."""
+    import optax
+
+    from determined_tpu.config import ExperimentConfig
+    from determined_tpu.data import DataLoader
+
+    class SgdNoShuffle(MnistTrial):
+        # plain SGD keeps the equivalence exact; unshuffled loader makes
+        # 4 microbatches of 8 cover the same 32 records as 1 batch of 32
+        def build_optimizer(self):
+            return optax.sgd(0.1)
+
+        def build_training_data_loader(self):
+            return DataLoader(
+                self._dataset(train=True),
+                self.context.get_global_batch_size(),
+                shuffle=False,
+                seed=0,
+            )
+
+    def run(exp_cfg, bs, steps, tag):
+        hp = dict(HPARAMS)
+        hp["global_batch_size"] = bs
+        ctx = make_context(
+            tmp_path / tag, MeshConfig(data=2), hparams=hp, exp_config=exp_cfg
+        )
+        trainer = train.Trainer(SgdNoShuffle(ctx))
+        trainer.fit(Length.batches(steps))
+        return jax.device_get(trainer.state.params)
+
+    agg_cfg = ExperimentConfig.parse({"optimizations": {"aggregation_frequency": 4}})
+    p_agg = run(agg_cfg, 8, 2, "agg")   # 2 steps x (4 micro x 8)
+    p_big = run(None, 32, 2, "big")     # 2 steps x 32
+    flat_a, flat_b = jax.tree.leaves(p_agg), jax.tree.leaves(p_big)
+    assert len(flat_a) == len(flat_b) and flat_a
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4)
+
+
+def test_custom_metric_reducers(tmp_path):
+    """Non-mean validation reducers: max/sum/min/custom combine across the
+    validation sweep inside the jitted eval step (reference _reducer.py)."""
+    import jax.numpy as jnp
+
+    from determined_tpu.train import MetricReducer
+
+    class ReducerTrial(MnistTrial):
+        def evaluate_batch(self, model, params, batch):
+            base = super().evaluate_batch(model, params, batch)
+            bs = batch["image"].shape[0]
+            return {
+                **base,
+                "val_examples": jnp.asarray(bs, jnp.float32),
+                "val_batch_max_label": batch["label"].max().astype(jnp.float32),
+                "val_batch_min_label": batch["label"].min().astype(jnp.float32),
+                "val_sq_examples": jnp.asarray(bs, jnp.float32),
+            }
+
+        def evaluation_reducers(self):
+            return {
+                "val_examples": "sum",
+                "val_batch_max_label": "max",
+                "val_batch_min_label": "min",
+                # custom: sum of squares, then sqrt at finalize
+                "val_sq_examples": MetricReducer(
+                    init=0.0,
+                    accumulate=lambda c, v: c + v * v,
+                    finalize=lambda c, n: c ** 0.5,
+                ),
+            }
+
+    ctx = make_context(tmp_path, MeshConfig(data=2))
+    trainer = train.Trainer(ReducerTrial(ctx))
+    result = trainer.fit(Length.batches(4), validation_period=Length.batches(4))
+    vm = result["validation_metrics"]
+    ds = HPARAMS["dataset_size"]
+    bs = HPARAMS["global_batch_size"]
+    n_batches = ds // bs
+    assert vm["val_examples"] == ds  # sum of batch sizes = dataset size
+    assert 0 <= vm["val_batch_min_label"] <= vm["val_batch_max_label"] <= 9
+    assert vm["val_sq_examples"] == pytest.approx((n_batches * bs * bs) ** 0.5)
+    # default mean still applies to unlisted metrics
+    assert 0.0 <= vm["validation_accuracy"] <= 1.0
