@@ -112,9 +112,13 @@ def init(
     preempt_mode: PreemptMode = PreemptMode.WorkersAskChief,
     session: Optional[Any] = None,
     metrics_path: Optional[str] = None,
+    info: Optional[Any] = None,
 ) -> Context:
-    """Build a Context from cluster info when present, dummies otherwise."""
-    info = get_cluster_info()
+    """Build a Context from cluster info when present, dummies otherwise.
+
+    ``info`` overrides the env-derived ClusterInfo (used by core_v2
+    unmanaged mode, which registers the experiment itself)."""
+    info = info or get_cluster_info()
 
     if session is None and info is not None and info.master_url:
         from determined_tpu.api.session import Session

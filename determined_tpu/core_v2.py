@@ -1,0 +1,116 @@
+"""core_v2: unmanaged experiments — tracked by the master, run by you.
+
+Reference: ``harness/determined/experimental/core_v2/_core_v2.py:27-124`` +
+``_unmanaged.py``: a wandb-style mode where any Python process registers an
+experiment+trial with the master, reports metrics/checkpoints through the
+normal Core API, and the master never schedules anything.  Usage::
+
+    from determined_tpu import core_v2
+
+    with core_v2.init(config={"name": "my-run"}, master="http://master:8080") as run:
+        for step in range(100):
+            ...
+            run.train.report_training_metrics(step, {"loss": loss})
+
+On exit the trial completes (ERROR if the block raised); the run shows up
+in `dtpu experiment list`, the WebUI-equivalent APIs, and the SDK like any
+managed experiment.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from determined_tpu import core
+from determined_tpu.api.authentication import ensure_session
+from determined_tpu.core._cluster_info import ClusterInfo
+
+
+class UnmanagedRun:
+    """Context-manager wrapper: delegates to the Core API Context and
+    reports the trial exit to the master on close."""
+
+    def __init__(self, ctx: core.Context, session, trial_id: int, experiment_id: int):
+        self.core = ctx
+        self._session = session
+        self.trial_id = trial_id
+        self.experiment_id = experiment_id
+        self._closed = False
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.core, name)
+
+    def close(self, exit_code: int = 0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.core.close()
+        try:
+            self._session.post(
+                f"/api/v1/trials/{self.trial_id}/exit", json={"exit_code": exit_code}
+            )
+        except Exception:  # noqa: BLE001 - master may be gone; run is local
+            pass
+
+    def __enter__(self) -> "UnmanagedRun":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(exit_code=0 if exc_type is None else 1)
+
+
+def init(
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    master: Optional[str] = None,
+    user: Optional[str] = None,
+    password: Optional[str] = None,
+    checkpoint_storage: Optional[str] = None,
+) -> UnmanagedRun:
+    """Register an unmanaged experiment and return a live run handle.
+
+    Falls back to a fully-local dummy context when no master is reachable
+    (same contract as ``core.init`` off-cluster).
+    """
+    master = master or os.environ.get("DTPU_MASTER") or os.environ.get(
+        "DTPU_MASTER_URL"
+    )
+    cfg = dict(config or {})
+    cfg.setdefault("name", "unmanaged")
+    cfg["unmanaged"] = True
+    cfg.setdefault(
+        "searcher",
+        {"name": "single", "metric": "loss", "max_length": {"batches": 1}},
+    )
+    if checkpoint_storage:
+        cfg.setdefault(
+            "checkpoint_storage",
+            {"type": "shared_fs", "host_path": checkpoint_storage},
+        )
+
+    if not master:
+        ctx = core._dummy_init(checkpoint_dir=checkpoint_storage)
+        return UnmanagedRun(ctx, session=None, trial_id=0, experiment_id=0)
+
+    session = ensure_session(master, user, password)
+    exp = session.post("/api/v1/experiments", json={"config": cfg}).json()
+    exp_id = int(exp["id"])
+    detail = session.get(f"/api/v1/experiments/{exp_id}").json()
+    trial_id = int(detail["trials"][0]["id"])
+
+    info = ClusterInfo(
+        master_url=master,
+        session_token=session.token or "",
+        trial_id=trial_id,
+        experiment_id=exp_id,
+        hparams=cfg.get("hyperparameters") or {},
+        exp_config=cfg,
+    )
+    ctx = core.init(info=info, checkpoint_storage=checkpoint_storage)
+    # first heartbeat flips the unmanaged trial RUNNING
+    try:
+        session.post(f"/api/v1/trials/{trial_id}/heartbeat")
+    except Exception:  # noqa: BLE001
+        pass
+    return UnmanagedRun(ctx, session, trial_id, exp_id)

@@ -22,16 +22,35 @@ import sys
 
 def _apply_environment_early() -> None:
     """Env vars from exp config must land BEFORE jax is imported
-    (XLA_FLAGS, JAX_PLATFORMS and friends are read at import time)."""
+    (XLA_FLAGS, JAX_PLATFORMS and friends are read at import time).
+
+    Config env OVERRIDES the inherited process env — the experiment's
+    declaration is authoritative, same as the reference's task container env
+    (``master/pkg/tasks/task.go`` env layering).  On the CPU platform the
+    local device count is then forced to this node's slot count, so an
+    N-slot allocation sees exactly N "chips" per host — the artificial-slots
+    analog (``agent/internal/detect/detect.go:40-57``); without this, a
+    multi-process gang's mesh would take its N devices from process 0 only.
+    """
     raw = os.environ.get("DTPU_EXP_CONFIG")
-    if not raw:
-        return
-    try:
-        env = (json.loads(raw).get("environment") or {}).get("env") or {}
-    except Exception:
-        return
-    for k, v in env.items():
-        os.environ.setdefault(str(k), str(v))
+    if raw:
+        try:
+            env = (json.loads(raw).get("environment") or {}).get("env") or {}
+        except Exception:
+            env = {}
+        for k, v in env.items():
+            os.environ[str(k)] = str(v)
+
+    slots = os.environ.get("DTPU_NUM_SLOTS")
+    if slots and "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        flags = os.environ.get("XLA_FLAGS", "")
+        kept = [
+            f
+            for f in flags.split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        kept.append(f"--xla_force_host_platform_device_count={int(slots)}")
+        os.environ["XLA_FLAGS"] = " ".join(kept)
 
 
 def _prepare_context(logger) -> None:
@@ -53,10 +72,18 @@ def _prepare_context(logger) -> None:
     from determined_tpu.common import extract_context
 
     url = master.rstrip("/") + ctx_url
+    # the context route requires auth; the master injects the allocation's
+    # session token into the task env (reference: entrypoint runs authed via
+    # DET_SESSION_TOKEN, master/pkg/tasks/task.go env injection)
+    headers = {}
+    token = os.environ.get("DTPU_SESSION_TOKEN")
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
     data = None
     for attempt in range(4):
         try:
-            with urllib.request.urlopen(url, timeout=60) as resp:
+            req = urllib.request.Request(url, headers=headers)
+            with urllib.request.urlopen(req, timeout=60) as resp:
                 data = resp.read()
             break
         except Exception as e:  # noqa: BLE001 - transient master hiccups
@@ -72,7 +99,47 @@ def _prepare_context(logger) -> None:
     logger.info("context: unpacked %d bytes into %s", len(data), workdir)
 
 
+class _RankPrefixStream:
+    """Line-wise rank prefixer over a text stream — the analog of the
+    reference's per-rank log wrapper (``launch/wrap_rank.py``), so
+    interleaved multi-process logs stay attributable after the agent ships
+    them.  Wraps Python-level stdout/stderr (tracebacks, logging, print);
+    native fd writes bypass it, which is acceptable for log dedup."""
+
+    def __init__(self, stream, prefix: str) -> None:
+        self._stream = stream
+        self._prefix = prefix
+        self._at_line_start = True
+
+    def write(self, text: str) -> int:
+        out = []
+        for chunk in text.splitlines(keepends=True):
+            if self._at_line_start:
+                out.append(self._prefix)
+            out.append(chunk)
+            self._at_line_start = chunk.endswith("\n")
+        self._stream.write("".join(out))
+        return len(text)
+
+    def flush(self) -> None:
+        self._stream.flush()
+
+    def __getattr__(self, name):
+        return getattr(self._stream, name)
+
+
 def main() -> int:
+    # per-rank prefix BEFORE logging configures its handlers
+    rdzv_early = os.environ.get("DTPU_RENDEZVOUS")
+    if rdzv_early:
+        try:
+            info_early = json.loads(rdzv_early)
+            if int(info_early.get("num_nodes", 1)) > 1:
+                prefix = f"[rank={int(info_early.get('node_rank', 0))}] "
+                sys.stdout = _RankPrefixStream(sys.stdout, prefix)
+                sys.stderr = _RankPrefixStream(sys.stderr, prefix)
+        except Exception:  # noqa: BLE001 - malformed rendezvous fails later
+            pass
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s [%(levelname)s] %(name)s: %(message)s"
     )

@@ -1,0 +1,151 @@
+"""TensorBoard task: live metrics/trace viewer served behind the master proxy.
+
+Reference: ``harness/determined/exec/tensorboard.py`` (TensorBoard server
+task fetching event files) + the NTSC readiness contract
+(``check_ready_logs.py`` pattern-match -> allocation.SetReady).  TPU-first
+divergence: this platform's metrics live in the master (jsonl per trial)
+and profiler traces are xplane files in checkpoint storage — neither is a
+TF event file, and the bundled ``tensorboard.program`` entry is not
+importable in this image — so the task serves a self-contained viewer:
+
+- ``/``                          HTML page with SVG metric charts (no JS deps)
+- ``/data/experiments``          experiments visible to this task
+- ``/data/trials/{id}/metrics``  metric rows proxied from the master
+- ``/healthz``                   readiness
+
+The task binds ``DTPU_TASK_PORT``, then POSTs ``/api/v1/tasks/{id}/ready``
+to the master, which flips the proxy live.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import sys
+import urllib.request
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>dtpu tensorboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.5rem; }
+ .chart { border: 1px solid #ccc; margin: .5rem 0; }
+ .label { font-size: .8rem; fill: #555; }
+ polyline { fill: none; stroke: #1a73e8; stroke-width: 1.5; }
+</style></head>
+<body><h1>determined-tpu metrics viewer</h1><div id="charts">loading…</div>
+<script>
+async function j(u) { const r = await fetch(u); return r.json(); }
+function chart(title, points) {
+  if (!points.length) return "";
+  const w = 640, h = 160, pad = 30;
+  const xs = points.map(p => p[0]), ys = points.map(p => p[1]);
+  const xmin = Math.min(...xs), xmax = Math.max(...xs, xmin + 1);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys, ymin + 1e-9);
+  const px = x => pad + (x - xmin) / (xmax - xmin) * (w - 2 * pad);
+  const py = y => h - pad - (y - ymin) / (ymax - ymin) * (h - 2 * pad);
+  const pts = points.map(p => px(p[0]) + "," + py(p[1])).join(" ");
+  return `<h2>${title}</h2><svg class="chart" width="${w}" height="${h}">` +
+    `<polyline points="${pts}"/>` +
+    `<text class="label" x="${pad}" y="${h-8}">${xmin}</text>` +
+    `<text class="label" x="${w-pad-30}" y="${h-8}">${xmax}</text>` +
+    `<text class="label" x="2" y="${py(ymax)+4}">${ymax.toPrecision(4)}</text>` +
+    `<text class="label" x="2" y="${py(ymin)+4}">${ymin.toPrecision(4)}</text></svg>`;
+}
+(async () => {
+  const exps = await j("data/experiments");
+  let html = "";
+  for (const e of exps) {
+    html += `<h2>experiment ${e.id}: ${e.name} [${e.state}]</h2>`;
+    for (const t of (e.trials || [])) {
+      const rows = await j(`data/trials/${t.id}/metrics`);
+      const series = {};
+      for (const r of rows) {
+        for (const [k, v] of Object.entries(r.metrics || {})) {
+          if (typeof v === "number") {
+            (series[k] ||= []).push([r.steps_completed || 0, v]);
+          }
+        }
+      }
+      for (const [k, pts] of Object.entries(series)) {
+        html += chart(`trial ${t.id} — ${k}`, pts);
+      }
+    }
+  }
+  document.getElementById("charts").innerHTML = html || "no data";
+})();
+</script></body></html>
+"""
+
+
+def _master_get(path: str) -> bytes:
+    master = os.environ["DTPU_MASTER_URL"].rstrip("/")
+    token = os.environ.get("DTPU_SESSION_TOKEN", "")
+    req = urllib.request.Request(
+        master + path, headers={"Authorization": f"Bearer {token}"}
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.read()
+
+
+def main() -> int:
+    import http.server
+
+    task_id = os.environ.get("DTPU_TASK_ID", "task")
+    port = int(os.environ.get("DTPU_TASK_PORT", "18000"))
+    cfg = json.loads(os.environ.get("DTPU_TASK_CONFIG", "{}") or "{}")
+    exp_filter = {int(e) for e in cfg.get("experiment_ids", [])}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _send(self, body: bytes, ctype: str = "application/json", code: int = 200):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            try:
+                if self.path in ("/", "/index.html"):
+                    self._send(_PAGE.encode(), "text/html")
+                elif self.path == "/healthz":
+                    self._send(b'{"ok":true}')
+                elif self.path == "/data/experiments":
+                    exps = json.loads(_master_get("/api/v1/experiments"))
+                    if exp_filter:
+                        exps = [e for e in exps if int(e["id"]) in exp_filter]
+                    self._send(json.dumps(exps).encode())
+                else:
+                    m = re.fullmatch(r"/data/trials/(\d+)/metrics", self.path)
+                    if m:
+                        self._send(_master_get(f"/api/v1/trials/{m.group(1)}/metrics"))
+                    else:
+                        self._send(b'{"error":"not found"}', code=404)
+            except Exception as e:  # noqa: BLE001 - surface upstream errors
+                self._send(json.dumps({"error": str(e)}).encode(), code=502)
+
+        def log_message(self, *args):
+            print("tensorboard:", *args, flush=True)
+
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+
+    # report readiness so the master proxy goes live
+    master = os.environ["DTPU_MASTER_URL"].rstrip("/")
+    token = os.environ.get("DTPU_SESSION_TOKEN", "")
+    req = urllib.request.Request(
+        f"{master}/api/v1/tasks/{task_id}/ready",
+        data=b"{}",
+        headers={"Authorization": f"Bearer {token}"},
+        method="POST",
+    )
+    urllib.request.urlopen(req, timeout=30).read()
+    print(f"tensorboard task {task_id} serving on :{port}", flush=True)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

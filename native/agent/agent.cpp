@@ -20,6 +20,8 @@
 
 #include <atomic>
 #include <cstring>
+#include <filesystem>
+#include <fstream>
 #include <map>
 #include <mutex>
 #include <string>
@@ -41,6 +43,11 @@ struct Options {
   std::string python = "python";
   std::string user = "determined";
   std::string password;
+  // pid files for running allocations live here so a restarted agent can
+  // clean up orphaned process groups (reference: ReattachContainers,
+  // agent/internal/agent.go:153 — our unit of recovery is kill+master
+  // reschedule, since jax.distributed jobs restart whole-gang anyway)
+  std::string state_dir;
 };
 
 class Agent {
@@ -48,6 +55,12 @@ class Agent {
   explicit Agent(Options opts) : opts_(std::move(opts)) {}
 
   int run() {
+    if (opts_.state_dir.empty()) {
+      opts_.state_dir = "/tmp/dtpu-agent-" + opts_.id;
+    }
+    std::error_code ec;
+    std::filesystem::create_directories(opts_.state_dir, ec);
+    kill_orphans();
     if (!login() || !register_agent()) {
       fprintf(stderr, "agent %s: cannot reach master\n", opts_.id.c_str());
       return 1;
@@ -73,6 +86,10 @@ class Agent {
           launch(item);
         } else if (type == "kill") {
           kill_allocation(item["allocation_id"].as_string());
+        } else if (type == "launch_task") {
+          launch_task(item);
+        } else if (type == "kill_task") {
+          kill_allocation(item["task_id"].as_string());
         } else if (type == "gc") {
           run_gc(item);
         }
@@ -118,6 +135,39 @@ class Agent {
     return resp.ok();
   }
 
+  std::string pidfile(const std::string& alloc_id) const {
+    return opts_.state_dir + "/" + alloc_id + ".pid";
+  }
+
+  // A previous incarnation of this agent may have left trial process
+  // groups running (they survive the agent's death as orphans, keep the
+  // TPU chips busy, and post stale metrics).  On startup, SIGKILL every
+  // process group recorded in the state dir that is still a run_trial
+  // process; the master has already (or will) fail those allocations.
+  void kill_orphans() {
+    std::error_code ec;
+    for (const auto& entry :
+         std::filesystem::directory_iterator(opts_.state_dir, ec)) {
+      if (ec) break;
+      if (entry.path().extension() != ".pid") continue;
+      std::ifstream in(entry.path());
+      pid_t pid = 0;
+      in >> pid;
+      if (pid > 1) {
+        // pid-reuse guard: only kill if it's still a run_trial process
+        std::ifstream cmd("/proc/" + std::to_string(pid) + "/cmdline");
+        std::string cmdline((std::istreambuf_iterator<char>(cmd)),
+                            std::istreambuf_iterator<char>());
+        if (cmdline.find("determined_tpu") != std::string::npos) {
+          fprintf(stderr, "agent %s: killing orphaned trial pgid %d\n",
+                  opts_.id.c_str(), pid);
+          ::kill(-pid, SIGKILL);
+        }
+      }
+      std::filesystem::remove(entry.path(), ec);
+    }
+  }
+
   // checkpoint-GC task: delete storage contents through the harness
   // StorageManager (reference exec/gc_checkpoints.py run as a task)
   void run_gc(const Json& work) {
@@ -137,6 +187,25 @@ class Agent {
     }
   }
 
+  // fork failed (EAGAIN/ENOMEM): close the pipe and tell the master the
+  // launch died, so the trial/task is failed instead of RUNNING forever
+  void report_fork_failure(int64_t trial_id, const std::string& alloc_id,
+                           const std::string& task_id, int out_pipe[2]) {
+    close(out_pipe[0]);
+    close(out_pipe[1]);
+    fprintf(stderr, "agent %s: fork failed for %s\n", opts_.id.c_str(),
+            (task_id.empty() ? alloc_id : task_id).c_str());
+    if (!task_id.empty()) {
+      master_req("POST", "/api/v1/tasks/" + task_id + "/exit", "{}", 10);
+      return;
+    }
+    Json body = Json::object();
+    body.set("exit_code", Json(126));
+    body.set("allocation_id", alloc_id);
+    master_req("POST", "/api/v1/trials/" + std::to_string(trial_id) + "/exit",
+               body.dump(), 10);
+  }
+
   void launch(const Json& work) {
     int64_t trial_id = work["trial_id"].as_int();
     const std::string alloc_id = work["allocation_id"].as_string();
@@ -144,6 +213,10 @@ class Agent {
     if (pipe(out_pipe) != 0) return;
 
     pid_t pid = fork();
+    if (pid < 0) {
+      report_fork_failure(trial_id, alloc_id, "", out_pipe);
+      return;
+    }
     if (pid == 0) {
       // child: own process group so kill() reaches workers too
       setpgid(0, 0);
@@ -168,21 +241,75 @@ class Agent {
       std::lock_guard<std::mutex> lk(mu_);
       running_[alloc_id] = pid;
     }
+    {
+      std::ofstream pf(pidfile(alloc_id), std::ios::trunc);
+      pf << pid << "\n";
+    }
     // reader thread: ship logs, then wait + report exit
     std::thread([this, pid, trial_id, alloc_id, fd = out_pipe[0]] {
       ship_logs_and_wait(fd, pid, trial_id, alloc_id);
     }).detach();
   }
 
+  // generic aux task (NTSC analog): fork the given harness module with the
+  // task env; logs ship to the master's task log file, exit reported to
+  // the tasks API.  Tracked in running_ under the task id so kill_task
+  // reuses the allocation kill path.
+  void launch_task(const Json& work) {
+    const std::string task_id = work["task_id"].as_string();
+    int out_pipe[2];
+    if (pipe(out_pipe) != 0) return;
+    pid_t pid = fork();
+    if (pid < 0) {
+      report_fork_failure(0, "", task_id, out_pipe);
+      return;
+    }
+    if (pid == 0) {
+      setpgid(0, 0);
+      dup2(out_pipe[1], STDOUT_FILENO);
+      dup2(out_pipe[1], STDERR_FILENO);
+      close(out_pipe[0]);
+      close(out_pipe[1]);
+      setenv("DTPU_MASTER_URL",
+             ("http://" + opts_.master_host + ":" + std::to_string(opts_.master_port)).c_str(), 1);
+      setenv("DTPU_AGENT_ID", opts_.id.c_str(), 1);
+      for (const auto& [k, v] : work["env"].items()) {
+        setenv(k.c_str(), v.as_string().c_str(), 1);
+      }
+      std::string module = work["module"].as_string();
+      execlp(opts_.python.c_str(), opts_.python.c_str(), "-m", module.c_str(),
+             (char*)nullptr);
+      _exit(127);
+    }
+    close(out_pipe[1]);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      running_[task_id] = pid;
+    }
+    {
+      std::ofstream pf(pidfile(task_id), std::ios::trunc);
+      pf << pid << "\n";
+    }
+    std::thread([this, pid, task_id, fd = out_pipe[0]] {
+      ship_logs_and_wait(fd, pid, /*trial_id=*/-1, task_id, task_id);
+    }).detach();
+  }
+
   void ship_logs_and_wait(int fd, pid_t pid, int64_t trial_id,
-                          const std::string& alloc_id) {
+                          const std::string& alloc_id,
+                          const std::string& task_id = "") {
     std::string partial;
     std::vector<std::string> batch;
     char buf[8192];
     auto flush = [&]() {
       if (batch.empty()) return;
       Json body = Json::object();
-      body.set("trial_id", Json(trial_id));
+      if (task_id.empty()) {
+        body.set("trial_id", Json(trial_id));
+      } else {
+        body.set("task_id", task_id);
+      }
+      body.set("agent", opts_.id);  // log-pattern exclude_node attribution
       Json lines = Json::array();
       for (auto& l : batch) lines.push_back(l);
       body.set("lines", lines);
@@ -211,6 +338,14 @@ class Agent {
     {
       std::lock_guard<std::mutex> lk(mu_);
       running_.erase(alloc_id);
+    }
+    {
+      std::error_code ec;
+      std::filesystem::remove(pidfile(alloc_id), ec);
+    }
+    if (!task_id.empty()) {
+      master_req("POST", "/api/v1/tasks/" + task_id + "/exit", "{}", 10);
+      return;
     }
     Json body = Json::object();
     body.set("exit_code", Json(exit_code));
@@ -266,6 +401,7 @@ int main(int argc, char** argv) {
     else if (arg == "--python") opts.python = next("--python");
     else if (arg == "--user") opts.user = next("--user");
     else if (arg == "--password") opts.password = next("--password");
+    else if (arg == "--state-dir") opts.state_dir = next("--state-dir");
     else { fprintf(stderr, "unknown arg %s\n", arg.c_str()); return 2; }
   }
   return dtpu::Agent(opts).run();

@@ -248,10 +248,19 @@ class HttpServer {
   HttpResponse dispatch(const HttpRequest& req) {
     auto parts = split_path(req.path);
     for (const auto& r : routes_) {
-      if (r.method != req.method || r.parts.size() != parts.size()) continue;
+      if (r.method != req.method) continue;
+      // a trailing "{*name}" wildcard swallows the rest of the path
+      // (used by the reverse proxy: /proxy/{id}/{*rest})
+      bool tail_wild =
+          !r.parts.empty() && r.parts.back().rfind("{*", 0) == 0;
+      if (tail_wild ? parts.size() < r.parts.size() - 1
+                    : r.parts.size() != parts.size()) {
+        continue;
+      }
       std::map<std::string, std::string> params;
       bool match = true;
-      for (size_t i = 0; i < parts.size(); ++i) {
+      size_t fixed = tail_wild ? r.parts.size() - 1 : r.parts.size();
+      for (size_t i = 0; i < fixed; ++i) {
         const std::string& pat = r.parts[i];
         if (pat.size() > 2 && pat.front() == '{' && pat.back() == '}') {
           params[pat.substr(1, pat.size() - 2)] = parts[i];
@@ -259,6 +268,15 @@ class HttpServer {
           match = false;
           break;
         }
+      }
+      if (match && tail_wild) {
+        const std::string& pat = r.parts.back();
+        std::string rest;
+        for (size_t i = fixed; i < parts.size(); ++i) {
+          if (!rest.empty()) rest += "/";
+          rest += parts[i];
+        }
+        params[pat.substr(2, pat.size() - 3)] = rest;
       }
       if (match) {
         HttpRequest req_copy = req;
@@ -281,6 +299,7 @@ class HttpServer {
 struct ClientResponse {
   int status = 0;
   std::string body;
+  std::string content_type;  // for proxy passthrough
   bool ok() const { return status >= 200 && status < 300; }
 };
 
@@ -327,7 +346,20 @@ inline ClientResponse http_request(const std::string& host, int port,
   if (sp == std::string::npos) return out;
   out.status = std::atoi(resp.c_str() + sp + 1);
   auto he = resp.find("\r\n\r\n");
-  if (he != std::string::npos) out.body = resp.substr(he + 4);
+  if (he != std::string::npos) {
+    std::string head = resp.substr(0, he);
+    // lowercase scan for the content-type header
+    std::string lower = head;
+    for (auto& c : lower) c = static_cast<char>(tolower(c));
+    auto ct = lower.find("content-type:");
+    if (ct != std::string::npos) {
+      auto eol = head.find("\r\n", ct);
+      std::string val = head.substr(ct + 13, eol - ct - 13);
+      while (!val.empty() && val.front() == ' ') val.erase(val.begin());
+      out.content_type = val;
+    }
+    out.body = resp.substr(he + 4);
+  }
   return out;
 }
 
