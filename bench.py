@@ -45,19 +45,26 @@ def main() -> None:
     from determined_tpu.models.transformer import LMTrial
     from determined_tpu.parallel.mesh import MeshConfig
 
+    import os
+
     n = len(jax.devices())
+    # env overrides for tuning sweeps (defaults are the tuned config)
+    bs = int(os.environ.get("DTPU_BENCH_BS", 8)) * n
+    fused = os.environ.get("DTPU_BENCH_FUSED", "auto")
     hp = {
         "lr": 3e-4,
-        "global_batch_size": 8 * n,
+        "global_batch_size": bs,
         "seq_len": 1024,
         "vocab_size": 32768,
         "d_model": 2048,
         "n_layers": 8,
         "n_heads": 16,
-        "dataset_size": 64 * n,
+        "dataset_size": 8 * bs,
         "bf16": True,
         "attention": "flash" if jax.default_backend() == "tpu" else "reference",
         "warmup_steps": 10,
+        "fused_ce": {"auto": "auto", "1": True, "0": False}[fused],
+        "ce_chunk": int(os.environ.get("DTPU_BENCH_CHUNK", 512)),
     }
     ctx = train.init(
         hparams=hp,

@@ -1,0 +1,12 @@
+"""MNIST MLP/CNN trial — the platform's `mnist_pytorch` tutorial analog
+(reference: examples/tutorials/mnist_pytorch/model_def.py, redesigned as a
+JaxTrial).  Submit with any yaml in this directory:
+
+    dtpu experiment create examples/mnist/const.yaml examples/mnist
+"""
+
+from determined_tpu.models.mnist import MnistTrial
+
+
+class Trial(MnistTrial):
+    """Direct reuse of the in-tree MNIST trial; subclass to customize."""

@@ -1,0 +1,9 @@
+"""Decoder-only transformer LM trial — the flagship model (the class the
+reference trains via hf_trainer / deepspeed gpt_neox examples), with
+DP/FSDP/TP/SP selected purely by `resources.mesh` in the yaml."""
+
+from determined_tpu.models.transformer import LMTrial
+
+
+class Trial(LMTrial):
+    pass

@@ -1,0 +1,145 @@
+"""Virtual-mesh weak-scaling curve: n=1..32 devices on CPU.
+
+What this measures (and what it does not): each point jits the FULL sharded
+training step (grad + optimizer + metrics) of the flagship transformer over
+an n-device mesh with a fixed per-device batch, and times steady-state
+steps.  On a CPU host the "devices" are virtual
+(``--xla_force_host_platform_device_count``), so the numbers capture
+*sharding correctness and XLA collective/partitioning overhead trends* —
+the part of scaling the framework controls — not ICI bandwidth, which
+needs a real pod (BASELINE.json north star: >=90% efficiency 8->256 chips).
+
+Each point runs in a subprocess because the device count is fixed at JAX
+init.  Output: one JSON line per n + a markdown table for BASELINE.md.
+
+Usage: python scripts/weak_scaling.py [--ns 1,2,4,8,16,32] [--steps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_point(n: int, steps: int) -> dict:
+    env = dict(os.environ)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_DTPU_SCALING_N"] = str(n)
+    env["_DTPU_SCALING_STEPS"] = str(steps)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=1800,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"n={n} failed:\n{out.stderr[-3000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def child() -> None:
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n = int(os.environ["_DTPU_SCALING_N"])
+    steps = int(os.environ["_DTPU_SCALING_STEPS"])
+
+    from determined_tpu import core, train
+    from determined_tpu.data import to_global
+    from determined_tpu.models.transformer import LMTrial
+    from determined_tpu.parallel.mesh import MeshConfig
+
+    per_device_batch = 2
+    hp = {
+        "lr": 1e-3,
+        "global_batch_size": per_device_batch * n,
+        "seq_len": 128,
+        "vocab_size": 1024,
+        "d_model": 128,
+        "n_layers": 2,
+        "n_heads": 4,
+        "dataset_size": 4 * per_device_batch * n,
+        "bf16": False,
+        "attention": "reference",
+        "warmup_steps": 1,
+    }
+    # dp soaks most devices; fsdp=2 keeps a param-sharding collective in
+    # the measured path once n allows it
+    mesh = MeshConfig(data=n // 2, fsdp=2) if n >= 2 else MeshConfig(data=1)
+    ctx = train.init(
+        hparams=hp, mesh_config=mesh, core_context=core._dummy_init(), seed=0
+    )
+    trainer = train.Trainer(LMTrial(ctx))
+    trainer._setup()
+    it = iter(trainer.train_loader)
+
+    def step_once():
+        trainer.state = trainer._train_step(
+            trainer.state, to_global(next(it), trainer.mesh)
+        )
+
+    for _ in range(3):
+        step_once()
+    jax.device_get(trainer.state.metric_count)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step_once()
+    jax.device_get(trainer.state.metric_count)
+    dt = time.perf_counter() - t0
+    tokens = steps * hp["global_batch_size"] * hp["seq_len"]
+    print(
+        json.dumps(
+            {
+                "n": n,
+                "tokens_per_sec": round(tokens / dt, 1),
+                "step_ms": round(dt / steps * 1000, 2),
+                "mesh": f"data={mesh.data},fsdp={mesh.fsdp}",
+            }
+        )
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ns", default="1,2,4,8,16,32")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--child", action="store_true")
+    args = ap.parse_args()
+    if args.child:
+        child()
+        return
+    ns = [int(x) for x in args.ns.split(",")]
+    rows = []
+    for n in ns:
+        r = run_point(n, args.steps)
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+    base = rows[0]["tokens_per_sec"] / rows[0]["n"]
+    print("\n| devices | tokens/s | step ms | per-device tokens/s | weak-scaling eff |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        per_dev = r["tokens_per_sec"] / r["n"]
+        print(
+            f"| {r['n']} | {r['tokens_per_sec']} | {r['step_ms']} "
+            f"| {per_dev:.1f} | {per_dev / base:.2f} |"
+        )
+
+
+if __name__ == "__main__":
+    main()
