@@ -51,6 +51,8 @@ def main() -> None:
     # env overrides for tuning sweeps (defaults are the tuned config)
     bs = int(os.environ.get("DTPU_BENCH_BS", 8)) * n
     fused = os.environ.get("DTPU_BENCH_FUSED", "auto")
+    if fused not in ("auto", "1", "0"):
+        raise SystemExit("DTPU_BENCH_FUSED must be one of: auto, 1, 0")
     hp = {
         "lr": 3e-4,
         "global_batch_size": bs,
@@ -64,7 +66,9 @@ def main() -> None:
         "attention": "flash" if jax.default_backend() == "tpu" else "reference",
         "warmup_steps": 10,
         "fused_ce": {"auto": "auto", "1": True, "0": False}[fused],
-        "ce_chunk": int(os.environ.get("DTPU_BENCH_CHUNK", 512)),
+        "ce_chunk": int(os.environ["DTPU_BENCH_CHUNK"])
+        if "DTPU_BENCH_CHUNK" in os.environ
+        else None,
     }
     ctx = train.init(
         hparams=hp,

@@ -1,0 +1,111 @@
+"""Declarative API contract: every master route, in one table.
+
+Reference: the proto/swagger contract (``proto/src/determined/**`` →
+generated ``bindings.py``) that keeps client and server from drifting.
+This build's master is hand-rolled C++, so the contract lives here as
+data: the SDK/CLI call through it conceptually, and
+``tests/test_api_contract.py`` drives EVERY route against a live
+devcluster asserting status + response shape — the drift a generated
+client would catch at codegen time is caught in CI instead (the round-2
+``alert()``-404 class of bug).
+
+Each entry: method, path template, auth level, and the top-level keys a
+successful JSON response must contain ("[]" = JSON array response,
+``None`` = shape not asserted, e.g. text).
+"""
+
+from __future__ import annotations
+
+API_VERSION = 1
+
+# (method, path, auth, response_keys)
+ROUTES = [
+    # auth + users
+    ("POST", "/api/v1/auth/login", "anon", {"token", "username", "admin"}),
+    ("GET", "/api/v1/auth/whoami", "token", {"username", "admin"}),
+    ("POST", "/api/v1/users", "admin", {"created"}),
+    ("GET", "/api/v1/users", "token", "[]"),
+    # master info + observability
+    ("GET", "/api/v1/master", "anon", {"version", "cluster_name", "agents"}),
+    ("GET", "/metrics", "anon", None),
+    # experiments
+    ("POST", "/api/v1/experiments", "token", {"id"}),
+    ("GET", "/api/v1/experiments", "token", "[]"),
+    ("GET", "/api/v1/experiments/{id}", "token",
+     {"id", "name", "owner", "state", "config", "progress", "trials"}),
+    ("GET", "/api/v1/experiments/{id}/context", "token", None),
+    ("POST", "/api/v1/experiments/{id}/pause", "token", {"state"}),
+    ("POST", "/api/v1/experiments/{id}/activate", "token", {"state"}),
+    ("POST", "/api/v1/experiments/{id}/cancel", "token", {"state"}),
+    ("POST", "/api/v1/experiments/{id}/kill", "token", {"state"}),
+    # trials
+    ("GET", "/api/v1/trials/{id}", "token",
+     {"id", "experiment_id", "state", "restarts", "latest_checkpoint",
+      "allocation_id", "progress"}),
+    ("POST", "/api/v1/trials/{id}/progress", "token", set()),
+    ("POST", "/api/v1/trials/{id}/heartbeat", "token", set()),
+    ("POST", "/api/v1/trials/{id}/exit", "token", set()),
+    ("GET", "/api/v1/trials/{id}/metrics", "token", "[]"),
+    ("GET", "/api/v1/trials/{id}/logs", "token", "[]"),
+    ("POST", "/api/v1/metrics", "token", set()),
+    ("POST", "/api/v1/trials/metrics", "token", set()),
+    ("POST", "/api/v1/logs", "token", set()),
+    # checkpoints + models
+    ("POST", "/api/v1/checkpoints", "token", set()),
+    ("GET", "/api/v1/checkpoints", "token", "[]"),
+    ("GET", "/api/v1/checkpoints/{uuid}", "token", {"uuid"}),
+    ("DELETE", "/api/v1/checkpoints/{uuid}", "token", set()),
+    ("POST", "/api/v1/models", "token", {"name"}),
+    ("GET", "/api/v1/models", "token", "[]"),
+    ("GET", "/api/v1/models/{name}", "token", {"name", "versions"}),
+    ("POST", "/api/v1/models/{name}/versions", "token", {"version"}),
+    ("GET", "/api/v1/models/{name}/versions", "token", "[]"),
+    # agents + scheduling
+    ("POST", "/api/v1/agents", "token", {"registered"}),
+    ("GET", "/api/v1/agents", "token", "[]"),
+    ("GET", "/api/v1/agents/{id}/work", "token", "[]"),
+    ("GET", "/api/v1/job-queue", "token", "[]"),
+    # allocations
+    ("GET", "/api/v1/allocations/{id}/signals/preemption", "token", {"preempt"}),
+    ("POST", "/api/v1/allocations/{id}/signals/ack_preemption", "token", set()),
+    # webhooks
+    ("POST", "/api/v1/webhooks", "token", {"id", "name"}),
+    ("GET", "/api/v1/webhooks", "token", "[]"),
+    ("DELETE", "/api/v1/webhooks/{id}", "token", set()),
+    ("POST", "/api/v1/webhooks/custom", "token", set()),
+    # events (streaming updates)
+    ("GET", "/api/v1/events", "token", "[]"),
+    # generic tasks + proxy
+    ("POST", "/api/v1/tasks", "token", {"id", "type", "state", "proxy_url"}),
+    ("GET", "/api/v1/tasks", "token", "[]"),
+    ("GET", "/api/v1/tasks/{id}", "token",
+     {"id", "type", "owner", "state", "ready", "agent_id", "proxy_url"}),
+    ("POST", "/api/v1/tasks/{id}/ready", "token", set()),
+    ("POST", "/api/v1/tasks/{id}/exit", "token", set()),
+    ("DELETE", "/api/v1/tasks/{id}", "token", set()),
+    ("GET", "/api/v1/tasks/{id}/logs", "token", "[]"),
+    ("GET", "/proxy/{id}/{path}", "token", None),
+]
+
+
+def markdown() -> str:
+    """Render the contract as API.md content."""
+    out = [
+        "# Master REST API (contract v%d)\n" % API_VERSION,
+        "Generated from `determined_tpu/api/spec.py`; "
+        "`tests/test_api_contract.py` asserts every row against a live "
+        "master.\n",
+        "| method | path | auth | response |",
+        "|---|---|---|---|",
+    ]
+    for method, path, auth, keys in ROUTES:
+        if keys == "[]":
+            resp = "array"
+        elif keys is None:
+            resp = "raw"
+        elif keys:
+            resp = "{" + ", ".join(sorted(keys)) + "}"
+        else:
+            resp = "{}"
+        out.append(f"| {method} | `{path}` | {auth} | {resp} |")
+    return "\n".join(out) + "\n"

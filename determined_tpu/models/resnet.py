@@ -1,0 +1,150 @@
+"""ResNet for 32x32 image classification — the cifar10_pytorch workload
+(BASELINE.json names it; the reference snapshot lacks the example, so this
+is authored from the mnist/iris patterns per SURVEY §2.11).
+
+TPU-first notes: convs lower onto the MXU as implicit GEMMs, so channels
+stay multiples of 8 and compute runs in bf16 with f32 params.
+Normalization is **GroupNorm, not BatchNorm** — deliberately: BatchNorm's
+running statistics are mutable cross-batch state that (a) breaks the pure
+`loss(params, batch)` step this framework jits and donates, and (b) needs
+cross-replica stat sync under data parallelism (the reference wraps torch
+SyncBN for exactly this reason).  GroupNorm is stateless, batch-size
+independent, and equally accurate at this scale.  Conv kernels replicate
+over the mesh (small next to activations; FSDP over them is not worth the
+collectives at this size) — data parallelism comes from the batch axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from determined_tpu.data import DataLoader, InMemoryDataset
+from determined_tpu.train._trial import JaxTrial
+
+
+class ResidualBlock(nn.Module):
+    channels: int
+    stride: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        conv = lambda ch, st, name: nn.Conv(  # noqa: E731
+            ch, (3, 3), strides=(st, st), padding="SAME", use_bias=False,
+            dtype=self.dtype, param_dtype=jnp.float32, name=name,
+        )
+        norm = lambda name: nn.GroupNorm(  # noqa: E731
+            num_groups=8, dtype=self.dtype, param_dtype=jnp.float32, name=name,
+        )
+        residual = x
+        y = nn.relu(norm("gn1")(conv(self.channels, self.stride, "conv1")(x)))
+        y = norm("gn2")(conv(self.channels, 1, "conv2")(y))
+        if residual.shape != y.shape:
+            residual = norm("gn_proj")(
+                conv(self.channels, self.stride, "proj")(x)
+            )
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet-(6n+2) family: stages of widths x depths over 32x32 inputs."""
+
+    num_classes: int = 10
+    widths: Sequence[int] = (16, 32, 64)
+    depth_per_stage: int = 3
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32, name="stem")(x)
+        x = nn.relu(nn.GroupNorm(num_groups=8, dtype=self.dtype,
+                                 param_dtype=jnp.float32, name="gn_stem")(x))
+        for stage, width in enumerate(self.widths):
+            for block in range(self.depth_per_stage):
+                stride = 2 if (stage > 0 and block == 0) else 1
+                x = ResidualBlock(width, stride, self.dtype,
+                                  name=f"s{stage}b{block}")(x)
+        x = x.mean(axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, param_dtype=jnp.float32,
+                        dtype=jnp.float32, name="head")(x)
+
+
+def cifar_like(size: int = 4096, num_classes: int = 10, seed: int = 0) -> InMemoryDataset:
+    """Class-separable synthetic 32x32x3 dataset (loads nothing: zero
+    egress on TPU pods), so accuracy provably improves in tests."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size).astype(np.int32)
+    # each class gets a distinct low-frequency template + noise
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32) / 32.0
+    templates = np.stack(
+        [
+            np.stack(
+                [
+                    np.sin((c + 1) * np.pi * xx),
+                    np.cos((c + 2) * np.pi * yy),
+                    np.sin((c + 1) * np.pi * (xx + yy)),
+                ],
+                axis=-1,
+            )
+            for c in range(num_classes)
+        ]
+    )
+    images = templates[labels] + rng.normal(0, 0.4, (size, 32, 32, 3)).astype(np.float32)
+    return InMemoryDataset({"image": images.astype(np.float32), "label": labels})
+
+
+class CifarTrial(JaxTrial):
+    """hparams: lr, momentum, global_batch_size, dataset_size,
+    depth_per_stage, widths, num_classes, bf16."""
+
+    def build_model(self) -> ResNet:
+        g = self.context.get_hparam
+        return ResNet(
+            num_classes=int(g("num_classes", 10)),
+            widths=tuple(g("widths", (16, 32, 64))),
+            depth_per_stage=int(g("depth_per_stage", 3)),
+            dtype=jnp.bfloat16 if bool(g("bf16", True)) else jnp.float32,
+        )
+
+    def build_optimizer(self) -> optax.GradientTransformation:
+        g = self.context.get_hparam
+        return optax.sgd(float(g("lr", 0.1)), momentum=float(g("momentum", 0.9)))
+
+    def _dataset(self, train: bool) -> InMemoryDataset:
+        g = self.context.get_hparam
+        return cifar_like(
+            size=int(g("dataset_size", 4096)),
+            num_classes=int(g("num_classes", 10)),
+            seed=0 if train else 1,
+        )
+
+    def build_training_data_loader(self) -> DataLoader:
+        return DataLoader(self._dataset(True), self.context.get_global_batch_size(),
+                          shuffle=True, seed=self.context.seed)
+
+    def build_validation_data_loader(self) -> DataLoader:
+        return DataLoader(self._dataset(False), self.context.get_global_batch_size(),
+                          shuffle=False, seed=self.context.seed)
+
+    def model_inputs(self, batch: Dict[str, Any]) -> Tuple[Any, ...]:
+        return (jnp.asarray(batch["image"]),)
+
+    def loss(self, model: ResNet, params: Any, batch: Dict[str, jax.Array], rng):
+        logits = model.apply(params, batch["image"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        acc = (logits.argmax(-1) == batch["label"]).mean()
+        return loss, {"accuracy": acc}
+
+    def evaluate_batch(self, model: ResNet, params: Any, batch: Dict[str, jax.Array]):
+        loss, metrics = self.loss(model, params, batch, jax.random.key(0))
+        return {"validation_loss": loss, "validation_accuracy": metrics["accuracy"]}

@@ -302,12 +302,22 @@ class LMTrial(JaxTrial):
 
             hidden = model.apply(params, inputs, return_hidden=True)
             kernel = flax_meta.unbox(params["params"]["lm_head"]["kernel"])
+            chunk = g("ce_chunk", None)
+            mesh = self.context.mesh
+            shards = 1
+            if mesh is not None:
+                from determined_tpu.parallel.mesh import MeshAxes
+
+                shards = mesh.shape.get(MeshAxes.DATA, 1) * mesh.shape.get(
+                    MeshAxes.FSDP, 1
+                )
             loss = fused_cross_entropy(
                 hidden,
                 kernel,
                 targets,
-                chunk_size=int(g("ce_chunk", 512)),
+                chunk_size=None if chunk in (None, "auto") else int(chunk),
                 compute_dtype=model.cfg.dtype,
+                batch_shards=shards,
             )
         else:
             logits = model.apply(params, inputs)

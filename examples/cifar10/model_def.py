@@ -1,0 +1,8 @@
+"""CIFAR-class ResNet trial (BASELINE.json's cifar10_pytorch workload,
+rebuilt TPU-first; see determined_tpu/models/resnet.py)."""
+
+from determined_tpu.models.resnet import CifarTrial
+
+
+class Trial(CifarTrial):
+    pass

@@ -40,6 +40,7 @@
 #include "../common/base64.hpp"
 #include "../common/http.hpp"
 #include "../common/json.hpp"
+#include "webui.hpp"
 #include "../common/sha256.hpp"
 #include "searcher.hpp"
 
@@ -1770,6 +1771,14 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     }
     return R::json(out.dump());
   }));
+
+  // WebUI: embedded single-page app (reference webui/react; see webui.hpp)
+  srv.route("GET", "/", [](const HttpRequest&) {
+    HttpResponse r;
+    r.content_type = "text/html; charset=utf-8";
+    r.body = kWebUIHtml;
+    return r;
+  });
 
   srv.route("GET", "/api/v1/master", [&m](const HttpRequest&) {
     std::lock_guard<std::mutex> lk(m.mu_);

@@ -1,0 +1,178 @@
+// Embedded single-page WebUI served by the master at GET /.
+//
+// Reference: webui/react/ (~134k LoC React). First slice, redesigned to
+// match this control plane: a dependency-free static page that logs in
+// against /api/v1/auth/login (token in localStorage), then renders
+// experiments/trials (with inline SVG metric charts pulled from the
+// metrics API), agents/slots, the job queue, tasks (with proxy links),
+// and live-follows the /api/v1/events feed. Embedded in the binary so
+// deployment stays single-file.
+#pragma once
+
+namespace dtpu {
+
+inline const char* kWebUIHtml = R"HTML(<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>determined-tpu</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 0; color: #1a1a2e; }
+ header { background: #16213e; color: #fff; padding: .7rem 1.2rem;
+          display: flex; justify-content: space-between; align-items: center; }
+ header h1 { font-size: 1rem; margin: 0; }
+ main { padding: 1rem 1.2rem; max-width: 1100px; }
+ h2 { font-size: .95rem; border-bottom: 1px solid #ddd; padding-bottom: .3rem;
+      margin-top: 1.4rem; }
+ table { border-collapse: collapse; width: 100%; font-size: .85rem; }
+ th, td { text-align: left; padding: .28rem .6rem; border-bottom: 1px solid #eee; }
+ th { color: #666; font-weight: 600; }
+ .st { padding: .1rem .45rem; border-radius: .6rem; font-size: .75rem; color: #fff; }
+ .st-ACTIVE, .st-RUNNING { background: #2d79c7; } .st-COMPLETED { background: #2e9e5b; }
+ .st-ERROR { background: #c0392b; } .st-PAUSED, .st-PENDING { background: #8a8a99; }
+ .st-CANCELED, .st-STOPPED, .st-TERMINATED { background: #b07d2b; }
+ button, input { font: inherit; padding: .25rem .6rem; }
+ #login { margin: 3rem auto; max-width: 320px; display: flex;
+          flex-direction: column; gap: .5rem; }
+ .chart polyline { fill: none; stroke: #2d79c7; stroke-width: 1.5; }
+ .chart text { font-size: .65rem; fill: #666; }
+ details { margin: .3rem 0 .6rem; }
+ #feed { font-family: ui-monospace, monospace; font-size: .75rem;
+         max-height: 180px; overflow-y: auto; background: #f7f7fb;
+         padding: .5rem; }
+ a { color: #2d79c7; }
+</style></head><body>
+<header><h1>determined-tpu</h1><div id="who"></div></header>
+<div id="login" style="display:none">
+  <h2>log in</h2>
+  <input id="u" placeholder="username" value="determined">
+  <input id="p" placeholder="password" type="password">
+  <button onclick="login()">login</button><div id="lerr"></div>
+</div>
+<main id="app" style="display:none">
+  <h2>cluster</h2><div id="cluster"></div>
+  <h2>experiments</h2><div id="exps"></div>
+  <h2>job queue</h2><div id="queue"></div>
+  <h2>tasks</h2><div id="tasks"></div>
+  <h2>event feed</h2><div id="feed"></div>
+</main>
+<script>
+let TOK = localStorage.getItem("dtpu_token") || "";
+let lastSeq = 0;
+const $ = id => document.getElementById(id);
+async function api(path, opts = {}) {
+  opts.headers = Object.assign({"Authorization": "Bearer " + TOK,
+                                "Content-Type": "application/json"},
+                               opts.headers || {});
+  const r = await fetch(path, opts);
+  if (r.status === 401) { showLogin(); throw new Error("unauthenticated"); }
+  return r.json();
+}
+function showLogin() { $("login").style.display = ""; $("app").style.display = "none"; }
+async function login() {
+  const r = await fetch("/api/v1/auth/login", {method: "POST",
+    body: JSON.stringify({username: $("u").value, password: $("p").value})});
+  if (!r.ok) { $("lerr").textContent = "invalid credentials"; return; }
+  TOK = (await r.json()).token;
+  localStorage.setItem("dtpu_token", TOK);
+  boot();
+}
+// all API-sourced strings pass through esc() before innerHTML: experiment
+// names/owners/metric keys are user-controlled (stored-XSS vector — the
+// bearer token in localStorage is the prize)
+function esc(v) {
+  return String(v).replace(/[&<>"']/g,
+    c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+}
+const STATES = ["ACTIVE","RUNNING","COMPLETED","ERROR","PAUSED","PENDING",
+                "CANCELED","STOPPED","TERMINATED"];
+function badge(s) {
+  const cls = STATES.includes(s) ? s : "PENDING";
+  return `<span class="st st-${cls}">${esc(s)}</span>`;
+}
+function table(rows, cols) {
+  if (!rows.length) return "<p>(none)</p>";
+  return "<table><tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>" +
+    rows.map(r => "<tr>" + cols.map(c => {
+      const v = r[c] ?? "";
+      return `<td>${r["_raw_" + c] ? v : esc(v)}</td>`;
+    }).join("") + "</tr>").join("") + "</table>";
+}
+function chart(points, w = 420, h = 110) {
+  if (points.length < 2) return "";
+  const pad = 26, xs = points.map(p => p[0]), ys = points.map(p => p[1]);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  const y0 = Math.min(...ys), y1 = Math.max(...ys, y0 + 1e-9);
+  const px = x => pad + (x - x0) / (x1 - x0 || 1) * (w - 2 * pad);
+  const pts = points.map(p => px(p[0]) + "," + (h - pad - (p[1] - y0) / (y1 - y0) * (h - 2 * pad))).join(" ");
+  return `<svg class="chart" width="${w}" height="${h}"><polyline points="${pts}"/>` +
+    `<text x="2" y="12">${y1.toPrecision(3)}</text>` +
+    `<text x="2" y="${h-4}">${y0.toPrecision(3)}</text></svg>`;
+}
+async function trialDetail(tid, el) {
+  const rows = await api(`/api/v1/trials/${tid}/metrics?group=validation`);
+  const series = {};
+  for (const r of rows) for (const [k, v] of Object.entries(r.metrics || {}))
+    if (typeof v === "number") (series[k] ||= []).push([r.steps_completed || 0, v]);
+  el.innerHTML = Object.entries(series).map(
+    ([k, pts]) => `<div><b>${esc(k)}</b><br>${chart(pts)}</div>`).join("") || "(no metrics)";
+}
+async function refresh() {
+  const [info, agents, exps, queue, tasks] = await Promise.all([
+    api("/api/v1/master"), api("/api/v1/agents"), api("/api/v1/experiments"),
+    api("/api/v1/job-queue"), api("/api/v1/tasks")]);
+  $("cluster").innerHTML = table(agents.map(a => ({id: a.id, host: a.host,
+    pool: a.pool, slots: `${a.used_slots}/${a.slots}`})),
+    ["id", "host", "pool", "slots"]);
+  $("exps").innerHTML = exps.slice().reverse().map(e => {
+    const trials = (e.trials || []).map(t =>
+      `<tr><td>${Number(t.id)}</td><td>${badge(t.state)}</td><td>${Number(t.restarts)}</td>` +
+      `<td>${Math.round((t.progress||0)*100)}%</td>` +
+      `<td><a href="#" onclick="event.preventDefault();` +
+      `trialDetail(${Number(t.id)}, this.closest('details').querySelector('.td'))">metrics</a></td></tr>`
+    ).join("");
+    return `<details><summary>#${Number(e.id)} <b>${esc(e.name)}</b> ${badge(e.state)} ` +
+      `${Math.round((e.progress||0)*100)}% — ${esc(e.owner)}</summary>` +
+      `<table><tr><th>trial</th><th>state</th><th>restarts</th>` +
+      `<th>progress</th><th></th></tr>${trials}</table><div class="td"></div></details>`;
+  }).join("") || "<p>(none)</p>";
+  $("queue").innerHTML = table(queue.map(j => ({trial: j.trial_id,
+    exp: j.experiment_id, state: badge(j.state), _raw_state: 1,
+    pri: j.priority, pool: j.resource_pool, slots: j.slots})),
+    ["trial", "exp", "state", "pri", "pool", "slots"]);
+  $("tasks").innerHTML = table(tasks.map(t => ({id: t.id, type: t.type,
+    state: badge(t.state), _raw_state: 1, _raw_link: 1,
+    link: t.ready ? `<a href="/proxy/${encodeURIComponent(t.id)}/" target="_blank">open</a>` : ""})),
+    ["id", "type", "state", "link"]);
+}
+async function followEvents() {
+  while (true) {
+    try {
+      const evs = await api(`/api/v1/events?since=${lastSeq}&timeout_seconds=25`);
+      for (const e of evs) {
+        lastSeq = Math.max(lastSeq, e.seq);
+        const line = document.createElement("div");
+        line.textContent = `#${e.seq} ${new Date(e.ts).toLocaleTimeString()} ` +
+          `${e.type} ${e.id ?? e.trial_id ?? ""} ${e.state ?? ""}`;
+        $("feed").prepend(line);
+      }
+      if (evs.length) refresh();
+    } catch (err) { await new Promise(r => setTimeout(r, 3000)); }
+  }
+}
+let pollersStarted = false;
+async function boot() {
+  try {
+    const who = await api("/api/v1/auth/whoami");
+    $("who").textContent = who.username;
+    $("login").style.display = "none"; $("app").style.display = "";
+    await refresh();
+    if (!pollersStarted) {  // re-login must not stack pollers
+      pollersStarted = true;
+      followEvents();
+      setInterval(refresh, 10000);
+    }
+  } catch (e) { /* showLogin already called */ }
+}
+boot();
+</script></body></html>
+)HTML";
+
+}  // namespace dtpu

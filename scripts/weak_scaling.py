@@ -38,6 +38,7 @@ def run_point(n: int, steps: int) -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env["_DTPU_SCALING_N"] = str(n)
     env["_DTPU_SCALING_STEPS"] = str(steps)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--child"],
         env=env,

@@ -1070,3 +1070,27 @@ def test_event_stream_follows_cluster_changes(cluster):
         cluster.url + "/api/v1/events", params={"since": mid}
     ).json()
     assert all(r["seq"] > mid for r in newer)
+
+
+def test_webui_served_and_uses_live_routes(cluster):
+    """GET / serves the embedded single-page WebUI (reference webui/react,
+    first slice); every API path the page fetches must exist in the live
+    master so the UI cannot drift off the API."""
+    import re
+
+    r = requests.get(cluster.url + "/", timeout=5)
+    assert r.status_code == 200
+    assert "text/html" in r.headers.get("Content-Type", "")
+    html = r.text
+    assert "determined-tpu" in html and "login" in html
+
+    # extract the static API paths the page references
+    paths = set(re.findall(r'"(/api/v1/[a-z\-/]*)["?]', html))
+    assert "/api/v1/auth/login" in paths
+    assert "/api/v1/experiments" in paths
+    for p in sorted(paths):
+        resp = cluster.http.get(cluster.url + p, timeout=5)
+        # login is POST-only; everything else must be a live GET
+        if p == "/api/v1/auth/login":
+            continue
+        assert resp.status_code == 200, f"{p} -> {resp.status_code}"

@@ -251,3 +251,26 @@ def test_custom_metric_reducers(tmp_path):
     assert vm["val_sq_examples"] == pytest.approx((n_batches * bs * bs) ** 0.5)
     # default mean still applies to unlisted metrics
     assert 0.0 <= vm["validation_accuracy"] <= 1.0
+
+
+def test_resnet_cifar_learns(tmp_path):
+    """CNN/ResNet model family (GroupNorm, bf16 convs) trains under dp
+    and beats random guessing on the separable synthetic set."""
+    from determined_tpu.models.resnet import CifarTrial
+
+    hp = {
+        "lr": 0.05,
+        "momentum": 0.9,
+        "global_batch_size": 32,
+        "dataset_size": 256,
+        "depth_per_stage": 1,
+        "widths": (8, 16),
+        "bf16": False,
+        "num_classes": 4,
+    }
+    ctx = make_context(tmp_path, MeshConfig(data=4), hparams=hp)
+    trainer = train.Trainer(CifarTrial(ctx))
+    result = trainer.fit(Length.batches(24), validation_period=Length.batches(24))
+    vm = result["validation_metrics"]
+    assert vm["validation_accuracy"] > 0.4, vm  # 4 classes -> random = 0.25
+    assert result["latest_checkpoint"]
