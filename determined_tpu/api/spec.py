@@ -73,6 +73,11 @@ ROUTES = [
     ("GET", "/api/v1/webhooks", "token", "[]"),
     ("DELETE", "/api/v1/webhooks/{id}", "token", set()),
     ("POST", "/api/v1/webhooks/custom", "token", set()),
+    # config templates
+    ("PUT", "/api/v1/templates/{name}", "token", {"name"}),
+    ("GET", "/api/v1/templates", "token", "[]"),
+    ("GET", "/api/v1/templates/{name}", "token", {"name", "config"}),
+    ("DELETE", "/api/v1/templates/{name}", "token", set()),
     # events (streaming updates)
     ("GET", "/api/v1/events", "token", "[]"),
     # generic tasks + proxy

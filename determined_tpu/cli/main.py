@@ -93,7 +93,10 @@ def exp_create(args) -> int:
         context_bytes = build_context(args.context_dir)
         print(f"context: {args.context_dir} ({len(context_bytes)} bytes packed)")
     exp = d.create_experiment(
-        args.config, context_dir=args.context_dir, context_bytes=context_bytes
+        args.config,
+        context_dir=args.context_dir,
+        context_bytes=context_bytes,
+        template=getattr(args, "template", None),
     )
     print(f"Created experiment {exp.id}")
     if args.follow:
@@ -207,6 +210,34 @@ def model_register_version(args) -> int:
 
 def master_info(args) -> int:
     _print_json(_client(args).master_info())
+    return 0
+
+
+# ---- templates --------------------------------------------------------------
+
+
+def template_set(args) -> int:
+    import yaml
+
+    with open(args.config) as f:
+        _client(args).set_template(args.name, yaml.safe_load(f))
+    print(f"template {args.name} set")
+    return 0
+
+
+def template_list(args) -> int:
+    _table(_client(args).list_templates(), ["name"])
+    return 0
+
+
+def template_describe(args) -> int:
+    _print_json(_client(args).get_template(args.name))
+    return 0
+
+
+def template_remove(args) -> int:
+    _client(args).delete_template(args.name)
+    print(f"template {args.name} removed")
     return 0
 
 
@@ -387,6 +418,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="model-code directory shipped to the cluster (.detignore honored)",
     )
     c.add_argument("-f", "--follow", action="store_true")
+    c.add_argument("--template", help="master-stored config template to merge under")
     c.set_defaults(fn=exp_create)
     exp.add_parser("list").set_defaults(fn=exp_list)
     d = exp.add_parser("describe")
@@ -435,6 +467,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     master = sub.add_parser("master").add_subparsers(dest="verb", required=True)
     master.add_parser("info").set_defaults(fn=master_info)
+
+    tpl = sub.add_parser("template").add_subparsers(dest="verb", required=True)
+    tset = tpl.add_parser("set")
+    tset.add_argument("name")
+    tset.add_argument("config")
+    tset.set_defaults(fn=template_set)
+    tpl.add_parser("list").set_defaults(fn=template_list)
+    td = tpl.add_parser("describe")
+    td.add_argument("name")
+    td.set_defaults(fn=template_describe)
+    tr = tpl.add_parser("remove")
+    tr.add_argument("name")
+    tr.set_defaults(fn=template_remove)
 
     tb = sub.add_parser("tensorboard").add_subparsers(dest="verb", required=True)
     ts = tb.add_parser("start")

@@ -265,10 +265,13 @@ class Determined:
         config: Union[str, Dict[str, Any]],
         context_dir: Optional[str] = None,
         context_bytes: Optional[bytes] = None,
+        template: Optional[str] = None,
     ) -> Experiment:
         """Submit an experiment; ``config`` is a yaml path or a dict.
         ``context_dir`` is packed (honoring .detignore) and shipped;
-        pass ``context_bytes`` instead if you already packed it."""
+        pass ``context_bytes`` instead if you already packed it.
+        ``template`` names a master-stored config template the config is
+        merged over (config wins; reference templates/)."""
         if isinstance(config, str):
             import yaml
 
@@ -276,8 +279,11 @@ class Determined:
                 config = yaml.safe_load(f)
         from determined_tpu.config.experiment import ExperimentConfig
 
-        ExperimentConfig.parse(dict(config))  # client-side validation
+        if template is None:
+            ExperimentConfig.parse(dict(config))  # client-side validation
         body: Dict[str, Any] = {"config": config}
+        if template is not None:
+            body["template"] = template
         if context_bytes is None and context_dir:
             from determined_tpu.common import build_context
 
@@ -364,6 +370,19 @@ class Determined:
             if time.time() > deadline:
                 raise TimeoutError(f"task {task_id} not ready after {timeout}s")
             time.sleep(0.5)
+
+    # -- config templates --
+    def set_template(self, name: str, config: Dict[str, Any]) -> None:
+        self._session.put(f"/api/v1/templates/{name}", json={"config": config})
+
+    def get_template(self, name: str) -> Dict[str, Any]:
+        return self._session.get(f"/api/v1/templates/{name}").json()["config"]
+
+    def list_templates(self) -> List[Dict[str, Any]]:
+        return self._session.get("/api/v1/templates").json()
+
+    def delete_template(self, name: str) -> None:
+        self._session.delete(f"/api/v1/templates/{name}")
 
     # -- streaming updates --
     def stream_events(

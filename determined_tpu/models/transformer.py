@@ -303,14 +303,7 @@ class LMTrial(JaxTrial):
             hidden = model.apply(params, inputs, return_hidden=True)
             kernel = flax_meta.unbox(params["params"]["lm_head"]["kernel"])
             chunk = g("ce_chunk", None)
-            mesh = self.context.mesh
-            shards = 1
-            if mesh is not None:
-                from determined_tpu.parallel.mesh import MeshAxes
-
-                shards = mesh.shape.get(MeshAxes.DATA, 1) * mesh.shape.get(
-                    MeshAxes.FSDP, 1
-                )
+            shards = self.context.batch_axis_size if self.context.mesh is not None else 1
             loss = fused_cross_entropy(
                 hidden,
                 kernel,

@@ -92,9 +92,14 @@ def fused_cross_entropy(
         # fastest; 2GB (16k tokens) loses to the scan's remat
         chunk_size = 0 if tile_bytes <= (3 << 29) else 4096
 
-    if chunk_size <= 0 or chunk_size >= n:
+    if chunk_size <= 0:
+        # single-tile is an explicit opt-in (or auto pick): no remat, the
+        # f32 logits tile survives as a backward residual.  An explicit
+        # chunk_size >= n still runs the remat'd scan with one chunk —
+        # callers who asked for chunking asked for the memory guarantee.
         loss_sum, count = _chunk_loss(x, kernel, tgt, compute_dtype)
         return loss_sum / jnp.maximum(count, 1.0)
+    chunk_size = min(chunk_size, n)
 
     pad = (-n) % chunk_size
     if pad:

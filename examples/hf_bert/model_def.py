@@ -1,0 +1,7 @@
+"""HF Flax BERT sequence classification (hf_trainer_api analog)."""
+
+from determined_tpu.models.hf_bert import BertClassifyTrial
+
+
+class Trial(BertClassifyTrial):
+    pass

@@ -141,6 +141,10 @@ struct GenericTaskState {
   int port = 0;
   std::string session_token;
   Json config = Json::object();   // e.g. {"experiment_ids": [...]}
+  // idle reaping (reference master/internal/task/idle/): tasks whose
+  // proxy has been quiet for idle_timeout_ms are killed
+  int64_t idle_timeout_ms = 0;    // 0 = never
+  int64_t last_used_ms = 0;
 };
 
 // outbound webhook (reference master/internal/webhooks/): fires on
@@ -261,6 +265,43 @@ class Master {
   void set_agent_timeout_ms(int64_t ms) { agent_timeout_ms_ = ms; }
   void set_scheduler(const std::string& mode) { scheduler_mode_ = mode; }
 
+  // Shared task teardown: release the port, fence the token, optionally
+  // send the kill to the agent.  Used by DELETE /tasks, /tasks/{id}/exit,
+  // the idle reaper, and the agent reaper (caller holds mu_).
+  void terminate_task(GenericTaskState& t, bool send_kill) {
+    if (t.state == "TERMINATED") return;
+    if (send_kill) {
+      auto ait = agents_.find(t.agent_id);
+      if (ait != agents_.end()) {
+        Json work = Json::object();
+        work.set("type", "kill_task");
+        work.set("task_id", t.id);
+        ait->second.work.push_back(work);
+        work_cv_.notify_all();
+      }
+    }
+    t.state = "TERMINATED";
+    t.ready = false;
+    if (t.port) coord_ports_in_use_[t.host].erase(t.port);
+    revoke_token(t.session_token);
+  }
+
+  // Kill ready tasks whose proxy has been idle past their declared
+  // idle_timeout_seconds (reference NTSC idle-timeout service).  The
+  // clock starts at readiness, not creation — slow startup is not idleness.
+  // Caller holds mu_.
+  void reap_idle_tasks() {
+    int64_t now = now_ms();
+    for (auto& [task_id, t] : tasks_) {
+      if (t.state != "RUNNING" || !t.ready || t.idle_timeout_ms <= 0) continue;
+      if (now - t.last_used_ms <= t.idle_timeout_ms) continue;
+      terminate_task(t, /*send_kill=*/true);
+      printf("master: task %s idle-reaped after %lldms\n", t.id.c_str(),
+             static_cast<long long>(t.idle_timeout_ms));
+      fflush(stdout);
+    }
+  }
+
   // Fail agents that stopped polling: their allocations are failed so the
   // trials restart elsewhere, and their slots are freed.  The reference
   // fails allocations when the agent websocket drops
@@ -292,10 +333,8 @@ class Master {
       // the fit and swallow the relaunch into a deque nobody drains
       agents_.erase(aid);
       for (auto& [task_id, task] : tasks_) {
-        if (task.agent_id == aid && task.state != "TERMINATED") {
-          task.state = "TERMINATED";
-          if (task.port) coord_ports_in_use_[task.host].erase(task.port);
-          revoke_token(task.session_token);
+        if (task.agent_id == aid) {
+          terminate_task(task, /*send_kill=*/false);  // agent is gone
         }
       }
       for (const auto& alloc_id : failed) {
@@ -418,6 +457,10 @@ class Master {
       next_webhook_id_ = std::max(next_webhook_id_, wh.id + 1);
     } else if (type == "webhook_deleted") {
       webhooks_.erase(ev["id"].as_int());
+    } else if (type == "template_set") {
+      templates_[ev["name"].as_string()] = ev["config"];
+    } else if (type == "template_deleted") {
+      templates_.erase(ev["name"].as_string());
     } else if (type == "model_created") {
       models_[ev["name"].as_string()] = ev["model"];
     } else if (type == "model_version") {
@@ -538,6 +581,9 @@ class Master {
     Json models = Json::object();
     for (const auto& [name, model] : models_) models.set(name, model);
     snap.set("models", models);
+    Json templates = Json::object();
+    for (const auto& [name, cfg] : templates_) templates.set(name, cfg);
+    snap.set("templates", templates);
     Json checkpoints = Json::object();
     for (const auto& [uuid, c] : checkpoints_) checkpoints.set(uuid, c);
     snap.set("checkpoints", checkpoints);
@@ -623,6 +669,9 @@ class Master {
       }
     }
     for (const auto& [name, model] : s["models"].items()) models_[name] = model;
+    if (s.contains("templates")) {
+      for (const auto& [name, cfg] : s["templates"].items()) templates_[name] = cfg;
+    }
     for (const auto& [uuid, c] : s["checkpoints"].items()) checkpoints_[uuid] = c;
     for (const auto& e : s["experiments"].elements()) {
       int64_t id = e["id"].as_int();
@@ -1493,6 +1542,23 @@ class Master {
 
   // ---- route helpers -----------------------------------------------------
 
+  // recursive dict merge, override wins — the template-application
+  // semantics shared with the Python side (config/experiment.py
+  // merge_configs; reference schemas.Merge)
+  static Json merge_json(const Json& base, const Json& override_) {
+    if (!base.is_object() || !override_.is_object()) return override_;
+    Json out = Json::object();
+    for (const auto& [k, v] : base.items()) out.set(k, v);
+    for (const auto& [k, v] : override_.items()) {
+      if (out.contains(k) && out[k].is_object() && v.is_object()) {
+        out.set(k, merge_json(out[k], v));
+      } else {
+        out.set(k, v);
+      }
+    }
+    return out;
+  }
+
   // submit-time config validation the Python dataclasses also enforce
   // (config/experiment.py); the master re-checks because it is the trust
   // boundary (reference: cluster-side expconf JSON-schema validation)
@@ -1611,6 +1677,7 @@ class Master {
   std::map<std::string, UserState> users_;
   std::map<std::string, TokenInfo> tokens_;
   std::map<std::string, Json> models_;         // registry: name -> model
+  std::map<std::string, Json> templates_;      // config templates (reference templates/)
   std::map<int64_t, WebhookState> webhooks_;
   int64_t next_webhook_id_ = 1;
   std::map<std::string, GenericTaskState> tasks_;
@@ -1829,7 +1896,17 @@ void install_routes_impl(Master& m, HttpServer& srv) {
   srv.route("POST", "/api/v1/experiments", authed([&m](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
-    const Json& config = body.contains("config") ? body["config"] : body;
+    Json config = body.contains("config") ? body["config"] : body;
+    // template application: submitted config overrides the stored
+    // template (reference templates/ + schemas.Merge semantics)
+    if (body.contains("template") && body["template"].is_string()) {
+      std::lock_guard<std::mutex> lk(m.mu_);
+      auto tit = m.templates_.find(body["template"].as_string());
+      if (tit == m.templates_.end()) {
+        return R::error(400, "no such template: " + body["template"].as_string());
+      }
+      config = Master::merge_json(tit->second, config);
+    }
     std::string cfg_err = Master::validate_config(config);
     if (!cfg_err.empty()) return R::error(400, cfg_err);
     // decode + write the context tarball to a temp file BEFORE creating the
@@ -2375,6 +2452,52 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     return R::json("{}");
   }));
 
+  // ---- config templates (reference templates/) ----
+  srv.route("PUT", "/api/v1/templates/{name}", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    const Json& config = body.contains("config") ? body["config"] : body;
+    if (!config.is_object()) return R::error(400, "template config must be an object");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    const std::string& name = req.params.at("name");
+    m.templates_[name] = config;
+    m.record(Json::object()
+                 .set("type", "template_set")
+                 .set("name", name)
+                 .set("config", config));
+    return R::json(Json::object().set("name", name).dump(), 201);
+  }));
+
+  srv.route("GET", "/api/v1/templates", authed([&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    Json out = Json::array();
+    for (const auto& [name, cfg] : m.templates_) {
+      out.push_back(Json::object().set("name", name).set("config", cfg));
+    }
+    return R::json(out.dump());
+  }));
+
+  srv.route("GET", "/api/v1/templates/{name}", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.templates_.find(req.params.at("name"));
+    if (it == m.templates_.end()) return R::error(404, "no such template");
+    Json out = Json::object();
+    out.set("name", it->first);
+    out.set("config", it->second);
+    return R::json(out.dump());
+  }));
+
+  srv.route("DELETE", "/api/v1/templates/{name}", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    if (m.templates_.erase(req.params.at("name")) == 0) {
+      return R::error(404, "no such template");
+    }
+    m.record(Json::object()
+                 .set("type", "template_deleted")
+                 .set("name", req.params.at("name")));
+    return R::json("{}");
+  }));
+
   // ---- streaming updates (reference master/internal/stream/, redesigned:
   // long-polled seq-ordered event feed instead of a websocket) ----
   srv.route("GET", "/api/v1/events", authed([&m](const HttpRequest& req) {
@@ -2454,6 +2577,9 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     task.agent_id = target->id;
     task.host = target->host.empty() ? "127.0.0.1" : target->host;
     if (body.contains("config")) task.config = body["config"];
+    task.idle_timeout_ms =
+        task.config["idle_timeout_seconds"].as_int(0) * 1000;
+    task.last_used_ms = now_ms();
     int port = 18000;
     {
       auto& used = m.coord_ports_in_use_[task.host];
@@ -2520,6 +2646,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     if (it == m.tasks_.end()) return R::error(404, "no such task");
     it->second.ready = true;
     it->second.state = "RUNNING";
+    it->second.last_used_ms = now_ms();  // idle clock starts at readiness
     return R::json("{}");
   }));
 
@@ -2527,11 +2654,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     std::lock_guard<std::mutex> lk(m.mu_);
     auto it = m.tasks_.find(req.params.at("id"));
     if (it == m.tasks_.end()) return R::error(404, "no such task");
-    GenericTaskState& t = it->second;
-    t.state = "TERMINATED";
-    t.ready = false;
-    if (t.port) m.coord_ports_in_use_[t.host].erase(t.port);
-    m.revoke_token(t.session_token);
+    m.terminate_task(it->second, /*send_kill=*/false);  // already exited
     return R::json("{}");
   }));
 
@@ -2539,19 +2662,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     std::lock_guard<std::mutex> lk(m.mu_);
     auto it = m.tasks_.find(req.params.at("id"));
     if (it == m.tasks_.end()) return R::error(404, "no such task");
-    GenericTaskState& t = it->second;
-    auto ait = m.agents_.find(t.agent_id);
-    if (ait != m.agents_.end()) {
-      Json work = Json::object();
-      work.set("type", "kill_task");
-      work.set("task_id", t.id);
-      ait->second.work.push_back(work);
-      m.work_cv_.notify_all();
-    }
-    t.state = "TERMINATED";
-    t.ready = false;
-    if (t.port) m.coord_ports_in_use_[t.host].erase(t.port);
-    m.revoke_token(t.session_token);
+    m.terminate_task(it->second, /*send_kill=*/true);
     return R::json("{}");
   }));
 
@@ -2579,6 +2690,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       auto it = m.tasks_.find(req.params.at("id"));
       if (it == m.tasks_.end()) return R::error(404, "no such task");
       if (!it->second.ready) return R::error(409, "task not ready");
+      it->second.last_used_ms = now_ms();  // idle-timeout clock
       host = it->second.host;
       port = it->second.port;
     }
@@ -2838,6 +2950,7 @@ int main(int argc, char** argv) {
     // every tick; only agents that actually stopped polling go stale
     master.work_cv_.notify_all();
     master.reap_dead_agents();
+    master.reap_idle_tasks();
     if (++ticks >= 1800) {
       ticks = 0;
       master.retention_sweep();

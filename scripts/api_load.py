@@ -1,0 +1,95 @@
+"""API load test: the k6 suite analog (reference
+performance/k6/src/api_performance_tests.ts:372-414 — per-endpoint-group
+p95 latency thresholds, nightly).
+
+Drives a live master with concurrent clients over the read-path endpoint
+groups and prints per-group p50/p95/p99 plus a JSON summary line.  Run
+against a devcluster:
+
+    python scripts/api_load.py --master http://127.0.0.1:8080 \
+        --clients 8 --requests 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GROUPS = {
+    "master_info": ("GET", "/api/v1/master"),
+    "experiment_list": ("GET", "/api/v1/experiments"),
+    "experiment_detail": ("GET", "/api/v1/experiments/1"),
+    "trial_detail": ("GET", "/api/v1/trials/1"),
+    "trial_metrics": ("GET", "/api/v1/trials/1/metrics"),
+    "trial_logs": ("GET", "/api/v1/trials/1/logs"),
+    "checkpoints": ("GET", "/api/v1/checkpoints"),
+    "agents": ("GET", "/api/v1/agents"),
+    "job_queue": ("GET", "/api/v1/job-queue"),
+    "events": ("GET", "/api/v1/events"),
+}
+
+
+def run(master: str, clients: int, requests: int, thresholds_ms: float):
+    from determined_tpu.api.authentication import ensure_session
+
+    session = ensure_session(master)
+
+    def one_group(name, method, path):
+        times = []
+        errors = 0
+
+        def one_request(_):
+            t0 = time.perf_counter()
+            try:
+                session.request(method, path, timeout=30)
+                return (time.perf_counter() - t0) * 1000, 0
+            except Exception:  # noqa: BLE001
+                return (time.perf_counter() - t0) * 1000, 1
+
+        with concurrent.futures.ThreadPoolExecutor(clients) as pool:
+            for dt, err in pool.map(one_request, range(requests)):
+                times.append(dt)
+                errors += err
+        times.sort()
+        pct = lambda p: times[min(len(times) - 1, int(p / 100 * len(times)))]  # noqa: E731
+        return {
+            "group": name,
+            "p50_ms": round(statistics.median(times), 2),
+            "p95_ms": round(pct(95), 2),
+            "p99_ms": round(pct(99), 2),
+            "errors": errors,
+        }
+
+    rows = [one_group(n, m, p) for n, (m, p) in GROUPS.items()]
+    print(f"{'group':20} {'p50':>8} {'p95':>8} {'p99':>8} errors")
+    worst = 0.0
+    for r in rows:
+        print(f"{r['group']:20} {r['p50_ms']:8.2f} {r['p95_ms']:8.2f} "
+              f"{r['p99_ms']:8.2f} {r['errors']:>6}")
+        worst = max(worst, r["p95_ms"])
+    ok = worst <= thresholds_ms and all(r["errors"] == 0 for r in rows)
+    print(json.dumps({"metric": "api_p95_worst_ms", "value": worst,
+                      "threshold_ms": thresholds_ms, "pass": ok}))
+    return 0 if ok else 1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--master", default=os.environ.get("DTPU_MASTER",
+                                                       "http://127.0.0.1:8080"))
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=100)
+    ap.add_argument("--threshold-ms", type=float, default=500.0)
+    args = ap.parse_args()
+    sys.exit(run(args.master, args.clients, args.requests, args.threshold_ms))
+
+
+if __name__ == "__main__":
+    main()

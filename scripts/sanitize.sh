@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Race/memory-sanitized builds of the native daemons + devcluster smoke —
+# the analog of the reference's `go test -race` CI (SURVEY §5: the Go side
+# relies on the race detector; the C++ side here uses TSAN/ASAN).
+#
+#   scripts/sanitize.sh thread    # TSAN build + smoke
+#   scripts/sanitize.sh address   # ASAN build + smoke
+set -euo pipefail
+SAN="${1:-thread}"
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+BUILD="$REPO/native/build-$SAN"
+cmake -S "$REPO/native" -B "$BUILD" -G Ninja -DSANITIZE="$SAN" >/dev/null
+cmake --build "$BUILD"
+LOG="$(mktemp -d)/san"
+export DTPU_NATIVE_BUILD_DIR="$BUILD"
+export TSAN_OPTIONS="log_path=$LOG" ASAN_OPTIONS="log_path=$LOG"
+cd "$REPO"
+python -m pytest \
+  tests/test_devcluster.py::test_single_experiment_completes \
+  tests/test_devcluster.py::test_webhooks_state_change_and_custom \
+  tests/test_devcluster.py::test_priority_preemption_yields_and_resumes \
+  -q
+if compgen -G "$LOG*" > /dev/null; then
+  echo "SANITIZER REPORTS:"
+  cat "$LOG"*
+  exit 1
+fi
+echo "sanitize($SAN): clean"

@@ -68,6 +68,7 @@ BODIES = {
     ("POST", "/api/v1/allocations/{id}/signals/ack_preemption"): {},
     ("POST", "/api/v1/trials/{id}/heartbeat"): {},
     ("POST", "/api/v1/auth/login"): {"username": "determined", "password": ""},
+    ("PUT", "/api/v1/templates/{name}"): {"config": {"max_restarts": 2}},
 }
 
 

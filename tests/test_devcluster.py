@@ -18,8 +18,13 @@ import pytest
 import requests
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-MASTER_BIN = os.path.join(REPO, "native", "build", "dtpu-master")
-AGENT_BIN = os.path.join(REPO, "native", "build", "dtpu-agent")
+# DTPU_NATIVE_BUILD_DIR points the whole suite at e.g. a TSAN build
+# (native/build-tsan; see native/CMakeLists.txt SANITIZE option)
+_BUILD_DIR = os.environ.get(
+    "DTPU_NATIVE_BUILD_DIR", os.path.join(REPO, "native", "build")
+)
+MASTER_BIN = os.path.join(_BUILD_DIR, "dtpu-master")
+AGENT_BIN = os.path.join(_BUILD_DIR, "dtpu-agent")
 
 pytestmark = pytest.mark.skipif(
     not (os.path.exists(MASTER_BIN) and os.path.exists(AGENT_BIN)),
@@ -1094,3 +1099,93 @@ def test_webui_served_and_uses_live_routes(cluster):
         if p == "/api/v1/auth/login":
             continue
         assert resp.status_code == 200, f"{p} -> {resp.status_code}"
+
+
+def test_api_load_p95_under_threshold(cluster):
+    """k6-analog API latency suite (reference performance/k6): read-path
+    p95 stays under a dev-grade threshold with concurrent clients while an
+    experiment exists."""
+    import subprocess as sp
+    import sys as _sys
+
+    exp_id = cluster.submit(exp_config(cluster.ckpt_dir))
+    cluster.wait_for_state(exp_id)
+    env = dict(os.environ)
+    env["DTPU_TOKEN"] = cluster.token
+    out = sp.run(
+        [_sys.executable, os.path.join(REPO, "scripts", "api_load.py"),
+         "--master", cluster.url, "--clients", "4", "--requests", "40",
+         "--threshold-ms", "2000"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["pass"] is True
+
+
+def test_task_idle_timeout_reaps(cluster):
+    """A task declaring idle_timeout_seconds is killed after its proxy
+    goes quiet (reference NTSC idle-timeout service)."""
+    r = cluster.http.post(
+        cluster.url + "/api/v1/tasks",
+        json={"type": "tensorboard", "config": {"idle_timeout_seconds": 3}},
+    )
+    assert r.status_code == 201
+    task_id = r.json()["id"]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        info = cluster.http.get(f"{cluster.url}/api/v1/tasks/{task_id}").json()
+        if info["ready"]:
+            break
+        time.sleep(0.5)
+    assert info["ready"]
+    # touch the proxy once; then go quiet and expect the reaper
+    assert cluster.http.get(cluster.url + f"/proxy/{task_id}/healthz").status_code == 200
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        info = cluster.http.get(f"{cluster.url}/api/v1/tasks/{task_id}").json()
+        if info["state"] == "TERMINATED":
+            break
+        time.sleep(1.0)
+    assert info["state"] == "TERMINATED", info
+
+
+def test_config_templates_merge_on_submit(cluster, tmp_path):
+    """Master-stored templates merge under the submitted config, config
+    wins (reference templates/ + schemas.Merge)."""
+    r = cluster.http.put(
+        cluster.url + "/api/v1/templates/fast-defaults",
+        json={"config": {
+            "max_restarts": 1,
+            "min_validation_period": {"batches": 3},
+            "searcher": {"name": "single", "metric": "validation_accuracy",
+                         "smaller_is_better": False,
+                         "max_length": {"batches": 6}},
+        }},
+    )
+    assert r.status_code == 201
+    assert [t["name"] for t in
+            cluster.http.get(cluster.url + "/api/v1/templates").json()] == ["fast-defaults"]
+
+    cfg = exp_config(cluster.ckpt_dir)
+    # strip what the template provides; override one field to prove config wins
+    del cfg["searcher"]
+    del cfg["min_validation_period"]
+    cfg["max_restarts"] = 4
+    r = cluster.http.post(
+        cluster.url + "/api/v1/experiments",
+        json={"config": cfg, "template": "fast-defaults"},
+    )
+    assert r.status_code == 201, r.text
+    exp_id = r.json()["id"]
+    merged = cluster.http.get(f"{cluster.url}/api/v1/experiments/{exp_id}").json()["config"]
+    assert merged["max_restarts"] == 4              # config wins
+    assert merged["searcher"]["name"] == "single"   # template filled
+    assert cluster.wait_for_state(exp_id)["state"] == "COMPLETED"
+
+    # unknown template rejected
+    r = cluster.http.post(
+        cluster.url + "/api/v1/experiments",
+        json={"config": exp_config(cluster.ckpt_dir), "template": "nope"},
+    )
+    assert r.status_code == 400
