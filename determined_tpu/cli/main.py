@@ -248,8 +248,18 @@ def tensorboard_start(args) -> int:
     d = _client(args)
     info = d.start_tensorboard(experiment_ids=args.experiment_ids or [])
     info = d.wait_task_ready(info["id"], timeout=args.timeout)
-    url = d.master + info["proxy_url"]
+    url = f"{d.master}{info['proxy_url']}?dtpu_token={d.session.token}"
     print(f"tensorboard {info['id']} ready: {url}")
+    return 0
+
+
+def notebook_start(args) -> int:
+    d = _client(args)
+    info = d.start_notebook(work_dir=args.work_dir)
+    info = d.wait_task_ready(info["id"], timeout=args.timeout)
+    url = (f"{d.master}{info['proxy_url']}?dtpu_token={d.session.token}"
+           f"&token={info.get('token', '')}")
+    print(f"notebook {info['id']} ready: {url}")
     return 0
 
 
@@ -486,6 +496,12 @@ def build_parser() -> argparse.ArgumentParser:
     ts.add_argument("experiment_ids", nargs="*", type=int)
     ts.add_argument("--timeout", type=float, default=60.0)
     ts.set_defaults(fn=tensorboard_start)
+
+    nb = sub.add_parser("notebook").add_subparsers(dest="verb", required=True)
+    ns = nb.add_parser("start")
+    ns.add_argument("--work-dir")
+    ns.add_argument("--timeout", type=float, default=150.0)
+    ns.set_defaults(fn=notebook_start)
 
     task = sub.add_parser("task").add_subparsers(dest="verb", required=True)
     task.add_parser("list").set_defaults(fn=task_list)

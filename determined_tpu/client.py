@@ -350,6 +350,15 @@ class Determined:
         )
         return resp.json()
 
+    def start_notebook(self, work_dir: Optional[str] = None) -> Dict[str, Any]:
+        """Launch a Jupyter notebook task behind the proxy (reference:
+        ``det notebook start``)."""
+        resp = self._session.post(
+            "/api/v1/tasks",
+            json={"type": "notebook", "config": {"work_dir": work_dir or ""}},
+        )
+        return resp.json()
+
     def get_task(self, task_id: str) -> Dict[str, Any]:
         return self._session.get(f"/api/v1/tasks/{task_id}").json()
 
@@ -416,12 +425,17 @@ class Determined:
         return self._session.get("/api/v1/auth/whoami").json()
 
     def create_user(
-        self, username: str, password: str = "", admin: bool = False
+        self,
+        username: str,
+        password: str = "",
+        admin: bool = False,
+        role: Optional[str] = None,
     ) -> Dict[str, Any]:
-        return self._session.post(
-            "/api/v1/users",
-            json={"username": username, "password": password, "admin": admin},
-        ).json()
+        """Create a user; ``role`` is admin/user/viewer (RBAC-lite)."""
+        body: Dict[str, Any] = {"username": username, "password": password, "admin": admin}
+        if role is not None:
+            body["role"] = role
+        return self._session.post("/api/v1/users", json=body).json()
 
 
 # -- module-level convenience (reference: client.py module functions bound to

@@ -1,0 +1,93 @@
+"""Notebook task: a Jupyter server behind the master proxy.
+
+Reference: ``master/internal/command/`` notebooks + ``api_notebook.go`` —
+NTSC tasks running jupyter with readiness detection
+(``check_ready_logs.py``) and proxy registration.  Here the task process
+launches ``jupyter server`` mounted at its proxy base url
+(``DTPU_TASK_BASE_URL``), polls it until it answers, then reports ready to
+the master, which flips the proxy live.  Auth: jupyter's own token is set
+to the task's session token (the proxy additionally requires the master
+bearer token, so the notebook is doubly gated).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+
+def main() -> int:
+    task_id = os.environ.get("DTPU_TASK_ID", "task")
+    port = int(os.environ.get("DTPU_TASK_PORT", "18888"))
+    base_url = os.environ.get("DTPU_TASK_BASE_URL", f"/proxy/{task_id}/")
+    token = os.environ.get("DTPU_SESSION_TOKEN", "")
+    master = os.environ["DTPU_MASTER_URL"].rstrip("/")
+    cfg = json.loads(os.environ.get("DTPU_TASK_CONFIG", "{}") or "{}")
+    workdir = cfg.get("work_dir") or os.environ.get("HOME") or "/tmp"
+
+    # the token rides the JUPYTER_TOKEN env var, NOT argv — command lines
+    # are world-readable via /proc and this is a live master bearer token
+    child_env = dict(os.environ)
+    child_env["JUPYTER_TOKEN"] = token
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "jupyter", "server",
+            "--ServerApp.ip=0.0.0.0",
+            f"--ServerApp.port={port}",
+            f"--ServerApp.base_url={base_url}",
+            f"--ServerApp.root_dir={workdir}",
+            "--ServerApp.open_browser=False",
+            "--ServerApp.allow_remote_access=True",
+            "--ServerApp.port_retries=0",
+            "--allow-root",  # TPU VMs and devcluster tests run as root
+            # the master proxy is the auth boundary and its dtpu_token
+            # cookie is SameSite=Strict (cross-site requests never reach
+            # the notebook), so jupyter's own XSRF double-check is off —
+            # it breaks token-authenticated API calls through the proxy
+            "--ServerApp.disable_check_xsrf=True",
+        ],
+        env=child_env,
+    )
+
+    def forward(sig, _frame):
+        proc.send_signal(sig)
+
+    signal.signal(signal.SIGTERM, forward)
+
+    # readiness: jupyter answers its own /api route
+    deadline = time.time() + 120
+    ready = False
+    while time.time() < deadline and proc.poll() is None:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{base_url}api", timeout=2
+            ) as resp:
+                if resp.status == 200:
+                    ready = True
+                    break
+        except Exception:  # noqa: BLE001 - still starting
+            time.sleep(1.0)
+    if not ready:
+        print("jupyter server did not become ready", flush=True)
+        proc.terminate()
+        return 1
+
+    req = urllib.request.Request(
+        f"{master}/api/v1/tasks/{task_id}/ready",
+        data=b"{}",
+        headers={"Authorization": f"Bearer {token}"},
+        method="POST",
+    )
+    urllib.request.urlopen(req, timeout=30).read()
+    print(f"notebook task {task_id} ready on :{port}{base_url} "
+          f"(jupyter token = task session token)", flush=True)
+    return proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

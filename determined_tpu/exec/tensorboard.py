@@ -108,6 +108,11 @@ def main() -> int:
 
         def do_GET(self):  # noqa: N802 (stdlib API)
             try:
+                # the master proxy forwards the FULL path; serve both the
+                # proxied prefix (DTPU_TASK_BASE_URL) and direct access
+                base = os.environ.get("DTPU_TASK_BASE_URL", "/")
+                if base != "/" and self.path.startswith(base):
+                    self.path = "/" + self.path[len(base):]
                 if self.path in ("/", "/index.html"):
                     self._send(_PAGE.encode(), "text/html")
                 elif self.path == "/healthz":

@@ -67,12 +67,16 @@ def _fwd_kernel(
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)          # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)          # [bk, d]
-        v = v_ref[0, 0].astype(jnp.float32)          # [bk, d]
+        # MXU inputs stay in the INPUT dtype (bf16 in production: ~4x the
+        # f32 matmul throughput on v5e) with f32 accumulation; only the
+        # softmax running stats are f32.  f32 inputs (tests/debug) keep
+        # full f32 matmuls, so tight-tolerance checks still hold.
+        q = q_ref[0, 0]                               # [bq, d]
+        k = k_ref[0, 0]                               # [bk, d]
+        v = v_ref[0, 0]                               # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                     # [bq, bk]
+        ) * scale                                     # [bq, bk] f32
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -84,11 +88,12 @@ def _fwd_kernel(
         m_prev, l_prev = m_sc[:], l_sc[:]
         m_cur = jnp.max(s, axis=1, keepdims=True)     # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                        # [bq, bk]
+        p = jnp.exp(s - m_new)                        # [bq, bk] f32
         alpha = jnp.exp(m_prev - m_new)               # [bq, 1]
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(q.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_sc[:] = m_new
         l_sc[:] = l_new
@@ -158,10 +163,11 @@ def _dq_kernel(
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 MXU inputs, f32 accumulation (see _fwd_kernel note)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0].reshape(-1, 1)            # [bq, 1]
         delta = delta_ref[0, 0].reshape(-1, 1)        # [bq, 1]
         s = jax.lax.dot_general(
@@ -175,11 +181,11 @@ def _dq_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                          # [bq, bk]
+        p = jnp.exp(s - lse)                          # [bq, bk] f32
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )                                             # [bq, bk]
-        ds = p * (dp - delta) * scale
+        )                                             # [bq, bk] f32
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dq_sc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -205,10 +211,11 @@ def _dkv_kernel(
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 MXU inputs, f32 accumulation (see _fwd_kernel note)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0].reshape(-1, 1)
         delta = delta_ref[0, 0].reshape(-1, 1)
         s = jax.lax.dot_general(
@@ -222,14 +229,15 @@ def _dkv_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                          # [bq, bk]
+        p = jnp.exp(s - lse)                          # [bq, bk] f32
+        p_in = p.astype(q.dtype)
         dv_sc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_in, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )                                             # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * scale                 # [bq, bk]
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
         dk_sc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )                                             # [bk, d]

@@ -37,6 +37,8 @@ struct HttpResponse {
   int status = 200;
   std::string content_type = "application/json";
   std::string body;
+  // extra response headers (e.g. Set-Cookie from the proxy auth)
+  std::vector<std::pair<std::string, std::string>> headers;
 
   static HttpResponse json(const std::string& body, int status = 200) {
     HttpResponse r;
@@ -219,9 +221,9 @@ class HttpServer {
     std::ostringstream out;
     out << "HTTP/1.1 " << resp.status << " " << reason(resp.status) << "\r\n"
         << "Content-Type: " << resp.content_type << "\r\n"
-        << "Content-Length: " << resp.body.size() << "\r\n"
-        << "Connection: keep-alive\r\n\r\n"
-        << resp.body;
+        << "Content-Length: " << resp.body.size() << "\r\n";
+    for (const auto& [k, v] : resp.headers) out << k << ": " << v << "\r\n";
+    out << "Connection: keep-alive\r\n\r\n" << resp.body;
     std::string data = out.str();
     size_t sent = 0;
     while (sent < data.size()) {
@@ -299,7 +301,8 @@ class HttpServer {
 struct ClientResponse {
   int status = 0;
   std::string body;
-  std::string content_type;  // for proxy passthrough
+  std::string content_type;                 // for proxy passthrough
+  std::vector<std::string> set_cookies;     // upstream Set-Cookie headers
   bool ok() const { return status >= 200 && status < 300; }
 };
 
@@ -348,7 +351,7 @@ inline ClientResponse http_request(const std::string& host, int port,
   auto he = resp.find("\r\n\r\n");
   if (he != std::string::npos) {
     std::string head = resp.substr(0, he);
-    // lowercase scan for the content-type header
+    // lowercase copy for case-insensitive header scans
     std::string lower = head;
     for (auto& c : lower) c = static_cast<char>(tolower(c));
     auto ct = lower.find("content-type:");
@@ -357,6 +360,14 @@ inline ClientResponse http_request(const std::string& host, int port,
       std::string val = head.substr(ct + 13, eol - ct - 13);
       while (!val.empty() && val.front() == ' ') val.erase(val.begin());
       out.content_type = val;
+    }
+    size_t pos = 0;
+    while ((pos = lower.find("set-cookie:", pos)) != std::string::npos) {
+      auto eol = head.find("\r\n", pos);
+      std::string val = head.substr(pos + 11, eol - pos - 11);
+      while (!val.empty() && val.front() == ' ') val.erase(val.begin());
+      out.set_cookies.push_back(val);
+      pos = eol == std::string::npos ? head.size() : eol;
     }
     out.body = resp.substr(he + 4);
   }

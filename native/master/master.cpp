@@ -108,6 +108,10 @@ struct UserState {
   std::string salt;
   std::string pwhash;  // sha256(salt + password)
   bool admin = false;
+  // RBAC-lite (reference internal/rbac basic impl): admin = everything;
+  // user = full use, but mutating OTHER users' experiments is denied;
+  // viewer = read-only API access
+  std::string role = "user";
 };
 
 struct TokenInfo {
@@ -435,6 +439,9 @@ class Master {
       u.salt = ev["salt"].as_string();
       u.pwhash = ev["pwhash"].as_string();
       u.admin = ev["admin"].as_bool(false);
+      u.role = ev.contains("role") && ev["role"].is_string()
+                   ? ev["role"].as_string()
+                   : (u.admin ? "admin" : "user");
       users_[ev["username"].as_string()] = u;
     } else if (type == "token_issued") {
       tokens_[ev["token"].as_string()] = {ev["username"].as_string(),
@@ -568,7 +575,8 @@ class Master {
       users.set(name, Json::object()
                           .set("salt", u.salt)
                           .set("pwhash", u.pwhash)
-                          .set("admin", Json(u.admin)));
+                          .set("admin", Json(u.admin))
+                          .set("role", u.role));
     }
     snap.set("users", users);
     Json tokens = Json::object();
@@ -659,6 +667,9 @@ class Master {
       user.salt = u["salt"].as_string();
       user.pwhash = u["pwhash"].as_string();
       user.admin = u["admin"].as_bool(false);
+      user.role = u.contains("role") && u["role"].is_string()
+                      ? u["role"].as_string()
+                      : (user.admin ? "admin" : "user");
       users_[name] = user;
     }
     for (const auto& [tok, info] : s["tokens"].items()) {
@@ -744,18 +755,21 @@ class Master {
     return out;
   }
 
-  void set_user(const std::string& name, const std::string& password, bool admin) {
+  void set_user(const std::string& name, const std::string& password, bool admin,
+                const std::string& role = "") {
     UserState u;
     u.salt = random_hex(8);
     u.pwhash = sha256_hex(u.salt + password);
     u.admin = admin;
+    u.role = !role.empty() ? role : (admin ? "admin" : "user");
     users_[name] = u;
     record(Json::object()
                .set("type", "user_set")
                .set("username", name)
                .set("salt", u.salt)
                .set("pwhash", u.pwhash)
-               .set("admin", Json(admin)));
+               .set("admin", Json(admin))
+               .set("role", u.role));
   }
 
   static constexpr int64_t kTokenTtlMs = 30LL * 24 * 3600 * 1000;  // 30 days
@@ -1766,8 +1780,15 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     return [&m, h](const HttpRequest& req) {
       {
         std::lock_guard<std::mutex> lk(m.mu_);
-        if (m.authenticate(req).empty()) {
+        std::string user = m.authenticate(req);
+        if (user.empty()) {
           return R::error(401, "unauthenticated: missing or invalid token");
+        }
+        // RBAC-lite: viewers are read-only across the API
+        auto uit = m.users_.find(user);
+        if (uit != m.users_.end() && uit->second.role == "viewer" &&
+            req.method != "GET") {
+          return R::error(403, "role 'viewer' is read-only");
         }
       }
       return h(req);
@@ -1814,6 +1835,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     Json out = Json::object();
     out.set("username", user);
     out.set("admin", Json(m.users_[user].admin));
+    out.set("role", m.users_[user].role);
     return R::json(out.dump());
   });
 
@@ -1823,10 +1845,17 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     std::string username = body["username"].as_string();
     if (username.empty()) return R::error(400, "username required");
+    std::string role;
+    if (body.contains("role") && body["role"].is_string()) {
+      role = body["role"].as_string();
+      if (role != "admin" && role != "user" && role != "viewer") {
+        return R::error(400, "role must be admin, user or viewer");
+      }
+    }
     std::lock_guard<std::mutex> lk(m.mu_);
     m.set_user(username,
                body.contains("password") ? body["password"].as_string() : "",
-               body["admin"].as_bool(false));
+               body["admin"].as_bool(false) || role == "admin", role);
     return R::json("{\"created\":true}", 201);
   }));
 
@@ -1834,7 +1863,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     std::lock_guard<std::mutex> lk(m.mu_);
     Json out = Json::array();
     for (const auto& [name, u] : m.users_) {
-      out.push_back(Json::object().set("username", name).set("admin", Json(u.admin)));
+      out.push_back(Json::object()
+                        .set("username", name)
+                        .set("admin", Json(u.admin))
+                        .set("role", u.role));
     }
     return R::json(out.dump());
   }));
@@ -1982,6 +2014,15 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     auto it = m.experiments_.find(std::stoll(req.params.at("id")));
     if (it == m.experiments_.end()) return R::error(404, "no such experiment");
     auto& exp = it->second;
+    // owner gating: non-admins may only signal their own experiments
+    // (reference authz basic: owner-or-admin on experiment mutations)
+    std::string user = m.authenticate(req);
+    auto uit = m.users_.find(user);
+    bool is_admin = uit != m.users_.end() && uit->second.admin;
+    if (!is_admin && user != exp.owner) {
+      return R::error(403, "only the owner or an admin may " + verb +
+                               " this experiment");
+    }
     if (verb == "pause" && exp.state == "ACTIVE") {
       m.set_exp_state(exp, "PAUSED");
       for (auto& [rid, tid] : exp.rid_to_trial) {
@@ -2556,7 +2597,12 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     std::string type =
         body.contains("type") ? body["type"].as_string() : "tensorboard";
-    if (type != "tensorboard") {
+    std::string module;
+    if (type == "tensorboard") {
+      module = "determined_tpu.exec.tensorboard";
+    } else if (type == "notebook") {
+      module = "determined_tpu.exec.notebook";
+    } else {
       return R::error(400, "unknown task type: " + type);
     }
     std::lock_guard<std::mutex> lk(m.mu_);
@@ -2593,12 +2639,13 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     env.set("DTPU_TASK_ID", task.id);
     env.set("DTPU_TASK_TYPE", task.type);
     env.set("DTPU_TASK_PORT", std::to_string(task.port));
+    env.set("DTPU_TASK_BASE_URL", "/proxy/" + task.id + "/");
     env.set("DTPU_SESSION_TOKEN", task.session_token);
     env.set("DTPU_TASK_CONFIG", task.config.dump());
     Json work = Json::object();
     work.set("type", "launch_task");
     work.set("task_id", task.id);
-    work.set("module", "determined_tpu.exec.tensorboard");
+    work.set("module", module);
     work.set("env", env);
     target->work.push_back(work);
     m.tasks_[task.id] = task;
@@ -2612,7 +2659,9 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     return R::json(out.dump(), 201);
   }));
 
-  auto task_json = [](const GenericTaskState& t) {
+  // the task's own session token doubles as its app token (jupyter);
+  // surfaced only to the task owner or an admin
+  auto task_json = [&m](const GenericTaskState& t, const std::string& viewer) {
     Json j = Json::object();
     j.set("id", t.id);
     j.set("type", t.type);
@@ -2621,13 +2670,19 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     j.set("ready", Json(t.ready));
     j.set("agent_id", t.agent_id);
     j.set("proxy_url", "/proxy/" + t.id + "/");
+    auto uit = m.users_.find(viewer);
+    bool is_admin = uit != m.users_.end() && uit->second.admin;
+    if (t.state != "TERMINATED" && (is_admin || viewer == t.owner)) {
+      j.set("token", t.session_token);
+    }
     return j;
   };
 
-  srv.route("GET", "/api/v1/tasks", authed([&m, task_json](const HttpRequest&) {
+  srv.route("GET", "/api/v1/tasks", authed([&m, task_json](const HttpRequest& req) {
     std::lock_guard<std::mutex> lk(m.mu_);
+    std::string viewer = m.authenticate(req);
     Json out = Json::array();
-    for (const auto& [tid, t] : m.tasks_) out.push_back(task_json(t));
+    for (const auto& [tid, t] : m.tasks_) out.push_back(task_json(t, viewer));
     return R::json(out.dump());
   }));
 
@@ -2635,7 +2690,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     std::lock_guard<std::mutex> lk(m.mu_);
     auto it = m.tasks_.find(req.params.at("id"));
     if (it == m.tasks_.end()) return R::error(404, "no such task");
-    return R::json(task_json(it->second).dump());
+    return R::json(task_json(it->second, m.authenticate(req)).dump());
   }));
 
   // the task process reports its server is bound + listening (the analog
@@ -2679,13 +2734,56 @@ void install_routes_impl(Master& m, HttpServer& srv) {
   // ---- reverse proxy to ready tasks (reference internal/proxy/) ----
   // Dev-grade: plain HTTP passthrough (no websocket upgrade, no TLS);
   // auth is the same bearer token as the API.
-  auto proxy_handler = [&m](const HttpRequest& req) {
-    std::string host, rest = "";
+  // Browser-friendly proxy auth: bearer header, or dtpu_token cookie, or
+  // a one-time ?dtpu_token= query param that sets the cookie (pasted
+  // notebook URLs can't carry an Authorization header).  Dev-grade note:
+  // a token in a URL can end up in browser history.
+  auto proxy_auth = [&m](const HttpRequest& req, bool* set_cookie,
+                         std::string* token_out) -> std::string {
+    std::string user = m.authenticate(req);  // caller holds mu_
+    if (!user.empty()) return user;
+    std::string tok;
+    auto qit = req.query.find("dtpu_token");
+    if (qit != req.query.end()) {
+      tok = qit->second;
+      *set_cookie = true;
+    } else {
+      auto cit = req.headers.find("cookie");
+      if (cit != req.headers.end()) {
+        const std::string needle = "dtpu_token=";
+        auto pos = cit->second.find(needle);
+        if (pos != std::string::npos) {
+          auto end = cit->second.find(';', pos);
+          tok = cit->second.substr(pos + needle.size(),
+                                   end == std::string::npos
+                                       ? std::string::npos
+                                       : end - pos - needle.size());
+        }
+      }
+    }
+    if (tok.empty()) return "";
+    *token_out = tok;
+    HttpRequest synth = req;
+    synth.headers["authorization"] = "Bearer " + tok;
+    return m.authenticate(synth);
+  };
+
+  auto proxy_handler = [&m, proxy_auth](const HttpRequest& req) {
+    std::string host;
     int port = 0;
+    bool set_cookie = false;
+    std::string cookie_tok;
+    bool header_was_master_auth = false;
     {
       std::lock_guard<std::mutex> lk(m.mu_);
-      if (m.authenticate(req).empty()) {
-        return R::error(401, "unauthenticated");
+      header_was_master_auth = !m.authenticate(req).empty();
+      std::string user = proxy_auth(req, &set_cookie, &cookie_tok);
+      if (user.empty()) return R::error(401, "unauthenticated");
+      // same RBAC rule as the API: viewers are read-only through the proxy
+      auto uit = m.users_.find(user);
+      if (uit != m.users_.end() && uit->second.role == "viewer" &&
+          req.method != "GET") {
+        return R::error(403, "role 'viewer' is read-only");
       }
       auto it = m.tasks_.find(req.params.at("id"));
       if (it == m.tasks_.end()) return R::error(404, "no such task");
@@ -2694,29 +2792,51 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       host = it->second.host;
       port = it->second.port;
     }
-    auto rit = req.params.find("rest");
-    if (rit != req.params.end()) rest = rit->second;
-    std::string target = "/" + rest;
+    // forward the FULL path (prefix included): tasks mount at their
+    // DTPU_TASK_BASE_URL (= /proxy/{id}/), which keeps absolute links in
+    // proxied apps (jupyter static assets, API routes) resolving through
+    // the proxy instead of 404ing at the master root
+    std::string target = req.path;
     if (!req.query.empty()) {
-      target += "?";
-      bool first = true;
+      std::string qs;
       for (const auto& [k, v] : req.query) {
-        if (!first) target += "&";
-        first = false;
-        target += k + "=" + v;
+        if (k == "dtpu_token") continue;  // ours, not the app's
+        if (!qs.empty()) qs += "&";
+        qs += k + "=" + v;
       }
+      if (!qs.empty()) target += "?" + qs;
     }
-    auto resp = http_request(host, port, req.method, target, req.body, 30);
+    // forward cookies (jupyter session/_xsrf) and — when the client's
+    // Authorization header was NOT consumed for master auth — the raw
+    // Authorization header too (headless `Authorization: token <jt>`
+    // jupyter API calls ride ?dtpu_token= for the master side)
+    std::vector<std::pair<std::string, std::string>> fwd;
+    auto cit = req.headers.find("cookie");
+    if (cit != req.headers.end()) fwd.push_back({"Cookie", cit->second});
+    auto ait = req.headers.find("authorization");
+    if (ait != req.headers.end() && !header_was_master_auth) {
+      fwd.push_back({"Authorization", ait->second});
+    }
+    auto xit = req.headers.find("x-xsrftoken");
+    if (xit != req.headers.end()) fwd.push_back({"X-XSRFToken", xit->second});
+    auto resp = http_request(host, port, req.method, target, req.body, 30, fwd);
     if (resp.status == 0) return R::error(502, "task unreachable");
     HttpResponse out;
     out.status = resp.status;
     out.body = resp.body;
     out.content_type =
         resp.content_type.empty() ? "text/html" : resp.content_type;
+    for (const auto& sc : resp.set_cookies) out.headers.push_back({"Set-Cookie", sc});
+    if (set_cookie) {
+      out.headers.push_back(
+          {"Set-Cookie", "dtpu_token=" + cookie_tok +
+                             "; Path=/proxy; HttpOnly; SameSite=Strict"});
+    }
     return out;
   };
-  srv.route("GET", "/proxy/{id}/{*rest}", proxy_handler);
-  srv.route("POST", "/proxy/{id}/{*rest}", proxy_handler);
+  for (const char* method : {"GET", "POST", "PUT", "DELETE", "PATCH", "HEAD"}) {
+    srv.route(method, "/proxy/{id}/{*rest}", proxy_handler);
+  }
 
   // ---- task logs (per-trial jsonl files, paged like metrics) ----
   srv.route("POST", "/api/v1/logs", authed([&m](const HttpRequest& req) {

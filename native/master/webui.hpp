@@ -139,7 +139,7 @@ async function refresh() {
     ["trial", "exp", "state", "pri", "pool", "slots"]);
   $("tasks").innerHTML = table(tasks.map(t => ({id: t.id, type: t.type,
     state: badge(t.state), _raw_state: 1, _raw_link: 1,
-    link: t.ready ? `<a href="/proxy/${encodeURIComponent(t.id)}/" target="_blank">open</a>` : ""})),
+    link: t.ready ? `<a href="/proxy/${encodeURIComponent(t.id)}/?dtpu_token=${encodeURIComponent(TOK)}" target="_blank">open</a>` : ""})),
     ["id", "type", "state", "link"]);
 }
 async function followEvents() {

@@ -66,7 +66,8 @@ def test_sdk_explicit_login_and_users(cluster, tmp_path):
     admin = client.login(cluster.url, user="admin", password="")
     admin.create_user("alice", password="wonder", admin=False)
     alice = client.Determined(cluster.url, user="alice", password="wonder")
-    assert alice.whoami() == {"username": "alice", "admin": False}
+    who = alice.whoami()
+    assert who["username"] == "alice" and who["admin"] is False
     # non-admin cannot create users
     with pytest.raises(APIError):
         alice.create_user("bob")
@@ -83,4 +84,43 @@ def test_sdk_pause_activate(cluster, tmp_path):
     assert exp.state == "PAUSED"
     exp.activate()
     assert exp.state == "ACTIVE"
+    assert exp.wait(timeout=300) == "COMPLETED"
+
+
+def test_rbac_viewer_and_owner_gating(cluster, tmp_path):
+    """RBAC-lite: viewers are read-only; non-admin users cannot signal
+    other users' experiments (reference internal/rbac basic authz)."""
+    from determined_tpu import client
+    from determined_tpu.api.session import APIError
+
+    admin = client.login(cluster.url, user="admin", password="")
+    admin.create_user("bob", password="b", role="user")
+    admin.create_user("eve", password="e", role="viewer")
+
+    bob = client.Determined(cluster.url, user="bob", password="b")
+    cfg = exp_config(cluster.ckpt_dir)
+    cfg["searcher"]["max_length"] = {"batches": 30}
+    exp = bob.create_experiment(cfg)
+    exp.reload()
+    assert exp.get("owner") == "bob"
+
+    # viewer: reads fine, mutations 403
+    eve = client.Determined(cluster.url, user="eve", password="e")
+    assert eve.get_experiment(exp.id).state in ("ACTIVE", "COMPLETED")
+    with pytest.raises(APIError) as err:
+        eve.create_experiment(exp_config(cluster.ckpt_dir))
+    assert err.value.status == 403
+
+    # another non-admin user cannot pause bob's experiment
+    admin.create_user("carol", password="c", role="user")
+    carol = client.Determined(cluster.url, user="carol", password="c")
+    with pytest.raises(APIError) as err:
+        carol.get_experiment(exp.id).pause()
+    assert err.value.status == 403
+
+    # owner and admin can
+    exp.pause()
+    assert exp.state == "PAUSED"
+    admin.get_experiment(exp.id).activate()
+    assert exp.reload().state == "ACTIVE"
     assert exp.wait(timeout=300) == "COMPLETED"

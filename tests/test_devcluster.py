@@ -1189,3 +1189,31 @@ def test_config_templates_merge_on_submit(cluster, tmp_path):
         json={"config": exp_config(cluster.ckpt_dir), "template": "nope"},
     )
     assert r.status_code == 400
+
+
+def test_notebook_task_behind_proxy(cluster, tmp_path):
+    """Second NTSC type: a Jupyter server task mounts at its proxy base
+    url and answers through the master proxy (reference api_notebook.go +
+    internal/proxy full-path forwarding)."""
+    pytest.importorskip("jupyter_server")
+    r = cluster.http.post(
+        cluster.url + "/api/v1/tasks",
+        json={"type": "notebook", "config": {"work_dir": str(tmp_path)}},
+    )
+    assert r.status_code == 201, r.text
+    task_id = r.json()["id"]
+    deadline = time.time() + 150
+    info = {}
+    while time.time() < deadline:
+        info = cluster.http.get(f"{cluster.url}/api/v1/tasks/{task_id}").json()
+        if info.get("ready") or info.get("state") == "TERMINATED":
+            break
+        time.sleep(1.0)
+    assert info.get("ready"), info
+    # jupyter's /api answers through the proxy (its token via query param)
+    r = cluster.http.get(
+        cluster.url + f"/proxy/{task_id}/api", params={"token": cluster.token}
+    )
+    assert r.status_code == 200, r.text
+    assert "version" in r.json()
+    cluster.http.delete(cluster.url + f"/api/v1/tasks/{task_id}")
