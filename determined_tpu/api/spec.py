@@ -34,6 +34,10 @@ ROUTES = [
     ("GET", "/api/v1/experiments/{id}", "token",
      {"id", "name", "owner", "state", "config", "progress", "trials"}),
     ("GET", "/api/v1/experiments/{id}/context", "token", None),
+    ("GET", "/api/v1/workspaces", "token", "[]"),
+    ("POST", "/api/v1/experiments/{id}/fork", "token", {"id", "forked_from"}),
+    ("POST", "/api/v1/experiments/{id}/continue", "token",
+     {"id", "forked_from", "continued_from_checkpoint"}),
     ("POST", "/api/v1/experiments/{id}/pause", "token", {"state"}),
     ("POST", "/api/v1/experiments/{id}/activate", "token", {"state"}),
     ("POST", "/api/v1/experiments/{id}/cancel", "token", {"state"}),

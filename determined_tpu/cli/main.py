@@ -123,19 +123,45 @@ def exp_list(args) -> int:
             {
                 "id": e.id,
                 "name": e.get("name", ""),
+                "workspace": e.get("workspace", ""),
                 "state": e.state,
                 "progress": f"{e.progress:.0%}",
                 "trials": len(e.get("trials", [])),
             }
-            for e in _client(args).list_experiments()
+            for e in _client(args).list_experiments(
+                workspace=getattr(args, "workspace", None),
+                project=getattr(args, "project", None),
+            )
         ],
-        ["id", "name", "state", "progress", "trials"],
+        ["id", "name", "workspace", "state", "progress", "trials"],
     )
     return 0
 
 
 def exp_describe(args) -> int:
     _print_json(_client(args).get_experiment(args.id).to_dict())
+    return 0
+
+
+def exp_fork(args) -> int:
+    import yaml
+
+    overrides = None
+    if args.config_overrides:
+        with open(args.config_overrides) as f:
+            overrides = yaml.safe_load(f)
+        if not isinstance(overrides, dict):
+            print(
+                f"error: {args.config_overrides} must contain a yaml mapping",
+                file=sys.stderr,
+            )
+            return 1
+    exp = _client(args).get_experiment(args.id)
+    new = exp.continue_(overrides) if args.verb == "continue" else exp.fork(overrides)
+    past = "continued" if args.verb == "continue" else "forked"
+    print(f"{past} experiment {args.id} -> {new.id}")
+    if args.follow:
+        return exp_wait(args, new.id)
     return 0
 
 
@@ -430,7 +456,16 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("-f", "--follow", action="store_true")
     c.add_argument("--template", help="master-stored config template to merge under")
     c.set_defaults(fn=exp_create)
-    exp.add_parser("list").set_defaults(fn=exp_list)
+    el = exp.add_parser("list")
+    el.add_argument("--workspace")
+    el.add_argument("--project")
+    el.set_defaults(fn=exp_list)
+    for verb in ("fork", "continue"):
+        fk = exp.add_parser(verb)
+        fk.add_argument("id", type=int)
+        fk.add_argument("--config-overrides", help="yaml file merged over the source config")
+        fk.add_argument("-f", "--follow", action="store_true")
+        fk.set_defaults(fn=exp_fork, verb=verb)
     d = exp.add_parser("describe")
     d.add_argument("id", type=int)
     d.set_defaults(fn=exp_describe)

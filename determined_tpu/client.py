@@ -82,6 +82,24 @@ class Experiment(_Resource):
     def kill(self) -> "Experiment":
         return self._signal("kill")
 
+    def fork(self, config_overrides: Optional[Dict[str, Any]] = None) -> "Experiment":
+        """New experiment from this one's config (+ overrides); inherits the
+        context directory, starts from scratch."""
+        resp = self._session.post(
+            f"/api/v1/experiments/{self.id}/fork",
+            json={"config": config_overrides or {}},
+        )
+        return Experiment(self._session, resp.json()).reload()
+
+    def continue_(self, config_overrides: Optional[Dict[str, Any]] = None) -> "Experiment":
+        """Fork whose trials resume from this experiment's newest
+        checkpoint (reference handleContinueExperiment)."""
+        resp = self._session.post(
+            f"/api/v1/experiments/{self.id}/continue",
+            json={"config": config_overrides or {}},
+        )
+        return Experiment(self._session, resp.json()).reload()
+
     def wait(self, timeout: Optional[float] = None, interval: float = 1.0) -> str:
         """Poll until the experiment reaches a terminal state; returns it."""
         deadline = None if timeout is None else time.time() + timeout
@@ -299,9 +317,25 @@ class Determined:
             self._session.get(f"/api/v1/experiments/{experiment_id}").json(),
         )
 
-    def list_experiments(self) -> List[Experiment]:
-        rows = self._session.get("/api/v1/experiments").json()
+    def list_experiments(
+        self,
+        workspace: Optional[str] = None,
+        project: Optional[str] = None,
+        owner: Optional[str] = None,
+    ) -> List[Experiment]:
+        params = {
+            k: v
+            for k, v in {
+                "workspace": workspace, "project": project, "owner": owner
+            }.items()
+            if v is not None
+        }
+        rows = self._session.get("/api/v1/experiments", params=params or None).json()
         return [Experiment(self._session, r) for r in rows]
+
+    def list_workspaces(self) -> List[Dict[str, Any]]:
+        """Workspace/project tree with experiment counts."""
+        return self._session.get("/api/v1/workspaces").json()
 
     # -- trials / checkpoints --
     def get_trial(self, trial_id: int) -> Trial:

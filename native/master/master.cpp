@@ -464,6 +464,11 @@ class Master {
       next_webhook_id_ = std::max(next_webhook_id_, wh.id + 1);
     } else if (type == "webhook_deleted") {
       webhooks_.erase(ev["id"].as_int());
+    } else if (type == "trial_seed_checkpoint") {
+      auto it = trials_.find(ev["trial_id"].as_int());
+      if (it != trials_.end()) {
+        it->second.latest_checkpoint = ev["uuid"].as_string();
+      }
     } else if (type == "template_set") {
       templates_[ev["name"].as_string()] = ev["config"];
     } else if (type == "template_deleted") {
@@ -1556,6 +1561,14 @@ class Master {
 
   // ---- route helpers -----------------------------------------------------
 
+  // workspace/project default shared by experiment_json, the list filter,
+  // and the /workspaces aggregation (must agree or filtering diverges
+  // from the tree view)
+  static std::string config_str(const Json& config, const char* key,
+                                const char* fallback) {
+    return config[key].is_string() ? config[key].as_string() : fallback;
+  }
+
   // recursive dict merge, override wins — the template-application
   // semantics shared with the Python side (config/experiment.py
   // merge_configs; reference schemas.Merge)
@@ -1648,6 +1661,8 @@ class Master {
     j.set("owner", e.owner);
     j.set("state", e.state);
     j.set("config", e.config);
+    j.set("workspace", config_str(e.config, "workspace", "Uncategorized"));
+    j.set("project", config_str(e.config, "project", "Uncategorized"));
     j.set("progress", Json(e.method ? e.method->progress() : 0.0));
     Json trials = Json::array();
     for (const auto& [rid, tid] : e.rid_to_trial) {
@@ -1995,10 +2010,54 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     return resp;
   }));
 
-  srv.route("GET", "/api/v1/experiments", authed([&m](const HttpRequest&) {
+  srv.route("GET", "/api/v1/experiments", authed([&m](const HttpRequest& req) {
     std::lock_guard<std::mutex> lk(m.mu_);
+    auto match = [&](const ExperimentState& e, const char* key,
+                     const std::string& want) {
+      return want.empty() ||
+             Master::config_str(e.config, key, "Uncategorized") == want;
+    };
+    std::string ws, pj, owner;
+    auto q = req.query.find("workspace");
+    if (q != req.query.end()) ws = q->second;
+    q = req.query.find("project");
+    if (q != req.query.end()) pj = q->second;
+    q = req.query.find("owner");
+    if (q != req.query.end()) owner = q->second;
     Json out = Json::array();
-    for (const auto& [id, e] : m.experiments_) out.push_back(m.experiment_json(e));
+    for (const auto& [id, e] : m.experiments_) {
+      if (!match(e, "workspace", ws) || !match(e, "project", pj)) continue;
+      if (!owner.empty() && e.owner != owner) continue;
+      out.push_back(m.experiment_json(e));
+    }
+    return R::json(out.dump());
+  }));
+
+  // workspace/project organization view (reference workspaces/projects;
+  // here derived from experiment configs rather than separate tables)
+  srv.route("GET", "/api/v1/workspaces", authed([&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    std::map<std::string, std::map<std::string, int>> tree;
+    for (const auto& [id, e] : m.experiments_) {
+      tree[Master::config_str(e.config, "workspace", "Uncategorized")]
+          [Master::config_str(e.config, "project", "Uncategorized")]++;
+    }
+    Json out = Json::array();
+    for (const auto& [ws, projects] : tree) {
+      Json w = Json::object();
+      w.set("name", ws);
+      Json ps = Json::array();
+      int total = 0;
+      for (const auto& [pj, n] : projects) {
+        ps.push_back(Json::object()
+                         .set("name", pj)
+                         .set("experiments", Json(static_cast<int64_t>(n))));
+        total += n;
+      }
+      w.set("projects", ps);
+      w.set("experiments", Json(static_cast<int64_t>(total)));
+      out.push_back(w);
+    }
     return R::json(out.dump());
   }));
 
@@ -2008,6 +2067,87 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     if (it == m.experiments_.end()) return R::error(404, "no such experiment");
     return R::json(m.experiment_json(it->second).dump());
   }));
+
+  // fork = new experiment from the source's config (+ overrides, overrides
+  // win); continue = fork whose initial trials resume from the source's
+  // latest checkpoint (reference experiment.go handleContinueExperiment +
+  // fork flows).  Both inherit the source's context directory.
+  auto fork_like = [&m](const HttpRequest& req, bool inherit_checkpoint) {
+    Json body;
+    if (!req.body.empty() && !Json::try_parse(req.body, &body)) {
+      return R::error(400, "bad json");
+    }
+    if (body.contains("config") && !body["config"].is_object()) {
+      return R::error(400, "config overrides must be an object");
+    }
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.experiments_.find(std::stoll(req.params.at("id")));
+    if (it == m.experiments_.end()) return R::error(404, "no such experiment");
+    ExperimentState& src = it->second;
+    Json config = src.config;
+    if (body.contains("config")) {
+      config = Master::merge_json(config, body["config"]);
+    }
+    std::string cfg_err = Master::validate_config(config);
+    if (!cfg_err.empty()) return R::error(400, cfg_err);
+
+    // the source's newest checkpoint (by steps across its trials)
+    std::string seed_ckpt;
+    if (inherit_checkpoint) {
+      int64_t best_step = -1;
+      for (const auto& [rid, tid] : src.rid_to_trial) {
+        auto tit = m.trials_.find(tid);
+        if (tit == m.trials_.end() || tit->second.latest_checkpoint.empty()) continue;
+        auto cit = m.checkpoints_.find(tit->second.latest_checkpoint);
+        int64_t steps =
+            cit != m.checkpoints_.end()
+                ? cit->second["metadata"]["steps_completed"].as_int(0)
+                : 0;
+        if (steps >= best_step) {
+          best_step = steps;
+          seed_ckpt = tit->second.latest_checkpoint;
+        }
+      }
+      if (seed_ckpt.empty()) {
+        return R::error(409, "source experiment has no checkpoint to continue from");
+      }
+    }
+
+    std::string owner = m.authenticate(req);
+    int64_t id = m.do_create_experiment(config, 0, owner);
+    m.record(Json::object()
+                 .set("type", "exp_created")
+                 .set("id", Json(id))
+                 .set("owner", owner)
+                 .set("config", config));
+    ExperimentState& fresh = m.experiments_[id];
+    if (!seed_ckpt.empty()) {
+      for (const auto& [rid, tid] : fresh.rid_to_trial) {
+        m.trials_[tid].latest_checkpoint = seed_ckpt;
+        m.record(Json::object()
+                     .set("type", "trial_seed_checkpoint")
+                     .set("trial_id", Json(tid))
+                     .set("uuid", seed_ckpt));
+      }
+    }
+    // inherit the source context directory (user code travels with forks)
+    std::error_code ec;
+    if (std::filesystem::exists(m.context_path(src.id))) {
+      std::filesystem::copy_file(m.context_path(src.id), m.context_path(id),
+                                 std::filesystem::copy_options::overwrite_existing,
+                                 ec);
+    }
+    m.schedule();
+    Json out = Json::object();
+    out.set("id", Json(id));
+    out.set("forked_from", Json(src.id));
+    if (!seed_ckpt.empty()) out.set("continued_from_checkpoint", seed_ckpt);
+    return R::json(out.dump(), 201);
+  };
+  srv.route("POST", "/api/v1/experiments/{id}/fork",
+            authed([fork_like](const HttpRequest& r) { return fork_like(r, false); }));
+  srv.route("POST", "/api/v1/experiments/{id}/continue",
+            authed([fork_like](const HttpRequest& r) { return fork_like(r, true); }));
 
   auto exp_signal = [&m](const HttpRequest& req, const std::string& verb) {
     std::lock_guard<std::mutex> lk(m.mu_);
@@ -2802,7 +2942,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       for (const auto& [k, v] : req.query) {
         if (k == "dtpu_token") continue;  // ours, not the app's
         if (!qs.empty()) qs += "&";
-        qs += k + "=" + v;
+        qs += url_encode(k) + "=" + url_encode(v);  // values were decoded
       }
       if (!qs.empty()) target += "?" + qs;
     }
@@ -2812,7 +2952,20 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     // jupyter API calls ride ?dtpu_token= for the master side)
     std::vector<std::pair<std::string, std::string>> fwd;
     auto cit = req.headers.find("cookie");
-    if (cit != req.headers.end()) fwd.push_back({"Cookie", cit->second});
+    if (cit != req.headers.end()) {
+      // the dtpu_token cookie is a live master bearer token and the
+      // upstream runs USER code (jupyter): it must never cross the proxy
+      std::string cleaned;
+      std::stringstream cs(cit->second);
+      std::string part;
+      while (std::getline(cs, part, ';')) {
+        while (!part.empty() && part.front() == ' ') part.erase(part.begin());
+        if (part.rfind("dtpu_token=", 0) == 0) continue;
+        if (!cleaned.empty()) cleaned += "; ";
+        cleaned += part;
+      }
+      if (!cleaned.empty()) fwd.push_back({"Cookie", cleaned});
+    }
     auto ait = req.headers.find("authorization");
     if (ait != req.headers.end() && !header_was_master_auth) {
       fwd.push_back({"Authorization", ait->second});

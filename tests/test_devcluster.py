@@ -1217,3 +1217,142 @@ def test_notebook_task_behind_proxy(cluster, tmp_path):
     assert r.status_code == 200, r.text
     assert "version" in r.json()
     cluster.http.delete(cluster.url + f"/api/v1/tasks/{task_id}")
+
+
+def test_fork_and_continue_experiment(cluster, tmp_path):
+    """Fork: new experiment from the source config, fresh start.
+    Continue: initial trials resume from the source's newest checkpoint
+    (reference experiment.go fork/handleContinueExperiment)."""
+    from determined_tpu import client
+
+    d = client.Determined(cluster.url)
+    cfg = exp_config(cluster.ckpt_dir)
+    cfg["name"] = "source-exp"
+    src = d.create_experiment(cfg)
+    assert src.wait(timeout=240) == "COMPLETED"
+    src_ckpt = src.get_trials()[0].get("latest_checkpoint")
+    assert src_ckpt
+
+    # continue: resumes from the source checkpoint and trains further
+    cont = src.continue_({"name": "continued-exp",
+                          "searcher": {"max_length": {"batches": 12}}})
+    assert cont.get("name") == "continued-exp"
+    assert cont.wait(timeout=240) == "COMPLETED"
+    trial = cont.get_trials()[0]
+    logs = list(trial.logs())
+    assert any("restored checkpoint" in str(l) for l in logs), (
+        "continued trial did not restore the inherited checkpoint"
+    )
+
+    # fork: same config, fresh start (no restore line)
+    fork = src.fork({"name": "forked-exp"})
+    assert fork.wait(timeout=240) == "COMPLETED"
+    flogs = list(fork.get_trials()[0].logs())
+    assert not any("restored checkpoint" in str(l) for l in flogs)
+
+
+def test_workspaces_and_filtering(cluster):
+    """Workspace/project organization: config-declared, filterable,
+    aggregated (reference workspaces/projects)."""
+    from determined_tpu import client
+
+    d = client.Determined(cluster.url)
+    for ws, pj in [("research", "lm"), ("research", "vision"), ("prod", "lm")]:
+        cfg = exp_config(cluster.ckpt_dir)
+        cfg["name"] = f"{ws}-{pj}"
+        cfg["workspace"] = ws
+        cfg["project"] = pj
+        cfg["searcher"]["max_length"] = {"batches": 2}
+        d.create_experiment(cfg)
+    research = d.list_experiments(workspace="research")
+    assert {e.get("name") for e in research} == {"research-lm", "research-vision"}
+    lm = d.list_experiments(workspace="research", project="lm")
+    assert [e.get("name") for e in lm] == ["research-lm"]
+    tree = {w["name"]: w for w in d.list_workspaces()}
+    assert tree["research"]["experiments"] == 2
+    assert {p["name"] for p in tree["research"]["projects"]} == {"lm", "vision"}
+    for e in d.list_experiments():
+        e.wait(timeout=240)
+
+
+def test_proxy_scrubs_master_token_from_upstream(tmp_path):
+    """The dtpu_token cookie is a live master bearer token and proxied
+    tasks run user code: the proxy must strip it from forwarded Cookie
+    headers (keeping the app's own cookies) and re-encode query params.
+    Driven at the agent-protocol level: the test plays the agent, binds
+    the task port itself, and echoes what it receives."""
+    import http.server
+    import threading
+
+    c = DevCluster(tmp_path, agents=0, slots=0)
+    c.start_master()
+    try:
+        # register a fake agent and pull its launch_task work item
+        r = c.http.post(
+            c.url + "/api/v1/agents",
+            json={"id": "fake-agent", "host": "127.0.0.1", "slots": 0},
+        )
+        assert r.status_code == 200
+        r = c.http.post(c.url + "/api/v1/tasks", json={"type": "tensorboard"})
+        assert r.status_code == 201
+        task_id = r.json()["id"]
+        env = None
+        deadline = time.time() + 20
+        while time.time() < deadline and env is None:
+            work = c.http.get(
+                c.url + "/api/v1/agents/fake-agent/work",
+                params={"timeout_seconds": 2},
+            ).json()
+            for item in work:
+                if item.get("type") == "launch_task":
+                    env = item["env"]
+        assert env, "launch_task work item never arrived"
+        port = int(env["DTPU_TASK_PORT"])
+        token = env["DTPU_SESSION_TOKEN"]
+
+        seen = {}
+
+        class Echo(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                seen["cookie"] = self.headers.get("Cookie")
+                seen["path"] = self.path
+                body = b'{"ok":true}'
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        echo = http.server.ThreadingHTTPServer(("127.0.0.1", port), Echo)
+        threading.Thread(target=echo.serve_forever, daemon=True).start()
+        r = requests.post(
+            c.url + f"/api/v1/tasks/{task_id}/ready",
+            headers={"Authorization": f"Bearer {token}"},
+            timeout=5,
+        )
+        assert r.status_code == 200
+
+        browser = requests.Session()
+        r = browser.get(
+            c.url + f"/proxy/{task_id}/probe",
+            params={"dtpu_token": c.token, "a": "b&c"},
+            cookies={"other": "keep-me"},
+            timeout=10,
+        )
+        assert r.status_code == 200, r.text
+        assert "dtpu_token" in browser.cookies  # our auth cookie was set
+        cookie = seen["cookie"] or ""
+        assert "dtpu_token" not in cookie, f"master token leaked upstream: {cookie}"
+        assert "keep-me" in cookie
+        assert "a=b%26c" in seen["path"], seen  # re-encoded query
+        # second request rides the cookie; still scrubbed upstream
+        seen.clear()
+        r = browser.get(c.url + f"/proxy/{task_id}/probe", timeout=10)
+        assert r.status_code == 200
+        assert "dtpu_token" not in (seen["cookie"] or "")
+        echo.shutdown()
+    finally:
+        c.stop()
