@@ -1,0 +1,143 @@
+"""Mixture-of-Experts layer with expert parallelism, TPU-first.
+
+The reference has NO MoE/expert-parallel code (SURVEY §2.10: absent —
+DeepSpeed passthrough at most); this is a capability the TPU build adds.
+Design follows the GShard/Switch pjit formulation rather than explicit
+all-to-all plumbing: expert weights are stacked ``[experts, ...]`` tensors
+whose leading dim carries the ``"expert"`` logical axis, and token routing
+is expressed as dense dispatch/combine einsums — under ``pjit`` over a mesh
+with an ``expert`` axis, XLA partitions the expert dim and inserts the
+all-to-all collectives itself (the "let the compiler place collectives"
+recipe).  Top-2 gating with capacity limiting and the standard
+load-balancing auxiliary loss (Switch Transformer eq. 4).
+
+Shapes (g = tokens per group, e = experts, c = capacity, d/f = model/ff):
+  gates      [g, e]      softmax router probabilities
+  dispatch   [g, e, c]   0/1 token->expert-slot assignment
+  combine    [g, e, c]   dispatch * gate prob (weighted un-routing)
+  x          [g, d]  ->  expert inputs  [e, c, d]   (einsum with dispatch)
+  expert ffn [e, c, d] @ w1[e, d, f] -> silu -> @ w2[e, f, d]
+  y          [g, d]      (einsum with combine)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _top2_dispatch(
+    gates: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Build dispatch/combine tensors for top-2 routing with capacity.
+
+    Tokens beyond an expert's capacity are dropped (standard GShard
+    behavior); the combine weights renormalize over the surviving routes.
+    Returns (dispatch [g,e,c], combine [g,e,c], aux_loss scalar).
+    """
+    g, e = gates.shape
+    # top-1 and top-2 expert per token
+    idx1 = jnp.argmax(gates, axis=-1)                          # [g]
+    mask1 = jax.nn.one_hot(idx1, e, dtype=gates.dtype)         # [g, e]
+    gates2 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=gates.dtype)
+
+    # load-balancing aux loss: e * sum_e(fraction_tokens_e * mean_prob_e)
+    density = mask1.mean(axis=0)                               # [e]
+    density_proxy = gates.mean(axis=0)                         # [e]
+    aux = (density * density_proxy).sum() * (e * e)
+
+    # position of each token in its expert's queue (top-1 first)
+    pos1 = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1           # [g, e]
+    used1 = jnp.sum(mask1, axis=0, keepdims=True)              # [1, e]
+    pos2 = ((jnp.cumsum(mask2, axis=0) - 1.0) + used1) * mask2
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    p1 = (gates * keep1).sum(axis=-1)                          # [g]
+    p2 = (gates * keep2).sum(axis=-1)
+    denom = jnp.maximum(p1 + p2, 1e-9)
+    w1 = p1 / denom
+    w2 = p2 / denom
+
+    def slots(keep, pos):
+        slot = jax.nn.one_hot(
+            (pos * keep).sum(axis=-1).astype(jnp.int32), capacity,
+            dtype=gates.dtype,
+        )                                                       # [g, c]
+        return keep[:, :, None] * slot[:, None, :]              # [g, e, c]
+
+    d1, d2 = slots(keep1, pos1), slots(keep2, pos2)
+    dispatch = d1 + d2
+    combine = d1 * w1[:, None, None] + d2 * w2[:, None, None]
+    return dispatch, combine, aux
+
+
+class MoE(nn.Module):
+    """Top-2 expert-parallel SwiGLU FFN (drop-in for a dense MLP block)."""
+
+    num_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """[batch, seq, d] -> ([batch, seq, d], aux_loss)."""
+        b, s, d = x.shape
+        g = b * s
+        e = self.num_experts
+        capacity = max(int(self.capacity_factor * g * 2 / e), 1)
+
+        xf = x.reshape(g, d)
+        router = self.param(
+            "router",
+            nn.with_partitioning(nn.initializers.lecun_normal(), ("embed", "expert")),
+            (d, e),
+            jnp.float32,
+        )
+        # routing decisions in f32: bf16 softmax ties misroute tokens
+        gates = jax.nn.softmax(xf.astype(jnp.float32) @ router)
+        dispatch, combine, aux = _top2_dispatch(gates, capacity)
+
+        w_in = self.param(
+            "w_in",
+            nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
+            ),
+            (e, d, self.d_ff),
+            jnp.float32,
+        )
+        w_gate = self.param(
+            "w_gate",
+            nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
+            ),
+            (e, d, self.d_ff),
+            jnp.float32,
+        )
+        w_out = self.param(
+            "w_out",
+            nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "mlp", "embed")
+            ),
+            (e, self.d_ff, d),
+            jnp.float32,
+        )
+
+        cd = self.dtype
+        # dispatch: [g,e,c] x [g,d] -> [e,c,d]; under an "expert"-sharded
+        # mesh axis XLA turns these einsums into the all-to-alls
+        expert_in = jnp.einsum(
+            "gec,gd->ecd", dispatch.astype(cd), xf.astype(cd)
+        )
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w_in.astype(cd))
+        gate = jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(cd))
+        h = nn.silu(gate) * h
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(cd))
+        y = jnp.einsum("gec,ecd->gd", combine.astype(cd), expert_out)
+        return y.reshape(b, s, d), aux.astype(jnp.float32)

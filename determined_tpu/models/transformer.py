@@ -46,6 +46,19 @@ class TransformerConfig:
     attention_impl: str = "auto"              # auto|reference|flash|ring
     remat: bool = False
     rope_theta: float = 10000.0
+    # MoE (models/moe.py): every moe_every-th block swaps its dense MLP
+    # for top-2 expert-parallel experts; 0 = dense everywhere
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+
+    def __post_init__(self):
+        if self.moe_experts > 0 and self.moe_every < 1:
+            raise ValueError(
+                "moe_every must be >= 1 when moe_experts > 0 "
+                f"(got moe_every={self.moe_every})"
+            )
 
     @property
     def kv_heads(self) -> int:
@@ -167,12 +180,27 @@ class MLP(nn.Module):
 class Block(nn.Module):
     cfg: TransformerConfig
     mesh: Any = None
+    use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         x = x + Attention(self.cfg, self.mesh, name="attn")(RMSNorm(name="ln1")(x))
-        x = x + MLP(self.cfg, self.mesh, name="mlp")(RMSNorm(name="ln2")(x))
-        return with_sharding_constraint(x, ("batch", "length", "embed"), mesh=self.mesh)
+        if self.use_moe:
+            from determined_tpu.models.moe import MoE
+
+            y, aux = MoE(
+                num_experts=self.cfg.moe_experts,
+                d_ff=self.cfg.ff_dim,
+                capacity_factor=self.cfg.moe_capacity_factor,
+                dtype=self.cfg.dtype,
+                name="moe",
+            )(RMSNorm(name="ln2")(x))
+            x = x + y
+        else:
+            x = x + MLP(self.cfg, self.mesh, name="mlp")(RMSNorm(name="ln2")(x))
+            aux = jnp.zeros((), jnp.float32)
+        x = with_sharding_constraint(x, ("batch", "length", "embed"), mesh=self.mesh)
+        return x, aux
 
 
 class TransformerLM(nn.Module):
@@ -180,7 +208,12 @@ class TransformerLM(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, return_hidden: bool = False) -> jax.Array:
+    def __call__(
+        self,
+        tokens: jax.Array,
+        return_hidden: bool = False,
+        return_aux: bool = False,
+    ) -> Any:
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size,
@@ -197,8 +230,13 @@ class TransformerLM(nn.Module):
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(Block, prevent_cse=False)
+        aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layers):
-            x = block_cls(cfg, self.mesh, name=f"block_{i}")(x)
+            use_moe = (
+                cfg.moe_experts > 0 and (i % cfg.moe_every) == cfg.moe_every - 1
+            )
+            x, aux = block_cls(cfg, self.mesh, use_moe, name=f"block_{i}")(x)
+            aux_total = aux_total + aux
         x = RMSNorm(name="ln_f")(x)
         lm_head = nn.Dense(
             cfg.vocab_size,
@@ -215,8 +253,9 @@ class TransformerLM(nn.Module):
             # chunk-by-chunk (ops/cross_entropy.py) so [b, s, vocab] logits
             # never hit HBM.  Init always takes the logits path, so the
             # param tree includes lm_head either way.
-            return x
-        return lm_head(x).astype(jnp.float32)
+            return (x, aux_total) if return_aux else x
+        out = lm_head(x).astype(jnp.float32)
+        return (out, aux_total) if return_aux else out
 
 
 class LMTrial(JaxTrial):
@@ -240,6 +279,10 @@ class LMTrial(JaxTrial):
             attention_impl=str(g("attention", "auto")),
             remat=bool(g("remat", False)),
             dtype=jnp.bfloat16 if bool(g("bf16", True)) else jnp.float32,
+            moe_experts=int(g("moe_experts", 0)),
+            moe_every=int(g("moe_every", 2)),
+            moe_capacity_factor=float(g("moe_capacity_factor", 1.25)),
+            moe_aux_weight=float(g("moe_aux_weight", 0.01)),
         )
 
     def build_model(self) -> TransformerLM:
@@ -300,7 +343,9 @@ class LMTrial(JaxTrial):
 
             from determined_tpu.ops.cross_entropy import fused_cross_entropy
 
-            hidden = model.apply(params, inputs, return_hidden=True)
+            hidden, moe_aux = model.apply(
+                params, inputs, return_hidden=True, return_aux=True
+            )
             kernel = flax_meta.unbox(params["params"]["lm_head"]["kernel"])
             chunk = g("ce_chunk", None)
             shards = self.context.batch_axis_size if self.context.mesh is not None else 1
@@ -313,9 +358,13 @@ class LMTrial(JaxTrial):
                 batch_shards=shards,
             )
         else:
-            logits = model.apply(params, inputs)
+            logits, moe_aux = model.apply(params, inputs, return_aux=True)
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
-        return loss, {"perplexity": jnp.exp(loss)}
+        metrics = {"perplexity": jnp.exp(loss)}
+        if model.cfg.moe_experts > 0:
+            metrics["moe_aux_loss"] = moe_aux
+            loss = loss + model.cfg.moe_aux_weight * moe_aux
+        return loss, metrics
 
     def evaluate_batch(
         self, model: TransformerLM, params: Any, batch: Dict[str, jax.Array]

@@ -40,6 +40,7 @@ struct Options {
   std::string advertised_host = "127.0.0.1";
   std::string pool = "default";
   int slots = 1;
+  std::string slot_type = "cpu";  // tpu when /dev/accel*/vfio chips found
   std::string python = "python";
   std::string user = "determined";
   std::string password;
@@ -131,6 +132,7 @@ class Agent {
     body.set("host", opts_.advertised_host);
     body.set("pool", opts_.pool);
     body.set("slots", Json(opts_.slots));
+    body.set("slot_type", opts_.slot_type);
     auto resp = master_req("POST", "/api/v1/agents", body.dump(), 10);
     return resp.ok();
   }
@@ -383,9 +385,36 @@ class Agent {
 
 }  // namespace dtpu
 
+// TPU chip enumeration (reference agent/internal/detect/: nvidia-smi for
+// cuda slots; here /dev/accel* — how libtpu exposes chips on TPU VMs —
+// with /dev/vfio/N as the newer binding, else one CPU slot).  --slots
+// overrides for tests/CPU hosts.
+static int detect_slots(std::string* slot_type) {
+  int n = 0;
+  for (int i = 0; i < 16; ++i) {
+    if (std::filesystem::exists("/dev/accel" + std::to_string(i))) ++n;
+  }
+  if (n > 0) {
+    *slot_type = "tpu";
+    return n;
+  }
+  std::error_code ec;
+  for (const auto& entry : std::filesystem::directory_iterator("/dev/vfio", ec)) {
+    const std::string name = entry.path().filename().string();
+    if (!name.empty() && std::all_of(name.begin(), name.end(), ::isdigit)) ++n;
+  }
+  if (n > 0) {
+    *slot_type = "tpu";
+    return n;
+  }
+  *slot_type = "cpu";
+  return 1;
+}
+
 int main(int argc, char** argv) {
   signal(SIGPIPE, SIG_IGN);
   dtpu::Options opts;
+  opts.slots = 0;  // 0 = auto-detect below
   for (int i = 1; i < argc; ++i) {
     std::string arg = argv[i];
     auto next = [&](const char* name) -> std::string {
@@ -403,6 +432,11 @@ int main(int argc, char** argv) {
     else if (arg == "--password") opts.password = next("--password");
     else if (arg == "--state-dir") opts.state_dir = next("--state-dir");
     else { fprintf(stderr, "unknown arg %s\n", arg.c_str()); return 2; }
+  }
+  if (opts.slots <= 0) {
+    opts.slots = detect_slots(&opts.slot_type);
+    fprintf(stderr, "agent %s: detected %d %s slot(s)\n", opts.id.c_str(),
+            opts.slots, opts.slot_type.c_str());
   }
   return dtpu::Agent(opts).run();
 }

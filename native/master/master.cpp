@@ -58,6 +58,7 @@ struct AgentState {
   std::string id;
   std::string host;
   std::string pool = "default";  // resource pool membership
+  std::string slot_type = "cpu";  // tpu on real TPU VMs (agent-detected)
   int slots = 0;
   int used_slots = 0;
   int64_t last_seen_ms = 0;
@@ -2080,18 +2081,50 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     if (body.contains("config") && !body["config"].is_object()) {
       return R::error(400, "config overrides must be an object");
     }
+    int64_t src_id = std::stoll(req.params.at("id"));
+    // stage the inherited context copy OUTSIDE the lock (the create route
+    // does the same: a big tarball copy must not stall agent polls); the
+    // per-id rename under the lock is trivial
+    std::string ctx_tmp;
+    {
+      std::error_code ec;
+      if (std::filesystem::exists(m.context_path(src_id), ec)) {
+        ctx_tmp = m.context_path(src_id) + ".fork-tmp-" +
+                  std::to_string(now_ms());
+        std::filesystem::copy_file(
+            m.context_path(src_id), ctx_tmp,
+            std::filesystem::copy_options::overwrite_existing, ec);
+        if (ec) {
+          return R::error(500, "failed to copy source context: " + ec.message());
+        }
+      }
+    }
+    auto cleanup_tmp = [&ctx_tmp]() {
+      if (!ctx_tmp.empty()) {
+        std::error_code ec;
+        std::filesystem::remove(ctx_tmp, ec);
+      }
+    };
+
     std::lock_guard<std::mutex> lk(m.mu_);
-    auto it = m.experiments_.find(std::stoll(req.params.at("id")));
-    if (it == m.experiments_.end()) return R::error(404, "no such experiment");
+    auto it = m.experiments_.find(src_id);
+    if (it == m.experiments_.end()) {
+      cleanup_tmp();
+      return R::error(404, "no such experiment");
+    }
     ExperimentState& src = it->second;
     Json config = src.config;
     if (body.contains("config")) {
       config = Master::merge_json(config, body["config"]);
     }
     std::string cfg_err = Master::validate_config(config);
-    if (!cfg_err.empty()) return R::error(400, cfg_err);
+    if (!cfg_err.empty()) {
+      cleanup_tmp();
+      return R::error(400, cfg_err);
+    }
 
-    // the source's newest checkpoint (by steps across its trials)
+    // the source's newest LIVE checkpoint (by steps across its trials);
+    // GC'd (DELETED) or unknown uuids must not seed new trials
     std::string seed_ckpt;
     if (inherit_checkpoint) {
       int64_t best_step = -1;
@@ -2099,17 +2132,18 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         auto tit = m.trials_.find(tid);
         if (tit == m.trials_.end() || tit->second.latest_checkpoint.empty()) continue;
         auto cit = m.checkpoints_.find(tit->second.latest_checkpoint);
-        int64_t steps =
-            cit != m.checkpoints_.end()
-                ? cit->second["metadata"]["steps_completed"].as_int(0)
-                : 0;
+        if (cit == m.checkpoints_.end()) continue;
+        if (cit->second["state"].as_string() == "DELETED") continue;
+        int64_t steps = cit->second["metadata"]["steps_completed"].as_int(0);
         if (steps >= best_step) {
           best_step = steps;
           seed_ckpt = tit->second.latest_checkpoint;
         }
       }
       if (seed_ckpt.empty()) {
-        return R::error(409, "source experiment has no checkpoint to continue from");
+        cleanup_tmp();
+        return R::error(409,
+                        "source experiment has no live checkpoint to continue from");
       }
     }
 
@@ -2130,12 +2164,13 @@ void install_routes_impl(Master& m, HttpServer& srv) {
                      .set("uuid", seed_ckpt));
       }
     }
-    // inherit the source context directory (user code travels with forks)
-    std::error_code ec;
-    if (std::filesystem::exists(m.context_path(src.id))) {
-      std::filesystem::copy_file(m.context_path(src.id), m.context_path(id),
-                                 std::filesystem::copy_options::overwrite_existing,
-                                 ec);
+    if (!ctx_tmp.empty()) {
+      std::error_code ec;
+      std::filesystem::rename(ctx_tmp, m.context_path(id), ec);
+      if (ec) {
+        cleanup_tmp();
+        return R::error(500, "failed to finalize inherited context");
+      }
     }
     m.schedule();
     Json out = Json::object();
@@ -2540,6 +2575,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       ag.pool = body["pool"].as_string();
     }
     ag.slots = static_cast<int>(body["slots"].as_int(1));
+    if (body["slot_type"].is_string()) ag.slot_type = body["slot_type"].as_string();
     if (fresh) ag.used_slots = 0;
     ag.last_seen_ms = now_ms();
     m.schedule();
@@ -2555,6 +2591,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       j.set("host", ag.host);
       j.set("pool", ag.pool);
       j.set("slots", Json(ag.slots));
+      j.set("slot_type", ag.slot_type);
       j.set("used_slots", Json(ag.used_slots));
       out.push_back(j);
     }

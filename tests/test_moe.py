@@ -1,0 +1,129 @@
+"""MoE layer + expert parallelism (no reference counterpart — SURVEY §2.10
+lists EP/MoE as absent upstream; TPU-first capability)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from determined_tpu.models.moe import MoE, _top2_dispatch
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+def test_top2_dispatch_routes_and_renormalizes():
+    g, e, c = 8, 4, 8  # ample capacity: nothing dropped
+    rng = np.random.default_rng(0)
+    gates = jax.nn.softmax(jnp.asarray(rng.standard_normal((g, e)), jnp.float32))
+    dispatch, combine, aux = _top2_dispatch(gates, c)
+    assert dispatch.shape == (g, e, c)
+    # every token lands on exactly two expert slots
+    np.testing.assert_allclose(np.asarray(dispatch.sum(axis=(1, 2))), 2.0)
+    # combine weights renormalize the two surviving gate probs to 1
+    np.testing.assert_allclose(np.asarray(combine.sum(axis=(1, 2))), 1.0, rtol=1e-5)
+    assert float(aux) > 0
+
+
+def test_top2_dispatch_respects_capacity():
+    # all tokens prefer expert 0 -> only `capacity` of them survive there
+    g, e, c = 16, 4, 2
+    gates = jnp.tile(jnp.asarray([[0.7, 0.3, 0.0, 0.0]], jnp.float32), (g, 1))
+    dispatch, combine, aux = _top2_dispatch(gates, c)
+    per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+    assert per_expert[0] == c  # expert 0 full
+    assert per_expert[1] == c  # expert 1 (everyone's second choice) full
+    # unbalanced routing => large aux loss (signal to the optimizer)
+    assert float(aux) > 1.0
+
+
+def test_moe_layer_trains_and_is_finite():
+    b, s, d = 2, 16, 32
+    layer = MoE(num_experts=4, d_ff=64, dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((b, s, d)), jnp.float32)
+    params = layer.init(jax.random.key(0), x)
+
+    def loss_fn(p, x):
+        y, aux = layer.apply(p, x)
+        return (y**2).mean() + 0.01 * aux
+
+    val, grads = jax.value_and_grad(loss_fn)(params, x)
+    assert np.isfinite(float(val))
+    for leaf in jax.tree.leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    # router must receive gradient (it is on the aux + routing path)
+    from flax.core import meta
+
+    router_grad = meta.unbox(grads)["params"]["router"]
+    assert float(jnp.abs(router_grad).sum()) > 0
+
+
+def test_moe_expert_sharding_matches_unsharded(devices8):
+    """The same MoE computation over an expert=4 mesh equals the
+    single-device result — XLA's inserted collectives preserve numerics."""
+    b, s, d = 2, 16, 32
+    layer = MoE(num_experts=4, d_ff=64, dtype=jnp.float32)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((b, s, d)), jnp.float32)
+    params = layer.init(jax.random.key(0), x)
+
+    from flax.core import meta
+
+    raw = meta.unbox(params)
+    ref_y, ref_aux = layer.apply(raw, x)
+
+    mesh = make_mesh(MeshConfig(data=2, expert=4), devices8)
+    from determined_tpu.parallel.sharding import param_shardings
+
+    specs = jax.tree.map(
+        lambda x: x.get_partition_spec() if hasattr(x, "get_partition_spec") else None,
+        params,
+        is_leaf=lambda v: hasattr(v, "get_partition_spec"),
+    )
+    with mesh:
+        sharded = jax.jit(lambda p, x: layer.apply(p, x))(raw, x)
+    np.testing.assert_allclose(
+        np.asarray(sharded[0]), np.asarray(ref_y), atol=1e-5, rtol=1e-5
+    )
+    np.testing.assert_allclose(float(sharded[1]), float(ref_aux), rtol=1e-5)
+
+
+def test_lm_with_moe_trains(tmp_path):
+    """TransformerLM with MoE blocks trains end-to-end on an
+    expert-parallel mesh; aux loss is reported and finite."""
+    from determined_tpu import core, train
+    from determined_tpu.config import Length
+    from determined_tpu.models.transformer import LMTrial
+
+    ctx = train.init(
+        hparams={
+            "lr": 1e-3,
+            "global_batch_size": 16,
+            "seq_len": 32,
+            "vocab_size": 128,
+            "d_model": 64,
+            "n_layers": 2,
+            "n_heads": 4,
+            "dataset_size": 64,
+            "bf16": False,
+            "attention": "reference",
+            "warmup_steps": 1,
+            "moe_experts": 4,
+            "moe_every": 2,
+        },
+        mesh_config=MeshConfig(data=2, expert=4),
+        core_context=core._dummy_init(checkpoint_dir=str(tmp_path / "ck")),
+        seed=0,
+    )
+    trainer = train.Trainer(LMTrial(ctx))
+    reported = []
+    orig = ctx.core.train.report_training_metrics
+    ctx.core.train.report_training_metrics = lambda s, m: (
+        reported.append((s, m)),
+        orig(s, m),
+    )
+    result = trainer.fit(Length.batches(8), report_period=Length.batches(4))
+    assert result["steps_completed"] == 8
+    assert any("moe_aux_loss" in m for _, m in reported)
+    last = reported[-1][1]
+    assert np.isfinite(last["loss"]) and np.isfinite(last["moe_aux_loss"])
+    assert last["loss"] < reported[0][1]["loss"]
