@@ -46,10 +46,12 @@ def _top2_dispatch(
     idx2 = jnp.argmax(gates2, axis=-1)
     mask2 = jax.nn.one_hot(idx2, e, dtype=gates.dtype)
 
-    # load-balancing aux loss: e * sum_e(fraction_tokens_e * mean_prob_e)
+    # load-balancing aux loss (Switch eq. 4): e * sum_e(fraction_tokens_e
+    # * mean_prob_e) — equals 1 at perfect balance regardless of e, so the
+    # aux weight means the same thing at any expert count
     density = mask1.mean(axis=0)                               # [e]
     density_proxy = gates.mean(axis=0)                         # [e]
-    aux = (density * density_proxy).sum() * (e * e)
+    aux = (density * density_proxy).sum() * e
 
     # position of each token in its expert's queue (top-1 first)
     pos1 = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1           # [g, e]
@@ -78,66 +80,79 @@ def _top2_dispatch(
 
 
 class MoE(nn.Module):
-    """Top-2 expert-parallel SwiGLU FFN (drop-in for a dense MLP block)."""
+    """Top-2 expert-parallel SwiGLU FFN (drop-in for a dense MLP block).
+
+    Tokens route within fixed-size GROUPS (GShard's formulation): dispatch
+    and combine are ``[groups, group_size, e, c]`` with ``c ~
+    2*group_size/e``, so their size is linear in the token count —
+    grouping capacity over the whole flattened batch would make them
+    quadratic and OOM real configs (64k tokens x 8 experts would need
+    ~1e10-element dispatch tensors).
+    """
 
     num_experts: int
     d_ff: int
     capacity_factor: float = 1.25
+    group_size: int = 4096
     dtype: Any = jnp.bfloat16
+    partition: bool = True  # False under manual-SPMD pipeline stages
 
     @nn.compact
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         """[batch, seq, d] -> ([batch, seq, d], aux_loss)."""
+        from determined_tpu.models.transformer import _maybe_partition
+
         b, s, d = x.shape
         g = b * s
         e = self.num_experts
-        capacity = max(int(self.capacity_factor * g * 2 / e), 1)
+        grp = min(self.group_size, g)
+        while g % grp:
+            grp -= 1  # largest divisor <= group_size; worst case 1
+        n_groups = g // grp
+        capacity = max(int(self.capacity_factor * grp * 2 / e), 1)
 
-        xf = x.reshape(g, d)
+        xg = x.reshape(n_groups, grp, d)
         router = self.param(
             "router",
-            nn.with_partitioning(nn.initializers.lecun_normal(), ("embed", "expert")),
+            _maybe_partition(
+                self.partition, nn.initializers.lecun_normal(), ("embed", "expert")
+            ),
             (d, e),
             jnp.float32,
         )
         # routing decisions in f32: bf16 softmax ties misroute tokens
-        gates = jax.nn.softmax(xf.astype(jnp.float32) @ router)
-        dispatch, combine, aux = _top2_dispatch(gates, capacity)
+        gates = jax.nn.softmax(
+            jnp.einsum("ngd,de->nge", xg.astype(jnp.float32), router)
+        )
+        dispatch, combine, aux = jax.vmap(
+            lambda gate: _top2_dispatch(gate, capacity)
+        )(gates)
+        aux = aux.mean()
 
-        w_in = self.param(
-            "w_in",
-            nn.with_partitioning(
-                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
-            ),
-            (e, d, self.d_ff),
-            jnp.float32,
-        )
-        w_gate = self.param(
-            "w_gate",
-            nn.with_partitioning(
-                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
-            ),
-            (e, d, self.d_ff),
-            jnp.float32,
-        )
-        w_out = self.param(
-            "w_out",
-            nn.with_partitioning(
-                nn.initializers.lecun_normal(), ("expert", "mlp", "embed")
-            ),
-            (e, self.d_ff, d),
-            jnp.float32,
-        )
+        def expert_param(name, shape, logical):
+            return self.param(
+                name,
+                _maybe_partition(
+                    self.partition, nn.initializers.lecun_normal(), logical
+                ),
+                shape,
+                jnp.float32,
+            )
+
+        w_in = expert_param("w_in", (e, d, self.d_ff), ("expert", "embed", "mlp"))
+        w_gate = expert_param("w_gate", (e, d, self.d_ff), ("expert", "embed", "mlp"))
+        w_out = expert_param("w_out", (e, self.d_ff, d), ("expert", "mlp", "embed"))
 
         cd = self.dtype
-        # dispatch: [g,e,c] x [g,d] -> [e,c,d]; under an "expert"-sharded
-        # mesh axis XLA turns these einsums into the all-to-alls
+        # dispatch: [n,g,e,c] x [n,g,d] -> [n,e,c,d]; under an
+        # "expert"-sharded mesh axis XLA turns these einsums into the
+        # all-to-alls
         expert_in = jnp.einsum(
-            "gec,gd->ecd", dispatch.astype(cd), xf.astype(cd)
+            "ngec,ngd->necd", dispatch.astype(cd), xg.astype(cd)
         )
-        h = jnp.einsum("ecd,edf->ecf", expert_in, w_in.astype(cd))
-        gate = jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(cd))
+        h = jnp.einsum("necd,edf->necf", expert_in, w_in.astype(cd))
+        gate = jnp.einsum("necd,edf->necf", expert_in, w_gate.astype(cd))
         h = nn.silu(gate) * h
-        expert_out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(cd))
-        y = jnp.einsum("gec,ecd->gd", combine.astype(cd), expert_out)
+        expert_out = jnp.einsum("necf,efd->necd", h, w_out.astype(cd))
+        y = jnp.einsum("ngec,necd->ngd", combine.astype(cd), expert_out)
         return y.reshape(b, s, d), aux.astype(jnp.float32)

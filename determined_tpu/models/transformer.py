@@ -52,6 +52,10 @@ class TransformerConfig:
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # False under manual-SPMD pipeline stages: logical param annotations
+    # are meaningless (and invalid) inside shard_map, where placement is
+    # explicit
+    partition_params: bool = True
 
     def __post_init__(self):
         if self.moe_experts > 0 and self.moe_every < 1:
@@ -85,13 +89,22 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def _maybe_partition(partition: bool, init, names):
+    """with_partitioning when annotations apply; plain init under manual
+    SPMD (pipeline stages inside shard_map)."""
+    return nn.with_partitioning(init, names) if partition else init
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-6
+    partition: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         scale = self.param(
-            "scale", nn.with_partitioning(nn.initializers.ones, ("embed",)), (x.shape[-1],)
+            "scale",
+            _maybe_partition(self.partition, nn.initializers.ones, ("embed",)),
+            (x.shape[-1],),
         )
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
         return (x * jax.lax.rsqrt(var + self.eps).astype(x.dtype)) * scale.astype(x.dtype)
@@ -112,7 +125,9 @@ class Attention(nn.Module):
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=jnp.float32,
-            kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), logical),
+            kernel_init=_maybe_partition(
+                cfg.partition_params, nn.initializers.lecun_normal(), logical
+            ),
             name=name,
         )
         q = dense((cfg.n_heads, hd), ("embed", "heads", "head_dim"), "wq")(x)
@@ -147,8 +162,10 @@ class Attention(nn.Module):
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=jnp.float32,
-            kernel_init=nn.with_partitioning(
-                nn.initializers.lecun_normal(), ("heads", "head_dim", "embed")
+            kernel_init=_maybe_partition(
+                cfg.partition_params,
+                nn.initializers.lecun_normal(),
+                ("heads", "head_dim", "embed"),
             ),
             name="wo",
         )(out)
@@ -167,13 +184,16 @@ class MLP(nn.Module):
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=jnp.float32,
-            kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), logical),
+            kernel_init=_maybe_partition(
+                cfg.partition_params, nn.initializers.lecun_normal(), logical
+            ),
             name=name,
         )
         gate = dense(cfg.ff_dim, ("embed", "mlp"), "w_gate")(x)
         up = dense(cfg.ff_dim, ("embed", "mlp"), "w_up")(x)
         h = nn.silu(gate) * up
-        h = with_sharding_constraint(h, ("batch", "length", "mlp"), mesh=self.mesh)
+        if cfg.partition_params:
+            h = with_sharding_constraint(h, ("batch", "length", "mlp"), mesh=self.mesh)
         return dense(cfg.d_model, ("mlp", "embed"), "w_down")(h)
 
 
@@ -184,7 +204,9 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        x = x + Attention(self.cfg, self.mesh, name="attn")(RMSNorm(name="ln1")(x))
+        x = x + Attention(self.cfg, self.mesh, name="attn")(
+            RMSNorm(partition=self.cfg.partition_params, name="ln1")(x)
+        )
         if self.use_moe:
             from determined_tpu.models.moe import MoE
 
@@ -193,13 +215,17 @@ class Block(nn.Module):
                 d_ff=self.cfg.ff_dim,
                 capacity_factor=self.cfg.moe_capacity_factor,
                 dtype=self.cfg.dtype,
+                partition=self.cfg.partition_params,
                 name="moe",
-            )(RMSNorm(name="ln2")(x))
+            )(RMSNorm(partition=self.cfg.partition_params, name="ln2")(x))
             x = x + y
         else:
-            x = x + MLP(self.cfg, self.mesh, name="mlp")(RMSNorm(name="ln2")(x))
+            x = x + MLP(self.cfg, self.mesh, name="mlp")(
+                RMSNorm(partition=self.cfg.partition_params, name="ln2")(x)
+            )
             aux = jnp.zeros((), jnp.float32)
-        x = with_sharding_constraint(x, ("batch", "length", "embed"), mesh=self.mesh)
+        if self.cfg.partition_params:
+            x = with_sharding_constraint(x, ("batch", "length", "embed"), mesh=self.mesh)
         return x, aux
 
 
@@ -220,13 +246,16 @@ class TransformerLM(nn.Module):
             cfg.d_model,
             dtype=cfg.dtype,
             param_dtype=jnp.float32,
-            embedding_init=nn.with_partitioning(
-                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            embedding_init=_maybe_partition(
+                cfg.partition_params,
+                nn.initializers.normal(stddev=0.02),
+                ("vocab", "embed"),
             ),
             name="embed",
         )
         x = embed(tokens)
-        x = with_sharding_constraint(x, ("batch", "length", "embed"), mesh=self.mesh)
+        if cfg.partition_params:
+            x = with_sharding_constraint(x, ("batch", "length", "embed"), mesh=self.mesh)
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(Block, prevent_cse=False)
@@ -237,14 +266,14 @@ class TransformerLM(nn.Module):
             )
             x, aux = block_cls(cfg, self.mesh, use_moe, name=f"block_{i}")(x)
             aux_total = aux_total + aux
-        x = RMSNorm(name="ln_f")(x)
+        x = RMSNorm(partition=cfg.partition_params, name="ln_f")(x)
         lm_head = nn.Dense(
             cfg.vocab_size,
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=jnp.float32,
-            kernel_init=nn.with_partitioning(
-                nn.initializers.lecun_normal(), ("embed", "vocab")
+            kernel_init=_maybe_partition(
+                cfg.partition_params, nn.initializers.lecun_normal(), ("embed", "vocab")
             ),
             name="lm_head",
         )

@@ -398,15 +398,10 @@ static int detect_slots(std::string* slot_type) {
     *slot_type = "tpu";
     return n;
   }
-  std::error_code ec;
-  for (const auto& entry : std::filesystem::directory_iterator("/dev/vfio", ec)) {
-    const std::string name = entry.path().filename().string();
-    if (!name.empty() && std::all_of(name.begin(), name.end(), ::isdigit)) ++n;
-  }
-  if (n > 0) {
-    *slot_type = "tpu";
-    return n;
-  }
+  // NOTE: /dev/vfio/N deliberately NOT counted — vfio groups also cover
+  // passthrough NICs/GPUs, so claiming them as TPU slots would schedule
+  // TPU trials onto hosts without chips.  Pass --slots on vfio-bound
+  // TPU VMs.
   *slot_type = "cpu";
   return 1;
 }

@@ -2089,8 +2089,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     {
       std::error_code ec;
       if (std::filesystem::exists(m.context_path(src_id), ec)) {
+        static std::atomic<uint64_t> fork_counter{0};
         ctx_tmp = m.context_path(src_id) + ".fork-tmp-" +
-                  std::to_string(now_ms());
+                  std::to_string(fork_counter.fetch_add(1)) + "-" +
+                  std::to_string(::getpid());
         std::filesystem::copy_file(
             m.context_path(src_id), ctx_tmp,
             std::filesystem::copy_options::overwrite_existing, ec);
@@ -2168,6 +2170,9 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       std::error_code ec;
       std::filesystem::rename(ctx_tmp, m.context_path(id), ec);
       if (ec) {
+        // the experiment is already journaled: fail it explicitly rather
+        // than leaving an ACTIVE experiment whose code never arrived
+        m.set_exp_state(m.experiments_[id], "ERROR");
         cleanup_tmp();
         return R::error(500, "failed to finalize inherited context");
       }

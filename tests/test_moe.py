@@ -67,20 +67,28 @@ def test_moe_expert_sharding_matches_unsharded(devices8):
     params = layer.init(jax.random.key(0), x)
 
     from flax.core import meta
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     raw = meta.unbox(params)
     ref_y, ref_aux = layer.apply(raw, x)
 
     mesh = make_mesh(MeshConfig(data=2, expert=4), devices8)
-    from determined_tpu.parallel.sharding import param_shardings
+    # expert-stacked weights REALLY sharded over the expert axis (the
+    # router [d, e] shards its expert output dim)
+    def shard_leaf(path, leaf):
+        name = path[-1].key
+        if name == "router":
+            spec = P(None, "expert")
+        else:  # w_in/w_gate/w_out: leading expert dim
+            spec = P("expert")
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
 
-    specs = jax.tree.map(
-        lambda x: x.get_partition_spec() if hasattr(x, "get_partition_spec") else None,
-        params,
-        is_leaf=lambda v: hasattr(v, "get_partition_spec"),
-    )
+    import jax.tree_util as jtu
+
+    sharded_params = jtu.tree_map_with_path(shard_leaf, raw)
+    xs = jax.device_put(x, NamedSharding(mesh, P("data")))
     with mesh:
-        sharded = jax.jit(lambda p, x: layer.apply(p, x))(raw, x)
+        sharded = jax.jit(lambda p, x: layer.apply(p, x))(sharded_params, xs)
     np.testing.assert_allclose(
         np.asarray(sharded[0]), np.asarray(ref_y), atol=1e-5, rtol=1e-5
     )

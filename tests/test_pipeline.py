@@ -1,0 +1,123 @@
+"""Pipeline-parallel schedule tests: the GPipe microbatch rotation over the
+``pipe`` mesh axis must match sequential stage application exactly, forward
+and backward (reference has no native pipeline engine; SURVEY §2.10)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+from determined_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+
+def _stage_fn(params, x):
+    # one dense block per stage: x @ w + b, gelu
+    return jax.nn.gelu(x @ params["w"] + params["b"])
+
+
+def _make(n_stages, d, seed=0):
+    rng = np.random.default_rng(seed)
+    stages = [
+        {
+            "w": jnp.asarray(rng.standard_normal((d, d)) * 0.3, jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((d,)) * 0.1, jnp.float32),
+        }
+        for _ in range(n_stages)
+    ]
+    return stack_stage_params(stages), stages
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = _stage_fn(p, x)
+    return x
+
+
+@pytest.mark.parametrize("microbatches", [2, 4, 8])
+def test_pipeline_matches_sequential(devices8, microbatches):
+    mesh = make_mesh(MeshConfig(pipe=4, data=2), devices8)
+    d, batch = 16, 8
+    stacked, stages = _make(4, d)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((batch, d)), jnp.float32)
+    ref = _sequential(stages, x)
+    with mesh:
+        out = jax.jit(
+            lambda p, x: pipeline_apply(_stage_fn, p, x, mesh, microbatches)
+        )(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_gradients_match(devices8):
+    mesh = make_mesh(MeshConfig(pipe=4, data=2), devices8)
+    d, batch, mb = 8, 8, 4
+    stacked, stages = _make(4, d, seed=2)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((batch, d)), jnp.float32)
+
+    def piped_loss(p, x):
+        return (pipeline_apply(_stage_fn, p, x, mesh, mb) ** 2).mean()
+
+    def seq_loss(p, x):
+        y = x
+        for i in range(4):
+            y = _stage_fn(jax.tree.map(lambda a: a[i], p), y)
+        return (y**2).mean()
+
+    with mesh:
+        gp = jax.jit(jax.grad(piped_loss))(stacked, x)
+    gs = jax.grad(seq_loss)(stacked, x)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4)
+
+
+def test_pipeline_single_stage_passthrough(devices8):
+    mesh = make_mesh(MeshConfig(data=8), devices8)
+    stacked, stages = _make(1, 8)
+    x = jnp.ones((4, 8), jnp.float32)
+    out = pipeline_apply(_stage_fn, stacked, x, mesh, 2)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_stage_fn(stages[0], x)), atol=1e-6
+    )
+
+
+def test_pipeline_rejects_indivisible_batch(devices8):
+    mesh = make_mesh(MeshConfig(pipe=4, data=2), devices8)
+    stacked, _ = _make(4, 8)
+    with pytest.raises(ValueError):
+        pipeline_apply(_stage_fn, stacked, jnp.ones((6, 8)), mesh, 4)
+
+
+def test_pipeline_carries_transformer_blocks(devices8):
+    """The schedule drives real flagship transformer blocks (attention +
+    MLP + norms) as stages, matching sequential application."""
+    from flax.core import meta as flax_meta
+
+    from determined_tpu.models.transformer import Block, TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=4, max_seq_len=16,
+        dtype=jnp.float32, attention_impl="reference", partition_params=False,
+    )
+    block = Block(cfg)
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((4, 16, 32)), jnp.float32)
+    stage_params = [
+        flax_meta.unbox(block.init(jax.random.key(i), x)) for i in range(4)
+    ]
+    stacked = stack_stage_params(stage_params)
+
+    def stage_fn(p, x):
+        return block.apply(p, x)[0]  # (x, aux) -> x
+
+    ref = x
+    for p in stage_params:
+        ref = stage_fn(p, ref)
+
+    mesh = make_mesh(MeshConfig(pipe=4, data=2), devices8)
+    with mesh:
+        out = jax.jit(lambda p, x: pipeline_apply(stage_fn, p, x, mesh, 2))(
+            stacked, x
+        )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
