@@ -105,13 +105,20 @@ class MoE(nn.Module):
         b, s, d = x.shape
         g = b * s
         e = self.num_experts
+        # pad up to a group multiple rather than shrinking groups: a
+        # divisor fallback can degenerate to tiny groups (prime token
+        # counts), collapsing capacity and dropping every top-2 route.
+        # Padded (zero) tokens route uniformly and consume at most the pad
+        # fraction of capacity; their outputs are sliced away.
         grp = min(self.group_size, g)
-        while g % grp:
-            grp -= 1  # largest divisor <= group_size; worst case 1
-        n_groups = g // grp
+        pad = (-g) % grp
+        n_groups = (g + pad) // grp
         capacity = max(int(self.capacity_factor * grp * 2 / e), 1)
 
-        xg = x.reshape(n_groups, grp, d)
+        xf = x.reshape(g, d)
+        if pad:
+            xf = jnp.concatenate([xf, jnp.zeros((pad, d), x.dtype)], axis=0)
+        xg = xf.reshape(n_groups, grp, d)
         router = self.param(
             "router",
             _maybe_partition(
@@ -155,4 +162,5 @@ class MoE(nn.Module):
         h = nn.silu(gate) * h
         expert_out = jnp.einsum("necf,efd->necd", h, w_out.astype(cd))
         y = jnp.einsum("ngec,necd->ngd", combine.astype(cd), expert_out)
+        y = y.reshape(n_groups * grp, d)[:g]
         return y.reshape(b, s, d), aux.astype(jnp.float32)

@@ -58,10 +58,26 @@ def pipeline_apply(
         )
     mb = batch // num_microbatches
     xm = x.reshape(num_microbatches, mb, *x.shape[1:])
+    bshards = 1
+    for a in (MeshAxes.DATA, MeshAxes.FSDP):
+        bshards *= mesh.shape.get(a, 1)
 
-    from jax import shard_map
+    try:  # jax >= 0.6 moved shard_map to jax.shard_map
+        shard_map = jax.shard_map
+    except AttributeError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map  # type: ignore
 
     pspec = jax.tree.map(lambda _: P(MeshAxes.PIPELINE), stacked_params)
+    # microbatch rows shard over the batch axes present in the mesh, so
+    # data/fsdp parallelism composes through the pipeline instead of being
+    # silently all-gathered away by a replicated in_spec; microbatches too
+    # small to split fall back to replication (still correct, no speedup)
+    batch_axes = tuple(
+        a for a in (MeshAxes.DATA, MeshAxes.FSDP) if mesh.shape.get(a, 1) > 1
+    )
+    if mb % bshards:
+        batch_axes = ()
+    xspec = P(None, batch_axes or None, *([None] * (x.ndim - 1)))
 
     def per_device(params, xm_local):
         # params leaves: [1, ...] (my stage); xm_local: [M, mb, ...]
@@ -109,8 +125,8 @@ def pipeline_apply(
     out = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
+        in_specs=(pspec, xspec),
+        out_specs=xspec,
         check_vma=False,
     )(stacked_params, xm)
     return out.reshape(batch, *x.shape[1:])

@@ -387,8 +387,8 @@ class Agent {
 
 // TPU chip enumeration (reference agent/internal/detect/: nvidia-smi for
 // cuda slots; here /dev/accel* — how libtpu exposes chips on TPU VMs —
-// with /dev/vfio/N as the newer binding, else one CPU slot).  --slots
-// overrides for tests/CPU hosts.
+// else one CPU slot).  --slots overrides for tests, CPU hosts, and
+// vfio-bound TPU VMs (see the NOTE below on why vfio is not counted).
 static int detect_slots(std::string* slot_type) {
   int n = 0;
   for (int i = 0; i < 16; ++i) {

@@ -264,6 +264,9 @@ class HttpServer {
 
   HttpResponse dispatch(const HttpRequest& req) {
     auto parts = split_path(req.path);
+    // decode AFTER splitting: %2F inside a segment (e.g. a model name
+    // containing '/') must not change segmentation
+    for (auto& part : parts) part = url_decode(part);
     for (const auto& r : routes_) {
       if (r.method != req.method) continue;
       // a trailing "{*name}" wildcard swallows the rest of the path

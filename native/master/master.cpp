@@ -2171,7 +2171,11 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       std::filesystem::rename(ctx_tmp, m.context_path(id), ec);
       if (ec) {
         // the experiment is already journaled: fail it explicitly rather
-        // than leaving an ACTIVE experiment whose code never arrived
+        // than leaving an ACTIVE experiment whose code never arrived —
+        // and stop its fresh trials too, or they poll PENDING forever
+        for (const auto& [rid, tid] : m.experiments_[id].rid_to_trial) {
+          m.trials_[tid].state = "STOPPED";
+        }
         m.set_exp_state(m.experiments_[id], "ERROR");
         cleanup_tmp();
         return R::error(500, "failed to finalize inherited context");

@@ -1,0 +1,66 @@
+"""Generated bindings: current with the spec, and working against a live
+master (reference: generated common/api/bindings.py as the only client)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.test_devcluster import (  # noqa: F401  (fixture reuse)
+    AGENT_BIN,
+    MASTER_BIN,
+    DevCluster,
+    cluster,
+    exp_config,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bindings_are_current(tmp_path):
+    """bindings.py must match a fresh generation — compared against a TEMP
+    output so a stale tree keeps failing instead of self-healing once."""
+    with open(os.path.join(REPO, "determined_tpu", "api", "bindings.py")) as f:
+        committed = f.read()
+    out_path = tmp_path / "bindings.py"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "gen_bindings.py"),
+         str(out_path)],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert committed == out_path.read_text(), (
+        "bindings.py is stale: run scripts/gen_bindings.py"
+    )
+
+
+@pytest.mark.skipif(
+    not (os.path.exists(MASTER_BIN) and os.path.exists(AGENT_BIN)),
+    reason="native binaries not built",
+)
+def test_bindings_drive_live_master(cluster):
+    from determined_tpu.api import bindings
+    from determined_tpu.api.session import Session
+
+    anon = Session(cluster.url)
+    tok = bindings.post_auth_login(
+        anon, body={"username": "determined", "password": ""}
+    )["token"]
+    s = Session(cluster.url, token=tok)
+
+    assert bindings.get_auth_whoami(s)["username"] == "determined"
+    exp = bindings.post_experiments(s, body={"config": exp_config(cluster.ckpt_dir)})
+    final = cluster.wait_for_state(exp["id"])
+    assert final["state"] == "COMPLETED"
+    got = bindings.get_experiments_by_id(s, exp["id"])
+    assert got["state"] == "COMPLETED"
+    trial = got["trials"][0]
+    rows = bindings.get_trials_by_id_metrics(
+        s, trial["id"], params={"group": "validation"}
+    )
+    assert rows and "validation_accuracy" in rows[-1]["metrics"]
+    assert any(a["id"] == "agent-0" for a in bindings.get_agents(s))
+    assert isinstance(bindings.get_events(s, params={"since": 0}), list)
