@@ -50,13 +50,14 @@ def main() -> None:
     n = len(jax.devices())
     # env overrides for tuning sweeps (defaults are the tuned config)
     bs = int(os.environ.get("DTPU_BENCH_BS", 8)) * n
+    seq = int(os.environ.get("DTPU_BENCH_SEQ", 1024))
     fused = os.environ.get("DTPU_BENCH_FUSED", "auto")
     if fused not in ("auto", "1", "0"):
         raise SystemExit("DTPU_BENCH_FUSED must be one of: auto, 1, 0")
     hp = {
         "lr": 3e-4,
         "global_batch_size": bs,
-        "seq_len": 1024,
+        "seq_len": seq,
         "vocab_size": 32768,
         "d_model": 2048,
         "n_layers": 8,
@@ -79,7 +80,7 @@ def main() -> None:
     trainer = train.Trainer(LMTrial(ctx))
     trainer._setup()
 
-    seq, gbs = hp["seq_len"], hp["global_batch_size"]
+    seq, gbs = hp["seq_len"], hp["global_batch_size"]  # noqa: F841 (seq above)
     d, L, V = hp["d_model"], hp["n_layers"], hp["vocab_size"]
     # matmul params: attn (4 d^2) + swiglu (3 * 4 d^2) per layer + lm head;
     # fwd+bwd flops/token ~ 6 * params + attention O(seq) term
