@@ -80,7 +80,7 @@ def main() -> None:
     trainer = train.Trainer(LMTrial(ctx))
     trainer._setup()
 
-    seq, gbs = hp["seq_len"], hp["global_batch_size"]  # noqa: F841 (seq above)
+    gbs = hp["global_batch_size"]
     d, L, V = hp["d_model"], hp["n_layers"], hp["vocab_size"]
     # matmul params: attn (4 d^2) + swiglu (3 * 4 d^2) per layer + lm head;
     # fwd+bwd flops/token ~ 6 * params + attention O(seq) term

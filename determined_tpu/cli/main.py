@@ -70,14 +70,19 @@ def do_whoami(args) -> int:
 
 
 def user_create(args) -> int:
-    _client(args).create_user(args.username, args.password or "", args.admin)
+    if args.admin and args.role in ("user", "viewer"):
+        print(f"error: --admin contradicts --role {args.role}", file=sys.stderr)
+        return 1
+    _client(args).create_user(
+        args.username, args.password or "", args.admin, role=args.role
+    )
     print(f"created user {args.username}")
     return 0
 
 
 def user_list(args) -> int:
     rows = _client(args).session.get("/api/v1/users").json()
-    _table(rows, ["username", "admin"])
+    _table(rows, ["username", "role", "admin"])
     return 0
 
 
@@ -440,6 +445,7 @@ def build_parser() -> argparse.ArgumentParser:
     uc.add_argument("username")
     uc.add_argument("-p", "--password")
     uc.add_argument("--admin", action="store_true")
+    uc.add_argument("--role", choices=["admin", "user", "viewer"])
     uc.set_defaults(fn=user_create)
     user.add_parser("list").set_defaults(fn=user_list)
 

@@ -1371,18 +1371,13 @@ def test_replay_skips_snapshot_covered_events(tmp_path):
     c.stop()
 
     state = tmp_path / "state"
-    snap = json.loads((state / "snapshot.json").read_text()) if (
-        state / "snapshot.json"
-    ).exists() else None
+    have_snapshot = (state / "snapshot.json").exists()
     journal_path = state / "journal.jsonl"
     journal = journal_path.read_text().strip().splitlines()
     events = [json.loads(l) for l in journal if l.strip()]
     created = next(e for e in events if e["type"] == "exp_created")
 
-    if snap is None:
-        # force the crash-window shape: compact manually by writing a
-        # snapshot covering everything, then leave the journal UNTRUNCATED
-        max_seq = max(e.get("seq", 0) for e in events)
+    if not have_snapshot:
         # restart once with a tiny journal limit to get a real snapshot
         c2 = DevCluster(tmp_path, agents=0, slots=0,
                         master_args=("--journal-limit", "1"))
@@ -1397,8 +1392,7 @@ def test_replay_skips_snapshot_covered_events(tmp_path):
     with open(journal_path, "a") as f:
         f.write(json.dumps(created) + "\n")
 
-    c3 = DevCluster(tmp_path, agents=0, slots=0)
-    c3.state_dir = str(state)
+    c3 = DevCluster(tmp_path, agents=0, slots=0)  # same state dir
     c3.start_master()
     try:
         exps = c3.http.get(c3.url + "/api/v1/experiments").json()
