@@ -219,6 +219,12 @@ def checkpoint_list(args) -> int:
     return 0
 
 
+def checkpoint_download(args) -> int:
+    path = _client(args).get_checkpoint(args.uuid).download(args.output)
+    print(path)
+    return 0
+
+
 def model_create(args) -> int:
     m = _client(args).create_model(args.name, description=args.description or "")
     print(f"created model {m.name}")
@@ -504,6 +510,10 @@ def build_parser() -> argparse.ArgumentParser:
         dest="verb", required=True
     )
     ckpt.add_parser("list").set_defaults(fn=checkpoint_list)
+    cd = ckpt.add_parser("download")
+    cd.add_argument("uuid")
+    cd.add_argument("--output", help="target directory (default: temp dir)")
+    cd.set_defaults(fn=checkpoint_download)
 
     model = sub.add_parser("model").add_subparsers(dest="verb", required=True)
     mc = model.add_parser("create")

@@ -224,6 +224,29 @@ class Checkpoint(_Resource):
     def delete(self) -> None:
         self._session.delete(f"/api/v1/checkpoints/{self.uuid}")
 
+    def download(self, target_dir: Optional[str] = None) -> str:
+        """Fetch the checkpoint's files locally via the owning experiment's
+        storage config; returns the local directory (reference:
+        ``Checkpoint.download``).  Pair with
+        ``train.load_trial_from_checkpoint`` to rebuild the model."""
+        if self.trial_id is None:
+            raise ValueError("checkpoint has no trial; cannot resolve storage")
+        trial = self._session.get(f"/api/v1/trials/{self.trial_id}").json()
+        exp = self._session.get(
+            f"/api/v1/experiments/{trial['experiment_id']}"
+        ).json()
+        storage_raw = (exp.get("config") or {}).get("checkpoint_storage")
+        if not storage_raw:
+            raise ValueError("experiment config has no checkpoint_storage")
+        from determined_tpu.storage import from_expconf
+
+        storage = from_expconf(storage_raw)
+        import tempfile
+
+        target = target_dir or tempfile.mkdtemp(prefix=f"dtpu-ckpt-{self.uuid}-")
+        storage.download(self.uuid, target)
+        return target
+
 
 class ModelVersion(_Resource):
     @property

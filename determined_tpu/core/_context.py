@@ -171,7 +171,15 @@ def init(
         allocation_id=info.allocation_id if info else None,
         mode=preempt_mode,
     )
-    profiler = ProfilerContext(distributed, metrics)
+    # xplane traces land in shared checkpoint storage when it has a local
+    # path, so a tensorboard/viewer task on any host can serve them
+    # (reference: tensorboard task fetching trial event files)
+    trace_dir = None
+    if hasattr(storage_manager, "base_path") and info is not None and info.trial_id:
+        trace_dir = os.path.join(
+            storage_manager.base_path, "traces", f"trial_{info.trial_id}"
+        )
+    profiler = ProfilerContext(distributed, metrics, trace_dir=trace_dir)
     heartbeat = (
         HeartbeatReporter(session, info.trial_id)
         if session is not None and info is not None and info.trial_id is not None

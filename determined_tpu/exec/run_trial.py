@@ -185,6 +185,12 @@ def main() -> int:
 
     core_ctx = core.init()
     try:
+        # expconf-driven profiling (reference exec/harness.py:211): system
+        # sampler + optional xplane trace into shared checkpoint storage;
+        # inside the try so a trace-setup failure still closes the context
+        prof = exp_config.profiling or {}
+        if prof.get("enabled"):
+            core_ctx.profiler.on(sampling=True, trace=bool(prof.get("trace", False)))
         ctx = train.init(
             hparams=cluster.hparams,
             exp_config=exp_config,

@@ -11,6 +11,9 @@ importable in this image — so the task serves a self-contained viewer:
 - ``/``                          HTML page with SVG metric charts (no JS deps)
 - ``/data/experiments``          experiments visible to this task
 - ``/data/trials/{id}/metrics``  metric rows proxied from the master
+- ``/data/traces``               xplane trace files found in the
+                                 experiments' shared_fs storage (written by
+                                 the profiler into <storage>/traces/)
 - ``/healthz``                   readiness
 
 The task binds ``DTPU_TASK_PORT``, then POSTs ``/api/v1/tasks/{id}/ready``
@@ -90,6 +93,47 @@ def _master_get(path: str) -> bytes:
         return resp.read()
 
 
+def _list_traces(exp_filter) -> list:
+    """xplane trace files for each visible experiment's OWN trials, under
+    its resolved storage path (honoring storage_path; local fs types only
+    — cloud storage returns nothing here).  Shared storage roots are the
+    norm, so attribution walks trial_<id> dirs per experiment rather than
+    claiming everything under the root."""
+    out = []
+    try:
+        exps = json.loads(_master_get("/api/v1/experiments"))
+    except Exception:  # noqa: BLE001
+        return out
+    for e in exps:
+        if exp_filter and int(e["id"]) not in exp_filter:
+            continue
+        storage = (e.get("config") or {}).get("checkpoint_storage") or {}
+        if storage.get("type", "shared_fs") not in ("shared_fs", "directory"):
+            continue
+        try:
+            from determined_tpu.config.experiment import CheckpointStorageConfig
+
+            base = CheckpointStorageConfig.parse(dict(storage)).to_url()
+        except Exception:  # noqa: BLE001
+            continue
+        for t in e.get("trials") or []:
+            tdir = os.path.join(base, "traces", f"trial_{t['id']}")
+            if not os.path.isdir(tdir):
+                continue
+            for dirpath, _dirs, files in os.walk(tdir):
+                for f in files:
+                    p = os.path.join(dirpath, f)
+                    out.append(
+                        {
+                            "experiment_id": e["id"],
+                            "trial_id": t["id"],
+                            "path": p,
+                            "bytes": os.path.getsize(p),
+                        }
+                    )
+    return out
+
+
 def main() -> int:
     import http.server
 
@@ -122,6 +166,8 @@ def main() -> int:
                     if exp_filter:
                         exps = [e for e in exps if int(e["id"]) in exp_filter]
                     self._send(json.dumps(exps).encode())
+                elif self.path == "/data/traces":
+                    self._send(json.dumps(_list_traces(exp_filter)).encode())
                 else:
                     m = re.fullmatch(r"/data/trials/(\d+)/metrics", self.path)
                     if m:

@@ -1,5 +1,6 @@
 from determined_tpu.storage.base import (  # noqa: F401
     StorageManager,
+    from_expconf,
     from_string,
     file_md5,
     list_directory,

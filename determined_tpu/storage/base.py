@@ -118,6 +118,14 @@ class StorageManager(abc.ABC):
             shutil.rmtree(src, ignore_errors=True)
 
 
+def from_expconf(raw: dict) -> "StorageManager":
+    """StorageManager from an expconf checkpoint_storage dict — the single
+    resolution used by core.init and SDK Checkpoint.download."""
+    from determined_tpu.config.experiment import CheckpointStorageConfig
+
+    return from_string(CheckpointStorageConfig.parse(dict(raw)).to_url())
+
+
 def from_string(url: str, **kwargs) -> StorageManager:
     """Build a StorageManager from a URL-ish string.
 

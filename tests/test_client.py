@@ -124,3 +124,24 @@ def test_rbac_viewer_and_owner_gating(cluster, tmp_path):
     admin.get_experiment(exp.id).activate()
     assert exp.reload().state == "ACTIVE"
     assert exp.wait(timeout=300) == "COMPLETED"
+
+
+def test_checkpoint_download_and_reload(cluster, tmp_path):
+    """SDK Checkpoint.download resolves storage via the owning experiment
+    and pairs with load_trial_from_checkpoint (reference Checkpoint.download
+    + pytorch _load)."""
+    from determined_tpu import client, train
+    from determined_tpu.models.mnist import MnistTrial
+    from determined_tpu.parallel.mesh import MeshConfig
+
+    d = client.Determined(cluster.url)
+    exp = d.create_experiment(exp_config(cluster.ckpt_dir))
+    assert exp.wait(timeout=240) == "COMPLETED"
+    cp = exp.get_trials()[0].list_checkpoints()[-1]
+    local = cp.download(str(tmp_path / "dl"))
+    assert os.path.isdir(local)
+    trial, trainer = train.load_trial_from_checkpoint(
+        local, mesh_config=MeshConfig(data=2)
+    )
+    assert isinstance(trial, MnistTrial)
+    assert trainer.steps_completed > 0

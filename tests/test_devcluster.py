@@ -1400,3 +1400,38 @@ def test_replay_skips_snapshot_covered_events(tmp_path):
         assert len(exps[0]["trials"]) == 1, "initial trials re-ran on replay"
     finally:
         c3.stop()
+
+
+def test_profiling_traces_reach_viewer(cluster, tmp_path):
+    """expconf profiling.enabled+trace: the trial writes an xplane trace
+    into shared checkpoint storage and the viewer task lists it
+    (reference: profiler -> tensorboard task loop, exec/harness.py:211)."""
+    cfg = exp_config(cluster.ckpt_dir)
+    cfg["profiling"] = {"enabled": True, "trace": True}
+    exp_id = cluster.submit(cfg)
+    assert cluster.wait_for_state(exp_id)["state"] == "COMPLETED"
+    # trace files landed in <storage>/traces/trial_N/
+    troot = os.path.join(cluster.ckpt_dir, "traces")
+    assert os.path.isdir(troot), "no traces dir in shared storage"
+    files = [
+        os.path.join(dp, f) for dp, _d, fs in os.walk(troot) for f in fs
+    ]
+    assert files, "profiler produced no trace files"
+
+    # the viewer task lists them
+    r = cluster.http.post(
+        cluster.url + "/api/v1/tasks",
+        json={"type": "tensorboard", "config": {"experiment_ids": [exp_id]}},
+    )
+    task_id = r.json()["id"]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if cluster.http.get(f"{cluster.url}/api/v1/tasks/{task_id}").json()["ready"]:
+            break
+        time.sleep(0.5)
+    traces = cluster.http.get(
+        cluster.url + f"/proxy/{task_id}/data/traces"
+    ).json()
+    assert traces and traces[0]["experiment_id"] == exp_id
+    assert any(t["bytes"] > 0 for t in traces)
+    cluster.http.delete(cluster.url + f"/api/v1/tasks/{task_id}")
