@@ -42,6 +42,7 @@ ROUTES = [
     ("POST", "/api/v1/experiments/{id}/activate", "token", {"state"}),
     ("POST", "/api/v1/experiments/{id}/cancel", "token", {"state"}),
     ("POST", "/api/v1/experiments/{id}/kill", "token", {"state"}),
+    ("DELETE", "/api/v1/experiments/{id}", "token", set()),
     # trials
     ("GET", "/api/v1/trials/{id}", "token",
      {"id", "experiment_id", "state", "restarts", "latest_checkpoint",

@@ -170,6 +170,12 @@ def exp_fork(args) -> int:
     return 0
 
 
+def exp_delete(args) -> int:
+    _client(args).get_experiment(args.id).delete()
+    print(f"deleted experiment {args.id}")
+    return 0
+
+
 def exp_signal(args) -> int:
     exp = _client(args).get_experiment(args.id)
     exp = getattr(exp, args.verb)()
@@ -485,6 +491,9 @@ def build_parser() -> argparse.ArgumentParser:
         v = exp.add_parser(verb)
         v.add_argument("id", type=int)
         v.set_defaults(fn=exp_signal, verb=verb)
+    dl = exp.add_parser("delete")
+    dl.add_argument("id", type=int)
+    dl.set_defaults(fn=exp_delete)
 
     trial = sub.add_parser("trial", aliases=["t"]).add_subparsers(
         dest="verb", required=True

@@ -100,6 +100,12 @@ class Experiment(_Resource):
         )
         return Experiment(self._session, resp.json()).reload()
 
+    def delete(self) -> None:
+        """Delete this terminal experiment: records removed, checkpoints
+        and profiler traces GC'd from storage (reference: det experiment
+        delete)."""
+        self._session.delete(f"/api/v1/experiments/{self.id}")
+
     def wait(self, timeout: Optional[float] = None, interval: float = 1.0) -> str:
         """Poll until the experiment reaches a terminal state; returns it."""
         deadline = None if timeout is None else time.time() + timeout

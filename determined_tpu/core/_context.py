@@ -22,7 +22,7 @@ from determined_tpu.core._metrics import MetricsContext
 from determined_tpu.core._preempt import PreemptContext, PreemptMode
 from determined_tpu.core._profiler import ProfilerContext
 from determined_tpu.core._train import TrainContext
-from determined_tpu.storage.base import StorageManager, from_string
+from determined_tpu.storage.base import StorageManager, from_expconf, from_string
 
 logger = logging.getLogger("determined_tpu.core")
 
@@ -138,11 +138,10 @@ def init(
         if url is None:
             url = os.path.join(os.getcwd(), "checkpoints")
         if isinstance(url, dict):
-            # expconf dict form ({"type": "shared_fs", "host_path": ...}).
-            from determined_tpu.config.experiment import CheckpointStorageConfig
-
-            url = CheckpointStorageConfig.parse(url).to_url()
-        storage_manager = from_string(url) if isinstance(url, str) else url
+            # expconf dict form ({"type": "shared_fs", "host_path": ...})
+            storage_manager = from_expconf(url)
+        else:
+            storage_manager = from_string(url) if isinstance(url, str) else url
 
     checkpoint = CheckpointContext(
         distributed,

@@ -136,16 +136,26 @@ class ProfilerContext:
             jax.profiler.start_trace(trace_dir)
             self._tracing = True
 
-    def off(self) -> None:
-        if self._thread is not None:
-            self._stop.set()
-            self._thread.join(timeout=5)
-            self._thread = None
+    @property
+    def tracing(self) -> bool:
+        return self._tracing
+
+    def stop_trace(self) -> None:
+        """End the xplane capture window (the trainer calls this after
+        ``profiling.end_after_batch`` steps — whole-run traces grow
+        unboundedly)."""
         if self._tracing:
             import jax
 
             jax.profiler.stop_trace()
             self._tracing = False
+
+    def off(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.stop_trace()
 
     def _sample_loop(self) -> None:
         prev_cpu = _read_proc_stat()

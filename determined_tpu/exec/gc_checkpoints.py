@@ -40,6 +40,19 @@ def main() -> int:
         except Exception:  # noqa: BLE001 - keep deleting the rest
             logger.exception("gc: failed to delete checkpoint %s", uuid)
             failed += 1
+    # experiment deletion also clears profiler trace dirs ("traces/trial_N"
+    # storage-relative prefixes; same delete path as checkpoints)
+    from determined_tpu.utils.errors import CheckpointNotFoundError
+
+    for rel in spec.get("trace_dirs", []):
+        try:
+            manager.delete(rel)
+            logger.info("gc: deleted traces %s", rel)
+        except CheckpointNotFoundError:
+            pass  # trial never traced
+        except Exception:  # noqa: BLE001
+            logger.exception("gc: failed to delete traces %s", rel)
+            failed += 1
     return 1 if failed else 0
 
 

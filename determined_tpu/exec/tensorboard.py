@@ -108,13 +108,14 @@ def _list_traces(exp_filter) -> list:
         if exp_filter and int(e["id"]) not in exp_filter:
             continue
         storage = (e.get("config") or {}).get("checkpoint_storage") or {}
-        if storage.get("type", "shared_fs") not in ("shared_fs", "directory"):
-            continue
         try:
-            from determined_tpu.config.experiment import CheckpointStorageConfig
+            from determined_tpu.storage import from_expconf
 
-            base = CheckpointStorageConfig.parse(dict(storage)).to_url()
+            manager = from_expconf(storage)
         except Exception:  # noqa: BLE001
+            continue
+        base = getattr(manager, "base_path", None)  # local fs types only
+        if not base:
             continue
         for t in e.get("trials") or []:
             tdir = os.path.join(base, "traces", f"trial_{t['id']}")

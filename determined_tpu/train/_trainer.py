@@ -490,6 +490,18 @@ class Trainer:
         if latest_checkpoint:
             self._restore_checkpoint(latest_checkpoint)
 
+        # bounded trace window: a whole-run xplane capture grows without
+        # limit, so tracing stops after profiling.end_after_batch steps —
+        # counted from the RESUME point (computed after checkpoint restore
+        # so restarts trace their own window, not an already-expired one)
+        prof_cfg = (self.context.exp_config.profiling
+                    if self.context.exp_config else None) or {}
+        self._trace_stop_step = (
+            self.steps_completed + int(prof_cfg.get("end_after_batch", 10))
+            if prof_cfg.get("trace")
+            else None
+        )
+
         for cb in self.callbacks.values():
             cb.on_training_start(self)
 
@@ -510,6 +522,15 @@ class Trainer:
                 rep_sched.next_after(self.steps_completed),
                 max_steps,
             )
+            if (
+                self._trace_stop_step is not None
+                and self.core.profiler.tracing
+                and self._trace_stop_step > self.steps_completed
+            ):
+                # break the hot segment at the trace boundary so the
+                # capture window is end_after_batch steps, not
+                # end_after_batch rounded up to the next report period
+                next_stop = min(next_stop, self._trace_stop_step)
             # ---- hot segment: no host syncs ------------------------------
             seg_t0 = time.monotonic()
             # the mesh context makes trace-time sharding constraints resolve
@@ -536,6 +557,12 @@ class Trainer:
                 epoch_seen = self.train_loader.epoch
 
             at_end = self.steps_completed >= max_steps
+            if (
+                self._trace_stop_step is not None
+                and self.core.profiler.tracing
+                and self.steps_completed >= self._trace_stop_step
+            ):
+                self.core.profiler.stop_trace()
 
             # ---- REPORT ---------------------------------------------------
             if rep_sched.is_boundary(self.steps_completed) or at_end:

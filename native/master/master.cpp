@@ -465,6 +465,13 @@ class Master {
       next_webhook_id_ = std::max(next_webhook_id_, wh.id + 1);
     } else if (type == "webhook_deleted") {
       webhooks_.erase(ev["id"].as_int());
+    } else if (type == "exp_deleted") {
+      int64_t eid = ev["id"].as_int();
+      auto eit = experiments_.find(eid);
+      if (eit != experiments_.end()) {
+        for (const auto& [rid, tid] : eit->second.rid_to_trial) trials_.erase(tid);
+        experiments_.erase(eit);
+      }
     } else if (type == "trial_seed_checkpoint") {
       auto it = trials_.find(ev["trial_id"].as_int());
       if (it != trials_.end()) {
@@ -1054,16 +1061,21 @@ class Master {
 
   // mark DELETED + journal, then dispatch a gc task to an agent in the pool
   void delete_checkpoints(const std::string& pool, const Json& storage,
-                          const std::vector<std::string>& uuids) {
+                          const std::vector<std::string>& uuids,
+                          const Json& trace_dirs = Json::array()) {
     Json uuid_arr = Json::array();
     for (const auto& uuid : uuids) {
       auto it = checkpoints_.find(uuid);
       if (it == checkpoints_.end()) continue;
+      if (it->second.contains("state") &&
+          it->second["state"].as_string() == "DELETED") {
+        continue;  // already marked; do not re-journal
+      }
       it->second.set("state", "DELETED");
       record(Json::object().set("type", "ckpt_deleted").set("uuid", uuid));
       uuid_arr.push_back(uuid);
     }
-    if (uuid_arr.size() == 0) return;
+    if (uuid_arr.size() == 0 && trace_dirs.size() == 0) return;
     AgentState* target = nullptr;
     for (auto& [aid, ag] : agents_) {
       if (target == nullptr) target = &ag;
@@ -1076,6 +1088,7 @@ class Master {
     Json work = Json::object();
     work.set("type", "gc");
     work.set("uuids", uuid_arr);
+    if (trace_dirs.size() > 0) work.set("trace_dirs", trace_dirs);
     work.set("storage", storage);
     work.set("checkpoint_dir", checkpoint_dir_);
     target->work.push_back(work);
@@ -1945,8 +1958,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     Json config = body.contains("config") ? body["config"] : body;
-    // template application: submitted config overrides the stored
-    // template (reference templates/ + schemas.Merge semantics)
+    // cluster-side defaulting: experiments without checkpoint_storage get
+    // the master's checkpoint dir (reference: cluster config defaults) so
+    // trials, SDK downloads and viewer tasks all resolve the same path
+    // (applied after template merge, below)
     if (body.contains("template") && body["template"].is_string()) {
       std::lock_guard<std::mutex> lk(m.mu_);
       auto tit = m.templates_.find(body["template"].as_string());
@@ -1954,6 +1969,12 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         return R::error(400, "no such template: " + body["template"].as_string());
       }
       config = Master::merge_json(tit->second, config);
+    }
+    if (!config.contains("checkpoint_storage")) {
+      std::lock_guard<std::mutex> lk(m.mu_);
+      config.set("checkpoint_storage", Json::object()
+                                           .set("type", "shared_fs")
+                                           .set("host_path", m.checkpoint_dir_));
     }
     std::string cfg_err = Master::validate_config(config);
     if (!cfg_err.empty()) return R::error(400, cfg_err);
@@ -2192,6 +2213,50 @@ void install_routes_impl(Master& m, HttpServer& srv) {
             authed([fork_like](const HttpRequest& r) { return fork_like(r, false); }));
   srv.route("POST", "/api/v1/experiments/{id}/continue",
             authed([fork_like](const HttpRequest& r) { return fork_like(r, true); }));
+
+  // delete a terminal experiment: records go away, its checkpoints AND
+  // profiler trace dirs are GC'd from storage (reference: det experiment
+  // delete; also the only cleanup path for traces, which outlive
+  // checkpoint GC by design so viewer tasks can read them)
+  srv.route("DELETE", "/api/v1/experiments/{id}", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.experiments_.find(std::stoll(req.params.at("id")));
+    if (it == m.experiments_.end()) return R::error(404, "no such experiment");
+    ExperimentState& exp = it->second;
+    std::string user = m.authenticate(req);
+    auto uit = m.users_.find(user);
+    bool is_admin = uit != m.users_.end() && uit->second.admin;
+    if (!is_admin && user != exp.owner) {
+      return R::error(403, "only the owner or an admin may delete this experiment");
+    }
+    if (exp.state == "ACTIVE" || exp.state == "PAUSED") {
+      return R::error(409, "terminate the experiment before deleting it");
+    }
+    std::vector<std::string> uuids;
+    Json trace_dirs = Json::array();
+    for (const auto& [rid, tid] : exp.rid_to_trial) {
+      trace_dirs.push_back("traces/trial_" + std::to_string(tid));
+      for (auto& [uuid, c] : m.checkpoints_) {
+        if (c["trial_id"].as_int() == tid) uuids.push_back(uuid);
+      }
+    }
+    Json storage = exp.config["checkpoint_storage"];
+    std::string pool = exp.resource_pool;
+    int64_t eid = exp.id;
+    m.record(Json::object().set("type", "exp_deleted").set("id", Json(eid)));
+    std::error_code ec;
+    for (const auto& [rid, tid] : exp.rid_to_trial) {
+      // per-trial jsonl state goes with the records (ids never recycle,
+      // so leftover files would accumulate forever)
+      std::filesystem::remove(m.logs_path(tid), ec);
+      std::filesystem::remove(m.metrics_path(tid), ec);
+      m.trials_.erase(tid);
+    }
+    m.experiments_.erase(it);
+    std::filesystem::remove(m.context_path(eid), ec);
+    m.delete_checkpoints(pool, storage, uuids, trace_dirs);
+    return R::json("{}");
+  }));
 
   auto exp_signal = [&m](const HttpRequest& req, const std::string& verb) {
     std::lock_guard<std::mutex> lk(m.mu_);

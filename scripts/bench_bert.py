@@ -1,0 +1,96 @@
+"""HF BERT fine-tune benchmark — the BASELINE.json ``hf_trainer BERT``
+north-star workload (samples/sec/chip), run through the platform's own
+Trainer over transformers' Flax BERT (models/hf_bert.py).
+
+BERT-base geometry (L12 H768 A12, vocab 30522), seq 128 classification —
+the standard fine-tune shape.  Reports samples/s plus TFLOP/s and MFU
+against the detected chip's bf16 peak using the 6*N(+attention) flops
+convention; ``vs_baseline`` anchors on the same 50 TFLOP/s/chip GPU-parity
+proxy as bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import chip_peak_flops  # noqa: E402
+
+
+def main() -> None:
+    import jax
+
+    from determined_tpu import core, train
+    from determined_tpu.data import to_global
+    from determined_tpu.models.hf_bert import BertClassifyTrial
+    from determined_tpu.parallel.mesh import MeshConfig
+
+    n = len(jax.devices())
+    seq = int(os.environ.get("DTPU_BENCH_SEQ", 128))
+    bs = int(os.environ.get("DTPU_BENCH_BS", 128)) * n
+    hp = {
+        "lr": 5e-5,
+        "global_batch_size": bs,
+        "seq_len": seq,
+        "vocab_size": 30522,
+        "hidden_size": 768,
+        "num_layers": 12,
+        "num_heads": 12,
+        "num_labels": 4,
+        "dataset_size": 8 * bs,
+        "warmup_steps": 10,
+    }
+    ctx = train.init(
+        hparams=hp,
+        mesh_config=MeshConfig(data=n),
+        core_context=core._dummy_init(),
+        seed=0,
+    )
+    trainer = train.Trainer(BertClassifyTrial(ctx))
+    trainer._setup()
+
+    d, L = hp["hidden_size"], hp["num_layers"]
+    n_params = L * 12 * d * d + hp["vocab_size"] * d
+    flops_per_token = 6 * n_params + 12 * L * seq * d
+    flops_per_sample = flops_per_token * seq
+
+    def sync():
+        jax.device_get(trainer.state.metric_count)
+
+    it = iter(trainer.train_loader)
+    step = trainer._train_step
+    for _ in range(5):
+        trainer.state = step(trainer.state, to_global(next(it), trainer.mesh))
+    sync()
+    measured = 30
+    t0 = time.perf_counter()
+    for _ in range(measured):
+        trainer.state = step(trainer.state, to_global(next(it), trainer.mesh))
+    sync()
+    dt = time.perf_counter() - t0
+
+    sps = measured * bs / dt
+    achieved = sps * flops_per_sample
+    peak = chip_peak_flops(jax.devices()[0]) * n
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_finetune_samples_per_sec",
+                "value": round(sps, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(achieved / (5e13 * n), 3),
+                "tflops": round(achieved / 1e12, 1),
+                "mfu": round(achieved / peak, 3),
+                "chip": getattr(jax.devices()[0], "device_kind", "unknown"),
+                "model": f"bert-base-L{L}-H{d}-seq{seq}-bs{bs}",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,7 @@ def _fill(path: str, ids: dict) -> str:
 # existence is still asserted (must NOT 404 on a bogus id)
 EXEMPT_SUCCESS = {
     ("GET", "/api/v1/experiments/{id}/context"),
+    ("DELETE", "/api/v1/experiments/{id}"),  # would delete the seeded exp
     ("GET", "/api/v1/agents/{id}/work"),
     ("POST", "/api/v1/trials/{id}/exit"),
     ("POST", "/api/v1/metrics"),
@@ -100,6 +101,8 @@ def test_every_route_conforms(cluster, tmp_path):
             fam_ids["id"] = "agent-0"
         if "/webhooks/{id}" in path:
             fam_ids["id"] = 1
+        if (method, path) == ("DELETE", "/api/v1/experiments/{id}"):
+            fam_ids["id"] = 999999  # must NOT delete the seeded experiment
         url = cluster.url + _fill(path, fam_ids)
         if "/work" in path or "/signals/preemption" in path:
             url += "?timeout_seconds=0"

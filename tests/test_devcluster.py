@@ -1435,3 +1435,50 @@ def test_profiling_traces_reach_viewer(cluster, tmp_path):
     assert traces and traces[0]["experiment_id"] == exp_id
     assert any(t["bytes"] > 0 for t in traces)
     cluster.http.delete(cluster.url + f"/api/v1/tasks/{task_id}")
+
+
+def test_experiment_delete_gcs_checkpoints_and_traces(cluster):
+    """DELETE /experiments/{id}: terminal-only, records removed, checkpoint
+    files AND profiler trace dirs GC'd from storage (det experiment delete
+    analog; the cleanup path for traces, which checkpoint GC leaves for
+    viewer tasks)."""
+    from determined_tpu import client
+
+    d = client.Determined(cluster.url)
+    cfg = exp_config(cluster.ckpt_dir)
+    cfg["profiling"] = {"enabled": True, "trace": True, "end_after_batch": 3}
+    exp = d.create_experiment(cfg)
+
+    # deleting a live experiment is refused
+    import requests as _rq
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        exp.reload()
+        if exp.state == "ACTIVE" and exp.get("trials"):
+            break
+        time.sleep(0.5)
+    r = cluster.http.delete(cluster.url + f"/api/v1/experiments/{exp.id}")
+    assert r.status_code == 409
+
+    assert exp.wait(timeout=240) == "COMPLETED"
+    trial = exp.get_trials()[0]
+    ckpt = trial.get("latest_checkpoint")
+    trace_dir = os.path.join(cluster.ckpt_dir, "traces", f"trial_{trial.id}")
+    assert os.path.isdir(os.path.join(cluster.ckpt_dir, ckpt))
+    assert os.path.isdir(trace_dir)
+
+    exp.delete()
+    # records gone
+    r = cluster.http.get(cluster.url + f"/api/v1/experiments/{exp.id}")
+    assert r.status_code == 404
+    r = cluster.http.get(cluster.url + f"/api/v1/trials/{trial.id}")
+    assert r.status_code == 404
+    # storage files gone (async gc task)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if not os.path.isdir(os.path.join(cluster.ckpt_dir, ckpt)) and not os.path.isdir(trace_dir):
+            break
+        time.sleep(0.5)
+    assert not os.path.isdir(os.path.join(cluster.ckpt_dir, ckpt)), "checkpoint files not GC'd"
+    assert not os.path.isdir(trace_dir), "trace dir not GC'd"
