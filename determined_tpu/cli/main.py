@@ -406,10 +406,40 @@ def preview_search(args) -> int:
     from determined_tpu.searcher import simulate
 
     with open(args.config) as f:
-        cfg = ExperimentConfig.parse(yaml.safe_load(f))
+        raw = yaml.safe_load(f)
+        cfg = ExperimentConfig.parse(raw)
 
-    # synthetic smooth trial: improves with budget, hp-independent
-    out = simulate(cfg, lambda hp, step: 1.0 / (1 + step), seed=0)
+    if getattr(args, "native", False):
+        # drive the MASTER's C++ searcher (the parity twin of the Python
+        # simulate below; see tests/test_searcher_parity.py)
+        import subprocess
+        import tempfile
+
+        master_bin = _find_binary("dtpu-master")
+        with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+            json.dump(raw, f)
+            cfg_path = f.name
+        try:
+            sim = subprocess.run(
+                [master_bin, "--simulate", cfg_path],
+                capture_output=True,
+                text=True,
+                timeout=120,
+            )
+        finally:
+            os.unlink(cfg_path)
+        if sim.returncode != 0:
+            print(sim.stderr, file=sys.stderr)
+            return 1
+        native = json.loads(sim.stdout)
+        out = {
+            "trials_created": native["trials_created"],
+            "total_units": native["total_units"],
+            "trial_units": native["trial_units"],
+        }
+    else:
+        # synthetic smooth trial: improves with budget, hp-independent
+        out = simulate(cfg, lambda hp, step: 1.0 / (1 + step), seed=0)
     smaller = cfg.searcher.smaller_is_better
     print(f"searcher: {cfg.searcher.name} (metric {cfg.searcher.metric}, "
           f"{'min' if smaller else 'max'})")
@@ -582,6 +612,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     ps = sub.add_parser("preview-search")
     ps.add_argument("config")
+    ps.add_argument("--native", action="store_true",
+                    help="simulate with the master's C++ searcher")
     ps.set_defaults(fn=preview_search)
 
     rl = sub.add_parser("run-local")

@@ -108,13 +108,15 @@ def _list_traces(exp_filter) -> list:
         if exp_filter and int(e["id"]) not in exp_filter:
             continue
         storage = (e.get("config") or {}).get("checkpoint_storage") or {}
+        if storage.get("type", "shared_fs") not in ("shared_fs", "directory"):
+            continue  # cheap gate: never construct cloud clients here
         try:
             from determined_tpu.storage import from_expconf
 
             manager = from_expconf(storage)
         except Exception:  # noqa: BLE001
             continue
-        base = getattr(manager, "base_path", None)  # local fs types only
+        base = getattr(manager, "base_path", None)
         if not base:
             continue
         for t in e.get("trials") or []:

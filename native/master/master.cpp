@@ -469,7 +469,20 @@ class Master {
       int64_t eid = ev["id"].as_int();
       auto eit = experiments_.find(eid);
       if (eit != experiments_.end()) {
-        for (const auto& [rid, tid] : eit->second.rid_to_trial) trials_.erase(tid);
+        std::set<int64_t> gone;
+        for (const auto& [rid, tid] : eit->second.rid_to_trial) {
+          gone.insert(tid);
+          trials_.erase(tid);
+        }
+        // checkpoint records go with their trials (ids never recycle:
+        // orphaned records would accumulate forever)
+        for (auto cit = checkpoints_.begin(); cit != checkpoints_.end();) {
+          if (gone.count(cit->second["trial_id"].as_int())) {
+            cit = checkpoints_.erase(cit);
+          } else {
+            ++cit;
+          }
+        }
         experiments_.erase(eit);
       }
     } else if (type == "trial_seed_checkpoint") {
@@ -1067,12 +1080,15 @@ class Master {
     for (const auto& uuid : uuids) {
       auto it = checkpoints_.find(uuid);
       if (it == checkpoints_.end()) continue;
-      if (it->second.contains("state") &&
-          it->second["state"].as_string() == "DELETED") {
-        continue;  // already marked; do not re-journal
+      bool already = it->second.contains("state") &&
+                     it->second["state"].as_string() == "DELETED";
+      if (!already) {
+        it->second.set("state", "DELETED");
+        record(Json::object().set("type", "ckpt_deleted").set("uuid", uuid));
       }
-      it->second.set("state", "DELETED");
-      record(Json::object().set("type", "ckpt_deleted").set("uuid", uuid));
+      // already-DELETED uuids still go to the gc task: an earlier dispatch
+      // may have been dropped (no agent connected); file deletion is
+      // idempotent, only the journal record must not repeat
       uuid_arr.push_back(uuid);
     }
     if (uuid_arr.size() == 0 && trace_dirs.size() == 0) return;
@@ -2243,18 +2259,29 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     Json storage = exp.config["checkpoint_storage"];
     std::string pool = exp.resource_pool;
     int64_t eid = exp.id;
+    // the gc dispatch must happen BEFORE the records are erased (it marks
+    // + journals ckpt_deleted for still-live records)
+    m.delete_checkpoints(pool, storage, uuids, trace_dirs);
     m.record(Json::object().set("type", "exp_deleted").set("id", Json(eid)));
     std::error_code ec;
+    std::set<int64_t> gone;
     for (const auto& [rid, tid] : exp.rid_to_trial) {
       // per-trial jsonl state goes with the records (ids never recycle,
       // so leftover files would accumulate forever)
       std::filesystem::remove(m.logs_path(tid), ec);
       std::filesystem::remove(m.metrics_path(tid), ec);
+      gone.insert(tid);
       m.trials_.erase(tid);
+    }
+    for (auto cit = m.checkpoints_.begin(); cit != m.checkpoints_.end();) {
+      if (gone.count(cit->second["trial_id"].as_int())) {
+        cit = m.checkpoints_.erase(cit);
+      } else {
+        ++cit;
+      }
     }
     m.experiments_.erase(it);
     std::filesystem::remove(m.context_path(eid), ec);
-    m.delete_checkpoints(pool, storage, uuids, trace_dirs);
     return R::json("{}");
   }));
 

@@ -1,0 +1,93 @@
+"""ASHA search throughput — the BASELINE.json north-star 'adaptive_asha:
+32 concurrent trials across a slice; trials/hour tracked'.
+
+Spins a real devcluster (master + agent processes), submits an
+adaptive-ASHA search over tiny MNIST trials, and reports trials/hour and
+end-to-end search wall time.  On this host the 'slice' is simulated with
+CPU slots (the scheduler, searcher, preemption and restart machinery are
+identical); per-trial JAX startup dominates, so the number measures the
+PLATFORM's search orchestration throughput, not chip math.
+
+Usage: python scripts/asha_throughput.py [--trials 16] [--slots 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--concurrent", type=int, default=4)
+    args = ap.parse_args()
+
+    os.environ.setdefault("DTPU_AUTH_PATH", tempfile.mktemp())
+    os.chdir(REPO)
+    from tests.test_devcluster import DevCluster, exp_config
+
+    tmp = Path(tempfile.mkdtemp())
+    c = DevCluster(tmp, agents=1, slots=args.slots)
+    c.start()
+    try:
+        cfg = exp_config(
+            c.ckpt_dir,
+            searcher={
+                "name": "adaptive_asha",
+                "metric": "validation_accuracy",
+                "smaller_is_better": False,
+                "max_trials": args.trials,
+                "max_length": {"batches": 8},
+                "num_rungs": 2,
+                "divisor": 4,
+                "mode": "standard",
+                "max_concurrent_trials": args.concurrent,
+            },
+        )
+        cfg["min_validation_period"] = {"batches": 2}
+        t0 = time.time()
+        exp_id = c.submit(cfg)
+        final = c.wait_for_state(exp_id, timeout=3600)
+        dt = time.time() - t0
+        assert final["state"] == "COMPLETED", final["state"]
+        n_trials = len(final["trials"])
+        states = {}
+        for t in final["trials"]:
+            states[t["state"]] = states.get(t["state"], 0) + 1
+        print(
+            json.dumps(
+                {
+                    "metric": "adaptive_asha_trials_per_hour",
+                    "value": round(n_trials / dt * 3600, 1),
+                    "unit": "trials/h",
+                    "trials": n_trials,
+                    "wall_s": round(dt, 1),
+                    "trial_states": states,
+                    "slots": args.slots,
+                    "concurrent": args.concurrent,
+                }
+            )
+        )
+    finally:
+        import subprocess
+
+        subprocess.run(
+            ["pkill", "-9", "-f", "determined_tpu.exec.run_trial"],
+            capture_output=True,
+        )
+        c.stop()
+
+
+if __name__ == "__main__":
+    main()
