@@ -69,6 +69,7 @@ ROUTES = [
     ("POST", "/api/v1/agents", "token", {"registered"}),
     ("GET", "/api/v1/agents", "token", "[]"),
     ("GET", "/api/v1/agents/{id}/work", "token", "[]"),
+    ("GET", "/api/v1/resource-pools", "token", "[]"),
     ("GET", "/api/v1/job-queue", "token", "[]"),
     # allocations
     ("GET", "/api/v1/allocations/{id}/signals/preemption", "token", {"preempt"}),

@@ -210,6 +210,14 @@ def agent_list(args) -> int:
     return 0
 
 
+def pool_list(args) -> int:
+    _table(
+        _client(args).list_resource_pools(),
+        ["name", "type", "agents", "slots", "used_slots", "provisioned"],
+    )
+    return 0
+
+
 def checkpoint_list(args) -> int:
     _table(
         [
@@ -544,6 +552,9 @@ def build_parser() -> argparse.ArgumentParser:
         dest="verb", required=True
     )
     agent.add_parser("list").set_defaults(fn=agent_list)
+
+    pool = sub.add_parser("pool").add_subparsers(dest="verb", required=True)
+    pool.add_parser("list").set_defaults(fn=pool_list)
 
     ckpt = sub.add_parser("checkpoint", aliases=["c"]).add_subparsers(
         dest="verb", required=True

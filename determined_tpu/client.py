@@ -481,6 +481,12 @@ class Determined:
     def list_agents(self) -> List[Dict[str, Any]]:
         return self._session.get("/api/v1/agents").json()
 
+    def list_resource_pools(self) -> List[Dict[str, Any]]:
+        """Declared pools (agent/kubernetes/slurm backends, ``rm.hpp``)
+        plus implicit agent pools with slot totals (reference
+        ``GetResourcePools``)."""
+        return self._session.get("/api/v1/resource-pools").json()
+
     def master_info(self) -> Dict[str, Any]:
         return self._session.get("/api/v1/master").json()
 

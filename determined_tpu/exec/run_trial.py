@@ -99,6 +99,129 @@ def _prepare_context(logger) -> None:
     logger.info("context: unpacked %d bytes into %s", len(data), workdir)
 
 
+# set by _install_log_shipper; called before the exit self-report so the
+# final lines land at the master before the trial record goes terminal
+_log_shipper_flush = None
+
+
+def _install_log_shipper() -> None:
+    """Ship this process's stdout/stderr to the master task-log API.
+
+    Agent-launched trials have the agent read their pipe and relay
+    (``native/agent/agent.cpp`` ship_logs_and_wait).  External-RM jobs
+    (kubernetes/slurm pools, ``native/master/rm.hpp``) have no agent, so
+    the trial ships its own output — the analog of the reference's
+    ``ship_logs.py`` wrapper running *inside* every task container
+    (``master/static/srv/ship_logs.py``).  fd-level dup2 so subprocess and
+    native writes are captured, not just Python-level prints.
+    """
+    master = os.environ.get("DTPU_MASTER_URL")
+    trial_id = os.environ.get("DTPU_TRIAL_ID")
+    if not master or not trial_id:
+        return
+    import select
+    import threading
+    import urllib.request
+
+    token = os.environ.get("DTPU_SESSION_TOKEN", "")
+    agent = os.environ.get("DTPU_AGENT_ID", "external")
+    url = master.rstrip("/") + "/api/v1/logs"
+
+    read_fd, write_fd = os.pipe()
+    os.dup2(write_fd, 1)
+    os.dup2(write_fd, 2)
+    os.close(write_fd)
+    sys.stdout = os.fdopen(1, "w", buffering=1, closefd=False)
+    sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+
+    batch: list = []
+    batch_lock = threading.Lock()
+
+    def post(lines) -> None:
+        body = json.dumps(
+            {"trial_id": int(trial_id), "agent": agent, "lines": lines}
+        ).encode()
+        req = urllib.request.Request(
+            url,
+            data=body,
+            headers={
+                "Authorization": f"Bearer {token}",
+                "Content-Type": "application/json",
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+        except Exception:  # noqa: BLE001 - logs are best-effort
+            pass
+
+    def flush() -> None:
+        with batch_lock:
+            lines, batch[:] = batch[:], []
+        if lines:
+            post(lines)
+
+    def pump() -> None:
+        partial = b""
+        while True:
+            ready, _, _ = select.select([read_fd], [], [], 0.5)
+            if ready:
+                try:
+                    chunk = os.read(read_fd, 8192)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                partial += chunk
+                while b"\n" in partial:
+                    line, partial = partial.split(b"\n", 1)
+                    with batch_lock:
+                        batch.append(line.decode("utf-8", "replace"))
+            with batch_lock:
+                full = len(batch) >= 64
+            if full or not ready:
+                flush()
+
+    threading.Thread(target=pump, daemon=True, name="dtpu-log-shipper").start()
+    global _log_shipper_flush
+    _log_shipper_flush = flush
+
+
+def _self_report_exit(code: int) -> None:
+    """POST this process's exit to the trials API.
+
+    Agent-launched trials get their exit reported by the agent's waitpid
+    loop; external-RM jobs report their own (the master's job-status poll
+    is only the crash safety net — ``rm.hpp`` poll_external_jobs).
+    """
+    master = os.environ.get("DTPU_MASTER_URL")
+    trial_id = os.environ.get("DTPU_TRIAL_ID")
+    if not master or not trial_id:
+        return
+    import time
+    import urllib.request
+
+    if _log_shipper_flush is not None:
+        time.sleep(0.6)  # let the pump drain fds 1/2
+        _log_shipper_flush()
+    body = json.dumps(
+        {"exit_code": code, "allocation_id": os.environ.get("DTPU_ALLOCATION_ID", "")}
+    ).encode()
+    req = urllib.request.Request(
+        master.rstrip("/") + f"/api/v1/trials/{trial_id}/exit",
+        data=body,
+        headers={
+            "Authorization": f"Bearer {os.environ.get('DTPU_SESSION_TOKEN', '')}",
+            "Content-Type": "application/json",
+        },
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+    except Exception:  # noqa: BLE001 - master poll catches silent deaths
+        pass
+
+
 class _RankPrefixStream:
     """Line-wise rank prefixer over a text stream — the analog of the
     reference's per-rank log wrapper (``launch/wrap_rank.py``), so
@@ -129,6 +252,10 @@ class _RankPrefixStream:
 
 
 def main() -> int:
+    # external-RM jobs ship their own logs; fd redirect must precede any
+    # output (and the rank prefixer, which wraps whatever stdout is)
+    if os.environ.get("DTPU_SHIP_LOGS"):
+        _install_log_shipper()
     # per-rank prefix BEFORE logging configures its handlers
     rdzv_early = os.environ.get("DTPU_RENDEZVOUS")
     if rdzv_early:
@@ -218,4 +345,21 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        _code = main()
+    except SystemExit as e:
+        # preserve sys.exit semantics: None = success, str = failure with
+        # the message on stderr (the log shipper is watching fd 2)
+        if e.code is None or isinstance(e.code, int):
+            _code = e.code or 0
+        else:
+            print(e.code, file=sys.stderr)
+            _code = 1
+    except BaseException:  # noqa: BLE001 - report the crash, then re-raise path
+        import traceback
+
+        traceback.print_exc()
+        _code = 1
+    if os.environ.get("DTPU_SELF_REPORT_EXIT"):
+        _self_report_exit(_code)
+    sys.exit(_code)

@@ -42,6 +42,7 @@
 #include "../common/json.hpp"
 #include "webui.hpp"
 #include "../common/sha256.hpp"
+#include "rm.hpp"
 #include "searcher.hpp"
 
 namespace dtpu {
@@ -62,6 +63,10 @@ struct AgentState {
   int slots = 0;
   int used_slots = 0;
   int64_t last_seen_ms = 0;
+  // provisioner bookkeeping: when this agent last held an allocation, and
+  // whether a scale-down terminate command has been issued for it
+  int64_t last_busy_ms = 0;
+  bool draining = false;
   std::deque<Json> work;  // pending launch/kill commands
 };
 
@@ -81,6 +86,13 @@ struct AllocationState {
   // allocation-scoped session token, revoked when the allocation ends so
   // orphaned processes are fenced out of the API
   std::string session_token;
+  // external-RM allocations (rm.hpp): which backend owns the job
+  // ("kubernetes"/"slurm", empty for agent pools), the pool it went to,
+  // and the backend's handle (k8s Job name / Slurm job id) once submitted
+  std::string external_kind;
+  std::string external_pool;
+  std::string external_ref;
+  int external_missing_polls = 0;  // consecutive polls the job was gone
 };
 
 struct TrialState {
@@ -269,6 +281,27 @@ class Master {
 
   void set_agent_timeout_ms(int64_t ms) { agent_timeout_ms_ = ms; }
   void set_scheduler(const std::string& mode) { scheduler_mode_ = mode; }
+
+  // declared resource pools (rm.hpp): agent pools need no declaration;
+  // kubernetes/slurm pools and provisioned agent pools are configured here
+  void set_pools(const Json& pools) {
+    for (const auto& p : pools.elements()) {
+      PoolConfig cfg = PoolConfig::parse(p);
+      if (!cfg.name.empty()) pools_[cfg.name] = cfg;
+    }
+  }
+  // where external jobs reach this master back (they have no agent to
+  // inject DTPU_MASTER_URL for them)
+  void set_advertised_url(const std::string& url) { advertised_url_ = url; }
+
+  const PoolConfig* pool_config(const std::string& name) const {
+    auto it = pools_.find(name);
+    return it == pools_.end() ? nullptr : &it->second;
+  }
+  bool is_external_pool(const std::string& name) const {
+    const PoolConfig* p = pool_config(name);
+    return p != nullptr && p->external();
+  }
 
   // Shared task teardown: release the port, fence the token, optionally
   // send the kill to the agent.  Used by DELETE /tasks, /tasks/{id}/exit,
@@ -469,20 +502,7 @@ class Master {
       int64_t eid = ev["id"].as_int();
       auto eit = experiments_.find(eid);
       if (eit != experiments_.end()) {
-        std::set<int64_t> gone;
-        for (const auto& [rid, tid] : eit->second.rid_to_trial) {
-          gone.insert(tid);
-          trials_.erase(tid);
-        }
-        // checkpoint records go with their trials (ids never recycle:
-        // orphaned records would accumulate forever)
-        for (auto cit = checkpoints_.begin(); cit != checkpoints_.end();) {
-          if (gone.count(cit->second["trial_id"].as_int())) {
-            cit = checkpoints_.erase(cit);
-          } else {
-            ++cit;
-          }
-        }
+        erase_experiment_trials(eit->second);
         experiments_.erase(eit);
       }
     } else if (type == "trial_seed_checkpoint") {
@@ -1250,7 +1270,7 @@ class Master {
     };
     AgentState* best = nullptr;
     for (auto& [aid, ag] : agents_) {
-      if (ag.pool != pool || excluded.count(aid)) continue;
+      if (ag.pool != pool || excluded.count(aid) || ag.draining) continue;
       int free = free_of(ag);
       if (free >= needed && (best == nullptr || free < free_of(*best))) {
         best = &ag;
@@ -1261,7 +1281,9 @@ class Master {
     int remaining = needed;
     std::vector<AgentState*> by_free;
     for (auto& [aid, ag] : agents_) {
-      if (ag.pool == pool && !excluded.count(aid)) by_free.push_back(&ag);
+      if (ag.pool == pool && !excluded.count(aid) && !ag.draining) {
+        by_free.push_back(&ag);
+      }
     }
     std::sort(by_free.begin(), by_free.end(),
               [&](AgentState* a, AgentState* b) { return free_of(*a) > free_of(*b); });
@@ -1287,11 +1309,80 @@ class Master {
   // returns to PENDING without burning a restart and resumes later from
   // its checkpoint).
   void schedule() {
+    schedule_external();
     if (scheduler_mode_ == "fair_share") {
       schedule_fair_share();
     } else {
       schedule_priority();
     }
+  }
+
+  // External pools (kubernetes/slurm, rm.hpp): the external system owns
+  // queueing and placement — every pending trial is handed off
+  // immediately, exactly the reference kubernetesrm/dispatcherrm split
+  // (they build Jobs / batch scripts and let k8s / Slurm schedule them).
+  void schedule_external() {
+    for (auto& [tid, t] : trials_) {
+      if (t.state != "PENDING") continue;
+      auto eit = experiments_.find(t.experiment_id);
+      if (eit == experiments_.end() || eit->second.state != "ACTIVE") continue;
+      ExperimentState& exp = eit->second;
+      if (exp.unmanaged) continue;
+      const PoolConfig* pool = pool_config(exp.resource_pool);
+      if (pool == nullptr || !pool->external()) continue;
+      place_external(tid, t, exp, *pool);
+    }
+  }
+
+  void place_external(int64_t tid, TrialState& t, ExperimentState& exp,
+                      const PoolConfig& pool) {
+    std::string alloc_id = "alloc-" + std::to_string(next_allocation_id_++);
+    AllocationState alloc;
+    alloc.id = alloc_id;
+    alloc.trial_id = tid;
+    alloc.external_kind = pool.type;
+    alloc.external_pool = pool.name;
+    std::string session_token = issue_token(exp.owner);
+    alloc.session_token = session_token;
+    allocations_[alloc_id] = alloc;
+    t.allocation_id = alloc_id;
+    t.state = "RUNNING";
+
+    Json env = Json::object();
+    env.set("DTPU_MASTER_URL", advertised_url_);
+    env.set("DTPU_SESSION_TOKEN", session_token);
+    env.set("DTPU_TRIAL_ID", std::to_string(tid));
+    env.set("DTPU_EXPERIMENT_ID", std::to_string(t.experiment_id));
+    env.set("DTPU_ALLOCATION_ID", alloc_id);
+    env.set("DTPU_HPARAMS", t.hparams.dump());
+    env.set("DTPU_EXP_CONFIG", exp.config.dump());
+    env.set("DTPU_TRIAL_SEED",
+            std::to_string(
+                exp.config["reproducibility"]["experiment_seed"].as_int(0) + tid));
+    env.set("DTPU_TRIAL_RUN_ID", std::to_string(t.run_id));
+    env.set("DTPU_NUM_SLOTS", std::to_string(exp.slots_per_trial));
+    if (!t.latest_checkpoint.empty()) {
+      env.set("DTPU_LATEST_CHECKPOINT", t.latest_checkpoint);
+    }
+    if (std::filesystem::exists(context_path(exp.id))) {
+      env.set("DTPU_CONTEXT_URL",
+              "/api/v1/experiments/" + std::to_string(exp.id) + "/context");
+    }
+    // no agent relays for external jobs: the harness ships its own logs
+    // and reports its own exit (reference: ship_logs.py inside the pod)
+    env.set("DTPU_AGENT_ID", pool.type + ":" + pool.name);
+    env.set("DTPU_SHIP_LOGS", "1");
+    env.set("DTPU_SELF_REPORT_EXIT", "1");
+
+    ExternalOp op;
+    op.kind = "launch";
+    op.alloc_id = alloc_id;
+    op.pool = pool.name;
+    op.entrypoint = exp.config["entrypoint"].as_string();
+    op.env = env;
+    op.slots = exp.slots_per_trial;
+    ext_ops_.push_back(std::move(op));
+    ext_cv_.notify_all();
   }
 
   // Fair-share scheduler (reference fair_share.go:52-400, redesigned
@@ -1322,6 +1413,7 @@ class Master {
         if (eit == experiments_.end() || eit->second.state != "ACTIVE") continue;
         ExperimentState& e = eit->second;
         if (e.unmanaged || e.resource_pool != pool) continue;
+        if (is_external_pool(pool)) continue;  // k8s/slurm own placement
         Demand& d = demand[e.id];
         d.weight = e.weight;
         if (t.state == "RUNNING" && !t.sched_preempted) {
@@ -1416,6 +1508,7 @@ class Master {
       auto eit = experiments_.find(t.experiment_id);
       if (eit == experiments_.end() || eit->second.state != "ACTIVE") continue;
       if (eit->second.unmanaged) continue;  // user process runs it
+      if (is_external_pool(eit->second.resource_pool)) continue;  // k8s/slurm own it
       pending.push_back({eit->second.priority, tid});
     }
     std::sort(pending.begin(), pending.end());
@@ -1506,6 +1599,7 @@ class Master {
       for (auto& [aid, slots] : groups) {
         AgentState& ag = agents_[aid];
         ag.used_slots += slots;
+        ag.last_busy_ms = now_ms();
         Json env = Json::object();
         env.set("DTPU_SESSION_TOKEN", session_token);
         env.set("DTPU_TRIAL_ID", std::to_string(tid));
@@ -1557,6 +1651,29 @@ class Master {
     preempt_cv_.notify_all();
   }
 
+  // Erase an experiment's trial records, their checkpoint records (ids
+  // never recycle: orphaned records would accumulate forever), and their
+  // per-trial jsonl state.  Shared by DELETE /experiments and the
+  // exp_deleted replay so live and replay behavior cannot diverge; the
+  // file removals are idempotent no-ops on replay.
+  void erase_experiment_trials(const ExperimentState& exp) {
+    std::error_code ec;
+    std::set<int64_t> gone;
+    for (const auto& [rid, tid] : exp.rid_to_trial) {
+      std::filesystem::remove(logs_path(tid), ec);
+      std::filesystem::remove(metrics_path(tid), ec);
+      gone.insert(tid);
+      trials_.erase(tid);
+    }
+    for (auto cit = checkpoints_.begin(); cit != checkpoints_.end();) {
+      if (gone.count(cit->second["trial_id"].as_int())) {
+        cit = checkpoints_.erase(cit);
+      } else {
+        ++cit;
+      }
+    }
+  }
+
   void end_allocation(const std::string& alloc_id) {
     auto it = allocations_.find(alloc_id);
     if (it == allocations_.end()) return;
@@ -1566,6 +1683,7 @@ class Master {
       auto ait = agents_.find(aid);
       if (ait != agents_.end()) {
         ait->second.used_slots = std::max(0, ait->second.used_slots - slots);
+        ait->second.last_busy_ms = now_ms();  // idle clock starts now
       }
     }
     if (it->second.coord_port) {
@@ -1578,6 +1696,15 @@ class Master {
   }
 
   void kill_allocation(AllocationState& alloc) {
+    if (!alloc.external_kind.empty()) {
+      ExternalOp op;
+      op.kind = "kill";
+      op.alloc_id = alloc.id;
+      op.pool = alloc.external_pool;
+      ext_ops_.push_back(std::move(op));
+      ext_cv_.notify_all();
+      return;
+    }
     for (auto& [aid, slots] : alloc.groups) {
       auto ait = agents_.find(aid);
       if (ait == agents_.end()) continue;
@@ -1710,6 +1837,277 @@ class Master {
   std::condition_variable preempt_cv_;
   std::condition_variable events_cv_;
 
+  // ---- external-RM worker (rm.hpp backends) ------------------------------
+  //
+  // All backend I/O (k8s apiserver HTTP, sbatch/squeue subprocesses,
+  // provisioner commands) happens on this thread with mu_ RELEASED —
+  // a slow apiserver must never stall the request path.  Queue ops are
+  // FIFO, so a kill for an allocation always executes after its launch
+  // (the launch is what learns the backend's job handle).
+  void run_external_worker() {
+    using namespace std::chrono_literals;
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+      ext_cv_.wait_for(lk, 2s, [&] { return !ext_ops_.empty(); });
+      while (!ext_ops_.empty()) {
+        ExternalOp op = std::move(ext_ops_.front());
+        ext_ops_.pop_front();
+        execute_external_op(lk, op);
+      }
+      poll_external_jobs(lk);
+      provision_tick(lk);
+    }
+  }
+
+ private:
+  struct ExternalOp {
+    std::string kind;  // "launch" | "kill"
+    std::string alloc_id;
+    std::string pool;
+    std::string entrypoint;  // launch only
+    Json env;                // launch only
+    int slots = 1;           // launch only
+  };
+
+  // caller holds lk; released around backend I/O
+  void execute_external_op(std::unique_lock<std::mutex>& lk, const ExternalOp& op) {
+    auto pit = pools_.find(op.pool);
+    if (pit == pools_.end()) return;
+    PoolConfig pool = pit->second;  // copy: used outside the lock
+    auto ait = allocations_.find(op.alloc_id);
+    if (ait == allocations_.end()) return;
+    int64_t tid = ait->second.trial_id;
+    std::string ref = ait->second.external_ref;
+
+    if (op.kind == "launch") {
+      std::string job_name = op.alloc_id;  // deterministic: k8s job = alloc id
+      std::string err, slurm_id;
+      bool ok = false;
+      lk.unlock();
+      if (pool.type == "kubernetes") {
+        ok = KubernetesBackend::submit(pool, job_name, op.entrypoint, op.env,
+                                       op.slots, &err);
+        slurm_id = job_name;
+      } else if (pool.type == "slurm") {
+        ok = SlurmBackend::submit(pool, op.alloc_id, op.entrypoint, op.env,
+                                  op.slots, &slurm_id, &err);
+      }
+      lk.lock();
+      auto it = allocations_.find(op.alloc_id);
+      if (it == allocations_.end() || it->second.ended) {
+        // killed while we were submitting: reap what we just started
+        if (ok) enqueue_external_remove(pool, slurm_id);
+        return;
+      }
+      if (!ok) {
+        append_jsonl(logs_path(tid),
+                     Json::object()
+                         .set("ts", Json(now_ms()))
+                         .set("level", "ERROR")
+                         .set("line", pool.type + " submit failed: " + err));
+        on_trial_exit(tid, /*exit_code=*/125);
+        return;
+      }
+      it->second.external_ref = slurm_id;
+    } else if (op.kind == "kill") {
+      if (ref.empty()) return;  // launch failed; nothing to kill
+      lk.unlock();
+      if (pool.type == "kubernetes") {
+        KubernetesBackend::remove(pool, ref);
+      } else if (pool.type == "slurm") {
+        SlurmBackend::cancel(pool, ref);
+      }
+      lk.lock();
+    }
+  }
+
+  // best-effort cleanup of a job whose allocation died mid-submit;
+  // caller holds the lock, removal runs on the next worker pass
+  void enqueue_external_remove(const PoolConfig& pool, const std::string& ref) {
+    lingering_external_.push_back({pool.name, ref});
+  }
+
+  // Crash safety net: the harness self-reports exits, but a pod that is
+  // OOM-killed or a Slurm job that hits its wall never gets to.  Poll the
+  // backend for every live external allocation and fail trials whose job
+  // died silently (reference kubernetesrm informers / dispatcherrm
+  // monitor loop, redesigned as a poll because the master is the only
+  // writer here).  Caller holds lk; released around backend I/O.
+  void poll_external_jobs(std::unique_lock<std::mutex>& lk) {
+    struct Probe {
+      std::string alloc_id;
+      std::string pool;
+      std::string ref;
+      std::string kind;
+      bool ended;
+    };
+    std::vector<Probe> probes;
+    for (auto& [alloc_id, alloc] : allocations_) {
+      if (alloc.external_kind.empty() || alloc.external_ref.empty()) continue;
+      probes.push_back({alloc_id, alloc.external_pool, alloc.external_ref,
+                        alloc.external_kind, alloc.ended});
+    }
+    for (auto& [pool_name, ref] : lingering_external_) {
+      probes.push_back({"", pool_name, ref, "", true});
+    }
+    lingering_external_.clear();
+    if (probes.empty()) return;
+    std::map<std::string, PoolConfig> pools = pools_;  // copy for off-lock use
+
+    struct Result {
+      std::string alloc_id;
+      ExternalJobState state;
+      int exit_code;
+    };
+    std::vector<Result> results;
+    lk.unlock();
+    for (auto& p : probes) {
+      {
+        // a queued launch/kill outranks status probes (each probe can
+        // block up to its backend timeout); finish them next pass
+        std::lock_guard<std::mutex> g(mu_);
+        if (!ext_ops_.empty()) break;
+      }
+      auto pit = pools.find(p.pool);
+      if (pit == pools.end()) continue;
+      const PoolConfig& pool = pit->second;
+      if (p.ended) {
+        // allocation over (self-reported exit or mid-submit kill): delete
+        // the completed k8s Job object / scancel the slurm job (a no-op
+        // for jobs that already finished, but the only kill a mid-submit
+        // cancellation ever gets — the queued kill op saw no ref yet)
+        if (pool.type == "kubernetes") {
+          KubernetesBackend::remove(pool, p.ref);
+        } else if (pool.type == "slurm") {
+          SlurmBackend::cancel(pool, p.ref);
+        }
+        results.push_back({p.alloc_id, ExternalJobState::kGone, 0});
+        continue;
+      }
+      int exit_code = 1;
+      ExternalJobState st = ExternalJobState::kRunning;
+      if (pool.type == "kubernetes") {
+        st = KubernetesBackend::status(pool, p.ref, &exit_code);
+      } else if (pool.type == "slurm") {
+        st = SlurmBackend::status(pool, p.ref);
+      }
+      results.push_back({p.alloc_id, st, exit_code});
+    }
+    lk.lock();
+    for (auto& r : results) {
+      auto ait = allocations_.find(r.alloc_id);
+      if (ait == allocations_.end()) continue;
+      AllocationState& alloc = ait->second;
+      if (alloc.ended) {
+        alloc.external_ref.clear();  // cleanup issued above; stop polling it
+        continue;
+      }
+      auto tit = trials_.find(alloc.trial_id);
+      if (tit == trials_.end() || tit->second.allocation_id != r.alloc_id ||
+          tit->second.state != "RUNNING") {
+        continue;
+      }
+      switch (r.state) {
+        case ExternalJobState::kRunning:
+          alloc.external_missing_polls = 0;
+          break;
+        case ExternalJobState::kSucceeded:
+          on_trial_exit(alloc.trial_id, 0);
+          break;
+        case ExternalJobState::kFailed:
+          on_trial_exit(alloc.trial_id, r.exit_code == 0 ? 1 : r.exit_code);
+          break;
+        case ExternalJobState::kGone:
+          // the self-report usually lands first; two consecutive gone
+          // polls with no exit means the job evaporated (node death,
+          // scancel outside the master, admin delete)
+          if (++alloc.external_missing_polls >= 2) {
+            append_jsonl(logs_path(alloc.trial_id),
+                         Json::object()
+                             .set("ts", Json(now_ms()))
+                             .set("level", "ERROR")
+                             .set("line", alloc.external_kind + " job " +
+                                              alloc.external_ref +
+                                              " disappeared; failing allocation"));
+            on_trial_exit(alloc.trial_id, 102);
+          }
+          break;
+      }
+    }
+  }
+
+  // Agent-pool autoscaling (reference rm/agentrm/provisioner/scaling.go:
+  // desired size from pending demand; here the cloud API is abstracted
+  // behind launch/terminate commands).  Caller holds lk; commands run
+  // detached so a hung cloud CLI cannot stall the worker.
+  void provision_tick(std::unique_lock<std::mutex>& lk) {
+    int64_t now = now_ms();
+    std::vector<std::string> cmds;
+    for (auto& [pool_name, pool] : pools_) {
+      if (!pool.has_provisioner || pool.external()) continue;
+      const ProvisionerConfig& pv = pool.provisioner;
+      int count = 0;
+      for (auto& [aid, ag] : agents_) {
+        if (ag.pool == pool_name && !ag.draining) ++count;
+      }
+      // demand: any PENDING trial in this pool that currently has no fit
+      bool unmet = false;
+      for (auto& [tid, t] : trials_) {
+        if (t.state != "PENDING") continue;
+        auto eit = experiments_.find(t.experiment_id);
+        if (eit == experiments_.end() || eit->second.state != "ACTIVE") continue;
+        ExperimentState& exp = eit->second;
+        if (exp.unmanaged || exp.resource_pool != pool_name) continue;
+        if (find_fit(pool_name, exp.slots_per_trial, exp.single_slice, {},
+                     t.excluded_agents)
+                .empty()) {
+          unmet = true;
+          break;
+        }
+      }
+      int64_t last = pool_last_launch_ms_[pool_name];
+      if ((unmet || count < pv.min_agents) && count < pv.max_agents &&
+          now - last >= pv.launch_cooldown_sec * 1000 &&
+          !pv.launch_cmd.empty()) {
+        pool_last_launch_ms_[pool_name] = now;
+        cmds.push_back("DTPU_POOL=" + rm_detail::shell_quote(pool_name) + " " +
+                       pv.launch_cmd);
+        printf("master: provisioner launching agent for pool %s (%d/%d)\n",
+               pool_name.c_str(), count, pv.max_agents);
+        fflush(stdout);
+      }
+      // scale down: idle past the grace window and above the floor
+      if (count > pv.min_agents && !pv.terminate_cmd.empty()) {
+        for (auto& [aid, ag] : agents_) {
+          if (ag.pool != pool_name || ag.draining || ag.used_slots > 0) continue;
+          if (ag.last_busy_ms == 0 ||
+              now - ag.last_busy_ms < pv.idle_grace_sec * 1000) {
+            continue;
+          }
+          ag.draining = true;
+          cmds.push_back("DTPU_AGENT_ID=" + rm_detail::shell_quote(aid) +
+                         " DTPU_POOL=" + rm_detail::shell_quote(pool_name) + " " +
+                         pv.terminate_cmd);
+          printf("master: provisioner draining idle agent %s\n", aid.c_str());
+          fflush(stdout);
+          if (--count <= pv.min_agents) break;
+        }
+      }
+    }
+    if (cmds.empty()) return;
+    lk.unlock();
+    for (const auto& cmd : cmds) {
+      std::thread([cmd] { (void)std::system(cmd.c_str()); }).detach();
+    }
+    lk.lock();
+  }
+
+  std::deque<ExternalOp> ext_ops_;
+  std::condition_variable ext_cv_;
+  std::vector<std::pair<std::string, std::string>> lingering_external_;
+
+ public:
+
  private:
   std::string state_dir_;
   std::string checkpoint_dir_;
@@ -1736,6 +2134,9 @@ class Master {
   std::map<std::string, UserState> users_;
   std::map<std::string, TokenInfo> tokens_;
   std::map<std::string, Json> models_;         // registry: name -> model
+  std::map<std::string, PoolConfig> pools_;    // declared pools (rm.hpp)
+  std::string advertised_url_ = "http://127.0.0.1:8080";
+  std::map<std::string, int64_t> pool_last_launch_ms_;  // provisioner cooldown
   std::map<std::string, Json> templates_;      // config templates (reference templates/)
   std::map<int64_t, WebhookState> webhooks_;
   int64_t next_webhook_id_ = 1;
@@ -2264,22 +2665,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     m.delete_checkpoints(pool, storage, uuids, trace_dirs);
     m.record(Json::object().set("type", "exp_deleted").set("id", Json(eid)));
     std::error_code ec;
-    std::set<int64_t> gone;
-    for (const auto& [rid, tid] : exp.rid_to_trial) {
-      // per-trial jsonl state goes with the records (ids never recycle,
-      // so leftover files would accumulate forever)
-      std::filesystem::remove(m.logs_path(tid), ec);
-      std::filesystem::remove(m.metrics_path(tid), ec);
-      gone.insert(tid);
-      m.trials_.erase(tid);
-    }
-    for (auto cit = m.checkpoints_.begin(); cit != m.checkpoints_.end();) {
-      if (gone.count(cit->second["trial_id"].as_int())) {
-        cit = m.checkpoints_.erase(cit);
-      } else {
-        ++cit;
-      }
-    }
+    m.erase_experiment_trials(exp);
     m.experiments_.erase(it);
     std::filesystem::remove(m.context_path(eid), ec);
     return R::json("{}");
@@ -2679,6 +3065,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     if (body["slot_type"].is_string()) ag.slot_type = body["slot_type"].as_string();
     if (fresh) ag.used_slots = 0;
     ag.last_seen_ms = now_ms();
+    // idle clock starts at registration — last_seen_ms is refreshed by
+    // every work long-poll, so it can never be the provisioner's idle
+    // baseline (a never-used agent would look busy forever)
+    if (ag.last_busy_ms == 0) ag.last_busy_ms = now_ms();
     m.schedule();
     return R::json("{\"registered\":true}");
   }));
@@ -2696,6 +3086,43 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       j.set("used_slots", Json(ag.used_slots));
       out.push_back(j);
     }
+    return R::json(out.dump());
+  }));
+
+  // resource pools: declared backends (rm.hpp) plus implicit agent pools
+  // (reference GetResourcePools; the `type` field is the multirm routing)
+  srv.route("GET", "/api/v1/resource-pools", authed([&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    std::map<std::string, Json> pools;
+    for (const auto& [name, cfg] : m.pools_) {
+      Json j = Json::object();
+      j.set("name", name);
+      j.set("type", cfg.type);
+      j.set("provisioned", Json(cfg.has_provisioner));
+      j.set("slots", Json(int64_t{0}));
+      j.set("used_slots", Json(int64_t{0}));
+      j.set("agents", Json(int64_t{0}));
+      pools[name] = j;
+    }
+    for (const auto& [id, ag] : m.agents_) {
+      auto it = pools.find(ag.pool);
+      if (it == pools.end()) {
+        Json j = Json::object();
+        j.set("name", ag.pool);
+        j.set("type", "agent");
+        j.set("provisioned", Json(false));
+        j.set("slots", Json(int64_t{0}));
+        j.set("used_slots", Json(int64_t{0}));
+        j.set("agents", Json(int64_t{0}));
+        it = pools.emplace(ag.pool, j).first;
+      }
+      Json& j = it->second;
+      j.set("slots", Json(j["slots"].as_int(0) + ag.slots));
+      j.set("used_slots", Json(j["used_slots"].as_int(0) + ag.used_slots));
+      j.set("agents", Json(j["agents"].as_int(0) + 1));
+    }
+    Json out = Json::array();
+    for (auto& [name, j] : pools) out.push_back(j);
     return R::json(out.dump());
   }));
 
@@ -3302,6 +3729,8 @@ int main(int argc, char** argv) {
   int log_retention_days = 0;
   int agent_timeout_sec = 90;
   std::string scheduler = "priority";
+  std::string pools_file;
+  std::string advertised_url;
   for (int i = 1; i < argc; ++i) {
     std::string arg = argv[i];
     auto next = [&](const char* name) -> std::string {
@@ -3318,6 +3747,8 @@ int main(int argc, char** argv) {
     else if (arg == "--agent-timeout-sec")
       agent_timeout_sec = std::atoi(next("--agent-timeout-sec").c_str());
     else if (arg == "--scheduler") scheduler = next("--scheduler");
+    else if (arg == "--pools") pools_file = next("--pools");
+    else if (arg == "--advertised-url") advertised_url = next("--advertised-url");
     else if (arg == "--simulate") {
       std::string cfg = next("--simulate");
       uint64_t seed = 0;
@@ -3341,6 +3772,18 @@ int main(int argc, char** argv) {
     return 2;
   }
   master.set_scheduler(scheduler);
+  if (!pools_file.empty()) {
+    std::ifstream in(pools_file);
+    std::ostringstream data;
+    data << in.rdbuf();
+    dtpu::Json pools;
+    if (!in || !dtpu::Json::try_parse(data.str(), &pools) || !pools.is_array()) {
+      fprintf(stderr, "--pools %s: unreadable or not a JSON array\n",
+              pools_file.c_str());
+      return 2;
+    }
+    master.set_pools(pools);
+  }
   master.boot();
   dtpu::HttpServer srv;
   master.install_routes(srv);
@@ -3349,6 +3792,10 @@ int main(int argc, char** argv) {
     fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);
     return 1;
   }
+  master.set_advertised_url(advertised_url.empty()
+                                ? "http://127.0.0.1:" + std::to_string(bound)
+                                : advertised_url);
+  std::thread([&master] { master.run_external_worker(); }).detach();
   printf("dtpu-master listening on %s:%d (state: %s)\n", host.c_str(), bound,
          state_dir.c_str());
   fflush(stdout);

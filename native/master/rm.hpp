@@ -1,0 +1,320 @@
+// External resource-manager backends for the dtpu master.
+//
+// The reference runs four resource managers behind one interface
+// (master/internal/rm/resource_manager_iface.go:14-67):
+//   - agentrm   (rm/agentrm/)      — its own agents + schedulers
+//   - kubernetesrm (rm/kubernetesrm/) — delegates placement to k8s Jobs
+//   - dispatcherrm (rm/dispatcherrm/) — delegates to Slurm via a launcher
+//   - multirm   (rm/multirm/)      — routes by resource pool to named RMs
+//
+// TPU-native redesign: the routing unit is the *resource pool*.  Every
+// pool row in the master's --pools config names its backend type; agent
+// pools keep the in-master gang scheduler (master.cpp), while kubernetes
+// and slurm pools hand each trial to the external system, which owns
+// queueing and placement (exactly the reference's split: kubernetesrm
+// builds Jobs and lets the k8s scheduler place them, dispatcherrm submits
+// batch scripts and lets Slurm queue them).  Two kubernetes pools may
+// point at different apiservers — that is multirm's multi-cluster case
+// with no extra machinery.
+//
+// Trials launched through an external backend self-report exits and ship
+// their own logs (DTPU_SELF_REPORT_EXIT / DTPU_SHIP_LOGS in
+// exec/run_trial.py) — the analog of the reference's ship_logs.py running
+// *inside* the k8s pod (master/static/srv/ship_logs.py), where no agent
+// exists to relay for them.  The master polls job status as the crash
+// safety net.
+
+#pragma once
+
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "../common/http.hpp"
+#include "../common/json.hpp"
+
+namespace dtpu {
+
+// Agent-pool autoscaling (reference rm/agentrm/provisioner/: AWS/GCP
+// instance launch from scaling.go pending-task calc).  Cloud specifics
+// live behind two commands so the same loop drives GCE, a test script, or
+// any future provider.
+struct ProvisionerConfig {
+  std::string launch_cmd;     // run with DTPU_POOL set; must start an agent
+  std::string terminate_cmd;  // run with DTPU_AGENT_ID + DTPU_POOL set
+  int min_agents = 0;
+  int max_agents = 1;
+  int idle_grace_sec = 300;   // scale down agents idle this long
+  int launch_cooldown_sec = 5;  // min spacing between launches per pool
+};
+
+struct PoolConfig {
+  std::string name;
+  std::string type = "agent";  // agent | kubernetes | slurm
+
+  // kubernetes backend
+  std::string k8s_api;                  // e.g. http://127.0.0.1:6443
+  std::string k8s_namespace = "default";
+  std::string k8s_token;                // serviceaccount bearer token
+  std::string k8s_image = "determined-tpu:latest";
+
+  // slurm backend (binaries overridable for tests / site wrappers)
+  std::string slurm_sbatch = "sbatch";
+  std::string slurm_squeue = "squeue";
+  std::string slurm_scancel = "scancel";
+  std::string slurm_partition;
+  std::string slurm_spool = "/tmp/dtpu-slurm";
+
+  bool has_provisioner = false;
+  ProvisionerConfig provisioner;
+
+  bool external() const { return type == "kubernetes" || type == "slurm"; }
+
+  static PoolConfig parse(const Json& j) {
+    PoolConfig p;
+    p.name = j["name"].as_string();
+    if (j["type"].is_string()) p.type = j["type"].as_string();
+    const Json& k = j["kubernetes"];
+    if (k.is_object()) {
+      p.k8s_api = k["apiserver"].as_string();
+      if (k["namespace"].is_string()) p.k8s_namespace = k["namespace"].as_string();
+      if (k["token"].is_string()) p.k8s_token = k["token"].as_string();
+      if (k["image"].is_string()) p.k8s_image = k["image"].as_string();
+    }
+    const Json& s = j["slurm"];
+    if (s.is_object()) {
+      if (s["sbatch"].is_string()) p.slurm_sbatch = s["sbatch"].as_string();
+      if (s["squeue"].is_string()) p.slurm_squeue = s["squeue"].as_string();
+      if (s["scancel"].is_string()) p.slurm_scancel = s["scancel"].as_string();
+      if (s["partition"].is_string()) p.slurm_partition = s["partition"].as_string();
+      if (s["spool_dir"].is_string()) p.slurm_spool = s["spool_dir"].as_string();
+    }
+    const Json& pv = j["provisioner"];
+    if (pv.is_object()) {
+      p.has_provisioner = true;
+      p.provisioner.launch_cmd = pv["launch_cmd"].as_string();
+      p.provisioner.terminate_cmd = pv["terminate_cmd"].as_string();
+      p.provisioner.min_agents = static_cast<int>(pv["min_agents"].as_int(0));
+      p.provisioner.max_agents = static_cast<int>(pv["max_agents"].as_int(1));
+      p.provisioner.idle_grace_sec =
+          static_cast<int>(pv["idle_grace_sec"].as_int(300));
+      p.provisioner.launch_cooldown_sec =
+          static_cast<int>(pv["launch_cooldown_sec"].as_int(5));
+    }
+    return p;
+  }
+};
+
+// lifecycle report from a backend poll
+enum class ExternalJobState { kRunning, kSucceeded, kFailed, kGone };
+
+namespace rm_detail {
+
+inline bool split_url(const std::string& url, std::string* host, int* port) {
+  // accepts http://host:port (the only scheme the in-cluster path needs;
+  // TLS terminates at a local kubectl proxy / gateway, as the reference's
+  // dispatcherrm does with its launcher service)
+  const std::string prefix = "http://";
+  if (url.rfind(prefix, 0) != 0) return false;
+  std::string rest = url.substr(prefix.size());
+  auto slash = rest.find('/');
+  if (slash != std::string::npos) rest = rest.substr(0, slash);
+  auto colon = rest.find(':');
+  if (colon == std::string::npos) {
+    *host = rest;
+    *port = 80;
+  } else {
+    *host = rest.substr(0, colon);
+    *port = std::atoi(rest.c_str() + colon + 1);
+  }
+  return !host->empty() && *port > 0;
+}
+
+inline std::string shell_quote(const std::string& s) {
+  std::string out = "'";
+  for (char c : s) {
+    if (c == '\'') out += "'\\''";
+    else out += c;
+  }
+  out += "'";
+  return out;
+}
+
+inline std::string run_capture(const std::string& cmd) {
+  std::string out;
+  FILE* f = popen((cmd + " 2>/dev/null").c_str(), "r");
+  if (!f) return out;
+  char buf[4096];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, n);
+  pclose(f);
+  return out;
+}
+
+}  // namespace rm_detail
+
+// ---- kubernetes backend ---------------------------------------------------
+
+class KubernetesBackend {
+ public:
+  // POST a batch/v1 Job whose pod runs the trial entrypoint.  Placement,
+  // queueing, and retries-on-node-failure belong to k8s (backoffLimit 0:
+  // the master's own restart policy owns retries, reference kubernetesrm
+  // sets the same).
+  static bool submit(const PoolConfig& pool, const std::string& job_name,
+                     const std::string& entrypoint, const Json& env, int slots,
+                     std::string* err) {
+    Json env_list = Json::array();
+    for (const auto& [k, v] : env.items()) {
+      env_list.push_back(Json::object().set("name", k).set("value", v));
+    }
+    Json container = Json::object()
+                         .set("name", "trial")
+                         .set("image", pool.k8s_image)
+                         .set("env", env_list);
+    Json cmd = Json::array();
+    for (const std::string& c :
+         {std::string("python"), std::string("-m"),
+          std::string("determined_tpu.exec.run_trial"), entrypoint}) {
+      cmd.push_back(c);
+    }
+    container.set("command", cmd);
+    // TPU chips are a k8s extended resource on TPU VMs' device plugin
+    container.set(
+        "resources",
+        Json::object().set(
+            "limits", Json::object().set("google.com/tpu",
+                                         Json(static_cast<int64_t>(slots)))));
+    Json pod_spec = Json::object().set("restartPolicy", "Never");
+    Json containers = Json::array();
+    containers.push_back(container);
+    pod_spec.set("containers", containers);
+    Json job = Json::object()
+                   .set("apiVersion", "batch/v1")
+                   .set("kind", "Job")
+                   .set("metadata", Json::object().set("name", job_name))
+                   .set("spec", Json::object()
+                                    .set("backoffLimit", Json(int64_t{0}))
+                                    .set("template",
+                                         Json::object().set("spec", pod_spec)));
+    auto resp = api(pool, "POST", jobs_path(pool), job.dump());
+    if (resp.status < 200 || resp.status >= 300) {
+      *err = "k8s job create failed (" + std::to_string(resp.status) + ") " +
+             resp.body.substr(0, 200);
+      return false;
+    }
+    return true;
+  }
+
+  static ExternalJobState status(const PoolConfig& pool,
+                                 const std::string& job_name, int* exit_code) {
+    auto resp = api(pool, "GET", jobs_path(pool) + "/" + job_name, "");
+    if (resp.status == 404) return ExternalJobState::kGone;
+    if (resp.status < 200 || resp.status >= 300) {
+      // apiserver unreachable: report running; the poll retries
+      return ExternalJobState::kRunning;
+    }
+    Json j;
+    if (!Json::try_parse(resp.body, &j)) return ExternalJobState::kRunning;
+    const Json& st = j["status"];
+    if (st["succeeded"].as_int(0) > 0) {
+      *exit_code = 0;
+      return ExternalJobState::kSucceeded;
+    }
+    if (st["failed"].as_int(0) > 0) {
+      *exit_code = static_cast<int>(st["exitCode"].as_int(1));
+      return ExternalJobState::kFailed;
+    }
+    return ExternalJobState::kRunning;
+  }
+
+  static void remove(const PoolConfig& pool, const std::string& job_name) {
+    api(pool, "DELETE", jobs_path(pool) + "/" + job_name, "");
+  }
+
+ private:
+  static std::string jobs_path(const PoolConfig& pool) {
+    return "/apis/batch/v1/namespaces/" + pool.k8s_namespace + "/jobs";
+  }
+
+  static ClientResponse api(const PoolConfig& pool, const std::string& method,
+                            const std::string& path, const std::string& body) {
+    std::string host;
+    int port = 0;
+    if (!rm_detail::split_url(pool.k8s_api, &host, &port)) {
+      ClientResponse r;
+      r.status = 0;
+      return r;
+    }
+    std::vector<std::pair<std::string, std::string>> headers;
+    if (!pool.k8s_token.empty()) {
+      headers.push_back({"Authorization", "Bearer " + pool.k8s_token});
+    }
+    headers.push_back({"Content-Type", "application/json"});
+    return http_request(host, port, method, path, body, 10, headers);
+  }
+};
+
+// ---- slurm backend --------------------------------------------------------
+
+class SlurmBackend {
+ public:
+  // Write a batch script and sbatch it; returns the Slurm job id.  The
+  // reference dispatcherrm goes through HPE's launcher REST service; on a
+  // TPU site the site-local sbatch wrapper is the equivalent seam (and the
+  // test seam: tests point slurm_sbatch at a stub).
+  static bool submit(const PoolConfig& pool, const std::string& alloc_id,
+                     const std::string& entrypoint, const Json& env, int slots,
+                     std::string* job_id, std::string* err) {
+    std::error_code ec;
+    std::filesystem::create_directories(pool.slurm_spool, ec);
+    std::string script_path = pool.slurm_spool + "/" + alloc_id + ".sh";
+    {
+      std::ofstream sh(script_path, std::ios::trunc);
+      sh << "#!/bin/bash\n";
+      sh << "#SBATCH --job-name=" << alloc_id << "\n";
+      if (!pool.slurm_partition.empty()) {
+        sh << "#SBATCH --partition=" << pool.slurm_partition << "\n";
+      }
+      sh << "#SBATCH --gres=tpu:" << slots << "\n";
+      for (const auto& [k, v] : env.items()) {
+        sh << "export " << k << "=" << rm_detail::shell_quote(v.as_string())
+           << "\n";
+      }
+      sh << "exec python -m determined_tpu.exec.run_trial "
+         << rm_detail::shell_quote(entrypoint) << "\n";
+    }
+    std::filesystem::permissions(script_path,
+                                 std::filesystem::perms::owner_all, ec);
+    std::string out = rm_detail::run_capture(
+        pool.slurm_sbatch + " " + rm_detail::shell_quote(script_path));
+    // "Submitted batch job 12345"
+    auto pos = out.find_last_of(' ');
+    std::string id =
+        pos == std::string::npos ? "" : out.substr(pos + 1);
+    while (!id.empty() && (id.back() == '\n' || id.back() == '\r')) id.pop_back();
+    if (id.empty() || id.find_first_not_of("0123456789") != std::string::npos) {
+      *err = "sbatch did not return a job id: " + out.substr(0, 200);
+      return false;
+    }
+    *job_id = id;
+    return true;
+  }
+
+  static ExternalJobState status(const PoolConfig& pool,
+                                 const std::string& job_id) {
+    std::string out = rm_detail::run_capture(
+        pool.slurm_squeue + " -h -j " + rm_detail::shell_quote(job_id));
+    bool listed = out.find_first_not_of(" \t\r\n") != std::string::npos;
+    // squeue says nothing about exit codes; the harness self-reports the
+    // real code, the poll only notices disappearance (crash safety net)
+    return listed ? ExternalJobState::kRunning : ExternalJobState::kGone;
+  }
+
+  static void cancel(const PoolConfig& pool, const std::string& job_id) {
+    rm_detail::run_capture(pool.slurm_scancel + " " +
+                           rm_detail::shell_quote(job_id));
+  }
+};
+
+}  // namespace dtpu

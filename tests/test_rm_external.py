@@ -1,0 +1,466 @@
+"""External resource managers e2e: kubernetes / slurm pools + provisioner.
+
+The reference runs four RMs behind one interface
+(``master/internal/rm/``): agentrm, kubernetesrm, dispatcherrm (Slurm),
+multirm.  Here the routing unit is the resource pool (``rm.hpp``), and
+these tests drive the master against *fake* backends the way the
+reference's unit tests mock the k8s clientset and the HPE launcher:
+
+- a fake kubernetes apiserver (HTTP) that actually runs the submitted
+  Job's pod command as a local subprocess, so the whole path —
+  Job manifest -> pod -> self-shipped logs -> self-reported exit —
+  executes for real;
+- fake ``sbatch``/``squeue``/``scancel`` scripts for the slurm pool;
+- a provisioner whose launch command starts a real dtpu-agent.
+"""
+
+import http.server
+import json
+import os
+import signal
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tests.test_devcluster import (
+    AGENT_BIN,
+    REPO,
+    DevCluster,
+    exp_config,
+    free_port,
+)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(AGENT_BIN),
+    reason="native binaries not built (cmake -S native -B native/build && ninja)",
+)
+
+
+class FakeKubeApiserver:
+    """Just enough of the batch/v1 Jobs API to host the kubernetesrm path.
+
+    POST creates the Job AND runs its pod command locally (command[0]
+    swapped for sys.executable); GET reports Job status from the child
+    process; DELETE kills it.  Requests are recorded for assertions.
+    """
+
+    def __init__(self):
+        self.port = free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.jobs = {}  # name -> {"proc": Popen, "manifest": dict}
+        self.requests = []  # (method, path)
+        self.lock = threading.Lock()
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code, body=b"{}"):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                with server.lock:
+                    server.requests.append(("POST", self.path))
+                length = int(self.headers.get("Content-Length", 0))
+                manifest = json.loads(self.rfile.read(length))
+                name = manifest["metadata"]["name"]
+                spec = manifest["spec"]["template"]["spec"]["containers"][0]
+                env = dict(os.environ)
+                env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+                for e in spec.get("env", []):
+                    env[e["name"]] = e["value"]
+                cmd = [sys.executable] + spec["command"][1:]
+                proc = subprocess.Popen(
+                    cmd,
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                    start_new_session=True,
+                )
+                with server.lock:
+                    server.jobs[name] = {"proc": proc, "manifest": manifest}
+                self._reply(201)
+
+            def do_GET(self):
+                with server.lock:
+                    server.requests.append(("GET", self.path))
+                    job = server.jobs.get(self.path.rsplit("/", 1)[-1])
+                if job is None:
+                    self._reply(404, b'{"kind":"Status","code":404}')
+                    return
+                rc = job["proc"].poll()
+                status = {}
+                if rc is not None:
+                    if rc == 0:
+                        status = {"succeeded": 1}
+                    else:
+                        status = {"failed": 1, "exitCode": rc}
+                self._reply(200, json.dumps({"status": status}).encode())
+
+            def do_DELETE(self):
+                name = self.path.rsplit("/", 1)[-1]
+                with server.lock:
+                    server.requests.append(("DELETE", self.path))
+                    job = server.jobs.pop(name, None)
+                if job is None:
+                    self._reply(404, b'{"kind":"Status","code":404}')
+                    return
+                if job["proc"].poll() is None:
+                    os.killpg(job["proc"].pid, signal.SIGTERM)
+                self._reply(200)
+
+        self.httpd = socketserver.ThreadingTCPServer(("127.0.0.1", self.port), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        with self.lock:
+            jobs = list(self.jobs.values())
+        for job in jobs:
+            if job["proc"].poll() is None:
+                try:
+                    os.killpg(job["proc"].pid, signal.SIGKILL)
+                except OSError:
+                    pass
+
+    def saw(self, method, fragment):
+        with self.lock:
+            return any(m == method and fragment in p for m, p in self.requests)
+
+
+def _write_pools(tmp_path, pools):
+    path = tmp_path / "pools.json"
+    path.write_text(json.dumps(pools))
+    return str(path)
+
+
+def _k8s_cluster(tmp_path, kube, pool_name="k8s", extra_pools=()):
+    pools = [
+        {
+            "name": pool_name,
+            "type": "kubernetes",
+            "kubernetes": {"apiserver": kube.url, "namespace": "dtpu"},
+        },
+        *extra_pools,
+    ]
+    c = DevCluster(
+        tmp_path,
+        agents=0,
+        master_args=("--pools", _write_pools(tmp_path, pools)),
+    )
+    c.start_master()
+    return c
+
+
+def test_kubernetes_pool_runs_experiment(tmp_path):
+    kube = FakeKubeApiserver()
+    c = _k8s_cluster(tmp_path, kube)
+    try:
+        config = exp_config(c.ckpt_dir)
+        config["resources"]["resource_pool"] = "k8s"
+        exp_id = c.submit(config)
+        exp = c.wait_for_state(exp_id, timeout=180)
+        assert exp["state"] == "COMPLETED"
+        assert kube.saw("POST", "/apis/batch/v1/namespaces/dtpu/jobs")
+        trial_id = exp["trials"][0]["id"]
+        # logs were shipped by the pod itself (no agent exists to relay)
+        r = c.http.get(f"{c.url}/api/v1/trials/{trial_id}/logs")
+        assert r.status_code == 200
+        text = json.dumps(r.json())
+        assert "trial finished" in text
+        # the completed Job object is garbage-collected by the master
+        deadline = time.time() + 15
+        while time.time() < deadline and kube.jobs:
+            time.sleep(0.5)
+        assert not kube.jobs
+    finally:
+        c.stop()
+        kube.stop()
+
+
+def test_kubernetes_job_vanishing_fails_trial(tmp_path):
+    """Crash safety net: a Job deleted behind the master's back (node
+    death, admin kubectl delete) must fail the allocation instead of
+    leaving the trial RUNNING forever."""
+    kube = FakeKubeApiserver()
+    c = _k8s_cluster(tmp_path, kube)
+    try:
+        config = exp_config(c.ckpt_dir, max_restarts=0)
+        config["resources"]["resource_pool"] = "k8s"
+        config["searcher"]["max_length"] = {"batches": 5000}  # long-running
+        exp_id = c.submit(config)
+        deadline = time.time() + 60
+        while time.time() < deadline and not kube.jobs:
+            time.sleep(0.2)
+        assert kube.jobs, "job never created"
+        name, job = next(iter(kube.jobs.items()))
+        with kube.lock:
+            kube.jobs.pop(name)
+        os.killpg(job["proc"].pid, signal.SIGKILL)  # pod dies with the node
+        exp = c.wait_for_state(exp_id, states=("ERROR",), timeout=60)
+        assert exp["state"] == "ERROR"
+    finally:
+        c.stop()
+        kube.stop()
+
+
+def test_multirm_routes_by_pool(tmp_path):
+    """Two kubernetes pools on two apiservers = the reference's multirm
+    multi-cluster case; each experiment's Job must land on its own
+    cluster."""
+    kube_a = FakeKubeApiserver()
+    kube_b = FakeKubeApiserver()
+    c = _k8s_cluster(
+        tmp_path,
+        kube_a,
+        pool_name="cluster-a",
+        extra_pools=[
+            {
+                "name": "cluster-b",
+                "type": "kubernetes",
+                "kubernetes": {"apiserver": kube_b.url, "namespace": "dtpu"},
+            }
+        ],
+    )
+    try:
+        cfg_a = exp_config(c.ckpt_dir)
+        cfg_a["resources"]["resource_pool"] = "cluster-a"
+        cfg_b = exp_config(c.ckpt_dir)
+        cfg_b["resources"]["resource_pool"] = "cluster-b"
+        id_a = c.submit(cfg_a)
+        id_b = c.submit(cfg_b)
+        assert c.wait_for_state(id_a, timeout=180)["state"] == "COMPLETED"
+        assert c.wait_for_state(id_b, timeout=180)["state"] == "COMPLETED"
+        assert kube_a.saw("POST", "/jobs") and kube_b.saw("POST", "/jobs")
+        # no cross-talk: each apiserver only ever created its own job
+        with kube_a.lock:
+            posts_a = [p for m, p in kube_a.requests if m == "POST"]
+        with kube_b.lock:
+            posts_b = [p for m, p in kube_b.requests if m == "POST"]
+        assert len(posts_a) == 1 and len(posts_b) == 1
+        # pools API reports both backends
+        pools = {p["name"]: p for p in c.http.get(c.url + "/api/v1/resource-pools").json()}
+        assert pools["cluster-a"]["type"] == "kubernetes"
+        assert pools["cluster-b"]["type"] == "kubernetes"
+    finally:
+        c.stop()
+        kube_a.stop()
+        kube_b.stop()
+
+
+def test_slurm_pool_runs_experiment(tmp_path):
+    """dispatcherrm analog: the master drives Slurm through
+    sbatch/squeue/scancel; the fakes run the generated batch script
+    locally, exactly what the script would do on a login node."""
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    sbatch = tmp_path / "sbatch"
+    sbatch.write_text(
+        "#!/bin/bash\n"
+        f"export PYTHONPATH={REPO}:$PYTHONPATH\n"
+        f"setsid bash \"$1\" > {spool}/job.out 2>&1 &\n"
+        'echo "Submitted batch job $!"\n'
+    )
+    squeue = tmp_path / "squeue"
+    squeue.write_text(
+        "#!/bin/bash\n"
+        "# -h -j <id>: print a row iff the job is alive\n"
+        'jid="$3"\n'
+        'if kill -0 "$jid" 2>/dev/null; then echo "$jid RUNNING"; fi\n'
+    )
+    scancel = tmp_path / "scancel"
+    scancel.write_text('#!/bin/bash\nkill -TERM -- "-$1" 2>/dev/null\n')
+    for f in (sbatch, squeue, scancel):
+        f.chmod(0o755)
+
+    pools = [
+        {
+            "name": "hpc",
+            "type": "slurm",
+            "slurm": {
+                "sbatch": str(sbatch),
+                "squeue": str(squeue),
+                "scancel": str(scancel),
+                "partition": "tpu",
+                "spool_dir": str(spool),
+            },
+        }
+    ]
+    c = DevCluster(
+        tmp_path, agents=0, master_args=("--pools", _write_pools(tmp_path, pools))
+    )
+    c.start_master()
+    try:
+        config = exp_config(c.ckpt_dir)
+        config["resources"]["resource_pool"] = "hpc"
+        exp_id = c.submit(config)
+        exp = c.wait_for_state(exp_id, timeout=180)
+        assert exp["state"] == "COMPLETED"
+        # the generated batch script carries the platform env + directives
+        scripts = [p for p in spool.iterdir() if p.suffix == ".sh"]
+        assert scripts, "no batch script spooled"
+        body = scripts[0].read_text()
+        assert "#SBATCH --partition=tpu" in body
+        assert "DTPU_TRIAL_ID" in body
+        assert "determined_tpu.exec.run_trial" in body
+    finally:
+        c.stop()
+
+
+def test_slurm_cancel_kills_job(tmp_path):
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    sbatch = tmp_path / "sbatch"
+    sbatch.write_text(
+        "#!/bin/bash\n"
+        f"export PYTHONPATH={REPO}:$PYTHONPATH\n"
+        f"setsid bash \"$1\" > {spool}/job.out 2>&1 &\n"
+        'echo "$!" >> ' + str(spool / "pids") + "\n"
+        'echo "Submitted batch job $!"\n'
+    )
+    squeue = tmp_path / "squeue"
+    squeue.write_text(
+        "#!/bin/bash\n"
+        'jid="$3"\n'
+        'if kill -0 "$jid" 2>/dev/null; then echo "$jid RUNNING"; fi\n'
+    )
+    scancel = tmp_path / "scancel"
+    scancel.write_text(
+        "#!/bin/bash\n"
+        'kill -TERM -- "-$1" 2>/dev/null\n'
+        "echo cancelled-$1 >> " + str(spool / "cancels") + "\n"
+    )
+    for f in (sbatch, squeue, scancel):
+        f.chmod(0o755)
+    pools = [
+        {
+            "name": "hpc",
+            "type": "slurm",
+            "slurm": {
+                "sbatch": str(sbatch),
+                "squeue": str(squeue),
+                "scancel": str(scancel),
+                "spool_dir": str(spool),
+            },
+        }
+    ]
+    c = DevCluster(
+        tmp_path, agents=0, master_args=("--pools", _write_pools(tmp_path, pools))
+    )
+    c.start_master()
+    try:
+        config = exp_config(c.ckpt_dir)
+        config["resources"]["resource_pool"] = "hpc"
+        config["searcher"]["max_length"] = {"batches": 5000}
+        exp_id = c.submit(config)
+        deadline = time.time() + 60
+        while time.time() < deadline and not (spool / "pids").exists():
+            time.sleep(0.2)
+        r = c.http.post(f"{c.url}/api/v1/experiments/{exp_id}/kill")
+        assert r.status_code == 200, r.text
+        c.wait_for_state(exp_id, states=("CANCELED", "STOPPED"), timeout=60)
+        deadline = time.time() + 30
+        while time.time() < deadline and not (spool / "cancels").exists():
+            time.sleep(0.5)
+        assert (spool / "cancels").exists(), "scancel never invoked"
+        # the job's process group is gone
+        pid = int((spool / "pids").read_text().split()[0])
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"slurm job pid {pid} survived scancel")
+    finally:
+        c.stop()
+
+
+def test_provisioner_scales_up_and_down(tmp_path):
+    """agentrm provisioner analog (``rm/agentrm/provisioner/``): zero
+    agents at submit, the launch command starts a real dtpu-agent, the
+    trial completes, and the idle agent is drained back down."""
+    piddir = tmp_path / "prov"
+    piddir.mkdir()
+    launch = tmp_path / "launch-agent.sh"
+    port_file = tmp_path / "master-port"
+    launch.write_text(
+        "#!/bin/bash\n"
+        f"port=$(cat {port_file})\n"
+        f"export PYTHONPATH={REPO}:$PYTHONPATH\n"
+        f"setsid {AGENT_BIN} --master-host 127.0.0.1 --master-port $port "
+        f'--id prov-$$ --pool "$DTPU_POOL" --slots 2 '
+        f"--state-dir {piddir}/state-$$ > {piddir}/agent-$$.log 2>&1 &\n"
+        f"echo $! > {piddir}/prov-$$.pid\n"
+    )
+    terminate = tmp_path / "terminate-agent.sh"
+    terminate.write_text(
+        "#!/bin/bash\n"
+        f'pid=$(cat {piddir}/"$DTPU_AGENT_ID".pid)\n'
+        'kill -TERM -- "-$pid" 2>/dev/null\n'
+        f'rm -f {piddir}/"$DTPU_AGENT_ID".pid\n'
+    )
+    launch.chmod(0o755)
+    terminate.chmod(0o755)
+    pools = [
+        {
+            "name": "autoscale",
+            "type": "agent",
+            "provisioner": {
+                "launch_cmd": str(launch),
+                "terminate_cmd": str(terminate),
+                "min_agents": 0,
+                "max_agents": 2,
+                "idle_grace_sec": 3,
+                "launch_cooldown_sec": 2,
+            },
+        }
+    ]
+    c = DevCluster(
+        tmp_path,
+        agents=0,
+        master_args=(
+            "--pools", _write_pools(tmp_path, pools),
+            "--agent-timeout-sec", "6",
+        ),
+    )
+    try:
+        c.start_master()
+        port_file.write_text(str(c.port))
+        config = exp_config(c.ckpt_dir)
+        config["resources"]["resource_pool"] = "autoscale"
+        exp_id = c.submit(config)
+        exp = c.wait_for_state(exp_id, timeout=180)
+        assert exp["state"] == "COMPLETED"
+        # an agent was provisioned into the pool
+        agents = c.http.get(c.url + "/api/v1/agents").json()
+        assert any(a["pool"] == "autoscale" for a in agents)
+        # ...and drained + reaped once idle past the grace window
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            agents = c.http.get(c.url + "/api/v1/agents").json()
+            if not any(a["pool"] == "autoscale" for a in agents):
+                break
+            time.sleep(1.0)
+        else:
+            pytest.fail(f"idle provisioned agent never reaped: {agents}")
+    finally:
+        c.stop()
+        # belt-and-braces: no orphaned provisioned agents survive the test
+        for pidfile in piddir.glob("*.pid"):
+            try:
+                os.killpg(int(pidfile.read_text().strip()), signal.SIGKILL)
+            except (OSError, ValueError):
+                pass
