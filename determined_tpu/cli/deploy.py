@@ -1,0 +1,231 @@
+"""Local cluster deployment: ``dtpu deploy local up|down|status``.
+
+Reference: ``det deploy local`` (``harness/determined/deploy/local/``), which
+brings up master+db+agents with docker-compose.  TPU redesign: there is no
+container sandwich — TPU VMs run training directly on the host — so a local
+cluster is plain process supervision: spawn ``dtpu-master`` and N
+``dtpu-agent`` processes detached, record their pids under the cluster
+directory, and tear down by pid.  The same binaries a production site runs
+under systemd are what ``deploy local`` runs under your shell.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional
+
+
+def _default_cluster_dir() -> str:
+    return os.path.join(os.path.expanduser("~"), ".dtpu", "cluster")
+
+
+def _find_binary(name: str, env_var: str) -> Optional[str]:
+    """Locate a native binary: env override, then the in-repo build dir,
+    then PATH."""
+    override = os.environ.get(env_var)
+    if override and os.path.exists(override):
+        return override
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    candidate = os.path.join(repo, "native", "build", name)
+    if os.path.exists(candidate):
+        return candidate
+    import shutil
+
+    return shutil.which(name)
+
+
+def _cluster_file(cluster_dir: str) -> str:
+    return os.path.join(cluster_dir, "cluster.json")
+
+
+def _load_cluster(cluster_dir: str) -> Optional[dict]:
+    try:
+        with open(_cluster_file(cluster_dir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def deploy_local_up(args) -> int:
+    cluster_dir = os.path.abspath(args.cluster_dir)
+    existing = _load_cluster(cluster_dir)
+    if existing and _alive(existing.get("master_pid", -1)):
+        print(f"cluster already running (master pid {existing['master_pid']}, "
+              f"{existing['url']}); `dtpu deploy local down` first")
+        return 1
+    if existing:
+        # half-dead cluster (master crashed, agents survive retrying the
+        # old port): stop the stragglers before the record is overwritten,
+        # or nothing could ever reach them again
+        for pid in existing.get("agent_pids", []):
+            if _alive(pid):
+                print(f"stopping stale agent pid {pid} from previous cluster")
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    pass
+    master_bin = _find_binary("dtpu-master", "DTPU_MASTER_BIN")
+    agent_bin = _find_binary("dtpu-agent", "DTPU_AGENT_BIN")
+    if not master_bin or not agent_bin:
+        print("dtpu-master / dtpu-agent binaries not found "
+              "(build native/ or set DTPU_MASTER_BIN / DTPU_AGENT_BIN)")
+        return 1
+    os.makedirs(cluster_dir, exist_ok=True)
+    port = args.port or _free_port()
+    url = f"http://127.0.0.1:{port}"
+    log_dir = os.path.join(cluster_dir, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+
+    master_cmd = [
+        master_bin,
+        "--host", "127.0.0.1",
+        "--port", str(port),
+        "--state-dir", os.path.join(cluster_dir, "state"),
+        "--checkpoint-dir", os.path.join(cluster_dir, "checkpoints"),
+        "--scheduler", args.scheduler,
+    ]
+    if args.pools:
+        master_cmd += ["--pools", os.path.abspath(args.pools)]
+    with open(os.path.join(log_dir, "master.log"), "ab") as log:
+        master = subprocess.Popen(
+            master_cmd, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+
+    deadline = time.time() + 15
+    up = False
+    while time.time() < deadline:
+        try:
+            import urllib.request
+
+            urllib.request.urlopen(url + "/api/v1/master", timeout=1).read()
+            up = True
+            break
+        except Exception:  # noqa: BLE001 - still booting
+            if master.poll() is not None:
+                break
+            time.sleep(0.2)
+    if not up:
+        print(f"master did not come up; see {log_dir}/master.log")
+        if master.poll() is None:
+            master.terminate()
+        return 1
+
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    agent_pids = []
+    for i in range(args.agents):
+        agent_cmd = [
+            agent_bin,
+            "--master-host", "127.0.0.1",
+            "--master-port", str(port),
+            "--id", f"local-agent-{i}",
+            "--state-dir", os.path.join(cluster_dir, f"agent-{i}"),
+        ]
+        if args.slots:
+            agent_cmd += ["--slots", str(args.slots)]
+        with open(os.path.join(log_dir, f"agent-{i}.log"), "ab") as log:
+            agent = subprocess.Popen(
+                agent_cmd, env=env, stdout=log, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        agent_pids.append(agent.pid)
+
+    with open(_cluster_file(cluster_dir), "w") as f:
+        json.dump(
+            {"url": url, "port": port, "master_pid": master.pid,
+             "agent_pids": agent_pids},
+            f,
+        )
+    print(f"cluster up: {url} (master pid {master.pid}, "
+          f"{len(agent_pids)} agent(s))")
+    print(f"export DTPU_MASTER={url}")
+    return 0
+
+
+def deploy_local_down(args) -> int:
+    cluster_dir = os.path.abspath(args.cluster_dir)
+    cluster = _load_cluster(cluster_dir)
+    if not cluster:
+        print(f"no cluster recorded under {cluster_dir}")
+        return 1
+    pids = [cluster.get("master_pid")] + list(cluster.get("agent_pids", []))
+    pids = [p for p in pids if p and _alive(p)]
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.time() + 10
+    while time.time() < deadline and any(_alive(p) for p in pids):
+        time.sleep(0.2)
+    for pid in pids:
+        if _alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+    os.remove(_cluster_file(cluster_dir))
+    print(f"cluster down ({len(pids)} process(es) stopped)")
+    return 0
+
+
+def deploy_local_status(args) -> int:
+    cluster_dir = os.path.abspath(args.cluster_dir)
+    cluster = _load_cluster(cluster_dir)
+    if not cluster:
+        print(f"no cluster recorded under {cluster_dir}")
+        return 1
+    master_ok = _alive(cluster.get("master_pid", -1))
+    agents_ok = sum(1 for p in cluster.get("agent_pids", []) if _alive(p))
+    print(f"master: {'up' if master_ok else 'DOWN'} "
+          f"(pid {cluster.get('master_pid')}, {cluster.get('url')})")
+    print(f"agents: {agents_ok}/{len(cluster.get('agent_pids', []))} up")
+    return 0 if master_ok else 1
+
+
+def register(sub) -> None:
+    deploy = sub.add_parser("deploy").add_subparsers(dest="verb", required=True)
+    local = deploy.add_parser("local").add_subparsers(dest="action", required=True)
+    up = local.add_parser("up")
+    up.add_argument("--agents", type=int, default=1)
+    up.add_argument("--slots", type=int, default=0, help="0 = agent auto-detect")
+    up.add_argument("--port", type=int, default=0, help="0 = pick a free port")
+    up.add_argument("--scheduler", default="priority",
+                    choices=["priority", "fair_share"])
+    up.add_argument("--pools", default=None, help="pools.json for RM backends")
+    up.add_argument("--cluster-dir", default=_default_cluster_dir())
+    up.set_defaults(fn=deploy_local_up)
+    down = local.add_parser("down")
+    down.add_argument("--cluster-dir", default=_default_cluster_dir())
+    down.set_defaults(fn=deploy_local_down)
+    status = local.add_parser("status")
+    status.add_argument("--cluster-dir", default=_default_cluster_dir())
+    status.set_defaults(fn=deploy_local_status)
+
+
+if __name__ == "__main__":
+    sys.exit(0)

@@ -633,6 +633,10 @@ def build_parser() -> argparse.ArgumentParser:
     rl.add_argument("--checkpoint-dir", default=None)
     rl.set_defaults(fn=run_local)
 
+    from determined_tpu.cli import deploy
+
+    deploy.register(sub)
+
     return p
 
 

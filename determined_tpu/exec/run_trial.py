@@ -119,8 +119,8 @@ def _install_log_shipper() -> None:
     trial_id = os.environ.get("DTPU_TRIAL_ID")
     if not master or not trial_id:
         return
-    import select
     import threading
+    import time
     import urllib.request
 
     token = os.environ.get("DTPU_SESSION_TOKEN", "")
@@ -136,6 +136,8 @@ def _install_log_shipper() -> None:
 
     batch: list = []
     batch_lock = threading.Lock()
+    # bound memory while the master is unreachable: keep the newest lines
+    max_buffered = 10000
 
     def post(lines) -> None:
         body = json.dumps(
@@ -162,27 +164,32 @@ def _install_log_shipper() -> None:
             post(lines)
 
     def pump() -> None:
+        # reader only: never blocks on the network, so a master outage
+        # cannot back-pressure the pipe and stall the training process's
+        # writes to fd 1/2 (the sender thread does the HTTP)
         partial = b""
         while True:
-            ready, _, _ = select.select([read_fd], [], [], 0.5)
-            if ready:
-                try:
-                    chunk = os.read(read_fd, 8192)
-                except OSError:
-                    break
-                if not chunk:
-                    break
-                partial += chunk
-                while b"\n" in partial:
-                    line, partial = partial.split(b"\n", 1)
-                    with batch_lock:
-                        batch.append(line.decode("utf-8", "replace"))
-            with batch_lock:
-                full = len(batch) >= 64
-            if full or not ready:
-                flush()
+            try:
+                chunk = os.read(read_fd, 8192)
+            except OSError:
+                break
+            if not chunk:
+                break
+            partial += chunk
+            while b"\n" in partial:
+                line, partial = partial.split(b"\n", 1)
+                with batch_lock:
+                    batch.append(line.decode("utf-8", "replace"))
+                    if len(batch) > max_buffered:
+                        del batch[: len(batch) - max_buffered]
 
-    threading.Thread(target=pump, daemon=True, name="dtpu-log-shipper").start()
+    def sender() -> None:
+        while True:
+            time.sleep(0.5)
+            flush()
+
+    threading.Thread(target=pump, daemon=True, name="dtpu-log-pump").start()
+    threading.Thread(target=sender, daemon=True, name="dtpu-log-shipper").start()
     global _log_shipper_flush
     _log_shipper_flush = flush
 

@@ -282,6 +282,56 @@ class Master {
   void set_agent_timeout_ms(int64_t ms) { agent_timeout_ms_ = ms; }
   void set_scheduler(const std::string& mode) { scheduler_mode_ = mode; }
 
+  // Anonymized usage telemetry (reference master/internal/telemetry/
+  // telemetry.go:13-40: Segment client posting cluster id, version,
+  // counts).  OFF unless --telemetry-url is set; payload carries no
+  // names, configs, metrics, or code — only a random persisted cluster
+  // id and object counts.
+  void set_telemetry(const std::string& url, int interval_sec) {
+    telemetry_url_ = url;
+    telemetry_interval_sec_ = interval_sec;
+    if (url.empty()) return;
+    // cluster id: random, persisted so restarts stay one cluster
+    std::string path = state_dir_ + "/cluster_id";
+    std::ifstream in(path);
+    if (in) {
+      std::getline(in, cluster_id_);
+    }
+    if (cluster_id_.empty()) {
+      std::random_device rd;
+      char buf[33];
+      snprintf(buf, sizeof(buf), "%08x%08x%08x%08x", rd(), rd(), rd(), rd());
+      cluster_id_ = buf;
+      std::ofstream out(path, std::ios::trunc);
+      out << cluster_id_ << "\n";
+    }
+  }
+
+  // gather the payload under the lock (caller holds mu_); the POST itself
+  // happens on the caller's thread with the lock released
+  Json telemetry_payload() const {
+    int agents = 0, slots = 0;
+    for (const auto& [aid, ag] : agents_) {
+      ++agents;
+      slots += ag.slots;
+    }
+    int running = 0;
+    for (const auto& [tid, t] : trials_) {
+      if (t.state == "RUNNING") ++running;
+    }
+    return Json::object()
+        .set("cluster_id", cluster_id_)
+        .set("version", "0.3.0")
+        .set("experiments", Json(static_cast<int64_t>(experiments_.size())))
+        .set("trials_running", Json(static_cast<int64_t>(running)))
+        .set("agents", Json(static_cast<int64_t>(agents)))
+        .set("slots", Json(static_cast<int64_t>(slots)))
+        .set("pools", Json(static_cast<int64_t>(pools_.size())));
+  }
+
+  const std::string& telemetry_url() const { return telemetry_url_; }
+  int telemetry_interval_sec() const { return telemetry_interval_sec_; }
+
   // declared resource pools (rm.hpp): agent pools need no declaration;
   // kubernetes/slurm pools and provisioned agent pools are configured here
   void set_pools(const Json& pools) {
@@ -1938,17 +1988,17 @@ class Master {
       std::string alloc_id;
       std::string pool;
       std::string ref;
-      std::string kind;
       bool ended;
+      bool lingering;  // no allocation behind it (mid-submit kill remnant)
     };
     std::vector<Probe> probes;
     for (auto& [alloc_id, alloc] : allocations_) {
       if (alloc.external_kind.empty() || alloc.external_ref.empty()) continue;
       probes.push_back({alloc_id, alloc.external_pool, alloc.external_ref,
-                        alloc.external_kind, alloc.ended});
+                        alloc.ended, false});
     }
     for (auto& [pool_name, ref] : lingering_external_) {
-      probes.push_back({"", pool_name, ref, "", true});
+      probes.push_back({"", pool_name, ref, true, true});
     }
     lingering_external_.clear();
     if (probes.empty()) return;
@@ -1958,8 +2008,10 @@ class Master {
       std::string alloc_id;
       ExternalJobState state;
       int exit_code;
+      bool cleaned;  // the ended-branch remove/cancel actually ran
     };
     std::vector<Result> results;
+    size_t processed = 0;
     lk.unlock();
     for (auto& p : probes) {
       {
@@ -1968,6 +2020,7 @@ class Master {
         std::lock_guard<std::mutex> g(mu_);
         if (!ext_ops_.empty()) break;
       }
+      ++processed;
       auto pit = pools.find(p.pool);
       if (pit == pools.end()) continue;
       const PoolConfig& pool = pit->second;
@@ -1981,7 +2034,7 @@ class Master {
         } else if (pool.type == "slurm") {
           SlurmBackend::cancel(pool, p.ref);
         }
-        results.push_back({p.alloc_id, ExternalJobState::kGone, 0});
+        results.push_back({p.alloc_id, ExternalJobState::kGone, 0, true});
         continue;
       }
       int exit_code = 1;
@@ -1991,15 +2044,26 @@ class Master {
       } else if (pool.type == "slurm") {
         st = SlurmBackend::status(pool, p.ref);
       }
-      results.push_back({p.alloc_id, st, exit_code});
+      results.push_back({p.alloc_id, st, exit_code, false});
     }
     lk.lock();
+    // probes abandoned by the early break: allocation-backed ones retry
+    // naturally (their ref is still stored), lingering ones must be
+    // re-queued or the orphaned job would never be reaped
+    for (size_t i = processed; i < probes.size(); ++i) {
+      if (probes[i].lingering) {
+        lingering_external_.push_back({probes[i].pool, probes[i].ref});
+      }
+    }
     for (auto& r : results) {
       auto ait = allocations_.find(r.alloc_id);
       if (ait == allocations_.end()) continue;
       AllocationState& alloc = ait->second;
       if (alloc.ended) {
-        alloc.external_ref.clear();  // cleanup issued above; stop polling it
+        // stop polling only once the ended-branch cleanup really ran; an
+        // allocation that ended between snapshot and here keeps its ref
+        // so the next pass can delete/cancel the backend job
+        if (r.cleaned) alloc.external_ref.clear();
         continue;
       }
       auto tit = trials_.find(alloc.trial_id);
@@ -2137,6 +2201,9 @@ class Master {
   std::map<std::string, PoolConfig> pools_;    // declared pools (rm.hpp)
   std::string advertised_url_ = "http://127.0.0.1:8080";
   std::map<std::string, int64_t> pool_last_launch_ms_;  // provisioner cooldown
+  std::string telemetry_url_;   // empty = telemetry disabled (the default)
+  int telemetry_interval_sec_ = 3600;
+  std::string cluster_id_;
   std::map<std::string, Json> templates_;      // config templates (reference templates/)
   std::map<int64_t, WebhookState> webhooks_;
   int64_t next_webhook_id_ = 1;
@@ -3731,6 +3798,8 @@ int main(int argc, char** argv) {
   std::string scheduler = "priority";
   std::string pools_file;
   std::string advertised_url;
+  std::string telemetry_url;
+  int telemetry_interval_sec = 3600;
   for (int i = 1; i < argc; ++i) {
     std::string arg = argv[i];
     auto next = [&](const char* name) -> std::string {
@@ -3749,6 +3818,9 @@ int main(int argc, char** argv) {
     else if (arg == "--scheduler") scheduler = next("--scheduler");
     else if (arg == "--pools") pools_file = next("--pools");
     else if (arg == "--advertised-url") advertised_url = next("--advertised-url");
+    else if (arg == "--telemetry-url") telemetry_url = next("--telemetry-url");
+    else if (arg == "--telemetry-interval-sec")
+      telemetry_interval_sec = std::atoi(next("--telemetry-interval-sec").c_str());
     else if (arg == "--simulate") {
       std::string cfg = next("--simulate");
       uint64_t seed = 0;
@@ -3796,6 +3868,29 @@ int main(int argc, char** argv) {
                                 ? "http://127.0.0.1:" + std::to_string(bound)
                                 : advertised_url);
   std::thread([&master] { master.run_external_worker(); }).detach();
+  master.set_telemetry(telemetry_url, telemetry_interval_sec);
+  if (!telemetry_url.empty()) {
+    // opt-in only: one anonymized counts payload per interval (reference
+    // telemetry.go); first post right away so short-lived clusters count
+    std::thread([&master] {
+      while (true) {
+        dtpu::Json payload;
+        {
+          std::lock_guard<std::mutex> lk(master.mu_);
+          payload = master.telemetry_payload();
+        }
+        std::string thost, tpath;
+        int tport = 0;
+        if (dtpu::rm_detail::split_url(master.telemetry_url(), &thost, &tport,
+                                       &tpath)) {
+          dtpu::http_request(thost, tport, "POST", tpath, payload.dump(), 10,
+                             {{"Content-Type", "application/json"}});
+        }
+        std::this_thread::sleep_for(
+            std::chrono::seconds(master.telemetry_interval_sec()));
+      }
+    }).detach();
+  }
   printf("dtpu-master listening on %s:%d (state: %s)\n", host.c_str(), bound,
          state_dir.c_str());
   fflush(stdout);

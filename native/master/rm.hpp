@@ -26,8 +26,14 @@
 
 #pragma once
 
+#include <sys/wait.h>
+
 #include <cstdio>
+#include <cstdlib>
+#include <filesystem>
+#include <fstream>
 #include <string>
+#include <system_error>
 #include <vector>
 
 #include "../common/http.hpp"
@@ -110,14 +116,18 @@ enum class ExternalJobState { kRunning, kSucceeded, kFailed, kGone };
 
 namespace rm_detail {
 
-inline bool split_url(const std::string& url, std::string* host, int* port) {
-  // accepts http://host:port (the only scheme the in-cluster path needs;
-  // TLS terminates at a local kubectl proxy / gateway, as the reference's
-  // dispatcherrm does with its launcher service)
+inline bool split_url(const std::string& url, std::string* host, int* port,
+                      std::string* path = nullptr) {
+  // accepts http://host:port[/path] (the only scheme the in-cluster path
+  // needs; TLS terminates at a local kubectl proxy / gateway, as the
+  // reference's dispatcherrm does with its launcher service)
   const std::string prefix = "http://";
   if (url.rfind(prefix, 0) != 0) return false;
   std::string rest = url.substr(prefix.size());
   auto slash = rest.find('/');
+  if (path != nullptr) {
+    *path = slash == std::string::npos ? "/" : rest.substr(slash);
+  }
   if (slash != std::string::npos) rest = rest.substr(0, slash);
   auto colon = rest.find(':');
   if (colon == std::string::npos) {
@@ -140,14 +150,22 @@ inline std::string shell_quote(const std::string& s) {
   return out;
 }
 
-inline std::string run_capture(const std::string& cmd) {
+inline std::string run_capture(const std::string& cmd, int* exit_code = nullptr) {
   std::string out;
-  FILE* f = popen((cmd + " 2>/dev/null").c_str(), "r");
-  if (!f) return out;
+  // stderr folded into the capture so callers can distinguish "job not
+  // found" from "slurmctld unreachable"
+  FILE* f = popen((cmd + " 2>&1").c_str(), "r");
+  if (!f) {
+    if (exit_code != nullptr) *exit_code = 127;
+    return out;
+  }
   char buf[4096];
   size_t n;
   while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, n);
-  pclose(f);
+  int status = pclose(f);
+  if (exit_code != nullptr) {
+    *exit_code = WIFEXITED(status) ? WEXITSTATUS(status) : 1;
+  }
   return out;
 }
 
@@ -222,14 +240,21 @@ class KubernetesBackend {
       return ExternalJobState::kSucceeded;
     }
     if (st["failed"].as_int(0) > 0) {
-      *exit_code = static_cast<int>(st["exitCode"].as_int(1));
+      // batch/v1 Job status carries no container exit code (those live in
+      // pod statuses); the harness self-report is the real-code path and
+      // this safety net reports a generic failure
+      *exit_code = 1;
       return ExternalJobState::kFailed;
     }
     return ExternalJobState::kRunning;
   }
 
   static void remove(const PoolConfig& pool, const std::string& job_name) {
-    api(pool, "DELETE", jobs_path(pool) + "/" + job_name, "");
+    // Background propagation: without it batch/v1 Jobs orphan their pods
+    // on delete (legacy default) and a killed trial would keep the TPU
+    // chips busy (reference kubernetesrm sets PropagationPolicy too)
+    api(pool, "DELETE",
+        jobs_path(pool) + "/" + job_name + "?propagationPolicy=Background", "");
   }
 
  private:
@@ -303,12 +328,21 @@ class SlurmBackend {
 
   static ExternalJobState status(const PoolConfig& pool,
                                  const std::string& job_id) {
+    int rc = 0;
     std::string out = rm_detail::run_capture(
-        pool.slurm_squeue + " -h -j " + rm_detail::shell_quote(job_id));
+        pool.slurm_squeue + " -h -j " + rm_detail::shell_quote(job_id), &rc);
     bool listed = out.find_first_not_of(" \t\r\n") != std::string::npos;
     // squeue says nothing about exit codes; the harness self-reports the
-    // real code, the poll only notices disappearance (crash safety net)
-    return listed ? ExternalJobState::kRunning : ExternalJobState::kGone;
+    // real code, the poll only notices disappearance (crash safety net).
+    // Gone means squeue SUCCEEDED and did not list the job (or named it
+    // invalid/expired); a failing squeue — slurmctld restart, network —
+    // must read as still-running or a transient outage would fail every
+    // live trial with a phantom exit.
+    if (rc == 0) return listed ? ExternalJobState::kRunning : ExternalJobState::kGone;
+    if (out.find("Invalid job id") != std::string::npos) {
+      return ExternalJobState::kGone;
+    }
+    return ExternalJobState::kRunning;
   }
 
   static void cancel(const PoolConfig& pool, const std::string& job_id) {

@@ -1,0 +1,180 @@
+"""`dtpu deploy local` process supervision + opt-in master telemetry.
+
+Reference: ``det deploy local`` (``harness/determined/deploy/local/``,
+docker-compose cluster-up) and ``master/internal/telemetry/telemetry.go``
+(anonymized Segment payloads).  Here deploy local supervises the native
+daemons directly and telemetry is a plain JSON POST, off by default.
+"""
+
+import http.server
+import json
+import os
+import signal
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+import requests
+
+from tests.test_devcluster import AGENT_BIN, MASTER_BIN, REPO, DevCluster, free_port
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(MASTER_BIN) and os.path.exists(AGENT_BIN)),
+    reason="native binaries not built (cmake -S native -B native/build && ninja)",
+)
+
+
+def _cli(args, **kw):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "determined_tpu.cli", *args],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=60,
+        **kw,
+    )
+
+
+def test_deploy_local_up_status_down(tmp_path):
+    cluster_dir = str(tmp_path / "cluster")
+    port = free_port()
+    up = _cli(
+        [
+            "deploy", "local", "up",
+            "--agents", "1",
+            "--slots", "2",
+            "--port", str(port),
+            "--cluster-dir", cluster_dir,
+        ]
+    )
+    assert up.returncode == 0, up.stdout + up.stderr
+    assert f"http://127.0.0.1:{port}" in up.stdout
+    try:
+        # the cluster is a real master + agent: login and see the agent
+        url = f"http://127.0.0.1:{port}"
+        r = requests.post(
+            url + "/api/v1/auth/login",
+            json={"username": "determined", "password": ""},
+            timeout=5,
+        )
+        token = r.json()["token"]
+        deadline = time.time() + 15
+        agents = []
+        while time.time() < deadline:
+            agents = requests.get(
+                url + "/api/v1/agents",
+                headers={"Authorization": f"Bearer {token}"},
+                timeout=5,
+            ).json()
+            if agents:
+                break
+            time.sleep(0.5)
+        assert len(agents) == 1 and agents[0]["slots"] == 2
+
+        status = _cli(["deploy", "local", "status", "--cluster-dir", cluster_dir])
+        assert status.returncode == 0
+        assert "master: up" in status.stdout
+        assert "agents: 1/1 up" in status.stdout
+
+        # double-up refuses while running
+        again = _cli(
+            ["deploy", "local", "up", "--cluster-dir", cluster_dir]
+        )
+        assert again.returncode == 1
+        assert "already running" in again.stdout
+    finally:
+        down = _cli(["deploy", "local", "down", "--cluster-dir", cluster_dir])
+    assert down.returncode == 0, down.stdout + down.stderr
+    with open(tmp_path / "cluster" / "logs" / "master.log") as f:
+        assert "listening" in f.read()
+    # processes really stopped
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if _cli(["deploy", "local", "status", "--cluster-dir", cluster_dir]).returncode == 1:
+            break
+        time.sleep(0.5)
+    status = _cli(["deploy", "local", "status", "--cluster-dir", cluster_dir])
+    assert status.returncode == 1
+
+
+class _TelemetrySink:
+    def __init__(self):
+        self.port = free_port()
+        self.payloads = []
+        sink = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                sink.payloads.append(
+                    (self.path, json.loads(self.rfile.read(length)))
+                )
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        self.httpd = socketserver.ThreadingTCPServer(("127.0.0.1", self.port), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+
+def test_telemetry_posts_anonymized_counts(tmp_path):
+    sink = _TelemetrySink()
+    c = DevCluster(
+        tmp_path,
+        agents=1,
+        slots=2,
+        master_args=(
+            "--telemetry-url", f"http://127.0.0.1:{sink.port}/ingest",
+            "--telemetry-interval-sec", "2",
+        ),
+    )
+    c.start()
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline and len(sink.payloads) < 2:
+            time.sleep(0.5)
+        assert len(sink.payloads) >= 2, "telemetry never posted"
+        path, payload = sink.payloads[-1]
+        assert path == "/ingest"
+        # anonymized: a random cluster id + counts, nothing else
+        assert set(payload) == {
+            "cluster_id", "version", "experiments", "trials_running",
+            "agents", "slots", "pools",
+        }
+        assert len(payload["cluster_id"]) == 32
+        assert payload["agents"] == 1 and payload["slots"] == 2
+        # cluster id persists across restarts (same cluster, one count)
+        first_id = payload["cluster_id"]
+        c.procs["master"].send_signal(signal.SIGKILL)
+        c.procs["master"].wait(timeout=5)
+        n = len(sink.payloads)
+        c.start_master()
+        deadline = time.time() + 20
+        while time.time() < deadline and len(sink.payloads) <= n:
+            time.sleep(0.5)
+        assert sink.payloads[-1][1]["cluster_id"] == first_id
+    finally:
+        c.stop()
+        sink.httpd.shutdown()
+
+
+def test_telemetry_off_by_default(tmp_path):
+    sink = _TelemetrySink()
+    c = DevCluster(tmp_path, agents=0)
+    c.start_master()
+    try:
+        time.sleep(3)
+        assert sink.payloads == []
+    finally:
+        c.stop()
+        sink.httpd.shutdown()

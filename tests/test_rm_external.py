@@ -93,21 +93,23 @@ class FakeKubeApiserver:
             def do_GET(self):
                 with server.lock:
                     server.requests.append(("GET", self.path))
-                    job = server.jobs.get(self.path.rsplit("/", 1)[-1])
+                    job = server.jobs.get(self.path.partition("?")[0].rsplit("/", 1)[-1])
                 if job is None:
                     self._reply(404, b'{"kind":"Status","code":404}')
                     return
                 rc = job["proc"].poll()
+                # real batch/v1 Job status: counts only, no exit codes
                 status = {}
                 if rc is not None:
-                    if rc == 0:
-                        status = {"succeeded": 1}
-                    else:
-                        status = {"failed": 1, "exitCode": rc}
+                    status = {"succeeded": 1} if rc == 0 else {"failed": 1}
                 self._reply(200, json.dumps({"status": status}).encode())
 
             def do_DELETE(self):
-                name = self.path.rsplit("/", 1)[-1]
+                path, _, query = self.path.partition("?")
+                assert "propagationPolicy=Background" in query, (
+                    "Job DELETE must not orphan its pods"
+                )
+                name = path.rsplit("/", 1)[-1]
                 with server.lock:
                     server.requests.append(("DELETE", self.path))
                     job = server.jobs.pop(name, None)
