@@ -78,12 +78,22 @@ def deploy_local_up(args) -> int:
     if existing:
         # half-dead cluster (master crashed, agents survive retrying the
         # old port): stop the stragglers before the record is overwritten,
-        # or nothing could ever reach them again
-        for pid in existing.get("agent_pids", []):
+        # or nothing could ever reach them again.  Wait for them to die —
+        # a replacement agent reuses the same state dir and slots.
+        stale = [p for p in existing.get("agent_pids", []) if _alive(p)]
+        for pid in stale:
+            print(f"stopping stale agent pid {pid} from previous cluster")
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.time() + 5
+        while time.time() < deadline and any(_alive(p) for p in stale):
+            time.sleep(0.2)
+        for pid in stale:
             if _alive(pid):
-                print(f"stopping stale agent pid {pid} from previous cluster")
                 try:
-                    os.kill(pid, signal.SIGTERM)
+                    os.kill(pid, signal.SIGKILL)
                 except OSError:
                     pass
     master_bin = _find_binary("dtpu-master", "DTPU_MASTER_BIN")

@@ -139,7 +139,7 @@ def _install_log_shipper() -> None:
     # bound memory while the master is unreachable: keep the newest lines
     max_buffered = 10000
 
-    def post(lines) -> None:
+    def post(lines) -> bool:
         body = json.dumps(
             {"trial_id": int(trial_id), "agent": agent, "lines": lines}
         ).encode()
@@ -154,14 +154,20 @@ def _install_log_shipper() -> None:
         try:
             with urllib.request.urlopen(req, timeout=10) as resp:
                 resp.read()
-        except Exception:  # noqa: BLE001 - logs are best-effort
-            pass
+            return True
+        except Exception:  # noqa: BLE001 - retried by the next flush
+            return False
 
     def flush() -> None:
         with batch_lock:
             lines, batch[:] = batch[:], []
-        if lines:
-            post(lines)
+        if lines and not post(lines):
+            # master unreachable: re-queue so the outage loses nothing
+            # (up to the buffer cap; the pump trims oldest-first past it)
+            with batch_lock:
+                batch[:0] = lines
+                if len(batch) > max_buffered:
+                    del batch[: len(batch) - max_buffered]
 
     def pump() -> None:
         # reader only: never blocks on the network, so a master outage

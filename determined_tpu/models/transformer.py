@@ -324,9 +324,17 @@ class LMTrial(JaxTrial):
         schedule = optax.warmup_cosine_decay_schedule(
             0.0, lr, warmup, int(g("decay_steps", 10000))
         )
+        # adam first-moment dtype: bf16 halves its HBM traffic (the
+        # optimizer update is bandwidth-bound); second moment stays f32
+        # for the rsqrt's dynamic range
+        mu_dtype = jnp.bfloat16 if bool(g("adam_mu_bf16", False)) else None
         return optax.chain(
             optax.clip_by_global_norm(float(g("grad_clip", 1.0))),
-            optax.adamw(schedule, weight_decay=float(g("weight_decay", 0.01))),
+            optax.adamw(
+                schedule,
+                weight_decay=float(g("weight_decay", 0.01)),
+                mu_dtype=mu_dtype,
+            ),
         )
 
     def _dataset(self, seed: int) -> SyntheticDataset:

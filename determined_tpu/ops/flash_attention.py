@@ -32,6 +32,13 @@ NEG_INF = -1e30
 # and [1024,1024] logit tiles still fit VMEM comfortably.
 DEFAULT_BLOCK = 1024
 
+# The softmax runs in log2 space: the qk dot is scaled by scale*log2(e)
+# once (MXU output epilogue) and every exp becomes a native exp2 — on TPU
+# `exp` lowers to exp2 + a per-element multiply, so log2 space deletes one
+# VPU multiply per logit from the kernel's bound resource (the VPU).  The
+# stored lse is base-2 (m + log2 l), consumed only by the bwd kernels.
+LOG2E = 1.4426950408889634
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -47,6 +54,26 @@ def _pick_block(seq: int, want: int) -> int:
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
+
+
+def _scores(q_ref, k_ref, qi, ki, scale, causal, block_q, block_k):
+    """qk dot in log2 space (scale*log2e folded into the MXU epilogue) +
+    causal mask.  Shared by the fwd and both bwd kernels so the three
+    stay bit-identical on the p they reconstruct."""
+    q = q_ref[0, 0]                                   # [bq, d]
+    k = k_ref[0, 0]                                   # [bk, d]
+    s2 = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * (scale * LOG2E)                               # [bq, bk] f32, log2 units
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s2 = jnp.where(q_pos >= k_pos, s2, NEG_INF)
+    return s2
 
 
 def _fwd_kernel(
@@ -72,24 +99,13 @@ def _fwd_kernel(
         # softmax running stats are f32.  f32 inputs (tests/debug) keep
         # full f32 matmuls, so tight-tolerance checks still hold.
         q = q_ref[0, 0]                               # [bq, d]
-        k = k_ref[0, 0]                               # [bk, d]
         v = v_ref[0, 0]                               # [bk, d]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                     # [bq, bk] f32
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s2 = _scores(q_ref, k_ref, qi, ki, scale, causal, block_q, block_k)
         m_prev, l_prev = m_sc[:], l_sc[:]
-        m_cur = jnp.max(s, axis=1, keepdims=True)     # [bq, 1]
+        m_cur = jnp.max(s2, axis=1, keepdims=True)    # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                        # [bq, bk] f32
-        alpha = jnp.exp(m_prev - m_new)               # [bq, 1]
+        p = jnp.exp2(s2 - m_new)                      # [bq, bk] f32
+        alpha = jnp.exp2(m_prev - m_new)              # [bq, 1]
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
             p.astype(q.dtype), v, (((1,), (0,)), ((), ())),
@@ -104,7 +120,31 @@ def _fwd_kernel(
         o_ref[0, 0] = (acc_sc[:] / l).astype(o_ref.dtype)
         # lse is laid out [b, h, 1, sq] so the block's last dim is the
         # 128-aligned seq dim (TPU block-shape constraint)
-        lse_ref[0, 0] = (m_sc[:] + jnp.log(l))[:, 0][None, :]
+        lse_ref[0, 0] = (m_sc[:] + jnp.log2(l))[:, 0][None, :]
+
+
+def _fwd_kernel_single(
+    q_ref, k_ref, v_ref, o_ref, lse_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    """nk == 1 specialization: the whole k sweep is one block, so the
+    online-softmax machinery (running m/l scratch, acc rescale, the init
+    and final grid phases) is pure VPU overhead — a plain one-pass softmax
+    does the same math with none of it.  This is the hot shape: the
+    flagship seq-1024 workload runs block 1024 (see DEFAULT_BLOCK note)."""
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    q = q_ref[0, 0]
+    v = v_ref[0, 0]
+    s2 = _scores(q_ref, k_ref, qi, ki, scale, causal, block_q, block_k)
+    m = jnp.max(s2, axis=1, keepdims=True)            # [bq, 1]
+    p = jnp.exp2(s2 - m)                              # [bq, bk] f32
+    l = jnp.maximum(jnp.sum(p, axis=1, keepdims=True), 1e-30)
+    acc = jax.lax.dot_general(
+        p.astype(q.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log2(l))[:, 0][None, :]
 
 
 def _flash_fwd_call(
@@ -115,10 +155,13 @@ def _flash_fwd_call(
     sk = k.shape[2]
     nq, nk = sq // block_q, sk // block_k
     grid = (b, h, nq, nk)
+    single = nk == 1
+    kernel = functools.partial(
+        _fwd_kernel_single if single else _fwd_kernel,
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+    )
     out, lse = pl.pallas_call(
-        functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
-        ),
+        kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -133,7 +176,7 @@ def _flash_fwd_call(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((b, h, 1, sq), jnp.float32),
         ],
-        scratch_shapes=[
+        scratch_shapes=[] if single else [
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -168,20 +211,10 @@ def _dq_kernel(
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0].reshape(-1, 1)            # [bq, 1]
+        lse = lse_ref[0, 0].reshape(-1, 1)            # [bq, 1], log2 units
         delta = delta_ref[0, 0].reshape(-1, 1)        # [bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                          # [bq, bk] f32
+        s2 = _scores(q_ref, k_ref, qi, ki, scale, causal, block_q, block_k)
+        p = jnp.exp2(s2 - lse)                        # [bq, bk] f32
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )                                             # [bq, bk] f32
@@ -213,23 +246,12 @@ def _dkv_kernel(
     def _compute():
         # bf16 MXU inputs, f32 accumulation (see _fwd_kernel note)
         q = q_ref[0, 0]
-        k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0].reshape(-1, 1)
+        lse = lse_ref[0, 0].reshape(-1, 1)            # log2 units
         delta = delta_ref[0, 0].reshape(-1, 1)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                          # [bq, bk] f32
+        s2 = _scores(q_ref, k_ref, qi, ki, scale, causal, block_q, block_k)
+        p = jnp.exp2(s2 - lse)                        # [bq, bk] f32
         p_in = p.astype(q.dtype)
         dv_sc[:] += jax.lax.dot_general(
             p_in, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32

@@ -289,7 +289,8 @@ class Master {
   // id and object counts.
   void set_telemetry(const std::string& url, int interval_sec) {
     telemetry_url_ = url;
-    telemetry_interval_sec_ = interval_sec;
+    // clamp: 0 (atoi of a typo) would busy-loop the telemetry thread
+    telemetry_interval_sec_ = std::max(interval_sec, 1);
     if (url.empty()) return;
     // cluster id: random, persisted so restarts stay one cluster
     std::string path = state_dir_ + "/cluster_id";

@@ -150,11 +150,13 @@ inline std::string shell_quote(const std::string& s) {
   return out;
 }
 
-inline std::string run_capture(const std::string& cmd, int* exit_code = nullptr) {
+inline std::string run_capture(const std::string& cmd, int* exit_code = nullptr,
+                               bool merge_stderr = false) {
   std::string out;
-  // stderr folded into the capture so callers can distinguish "job not
-  // found" from "slurmctld unreachable"
-  FILE* f = popen((cmd + " 2>&1").c_str(), "r");
+  // merge_stderr: only status probes want stderr in-band (to distinguish
+  // "Invalid job id" from a slurmctld outage) — sbatch's id parse must
+  // never see warning text interleaved with "Submitted batch job N"
+  FILE* f = popen((cmd + (merge_stderr ? " 2>&1" : " 2>/dev/null")).c_str(), "r");
   if (!f) {
     if (exit_code != nullptr) *exit_code = 127;
     return out;
@@ -330,7 +332,8 @@ class SlurmBackend {
                                  const std::string& job_id) {
     int rc = 0;
     std::string out = rm_detail::run_capture(
-        pool.slurm_squeue + " -h -j " + rm_detail::shell_quote(job_id), &rc);
+        pool.slurm_squeue + " -h -j " + rm_detail::shell_quote(job_id), &rc,
+        /*merge_stderr=*/true);
     bool listed = out.find_first_not_of(" \t\r\n") != std::string::npos;
     // squeue says nothing about exit codes; the harness self-reports the
     // real code, the poll only notices disappearance (crash safety net).

@@ -53,6 +53,7 @@ class FakeKubeApiserver:
         self.url = f"http://127.0.0.1:{self.port}"
         self.jobs = {}  # name -> {"proc": Popen, "manifest": dict}
         self.requests = []  # (method, path)
+        self.delete_queries = []  # query strings of Job DELETEs
         self.lock = threading.Lock()
         server = self
 
@@ -106,9 +107,8 @@ class FakeKubeApiserver:
 
             def do_DELETE(self):
                 path, _, query = self.path.partition("?")
-                assert "propagationPolicy=Background" in query, (
-                    "Job DELETE must not orphan its pods"
-                )
+                with server.lock:
+                    server.delete_queries.append(query)
                 name = path.rsplit("/", 1)[-1]
                 with server.lock:
                     server.requests.append(("DELETE", self.path))
@@ -185,6 +185,10 @@ def test_kubernetes_pool_runs_experiment(tmp_path):
         while time.time() < deadline and kube.jobs:
             time.sleep(0.5)
         assert not kube.jobs
+        # deletes must not orphan the pods (Jobs' legacy default would)
+        with kube.lock:
+            assert all("propagationPolicy=Background" in q for q in kube.delete_queries)
+            assert kube.delete_queries
     finally:
         c.stop()
         kube.stop()
