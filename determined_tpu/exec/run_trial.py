@@ -139,9 +139,16 @@ def _install_log_shipper() -> None:
     # bound memory while the master is unreachable: keep the newest lines
     max_buffered = 10000
 
-    def post(lines) -> bool:
+    seq = [0]
+    pending: list = []  # last unacknowledged batch; resent verbatim
+
+    def post(lines, batch_seq) -> bool:
+        # batch_seq makes the retry loop at-least-once-safe: if the master
+        # stored a batch but answered too slowly, the identical re-send
+        # carries the same seq and is dropped server-side
         body = json.dumps(
-            {"trial_id": int(trial_id), "agent": agent, "lines": lines}
+            {"trial_id": int(trial_id), "agent": agent, "lines": lines,
+             "batch_seq": batch_seq}
         ).encode()
         req = urllib.request.Request(
             url,
@@ -159,15 +166,20 @@ def _install_log_shipper() -> None:
             return False
 
     def flush() -> None:
+        # a failed batch is retried as-is (same lines, same seq) before any
+        # new lines ship, so the server-side dedup stays exact
+        if pending:
+            if not post(pending, seq[0]):
+                return  # master still unreachable; new lines wait in batch
+            pending.clear()
+            seq[0] += 1
         with batch_lock:
             lines, batch[:] = batch[:], []
-        if lines and not post(lines):
-            # master unreachable: re-queue so the outage loses nothing
-            # (up to the buffer cap; the pump trims oldest-first past it)
-            with batch_lock:
-                batch[:0] = lines
-                if len(batch) > max_buffered:
-                    del batch[: len(batch) - max_buffered]
+        if lines:
+            if post(lines, seq[0]):
+                seq[0] += 1
+            else:
+                pending[:] = lines[-max_buffered:]
 
     def pump() -> None:
         # reader only: never blocks on the network, so a master outage

@@ -2211,6 +2211,7 @@ class Master {
   std::map<std::string, GenericTaskState> tasks_;
   int64_t next_task_id_ = 1;
   std::deque<Json> events_;  // recent journal events for /api/v1/events
+  std::map<std::string, int64_t> log_batch_seq_;  // trial/agent -> last seq
   std::map<std::string, std::set<int>> coord_ports_in_use_;  // host -> ports
 
   // metric and log records live in per-trial jsonl files under state_dir,
@@ -3639,6 +3640,17 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       return R::json("{}");
     }
     int64_t tid = body["trial_id"].as_int();
+    // at-least-once senders (the trial's own shipper retries batches the
+    // master received but answered too slowly) tag batches with a
+    // monotone batch_seq; replays are dropped here so retried batches
+    // cannot duplicate log lines
+    if (body.contains("batch_seq")) {
+      int64_t seq = body["batch_seq"].as_int(0);
+      std::string key = std::to_string(tid) + "/" + agent_id;
+      auto [it, fresh] = m.log_batch_seq_.try_emplace(key, -1);
+      if (!fresh && seq <= it->second) return R::json("{\"duplicate\":true}");
+      it->second = seq;
+    }
     for (const auto& line : body["lines"].elements()) {
       m.append_jsonl(m.logs_path(tid), line);
       if (line.is_string()) m.apply_log_policies(tid, line.as_string(), agent_id);

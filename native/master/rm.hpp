@@ -28,6 +28,7 @@
 
 #include <sys/wait.h>
 
+#include <cctype>
 #include <cstdio>
 #include <cstdlib>
 #include <filesystem>
@@ -313,15 +314,23 @@ class SlurmBackend {
     }
     std::filesystem::permissions(script_path,
                                  std::filesystem::perms::owner_all, ec);
+    // stderr merged so a rejection ("invalid partition") reaches *err;
+    // the id parse anchors on sbatch's fixed success phrase, so warning
+    // text interleaved around it cannot corrupt the parse
     std::string out = rm_detail::run_capture(
-        pool.slurm_sbatch + " " + rm_detail::shell_quote(script_path));
-    // "Submitted batch job 12345"
-    auto pos = out.find_last_of(' ');
-    std::string id =
-        pos == std::string::npos ? "" : out.substr(pos + 1);
-    while (!id.empty() && (id.back() == '\n' || id.back() == '\r')) id.pop_back();
-    if (id.empty() || id.find_first_not_of("0123456789") != std::string::npos) {
-      *err = "sbatch did not return a job id: " + out.substr(0, 200);
+        pool.slurm_sbatch + " " + rm_detail::shell_quote(script_path), nullptr,
+        /*merge_stderr=*/true);
+    const std::string phrase = "Submitted batch job ";
+    auto pos = out.find(phrase);
+    std::string id;
+    if (pos != std::string::npos) {
+      for (size_t i = pos + phrase.size();
+           i < out.size() && isdigit(static_cast<unsigned char>(out[i])); ++i) {
+        id += out[i];
+      }
+    }
+    if (id.empty()) {
+      *err = "sbatch did not return a job id: " + out.substr(0, 300);
       return false;
     }
     *job_id = id;

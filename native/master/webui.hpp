@@ -1,12 +1,13 @@
 // Embedded single-page WebUI served by the master at GET /.
 //
-// Reference: webui/react/ (~134k LoC React). First slice, redesigned to
-// match this control plane: a dependency-free static page that logs in
-// against /api/v1/auth/login (token in localStorage), then renders
-// experiments/trials (with inline SVG metric charts pulled from the
-// metrics API), agents/slots, the job queue, tasks (with proxy links),
-// and live-follows the /api/v1/events feed. Embedded in the binary so
-// deployment stays single-file.
+// Reference: webui/react/ (~134k LoC React). Redesigned to match this
+// control plane: a dependency-free static page that logs in against
+// /api/v1/auth/login (token in localStorage), then renders
+// experiments/trials (inline SVG metric charts, hparams, logs viewer,
+// lifecycle actions), agents/pools/slots, the job queue, tasks (with
+// proxy links), the model registry, users, webhooks, and live-follows
+// the /api/v1/events feed. Embedded in the binary so deployment stays
+// single-file.
 #pragma once
 
 namespace dtpu {
@@ -18,7 +19,11 @@ inline const char* kWebUIHtml = R"HTML(<!DOCTYPE html>
  header { background: #16213e; color: #fff; padding: .7rem 1.2rem;
           display: flex; justify-content: space-between; align-items: center; }
  header h1 { font-size: 1rem; margin: 0; }
- main { padding: 1rem 1.2rem; max-width: 1100px; }
+ nav { background: #f0f1f6; padding: .4rem 1.2rem; display: flex; gap: 1rem;
+       font-size: .85rem; }
+ nav a { cursor: pointer; color: #2d79c7; text-decoration: none; }
+ nav a.on { font-weight: 700; color: #16213e; }
+ main { padding: 1rem 1.2rem; max-width: 1180px; }
  h2 { font-size: .95rem; border-bottom: 1px solid #ddd; padding-bottom: .3rem;
       margin-top: 1.4rem; }
  table { border-collapse: collapse; width: 100%; font-size: .85rem; }
@@ -28,18 +33,22 @@ inline const char* kWebUIHtml = R"HTML(<!DOCTYPE html>
  .st-ACTIVE, .st-RUNNING { background: #2d79c7; } .st-COMPLETED { background: #2e9e5b; }
  .st-ERROR { background: #c0392b; } .st-PAUSED, .st-PENDING { background: #8a8a99; }
  .st-CANCELED, .st-STOPPED, .st-TERMINATED { background: #b07d2b; }
- button, input { font: inherit; padding: .25rem .6rem; }
+ button, input, select { font: inherit; padding: .25rem .6rem; }
+ button.mini { font-size: .72rem; padding: .1rem .45rem; margin-left: .25rem; }
  #login { margin: 3rem auto; max-width: 320px; display: flex;
           flex-direction: column; gap: .5rem; }
  .chart polyline { fill: none; stroke: #2d79c7; stroke-width: 1.5; }
  .chart text { font-size: .65rem; fill: #666; }
  details { margin: .3rem 0 .6rem; }
- #feed { font-family: ui-monospace, monospace; font-size: .75rem;
-         max-height: 180px; overflow-y: auto; background: #f7f7fb;
-         padding: .5rem; }
+ .mono, #feed { font-family: ui-monospace, monospace; font-size: .75rem; }
+ #feed, .logbox { max-height: 220px; overflow-y: auto; background: #f7f7fb;
+                  padding: .5rem; white-space: pre-wrap; }
+ .hp { color: #555; font-size: .75rem; }
  a { color: #2d79c7; }
+ .page { display: none; } .page.on { display: block; }
 </style></head><body>
 <header><h1>determined-tpu</h1><div id="who"></div></header>
+<nav id="nav"></nav>
 <div id="login" style="display:none">
   <h2>log in</h2>
   <input id="u" placeholder="username" value="determined">
@@ -47,15 +56,33 @@ inline const char* kWebUIHtml = R"HTML(<!DOCTYPE html>
   <button onclick="login()">login</button><div id="lerr"></div>
 </div>
 <main id="app" style="display:none">
-  <h2>cluster</h2><div id="cluster"></div>
-  <h2>experiments</h2><div id="exps"></div>
+ <div class="page" data-page="experiments">
+  <h2>experiments <select id="wsfilter" onchange="refresh()"><option value="">all workspaces</option></select></h2>
+  <div id="exps"></div>
   <h2>job queue</h2><div id="queue"></div>
+ </div>
+ <div class="page" data-page="cluster">
+  <h2>agents</h2><div id="cluster"></div>
+  <h2>resource pools</h2><div id="pools"></div>
   <h2>tasks</h2><div id="tasks"></div>
+ </div>
+ <div class="page" data-page="registry">
+  <h2>model registry</h2><div id="models"></div>
+  <h2>checkpoints</h2><div id="ckpts"></div>
+ </div>
+ <div class="page" data-page="admin">
+  <h2>users</h2><div id="users"></div>
+  <h2>webhooks</h2><div id="webhooks"></div>
+ </div>
+ <div class="page" data-page="activity">
   <h2>event feed</h2><div id="feed"></div>
+ </div>
 </main>
 <script>
 let TOK = localStorage.getItem("dtpu_token") || "";
 let lastSeq = 0;
+const PAGES = ["experiments", "cluster", "registry", "admin", "activity"];
+let PAGE = localStorage.getItem("dtpu_page") || "experiments";
 const $ = id => document.getElementById(id);
 async function api(path, opts = {}) {
   opts.headers = Object.assign({"Authorization": "Bearer " + TOK,
@@ -114,33 +141,115 @@ async function trialDetail(tid, el) {
   el.innerHTML = Object.entries(series).map(
     ([k, pts]) => `<div><b>${esc(k)}</b><br>${chart(pts)}</div>`).join("") || "(no metrics)";
 }
+async function trialLogs(tid, el) {
+  const rows = await api(`/api/v1/trials/${tid}/logs`);
+  el.innerHTML = `<div class="logbox mono">` +
+    rows.map(r => esc(r.line ?? "")).join("\n") + `</div>`;
+  el.firstChild.scrollTop = el.firstChild.scrollHeight;
+}
+async function expAction(id, verb) {
+  if ((verb === "kill" || verb === "delete") &&
+      !confirm(`${verb} experiment ${id}?`)) return;
+  if (verb === "delete") {
+    await api(`/api/v1/experiments/${id}`, {method: "DELETE"});
+  } else {
+    await api(`/api/v1/experiments/${id}/${verb}`, {method: "POST"});
+  }
+  refresh();
+}
+function actions(e) {
+  const b = (verb) =>
+    `<button class="mini" onclick="event.stopPropagation();expAction(${Number(e.id)},'${verb}')">${verb}</button>`;
+  let out = "";
+  if (e.state === "ACTIVE") out += b("pause") + b("cancel") + b("kill");
+  if (e.state === "PAUSED") out += b("activate") + b("cancel");
+  if (["COMPLETED","ERROR","CANCELED"].includes(e.state)) out += b("delete");
+  return out;
+}
+function hpline(h) {
+  const parts = Object.entries(h || {}).map(([k, v]) =>
+    `${esc(k)}=${esc(typeof v === "object" ? JSON.stringify(v) : v)}`);
+  return parts.length ? `<div class="hp">${parts.join("  ")}</div>` : "";
+}
+function setPage(p) {
+  PAGE = p; localStorage.setItem("dtpu_page", p);
+  document.querySelectorAll(".page").forEach(el =>
+    el.classList.toggle("on", el.dataset.page === p));
+  document.querySelectorAll("nav a").forEach(a =>
+    a.classList.toggle("on", a.dataset.page === p));
+  refresh();
+}
+function nav() {
+  $("nav").innerHTML = PAGES.map(p =>
+    `<a data-page="${p}" onclick="setPage('${p}')">${p}</a>`).join("");
+}
 async function refresh() {
-  const [info, agents, exps, queue, tasks] = await Promise.all([
-    api("/api/v1/master"), api("/api/v1/agents"), api("/api/v1/experiments"),
-    api("/api/v1/job-queue"), api("/api/v1/tasks")]);
-  $("cluster").innerHTML = table(agents.map(a => ({id: a.id, host: a.host,
-    pool: a.pool, slots: `${a.used_slots}/${a.slots}`})),
-    ["id", "host", "pool", "slots"]);
-  $("exps").innerHTML = exps.slice().reverse().map(e => {
-    const trials = (e.trials || []).map(t =>
-      `<tr><td>${Number(t.id)}</td><td>${badge(t.state)}</td><td>${Number(t.restarts)}</td>` +
-      `<td>${Math.round((t.progress||0)*100)}%</td>` +
-      `<td><a href="#" onclick="event.preventDefault();` +
-      `trialDetail(${Number(t.id)}, this.closest('details').querySelector('.td'))">metrics</a></td></tr>`
-    ).join("");
-    return `<details><summary>#${Number(e.id)} <b>${esc(e.name)}</b> ${badge(e.state)} ` +
-      `${Math.round((e.progress||0)*100)}% — ${esc(e.owner)}</summary>` +
-      `<table><tr><th>trial</th><th>state</th><th>restarts</th>` +
-      `<th>progress</th><th></th></tr>${trials}</table><div class="td"></div></details>`;
-  }).join("") || "<p>(none)</p>";
-  $("queue").innerHTML = table(queue.map(j => ({trial: j.trial_id,
-    exp: j.experiment_id, state: badge(j.state), _raw_state: 1,
-    pri: j.priority, pool: j.resource_pool, slots: j.slots})),
-    ["trial", "exp", "state", "pri", "pool", "slots"]);
-  $("tasks").innerHTML = table(tasks.map(t => ({id: t.id, type: t.type,
-    state: badge(t.state), _raw_state: 1, _raw_link: 1,
-    link: t.ready ? `<a href="/proxy/${encodeURIComponent(t.id)}/?dtpu_token=${encodeURIComponent(TOK)}" target="_blank">open</a>` : ""})),
-    ["id", "type", "state", "link"]);
+  if (PAGE === "experiments") {
+    const [exps, queue] = await Promise.all([
+      api("/api/v1/experiments"), api("/api/v1/job-queue")]);
+    const wss = [...new Set(exps.map(e => e.workspace || "Uncategorized"))].sort();
+    const sel = $("wsfilter"), cur = sel.value;
+    sel.innerHTML = `<option value="">all workspaces</option>` +
+      wss.map(w => `<option${w === cur ? " selected" : ""}>${esc(w)}</option>`).join("");
+    const shown = cur ? exps.filter(e => (e.workspace || "Uncategorized") === cur) : exps;
+    $("exps").innerHTML = shown.slice().reverse().map(e => {
+      const trials = (e.trials || []).map(t => {
+        return `<tr><td>${Number(t.id)}</td><td>${badge(t.state)}</td>` +
+          `<td>${Number(t.restarts)}</td>` +
+          `<td>${Math.round((t.progress||0)*100)}%</td>` +
+          `<td class="hp">${hpline(t.hparams)}</td>` +
+          `<td><a href="#" onclick="event.preventDefault();` +
+          `trialDetail(${Number(t.id)}, this.closest('details').querySelector('.td'))">metrics</a> ` +
+          `<a href="#" onclick="event.preventDefault();` +
+          `trialLogs(${Number(t.id)}, this.closest('details').querySelector('.td'))">logs</a></td></tr>`;
+      }).join("");
+      return `<details><summary>#${Number(e.id)} <b>${esc(e.name)}</b> ${badge(e.state)} ` +
+        `${Math.round((e.progress||0)*100)}% — ${esc(e.owner)} ` +
+        `<span class="hp">${esc(e.workspace || "")}${e.project ? " / " + esc(e.project) : ""}</span>` +
+        `${actions(e)}</summary>` +
+        `<table><tr><th>trial</th><th>state</th><th>restarts</th>` +
+        `<th>progress</th><th>hparams</th><th></th></tr>${trials}</table><div class="td"></div></details>`;
+    }).join("") || "<p>(none)</p>";
+    $("queue").innerHTML = table(queue.map(j => ({trial: j.trial_id,
+      exp: j.experiment_id, state: badge(j.state), _raw_state: 1,
+      pri: j.priority, pool: j.resource_pool, slots: j.slots})),
+      ["trial", "exp", "state", "pri", "pool", "slots"]);
+  } else if (PAGE === "cluster") {
+    const [agents, pools, tasks] = await Promise.all([
+      api("/api/v1/agents"), api("/api/v1/resource-pools"), api("/api/v1/tasks")]);
+    $("cluster").innerHTML = table(agents.map(a => ({id: a.id, host: a.host,
+      pool: a.pool, type: a.slot_type, slots: `${a.used_slots}/${a.slots}`})),
+      ["id", "host", "pool", "type", "slots"]);
+    $("pools").innerHTML = table(pools.map(p => ({name: p.name, type: p.type,
+      agents: p.agents, slots: `${p.used_slots}/${p.slots}`,
+      provisioned: p.provisioned ? "yes" : ""})),
+      ["name", "type", "agents", "slots", "provisioned"]);
+    $("tasks").innerHTML = table(tasks.map(t => ({id: t.id, type: t.type,
+      state: badge(t.state), _raw_state: 1, _raw_link: 1,
+      link: t.ready ? `<a href="/proxy/${encodeURIComponent(t.id)}/?dtpu_token=${encodeURIComponent(TOK)}" target="_blank">open</a>` : ""})),
+      ["id", "type", "state", "link"]);
+  } else if (PAGE === "registry") {
+    const [models, ckpts] = await Promise.all([
+      api("/api/v1/models"), api("/api/v1/checkpoints")]);
+    $("models").innerHTML = models.map(m =>
+      `<details><summary><b>${esc(m.name)}</b> — ${(m.versions || []).length} version(s)</summary>` +
+      table((m.versions || []).map(v => ({version: v.version,
+        checkpoint: v.checkpoint_uuid, notes: v.notes || ""})),
+        ["version", "checkpoint", "notes"]) + `</details>`).join("") || "<p>(none)</p>";
+    $("ckpts").innerHTML = table(ckpts.slice(-60).reverse().map(c => ({
+      uuid: c.uuid, trial: c.trial_id, step: c.steps_completed,
+      state: badge(c.state || "COMPLETED"), _raw_state: 1})),
+      ["uuid", "trial", "step", "state"]);
+  } else if (PAGE === "admin") {
+    const [users, hooks] = await Promise.all([
+      api("/api/v1/users"), api("/api/v1/webhooks")]);
+    $("users").innerHTML = table(users.map(u => ({username: u.username,
+      role: u.role || (u.admin ? "admin" : "user")})), ["username", "role"]);
+    $("webhooks").innerHTML = table(hooks.map(w => ({id: w.id, name: w.name,
+      url: w.url, triggers: (w.trigger_states || []).join(","),
+      custom: w.on_custom ? "yes" : ""})),
+      ["id", "name", "url", "triggers", "custom"]);
+  }
 }
 async function followEvents() {
   while (true) {
@@ -163,7 +272,7 @@ async function boot() {
     const who = await api("/api/v1/auth/whoami");
     $("who").textContent = who.username;
     $("login").style.display = "none"; $("app").style.display = "";
-    await refresh();
+    nav(); setPage(PAGE);
     if (!pollersStarted) {  // re-login must not stack pollers
       pollersStarted = true;
       followEvents();
