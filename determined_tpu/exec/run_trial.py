@@ -141,14 +141,17 @@ def _install_log_shipper() -> None:
 
     seq = [0]
     pending: list = []  # last unacknowledged batch; resent verbatim
+    flush_lock = threading.Lock()  # sender thread vs the exit-path flush
+    alloc_id = os.environ.get("DTPU_ALLOCATION_ID", "")
 
     def post(lines, batch_seq) -> bool:
-        # batch_seq makes the retry loop at-least-once-safe: if the master
-        # stored a batch but answered too slowly, the identical re-send
-        # carries the same seq and is dropped server-side
+        # batch_seq (scoped to this allocation server-side) makes the
+        # retry loop at-least-once-safe: if the master stored a batch but
+        # answered too slowly, the identical re-send carries the same seq
+        # and is dropped server-side
         body = json.dumps(
             {"trial_id": int(trial_id), "agent": agent, "lines": lines,
-             "batch_seq": batch_seq}
+             "allocation_id": alloc_id, "batch_seq": batch_seq}
         ).encode()
         req = urllib.request.Request(
             url,
@@ -167,19 +170,23 @@ def _install_log_shipper() -> None:
 
     def flush() -> None:
         # a failed batch is retried as-is (same lines, same seq) before any
-        # new lines ship, so the server-side dedup stays exact
-        if pending:
-            if not post(pending, seq[0]):
-                return  # master still unreachable; new lines wait in batch
-            pending.clear()
-            seq[0] += 1
-        with batch_lock:
-            lines, batch[:] = batch[:], []
-        if lines:
-            if post(lines, seq[0]):
+        # new lines ship, so the server-side dedup stays exact.  flush_lock
+        # serializes the sender thread against the exit-path flush — two
+        # concurrent flushes could otherwise post different batches under
+        # one seq (one of them silently dropped as a duplicate).
+        with flush_lock:
+            if pending:
+                if not post(pending, seq[0]):
+                    return  # master still unreachable; new lines wait
+                pending.clear()
                 seq[0] += 1
-            else:
-                pending[:] = lines[-max_buffered:]
+            with batch_lock:
+                lines, batch[:] = batch[:], []
+            if lines:
+                if post(lines, seq[0]):
+                    seq[0] += 1
+                else:
+                    pending[:] = lines[-max_buffered:]
 
     def pump() -> None:
         # reader only: never blocks on the network, so a master outage

@@ -1,5 +1,6 @@
-"""Model zoo: MNIST tutorials + flagship transformer LM."""
+"""Model zoo: MNIST tutorials, flagship transformer LM, DDPM diffusion."""
 
+from determined_tpu.models.diffusion import DiffusionTrial, UNet, ddpm_sample
 from determined_tpu.models.mnist import MnistCNN, MnistMLP, MnistTrial
 from determined_tpu.models.transformer import (
     LMTrial,
@@ -8,6 +9,9 @@ from determined_tpu.models.transformer import (
 )
 
 __all__ = [
+    "DiffusionTrial",
+    "UNet",
+    "ddpm_sample",
     "MnistCNN",
     "MnistMLP",
     "MnistTrial",

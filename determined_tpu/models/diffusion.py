@@ -1,0 +1,255 @@
+"""Denoising diffusion (DDPM) model family: UNet + noise-prediction trial.
+
+Reference parity: the reference ships a diffusion example family
+(``examples/diffusion/``, a HF-diffusers textual-inversion fine-tune under
+Core API).  TPU-first redesign rather than a wrapper: a self-contained
+flax UNet whose convs/denses carry logical partitioning axes (the same
+mesh machinery as every other model family), a cosine noise schedule, a
+jittable training loss (random-timestep epsilon prediction), and an
+ancestral sampler expressed as ``lax.scan`` so the entire reverse process
+is one compiled loop — no Python stepping, no host syncs (SURVEY §7:
+compiler-friendly control flow).
+
+Convs run on the MXU as implicit GEMMs; channel widths carry the "mlp"
+logical axis so a tensor mesh axis shards them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from determined_tpu.data import DataLoader, mnist_like
+from determined_tpu.train._trial import JaxTrial
+
+
+def timestep_embedding(t: jax.Array, dim: int, max_period: int = 10000) -> jax.Array:
+    """Sinusoidal timestep embedding [batch, dim] (f32 for stable freqs)."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class ResBlock(nn.Module):
+    """Conv residual block with time-embedding FiLM conditioning."""
+
+    channels: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, temb: jax.Array) -> jax.Array:
+        h = nn.GroupNorm(num_groups=min(8, x.shape[-1]), dtype=self.dtype)(x)
+        h = nn.silu(h)
+        h = nn.Conv(
+            self.channels, (3, 3), dtype=self.dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), (None, None, None, "mlp")
+            ),
+            name="conv1",
+        )(h)
+        # FiLM: scale/shift from the time embedding
+        ss = nn.Dense(2 * self.channels, dtype=self.dtype, name="temb_proj")(
+            nn.silu(temb)
+        )
+        scale, shift = jnp.split(ss[:, None, None, :], 2, axis=-1)
+        h = nn.GroupNorm(num_groups=min(8, self.channels), dtype=self.dtype)(h)
+        h = h * (1 + scale) + shift
+        h = nn.silu(h)
+        h = nn.Conv(
+            self.channels, (3, 3), dtype=self.dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.zeros_init(), (None, None, None, "mlp")
+            ),
+            name="conv2",
+        )(h)
+        if x.shape[-1] != self.channels:
+            x = nn.Conv(self.channels, (1, 1), dtype=self.dtype, name="skip")(x)
+        return x + h
+
+
+class SelfAttention2D(nn.Module):
+    """Full self-attention over the (small) lowest-resolution feature map."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, h, w, c = x.shape
+        y = nn.GroupNorm(num_groups=min(8, c), dtype=self.dtype)(x)
+        y = y.reshape(b, h * w, c)
+        qkv = nn.Dense(3 * c, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        scale = c ** -0.5
+        attn = jax.nn.softmax(
+            jnp.einsum("bqc,bkc->bqk", q, k) * scale, axis=-1
+        )
+        y = jnp.einsum("bqk,bkc->bqc", attn, v)
+        y = nn.Dense(c, dtype=self.dtype, kernel_init=nn.initializers.zeros_init(),
+                     name="proj")(y)
+        return x + y.reshape(b, h, w, c)
+
+
+class UNet(nn.Module):
+    """Small DDPM UNet: down/up path with skip connections, attention at
+    the bottleneck.  Sized by ``base_channels`` (default fits tests; real
+    runs scale it up — convs are MXU-bound so width is the lever)."""
+
+    base_channels: int = 32
+    channel_mults: Tuple[int, ...] = (1, 2)
+    out_channels: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, t: jax.Array) -> jax.Array:
+        ch = self.base_channels
+        temb = timestep_embedding(t, ch * 4).astype(self.dtype)
+        temb = nn.Dense(ch * 4, dtype=self.dtype, name="temb1")(temb)
+        temb = nn.Dense(ch * 4, dtype=self.dtype, name="temb2")(nn.silu(temb))
+
+        h = nn.Conv(ch, (3, 3), dtype=self.dtype, name="stem")(x.astype(self.dtype))
+        # down path: skip saved per level BEFORE pooling, so each up level
+        # concatenates a same-resolution tensor
+        skips = []
+        for i, mult in enumerate(self.channel_mults):
+            h = ResBlock(ch * mult, self.dtype, name=f"down{i}")(h, temb)
+            skips.append(h)
+            if i < len(self.channel_mults) - 1:
+                h = nn.avg_pool(h, (2, 2), strides=(2, 2))
+        # bottleneck with attention
+        mid = ch * self.channel_mults[-1]
+        h = ResBlock(mid, self.dtype, name="mid1")(h, temb)
+        h = SelfAttention2D(self.dtype, name="mid_attn")(h)
+        h = ResBlock(mid, self.dtype, name="mid2")(h, temb)
+        # up path
+        for i, mult in reversed(list(enumerate(self.channel_mults))):
+            h = jnp.concatenate([h, skips.pop()], axis=-1)
+            h = ResBlock(ch * mult, self.dtype, name=f"up{i}")(h, temb)
+            if i > 0:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+        h = nn.GroupNorm(num_groups=min(8, h.shape[-1]), dtype=self.dtype)(h)
+        h = nn.silu(h)
+        return nn.Conv(
+            self.out_channels, (3, 3), dtype=self.dtype,
+            kernel_init=nn.initializers.zeros_init(), name="head",
+        )(h).astype(jnp.float32)
+
+
+def cosine_schedule(timesteps: int, s: float = 0.008) -> Dict[str, jax.Array]:
+    """DDPM cosine betas -> the alpha-bar tables the loss/sampler need."""
+    steps = jnp.arange(timesteps + 1, dtype=jnp.float32)
+    f = jnp.cos(((steps / timesteps) + s) / (1 + s) * math.pi / 2) ** 2
+    alpha_bar = f / f[0]
+    betas = jnp.clip(1 - alpha_bar[1:] / alpha_bar[:-1], 0, 0.999)
+    alphas = 1 - betas
+    alpha_bar = jnp.cumprod(alphas)
+    return {
+        "betas": betas,
+        "alphas": alphas,
+        "alpha_bar": alpha_bar,
+        "sqrt_ab": jnp.sqrt(alpha_bar),
+        "sqrt_1mab": jnp.sqrt(1 - alpha_bar),
+    }
+
+
+def ddpm_sample(
+    model: nn.Module,
+    params: Any,
+    rng: jax.Array,
+    shape: Tuple[int, ...],
+    timesteps: int = 1000,
+) -> jax.Array:
+    """Ancestral sampling as ONE ``lax.scan`` over t = T-1..0 — the whole
+    reverse chain compiles to a single device loop."""
+    sched = cosine_schedule(timesteps)
+
+    def step(x, t):
+        eps = model.apply(params, x, jnp.full((shape[0],), t))
+        beta = sched["betas"][t]
+        alpha = sched["alphas"][t]
+        ab = sched["alpha_bar"][t]
+        mean = (x - beta / jnp.sqrt(1 - ab) * eps) / jnp.sqrt(alpha)
+        noise = jax.random.normal(jax.random.fold_in(rng, t), shape)
+        x = mean + jnp.where(t > 0, jnp.sqrt(beta), 0.0) * noise
+        return x, None
+
+    x0 = jax.random.normal(rng, shape)
+    x, _ = jax.lax.scan(step, x0, jnp.arange(timesteps - 1, -1, -1))
+    return x
+
+
+class DiffusionTrial(JaxTrial):
+    """Epsilon-prediction DDPM training (Ho et al. simple loss).
+
+    Hyperparameters: lr, base_channels, timesteps, global_batch_size,
+    dataset_size, bf16.
+    """
+
+    def _hp(self, name, default):
+        return self.context.get_hparam(name, default)
+
+    def build_model(self) -> UNet:
+        return UNet(
+            base_channels=int(self._hp("base_channels", 32)),
+            dtype=jnp.bfloat16 if bool(self._hp("bf16", False)) else jnp.float32,
+        )
+
+    def build_optimizer(self) -> optax.GradientTransformation:
+        return optax.adamw(float(self._hp("lr", 2e-4)))
+
+    def _dataset(self, train: bool):
+        return mnist_like(
+            size=int(self._hp("dataset_size", 4096)), seed=0 if train else 1
+        )
+
+    def build_training_data_loader(self) -> DataLoader:
+        return DataLoader(
+            self._dataset(train=True),
+            self.context.get_global_batch_size(),
+            shuffle=True,
+            seed=self.context.seed,
+        )
+
+    def build_validation_data_loader(self) -> DataLoader:
+        return DataLoader(
+            self._dataset(train=False),
+            self.context.get_global_batch_size(),
+            shuffle=False,
+            seed=self.context.seed,
+        )
+
+    def model_inputs(self, batch: Dict[str, Any]) -> Tuple[Any, ...]:
+        img = batch["image"]
+        return (img, jnp.zeros((img.shape[0],), jnp.int32))
+
+    def loss(
+        self, model: UNet, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        img = batch["image"].astype(jnp.float32) * 2.0 - 1.0  # [-1, 1]
+        timesteps = int(self._hp("timesteps", 1000))
+        sched = cosine_schedule(timesteps)
+        t_rng, n_rng = jax.random.split(rng)
+        t = jax.random.randint(t_rng, (img.shape[0],), 0, timesteps)
+        eps = jax.random.normal(n_rng, img.shape)
+        x_t = (
+            sched["sqrt_ab"][t][:, None, None, None] * img
+            + sched["sqrt_1mab"][t][:, None, None, None] * eps
+        )
+        pred = model.apply(params, x_t, t)
+        loss = jnp.mean((pred - eps) ** 2)
+        return loss, {"mse": loss}
+
+    def evaluate_batch(
+        self, model: UNet, params: Any, batch: Dict[str, jax.Array]
+    ) -> Dict[str, jax.Array]:
+        # fixed rng -> deterministic validation (same t/noise every epoch)
+        loss, _ = self.loss(model, params, batch, jax.random.key(0))
+        return {"validation_loss": loss}

@@ -1,0 +1,13 @@
+"""DDPM diffusion trial — the platform's diffusion example family
+(reference: examples/diffusion/, a HF-diffusers fine-tune under Core API;
+here an in-tree TPU-native UNet + DDPM, see
+determined_tpu/models/diffusion.py).  Submit with:
+
+    dtpu experiment create examples/diffusion/const.yaml examples/diffusion
+"""
+
+from determined_tpu.models.diffusion import DiffusionTrial
+
+
+class Trial(DiffusionTrial):
+    """Direct reuse of the in-tree DDPM trial; subclass to customize."""

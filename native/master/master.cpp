@@ -1744,6 +1744,7 @@ class Master {
       coord_ports_in_use_[it->second.coord_host].erase(it->second.chief_port);
     }
     revoke_token(it->second.session_token);
+    log_batch_seq_.erase(std::to_string(it->second.trial_id) + "/" + alloc_id);
   }
 
   void kill_allocation(AllocationState& alloc) {
@@ -3646,7 +3647,12 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     // cannot duplicate log lines
     if (body.contains("batch_seq")) {
       int64_t seq = body["batch_seq"].as_int(0);
-      std::string key = std::to_string(tid) + "/" + agent_id;
+      // keyed per ALLOCATION, not per trial: a restarted trial's shipper
+      // starts back at seq 0 under a fresh allocation id and must not
+      // collide with the dead run's watermark (entries die with the
+      // allocation in end_allocation)
+      std::string key = std::to_string(tid) + "/" +
+                        body["allocation_id"].as_string();
       auto [it, fresh] = m.log_batch_seq_.try_emplace(key, -1);
       if (!fresh && seq <= it->second) return R::json("{\"duplicate\":true}");
       it->second = seq;
@@ -3669,6 +3675,19 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     {
       std::lock_guard<std::mutex> lk(m.mu_);
       path = m.logs_path(tid);
+    }
+    // tail=N: the last N lines (what a logs viewer wants); implemented as
+    // a count pass + offset so read_jsonl stays the single reader
+    auto t = req.query.find("tail");
+    if (t != req.query.end()) {
+      limit = std::min(std::stoul(t->second), 10000ul);
+      size_t total = 0;
+      {
+        std::ifstream in(path);
+        std::string line;
+        while (std::getline(in, line)) ++total;
+      }
+      offset = total > limit ? total - limit : 0;
     }
     Json out = Master::read_jsonl(path, offset, limit, nullptr);
     return R::json(out.dump());

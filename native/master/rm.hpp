@@ -154,9 +154,11 @@ inline std::string shell_quote(const std::string& s) {
 inline std::string run_capture(const std::string& cmd, int* exit_code = nullptr,
                                bool merge_stderr = false) {
   std::string out;
-  // merge_stderr: only status probes want stderr in-band (to distinguish
-  // "Invalid job id" from a slurmctld outage) — sbatch's id parse must
-  // never see warning text interleaved with "Submitted batch job N"
+  // merge_stderr folds diagnostics in-band: status probes distinguish
+  // "Invalid job id" from a slurmctld outage, and submit surfaces sbatch
+  // rejections ("invalid partition") into its error message — submit's
+  // id parse anchors on the fixed success phrase, so interleaved warning
+  // text cannot corrupt it
   FILE* f = popen((cmd + (merge_stderr ? " 2>&1" : " 2>/dev/null")).c_str(), "r");
   if (!f) {
     if (exit_code != nullptr) *exit_code = 127;

@@ -142,9 +142,12 @@ async function trialDetail(tid, el) {
     ([k, pts]) => `<div><b>${esc(k)}</b><br>${chart(pts)}</div>`).join("") || "(no metrics)";
 }
 async function trialLogs(tid, el) {
-  const rows = await api(`/api/v1/trials/${tid}/logs`);
-  el.innerHTML = `<div class="logbox mono">` +
-    rows.map(r => esc(r.line ?? "")).join("\n") + `</div>`;
+  const rows = await api(`/api/v1/trials/${tid}/logs?tail=1000`);
+  // shipped rows are plain strings; master-synthesized rows are
+  // {ts, level, line} records
+  const text = rows.map(r =>
+    typeof r === "string" ? r : (r.line ?? JSON.stringify(r))).join("\n");
+  el.innerHTML = `<div class="logbox mono">` + esc(text) + `</div>`;
   el.firstChild.scrollTop = el.firstChild.scrollHeight;
 }
 async function expAction(id, verb) {
