@@ -1,4 +1,6 @@
-"""Model zoo: MNIST tutorials, flagship transformer LM, DDPM diffusion."""
+"""Model zoo: MNIST tutorials, flagship transformer LM, DDPM diffusion,
+HF Flax fine-tune families (BERT, GPT-2 — imported lazily from their
+modules to keep transformers optional)."""
 
 from determined_tpu.models.diffusion import DiffusionTrial, UNet, ddpm_sample
 from determined_tpu.models.mnist import MnistCNN, MnistMLP, MnistTrial

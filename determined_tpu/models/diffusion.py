@@ -28,6 +28,15 @@ from determined_tpu.data import DataLoader, mnist_like
 from determined_tpu.train._trial import JaxTrial
 
 
+def _groups(channels: int, want: int = 8) -> int:
+    """Largest group count <= want that divides the channel width — any
+    base_channels value is valid (GroupNorm requires divisibility)."""
+    g = min(want, channels)
+    while channels % g:
+        g -= 1
+    return g
+
+
 def timestep_embedding(t: jax.Array, dim: int, max_period: int = 10000) -> jax.Array:
     """Sinusoidal timestep embedding [batch, dim] (f32 for stable freqs)."""
     half = dim // 2
@@ -46,7 +55,7 @@ class ResBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, temb: jax.Array) -> jax.Array:
-        h = nn.GroupNorm(num_groups=min(8, x.shape[-1]), dtype=self.dtype)(x)
+        h = nn.GroupNorm(num_groups=_groups(x.shape[-1]), dtype=self.dtype)(x)
         h = nn.silu(h)
         h = nn.Conv(
             self.channels, (3, 3), dtype=self.dtype,
@@ -60,7 +69,7 @@ class ResBlock(nn.Module):
             nn.silu(temb)
         )
         scale, shift = jnp.split(ss[:, None, None, :], 2, axis=-1)
-        h = nn.GroupNorm(num_groups=min(8, self.channels), dtype=self.dtype)(h)
+        h = nn.GroupNorm(num_groups=_groups(self.channels), dtype=self.dtype)(h)
         h = h * (1 + scale) + shift
         h = nn.silu(h)
         h = nn.Conv(
@@ -83,7 +92,7 @@ class SelfAttention2D(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         b, h, w, c = x.shape
-        y = nn.GroupNorm(num_groups=min(8, c), dtype=self.dtype)(x)
+        y = nn.GroupNorm(num_groups=_groups(c), dtype=self.dtype)(x)
         y = y.reshape(b, h * w, c)
         qkv = nn.Dense(3 * c, dtype=self.dtype, name="qkv")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -135,7 +144,7 @@ class UNet(nn.Module):
             if i > 0:
                 b, hh, ww, c = h.shape
                 h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
-        h = nn.GroupNorm(num_groups=min(8, h.shape[-1]), dtype=self.dtype)(h)
+        h = nn.GroupNorm(num_groups=_groups(h.shape[-1]), dtype=self.dtype)(h)
         h = nn.silu(h)
         return nn.Conv(
             self.out_channels, (3, 3), dtype=self.dtype,

@@ -39,17 +39,32 @@ def synthetic_classification(
 
 
 class _BertModule:
-    """Thin holder so build_model returns one object with config attached."""
+    """Thin holder so build_model returns one object with config attached.
 
-    def __init__(self, config, seed: int) -> None:
+    ``pretrained_dir``: local ``save_pretrained`` directory — its weights
+    become the initial params, so the trial is a true fine-tune; no
+    network is touched.
+    """
+
+    def __init__(self, config, seed: int, pretrained_dir: str = "") -> None:
         from transformers import FlaxBertForSequenceClassification
 
         self.config = config
-        self.module = FlaxBertForSequenceClassification(
-            config, seed=seed, _do_init=False
-        ).module
+        self._pretrained = None
+        if pretrained_dir:
+            loaded = FlaxBertForSequenceClassification.from_pretrained(
+                pretrained_dir, config=config, local_files_only=True
+            )
+            self._pretrained = {"params": loaded.params}
+            self.module = loaded.module
+        else:
+            self.module = FlaxBertForSequenceClassification(
+                config, seed=seed, _do_init=False
+            ).module
 
     def init(self, rng, input_ids):
+        if self._pretrained is not None:
+            return self._pretrained
         return self.module.init(
             rng,
             input_ids,
@@ -92,7 +107,10 @@ class BertClassifyTrial(JaxTrial):
             max_position_embeddings=max(int(self._hp("seq_len", 64)), 64),
             num_labels=int(self._hp("num_labels", 4)),
         )
-        return _BertModule(cfg, seed=self.context.seed)
+        return _BertModule(
+            cfg, seed=self.context.seed,
+            pretrained_dir=str(self._hp("pretrained_dir", "")),
+        )
 
     def build_optimizer(self) -> optax.GradientTransformation:
         lr = float(self._hp("lr", 5e-4))

@@ -1,0 +1,164 @@
+"""HuggingFace Flax GPT-2 causal-LM fine-tuning trial.
+
+Reference: ``examples/hf_trainer_api`` (HF Trainer + Core API callbacks;
+BASELINE.json's north-star names the BERT/GPT-2 fine-tunes).  Like the
+BERT family (``hf_bert.py``), the HF **Flax** module drops straight into
+the JaxTrial contract — the platform's jitted/donated step, mesh
+parallelism, checkpointing and preemption apply to an off-the-shelf
+transformers model with a page of glue.
+
+Offline by design: the model initializes from a ``GPT2Config`` (random
+weights) and trains on a synthetic Markov-chain language task whose
+next-token structure is learnable — TPU pods have no egress.  To
+fine-tune real weights, point ``hparams.pretrained_dir`` at a local
+``save_pretrained`` directory.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from determined_tpu.data import DataLoader, InMemoryDataset
+from determined_tpu.train._trial import JaxTrial
+
+
+def synthetic_lm(size: int, seq_len: int, vocab: int, seed: int) -> InMemoryDataset:
+    """Markov-chain token streams: each token strongly conditions the next
+    (one dominant successor per token, from a FIXED permutation shared by
+    train/val), so causal-LM loss has real structure to learn and falls
+    well below the uniform-vocabulary entropy."""
+    fixed = np.random.default_rng(1234)
+    successor = fixed.permutation(vocab).astype(np.int32)
+    rng = np.random.default_rng(seed)
+    ids = np.empty((size, seq_len), np.int32)
+    ids[:, 0] = rng.integers(0, vocab, size)
+    follow = rng.random((size, seq_len)) < 0.85
+    noise = rng.integers(0, vocab, (size, seq_len)).astype(np.int32)
+    for t in range(1, seq_len):
+        ids[:, t] = np.where(follow[:, t], successor[ids[:, t - 1]], noise[:, t])
+    return InMemoryDataset({"input_ids": ids})
+
+
+class _GPT2Module:
+    """Thin holder so build_model returns one object with config attached.
+
+    ``pretrained_dir``: local ``save_pretrained`` directory — its weights
+    become the initial params (returned by ``init``), so the trial is a
+    true fine-tune; no network is touched.
+    """
+
+    def __init__(self, config, seed: int, pretrained_dir: str = "") -> None:
+        from transformers import FlaxGPT2LMHeadModel
+
+        self.config = config
+        self._pretrained = None
+        if pretrained_dir:
+            loaded = FlaxGPT2LMHeadModel.from_pretrained(
+                pretrained_dir, config=config, local_files_only=True
+            )
+            self._pretrained = {"params": loaded.params}
+            self.module = loaded.module
+        else:
+            self.module = FlaxGPT2LMHeadModel(
+                config, seed=seed, _do_init=False
+            ).module
+
+    def init(self, rng, input_ids):
+        if self._pretrained is not None:
+            return self._pretrained
+        b, s = input_ids.shape
+        return self.module.init(
+            rng,
+            input_ids,
+            jnp.ones_like(input_ids),
+            jnp.broadcast_to(jnp.arange(s), (b, s)),
+            deterministic=True,
+        )
+
+    def apply(self, params, input_ids, deterministic=True, rngs=None):
+        b, s = input_ids.shape
+        return self.module.apply(
+            params,
+            input_ids,
+            jnp.ones_like(input_ids),
+            jnp.broadcast_to(jnp.arange(s), (b, s)),
+            deterministic=deterministic,
+            rngs=rngs,
+        )
+
+
+class GPT2FinetuneTrial(JaxTrial):
+    """hparams: lr, global_batch_size, seq_len, vocab_size, hidden_size,
+    num_layers, num_heads, dataset_size, warmup_steps."""
+
+    def _hp(self, name, default):
+        return self.context.get_hparam(name, default)
+
+    def build_model(self) -> _GPT2Module:
+        from transformers import GPT2Config
+
+        h = int(self._hp("hidden_size", 128))
+        cfg = GPT2Config(
+            vocab_size=int(self._hp("vocab_size", 512)),
+            n_positions=max(int(self._hp("seq_len", 64)), 64),
+            n_embd=h,
+            n_layer=int(self._hp("num_layers", 2)),
+            n_head=int(self._hp("num_heads", 4)),
+            n_inner=4 * h,
+        )
+        return _GPT2Module(
+            cfg, seed=self.context.seed,
+            pretrained_dir=str(self._hp("pretrained_dir", "")),
+        )
+
+    def build_optimizer(self) -> optax.GradientTransformation:
+        lr = float(self._hp("lr", 1e-3))
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, lr, int(self._hp("warmup_steps", 20)), int(self._hp("decay_steps", 2000))
+        )
+        return optax.adamw(schedule, weight_decay=0.01)
+
+    def _dataset(self, train: bool) -> InMemoryDataset:
+        return synthetic_lm(
+            size=int(self._hp("dataset_size", 1024)),
+            seq_len=int(self._hp("seq_len", 64)),
+            vocab=int(self._hp("vocab_size", 512)),
+            seed=0 if train else 1,
+        )
+
+    def build_training_data_loader(self) -> DataLoader:
+        return DataLoader(self._dataset(True), self.context.get_global_batch_size(),
+                          shuffle=True, seed=self.context.seed)
+
+    def build_validation_data_loader(self) -> DataLoader:
+        return DataLoader(self._dataset(False), self.context.get_global_batch_size(),
+                          shuffle=False, seed=self.context.seed)
+
+    def model_inputs(self, batch: Dict[str, Any]) -> Tuple[Any, ...]:
+        return (jnp.asarray(batch["input_ids"]),)
+
+    def init_params(self, model: _GPT2Module, rng: jax.Array, sample_batch):
+        return model.init(rng, jnp.asarray(sample_batch["input_ids"]))
+
+    def _lm_loss(self, logits: jax.Array, ids: jax.Array) -> jax.Array:
+        # standard causal shift: predict token t+1 from prefix ..t
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], ids[:, 1:]
+        ).mean()
+
+    def loss(self, model: _GPT2Module, params: Any, batch: Dict[str, jax.Array], rng):
+        out = model.apply(
+            params, batch["input_ids"], deterministic=False, rngs={"dropout": rng}
+        )
+        loss = self._lm_loss(out.logits, batch["input_ids"])
+        return loss, {"perplexity": jnp.exp(loss)}
+
+    def evaluate_batch(self, model: _GPT2Module, params: Any, batch):
+        out = model.apply(params, batch["input_ids"], deterministic=True)
+        loss = self._lm_loss(out.logits, batch["input_ids"])
+        return {"validation_loss": loss, "validation_perplexity": jnp.exp(loss)}

@@ -1,0 +1,11 @@
+"""HF Flax GPT-2 causal-LM fine-tune (reference: examples/hf_trainer_api;
+see determined_tpu/models/hf_gpt2.py).  Submit with:
+
+    dtpu experiment create examples/hf_gpt2/const.yaml examples/hf_gpt2
+"""
+
+from determined_tpu.models.hf_gpt2 import GPT2FinetuneTrial
+
+
+class Trial(GPT2FinetuneTrial):
+    """Direct reuse of the in-tree GPT-2 trial; subclass to customize."""

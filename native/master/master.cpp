@@ -2212,7 +2212,7 @@ class Master {
   std::map<std::string, GenericTaskState> tasks_;
   int64_t next_task_id_ = 1;
   std::deque<Json> events_;  // recent journal events for /api/v1/events
-  std::map<std::string, int64_t> log_batch_seq_;  // trial/agent -> last seq
+  std::map<std::string, int64_t> log_batch_seq_;  // trial/allocation -> last seq
   std::map<std::string, std::set<int>> coord_ports_in_use_;  // host -> ports
 
   // metric and log records live in per-trial jsonl files under state_dir,
@@ -2251,6 +2251,25 @@ class Master {
       if (matched++ < offset) continue;
       out.push_back(rec);
     }
+    return out;
+  }
+
+  // last `limit` parsed records of a jsonl file (one pass, bounded
+  // memory): tail semantics must count PARSED records exactly like
+  // read_jsonl, not raw lines — torn/empty lines would shift the window
+  static Json read_jsonl_tail(const std::string& path, size_t limit) {
+    std::deque<Json> keep;
+    std::ifstream in(path);
+    std::string line;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      Json rec;
+      if (!Json::try_parse(line, &rec)) continue;
+      keep.push_back(std::move(rec));
+      if (keep.size() > limit) keep.pop_front();
+    }
+    Json out = Json::array();
+    for (auto& r : keep) out.push_back(std::move(r));
     return out;
   }
 
@@ -3676,18 +3695,12 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       std::lock_guard<std::mutex> lk(m.mu_);
       path = m.logs_path(tid);
     }
-    // tail=N: the last N lines (what a logs viewer wants); implemented as
-    // a count pass + offset so read_jsonl stays the single reader
+    // tail=N: the last N records (what a logs viewer wants)
     auto t = req.query.find("tail");
     if (t != req.query.end()) {
-      limit = std::min(std::stoul(t->second), 10000ul);
-      size_t total = 0;
-      {
-        std::ifstream in(path);
-        std::string line;
-        while (std::getline(in, line)) ++total;
-      }
-      offset = total > limit ? total - limit : 0;
+      Json out = Master::read_jsonl_tail(
+          path, std::min(std::stoul(t->second), 10000ul));
+      return R::json(out.dump());
     }
     Json out = Master::read_jsonl(path, offset, limit, nullptr);
     return R::json(out.dump());
