@@ -1,0 +1,53 @@
+"""Shared plumbing for the HuggingFace Flax trial families.
+
+One holder pattern serves every HF family (BERT, GPT-2, ...): it wraps
+the raw flax ``.module`` so ``build_model`` returns a single object with
+the config attached, and implements the offline ``pretrained_dir``
+contract — a local ``save_pretrained`` directory's weights become the
+initial params (returned by ``init``), so the trial is a true fine-tune
+with no network touched.  Subclasses supply the transformers model class
+and the positional forward arguments their architecture expects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+
+class HFModuleHolder:
+    """Base holder; subclasses define ``_model_cls`` and ``_forward_args``."""
+
+    def __init__(self, config, seed: int, pretrained_dir: str = "") -> None:
+        model_cls = self._model_cls()
+        self.config = config
+        self._pretrained = None
+        if pretrained_dir:
+            loaded = model_cls.from_pretrained(
+                pretrained_dir, config=config, local_files_only=True
+            )
+            self._pretrained = {"params": loaded.params}
+            self.module = loaded.module
+        else:
+            self.module = model_cls(config, seed=seed, _do_init=False).module
+
+    @classmethod
+    def _model_cls(cls):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _forward_args(self, input_ids) -> Tuple[Any, ...]:  # pragma: no cover
+        raise NotImplementedError
+
+    def init(self, rng, input_ids):
+        if self._pretrained is not None:
+            return self._pretrained
+        return self.module.init(
+            rng, *self._forward_args(input_ids), deterministic=True
+        )
+
+    def apply(self, params, input_ids, deterministic=True, rngs=None):
+        return self.module.apply(
+            params,
+            *self._forward_args(input_ids),
+            deterministic=deterministic,
+            rngs=rngs,
+        )

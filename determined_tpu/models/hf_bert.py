@@ -22,6 +22,7 @@ import numpy as np
 import optax
 
 from determined_tpu.data import DataLoader, InMemoryDataset
+from determined_tpu.models._hf_common import HFModuleHolder
 from determined_tpu.train._trial import JaxTrial
 
 
@@ -38,53 +39,23 @@ def synthetic_classification(
     return InMemoryDataset({"input_ids": ids, "label": labels})
 
 
-class _BertModule:
-    """Thin holder so build_model returns one object with config attached.
+class _BertModule(HFModuleHolder):
+    """Holder wiring BERT's forward signature into the shared HF plumbing
+    (``_hf_common.HFModuleHolder`` owns the pretrained_dir contract)."""
 
-    ``pretrained_dir``: local ``save_pretrained`` directory — its weights
-    become the initial params, so the trial is a true fine-tune; no
-    network is touched.
-    """
-
-    def __init__(self, config, seed: int, pretrained_dir: str = "") -> None:
+    @classmethod
+    def _model_cls(cls):
         from transformers import FlaxBertForSequenceClassification
 
-        self.config = config
-        self._pretrained = None
-        if pretrained_dir:
-            loaded = FlaxBertForSequenceClassification.from_pretrained(
-                pretrained_dir, config=config, local_files_only=True
-            )
-            self._pretrained = {"params": loaded.params}
-            self.module = loaded.module
-        else:
-            self.module = FlaxBertForSequenceClassification(
-                config, seed=seed, _do_init=False
-            ).module
+        return FlaxBertForSequenceClassification
 
-    def init(self, rng, input_ids):
-        if self._pretrained is not None:
-            return self._pretrained
-        return self.module.init(
-            rng,
+    def _forward_args(self, input_ids):
+        return (
             input_ids,
             jnp.ones_like(input_ids),
             jnp.zeros_like(input_ids),
             None,
             None,
-            deterministic=True,
-        )
-
-    def apply(self, params, input_ids, deterministic=True, rngs=None):
-        return self.module.apply(
-            params,
-            input_ids,
-            jnp.ones_like(input_ids),
-            jnp.zeros_like(input_ids),
-            None,
-            None,
-            deterministic=deterministic,
-            rngs=rngs,
         )
 
 

@@ -24,6 +24,7 @@ import numpy as np
 import optax
 
 from determined_tpu.data import DataLoader, InMemoryDataset
+from determined_tpu.models._hf_common import HFModuleHolder
 from determined_tpu.train._trial import JaxTrial
 
 
@@ -44,51 +45,22 @@ def synthetic_lm(size: int, seq_len: int, vocab: int, seed: int) -> InMemoryData
     return InMemoryDataset({"input_ids": ids})
 
 
-class _GPT2Module:
-    """Thin holder so build_model returns one object with config attached.
+class _GPT2Module(HFModuleHolder):
+    """Holder wiring GPT-2's forward signature into the shared HF plumbing
+    (``_hf_common.HFModuleHolder`` owns the pretrained_dir contract)."""
 
-    ``pretrained_dir``: local ``save_pretrained`` directory — its weights
-    become the initial params (returned by ``init``), so the trial is a
-    true fine-tune; no network is touched.
-    """
-
-    def __init__(self, config, seed: int, pretrained_dir: str = "") -> None:
+    @classmethod
+    def _model_cls(cls):
         from transformers import FlaxGPT2LMHeadModel
 
-        self.config = config
-        self._pretrained = None
-        if pretrained_dir:
-            loaded = FlaxGPT2LMHeadModel.from_pretrained(
-                pretrained_dir, config=config, local_files_only=True
-            )
-            self._pretrained = {"params": loaded.params}
-            self.module = loaded.module
-        else:
-            self.module = FlaxGPT2LMHeadModel(
-                config, seed=seed, _do_init=False
-            ).module
+        return FlaxGPT2LMHeadModel
 
-    def init(self, rng, input_ids):
-        if self._pretrained is not None:
-            return self._pretrained
+    def _forward_args(self, input_ids):
         b, s = input_ids.shape
-        return self.module.init(
-            rng,
+        return (
             input_ids,
             jnp.ones_like(input_ids),
             jnp.broadcast_to(jnp.arange(s), (b, s)),
-            deterministic=True,
-        )
-
-    def apply(self, params, input_ids, deterministic=True, rngs=None):
-        b, s = input_ids.shape
-        return self.module.apply(
-            params,
-            input_ids,
-            jnp.ones_like(input_ids),
-            jnp.broadcast_to(jnp.arange(s), (b, s)),
-            deterministic=deterministic,
-            rngs=rngs,
         )
 
 

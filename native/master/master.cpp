@@ -1211,6 +1211,12 @@ class Master {
     auto tit = trials_.find(trial_id);
     if (tit == trials_.end()) return;
     TrialState& t = tit->second;
+    // one logical exit per allocation: every member of a multi-node gang
+    // reports (N agents, or N self-reporting k8s pods), and only the
+    // first may advance the searcher — a second trial_exited callback
+    // would double-advance ASHA counters, and a late success report must
+    // not flip an already-ERROR trial
+    if (t.state != "RUNNING") return;
     auto eit = experiments_.find(t.experiment_id);
     if (eit == experiments_.end()) return;
     ExperimentState& exp = eit->second;
@@ -1744,7 +1750,13 @@ class Master {
       coord_ports_in_use_[it->second.coord_host].erase(it->second.chief_port);
     }
     revoke_token(it->second.session_token);
-    log_batch_seq_.erase(std::to_string(it->second.trial_id) + "/" + alloc_id);
+    // batch-seq watermarks are keyed "tid/alloc/shipper": erase the
+    // allocation's whole prefix (one entry per gang member)
+    std::string prefix = std::to_string(it->second.trial_id) + "/" + alloc_id + "/";
+    for (auto sit = log_batch_seq_.lower_bound(prefix);
+         sit != log_batch_seq_.end() && sit->first.rfind(prefix, 0) == 0;) {
+      sit = log_batch_seq_.erase(sit);
+    }
   }
 
   void kill_allocation(AllocationState& alloc) {
@@ -1799,6 +1811,11 @@ class Master {
   // (config/experiment.py); the master re-checks because it is the trust
   // boundary (reference: cluster-side expconf JSON-schema validation)
   static std::string validate_config(const Json& config) {
+    if (config.contains("resources") &&
+        config["resources"].contains("slots_per_trial") &&
+        config["resources"]["slots_per_trial"].as_int(1) < 1) {
+      return "resources.slots_per_trial must be >= 1";
+    }
     const Json& scfg = config["searcher"];
     std::string sname =
         scfg.contains("name") ? scfg["name"].as_string() : "single";
@@ -1932,23 +1949,68 @@ class Master {
     std::string ref = ait->second.external_ref;
 
     if (op.kind == "launch") {
-      std::string job_name = op.alloc_id;  // deterministic: k8s job = alloc id
-      std::string err, slurm_id;
+      std::string err, ref;
       bool ok = false;
       lk.unlock();
       if (pool.type == "kubernetes") {
-        ok = KubernetesBackend::submit(pool, job_name, op.entrypoint, op.env,
-                                       op.slots, &err);
-        slurm_id = job_name;
+        // multi-node gang: N indexed Jobs; rank-0's pod hosts the
+        // jax.distributed coordinator + control-plane chief (reference
+        // kubernetesrm runs one pod per node of a gang too).  The jobs'
+        // names join into the allocation's ref, comma-separated.
+        int per_node = pool.k8s_slots_per_node > 0
+                           ? std::min(pool.k8s_slots_per_node, op.slots)
+                           : op.slots;
+        per_node = std::max(per_node, 1);  // 0-slot trial: one pod, no div-0
+        int num_nodes = (op.slots + per_node - 1) / per_node;
+        num_nodes = std::max(num_nodes, 1);
+        std::string rank0 = op.alloc_id + "-r0";
+        std::string coord = rm_detail::expand_pattern(
+            pool.k8s_coordinator_pattern, rank0, pool.k8s_namespace);
+        std::vector<std::string> names;
+        ok = true;
+        for (int rank = 0; rank < num_nodes && ok; ++rank) {
+          std::string job_name =
+              num_nodes == 1 ? op.alloc_id
+                             : op.alloc_id + "-r" + std::to_string(rank);
+          Json env = op.env;  // per-node copy
+          int slots =
+              std::min(per_node, op.slots - rank * per_node);
+          env.set("DTPU_NUM_SLOTS", std::to_string(slots));
+          if (num_nodes > 1) {
+            Json rdzv = Json::object();
+            rdzv.set("coordinator", coord + ":16999");
+            rdzv.set("num_nodes", Json(static_cast<int64_t>(num_nodes)));
+            rdzv.set("node_rank", Json(static_cast<int64_t>(rank)));
+            env.set("DTPU_RENDEZVOUS", rdzv.dump());
+            env.set("DTPU_CHIEF_ADDR", coord);
+            env.set("DTPU_CHIEF_PORT", "16998");
+            // each pod ships its own log stream: distinct shipper
+            // identity so the per-allocation batch-seq watermarks don't
+            // collide across ranks (and exclude_node attribution names
+            // the rank)
+            env.set("DTPU_AGENT_ID",
+                    pool.type + ":" + pool.name + "/r" + std::to_string(rank));
+          }
+          ok = KubernetesBackend::submit(pool, job_name, op.entrypoint, env,
+                                         slots, &err);
+          if (ok) names.push_back(job_name);
+        }
+        if (!ok) {
+          // partial gang is useless: reap what was created
+          for (const auto& n : names) KubernetesBackend::remove(pool, n);
+        } else {
+          ref = names[0];
+          for (size_t i = 1; i < names.size(); ++i) ref += "," + names[i];
+        }
       } else if (pool.type == "slurm") {
         ok = SlurmBackend::submit(pool, op.alloc_id, op.entrypoint, op.env,
-                                  op.slots, &slurm_id, &err);
+                                  op.slots, &ref, &err);
       }
       lk.lock();
       auto it = allocations_.find(op.alloc_id);
       if (it == allocations_.end() || it->second.ended) {
         // killed while we were submitting: reap what we just started
-        if (ok) enqueue_external_remove(pool, slurm_id);
+        if (ok) enqueue_external_remove(pool, ref);
         return;
       }
       if (!ok) {
@@ -1960,17 +2022,36 @@ class Master {
         on_trial_exit(tid, /*exit_code=*/125);
         return;
       }
-      it->second.external_ref = slurm_id;
+      it->second.external_ref = ref;
     } else if (op.kind == "kill") {
       if (ref.empty()) return;  // launch failed; nothing to kill
       lk.unlock();
       if (pool.type == "kubernetes") {
-        KubernetesBackend::remove(pool, ref);
+        for (const auto& name : split_ref(ref)) {
+          KubernetesBackend::remove(pool, name);
+        }
       } else if (pool.type == "slurm") {
         SlurmBackend::cancel(pool, ref);
       }
       lk.lock();
     }
+  }
+
+  // an external ref may name several k8s Jobs (multi-node gang),
+  // comma-separated
+  static std::vector<std::string> split_ref(const std::string& ref) {
+    std::vector<std::string> out;
+    size_t start = 0;
+    while (start <= ref.size()) {
+      size_t comma = ref.find(',', start);
+      if (comma == std::string::npos) {
+        out.push_back(ref.substr(start));
+        break;
+      }
+      out.push_back(ref.substr(start, comma - start));
+      start = comma + 1;
+    }
+    return out;
   }
 
   // best-effort cleanup of a job whose allocation died mid-submit;
@@ -2032,7 +2113,9 @@ class Master {
         // for jobs that already finished, but the only kill a mid-submit
         // cancellation ever gets — the queued kill op saw no ref yet)
         if (pool.type == "kubernetes") {
-          KubernetesBackend::remove(pool, p.ref);
+          for (const auto& name : split_ref(p.ref)) {
+            KubernetesBackend::remove(pool, name);
+          }
         } else if (pool.type == "slurm") {
           SlurmBackend::cancel(pool, p.ref);
         }
@@ -2042,7 +2125,30 @@ class Master {
       int exit_code = 1;
       ExternalJobState st = ExternalJobState::kRunning;
       if (pool.type == "kubernetes") {
-        st = KubernetesBackend::status(pool, p.ref, &exit_code);
+        // gang aggregate over the ref's jobs: any failure fails the
+        // gang, any vanished job counts as gone, success only when every
+        // job succeeded
+        bool any_gone = false, any_failed = false, all_ok = true;
+        int failed_code = 1;
+        for (const auto& name : split_ref(p.ref)) {
+          int code = 1;
+          ExternalJobState s = KubernetesBackend::status(pool, name, &code);
+          if (s == ExternalJobState::kFailed) {
+            any_failed = true;
+            failed_code = code;
+          }
+          if (s == ExternalJobState::kGone) any_gone = true;
+          if (s != ExternalJobState::kSucceeded) all_ok = false;
+        }
+        if (any_failed) {
+          st = ExternalJobState::kFailed;
+          exit_code = failed_code;
+        } else if (any_gone) {
+          st = ExternalJobState::kGone;
+        } else if (all_ok) {
+          st = ExternalJobState::kSucceeded;
+          exit_code = 0;
+        }
       } else if (pool.type == "slurm") {
         st = SlurmBackend::status(pool, p.ref);
       }
@@ -3666,12 +3772,13 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     // cannot duplicate log lines
     if (body.contains("batch_seq")) {
       int64_t seq = body["batch_seq"].as_int(0);
-      // keyed per ALLOCATION, not per trial: a restarted trial's shipper
+      // keyed per ALLOCATION + shipper: a restarted trial's shipper
       // starts back at seq 0 under a fresh allocation id and must not
-      // collide with the dead run's watermark (entries die with the
+      // collide with the dead run's watermark, and a multi-node gang's
+      // pods each run their own shipper stream (entries die with the
       // allocation in end_allocation)
       std::string key = std::to_string(tid) + "/" +
-                        body["allocation_id"].as_string();
+                        body["allocation_id"].as_string() + "/" + agent_id;
       auto [it, fresh] = m.log_batch_seq_.try_emplace(key, -1);
       if (!fresh && seq <= it->second) return R::json("{\"duplicate\":true}");
       it->second = seq;

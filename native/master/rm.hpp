@@ -64,6 +64,16 @@ struct PoolConfig {
   std::string k8s_namespace = "default";
   std::string k8s_token;                // serviceaccount bearer token
   std::string k8s_image = "determined-tpu:latest";
+  // multi-node gangs: slots per pod (0 = whole trial on one pod).  A
+  // trial wanting more becomes N indexed Jobs whose rank-0 pod hosts the
+  // jax.distributed coordinator + control-plane chief.
+  int k8s_slots_per_node = 0;
+  // how workers reach the rank-0 pod: {job} -> rank-0 job name,
+  // {namespace} -> pool namespace.  Real clusters point this at their
+  // pod-DNS scheme (e.g. "{job}.trainers.{namespace}.svc.cluster.local"
+  // with a matching headless Service + pod hostname/subdomain); the
+  // test's fake apiserver runs pods locally and uses "127.0.0.1".
+  std::string k8s_coordinator_pattern = "{job}";
 
   // slurm backend (binaries overridable for tests / site wrappers)
   std::string slurm_sbatch = "sbatch";
@@ -87,6 +97,10 @@ struct PoolConfig {
       if (k["namespace"].is_string()) p.k8s_namespace = k["namespace"].as_string();
       if (k["token"].is_string()) p.k8s_token = k["token"].as_string();
       if (k["image"].is_string()) p.k8s_image = k["image"].as_string();
+      p.k8s_slots_per_node = static_cast<int>(k["slots_per_node"].as_int(0));
+      if (k["coordinator_pattern"].is_string()) {
+        p.k8s_coordinator_pattern = k["coordinator_pattern"].as_string();
+      }
     }
     const Json& s = j["slurm"];
     if (s.is_object()) {
@@ -139,6 +153,18 @@ inline bool split_url(const std::string& url, std::string* host, int* port,
     *port = std::atoi(rest.c_str() + colon + 1);
   }
   return !host->empty() && *port > 0;
+}
+
+inline std::string expand_pattern(std::string pat, const std::string& job,
+                                  const std::string& ns) {
+  for (auto [key, val] : {std::pair<std::string, const std::string&>{"{job}", job},
+                          {"{namespace}", ns}}) {
+    size_t pos;
+    while ((pos = pat.find(key)) != std::string::npos) {
+      pat.replace(pos, key.size(), val);
+    }
+  }
+  return pat;
 }
 
 inline std::string shell_quote(const std::string& s) {

@@ -470,3 +470,56 @@ def test_provisioner_scales_up_and_down(tmp_path):
                 os.killpg(int(pidfile.read_text().strip()), signal.SIGKILL)
             except (OSError, ValueError):
                 pass
+
+
+def test_kubernetes_multinode_gang(tmp_path):
+    """A trial wider than one pod becomes N indexed Jobs whose rank-0 pod
+    hosts the jax.distributed coordinator (reference kubernetesrm runs
+    one pod per gang node).  The fake apiserver runs both pods locally,
+    so real 2-process jax.distributed training executes end to end."""
+    kube = FakeKubeApiserver()
+    pools = [
+        {
+            "name": "k8s",
+            "type": "kubernetes",
+            "kubernetes": {
+                "apiserver": kube.url,
+                "namespace": "dtpu",
+                "slots_per_node": 1,
+                "coordinator_pattern": "127.0.0.1",  # pods run locally
+            },
+        }
+    ]
+    c = DevCluster(
+        tmp_path,
+        agents=0,
+        master_args=("--pools", _write_pools(tmp_path, pools)),
+    )
+    c.start_master()
+    try:
+        config = exp_config(c.ckpt_dir, slots=2)
+        config["resources"]["resource_pool"] = "k8s"
+        # each pod hosts 1 slot -> 1 virtual CPU device per process
+        config["environment"]["env"]["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=1"
+        )
+        exp_id = c.submit(config)
+        exp = c.wait_for_state(exp_id, timeout=240)
+        assert exp["state"] == "COMPLETED"
+        # two rank jobs were created, named alloc-N-r0 / alloc-N-r1
+        with kube.lock:
+            posts = [p for m, p in kube.requests if m == "POST"]
+        assert len(posts) == 2
+        trial_id = exp["trials"][0]["id"]
+        r = c.http.get(f"{c.url}/api/v1/trials/{trial_id}/logs?tail=2000")
+        text = json.dumps(r.json())
+        # both ranks shipped logs (rank prefixes from the per-rank wrapper)
+        assert "[rank=0]" in text and "[rank=1]" in text
+        # gang jobs garbage-collected after completion
+        deadline = time.time() + 20
+        while time.time() < deadline and kube.jobs:
+            time.sleep(0.5)
+        assert not kube.jobs
+    finally:
+        c.stop()
+        kube.stop()
