@@ -1,0 +1,185 @@
+"""``dtpu deploy gcp``: generate a GCP TPU-VM cluster deployment.
+
+Reference: ``det deploy gcp`` (``harness/determined/deploy/gcp/``, which
+drives Terraform against GCE).  TPU redesign: the deployment unit is the
+**TPU VM** (agents run on the TPU hosts themselves — no GPU-instance +
+docker sandwich), and instead of embedding a cloud SDK this emits a
+self-contained bundle of ``gcloud`` scripts + startup scripts + a pools
+config wired for the master's provisioner, which the operator reviews
+and runs.  Zero egress from this tool; everything is reviewable text.
+
+    dtpu deploy gcp --project my-proj --zone us-central2-b \
+        --accelerator v5litepod-8 --max-agents 4 --out ./deploy-gcp
+    cd deploy-gcp && ./up.sh     # creates master VM + TPU agent VMs
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+
+
+MASTER_STARTUP = """#!/bin/bash
+# master VM startup: runs the dtpu master as a systemd unit
+set -e
+mkdir -p /opt/dtpu /var/lib/dtpu
+# operator: place the dtpu-master binary + pools.json under /opt/dtpu
+# (bake them into the image or pull from your artifact store here)
+#
+# the provisioner launches autoscaled agents with this startup script:
+# generated HERE so the master's own address is baked in (the bundle's
+# agent-startup.sh keeps a placeholder only up.sh substitutes)
+sed "s/{{master_host}}/$(hostname -i)/" /opt/dtpu/agent-startup.tmpl \\
+  > /opt/dtpu/agent-startup.sh || true
+cat > /etc/systemd/system/dtpu-master.service <<UNIT
+[Unit]
+Description=determined-tpu master
+After=network-online.target
+[Service]
+ExecStart=/opt/dtpu/dtpu-master --port {port} --state-dir /var/lib/dtpu/state \\
+  --checkpoint-dir {checkpoint_dir} --pools /opt/dtpu/pools.json \\
+  --advertised-url http://$(hostname -i):{port}
+Restart=always
+[Install]
+WantedBy=multi-user.target
+UNIT
+systemctl daemon-reload
+systemctl enable --now dtpu-master
+"""
+
+AGENT_STARTUP = """#!/bin/bash
+# TPU-VM startup: runs the dtpu agent; slots auto-detect the chips
+set -e
+mkdir -p /opt/dtpu
+cat > /etc/systemd/system/dtpu-agent.service <<UNIT
+[Unit]
+Description=determined-tpu agent
+After=network-online.target
+[Service]
+Environment=PYTHONPATH=/opt/dtpu
+ExecStart=/opt/dtpu/dtpu-agent --master-host {master_host} \\
+  --master-port {port} --id %H --pool {pool}
+Restart=always
+[Install]
+WantedBy=multi-user.target
+UNIT
+systemctl daemon-reload
+systemctl enable --now dtpu-agent
+"""
+
+UP_SH = """#!/bin/bash
+# create the master VM, then {agents} TPU agent VM(s)
+set -euo pipefail
+gcloud compute instances create {name}-master \\
+  --project {project} --zone {zone} \\
+  --machine-type {master_machine_type} \\
+  --metadata-from-file startup-script=master-startup.sh
+MASTER_IP=$(gcloud compute instances describe {name}-master \\
+  --project {project} --zone {zone} \\
+  --format='get(networkInterfaces[0].networkIP)')
+if [ {agents} -gt 0 ]; then
+  sed "s/{{master_host}}/$MASTER_IP/" agent-startup.tmpl > /tmp/agent-startup.sh
+  for i in $(seq 0 {last_agent}); do
+    gcloud compute tpus tpu-vm create {name}-agent-$i \\
+      --project {project} --zone {zone} \\
+      --accelerator-type {accelerator} --version {runtime_version} \\
+      --metadata-from-file startup-script=/tmp/agent-startup.sh
+  done
+fi
+echo "master: http://$MASTER_IP:{port}"
+"""
+
+DOWN_SH = """#!/bin/bash
+set -uo pipefail
+if [ {agents} -gt 0 ]; then
+  for i in $(seq 0 {last_agent}); do
+    gcloud compute tpus tpu-vm delete {name}-agent-$i \\
+      --project {project} --zone {zone} --quiet
+  done
+fi
+gcloud compute instances delete {name}-master \\
+  --project {project} --zone {zone} --quiet
+"""
+
+# provisioner commands the master VM uses to autoscale TPU agent VMs
+LAUNCH_CMD = (
+    "gcloud compute tpus tpu-vm create {name}-auto-$RANDOM"
+    " --project {project} --zone {zone}"
+    " --accelerator-type {accelerator} --version {runtime_version}"
+    " --metadata-from-file startup-script=/opt/dtpu/agent-startup.sh"
+)
+TERMINATE_CMD = (
+    "gcloud compute tpus tpu-vm delete \"$DTPU_AGENT_ID\""
+    " --project {project} --zone {zone} --quiet"
+)
+
+
+def deploy_gcp(args) -> int:
+    out = os.path.abspath(args.out)
+    os.makedirs(out, exist_ok=True)
+    subs = {
+        "name": args.name,
+        "project": args.project,
+        "zone": args.zone,
+        "accelerator": args.accelerator,
+        "runtime_version": args.runtime_version,
+        "agents": args.agents,
+        "last_agent": max(args.agents - 1, 0),
+        "port": args.port,
+        "pool": "default",
+        "master_machine_type": args.master_machine_type,
+        "checkpoint_dir": args.checkpoint_dir,
+        "master_host": "{master_host}",  # substituted by up.sh at create time
+    }
+    pools = [
+        {
+            "name": "default",
+            "type": "agent",
+            "provisioner": {
+                "launch_cmd": LAUNCH_CMD.format(**subs),
+                "terminate_cmd": TERMINATE_CMD.format(**subs),
+                "min_agents": 0,
+                "max_agents": args.max_agents,
+                "idle_grace_sec": args.idle_grace_sec,
+            },
+        }
+        if args.max_agents > args.agents
+        else {"name": "default", "type": "agent"}
+    ]
+    files = {
+        "master-startup.sh": MASTER_STARTUP.format(**subs),
+        "agent-startup.tmpl": AGENT_STARTUP.format(**subs),
+        "up.sh": UP_SH.format(**subs),
+        "down.sh": DOWN_SH.format(**subs),
+        "pools.json": json.dumps(pools, indent=2) + "\n",
+    }
+    for fname, content in files.items():
+        path = os.path.join(out, fname)
+        with open(path, "w") as f:
+            f.write(content)
+        if fname.endswith(".sh"):
+            os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR | stat.S_IXGRP)
+    print(f"wrote {len(files)} files to {out}")
+    print(f"review them, then: cd {out} && ./up.sh")
+    return 0
+
+
+def register(deploy_sub) -> None:
+    gcp = deploy_sub.add_parser("gcp")
+    gcp.add_argument("--project", required=True)
+    gcp.add_argument("--zone", required=True)
+    gcp.add_argument("--name", default="dtpu")
+    gcp.add_argument("--accelerator", default="v5litepod-8")
+    gcp.add_argument("--runtime-version", default="v2-alpha-tpuv5-lite")
+    gcp.add_argument("--agents", type=int, default=1,
+                     help="TPU agent VMs created by up.sh")
+    gcp.add_argument("--max-agents", type=int, default=1,
+                     help="> --agents enables the provisioner (autoscale)")
+    gcp.add_argument("--port", type=int, default=8080)
+    gcp.add_argument("--master-machine-type", default="n2-standard-8")
+    gcp.add_argument("--checkpoint-dir", default="/var/lib/dtpu/checkpoints",
+                     help="shared checkpoint path (GCS fuse mount or NFS)")
+    gcp.add_argument("--idle-grace-sec", type=int, default=600)
+    gcp.add_argument("--out", default="./deploy-gcp")
+    gcp.set_defaults(fn=deploy_gcp)

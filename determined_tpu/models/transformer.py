@@ -324,6 +324,7 @@ class LMTrial(JaxTrial):
         schedule = optax.warmup_cosine_decay_schedule(
             0.0, lr, warmup, int(g("decay_steps", 10000))
         )
+        self.lr_schedule = schedule  # surfaced as the per-batch `lr` metric
         # adam first-moment dtype: bf16 halves its HBM traffic (the
         # optimizer update is bandwidth-bound); second moment stays f32
         # for the rsqrt's dynamic range

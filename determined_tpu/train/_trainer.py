@@ -208,6 +208,8 @@ class Trainer:
             state_rng,
         )
         metric_keys = tuple(sorted(metrics_shape.keys())) + ("loss",)
+        if getattr(self.trial, "lr_schedule", None) is not None:
+            metric_keys = metric_keys + ("lr",)
 
         # ---- sharded init --------------------------------------------------
         # 1. init params, then commit them to their planned mesh shardings;
@@ -261,7 +263,7 @@ class Trainer:
                 m0 = {
                     k: jnp.zeros((), jnp.float32)
                     for k in state.metric_acc
-                    if k != "loss"
+                    if k not in ("loss", "lr")  # synthesized post-scan
                 }
                 (grads, loss, metrics), _ = jax.lax.scan(
                     micro, (g0, jnp.zeros((), jnp.float32), m0), batch
@@ -274,6 +276,12 @@ class Trainer:
             new_params = optax.apply_updates(state.params, updates)
             metrics = dict(metrics)
             metrics["loss"] = loss
+            # schedule-state surfacing (reference LRScheduler wrapper): a
+            # trial exposing `lr_schedule` (an optax schedule callable)
+            # gets its current learning rate reported with every batch
+            schedule = getattr(trial, "lr_schedule", None)
+            if schedule is not None:
+                metrics["lr"] = schedule(state.step).astype(jnp.float32)
             acc = {
                 k: state.metric_acc[k] + metrics[k].astype(jnp.float32)
                 for k in state.metric_acc

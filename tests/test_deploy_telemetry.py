@@ -178,3 +178,54 @@ def test_telemetry_off_by_default(tmp_path):
     finally:
         c.stop()
         sink.httpd.shutdown()
+
+
+def test_deploy_gcp_generates_bundle(tmp_path):
+    """`dtpu deploy gcp` emits a reviewable gcloud bundle (reference:
+    det deploy gcp drives Terraform; here the cloud surface is generated
+    scripts + a provisioner-wired pools.json, zero egress)."""
+    out = tmp_path / "gcp"
+    r = _cli(
+        [
+            "deploy", "gcp",
+            "--project", "my-proj",
+            "--zone", "us-central2-b",
+            "--accelerator", "v5litepod-16",
+            "--agents", "2",
+            "--max-agents", "6",
+            "--out", str(out),
+        ]
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    names = {p.name for p in out.iterdir()}
+    assert names == {"master-startup.sh", "agent-startup.tmpl", "up.sh",
+                     "down.sh", "pools.json"}
+    up = (out / "up.sh").read_text()
+    assert "gcloud compute tpus tpu-vm create" in up
+    assert "--accelerator-type v5litepod-16" in up
+    assert "seq 0 1" in up  # 2 agents
+    assert os.access(out / "up.sh", os.X_OK)
+    pools = json.loads((out / "pools.json").read_text())
+    prov = pools[0]["provisioner"]
+    assert prov["max_agents"] == 6
+    assert "tpu-vm create" in prov["launch_cmd"]
+    assert "$DTPU_AGENT_ID" in prov["terminate_cmd"]
+    master = (out / "master-startup.sh").read_text()
+    assert "--pools /opt/dtpu/pools.json" in master
+    down = (out / "down.sh").read_text()
+    assert "tpu-vm delete" in down
+
+
+def test_deploy_gcp_pure_autoscale_creates_no_static_agents(tmp_path):
+    out = tmp_path / "gcp0"
+    r = _cli(
+        ["deploy", "gcp", "--project", "p", "--zone", "z",
+         "--agents", "0", "--max-agents", "4", "--out", str(out)]
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    up = (out / "up.sh").read_text()
+    # zero static agents: the create loop is gated off entirely
+    assert "if [ 0 -gt 0 ]" in up
+    # the provisioner bootstraps agents from the master-side template
+    master = (out / "master-startup.sh").read_text()
+    assert "agent-startup.tmpl" in master

@@ -274,3 +274,40 @@ def test_resnet_cifar_learns(tmp_path):
     vm = result["validation_metrics"]
     assert vm["validation_accuracy"] > 0.4, vm  # 4 classes -> random = 0.25
     assert result["latest_checkpoint"]
+
+
+def test_lr_schedule_surfaced_in_metrics(tmp_path):
+    """A trial exposing `lr_schedule` gets its live learning rate reported
+    with the training metrics (reference: the LRScheduler wrapper's state
+    surfacing)."""
+    import optax
+
+    from determined_tpu import core, train
+    from determined_tpu.models.mnist import MnistTrial
+    from determined_tpu.parallel.mesh import MeshConfig
+
+    class SchedTrial(MnistTrial):
+        def build_optimizer(self):
+            self.lr_schedule = optax.linear_schedule(1e-2, 0.0, 100)
+            return optax.adam(self.lr_schedule)
+
+    ctx = train.init(
+        hparams={"lr": 1e-2, "hidden": 8, "global_batch_size": 8,
+                 "dataset_size": 32},
+        mesh_config=MeshConfig(data=1),
+        core_context=core._dummy_init(checkpoint_dir=str(tmp_path)),
+        seed=0,
+    )
+    trainer = train.Trainer(SchedTrial(ctx))
+    trainer._setup()
+    assert "lr" in trainer.state.metric_acc
+    it = iter(trainer.train_loader)
+    from determined_tpu.data import to_global
+
+    trainer.state = trainer._train_step(
+        trainer.state, to_global(next(it), trainer.mesh)
+    )
+    import numpy as np
+
+    first = float(np.asarray(trainer.state.metric_acc["lr"]))
+    assert 0 < first <= 1e-2  # step-0 rate of the linear schedule
