@@ -394,6 +394,7 @@ class LMTrial(JaxTrial):
                 chunk_size=None if chunk in (None, "auto") else int(chunk),
                 compute_dtype=model.cfg.dtype,
                 batch_shards=shards,
+                bf16_residual=bool(g("ce_bf16_residual", False)),
             )
         else:
             logits, moe_aux = model.apply(params, inputs, return_aux=True)
