@@ -335,6 +335,18 @@ class ExperimentConfig:
     @classmethod
     def parse(cls, raw: Dict[str, Any]) -> "ExperimentConfig":
         raw = dict(raw or {})
+        # schema versioning (reference: versioned expconf union types):
+        # v1 is the only version; an explicit other value is a config from
+        # a different era and must fail loudly, not half-parse
+        version = raw.pop("version", 1)
+        if not (
+            isinstance(version, (int, float))
+            and not isinstance(version, bool)  # YAML true would == 1
+            and version == 1
+        ):
+            raise InvalidExperimentConfig(
+                f"unsupported experiment config version {version!r} (supported: 1)"
+            )
         kwargs: Dict[str, Any] = {"raw": dict(raw)}
         if "hyperparameters" in raw:
             kwargs["hyperparameters"] = parse_hyperparameters(raw.pop("hyperparameters"))

@@ -1811,6 +1811,15 @@ class Master {
   // (config/experiment.py); the master re-checks because it is the trust
   // boundary (reference: cluster-side expconf JSON-schema validation)
   static std::string validate_config(const Json& config) {
+    // schema versioning, enforced identically to the Python parser
+    // (config/experiment.py): v1 only, fail loudly on anything else —
+    // including non-numeric values (as_int would default them to 1 and
+    // let a '"2"' string half-parse later in the trial)
+    if (config.contains("version") &&
+        (!config["version"].is_number() || config["version"].as_int(0) != 1 ||
+         config["version"].as_double(0) != 1.0)) {
+      return "unsupported experiment config version (supported: 1)";
+    }
     if (config.contains("resources") &&
         config["resources"].contains("slots_per_trial") &&
         config["resources"]["slots_per_trial"].as_int(1) < 1) {

@@ -15,10 +15,15 @@ LOG="$(mktemp -d)/san"
 export DTPU_NATIVE_BUILD_DIR="$BUILD"
 export TSAN_OPTIONS="log_path=$LOG" ASAN_OPTIONS="log_path=$LOG"
 cd "$REPO"
+# smoke tests chosen to exercise the master's concurrency (routes, agent
+# long-polls, webhook delivery, external-RM worker) without tight timing
+# margins — sanitizer slowdown (5-15x) makes latency-sensitive tests
+# (e.g. preemption grace windows) flaky without finding races
 python -m pytest \
   tests/test_devcluster.py::test_single_experiment_completes \
   tests/test_devcluster.py::test_webhooks_state_change_and_custom \
-  tests/test_devcluster.py::test_priority_preemption_yields_and_resumes \
+  tests/test_devcluster.py::test_context_directory_ships_user_code \
+  tests/test_rm_external.py::test_kubernetes_pool_runs_experiment \
   -q
 if compgen -G "$LOG*" > /dev/null; then
   echo "SANITIZER REPORTS:"

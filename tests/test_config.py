@@ -146,3 +146,13 @@ def test_example_configs_parse():
         with open(p) as f:
             cfg = ExperimentConfig.parse(yaml.safe_load(f))
         assert cfg.entrypoint, p
+
+
+def test_config_version_gate():
+    """v1 accepted (explicit or implicit); anything else fails loudly —
+    both sides of the shared contract (master.cpp validate_config
+    mirrors this)."""
+    ExperimentConfig.parse({"version": 1, "name": "x"})
+    ExperimentConfig.parse({"name": "x"})
+    with pytest.raises(InvalidExperimentConfig):
+        ExperimentConfig.parse({"version": 2, "name": "x"})

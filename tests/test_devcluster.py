@@ -892,6 +892,26 @@ def test_grid_requires_count_on_continuous(cluster, tmp_path):
         ExperimentConfig.parse(cfg)
 
 
+def test_config_version_gate_e2e(cluster):
+    """The schema version gate rejects identically on both sides of the
+    contract — including non-numeric values a YAML quoted scalar could
+    produce (the C++ as_int default must not let '"2"' half-parse)."""
+    from determined_tpu.config.experiment import ExperimentConfig, InvalidExperimentConfig
+
+    for bad in (2, "2", 1.9, True, None):
+        vcfg = exp_config(cluster.ckpt_dir)
+        vcfg["version"] = bad
+        r = cluster.http.post(cluster.url + "/api/v1/experiments", json={"config": vcfg})
+        assert r.status_code == 400, (bad, r.text)
+        assert "version" in r.text
+        with pytest.raises(InvalidExperimentConfig):
+            ExperimentConfig.parse(vcfg)
+    ok = exp_config(cluster.ckpt_dir)
+    ok["version"] = 1
+    r = cluster.http.post(cluster.url + "/api/v1/experiments", json={"config": ok})
+    assert r.status_code == 201, r.text
+
+
 def test_tensorboard_task_behind_proxy(cluster, tmp_path):
     """First NTSC slice: a 0-slot tensorboard task launches on an agent,
     reports ready, and the master reverse-proxies HTTP into it (reference:
