@@ -70,6 +70,9 @@ def main() -> None:
         "ce_chunk": int(os.environ["DTPU_BENCH_CHUNK"])
         if "DTPU_BENCH_CHUNK" in os.environ
         else None,
+        # per-block remat: required for very long context on one chip
+        # (seq 32k activations exceed HBM without it)
+        "remat": os.environ.get("DTPU_BENCH_REMAT", "0") == "1",
     }
     ctx = train.init(
         hparams=hp,
