@@ -314,6 +314,73 @@ def notebook_start(args) -> int:
     return 0
 
 
+def shell_start(args) -> int:
+    d = _client(args)
+    info = d.start_shell(shell=args.shell)
+    info = d.wait_task_ready(info["id"], timeout=args.timeout)
+    print(f"shell {info['id']} ready")
+    if getattr(args, "no_open", False):
+        print(f"attach with: dtpu shell open {info['id']}")
+        return 0
+    args.id = info["id"]
+    return shell_open(args)
+
+
+def shell_open(args) -> int:
+    """Attach the local terminal to the task PTY over the proxied websocket
+    (reference: ``det shell open`` over an sshd tunnel)."""
+    import json as _json
+    import select as _select
+    import shutil
+    import termios
+    import tty
+
+    from determined_tpu.common import ws as wslib
+
+    d = _client(args)
+    ws = d.open_shell_ws(args.id)
+    size = shutil.get_terminal_size()
+    ws.send_text(_json.dumps({"type": "resize", "rows": size.lines, "cols": size.columns}))
+
+    stdin_fd = sys.stdin.fileno()
+    interactive = sys.stdin.isatty()
+    saved = termios.tcgetattr(stdin_fd) if interactive else None
+    if interactive:
+        tty.setraw(stdin_fd)
+    try:
+        print("connected; exit the shell (or ctrl-d) to detach\r", flush=True)
+        stdin_open = True
+        while True:
+            if ws.has_buffered_frame():
+                r = [ws.sock]  # complete frame already read past select's view
+            else:
+                fds = [ws.sock] + ([stdin_fd] if stdin_open else [])
+                r, _, _ = _select.select(fds, [], [])
+            if ws.sock in r:
+                op, data = ws.recv_message()
+                if op == wslib.OP_CLOSE or ws.closed:
+                    break
+                if data:
+                    os.write(sys.stdout.fileno(), data)
+            if stdin_open and stdin_fd in r:
+                data = os.read(stdin_fd, 65536)
+                if not data:
+                    # piped input exhausted: keep draining shell output
+                    # until the remote side closes (the typical pipe ends
+                    # with `exit`, which closes the PTY server-side)
+                    stdin_open = False
+                    continue
+                ws.send_binary(data)
+    except (ConnectionError, OSError):
+        pass
+    finally:
+        if saved is not None:
+            termios.tcsetattr(stdin_fd, termios.TCSADRAIN, saved)
+        ws.close()
+    print("\ndetached")
+    return 0
+
+
 def task_list(args) -> int:
     _table(_client(args).list_tasks(), ["id", "type", "state", "ready", "agent_id"])
     return 0
@@ -603,6 +670,17 @@ def build_parser() -> argparse.ArgumentParser:
     ns.add_argument("--work-dir")
     ns.add_argument("--timeout", type=float, default=150.0)
     ns.set_defaults(fn=notebook_start)
+
+    sh = sub.add_parser("shell").add_subparsers(dest="verb", required=True)
+    ss = sh.add_parser("start")
+    ss.add_argument("--shell", default="/bin/sh")
+    ss.add_argument("--timeout", type=float, default=60.0)
+    ss.add_argument("--no-open", action="store_true",
+                    help="start only; do not attach a terminal")
+    ss.set_defaults(fn=shell_start)
+    so = sh.add_parser("open")
+    so.add_argument("id")
+    so.set_defaults(fn=shell_open)
 
     task = sub.add_parser("task").add_subparsers(dest="verb", required=True)
     task.add_parser("list").set_defaults(fn=task_list)

@@ -422,6 +422,30 @@ class Determined:
         )
         return resp.json()
 
+    def start_shell(self, shell: Optional[str] = None) -> Dict[str, Any]:
+        """Launch a shell task (PTY behind a websocket through the proxy;
+        reference: ``det shell start`` + sshd tunnel)."""
+        resp = self._session.post(
+            "/api/v1/tasks",
+            json={"type": "shell", "config": {"shell": shell or "/bin/sh"}},
+        )
+        return resp.json()
+
+    def open_shell_ws(self, task_id: str):
+        """Open the shell task's websocket through the master proxy; returns
+        a connected ``determined_tpu.common.ws.WebSocket``."""
+        from urllib.parse import urlparse
+
+        from determined_tpu.common import ws as wslib
+
+        u = urlparse(self.master)
+        return wslib.connect(
+            u.hostname or "127.0.0.1",
+            u.port or 80,
+            f"/proxy/{task_id}/ws",
+            headers={"Authorization": f"Bearer {self._session.token}"},
+        )
+
     def get_task(self, task_id: str) -> Dict[str, Any]:
         return self._session.get(f"/api/v1/tasks/{task_id}").json()
 

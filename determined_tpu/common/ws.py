@@ -1,0 +1,240 @@
+"""Minimal RFC6455 websocket client + server primitives.
+
+Used by the shell task (``exec/shell.py`` — PTY behind a websocket), the
+CLI's ``shell open`` terminal bridge, and the devcluster tests that drive a
+jupyter kernel through the master proxy.  The reference tunnels such
+channels through Go's websocket stack + sshd (``master/internal/proxy/
+proxy.go``, ``harness/determined/cli/tunnel.py``); here one small codec
+serves both ends.
+
+Scope: text/binary/ping/pong/close frames, client-side masking, server
+handshake.  No extensions, no compression — none of our peers negotiate
+them (the proxy forwards ``Sec-WebSocket-Extensions`` but jupyter/our tasks
+run without permessage-deflate).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def accept_key(client_key: str) -> str:
+    """Sec-WebSocket-Accept for a client's Sec-WebSocket-Key."""
+    digest = hashlib.sha1((client_key + _WS_GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def encode_frame(opcode: int, payload: bytes, mask: bool = False) -> bytes:
+    """One complete (FIN) frame."""
+    head = bytearray([0x80 | opcode])
+    n = len(payload)
+    mask_bit = 0x80 if mask else 0
+    if n < 126:
+        head.append(mask_bit | n)
+    elif n < (1 << 16):
+        head.append(mask_bit | 126)
+        head += struct.pack(">H", n)
+    else:
+        head.append(mask_bit | 127)
+        head += struct.pack(">Q", n)
+    if mask:
+        key = os.urandom(4)
+        head += key
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return bytes(head) + payload
+
+
+class WebSocket:
+    """A connected websocket endpoint over a plain socket.
+
+    ``client=True`` masks outgoing frames (RFC6455 §5.3 requires it of
+    clients; servers must not mask).
+    """
+
+    def __init__(self, sock: socket.socket, client: bool) -> None:
+        self.sock = sock
+        self.client = client
+        self._buf = b""
+        self.closed = False
+        # sends may come from multiple threads (a PTY pump thread plus the
+        # receive loop's automatic PONG replies); frames must not interleave
+        self._send_lock = threading.Lock()
+
+    # -- send ----------------------------------------------------------------
+
+    def send_text(self, text: str) -> None:
+        self._send(OP_TEXT, text.encode())
+
+    def send_binary(self, data: bytes) -> None:
+        self._send(OP_BINARY, data)
+
+    def send_close(self, code: int = 1000) -> None:
+        try:
+            self._send(OP_CLOSE, struct.pack(">H", code))
+        except OSError:
+            pass
+        self.closed = True
+
+    def _send(self, opcode: int, payload: bytes) -> None:
+        frame = encode_frame(opcode, payload, mask=self.client)
+        with self._send_lock:
+            self.sock.sendall(frame)
+
+    # -- receive -------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("websocket peer closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def recv_frame(self) -> Tuple[int, bytes]:
+        """Next frame as (opcode, payload); reassembles fragmented messages."""
+        opcode = None
+        payload = b""
+        while True:
+            b1, b2 = self._read_exact(2)
+            fin = b1 & 0x80
+            op = b1 & 0x0F
+            masked = b2 & 0x80
+            n = b2 & 0x7F
+            if n == 126:
+                (n,) = struct.unpack(">H", self._read_exact(2))
+            elif n == 127:
+                (n,) = struct.unpack(">Q", self._read_exact(8))
+            key = self._read_exact(4) if masked else None
+            data = self._read_exact(n)
+            if key:
+                data = bytes(c ^ key[i % 4] for i, c in enumerate(data))
+            if op in (OP_PING,):
+                self._send(OP_PONG, data)
+                continue
+            if op in (OP_PONG,):
+                continue
+            if opcode is None:
+                opcode = op
+            payload += data
+            if fin:
+                return opcode, payload
+
+    def recv_message(self) -> Tuple[int, bytes]:
+        """Like recv_frame but answers pings and surfaces close frames."""
+        op, data = self.recv_frame()
+        if op == OP_CLOSE:
+            self.closed = True
+        return op, data
+
+    def has_buffered_frame(self) -> bool:
+        """True when a complete frame already sits in the internal buffer.
+
+        Callers multiplexing on the raw socket (select/poll) must drain
+        buffered frames first — one recv() can deliver several frames, and
+        select would never fire for bytes already read.  Caveat: a buffered
+        PING (which recv_message swallows) or a non-FIN fragment can still
+        make the next recv_message block; our peers (shell PTY, jupyter)
+        send unfragmented data frames.
+        """
+        buf = self._buf
+        if len(buf) < 2:
+            return False
+        n = buf[1] & 0x7F
+        off = 2
+        if n == 126:
+            if len(buf) < 4:
+                return False
+            (n,) = struct.unpack(">H", buf[2:4])
+            off = 4
+        elif n == 127:
+            if len(buf) < 10:
+                return False
+            (n,) = struct.unpack(">Q", buf[2:10])
+            off = 10
+        if buf[1] & 0x80:
+            off += 4
+        return len(buf) >= off + n
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def connect(
+    host: str,
+    port: int,
+    path: str,
+    headers: Optional[Dict[str, str]] = None,
+    timeout: float = 30.0,
+) -> WebSocket:
+    """Client handshake; raises on a non-101 response."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    key = base64.b64encode(os.urandom(16)).decode()
+    req = [
+        f"GET {path} HTTP/1.1",
+        f"Host: {host}:{port}",
+        "Upgrade: websocket",
+        "Connection: Upgrade",
+        f"Sec-WebSocket-Key: {key}",
+        "Sec-WebSocket-Version: 13",
+    ]
+    for k, v in (headers or {}).items():
+        req.append(f"{k}: {v}")
+    sock.sendall(("\r\n".join(req) + "\r\n\r\n").encode())
+
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("connection closed during ws handshake")
+        resp += chunk
+    head, rest = resp.split(b"\r\n\r\n", 1)
+    status_line = head.split(b"\r\n", 1)[0].decode()
+    if " 101 " not in status_line + " ":
+        raise ConnectionError(f"websocket handshake failed: {status_line}")
+    expect = accept_key(key)
+    if expect.encode() not in head:
+        raise ConnectionError("bad Sec-WebSocket-Accept from server")
+    ws = WebSocket(sock, client=True)
+    ws._buf = rest
+    return ws
+
+
+def accept(sock: socket.socket, headers: Dict[str, str], leftover: bytes = b"") -> WebSocket:
+    """Server-side handshake over an already-parsed HTTP upgrade request.
+
+    ``headers`` must be lower-cased; ``leftover`` is any bytes the caller
+    read past the request head.
+    """
+    key = headers.get("sec-websocket-key", "")
+    if not key:
+        raise ValueError("missing Sec-WebSocket-Key")
+    resp = (
+        "HTTP/1.1 101 Switching Protocols\r\n"
+        "Upgrade: websocket\r\n"
+        "Connection: Upgrade\r\n"
+        f"Sec-WebSocket-Accept: {accept_key(key)}\r\n\r\n"
+    )
+    sock.sendall(resp.encode())
+    ws = WebSocket(sock, client=False)
+    ws._buf = leftover
+    return ws

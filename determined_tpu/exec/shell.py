@@ -1,0 +1,173 @@
+"""Shell task: a PTY behind a websocket, served behind the master proxy.
+
+Reference: ``master/internal/api_shell.go`` launches sshd in the task
+container and the CLI tunnels TCP over a TLS websocket
+(``harness/determined/cli/tunnel.py``).  TPU-native redesign: no sshd, no
+key management — the task process itself serves one endpoint,
+
+    GET {base_url}ws   (websocket)  ->  a login shell on a PTY
+
+with the master proxy as the auth boundary (the handshake only ever arrives
+through ``/proxy/{id}/ws``, which requires a master bearer token).  Frames:
+binary = raw terminal bytes both ways; text = JSON control messages
+(``{"type": "resize", "rows": R, "cols": C}``).
+
+A tiny HTTP 200 on any other path keeps the proxy's readiness/info checks
+working like the other NTSC types.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import pty
+import select
+import signal
+import socket
+import struct
+import sys
+import termios
+import threading
+import urllib.request
+
+from determined_tpu.common import ws as wslib
+
+
+def _serve_client(conn: socket.socket, shell_cmd: str) -> None:
+    """Parse one HTTP request; upgrade to WS + PTY, or answer a stub page."""
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = conn.recv(65536)
+        if not chunk:
+            conn.close()
+            return
+        buf += chunk
+    head, leftover = buf.split(b"\r\n\r\n", 1)
+    lines = head.decode(errors="replace").split("\r\n")
+    path = lines[0].split(" ")[1] if len(lines[0].split(" ")) > 1 else "/"
+    headers = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, v = line.split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+
+    if "websocket" not in headers.get("upgrade", "").lower():
+        body = json.dumps({"type": "shell", "ws": "connect with a websocket at {base}ws"})
+        conn.sendall(
+            (
+                "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n{body}"
+            ).encode()
+        )
+        conn.close()
+        return
+
+    sock_ws = wslib.accept(conn, headers, leftover)
+    pid, master_fd = pty.fork()
+    if pid == 0:  # the shell itself
+        os.environ.setdefault("TERM", "xterm-256color")
+        cmd = shell_cmd or "/bin/sh"
+        os.execvp(cmd, [cmd, "-l"])
+        os._exit(1)
+
+    stop = threading.Event()
+
+    def pty_to_ws() -> None:
+        try:
+            while not stop.is_set():
+                r, _, _ = select.select([master_fd], [], [], 0.5)
+                if master_fd in r:
+                    data = os.read(master_fd, 65536)
+                    if not data:
+                        break
+                    sock_ws.send_binary(data)
+        except OSError:
+            pass
+        finally:
+            stop.set()
+            try:
+                sock_ws.send_close()
+            except OSError:
+                pass
+
+    t = threading.Thread(target=pty_to_ws, daemon=True)
+    t.start()
+    try:
+        while not stop.is_set():
+            op, data = sock_ws.recv_message()
+            if op == wslib.OP_CLOSE:
+                break
+            if op == wslib.OP_TEXT:
+                try:
+                    msg = json.loads(data.decode())
+                except ValueError:
+                    continue
+                if msg.get("type") == "resize":
+                    winsz = struct.pack(
+                        "HHHH", int(msg.get("rows", 24)), int(msg.get("cols", 80)), 0, 0
+                    )
+                    fcntl.ioctl(master_fd, termios.TIOCSWINSZ, winsz)
+                continue
+            if data:
+                os.write(master_fd, data)
+    except (ConnectionError, OSError):
+        pass
+    finally:
+        stop.set()
+        try:
+            os.close(master_fd)
+        except OSError:
+            pass
+        try:
+            os.kill(pid, signal.SIGHUP)
+        except OSError:
+            pass
+        sock_ws.close()
+
+
+def main() -> int:
+    task_id = os.environ.get("DTPU_TASK_ID", "task")
+    port = int(os.environ.get("DTPU_TASK_PORT", "18022"))
+    token = os.environ.get("DTPU_SESSION_TOKEN", "")
+    master = os.environ["DTPU_MASTER_URL"].rstrip("/")
+    cfg = json.loads(os.environ.get("DTPU_TASK_CONFIG", "{}") or "{}")
+    shell_cmd = cfg.get("shell", "/bin/sh")
+
+    # auto-reap shell children: each ws session forks a PTY child and a
+    # long-lived task would otherwise accumulate zombies across sessions
+    signal.signal(signal.SIGCHLD, signal.SIG_IGN)
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", port))
+    srv.listen(16)
+
+    req = urllib.request.Request(
+        f"{master}/api/v1/tasks/{task_id}/ready",
+        data=b"{}",
+        headers={"Authorization": f"Bearer {token}"},
+        method="POST",
+    )
+    urllib.request.urlopen(req, timeout=30).read()
+    print(f"shell task {task_id} ready on :{port} (ws endpoint)", flush=True)
+
+    def on_term(_sig, _frame):
+        srv.close()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, on_term)
+
+    while True:
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return 0
+        threading.Thread(
+            target=_serve_client, args=(conn, shell_cmd), daemon=True
+        ).start()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -287,16 +287,136 @@ class TransformerLM(nn.Module):
         return (out, aux_total) if return_aux else out
 
 
+def split_pipeline_params(boxed_params: Any, n_stages: int) -> Dict[str, Any]:
+    """Restructure a plain ``TransformerLM`` param tree for pipeline stages.
+
+    Input: the tree from ``TransformerLM.init`` (possibly flax-``Partitioned``
+    boxed).  Output: ``{"outer": <embed/ln_f/lm_head, boxes kept>,
+    "blocks": <stacked [P, layers_per_stage, ...], unboxed>}``.  Because the
+    stacked leaves are built from the SAME initialized values as the flat
+    ``block_i`` subtrees, a pipe>1 trial initializes identically to pipe=1 —
+    the basis of the loss-parity tests.
+    """
+    from flax.core import meta as flax_meta
+
+    tree = dict(boxed_params["params"])
+    block_keys = sorted(
+        (k for k in tree if k.startswith("block_")), key=lambda k: int(k.split("_")[1])
+    )
+    n_layers = len(block_keys)
+    if n_layers == 0 or n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={n_layers} not divisible into {n_stages} pipeline stages"
+        )
+    lps = n_layers // n_stages
+    blocks = [flax_meta.unbox(tree.pop(k)) for k in block_keys]
+    stages = [
+        jax.tree.map(lambda *ls: jnp.stack(ls), *blocks[s * lps : (s + 1) * lps])
+        for s in range(n_stages)
+    ]
+    stacked = jax.tree.map(lambda *ss: jnp.stack(ss), *stages)
+    outer = {"params": tree}
+    extra = {k: v for k, v in boxed_params.items() if k != "params"}
+    if extra:
+        outer.update(extra)
+    return {"outer": outer, "blocks": stacked}
+
+
+def pipeline_forward(
+    cfg: TransformerConfig,
+    mesh: Any,
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    num_microbatches: int,
+    return_hidden: bool = False,
+    rules: Any = None,
+) -> jax.Array:
+    """Forward pass with the transformer blocks pipelined over ``pipe``.
+
+    ``params`` is the ``split_pipeline_params`` layout.  Embed / final norm /
+    lm_head run as ordinary SPMD computation outside the pipeline (sharded by
+    their logical annotations); only the block stack rides the GPipe schedule
+    (``parallel/pipeline.py``).  Stage block params are sharded over ``pipe``
+    and replicated over data/fsdp inside the schedule's ``shard_map``; the
+    batch stays sharded over data/fsdp (pipeline composes with DP/FSDP on the
+    batch — FSDP sharding of block *params* does not compose yet).
+    """
+    from flax.core import meta as flax_meta
+
+    from determined_tpu.parallel.pipeline import pipeline_apply
+
+    if mesh is not None and mesh.shape.get(MeshAxes.SEQUENCE, 1) > 1:
+        raise ValueError("pipeline parallelism does not compose with the seq axis yet")
+    outer = flax_meta.unbox(params["outer"])["params"]
+    blocks = params["blocks"]
+
+    emb = nn.Embed(
+        cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32
+    )
+    x = emb.apply({"params": outer["embed"]}, tokens)
+    x = with_sharding_constraint(x, ("batch", "length", "embed"), mesh=mesh, rules=rules)
+
+    stage_cfg = dataclasses.replace(
+        cfg,
+        partition_params=False,
+        attention_impl="auto" if cfg.attention_impl == "ring" else cfg.attention_impl,
+    )
+    blk = Block(stage_cfg)
+    lps = jax.tree.leaves(blocks)[0].shape[1]
+
+    def block_step(p, h):
+        return blk.apply({"params": p}, h)[0]
+
+    if cfg.remat:
+        block_step = jax.checkpoint(block_step, prevent_cse=False)
+
+    def stage_fn(stage_params, h):
+        for l in range(lps):
+            h = block_step(jax.tree.map(lambda a: a[l], stage_params), h)
+        return h
+
+    x = pipeline_apply(stage_fn, blocks, x, mesh, num_microbatches)
+    x = RMSNorm(partition=False).apply({"params": outer["ln_f"]}, x)
+    if return_hidden:
+        return x
+    head = nn.Dense(
+        cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32
+    )
+    return head.apply({"params": outer["lm_head"]}, x).astype(jnp.float32)
+
+
 class LMTrial(JaxTrial):
     """Language-model trial over synthetic (or user-supplied) token data.
 
     Hyperparameters: lr, global_batch_size, seq_len, vocab_size, d_model,
     n_layers, n_heads, n_kv_heads, d_ff, attention (auto/flash/ring/
-    reference), remat, warmup_steps, dataset_size.
+    reference), remat, warmup_steps, dataset_size, pipe_microbatches.
+
+    When the context mesh has a ``pipe`` axis of size P > 1, the trial
+    restructures its params into stacked pipeline stages and trains through
+    the GPipe schedule (``pipeline_forward``) — same init, same loss as
+    pipe=1 (verified by ``tests/test_pipeline_e2e.py``).
     """
+
+    def _pipe_stages(self) -> int:
+        mesh = self.context.mesh
+        return int(mesh.shape.get(MeshAxes.PIPELINE, 1)) if mesh is not None else 1
+
+    def _pipe_microbatches(self, batch: int) -> int:
+        m = self.context.get_hparam("pipe_microbatches", None)
+        if m:
+            return int(m)
+        # default: 2 microbatches per stage (bubble fraction (P-1)/(M+P-1)),
+        # shrunk to the largest divisor of the batch
+        m = min(batch, 2 * self._pipe_stages())
+        while batch % m:
+            m -= 1
+        return m
 
     def _cfg(self) -> TransformerConfig:
         g = self.context.get_hparam
+        if self._pipe_stages() > 1 and int(g("moe_experts", 0)) > 0:
+            raise ValueError("MoE blocks do not compose with pipeline stages yet")
         return TransformerConfig(
             vocab_size=int(g("vocab_size", 2048)),
             d_model=int(g("d_model", 256)),
@@ -367,6 +487,28 @@ class LMTrial(JaxTrial):
     def model_inputs(self, batch: Dict[str, Any]) -> Tuple[Any, ...]:
         return (jnp.asarray(batch["tokens"])[:, :-1],)
 
+    def init_params(self, model: TransformerLM, rng: jax.Array, sample_batch: Dict[str, Any]) -> Any:
+        params = super().init_params(model, rng, sample_batch)
+        pipe = self._pipe_stages()
+        if pipe > 1:
+            return split_pipeline_params(params, pipe)
+        return params
+
+    def param_logical_specs(self, params: Any) -> Any:
+        if self._pipe_stages() <= 1:
+            return None
+        from flax.core import meta as flax_meta
+
+        from determined_tpu.train._trainer import _specs_from_flax_metadata
+
+        outer = _specs_from_flax_metadata(params["outer"])
+        if outer is None:
+            outer = jax.tree.map(lambda _: None, flax_meta.unbox(params["outer"]))
+        blocks = jax.tree.map(
+            lambda a: ("stage",) + (None,) * (a.ndim - 1), params["blocks"]
+        )
+        return {"outer": outer, "blocks": blocks}
+
     def loss(
         self, model: TransformerLM, params: Any, batch: Dict[str, jax.Array], rng: jax.Array
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
@@ -376,6 +518,8 @@ class LMTrial(JaxTrial):
         fused = g("fused_ce", "auto")
         if fused == "auto":
             fused = model.cfg.vocab_size >= 8192
+        if self._pipe_stages() > 1:
+            return self._pipeline_loss(model, params, inputs, targets, fused)
         if fused:
             from flax.core import meta as flax_meta
 
@@ -404,6 +548,46 @@ class LMTrial(JaxTrial):
             metrics["moe_aux_loss"] = moe_aux
             loss = loss + model.cfg.moe_aux_weight * moe_aux
         return loss, metrics
+
+    def _pipeline_loss(
+        self,
+        model: TransformerLM,
+        params: Any,
+        inputs: jax.Array,
+        targets: jax.Array,
+        fused: bool,
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Loss through the GPipe schedule (mesh has a pipe axis > 1)."""
+        g = self.context.get_hparam
+        mb = self._pipe_microbatches(inputs.shape[0])
+        if fused:
+            from flax.core import meta as flax_meta
+
+            from determined_tpu.ops.cross_entropy import fused_cross_entropy
+
+            hidden = pipeline_forward(
+                model.cfg, self.context.mesh, params, inputs, mb,
+                return_hidden=True, rules=self.context.rules,
+            )
+            kernel = flax_meta.unbox(params["outer"]["params"]["lm_head"]["kernel"])
+            chunk = g("ce_chunk", None)
+            shards = self.context.batch_axis_size
+            loss = fused_cross_entropy(
+                hidden,
+                kernel,
+                targets,
+                chunk_size=None if chunk in (None, "auto") else int(chunk),
+                compute_dtype=model.cfg.dtype,
+                batch_shards=shards,
+                bf16_residual=bool(g("ce_bf16_residual", False)),
+            )
+        else:
+            logits = pipeline_forward(
+                model.cfg, self.context.mesh, params, inputs, mb,
+                rules=self.context.rules,
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+        return loss, {"perplexity": jnp.exp(loss)}
 
     def evaluate_batch(
         self, model: TransformerLM, params: Any, batch: Dict[str, jax.Array]

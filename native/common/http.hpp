@@ -10,8 +10,11 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
+
+#include <ctime>
 
 #include <atomic>
 #include <cstring>
@@ -39,6 +42,11 @@ struct HttpResponse {
   std::string body;
   // extra response headers (e.g. Set-Cookie from the proxy auth)
   std::vector<std::pair<std::string, std::string>> headers;
+  // Connection hijack (websocket upgrade passthrough): when set, the server
+  // writes NO response; the hijacker takes ownership of the client fd (and
+  // any bytes already read past the request) and must close it.  Reference
+  // analog: the Go proxy's ws hijack (master/internal/proxy/proxy.go).
+  std::function<void(int client_fd, std::string leftover)> hijack;
 
   static HttpResponse json(const std::string& body, int status = 200) {
     HttpResponse r;
@@ -165,6 +173,10 @@ class HttpServer {
         resp = dispatch(req);
       } catch (const std::exception& e) {
         resp = HttpResponse::error(500, e.what());
+      }
+      if (resp.hijack) {
+        resp.hijack(client, std::move(buffer));
+        return;  // hijacker owns + closes the fd
       }
       if (!write_response(client, resp)) break;
       auto conn = req.headers.find("connection");
@@ -313,6 +325,76 @@ class HttpServer {
   std::thread accept_thread_;
   std::vector<Route> routes_;
 };
+
+// ---- raw TCP helpers (websocket upgrade passthrough) -----------------------
+
+inline int tcp_connect(const std::string& host, int port, int timeout_sec = 10) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  timeval tv{timeout_sec, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int opt = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &opt, sizeof(opt));
+  return fd;
+}
+
+inline bool send_all(int fd, const char* data, size_t len) {
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd, data + sent, len - sent, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    sent += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// Pump bytes both ways between two sockets until either side closes.
+// ``on_activity`` (optional) is invoked at most every ``activity_period_sec``
+// while traffic flows — the proxy uses it to keep a task's idle clock fresh
+// during a long-lived websocket session.  Closes NEITHER fd.
+inline void relay_bidirectional(int a, int b,
+                                std::function<void()> on_activity = nullptr,
+                                int activity_period_sec = 15) {
+  // clear any client-handshake timeouts: ws sessions idle legitimately
+  timeval tv{0, 0};
+  setsockopt(a, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(b, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  time_t last_touch = ::time(nullptr);
+  pollfd fds[2];
+  fds[0] = {a, POLLIN, 0};
+  fds[1] = {b, POLLIN, 0};
+  char buf[16384];
+  while (true) {
+    fds[0].revents = fds[1].revents = 0;
+    int rc = ::poll(fds, 2, 60000);
+    if (rc < 0) break;
+    if (rc == 0) continue;  // idle: keep the session open
+    for (int i = 0; i < 2; ++i) {
+      if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+        ssize_t n = ::recv(fds[i].fd, buf, sizeof(buf), 0);
+        if (n <= 0) return;
+        int dst = (i == 0) ? b : a;
+        if (!send_all(dst, buf, static_cast<size_t>(n))) return;
+        if (on_activity) {
+          time_t now = ::time(nullptr);
+          if (now - last_touch >= activity_period_sec) {
+            last_touch = now;
+            on_activity();
+          }
+        }
+      }
+    }
+  }
+}
 
 // ---- tiny blocking client (used by the agent) ------------------------------
 

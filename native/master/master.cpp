@@ -3511,6 +3511,11 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       module = "determined_tpu.exec.tensorboard";
     } else if (type == "notebook") {
       module = "determined_tpu.exec.notebook";
+    } else if (type == "shell") {
+      // PTY behind a websocket (reference api_shell.go tunnels sshd; a WS
+      // exec channel is the TPU-native redesign — same capability, one
+      // fewer daemon)
+      module = "determined_tpu.exec.shell";
     } else {
       return R::error(400, "unknown task type: " + type);
     }
@@ -3641,7 +3646,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
   }));
 
   // ---- reverse proxy to ready tasks (reference internal/proxy/) ----
-  // Dev-grade: plain HTTP passthrough (no websocket upgrade, no TLS);
+  // HTTP passthrough + RFC6455 websocket upgrade relay (no TLS yet);
   // auth is the same bearer token as the API.
   // Browser-friendly proxy auth: bearer header, or dtpu_token cookie, or
   // a one-time ?dtpu_token= query param that sets the cookie (pasted
@@ -3741,6 +3746,59 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     }
     auto xit = req.headers.find("x-xsrftoken");
     if (xit != req.headers.end()) fwd.push_back({"X-XSRFToken", xit->second});
+
+    // ---- websocket upgrade passthrough (RFC6455) ----
+    // Forward the handshake to the task, then relay raw bytes both ways —
+    // no frame parsing needed for a transparent proxy.  This is what makes
+    // jupyter kernels (ws-only) and shell PTYs work through the master.
+    auto upit = req.headers.find("upgrade");
+    if (upit != req.headers.end()) {
+      std::string up = upit->second;
+      for (auto& c : up) c = static_cast<char>(tolower(c));
+      if (up.find("websocket") != std::string::npos) {
+        std::string task_id = req.params.at("id");
+        std::ostringstream hs;
+        hs << "GET " << target << " HTTP/1.1\r\n"
+           << "Host: " << host << ":" << port << "\r\n"
+           << "Upgrade: websocket\r\nConnection: Upgrade\r\n";
+        for (const char* h : {"sec-websocket-key", "sec-websocket-version",
+                              "sec-websocket-protocol",
+                              "sec-websocket-extensions", "origin"}) {
+          auto hit = req.headers.find(h);
+          if (hit != req.headers.end()) hs << h << ": " << hit->second << "\r\n";
+        }
+        for (const auto& [k, v] : fwd) hs << k << ": " << v << "\r\n";
+        hs << "\r\n";
+        std::string handshake = hs.str();
+        HttpResponse out;
+        out.hijack = [&m, host, port, handshake, task_id](int client,
+                                                          std::string leftover) {
+          int upstream = tcp_connect(host, port, 10);
+          if (upstream < 0) {
+            const char* err =
+                "HTTP/1.1 502 Bad Gateway\r\nContent-Length: 0\r\n\r\n";
+            send_all(client, err, strlen(err));
+            ::close(client);
+            return;
+          }
+          bool ok = send_all(upstream, handshake.data(), handshake.size());
+          if (ok && !leftover.empty()) {
+            ok = send_all(upstream, leftover.data(), leftover.size());
+          }
+          if (ok) {
+            relay_bidirectional(client, upstream, [&m, task_id] {
+              std::lock_guard<std::mutex> lk(m.mu_);
+              auto it = m.tasks_.find(task_id);
+              if (it != m.tasks_.end()) it->second.last_used_ms = now_ms();
+            });
+          }
+          ::close(upstream);
+          ::close(client);
+        };
+        return out;
+      }
+    }
+
     auto resp = http_request(host, port, req.method, target, req.body, 30, fwd);
     if (resp.status == 0) return R::error(502, "task unreachable");
     HttpResponse out;

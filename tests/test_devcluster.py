@@ -13,6 +13,7 @@ import signal
 import socket
 import subprocess
 import time
+import uuid
 
 import pytest
 import requests
@@ -1236,6 +1237,145 @@ def test_notebook_task_behind_proxy(cluster, tmp_path):
     )
     assert r.status_code == 200, r.text
     assert "version" in r.json()
+    cluster.http.delete(cluster.url + f"/api/v1/tasks/{task_id}")
+
+
+def _wait_task_ready(cluster, task_id, timeout=150):
+    deadline = time.time() + timeout
+    info = {}
+    while time.time() < deadline:
+        info = cluster.http.get(f"{cluster.url}/api/v1/tasks/{task_id}").json()
+        if info.get("ready") or info.get("state") == "TERMINATED":
+            break
+        time.sleep(1.0)
+    assert info.get("ready"), info
+    return info
+
+
+def test_notebook_kernel_executes_through_proxy(cluster, tmp_path):
+    """The real thing a notebook exists for: a KERNEL executes code — and
+    jupyter kernels speak ONLY websocket, so this exercises the proxy's
+    RFC6455 upgrade passthrough end to end (reference proxy.go ws path)."""
+    pytest.importorskip("jupyter_server")
+    from determined_tpu.common import ws as wslib
+
+    r = cluster.http.post(
+        cluster.url + "/api/v1/tasks",
+        json={"type": "notebook", "config": {"work_dir": str(tmp_path)}},
+    )
+    assert r.status_code == 201, r.text
+    task_id = r.json()["id"]
+    info = _wait_task_ready(cluster, task_id)
+    jt = info["token"]  # the task session token doubles as jupyter's token
+
+    # start a kernel over REST through the proxy
+    r = cluster.http.post(
+        cluster.url + f"/proxy/{task_id}/api/kernels",
+        params={"token": jt},
+        json={"name": "python3"},
+        timeout=60,
+    )
+    assert r.status_code in (200, 201), r.text
+    kid = r.json()["id"]
+
+    # open the kernel's channels WEBSOCKET through the proxy and run 1+1
+    session = uuid.uuid4().hex
+    ws = wslib.connect(
+        "127.0.0.1",
+        cluster.port,
+        f"/proxy/{task_id}/api/kernels/{kid}/channels"
+        f"?session_id={session}&token={jt}",
+        headers={"Authorization": f"Bearer {cluster.token}"},
+        timeout=60,
+    )
+    msg_id = uuid.uuid4().hex
+    execute = {
+        "header": {
+            "msg_id": msg_id,
+            "username": "tests",
+            "session": session,
+            "msg_type": "execute_request",
+            "version": "5.3",
+            "date": "2026-01-01T00:00:00Z",
+        },
+        "parent_header": {},
+        "metadata": {},
+        "content": {
+            "code": "1+1",
+            "silent": False,
+            "store_history": True,
+            "user_expressions": {},
+            "allow_stdin": False,
+        },
+        "channel": "shell",
+        "buffers": [],
+    }
+    ws.send_text(json.dumps(execute))
+    result = None
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        op, data = ws.recv_message()
+        if op == wslib.OP_CLOSE:
+            break
+        try:
+            msg = json.loads(data.decode())
+        except ValueError:
+            continue
+        if (
+            msg.get("msg_type") == "execute_result"
+            and msg.get("parent_header", {}).get("msg_id") == msg_id
+        ):
+            result = msg["content"]["data"]["text/plain"]
+            break
+    ws.close()
+    assert result == "2", f"kernel did not answer 1+1: {result!r}"
+    cluster.http.delete(cluster.url + f"/api/v1/tasks/{task_id}")
+
+
+def test_shell_task_executes_through_proxy(cluster):
+    """Third NTSC type: an interactive shell — a PTY behind a websocket
+    (reference api_shell.go + cli/tunnel.py, redesigned without sshd)."""
+    from determined_tpu.common import ws as wslib
+
+    r = cluster.http.post(
+        cluster.url + "/api/v1/tasks",
+        json={"type": "shell", "config": {"shell": "/bin/sh"}},
+    )
+    assert r.status_code == 201, r.text
+    task_id = r.json()["id"]
+    _wait_task_ready(cluster, task_id, timeout=60)
+
+    # non-ws GET still answers (readiness/info page)
+    r = cluster.http.get(
+        cluster.url + f"/proxy/{task_id}/", params={"dtpu_token": cluster.token}
+    )
+    assert r.status_code == 200, r.text
+    assert r.json()["type"] == "shell"
+
+    ws = wslib.connect(
+        "127.0.0.1",
+        cluster.port,
+        f"/proxy/{task_id}/ws",
+        headers={"Authorization": f"Bearer {cluster.token}"},
+        timeout=30,
+    )
+    ws.send_text(json.dumps({"type": "resize", "rows": 24, "cols": 80}))
+    ws.send_binary(b"echo dtpu-$((40+2))\n")
+    seen = b""
+    deadline = time.time() + 30
+    ok = False
+    while time.time() < deadline:
+        op, data = ws.recv_message()
+        if op == wslib.OP_CLOSE:
+            break
+        seen += data
+        # the PTY echoes the command; require the OUTPUT line (no '$((' )
+        if b"dtpu-42" in seen and b"dtpu-42\r" in seen.replace(b"$((40+2))", b""):
+            ok = True
+            break
+    assert ok, f"shell output not seen: {seen[-500:]!r}"
+    ws.send_binary(b"exit\n")
+    ws.close()
     cluster.http.delete(cluster.url + f"/api/v1/tasks/{task_id}")
 
 
