@@ -73,6 +73,15 @@ def main() -> None:
         # per-block remat: required for very long context on one chip
         # (seq 32k activations exceed HBM without it)
         "remat": os.environ.get("DTPU_BENCH_REMAT", "0") == "1",
+        # optimizer: fused single-sweep pallas adamw (auto = on-TPU) vs
+        # the optax chain; DTPU_BENCH_OPT=ref for A/B sweeps
+        "fused_adamw": {"auto": "auto", "fused": True, "ref": False}[
+            os.environ.get("DTPU_BENCH_OPT", "auto")
+        ],
+        # bf16 first moment is free inside the fused kernel (conversion
+        # rides the same pass) and halves mu traffic: part of the tuned
+        # config.  DTPU_BENCH_MU_BF16=0 for the f32 A/B.
+        "adam_mu_bf16": os.environ.get("DTPU_BENCH_MU_BF16", "1") == "1",
     }
     ctx = train.init(
         hparams=hp,

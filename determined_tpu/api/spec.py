@@ -35,6 +35,12 @@ ROUTES = [
      {"id", "name", "owner", "state", "config", "progress", "trials"}),
     ("GET", "/api/v1/experiments/{id}/context", "token", None),
     ("GET", "/api/v1/workspaces", "token", "[]"),
+    # first-class workspace entities + scoped RBAC
+    ("POST", "/api/v1/workspaces", "token", {"name", "owner"}),
+    ("POST", "/api/v1/workspaces/{name}/archive", "token", {"name", "archived"}),
+    ("POST", "/api/v1/workspaces/{name}/unarchive", "token", {"name", "archived"}),
+    ("PUT", "/api/v1/workspaces/{name}/roles", "token", {"name", "username", "role"}),
+    ("DELETE", "/api/v1/workspaces/{name}", "token", set()),
     ("POST", "/api/v1/experiments/{id}/fork", "token", {"id", "forked_from"}),
     ("POST", "/api/v1/experiments/{id}/continue", "token",
      {"id", "forked_from", "continued_from_checkpoint"}),
@@ -84,6 +90,11 @@ ROUTES = [
     ("GET", "/api/v1/templates", "token", "[]"),
     ("GET", "/api/v1/templates/{name}", "token", {"name", "config"}),
     ("DELETE", "/api/v1/templates/{name}", "token", set()),
+    # config policies (cluster/workspace defaults + invariants + constraints)
+    ("PUT", "/api/v1/config-policies/{scope}", "admin", {"scope"}),
+    ("GET", "/api/v1/config-policies", "token", "[]"),
+    ("GET", "/api/v1/config-policies/{scope}", "token", {"scope", "policy"}),
+    ("DELETE", "/api/v1/config-policies/{scope}", "admin", set()),
     # events (streaming updates)
     ("GET", "/api/v1/events", "token", "[]"),
     # generic tasks + proxy

@@ -314,6 +314,51 @@ def notebook_start(args) -> int:
     return 0
 
 
+def workspace_create(args) -> int:
+    info = _client(args).create_workspace(args.name)
+    print(f"workspace {info['name']} created (owner {info['owner']})")
+    return 0
+
+
+def workspace_list(args) -> int:
+    _table(
+        _client(args).list_workspaces(),
+        ["name", "experiments", "registered", "archived", "owner"],
+    )
+    return 0
+
+
+def workspace_archive(args) -> int:
+    _client(args).archive_workspace(args.name, archived=not args.undo)
+    print(f"workspace {args.name} {'unarchived' if args.undo else 'archived'}")
+    return 0
+
+
+def workspace_delete(args) -> int:
+    _client(args).delete_workspace(args.name)
+    print(f"workspace {args.name} deleted")
+    return 0
+
+
+def workspace_assign(args) -> int:
+    _client(args).assign_workspace_role(args.name, args.username, args.role)
+    print(f"workspace {args.name}: {args.username} -> {args.role}")
+    return 0
+
+
+def events_cmd(args) -> int:
+    """Stream the cluster event feed (reference `det` streams client)."""
+    d = _client(args)
+    try:
+        for ev in d.events(
+            since=args.since, follow=args.follow, types=args.type or None
+        ):
+            print(json.dumps(ev), flush=True)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def shell_start(args) -> int:
     d = _client(args)
     info = d.start_shell(shell=args.shell)
@@ -670,6 +715,32 @@ def build_parser() -> argparse.ArgumentParser:
     ns.add_argument("--work-dir")
     ns.add_argument("--timeout", type=float, default=150.0)
     ns.set_defaults(fn=notebook_start)
+
+    ws = sub.add_parser("workspace", aliases=["w"]).add_subparsers(
+        dest="verb", required=True
+    )
+    wc = ws.add_parser("create")
+    wc.add_argument("name")
+    wc.set_defaults(fn=workspace_create)
+    ws.add_parser("list").set_defaults(fn=workspace_list)
+    wa = ws.add_parser("archive")
+    wa.add_argument("name")
+    wa.add_argument("--undo", action="store_true")
+    wa.set_defaults(fn=workspace_archive)
+    wd = ws.add_parser("delete")
+    wd.add_argument("name")
+    wd.set_defaults(fn=workspace_delete)
+    wr = ws.add_parser("assign")
+    wr.add_argument("name")
+    wr.add_argument("username")
+    wr.add_argument("role", choices=["viewer", "user", "admin", "none"])
+    wr.set_defaults(fn=workspace_assign)
+
+    ev = sub.add_parser("events")
+    ev.add_argument("-f", "--follow", action="store_true")
+    ev.add_argument("--since", type=int, default=0)
+    ev.add_argument("--type", action="append", help="filter by event type (repeatable)")
+    ev.set_defaults(fn=events_cmd)
 
     sh = sub.add_parser("shell").add_subparsers(dest="verb", required=True)
     ss = sh.add_parser("start")

@@ -467,6 +467,65 @@ class Determined:
                 raise TimeoutError(f"task {task_id} not ready after {timeout}s")
             time.sleep(0.5)
 
+    # -- workspaces (reference api_project.go + rbac/) --
+    def create_workspace(self, name: str) -> Dict[str, Any]:
+        return self._session.post("/api/v1/workspaces", json={"name": name}).json()
+
+    def list_workspaces(self) -> List[Dict[str, Any]]:
+        return self._session.get("/api/v1/workspaces").json()
+
+    def archive_workspace(self, name: str, archived: bool = True) -> None:
+        from urllib.parse import quote
+
+        verb = "archive" if archived else "unarchive"
+        self._session.post(f"/api/v1/workspaces/{quote(name, safe='')}/{verb}")
+
+    def delete_workspace(self, name: str) -> None:
+        from urllib.parse import quote
+
+        self._session.delete(f"/api/v1/workspaces/{quote(name, safe='')}")
+
+    def assign_workspace_role(self, name: str, username: str, role: str) -> None:
+        """Bind ``username`` to ``role`` (viewer/user/admin; "none" removes)
+        in workspace ``name``; a workspace with any binding is restricted
+        to bound users + its owner + cluster admins."""
+        from urllib.parse import quote
+
+        self._session.put(
+            f"/api/v1/workspaces/{quote(name, safe='')}/roles",
+            json={"username": username, "role": role},
+        )
+
+    # -- streaming events (reference common/streams/_client.py) --
+    def events(
+        self,
+        since: int = 0,
+        follow: bool = False,
+        types: Optional[List[str]] = None,
+        poll_timeout: float = 30.0,
+    ):
+        """Iterate the master's seq-ordered event feed.
+
+        The reference streams entity deltas over a websocket
+        (``harness/determined/common/streams/_client.py``); here the
+        journal doubles as the feed and a long-poll carries it.  Yields
+        event dicts (each has ``seq`` + ``type``); with ``follow=True``
+        blocks for new events until the caller breaks, otherwise returns
+        once the backlog is drained.
+        """
+        while True:
+            params = {"since": str(since)}
+            if follow:
+                params["timeout_seconds"] = str(int(poll_timeout))
+            batch = self._session.get("/api/v1/events", params=params).json()
+            for ev in batch:
+                since = max(since, int(ev.get("seq", since)))
+                if types and ev.get("type") not in types:
+                    continue
+                yield ev
+            if not batch and not follow:
+                return
+
     # -- config templates --
     def set_template(self, name: str, config: Dict[str, Any]) -> None:
         self._session.put(f"/api/v1/templates/{name}", json={"config": config})

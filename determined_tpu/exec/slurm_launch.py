@@ -1,0 +1,72 @@
+"""Per-rank bootstrap for Slurm multi-node gangs.
+
+Reference: dispatcherrm's multi-node batch launch
+(``master/internal/rm/dispatcherrm/dispatcher_resource_manager.go``) wires
+ranks through the HPE launcher; here the master submits ONE sbatch job with
+``--nodes=N --ntasks-per-node=1`` (``native/master/rm.hpp``) and every srun
+task runs this module, which derives its rank envs from Slurm's own
+variables and then execs the normal trial runner:
+
+- node rank           <- SLURM_PROCID (fallback SLURM_NODEID)
+- coordinator host    <- first host of SLURM_JOB_NODELIST (``scontrol show
+                         hostnames`` for bracketed lists), rank-0's node
+- DTPU_RENDEZVOUS / DTPU_CHIEF_* / DTPU_NUM_SLOTS / per-rank DTPU_AGENT_ID
+
+This mirrors what the master computes server-side for k8s gangs
+(master.cpp: kubernetes launch branch); Slurm can't know hostnames at
+submit time, so the computation moves into the job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def coordinator_host() -> str:
+    override = os.environ.get("DTPU_SLURM_COORD_HOST")
+    if override:
+        return override
+    nodelist = os.environ.get("SLURM_JOB_NODELIST", "127.0.0.1")
+    if "[" not in nodelist:
+        return nodelist.split(",")[0].strip()
+    out = subprocess.run(
+        ["scontrol", "show", "hostnames", nodelist],
+        capture_output=True,
+        text=True,
+        timeout=30,
+    )
+    hosts = out.stdout.split()
+    if not hosts:
+        raise SystemExit(f"cannot resolve SLURM_JOB_NODELIST {nodelist!r}")
+    return hosts[0]
+
+
+def main() -> None:
+    rank = int(os.environ.get("SLURM_PROCID", os.environ.get("SLURM_NODEID", "0")))
+    n = int(os.environ["DTPU_GANG_NODES"])
+    per_node = int(os.environ["DTPU_GANG_SLOTS_PER_NODE"])
+    total = int(os.environ.get("DTPU_GANG_TOTAL_SLOTS", str(n * per_node)))
+    slots = min(per_node, max(total - rank * per_node, 1))
+    env = os.environ
+    env["DTPU_NUM_SLOTS"] = str(slots)
+    if n > 1:
+        coord = coordinator_host()
+        env["DTPU_RENDEZVOUS"] = json.dumps(
+            {"coordinator": f"{coord}:16999", "num_nodes": n, "node_rank": rank}
+        )
+        env["DTPU_CHIEF_ADDR"] = coord
+        env["DTPU_CHIEF_PORT"] = "16998"
+        # distinct shipper identity per rank (see master.cpp k8s branch:
+        # batch-seq watermarks and exclude_node attribution are per-agent)
+        env["DTPU_AGENT_ID"] = env.get("DTPU_AGENT_ID", "slurm") + f"/r{rank}"
+    os.execv(
+        sys.executable,
+        [sys.executable, "-m", "determined_tpu.exec.run_trial"] + sys.argv[1:],
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -449,6 +449,20 @@ class LMTrial(JaxTrial):
         # optimizer update is bandwidth-bound); second moment stays f32
         # for the rsqrt's dynamic range
         mu_dtype = jnp.bfloat16 if bool(g("adam_mu_bf16", False)) else None
+        fused = g("fused_adamw", "auto")
+        if fused == "auto":
+            fused = jax.default_backend() == "tpu"
+        if fused:
+            # single-sweep Pallas AdamW+clip (ops/fused_adamw.py): 8 HBM
+            # passes vs optax's measured 9 on the bandwidth-bound update
+            from determined_tpu.ops.fused_adamw import fused_adamw
+
+            return fused_adamw(
+                schedule,
+                weight_decay=float(g("weight_decay", 0.01)),
+                clip_norm=float(g("grad_clip", 1.0)),
+                mu_dtype=mu_dtype,
+            )
         return optax.chain(
             optax.clip_by_global_norm(float(g("grad_clip", 1.0))),
             optax.adamw(

@@ -272,8 +272,14 @@ class Trainer:
                 metrics = {k: v / agg for k, v in metrics.items()}
                 if average_grads:
                     grads = jax.tree.map(lambda g: g / agg, grads)
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
+            if hasattr(tx, "apply_step"):
+                # fused full-step optimizer (ops/fused_adamw.py): produces
+                # new params directly — materializing an updates tree would
+                # cost two extra HBM passes on a bandwidth-bound step
+                new_params, new_opt = tx.apply_step(grads, state.opt_state, state.params)
+            else:
+                updates, new_opt = tx.update(grads, state.opt_state, state.params)
+                new_params = optax.apply_updates(state.params, updates)
             metrics = dict(metrics)
             metrics["loss"] = loss
             # schedule-state surfacing (reference LRScheduler wrapper): a

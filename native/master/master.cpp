@@ -19,6 +19,7 @@
 //
 // Build: see native/CMakeLists.txt.  No third-party dependencies.
 
+#include <array>
 #include <condition_variable>
 #include <cstdio>
 #include <deque>
@@ -162,6 +163,19 @@ struct GenericTaskState {
   // proxy has been quiet for idle_timeout_ms are killed
   int64_t idle_timeout_ms = 0;    // 0 = never
   int64_t last_used_ms = 0;
+};
+
+// First-class workspace entity (reference master/internal/api_project.go +
+// rbac/: workspaces own experiments, carry archival state, and scope role
+// bindings).  A workspace with bindings is RESTRICTED: only bound users,
+// the owner, and cluster admins touch its experiments; a workspace that is
+// only ever a config tag stays open (back-compat with tag filtering).
+struct WorkspaceState {
+  std::string name;
+  std::string owner;
+  bool archived = false;
+  int64_t created_ms = 0;
+  std::map<std::string, std::string> bindings;  // user -> viewer|user|admin
 };
 
 // outbound webhook (reference master/internal/webhooks/): fires on
@@ -431,7 +445,7 @@ class Master {
         int64_t tid = alloc.trial_id;
         // kill the gang's processes on the agents that are still alive
         kill_allocation(alloc);
-        append_jsonl(logs_path(tid),
+        append_jsonl_striped(logs_path(tid),
                      Json::object()
                          .set("ts", Json(now))
                          .set("level", "ERROR")
@@ -565,6 +579,31 @@ class Master {
       templates_[ev["name"].as_string()] = ev["config"];
     } else if (type == "template_deleted") {
       templates_.erase(ev["name"].as_string());
+    } else if (type == "config_policy_set") {
+      config_policies_[ev["scope"].as_string()] = ev["policy"];
+    } else if (type == "config_policy_deleted") {
+      config_policies_.erase(ev["scope"].as_string());
+    } else if (type == "workspace_created") {
+      WorkspaceState w;
+      w.name = ev["name"].as_string();
+      w.owner = ev["owner"].as_string();
+      w.created_ms = ev["ts"].as_int(0);
+      workspaces_[w.name] = w;
+    } else if (type == "workspace_archived") {
+      auto it = workspaces_.find(ev["name"].as_string());
+      if (it != workspaces_.end()) it->second.archived = ev["archived"].as_bool(true);
+    } else if (type == "workspace_deleted") {
+      workspaces_.erase(ev["name"].as_string());
+    } else if (type == "workspace_role_set") {
+      auto it = workspaces_.find(ev["name"].as_string());
+      if (it != workspaces_.end()) {
+        const std::string role = ev["role"].as_string();
+        if (role.empty() || role == "none") {
+          it->second.bindings.erase(ev["username"].as_string());
+        } else {
+          it->second.bindings[ev["username"].as_string()] = role;
+        }
+      }
     } else if (type == "model_created") {
       models_[ev["name"].as_string()] = ev["model"];
     } else if (type == "model_version") {
@@ -689,6 +728,20 @@ class Master {
     Json templates = Json::object();
     for (const auto& [name, cfg] : templates_) templates.set(name, cfg);
     snap.set("templates", templates);
+    Json policies = Json::object();
+    for (const auto& [scope, pol] : config_policies_) policies.set(scope, pol);
+    snap.set("config_policies", policies);
+    Json wss = Json::object();
+    for (const auto& [name, w] : workspaces_) {
+      Json b = Json::object();
+      for (const auto& [u, r] : w.bindings) b.set(u, r);
+      wss.set(name, Json::object()
+                        .set("owner", w.owner)
+                        .set("archived", Json(w.archived))
+                        .set("created_ms", Json(w.created_ms))
+                        .set("bindings", b));
+    }
+    snap.set("workspace_entities", wss);
     Json checkpoints = Json::object();
     for (const auto& [uuid, c] : checkpoints_) checkpoints.set(uuid, c);
     snap.set("checkpoints", checkpoints);
@@ -779,6 +832,24 @@ class Master {
     for (const auto& [name, model] : s["models"].items()) models_[name] = model;
     if (s.contains("templates")) {
       for (const auto& [name, cfg] : s["templates"].items()) templates_[name] = cfg;
+    }
+    if (s.contains("config_policies")) {
+      for (const auto& [scope, pol] : s["config_policies"].items()) {
+        config_policies_[scope] = pol;
+      }
+    }
+    if (s.contains("workspace_entities")) {
+      for (const auto& [name, wj] : s["workspace_entities"].items()) {
+        WorkspaceState w;
+        w.name = name;
+        w.owner = wj["owner"].as_string();
+        w.archived = wj["archived"].as_bool(false);
+        w.created_ms = wj["created_ms"].as_int(0);
+        for (const auto& [u, r] : wj["bindings"].items()) {
+          w.bindings[u] = r.as_string();
+        }
+        workspaces_[name] = w;
+      }
     }
     for (const auto& [uuid, c] : s["checkpoints"].items()) checkpoints_[uuid] = c;
     for (const auto& e : s["experiments"].elements()) {
@@ -1056,7 +1127,7 @@ class Master {
                  .set("action", lp.action)
                  .set("agent", agent_id));
       do_log_policy(tid, lp.name, lp.action, agent_id);
-      append_jsonl(logs_path(tid),
+      append_jsonl_striped(logs_path(tid),
                    Json::object()
                        .set("ts", Json(now_ms()))
                        .set("level", "WARNING")
@@ -1790,6 +1861,41 @@ class Master {
     return config[key].is_string() ? config[key].as_string() : fallback;
   }
 
+  // Workspace-scoped RBAC (reference master/internal/rbac/ +
+  // usergroup/, collapsed to per-user bindings): cluster admins see all;
+  // a workspace WITH bindings restricts access to its owner + bound
+  // users (binding "viewer" = read-only there); a workspace without
+  // bindings — including tag-only workspaces — stays open under the
+  // global roles.  Caller holds mu_.
+  bool workspace_allows(const std::string& user, const std::string& ws,
+                        bool write) const {
+    auto uit = users_.find(user);
+    if (uit != users_.end() && uit->second.admin) return true;
+    auto wit = workspaces_.find(ws);
+    if (wit == workspaces_.end() || wit->second.bindings.empty()) return true;
+    if (user == wit->second.owner) return true;
+    auto bit = wit->second.bindings.find(user);
+    if (bit == wit->second.bindings.end()) return false;
+    return !write || bit->second != "viewer";
+  }
+
+  bool exp_allows(const std::string& user, const ExperimentState& e,
+                  bool write) const {
+    return workspace_allows(user, config_str(e.config, "workspace", "Uncategorized"),
+                            write);
+  }
+
+  // data-route guards (logs/metrics/context): deleted experiments resolve
+  // to "visible" — their data is already GC'd.  Caller holds mu_.
+  bool exp_visible(const std::string& user, int64_t exp_id) const {
+    auto it = experiments_.find(exp_id);
+    return it == experiments_.end() || exp_allows(user, it->second, false);
+  }
+  bool trial_visible(const std::string& user, int64_t tid) const {
+    auto it = trials_.find(tid);
+    return it == trials_.end() || exp_visible(user, it->second.experiment_id);
+  }
+
   // recursive dict merge, override wins — the template-application
   // semantics shared with the Python side (config/experiment.py
   // merge_configs; reference schemas.Merge)
@@ -1805,6 +1911,53 @@ class Master {
       }
     }
     return out;
+  }
+
+  // Apply cluster + workspace config policies at submit (reference
+  // master/internal/configpolicy/: task_container_defaults + invariant
+  // configs + constraints).  ``defaults`` merge UNDER the submitted
+  // config, ``invariants`` merge OVER it (workspace first so the cluster
+  // policy has the last word), ``constraints.max_slots`` rejects.  Caller
+  // holds mu_.  Returns "" or an error message.
+  std::string apply_config_policies(Json* config) {
+    std::string ws = config_str(*config, "workspace", "Uncategorized");
+    const std::string scopes[] = {"workspace:" + ws, std::string("cluster")};
+    for (const auto& scope : scopes) {
+      auto it = config_policies_.find(scope);
+      if (it == config_policies_.end()) continue;
+      const Json& pol = it->second;
+      if (pol["defaults"].is_object()) {
+        *config = merge_json(pol["defaults"], *config);
+      }
+      if (pol["invariants"].is_object()) {
+        *config = merge_json(*config, pol["invariants"]);
+      }
+    }
+    for (const auto& scope : scopes) {
+      auto it = config_policies_.find(scope);
+      if (it == config_policies_.end()) continue;
+      const Json& con = it->second["constraints"];
+      if (!con.is_object()) continue;
+      int64_t max_slots = con["max_slots"].as_int(0);
+      if (max_slots > 0) {
+        const Json& res = (*config)["resources"];
+        int64_t slots = 1;
+        if (res.contains("mesh")) {
+          for (const auto& [axis, size] : res["mesh"].items()) {
+            (void)axis;
+            slots *= std::max<int64_t>(size.as_int(1), 1);
+          }
+        } else {
+          slots = res["slots_per_trial"].as_int(1);
+        }
+        if (slots > max_slots) {
+          return "config policy (" + scope + ") rejects: slots_per_trial " +
+                 std::to_string(slots) + " > max_slots " +
+                 std::to_string(max_slots);
+        }
+      }
+    }
+    return "";
   }
 
   // submit-time config validation the Python dataclasses also enforce
@@ -2023,7 +2176,7 @@ class Master {
         return;
       }
       if (!ok) {
-        append_jsonl(logs_path(tid),
+        append_jsonl_striped(logs_path(tid),
                      Json::object()
                          .set("ts", Json(now_ms()))
                          .set("level", "ERROR")
@@ -2203,7 +2356,7 @@ class Master {
           // polls with no exit means the job evaporated (node death,
           // scancel outside the master, admin delete)
           if (++alloc.external_missing_polls >= 2) {
-            append_jsonl(logs_path(alloc.trial_id),
+            append_jsonl_striped(logs_path(alloc.trial_id),
                          Json::object()
                              .set("ts", Json(now_ms()))
                              .set("level", "ERROR")
@@ -2322,6 +2475,12 @@ class Master {
   int telemetry_interval_sec_ = 3600;
   std::string cluster_id_;
   std::map<std::string, Json> templates_;      // config templates (reference templates/)
+  // config policies (reference internal/configpolicy/): scope is "cluster"
+  // or "workspace:NAME"; each policy holds {defaults, invariants,
+  // constraints} applied at experiment submit
+  std::map<std::string, Json> config_policies_;
+  // first-class workspaces (reference api_project.go + rbac/)
+  std::map<std::string, WorkspaceState> workspaces_;
   std::map<int64_t, WebhookState> webhooks_;
   int64_t next_webhook_id_ = 1;
   std::map<std::string, GenericTaskState> tasks_;
@@ -2349,6 +2508,24 @@ class Master {
         std::filesystem::path(path).parent_path(), ec);
     std::ofstream out(path, std::ios::app);
     out << rec.dump() << "\n";
+  }
+  // Append WITHOUT holding mu_ — the metric/log ingest hot paths must not
+  // serialize the whole master on file I/O (32 concurrent ASHA trials all
+  // ship batches).  A striped lock keeps same-file appends atomic while
+  // different trials' files proceed in parallel.
+  std::array<std::mutex, 32> file_mu_;
+  void append_jsonl_striped(const std::string& path, const Json& rec) {
+    std::lock_guard<std::mutex> lk(
+        file_mu_[std::hash<std::string>{}(path) % file_mu_.size()]);
+    append_jsonl(path, rec);
+  }
+  // whole batch under one stripe hold: lines of a shipper batch stay
+  // contiguous in the file even when another stream races the same file
+  void append_jsonl_batch_striped(const std::string& path,
+                                  const std::vector<const Json*>& recs) {
+    std::lock_guard<std::mutex> lk(
+        file_mu_[std::hash<std::string>{}(path) % file_mu_.size()]);
+    for (const Json* rec : recs) append_jsonl(path, *rec);
   }
   // stream matching records from a jsonl file with offset/limit paging;
   // pred filters BEFORE offset counting so paging is stable per filter
@@ -2591,6 +2768,21 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       }
       config = Master::merge_json(tit->second, config);
     }
+    {
+      // config policies: defaults under, invariants over, constraints veto
+      std::lock_guard<std::mutex> lk(m.mu_);
+      std::string pol_err = m.apply_config_policies(&config);
+      if (!pol_err.empty()) return R::error(400, pol_err);
+      // workspace RBAC + archival (reference rbac + api_project archive)
+      std::string ws = Master::config_str(config, "workspace", "Uncategorized");
+      if (!m.workspace_allows(m.authenticate(req), ws, true)) {
+        return R::error(403, "no access to workspace " + ws);
+      }
+      auto wit = m.workspaces_.find(ws);
+      if (wit != m.workspaces_.end() && wit->second.archived) {
+        return R::error(409, "workspace " + ws + " is archived");
+      }
+    }
     if (!config.contains("checkpoint_storage")) {
       std::lock_guard<std::mutex> lk(m.mu_);
       config.set("checkpoint_storage", Json::object()
@@ -2641,7 +2833,11 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     std::string path;
     {
       std::lock_guard<std::mutex> lk(m.mu_);
-      path = m.context_path(std::stoll(req.params.at("id")));
+      int64_t id = std::stoll(req.params.at("id"));
+      if (!m.exp_visible(m.authenticate(req), id)) {
+        return R::error(404, "no context for experiment");
+      }
+      path = m.context_path(id);
     }
     std::ifstream in(path, std::ios::binary);
     if (!in) return R::error(404, "no context for experiment");
@@ -2667,10 +2863,12 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     if (q != req.query.end()) pj = q->second;
     q = req.query.find("owner");
     if (q != req.query.end()) owner = q->second;
+    std::string viewer = m.authenticate(req);
     Json out = Json::array();
     for (const auto& [id, e] : m.experiments_) {
       if (!match(e, "workspace", ws) || !match(e, "project", pj)) continue;
       if (!owner.empty() && e.owner != owner) continue;
+      if (!m.exp_allows(viewer, e, false)) continue;  // workspace RBAC
       out.push_back(m.experiment_json(e));
     }
     return R::json(out.dump());
@@ -2678,15 +2876,19 @@ void install_routes_impl(Master& m, HttpServer& srv) {
 
   // workspace/project organization view (reference workspaces/projects;
   // here derived from experiment configs rather than separate tables)
-  srv.route("GET", "/api/v1/workspaces", authed([&m](const HttpRequest&) {
+  srv.route("GET", "/api/v1/workspaces", authed([&m](const HttpRequest& req) {
     std::lock_guard<std::mutex> lk(m.mu_);
+    std::string viewer = m.authenticate(req);
     std::map<std::string, std::map<std::string, int>> tree;
     for (const auto& [id, e] : m.experiments_) {
       tree[Master::config_str(e.config, "workspace", "Uncategorized")]
           [Master::config_str(e.config, "project", "Uncategorized")]++;
     }
+    // registered entities appear even when empty
+    for (const auto& [name, w] : m.workspaces_) tree[name];
     Json out = Json::array();
     for (const auto& [ws, projects] : tree) {
+      if (!m.workspace_allows(viewer, ws, false)) continue;
       Json w = Json::object();
       w.set("name", ws);
       Json ps = Json::array();
@@ -2699,15 +2901,132 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       }
       w.set("projects", ps);
       w.set("experiments", Json(static_cast<int64_t>(total)));
+      auto wit = m.workspaces_.find(ws);
+      if (wit != m.workspaces_.end()) {
+        w.set("owner", wit->second.owner);
+        w.set("archived", Json(wit->second.archived));
+        w.set("registered", Json(true));
+        Json b = Json::object();
+        for (const auto& [u, r] : wit->second.bindings) b.set(u, r);
+        w.set("roles", b);
+      } else {
+        w.set("registered", Json(false));
+      }
       out.push_back(w);
     }
     return R::json(out.dump());
+  }));
+
+  // ---- first-class workspace entities (reference api_project.go + rbac/) ----
+  srv.route("POST", "/api/v1/workspaces", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    if (!body["name"].is_string() || body["name"].as_string().empty()) {
+      return R::error(400, "workspace name required");
+    }
+    std::lock_guard<std::mutex> lk(m.mu_);
+    const std::string name = body["name"].as_string();
+    if (m.workspaces_.count(name)) return R::error(409, "workspace exists");
+    WorkspaceState w;
+    w.name = name;
+    w.owner = m.authenticate(req);
+    w.created_ms = now_ms();
+    m.workspaces_[name] = w;
+    m.record(Json::object()
+                 .set("type", "workspace_created")
+                 .set("name", name)
+                 .set("owner", w.owner)
+                 .set("ts", Json(w.created_ms)));
+    return R::json(Json::object().set("name", name).set("owner", w.owner).dump(), 201);
+  }));
+
+  auto ws_admin_guard = [&m](const HttpRequest& req, WorkspaceState** out) -> std::string {
+    // caller holds mu_; returns error message or "" with *out set
+    auto it = m.workspaces_.find(req.params.at("name"));
+    if (it == m.workspaces_.end()) return "no such workspace";
+    std::string user = m.authenticate(req);
+    auto uit = m.users_.find(user);
+    bool cluster_admin = uit != m.users_.end() && uit->second.admin;
+    auto bit = it->second.bindings.find(user);
+    bool ws_admin = bit != it->second.bindings.end() && bit->second == "admin";
+    if (!cluster_admin && user != it->second.owner && !ws_admin) {
+      return "workspace administration requires owner/admin";
+    }
+    *out = &it->second;
+    return "";
+  };
+
+  srv.route("POST", "/api/v1/workspaces/{name}/archive", authed([&m, ws_admin_guard](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    WorkspaceState* w = nullptr;
+    std::string err = ws_admin_guard(req, &w);
+    if (!err.empty()) return R::error(err == "no such workspace" ? 404 : 403, err);
+    w->archived = true;
+    m.record(Json::object().set("type", "workspace_archived").set("name", w->name).set("archived", Json(true)));
+    return R::json(Json::object().set("name", w->name).set("archived", Json(true)).dump());
+  }));
+
+  srv.route("POST", "/api/v1/workspaces/{name}/unarchive", authed([&m, ws_admin_guard](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    WorkspaceState* w = nullptr;
+    std::string err = ws_admin_guard(req, &w);
+    if (!err.empty()) return R::error(err == "no such workspace" ? 404 : 403, err);
+    w->archived = false;
+    m.record(Json::object().set("type", "workspace_archived").set("name", w->name).set("archived", Json(false)));
+    return R::json(Json::object().set("name", w->name).set("archived", Json(false)).dump());
+  }));
+
+  srv.route("PUT", "/api/v1/workspaces/{name}/roles", authed([&m, ws_admin_guard](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    const std::string username = body["username"].as_string();
+    const std::string role = body["role"].as_string();
+    if (username.empty() ||
+        (role != "viewer" && role != "user" && role != "admin" && role != "none")) {
+      return R::error(400, "need username + role in {viewer,user,admin,none}");
+    }
+    std::lock_guard<std::mutex> lk(m.mu_);
+    WorkspaceState* w = nullptr;
+    std::string err = ws_admin_guard(req, &w);
+    if (!err.empty()) return R::error(err == "no such workspace" ? 404 : 403, err);
+    if (!m.users_.count(username)) return R::error(404, "no such user");
+    if (role == "none") {
+      w->bindings.erase(username);
+    } else {
+      w->bindings[username] = role;
+    }
+    m.record(Json::object()
+                 .set("type", "workspace_role_set")
+                 .set("name", w->name)
+                 .set("username", username)
+                 .set("role", role));
+    return R::json(Json::object().set("name", w->name).set("username", username).set("role", role).dump());
+  }));
+
+  srv.route("DELETE", "/api/v1/workspaces/{name}", authed([&m, ws_admin_guard](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    WorkspaceState* w = nullptr;
+    std::string err = ws_admin_guard(req, &w);
+    if (!err.empty()) return R::error(err == "no such workspace" ? 404 : 403, err);
+    for (const auto& [id, e] : m.experiments_) {
+      if (Master::config_str(e.config, "workspace", "Uncategorized") == w->name) {
+        return R::error(409, "workspace is not empty");
+      }
+    }
+    std::string name = w->name;
+    m.workspaces_.erase(name);
+    m.record(Json::object().set("type", "workspace_deleted").set("name", name));
+    return R::json("{}");
   }));
 
   srv.route("GET", "/api/v1/experiments/{id}", authed([&m](const HttpRequest& req) {
     std::lock_guard<std::mutex> lk(m.mu_);
     auto it = m.experiments_.find(std::stoll(req.params.at("id")));
     if (it == m.experiments_.end()) return R::error(404, "no such experiment");
+    // restricted workspace: absence and denial are indistinguishable
+    if (!m.exp_allows(m.authenticate(req), it->second, false)) {
+      return R::error(404, "no such experiment");
+    }
     return R::json(m.experiment_json(it->second).dump());
   }));
 
@@ -2757,9 +3076,36 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       return R::error(404, "no such experiment");
     }
     ExperimentState& src = it->second;
+    {
+      std::string user = m.authenticate(req);
+      if (!m.exp_allows(user, src, false)) {
+        cleanup_tmp();
+        return R::error(404, "no such experiment");
+      }
+    }
     Json config = src.config;
     if (body.contains("config")) {
       config = Master::merge_json(config, body["config"]);
+    }
+    {
+      // same submit-time gates as POST /experiments: config policies,
+      // workspace write access, archival
+      std::string pol_err = m.apply_config_policies(&config);
+      if (!pol_err.empty()) {
+        cleanup_tmp();
+        return R::error(400, pol_err);
+      }
+      std::string user = m.authenticate(req);
+      std::string ws = Master::config_str(config, "workspace", "Uncategorized");
+      if (!m.workspace_allows(user, ws, true)) {
+        cleanup_tmp();
+        return R::error(403, "no access to workspace " + ws);
+      }
+      auto wit = m.workspaces_.find(ws);
+      if (wit != m.workspaces_.end() && wit->second.archived) {
+        cleanup_tmp();
+        return R::error(409, "workspace " + ws + " is archived");
+      }
     }
     std::string cfg_err = Master::validate_config(config);
     if (!cfg_err.empty()) {
@@ -2880,9 +3226,14 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     auto it = m.experiments_.find(std::stoll(req.params.at("id")));
     if (it == m.experiments_.end()) return R::error(404, "no such experiment");
     auto& exp = it->second;
+    std::string user = m.authenticate(req);
+    // restricted workspace: same 404 as GET, or a 403 here would confirm
+    // the id exists
+    if (!m.exp_allows(user, exp, false)) {
+      return R::error(404, "no such experiment");
+    }
     // owner gating: non-admins may only signal their own experiments
     // (reference authz basic: owner-or-admin on experiment mutations)
-    std::string user = m.authenticate(req);
     auto uit = m.users_.find(user);
     bool is_admin = uit != m.users_.end() && uit->second.admin;
     if (!is_admin && user != exp.owner) {
@@ -2931,6 +3282,11 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     std::lock_guard<std::mutex> lk(m.mu_);
     auto it = m.trials_.find(std::stoll(req.params.at("id")));
     if (it == m.trials_.end()) return R::error(404, "no such trial");
+    auto eit = m.experiments_.find(it->second.experiment_id);
+    if (eit != m.experiments_.end() &&
+        !m.exp_allows(m.authenticate(req), eit->second, false)) {
+      return R::error(404, "no such trial");
+    }
     return R::json(m.trial_json(it->second).dump());
   }));
 
@@ -2941,42 +3297,50 @@ void install_routes_impl(Master& m, HttpServer& srv) {
   // returns true when the record was a validation report (searcher may
   // have created/stopped trials -> the caller should run the scheduler;
   // plain training metrics must NOT trigger the O(trials x agents) scan)
-  auto ingest_metric = [&m](const Json& rec) -> bool {
+  // Plain training metrics: file append only, NO master lock (striped
+  // file lock keeps same-trial appends atomic).  Validation metrics drive
+  // the searcher and take mu_; caller must hold mu_ for those.
+  auto ingest_validation = [&m](const Json& rec) -> bool {
     int64_t tid = rec["trial_id"].as_int();
-    m.append_jsonl(m.metrics_path(tid), rec);
-    if (rec["group"].as_string() == "validation") {
-      auto tit = m.trials_.find(tid);
-      if (tit != m.trials_.end()) {
-        auto& exp = m.experiments_[tit->second.experiment_id];
-        const Json& metric = rec["metrics"][exp.metric];
-        if (metric.is_number()) {
-          m.do_validation(tid, metric.as_double(),
-                          rec["steps_completed"].as_int(), false);
-          return true;
-        }
+    auto tit = m.trials_.find(tid);
+    if (tit != m.trials_.end()) {
+      auto& exp = m.experiments_[tit->second.experiment_id];
+      const Json& metric = rec["metrics"][exp.metric];
+      if (metric.is_number()) {
+        m.do_validation(tid, metric.as_double(),
+                        rec["steps_completed"].as_int(), false);
+        return true;
       }
     }
     return false;
   };
 
-  srv.route("POST", "/api/v1/metrics", authed([&m, ingest_metric](const HttpRequest& req) {
+  srv.route("POST", "/api/v1/metrics", authed([&m, ingest_validation](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
-    std::lock_guard<std::mutex> lk(m.mu_);
-    if (ingest_metric(body)) m.schedule();
+    m.append_jsonl_striped(m.metrics_path(body["trial_id"].as_int()), body);
+    if (body["group"].as_string() == "validation") {
+      std::lock_guard<std::mutex> lk(m.mu_);
+      if (ingest_validation(body)) m.schedule();
+    }
     return R::json("{}");
   }));
 
   // batched form used by the harness metrics shipper (core/_metrics.py)
-  srv.route("POST", "/api/v1/trials/metrics", authed([&m, ingest_metric](const HttpRequest& req) {
+  srv.route("POST", "/api/v1/trials/metrics", authed([&m, ingest_validation](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
-    std::lock_guard<std::mutex> lk(m.mu_);
-    bool any_validation = false;
+    std::vector<const Json*> validations;
     for (const auto& rec : body["metrics"].elements()) {
-      any_validation = ingest_metric(rec) || any_validation;
+      m.append_jsonl_striped(m.metrics_path(rec["trial_id"].as_int()), rec);
+      if (rec["group"].as_string() == "validation") validations.push_back(&rec);
     }
-    if (any_validation) m.schedule();
+    if (!validations.empty()) {
+      std::lock_guard<std::mutex> lk(m.mu_);
+      bool any = false;
+      for (const Json* rec : validations) any = ingest_validation(*rec) || any;
+      if (any) m.schedule();
+    }
     return R::json("{}");
   }));
 
@@ -3100,6 +3464,9 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     std::string path;
     {
       std::lock_guard<std::mutex> lk(m.mu_);
+      if (!m.trial_visible(m.authenticate(req), tid)) {
+        return R::error(404, "no such trial");
+      }
       path = m.metrics_path(tid);
     }
     // read off disk without the master lock: appends are whole-line and a
@@ -3402,6 +3769,67 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     return R::json("{}");
   }));
 
+  // ---- config policies (reference internal/configpolicy/) ----
+  // scope: "cluster" or "workspace:NAME"; body: {defaults, invariants,
+  // constraints:{max_slots}}.  Admin-only writes; applied at submit.
+  srv.route("PUT", "/api/v1/config-policies/{scope}", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    if (!body.is_object()) return R::error(400, "policy must be an object");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto uit = m.users_.find(m.authenticate(req));
+    if (uit == m.users_.end() || !uit->second.admin) {
+      return R::error(403, "config policies require the admin role");
+    }
+    const std::string& scope = req.params.at("scope");
+    if (scope != "cluster" && scope.rfind("workspace:", 0) != 0) {
+      return R::error(400, "scope must be 'cluster' or 'workspace:NAME'");
+    }
+    for (const char* key : {"defaults", "invariants", "constraints"}) {
+      if (body.contains(key) && !body[key].is_object()) {
+        return R::error(400, std::string(key) + " must be an object");
+      }
+    }
+    m.config_policies_[scope] = body;
+    m.record(Json::object()
+                 .set("type", "config_policy_set")
+                 .set("scope", scope)
+                 .set("policy", body));
+    return R::json(Json::object().set("scope", scope).dump(), 201);
+  }));
+
+  srv.route("GET", "/api/v1/config-policies", authed([&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    Json out = Json::array();
+    for (const auto& [scope, pol] : m.config_policies_) {
+      out.push_back(Json::object().set("scope", scope).set("policy", pol));
+    }
+    return R::json(out.dump());
+  }));
+
+  srv.route("GET", "/api/v1/config-policies/{scope}", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.config_policies_.find(req.params.at("scope"));
+    if (it == m.config_policies_.end()) return R::error(404, "no such policy");
+    return R::json(
+        Json::object().set("scope", it->first).set("policy", it->second).dump());
+  }));
+
+  srv.route("DELETE", "/api/v1/config-policies/{scope}", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto uit = m.users_.find(m.authenticate(req));
+    if (uit == m.users_.end() || !uit->second.admin) {
+      return R::error(403, "config policies require the admin role");
+    }
+    if (m.config_policies_.erase(req.params.at("scope")) == 0) {
+      return R::error(404, "no such policy");
+    }
+    m.record(Json::object()
+                 .set("type", "config_policy_deleted")
+                 .set("scope", req.params.at("scope")));
+    return R::json("{}");
+  }));
+
   // ---- config templates (reference templates/) ----
   srv.route("PUT", "/api/v1/templates/{name}", authed([&m](const HttpRequest& req) {
     Json body;
@@ -3458,8 +3886,39 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     auto t = req.query.find("timeout_seconds");
     if (t != req.query.end()) timeout_s = std::max(0, std::atoi(t->second.c_str()));
     std::unique_lock<std::mutex> lk(m.mu_);
+    std::string viewer = m.authenticate(req);
     auto deadline =
         std::chrono::steady_clock::now() + std::chrono::seconds(timeout_s);
+    // workspace RBAC on the feed: events attributable to a restricted
+    // workspace (configs, states, role bindings) only reach users that
+    // workspace admits; policy admin events are admin-only.  Events with
+    // no resolvable scope (e.g. states of since-deleted experiments) pass.
+    auto ev_visible = [&m, &viewer](const Json& ev) -> bool {
+      const std::string& type = ev["type"].as_string();
+      if (type.rfind("config_policy", 0) == 0) {
+        auto uit = m.users_.find(viewer);
+        return uit != m.users_.end() && uit->second.admin;
+      }
+      if (type.rfind("workspace_", 0) == 0) {
+        return m.workspace_allows(viewer, ev["name"].as_string(), false);
+      }
+      if (type == "exp_created") {
+        return m.workspace_allows(
+            viewer,
+            Master::config_str(ev["config"], "workspace", "Uncategorized"),
+            false);
+      }
+      if (ev.contains("trial_id")) {
+        return m.trial_visible(viewer, ev["trial_id"].as_int());
+      }
+      if (ev.contains("experiment_id")) {
+        return m.exp_visible(viewer, ev["experiment_id"].as_int());
+      }
+      if (type.rfind("exp_", 0) == 0 && ev.contains("id")) {
+        return m.exp_visible(viewer, ev["id"].as_int());
+      }
+      return true;
+    };
     // the in-memory ring covers the recent window; a consumer that fell
     // behind it (or connected after a master restart, when the ring is
     // empty) is served from the journal file, which holds every event
@@ -3472,7 +3931,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
                          m.events_.front()["seq"].as_int(0) <= since + 1;
       if (ring_covers) {
         for (const auto& ev : m.events_) {
-          if (ev["seq"].as_int(0) > since) out.push_back(ev);
+          if (ev["seq"].as_int(0) > since && ev_visible(ev)) out.push_back(ev);
         }
         return out;
       }
@@ -3488,6 +3947,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
             type == "user_set") {
           continue;  // redacted from the feed
         }
+        if (!ev_visible(ev)) continue;
         out.push_back(ev);
       }
       return out;
@@ -3824,12 +4284,12 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     std::string agent_id =
         body.contains("agent") ? body["agent"].as_string() : "";
-    std::lock_guard<std::mutex> lk(m.mu_);
     if (body.contains("task_id") && body["task_id"].is_string()) {
+      // pure file append: no master state touched, no mu_
       const std::string path = m.task_logs_path(body["task_id"].as_string());
-      for (const auto& line : body["lines"].elements()) {
-        m.append_jsonl(path, line);
-      }
+      std::vector<const Json*> lines;
+      for (const auto& line : body["lines"].elements()) lines.push_back(&line);
+      m.append_jsonl_batch_striped(path, lines);
       return R::json("{}");
     }
     int64_t tid = body["trial_id"].as_int();
@@ -3846,13 +4306,25 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       // allocation in end_allocation)
       std::string key = std::to_string(tid) + "/" +
                         body["allocation_id"].as_string() + "/" + agent_id;
+      std::lock_guard<std::mutex> lk(m.mu_);
       auto [it, fresh] = m.log_batch_seq_.try_emplace(key, -1);
       if (!fresh && seq <= it->second) return R::json("{\"duplicate\":true}");
       it->second = seq;
     }
+    // file appends outside mu_ (striped per-file lock keeps a batch
+    // contiguous); log-pattern policies re-take mu_ only for string
+    // lines, which are the only ones the matcher inspects
+    std::vector<const Json*> all_lines, policy_lines;
     for (const auto& line : body["lines"].elements()) {
-      m.append_jsonl(m.logs_path(tid), line);
-      if (line.is_string()) m.apply_log_policies(tid, line.as_string(), agent_id);
+      all_lines.push_back(&line);
+      if (line.is_string()) policy_lines.push_back(&line);
+    }
+    m.append_jsonl_batch_striped(m.logs_path(tid), all_lines);
+    if (!policy_lines.empty()) {
+      std::lock_guard<std::mutex> lk(m.mu_);
+      for (const Json* line : policy_lines) {
+        m.apply_log_policies(tid, line->as_string(), agent_id);
+      }
     }
     return R::json("{}");
   }));
@@ -3867,6 +4339,9 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     std::string path;
     {
       std::lock_guard<std::mutex> lk(m.mu_);
+      if (!m.trial_visible(m.authenticate(req), tid)) {
+        return R::error(404, "no such trial");
+      }
       path = m.logs_path(tid);
     }
     // tail=N: the last N records (what a logs viewer wants)

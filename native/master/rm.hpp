@@ -79,8 +79,14 @@ struct PoolConfig {
   std::string slurm_sbatch = "sbatch";
   std::string slurm_squeue = "squeue";
   std::string slurm_scancel = "scancel";
+  std::string slurm_srun = "srun";
   std::string slurm_partition;
   std::string slurm_spool = "/tmp/dtpu-slurm";
+  // multi-node gangs: chips per Slurm node (0 = whole trial on one node).
+  // A trial wanting more becomes ONE sbatch job with --nodes=N whose tasks
+  // bootstrap per-rank rendezvous via exec/slurm_launch.py (rank-0's host
+  // carries the jax.distributed coordinator + control-plane chief).
+  int slurm_slots_per_node = 0;
 
   bool has_provisioner = false;
   ProvisionerConfig provisioner;
@@ -107,8 +113,10 @@ struct PoolConfig {
       if (s["sbatch"].is_string()) p.slurm_sbatch = s["sbatch"].as_string();
       if (s["squeue"].is_string()) p.slurm_squeue = s["squeue"].as_string();
       if (s["scancel"].is_string()) p.slurm_scancel = s["scancel"].as_string();
+      if (s["srun"].is_string()) p.slurm_srun = s["srun"].as_string();
       if (s["partition"].is_string()) p.slurm_partition = s["partition"].as_string();
       if (s["spool_dir"].is_string()) p.slurm_spool = s["spool_dir"].as_string();
+      p.slurm_slots_per_node = static_cast<int>(s["slots_per_node"].as_int(0));
     }
     const Json& pv = j["provisioner"];
     if (pv.is_object()) {
@@ -325,6 +333,18 @@ class SlurmBackend {
     std::error_code ec;
     std::filesystem::create_directories(pool.slurm_spool, ec);
     std::string script_path = pool.slurm_spool + "/" + alloc_id + ".sh";
+    // multi-node gang: one batch job, N single-task nodes; each task
+    // bootstraps its rank env (rendezvous, chief, per-rank slots) in
+    // exec/slurm_launch.py from SLURM_PROCID/SLURM_JOB_NODELIST — the
+    // dispatcherrm analog of the reference's multi-node batch launch
+    int per_node = pool.slurm_slots_per_node > 0
+                       ? (pool.slurm_slots_per_node < slots
+                              ? pool.slurm_slots_per_node
+                              : slots)
+                       : slots;
+    if (per_node < 1) per_node = 1;
+    int num_nodes = (slots + per_node - 1) / per_node;
+    if (num_nodes < 1) num_nodes = 1;
     {
       std::ofstream sh(script_path, std::ios::trunc);
       sh << "#!/bin/bash\n";
@@ -332,13 +352,27 @@ class SlurmBackend {
       if (!pool.slurm_partition.empty()) {
         sh << "#SBATCH --partition=" << pool.slurm_partition << "\n";
       }
-      sh << "#SBATCH --gres=tpu:" << slots << "\n";
+      if (num_nodes > 1) {
+        sh << "#SBATCH --nodes=" << num_nodes << "\n";
+        sh << "#SBATCH --ntasks=" << num_nodes << "\n";
+        sh << "#SBATCH --ntasks-per-node=1\n";
+      }
+      sh << "#SBATCH --gres=tpu:" << per_node << "\n";
       for (const auto& [k, v] : env.items()) {
         sh << "export " << k << "=" << rm_detail::shell_quote(v.as_string())
            << "\n";
       }
-      sh << "exec python -m determined_tpu.exec.run_trial "
-         << rm_detail::shell_quote(entrypoint) << "\n";
+      if (num_nodes > 1) {
+        sh << "export DTPU_GANG_NODES=" << num_nodes << "\n";
+        sh << "export DTPU_GANG_SLOTS_PER_NODE=" << per_node << "\n";
+        sh << "export DTPU_GANG_TOTAL_SLOTS=" << slots << "\n";
+        sh << "exec " << pool.slurm_srun
+           << " python -m determined_tpu.exec.slurm_launch "
+           << rm_detail::shell_quote(entrypoint) << "\n";
+      } else {
+        sh << "exec python -m determined_tpu.exec.run_trial "
+           << rm_detail::shell_quote(entrypoint) << "\n";
+      }
     }
     std::filesystem::permissions(script_path,
                                  std::filesystem::perms::owner_all, ec);

@@ -70,6 +70,14 @@ BODIES = {
     ("POST", "/api/v1/trials/{id}/heartbeat"): {},
     ("POST", "/api/v1/auth/login"): {"username": "determined", "password": ""},
     ("PUT", "/api/v1/templates/{name}"): {"config": {"max_restarts": 2}},
+    ("PUT", "/api/v1/config-policies/{scope}"): {
+        "constraints": {"max_slots": 64}
+    },
+    ("POST", "/api/v1/workspaces"): {"name": "contract-model"},
+    ("PUT", "/api/v1/workspaces/{name}/roles"): {
+        "username": "determined",
+        "role": "admin",
+    },
 }
 
 
@@ -84,6 +92,7 @@ def test_every_route_conforms(cluster, tmp_path):
         "uuid": ckpt,
         "name": "contract-model",
         "path": "x",
+        "scope": "cluster",
     }
 
     bodies = dict(BODIES)

@@ -1642,3 +1642,207 @@ def test_experiment_delete_gcs_checkpoints_and_traces(cluster):
         time.sleep(0.5)
     assert not os.path.isdir(os.path.join(cluster.ckpt_dir, ckpt)), "checkpoint files not GC'd"
     assert not os.path.isdir(trace_dir), "trace dir not GC'd"
+
+
+def test_config_policies_merge_and_constraints(cluster, tmp_path):
+    """Reference internal/configpolicy/: cluster/workspace defaults merge
+    UNDER a submitted config, invariants OVER it, constraints reject —
+    all enforced server-side at submit."""
+    # cluster scope: default priority, invariant max_restarts, slot cap
+    r = cluster.http.put(
+        cluster.url + "/api/v1/config-policies/cluster",
+        json={
+            "defaults": {"resources": {"priority": 13}},
+            "invariants": {"max_restarts": 0},
+            "constraints": {"max_slots": 1},
+        },
+    )
+    assert r.status_code == 201, r.text
+    # workspace scope: its own default
+    r = cluster.http.put(
+        cluster.url + "/api/v1/config-policies/workspace:research",
+        json={"defaults": {"labels": {"team": "research"}}},
+    )
+    assert r.status_code == 201, r.text
+
+    cfg = exp_config(cluster.ckpt_dir, max_restarts=5)
+    cfg["workspace"] = "research"
+    exp_id = cluster.submit(cfg)
+    exp = cluster.http.get(f"{cluster.url}/api/v1/experiments/{exp_id}").json()
+    stored = exp["config"]
+    assert stored["max_restarts"] == 0, "invariant must override user config"
+    assert stored["resources"]["priority"] == 13, "cluster default not merged"
+    assert stored["labels"]["team"] == "research", "workspace default not merged"
+
+    # constraint veto: 2 slots > max_slots 1
+    big = exp_config(cluster.ckpt_dir, slots=2)
+    r = cluster.http.post(cluster.url + "/api/v1/experiments", json={"config": big})
+    assert r.status_code == 400 and "max_slots" in r.text, r.text
+
+    # fork must pass the same gates: a fork override cannot smuggle slots
+    # past the policy constraint
+    r = cluster.http.post(
+        cluster.url + f"/api/v1/experiments/{exp_id}/fork",
+        json={"config": {"resources": {"slots_per_trial": 2}}},
+    )
+    assert r.status_code == 400 and "max_slots" in r.text, r.text
+
+    # non-admins cannot write policies
+    cluster.http.post(
+        cluster.url + "/api/v1/users",
+        json={"username": "plain", "password": "x", "role": "user"},
+    )
+    import requests as _rq
+
+    plain = _rq.Session()
+    tok = plain.post(
+        cluster.url + "/api/v1/auth/login",
+        json={"username": "plain", "password": "x"},
+    ).json()["token"]
+    plain.headers.update({"Authorization": f"Bearer {tok}"})
+    r = plain.put(
+        cluster.url + "/api/v1/config-policies/cluster", json={"defaults": {}}
+    )
+    assert r.status_code == 403, r.text
+
+    # survives a master restart (journaled)
+    cluster.procs["master"].send_signal(signal.SIGKILL)
+    cluster.procs["master"].wait(timeout=10)
+    cluster.start_master()
+    r = cluster.http.get(cluster.url + "/api/v1/config-policies/cluster")
+    assert r.status_code == 200
+    assert r.json()["policy"]["constraints"]["max_slots"] == 1
+    cluster.http.delete(cluster.url + "/api/v1/config-policies/cluster")
+    cluster.http.delete(
+        cluster.url + "/api/v1/config-policies/workspace:research"
+    )
+
+
+def test_events_sdk_follow(cluster, tmp_path):
+    """The streams-client analog (reference common/streams/_client.py):
+    the SDK iterates the seq-ordered event feed, following live."""
+    from determined_tpu.client import Determined
+
+    d = Determined(master=cluster.url, user="determined", password="")
+    exp_id = cluster.submit(exp_config(cluster.ckpt_dir))
+    seen = {}
+    deadline = time.time() + 120
+    for ev in d.events(follow=True, poll_timeout=5):
+        if ev.get("type") == "exp_created" and int(ev.get("id", -1)) == exp_id:
+            seen["created"] = ev
+        if ev.get("type") == "exp_state" and int(ev.get("id", -1)) == exp_id:
+            seen["state"] = ev
+            if ev.get("state") == "COMPLETED":
+                break
+        if time.time() > deadline:
+            break
+    assert "created" in seen, "exp_created never streamed"
+    assert seen.get("state", {}).get("state") == "COMPLETED", seen
+    # non-follow drains the backlog and returns
+    types = [e["type"] for e in d.events()]
+    assert "exp_created" in types
+
+
+def test_workspace_rbac_scoping(cluster, tmp_path):
+    """Reference rbac/ + usergroup/ collapsed to workspace bindings: a
+    restricted workspace's experiments are invisible and untouchable to
+    unbound users; bound users and cluster admins operate normally."""
+    import requests as _rq
+
+    def login(u, p):
+        s = _rq.Session()
+        tok = s.post(
+            cluster.url + "/api/v1/auth/login",
+            json={"username": u, "password": p},
+        ).json()["token"]
+        s.headers.update({"Authorization": f"Bearer {tok}"})
+        return s
+
+    for u in ("alice", "bob"):
+        cluster.http.post(
+            cluster.url + "/api/v1/users",
+            json={"username": u, "password": "x", "role": "user"},
+        )
+    alice, bob = login("alice", "x"), login("bob", "x")
+
+    # admin registers a restricted workspace and binds only bob
+    r = cluster.http.post(cluster.url + "/api/v1/workspaces", json={"name": "secret"})
+    assert r.status_code == 201, r.text
+    r = cluster.http.put(
+        cluster.url + "/api/v1/workspaces/secret/roles",
+        json={"username": "bob", "role": "user"},
+    )
+    assert r.status_code == 200, r.text
+
+    # bob submits into it
+    cfg = exp_config(cluster.ckpt_dir)
+    cfg["workspace"] = "secret"
+    r = bob.post(cluster.url + "/api/v1/experiments", json={"config": cfg})
+    assert r.status_code == 201, r.text
+    exp_id = r.json()["id"]
+
+    # alice: cannot submit into it, cannot see it, cannot kill it
+    r = alice.post(cluster.url + "/api/v1/experiments", json={"config": cfg})
+    assert r.status_code == 403, r.text
+    listed = alice.get(cluster.url + "/api/v1/experiments").json()
+    assert exp_id not in [e["id"] for e in listed]
+    assert "secret" not in [
+        w["name"] for w in alice.get(cluster.url + "/api/v1/workspaces").json()
+    ]
+    r = alice.get(f"{cluster.url}/api/v1/experiments/{exp_id}")
+    assert r.status_code == 404, "restricted workspace must not leak existence"
+    r = alice.post(f"{cluster.url}/api/v1/experiments/{exp_id}/kill")
+    assert r.status_code == 404, "signal must not confirm a restricted id exists"
+    # data routes are scoped too: logs/metrics/context/events leak nothing
+    exp = cluster.http.get(f"{cluster.url}/api/v1/experiments/{exp_id}").json()
+    if exp["trials"]:
+        tid = exp["trials"][0]["id"]
+        assert alice.get(f"{cluster.url}/api/v1/trials/{tid}/logs").status_code == 404
+        assert alice.get(f"{cluster.url}/api/v1/trials/{tid}/metrics").status_code == 404
+    assert (
+        alice.get(f"{cluster.url}/api/v1/experiments/{exp_id}/context").status_code
+        == 404
+    )
+    alice_events = alice.get(
+        cluster.url + "/api/v1/events", params={"since": "0"}
+    ).json()
+    for ev in alice_events:
+        assert not (
+            ev.get("type") == "exp_created" and ev.get("id") == exp_id
+        ), "restricted experiment config leaked through the event feed"
+
+    # bob and the admin see it fine
+    assert exp_id in [e["id"] for e in bob.get(cluster.url + "/api/v1/experiments").json()]
+    assert cluster.http.get(f"{cluster.url}/api/v1/experiments/{exp_id}").status_code == 200
+
+    final = cluster.wait_for_state(exp_id)
+    assert final["state"] == "COMPLETED"
+
+    # archival: no new experiments in an archived workspace
+    r = cluster.http.post(cluster.url + "/api/v1/workspaces/secret/archive")
+    assert r.status_code == 200, r.text
+    r = bob.post(cluster.url + "/api/v1/experiments", json={"config": cfg})
+    assert r.status_code == 409 and "archived" in r.text, r.text
+    cluster.http.post(cluster.url + "/api/v1/workspaces/secret/unarchive")
+
+    # deletion: refused while experiments exist; fine once deleted
+    r = cluster.http.delete(cluster.url + "/api/v1/workspaces/secret")
+    assert r.status_code == 409, r.text
+    cluster.http.delete(f"{cluster.url}/api/v1/experiments/{exp_id}")
+    r = cluster.http.delete(cluster.url + "/api/v1/workspaces/secret")
+    assert r.status_code == 200, r.text
+
+    # rbac survives restart (journaled entities)
+    cluster.http.post(cluster.url + "/api/v1/workspaces", json={"name": "keep"})
+    cluster.http.put(
+        cluster.url + "/api/v1/workspaces/keep/roles",
+        json={"username": "bob", "role": "viewer"},
+    )
+    cluster.procs["master"].send_signal(signal.SIGKILL)
+    cluster.procs["master"].wait(timeout=10)
+    cluster.start_master()
+    kept = {
+        w["name"]: w
+        for w in cluster.http.get(cluster.url + "/api/v1/workspaces").json()
+    }
+    assert kept["keep"]["roles"] == {"bob": "viewer"}

@@ -217,3 +217,68 @@ def test_fused_ce_bf16_residual_grads_close():
     assert abs(float(l16) - float(lref)) < 5e-2
     np.testing.assert_allclose(gx16, gxr, rtol=0.1, atol=5e-3)
     np.testing.assert_allclose(gw16, gwr, rtol=0.1, atol=5e-3)
+
+
+def test_fused_adamw_matches_optax_chain():
+    """The single-sweep fused optimizer must be bit-compatible (to f32
+    rounding) with optax.chain(clip_by_global_norm, adamw) over a
+    multi-step trajectory; the big leaf takes the pallas path (interpret
+    mode on CPU), the small leaf the jnp path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from determined_tpu.ops.fused_adamw import fused_adamw
+
+    rng = np.random.default_rng(0)
+    params = {
+        "w": jnp.asarray(rng.standard_normal((512, 1024)), jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((64,)), jnp.float32),
+    }
+    sched = optax.warmup_cosine_decay_schedule(0.0, 1e-2, 2, 100)
+    fused = fused_adamw(sched, weight_decay=0.01, clip_norm=1.0)
+    ref = optax.chain(
+        optax.clip_by_global_norm(1.0), optax.adamw(sched, weight_decay=0.01)
+    )
+    fs, rs = fused.init(params), ref.init(params)
+    fp, rp = params, params
+    for step in range(3):
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(
+                rng.standard_normal(p.shape) * (10.0 if step == 0 else 0.1),
+                jnp.float32,
+            ),
+            fp,
+        )
+        fp, fs = jax.jit(fused.apply_step)(grads, fs, fp)
+        updates, rs = jax.jit(ref.update)(grads, rs, rp)
+        rp = optax.apply_updates(rp, updates)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(fp[k]), np.asarray(rp[k]), rtol=2e-6, atol=2e-7,
+                err_msg=f"step {step} leaf {k}",
+            )
+
+
+def test_fused_adamw_bf16_mu():
+    """bf16 first moment: state dtype honored, trajectory stays close to
+    the f32 reference (bf16-epsilon drift is the documented tradeoff)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from determined_tpu.ops.fused_adamw import fused_adamw
+
+    rng = np.random.default_rng(1)
+    params = {"w": jnp.asarray(rng.standard_normal((512, 1024)), jnp.float32)}
+    grads = {"w": jnp.asarray(rng.standard_normal((512, 1024)), jnp.float32)}
+    opt16 = fused_adamw(1e-2, mu_dtype=jnp.bfloat16)
+    opt32 = fused_adamw(1e-2)
+    s16, s32 = opt16.init(params), opt32.init(params)
+    assert s16.mu["w"].dtype == jnp.bfloat16
+    p16, s16 = opt16.apply_step(grads, s16, params)
+    p32, s32 = opt32.apply_step(grads, s32, params)
+    assert s16.mu["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(p16["w"]), np.asarray(p32["w"]), rtol=1e-2, atol=1e-4
+    )

@@ -523,3 +523,89 @@ def test_kubernetes_multinode_gang(tmp_path):
     finally:
         c.stop()
         kube.stop()
+
+
+def test_slurm_multinode_gang(tmp_path):
+    """dispatcherrm multi-node analog: a 2-slot trial on a slurm pool with
+    slots_per_node=1 becomes ONE sbatch job with --nodes=2 whose srun tasks
+    bootstrap per-rank rendezvous (exec/slurm_launch.py) and train as a
+    real 2-process jax.distributed gang."""
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    sbatch = tmp_path / "sbatch"
+    sbatch.write_text(
+        "#!/bin/bash\n"
+        f"export PYTHONPATH={REPO}:$PYTHONPATH\n"
+        f"export PATH={tmp_path}:$PATH\n"  # the script's `srun` is our stub
+        f"setsid bash \"$1\" > {spool}/job.out 2>&1 &\n"
+        'echo "Submitted batch job $!"\n'
+    )
+    # srun stub: one task per gang node, rank in SLURM_PROCID, single-host
+    # nodelist (slurm_launch resolves the coordinator to 127.0.0.1)
+    srun = tmp_path / "srun"
+    srun.write_text(
+        "#!/bin/bash\n"
+        "pids=()\n"
+        'for i in $(seq 0 $((DTPU_GANG_NODES-1))); do\n'
+        '  SLURM_PROCID=$i SLURM_JOB_NODELIST=127.0.0.1 "$@" &\n'
+        "  pids+=($!)\n"
+        "done\n"
+        "rc=0\n"
+        'for p in "${pids[@]}"; do wait "$p" || rc=$?; done\n'
+        "exit $rc\n"
+    )
+    squeue = tmp_path / "squeue"
+    squeue.write_text(
+        "#!/bin/bash\n"
+        'jid="$3"\n'
+        'if kill -0 "$jid" 2>/dev/null; then echo "$jid RUNNING"; fi\n'
+    )
+    scancel = tmp_path / "scancel"
+    scancel.write_text('#!/bin/bash\nkill -TERM -- "-$1" 2>/dev/null\n')
+    for f in (sbatch, srun, squeue, scancel):
+        f.chmod(0o755)
+
+    pools = [
+        {
+            "name": "hpc",
+            "type": "slurm",
+            "slurm": {
+                "sbatch": str(sbatch),
+                "squeue": str(squeue),
+                "scancel": str(scancel),
+                "srun": "srun",  # resolved via the script's PATH
+                "spool_dir": str(spool),
+                "slots_per_node": 1,
+            },
+        }
+    ]
+    c = DevCluster(
+        tmp_path, agents=0, master_args=("--pools", _write_pools(tmp_path, pools))
+    )
+    c.start_master()
+    try:
+        config = exp_config(c.ckpt_dir, slots=2)
+        config["resources"]["resource_pool"] = "hpc"
+        exp_id = c.submit(config)
+        exp = c.wait_for_state(exp_id, timeout=240)
+        assert exp["state"] == "COMPLETED", (spool / "job.out").read_text()[-2000:]
+        # ONE batch script, multi-node directives + per-rank bootstrap
+        scripts = [p for p in spool.iterdir() if p.suffix == ".sh"]
+        assert len(scripts) == 1, scripts
+        body = scripts[0].read_text()
+        assert "#SBATCH --nodes=2" in body
+        assert "#SBATCH --ntasks-per-node=1" in body
+        assert "DTPU_GANG_NODES=2" in body
+        assert "determined_tpu.exec.slurm_launch" in body
+        # both ranks shipped logs under distinct agent identities
+        tid = exp["trials"][0]["id"]
+        logs = c.http.get(f"{c.url}/api/v1/trials/{tid}/logs").json()
+        assert any("[rank=1]" in l or "/r1" in l for l in logs), (
+            "no rank-1 log stream; gang did not run 2 processes"
+        )
+    finally:
+        subprocess.run(
+            ["pkill", "-9", "-f", "determined_tpu.exec.run_trial"],
+            capture_output=True,
+        )
+        c.stop()
