@@ -27,6 +27,27 @@ class NotFoundError(APIError):
     pass
 
 
+class TlsAdapter(requests.adapters.HTTPAdapter):
+    """HTTPS adapter pinned to a CA bundle via an explicit ssl_context.
+
+    ``session.verify = cafile`` alone is unreliable on this requests
+    version: its pooled-TLS-context cache drops the custom CA on
+    connection reuse, so the SECOND request to a self-signed master fails
+    verification.  An adapter-owned ``ssl_context`` is applied to every
+    connection the pool makes.
+    """
+
+    def __init__(self, cafile: str, **kwargs) -> None:
+        import ssl
+
+        self._ctx = ssl.create_default_context(cafile=cafile)
+        super().__init__(**kwargs)
+
+    def init_poolmanager(self, *args, **kwargs):
+        kwargs["ssl_context"] = self._ctx
+        return super().init_poolmanager(*args, **kwargs)
+
+
 class Session:
     RETRIES = 5
     BACKOFF = 0.5
@@ -42,8 +63,15 @@ class Session:
         self.token = token
         self.timeout = timeout
         self._http = requests.Session()
+        # master cert bundle for https:// masters (reference certs.py):
+        # explicit arg wins, then the env the agent injects into trials
+        if cert_path is None:
+            import os
+
+            cert_path = os.environ.get("DTPU_MASTER_CERT") or None
         if cert_path:
             self._http.verify = cert_path
+            self._http.mount("https://", TlsAdapter(cert_path))
 
     def _headers(self) -> Dict[str, str]:
         h = {"Content-Type": "application/json"}

@@ -25,6 +25,10 @@ def _client(args):
     from determined_tpu.client import Determined
 
     url = args.master or os.environ.get("DTPU_MASTER", "http://127.0.0.1:8080")
+    # --cert rides the env so every Session (SDK, bindings, core) picks it
+    # up without threading it through each constructor
+    if getattr(args, "cert", None):
+        os.environ["DTPU_MASTER_CERT"] = args.cert
     return Determined(url, user=getattr(args, "user", None) or None)
 
 
@@ -594,6 +598,10 @@ def run_local(args) -> int:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dtpu", description="determined-tpu CLI")
     p.add_argument("-m", "--master", help="master url (default $DTPU_MASTER)")
+    p.add_argument(
+        "--cert",
+        help="CA bundle for an https master (default $DTPU_MASTER_CERT)",
+    )
     p.add_argument("-u", "--user", help="username (default: cached or 'determined')")
     sub = p.add_subparsers(dest="noun", required=True)
 

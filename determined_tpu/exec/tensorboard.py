@@ -14,6 +14,12 @@ importable in this image — so the task serves a self-contained viewer:
 - ``/data/traces``               xplane trace files found in the
                                  experiments' shared_fs storage (written by
                                  the profiler into <storage>/traces/)
+- ``/data/trials/{id}/profile``  the trial's xplane traces RENDERED: per-op
+                                 device-time table + category totals
+                                 (utils/xplane.py drives xprof's hlo_stats —
+                                 the reference wires torch.profiler traces
+                                 into TensorBoard, ``_pytorch_context.py:
+                                 426-462``)
 - ``/healthz``                   readiness
 
 The task binds ``DTPU_TASK_PORT``, then POSTs ``/api/v1/tasks/{id}/ready``
@@ -57,8 +63,27 @@ function chart(title, points) {
     `<text class="label" x="2" y="${py(ymax)+4}">${ymax.toPrecision(4)}</text>` +
     `<text class="label" x="2" y="${py(ymin)+4}">${ymin.toPrecision(4)}</text></svg>`;
 }
+function opTable(p) {
+  if (p.error) return `<p class="label">${p.error}</p>`;
+  let rows = p.ops.slice(0, 20).map(o =>
+    `<tr><td>${o.name}</td><td>${o.category}</td>` +
+    `<td style="text-align:right">${(o.time_us/1000).toFixed(3)}</td>` +
+    `<td style="text-align:right">${o.pct}%</td></tr>`).join("");
+  let cats = Object.entries(p.categories).map(([k, us]) =>
+    `<tr><td>${k}</td><td style="text-align:right">${(us/1000).toFixed(3)}</td>` +
+    `<td style="text-align:right">${(100*us/p.device_total_us).toFixed(1)}%</td></tr>`
+  ).join("");
+  return `<h3>profiler — trial ${p.trial_id} (device ${(p.device_total_us/1000).toFixed(1)} ms,` +
+    ` collectives ${(p.collective_us/1000).toFixed(1)} ms)</h3>` +
+    `<table border="1" cellpadding="4" style="border-collapse:collapse;font-size:.8rem">` +
+    `<tr><th>category</th><th>ms</th><th>%</th></tr>${cats}</table><br>` +
+    `<table border="1" cellpadding="4" style="border-collapse:collapse;font-size:.8rem">` +
+    `<tr><th>op</th><th>category</th><th>ms</th><th>%</th></tr>${rows}</table>`;
+}
 (async () => {
   const exps = await j("data/experiments");
+  const traces = await j("data/traces").catch(() => []);
+  const traced = new Set((traces || []).map(t => t.trial_id));
   let html = "";
   for (const e of exps) {
     html += `<h2>experiment ${e.id}: ${e.name} [${e.state}]</h2>`;
@@ -74,6 +99,9 @@ function chart(title, points) {
       }
       for (const [k, pts] of Object.entries(series)) {
         html += chart(`trial ${t.id} — ${k}`, pts);
+      }
+      if (traced.has(t.id)) {
+        html += opTable(await j(`data/trials/${t.id}/profile`));
       }
     }
   }
@@ -137,6 +165,48 @@ def _list_traces(exp_filter) -> list:
     return out
 
 
+def _trace_profile(exp_filter, trial_id: int) -> dict:
+    """Op table for one trial's xplane traces (the profiler-visualization
+    path; heavy deps import lazily so the viewer works without them)."""
+    files = [
+        t["path"]
+        for t in _list_traces(exp_filter)
+        if t["trial_id"] == trial_id and t["path"].endswith(".xplane.pb")
+    ]
+    if not files:
+        return {"trial_id": trial_id, "error": "no xplane traces for this trial"}
+    try:
+        from determined_tpu.utils.xplane import (
+            category_totals,
+            hlo_op_table,
+            split_collectives,
+        )
+
+        ops = hlo_op_table(files)
+    except Exception as e:  # noqa: BLE001 - tooling optional in-task
+        return {"trial_id": trial_id, "error": f"trace parse failed: {e}"}
+    total = sum(o["time_us"] for o in ops)
+    coll, other = split_collectives(ops)
+    return {
+        "trial_id": trial_id,
+        "files": len(files),
+        "device_total_us": round(total, 1),
+        "collective_us": round(coll, 1),
+        "categories": {
+            k: round(v, 1) for k, v in category_totals(ops).items()
+        },
+        "ops": [
+            {
+                "name": o["name"],
+                "category": o["category"],
+                "time_us": round(o["time_us"], 1),
+                "pct": round(100 * o["time_us"] / max(total, 1e-9), 2),
+            }
+            for o in ops[:60]
+        ],
+    }
+
+
 def main() -> int:
     import http.server
 
@@ -173,8 +243,15 @@ def main() -> int:
                     self._send(json.dumps(_list_traces(exp_filter)).encode())
                 else:
                     m = re.fullmatch(r"/data/trials/(\d+)/metrics", self.path)
+                    p = re.fullmatch(r"/data/trials/(\d+)/profile", self.path)
                     if m:
                         self._send(_master_get(f"/api/v1/trials/{m.group(1)}/metrics"))
+                    elif p:
+                        self._send(
+                            json.dumps(
+                                _trace_profile(exp_filter, int(p.group(1)))
+                            ).encode()
+                        )
                     else:
                         self._send(b'{"error":"not found"}', code=404)
             except Exception as e:  # noqa: BLE001 - surface upstream errors

@@ -49,6 +49,11 @@ struct Options {
   // agent/internal/agent.go:153 — our unit of recovery is kill+master
   // reschedule, since jax.distributed jobs restart whole-gang anyway)
   std::string state_dir;
+  // TLS to the master: --master-cert names the CA bundle (typically the
+  // master's own self-signed cert) that its chain must verify against
+  // (reference harness/.../certs.py trust model)
+  bool master_tls = false;
+  std::string master_cert;
 };
 
 class Agent {
@@ -109,7 +114,8 @@ class Agent {
       tok = token_;
     }
     return http_request(opts_.master_host, opts_.master_port, method, target, body,
-                        timeout_sec, {{"Authorization", "Bearer " + tok}});
+                        timeout_sec, {{"Authorization", "Bearer " + tok}},
+                        opts_.master_tls, opts_.master_cert);
   }
 
   bool login() {
@@ -117,7 +123,8 @@ class Agent {
     body.set("username", opts_.user);
     body.set("password", opts_.password);
     auto resp = http_request(opts_.master_host, opts_.master_port, "POST",
-                             "/api/v1/auth/login", body.dump(), 10);
+                             "/api/v1/auth/login", body.dump(), 10, {},
+                             opts_.master_tls, opts_.master_cert);
     if (!resp.ok()) return false;
     Json out;
     if (!Json::try_parse(resp.body, &out)) return false;
@@ -228,7 +235,11 @@ class Agent {
       close(out_pipe[1]);
       // platform env
       setenv("DTPU_MASTER_URL",
-             ("http://" + opts_.master_host + ":" + std::to_string(opts_.master_port)).c_str(), 1);
+             ((opts_.master_tls ? "https://" : "http://") + opts_.master_host +
+              ":" + std::to_string(opts_.master_port)).c_str(), 1);
+      if (!opts_.master_cert.empty()) {
+        setenv("DTPU_MASTER_CERT", opts_.master_cert.c_str(), 1);
+      }
       setenv("DTPU_AGENT_ID", opts_.id.c_str(), 1);
       for (const auto& [k, v] : work["env"].items()) {
         setenv(k.c_str(), v.as_string().c_str(), 1);
@@ -273,7 +284,11 @@ class Agent {
       close(out_pipe[0]);
       close(out_pipe[1]);
       setenv("DTPU_MASTER_URL",
-             ("http://" + opts_.master_host + ":" + std::to_string(opts_.master_port)).c_str(), 1);
+             ((opts_.master_tls ? "https://" : "http://") + opts_.master_host +
+              ":" + std::to_string(opts_.master_port)).c_str(), 1);
+      if (!opts_.master_cert.empty()) {
+        setenv("DTPU_MASTER_CERT", opts_.master_cert.c_str(), 1);
+      }
       setenv("DTPU_AGENT_ID", opts_.id.c_str(), 1);
       for (const auto& [k, v] : work["env"].items()) {
         setenv(k.c_str(), v.as_string().c_str(), 1);
@@ -426,6 +441,8 @@ int main(int argc, char** argv) {
     else if (arg == "--user") opts.user = next("--user");
     else if (arg == "--password") opts.password = next("--password");
     else if (arg == "--state-dir") opts.state_dir = next("--state-dir");
+    else if (arg == "--master-tls") opts.master_tls = true;
+    else if (arg == "--master-cert") { opts.master_tls = true; opts.master_cert = next("--master-cert"); }
     else { fprintf(stderr, "unknown arg %s\n", arg.c_str()); return 2; }
   }
   if (opts.slots <= 0) {

@@ -20,10 +20,13 @@
 #include <cstring>
 #include <functional>
 #include <map>
+#include <memory>
 #include <sstream>
 #include <string>
 #include <thread>
 #include <vector>
+
+#include "tls.hpp"
 
 namespace dtpu {
 
@@ -64,6 +67,26 @@ struct HttpResponse {
 
 using Handler = std::function<HttpResponse(const HttpRequest&)>;
 
+// One accepted connection: plaintext fd or a TLS session over it.
+struct IoStream {
+  int fd = -1;
+  TlsSession* tls = nullptr;
+  long read(char* buf, size_t n) {
+    if (tls != nullptr) return tls->read(buf, static_cast<long>(n));
+    return ::recv(fd, buf, n, 0);
+  }
+  bool write_all(const char* data, size_t n) {
+    if (tls != nullptr) return tls->write_all(data, n);
+    size_t sent = 0;
+    while (sent < n) {
+      ssize_t w = ::send(fd, data + sent, n - sent, MSG_NOSIGNAL);
+      if (w <= 0) return false;
+      sent += static_cast<size_t>(w);
+    }
+    return true;
+  }
+};
+
 inline std::string url_encode(const std::string& s) {
   static const char* hex = "0123456789ABCDEF";
   std::string out;
@@ -103,6 +126,13 @@ class HttpServer {
   void route(const std::string& method, const std::string& pattern, Handler h) {
     routes_.push_back({method, split_path(pattern), std::move(h)});
   }
+
+  // Serve HTTPS (reference master: TLS on the one port, core.go:694-799).
+  // Call before listen(); returns "" or an error message.
+  std::string enable_tls(const std::string& cert_file, const std::string& key_file) {
+    return tls_.init(cert_file, key_file);
+  }
+  bool tls_enabled() const { return tls_.enabled(); }
 
   // returns the bound port (pass port=0 for ephemeral)
   int listen(const std::string& host, int port) {
@@ -164,10 +194,19 @@ class HttpServer {
   void serve_connection(int client) {
     int opt = 1;
     setsockopt(client, IPPROTO_TCP, TCP_NODELAY, &opt, sizeof(opt));
+    TlsSession tls_session;
+    IoStream stream{client, nullptr};
+    if (tls_.enabled()) {
+      if (!tls_session.accept(tls_.ctx(), client)) {
+        ::close(client);
+        return;
+      }
+      stream.tls = &tls_session;
+    }
     std::string buffer;
     while (running_) {
       HttpRequest req;
-      if (!read_request(client, buffer, &req)) break;
+      if (!read_request(stream, buffer, &req)) break;
       HttpResponse resp;
       try {
         resp = dispatch(req);
@@ -175,22 +214,33 @@ class HttpServer {
         resp = HttpResponse::error(500, e.what());
       }
       if (resp.hijack) {
+        if (stream.tls != nullptr) {
+          // raw-fd hijack (ws relay) does not compose with TLS framing yet
+          write_response(stream,
+                         HttpResponse::error(501, "websocket upgrade not "
+                                                  "supported over TLS"));
+          break;
+        }
         resp.hijack(client, std::move(buffer));
         return;  // hijacker owns + closes the fd
       }
-      if (!write_response(client, resp)) break;
+      if (!write_response(stream, resp)) break;
       auto conn = req.headers.find("connection");
       if (conn != req.headers.end() && conn->second == "close") break;
     }
+    // shutdown TLS BEFORE closing the fd: a detached sibling thread can
+    // recycle the fd number the instant it closes, and a late
+    // SSL_shutdown would write close_notify into a stranger's connection
+    tls_session.close();
     ::close(client);
   }
 
-  bool read_request(int client, std::string& buffer, HttpRequest* req) {
+  bool read_request(IoStream& stream, std::string& buffer, HttpRequest* req) {
     // read until header terminator
     size_t header_end;
     while ((header_end = buffer.find("\r\n\r\n")) == std::string::npos) {
       char chunk[8192];
-      ssize_t n = ::recv(client, chunk, sizeof(chunk), 0);
+      long n = stream.read(chunk, sizeof(chunk));
       if (n <= 0) return false;
       buffer.append(chunk, static_cast<size_t>(n));
       if (buffer.size() > (16u << 20)) return false;  // 16MB header+body cap
@@ -235,7 +285,7 @@ class HttpServer {
     size_t total = header_end + 4 + body_len;
     while (buffer.size() < total) {
       char chunk[16384];
-      ssize_t n = ::recv(client, chunk, sizeof(chunk), 0);
+      long n = stream.read(chunk, sizeof(chunk));
       if (n <= 0) return false;
       buffer.append(chunk, static_cast<size_t>(n));
     }
@@ -244,7 +294,7 @@ class HttpServer {
     return true;
   }
 
-  bool write_response(int client, const HttpResponse& resp) {
+  bool write_response(IoStream& stream, const HttpResponse& resp) {
     std::ostringstream out;
     out << "HTTP/1.1 " << resp.status << " " << reason(resp.status) << "\r\n"
         << "Content-Type: " << resp.content_type << "\r\n"
@@ -252,13 +302,7 @@ class HttpServer {
     for (const auto& [k, v] : resp.headers) out << k << ": " << v << "\r\n";
     out << "Connection: keep-alive\r\n\r\n" << resp.body;
     std::string data = out.str();
-    size_t sent = 0;
-    while (sent < data.size()) {
-      ssize_t n = ::send(client, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
-      if (n <= 0) return false;
-      sent += static_cast<size_t>(n);
-    }
-    return true;
+    return stream.write_all(data.data(), data.size());
   }
 
   static const char* reason(int status) {
@@ -324,6 +368,7 @@ class HttpServer {
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
   std::vector<Route> routes_;
+  TlsServerContext tls_;
 };
 
 // ---- raw TCP helpers (websocket upgrade passthrough) -----------------------
@@ -406,12 +451,17 @@ struct ClientResponse {
   bool ok() const { return status >= 200 && status < 300; }
 };
 
+// ``use_tls``/``tls_ca``: speak TLS to the server; a non-empty CA bundle
+// (typically the master's own self-signed cert) must verify the peer —
+// the agent/CLI trust model of the reference's certs.py.
 inline ClientResponse http_request(const std::string& host, int port,
                                    const std::string& method, const std::string& target,
                                    const std::string& body = "",
                                    int timeout_sec = 75,
                                    const std::vector<std::pair<std::string, std::string>>&
-                                       extra_headers = {}) {
+                                       extra_headers = {},
+                                   bool use_tls = false,
+                                   const std::string& tls_ca = "") {
   ClientResponse out;
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return out;
@@ -426,6 +476,15 @@ inline ClientResponse http_request(const std::string& host, int port,
     ::close(fd);
     return out;
   }
+  TlsSession tls;
+  IoStream stream{fd, nullptr};
+  if (use_tls) {
+    if (!tls.connect(fd, tls_ca, host)) {
+      ::close(fd);
+      return out;
+    }
+    stream.tls = &tls;
+  }
   std::ostringstream req;
   req << method << " " << target << " HTTP/1.1\r\n"
       << "Host: " << host << "\r\n"
@@ -434,16 +493,15 @@ inline ClientResponse http_request(const std::string& host, int port,
   for (const auto& [k, v] : extra_headers) req << k << ": " << v << "\r\n";
   req << "Connection: close\r\n\r\n" << body;
   std::string data = req.str();
-  size_t sent = 0;
-  while (sent < data.size()) {
-    ssize_t n = ::send(fd, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
-    if (n <= 0) { ::close(fd); return out; }
-    sent += static_cast<size_t>(n);
+  if (!stream.write_all(data.data(), data.size())) {
+    ::close(fd);
+    return out;
   }
   std::string resp;
   char chunk[16384];
-  ssize_t n;
-  while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0) resp.append(chunk, static_cast<size_t>(n));
+  long n;
+  while ((n = stream.read(chunk, sizeof(chunk))) > 0) resp.append(chunk, static_cast<size_t>(n));
+  tls.close();
   ::close(fd);
   auto sp = resp.find(' ');
   if (sp == std::string::npos) return out;

@@ -1,0 +1,240 @@
+// TLS for the dtpu master/agent via a dlopen'd OpenSSL 3 (libssl.so.3).
+//
+// Reference: the Go master terminates TLS on its one port
+// (master/internal/core.go:694-799) and the CLI/harness verify with a
+// master cert bundle (harness/determined/common/api/certs.py).  This image
+// ships the OpenSSL 3 RUNTIME but no dev headers, so the needed dozen
+// functions are declared here and resolved with dlsym at startup —
+// no build-time OpenSSL dependency, and hosts without libssl cleanly
+// report TLS as unavailable instead of failing to build.
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+
+#include <mutex>
+#include <string>
+
+namespace dtpu {
+
+// Opaque OpenSSL types (we only pass pointers around).
+struct SSL_CTX;
+struct SSL;
+struct SSL_METHOD;
+
+class TlsLib {
+ public:
+  static TlsLib& instance() {
+    static TlsLib lib;
+    return lib;
+  }
+
+  bool available() const { return handle_ != nullptr; }
+
+  // resolved function pointers (OpenSSL 3 stable ABI)
+  const SSL_METHOD* (*TLS_server_method)() = nullptr;
+  const SSL_METHOD* (*TLS_client_method)() = nullptr;
+  SSL_CTX* (*SSL_CTX_new)(const SSL_METHOD*) = nullptr;
+  void (*SSL_CTX_free)(SSL_CTX*) = nullptr;
+  int (*SSL_CTX_use_certificate_chain_file)(SSL_CTX*, const char*) = nullptr;
+  int (*SSL_CTX_use_PrivateKey_file)(SSL_CTX*, const char*, int) = nullptr;
+  int (*SSL_CTX_load_verify_locations)(SSL_CTX*, const char*, const char*) = nullptr;
+  void (*SSL_CTX_set_verify)(SSL_CTX*, int, void*) = nullptr;
+  SSL* (*SSL_new)(SSL_CTX*) = nullptr;
+  void (*SSL_free)(SSL*) = nullptr;
+  int (*SSL_set_fd)(SSL*, int) = nullptr;
+  int (*SSL_accept)(SSL*) = nullptr;
+  int (*SSL_connect)(SSL*) = nullptr;
+  int (*SSL_read)(SSL*, void*, int) = nullptr;
+  int (*SSL_write)(SSL*, const void*, int) = nullptr;
+  int (*SSL_shutdown)(SSL*) = nullptr;
+  long (*SSL_get_verify_result)(SSL*) = nullptr;
+  int (*SSL_set1_host)(SSL*, const char*) = nullptr;
+  // IP peers verify against IP SANs via the verify param, not set1_host
+  void* (*SSL_get0_param)(SSL*) = nullptr;
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(void*, const char*) = nullptr;
+
+ private:
+  TlsLib() {
+    handle_ = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!handle_) handle_ = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!handle_) return;
+    bool ok = true;
+    auto load = [&](auto& fn, const char* name) {
+      fn = reinterpret_cast<std::decay_t<decltype(fn)>>(dlsym(handle_, name));
+      if (fn == nullptr) ok = false;
+    };
+    load(TLS_server_method, "TLS_server_method");
+    load(TLS_client_method, "TLS_client_method");
+    load(SSL_CTX_new, "SSL_CTX_new");
+    load(SSL_CTX_free, "SSL_CTX_free");
+    load(SSL_CTX_use_certificate_chain_file, "SSL_CTX_use_certificate_chain_file");
+    load(SSL_CTX_use_PrivateKey_file, "SSL_CTX_use_PrivateKey_file");
+    load(SSL_CTX_load_verify_locations, "SSL_CTX_load_verify_locations");
+    load(SSL_CTX_set_verify, "SSL_CTX_set_verify");
+    load(SSL_new, "SSL_new");
+    load(SSL_free, "SSL_free");
+    load(SSL_set_fd, "SSL_set_fd");
+    load(SSL_accept, "SSL_accept");
+    load(SSL_connect, "SSL_connect");
+    load(SSL_read, "SSL_read");
+    load(SSL_write, "SSL_write");
+    load(SSL_shutdown, "SSL_shutdown");
+    load(SSL_get_verify_result, "SSL_get_verify_result");
+    load(SSL_set1_host, "SSL_set1_host");
+    load(SSL_get0_param, "SSL_get0_param");
+    // lives in libcrypto (a dependency of libssl, loaded RTLD_GLOBAL)
+    X509_VERIFY_PARAM_set1_ip_asc =
+        reinterpret_cast<int (*)(void*, const char*)>(
+            dlsym(RTLD_DEFAULT, "X509_VERIFY_PARAM_set1_ip_asc"));
+    if (X509_VERIFY_PARAM_set1_ip_asc == nullptr) ok = false;
+    if (!ok) {
+      dlclose(handle_);
+      handle_ = nullptr;
+    }
+  }
+  void* handle_ = nullptr;
+};
+
+constexpr int kSSL_FILETYPE_PEM = 1;   // SSL_FILETYPE_PEM
+constexpr int kSSL_VERIFY_NONE = 0;    // SSL_VERIFY_NONE
+constexpr int kSSL_VERIFY_PEER = 1;    // SSL_VERIFY_PEER
+constexpr long kX509_V_OK = 0;
+
+// Server-side TLS context (cert + key files).  Empty cert disables TLS.
+class TlsServerContext {
+ public:
+  TlsServerContext() = default;
+  ~TlsServerContext() { reset(); }
+
+  // returns "" on success, else an error message
+  std::string init(const std::string& cert_file, const std::string& key_file) {
+    auto& lib = TlsLib::instance();
+    if (!lib.available()) return "libssl.so.3 not found on this host";
+    ctx_ = lib.SSL_CTX_new(lib.TLS_server_method());
+    if (!ctx_) return "SSL_CTX_new failed";
+    if (lib.SSL_CTX_use_certificate_chain_file(ctx_, cert_file.c_str()) != 1) {
+      reset();
+      return "cannot load certificate: " + cert_file;
+    }
+    if (lib.SSL_CTX_use_PrivateKey_file(ctx_, key_file.c_str(), kSSL_FILETYPE_PEM) != 1) {
+      reset();
+      return "cannot load private key: " + key_file;
+    }
+    return "";
+  }
+
+  bool enabled() const { return ctx_ != nullptr; }
+  SSL_CTX* ctx() const { return ctx_; }
+
+ private:
+  void reset() {
+    if (ctx_ != nullptr) TlsLib::instance().SSL_CTX_free(ctx_);
+    ctx_ = nullptr;
+  }
+  SSL_CTX* ctx_ = nullptr;
+};
+
+// One TLS session over an accepted/connected socket.  Used by HttpServer
+// (server side) and http_request (client side).
+class TlsSession {
+ public:
+  TlsSession() = default;
+  ~TlsSession() { close(); }
+  TlsSession(const TlsSession&) = delete;
+  TlsSession& operator=(const TlsSession&) = delete;
+
+  bool accept(SSL_CTX* ctx, int fd) {
+    auto& lib = TlsLib::instance();
+    ssl_ = lib.SSL_new(ctx);
+    if (!ssl_) return false;
+    lib.SSL_set_fd(ssl_, fd);
+    if (lib.SSL_accept(ssl_) != 1) {
+      close();
+      return false;
+    }
+    return true;
+  }
+
+  // client connect; when ca_file is set the peer chain must verify AND
+  // its identity must match ``host`` (SSL_set1_host — chain verification
+  // alone would accept ANY cert the CA ever issued, for any service)
+  bool connect(int fd, const std::string& ca_file, const std::string& host = "") {
+    auto& lib = TlsLib::instance();
+    if (!lib.available()) return false;
+    ctx_ = lib.SSL_CTX_new(lib.TLS_client_method());
+    if (!ctx_) return false;
+    if (!ca_file.empty()) {
+      if (lib.SSL_CTX_load_verify_locations(ctx_, ca_file.c_str(), nullptr) != 1) {
+        close();
+        return false;
+      }
+      lib.SSL_CTX_set_verify(ctx_, kSSL_VERIFY_PEER, nullptr);
+    }
+    ssl_ = lib.SSL_new(ctx_);
+    if (!ssl_) {
+      close();
+      return false;
+    }
+    if (!ca_file.empty() && !host.empty()) {
+      // IP literals check against IP SANs; names against DNS SANs/CN
+      unsigned char ipbuf[16];
+      bool is_ip = inet_pton(AF_INET, host.c_str(), ipbuf) == 1 ||
+                   inet_pton(AF_INET6, host.c_str(), ipbuf) == 1;
+      int ok = is_ip ? lib.X509_VERIFY_PARAM_set1_ip_asc(
+                           lib.SSL_get0_param(ssl_), host.c_str())
+                     : lib.SSL_set1_host(ssl_, host.c_str());
+      if (ok != 1) {
+        close();
+        return false;
+      }
+    }
+    lib.SSL_set_fd(ssl_, fd);
+    if (lib.SSL_connect(ssl_) != 1) {
+      close();
+      return false;
+    }
+    if (!ca_file.empty() &&
+        lib.SSL_get_verify_result(ssl_) != kX509_V_OK) {
+      close();
+      return false;
+    }
+    return true;
+  }
+
+  long read(char* buf, long n) {
+    return TlsLib::instance().SSL_read(ssl_, buf, static_cast<int>(n));
+  }
+  bool write_all(const char* buf, size_t n) {
+    auto& lib = TlsLib::instance();
+    size_t sent = 0;
+    while (sent < n) {
+      int w = lib.SSL_write(ssl_, buf + sent, static_cast<int>(n - sent));
+      if (w <= 0) return false;
+      sent += static_cast<size_t>(w);
+    }
+    return true;
+  }
+
+  void close() {
+    auto& lib = TlsLib::instance();
+    if (ssl_ != nullptr) {
+      lib.SSL_shutdown(ssl_);
+      lib.SSL_free(ssl_);
+      ssl_ = nullptr;
+    }
+    if (ctx_ != nullptr) {
+      lib.SSL_CTX_free(ctx_);
+      ctx_ = nullptr;
+    }
+  }
+
+  bool active() const { return ssl_ != nullptr; }
+
+ private:
+  SSL* ssl_ = nullptr;
+  SSL_CTX* ctx_ = nullptr;  // client-side only
+};
+
+}  // namespace dtpu

@@ -19,6 +19,7 @@
 //
 // Build: see native/CMakeLists.txt.  No third-party dependencies.
 
+#include <csignal>
 #include <array>
 #include <condition_variable>
 #include <cstdio>
@@ -4482,6 +4483,9 @@ static int run_simulate(const std::string& config_path, uint64_t seed) {
 }
 
 int main(int argc, char** argv) {
+  // TLS writes go through SSL_write (plain write(2), no MSG_NOSIGNAL);
+  // a client resetting mid-response must not SIGPIPE the master
+  signal(SIGPIPE, SIG_IGN);
   std::string host = "0.0.0.0";
   int port = 8080;
   std::string state_dir = "/tmp/dtpu-master";
@@ -4493,6 +4497,7 @@ int main(int argc, char** argv) {
   std::string pools_file;
   std::string advertised_url;
   std::string telemetry_url;
+  std::string tls_cert, tls_key;
   int telemetry_interval_sec = 3600;
   for (int i = 1; i < argc; ++i) {
     std::string arg = argv[i];
@@ -4515,6 +4520,8 @@ int main(int argc, char** argv) {
     else if (arg == "--telemetry-url") telemetry_url = next("--telemetry-url");
     else if (arg == "--telemetry-interval-sec")
       telemetry_interval_sec = std::atoi(next("--telemetry-interval-sec").c_str());
+    else if (arg == "--tls-cert") tls_cert = next("--tls-cert");
+    else if (arg == "--tls-key") tls_key = next("--tls-key");
     else if (arg == "--simulate") {
       std::string cfg = next("--simulate");
       uint64_t seed = 0;
@@ -4553,13 +4560,26 @@ int main(int argc, char** argv) {
   master.boot();
   dtpu::HttpServer srv;
   master.install_routes(srv);
+  if (!tls_cert.empty() || !tls_key.empty()) {
+    if (tls_cert.empty() || tls_key.empty()) {
+      fprintf(stderr, "--tls-cert and --tls-key must be given together\n");
+      return 2;
+    }
+    std::string err = srv.enable_tls(tls_cert, tls_key);
+    if (!err.empty()) {
+      fprintf(stderr, "TLS setup failed: %s\n", err.c_str());
+      return 2;
+    }
+    printf("master: serving TLS (cert %s)\n", tls_cert.c_str());
+  }
   int bound = srv.listen(host, port);
   if (bound < 0) {
     fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);
     return 1;
   }
+  const std::string scheme = srv.tls_enabled() ? "https" : "http";
   master.set_advertised_url(advertised_url.empty()
-                                ? "http://127.0.0.1:" + std::to_string(bound)
+                                ? scheme + "://127.0.0.1:" + std::to_string(bound)
                                 : advertised_url);
   std::thread([&master] { master.run_external_worker(); }).detach();
   master.set_telemetry(telemetry_url, telemetry_interval_sec);

@@ -7,7 +7,6 @@ behind BASELINE.md's roofline accounting.
 
 from __future__ import annotations
 
-import glob
 import os
 import sys
 import tempfile
@@ -15,35 +14,12 @@ from collections import defaultdict
 
 
 def parse_xplane(trace_dir):
-    """Op name -> device-time us via xprof's hlo_stats tool."""
-    import json
+    """Op table via the shared analyzer (determined_tpu/utils/xplane.py)."""
+    from determined_tpu.utils.xplane import hlo_op_table
 
-    from xprof.convert import raw_to_tool_data
-
-    files = glob.glob(
-        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
-    )
-    assert files, f"no xplane under {trace_dir}"
-    data, _ = raw_to_tool_data.xspace_to_tool_data(files, "hlo_stats", {})
-    if isinstance(data, bytes):
-        data = data.decode()
-    table = json.loads(data)
-    if isinstance(table, dict):  # gviz DataTable
-        cols = [c.get("label") or c.get("id") or "" for c in table["cols"]]
-        rows = [[(c or {}).get("v") for c in r["c"]] for r in table["rows"]]
-    else:
-        cols = [c["label"] if isinstance(c, dict) else c for c in table[0]]
-        rows = table[1:]
-    low = [c.lower() for c in cols]
-    name_i = next(i for i, c in enumerate(low) if "hlo op name" in c or c == "name")
-    expr_i = next((i for i, c in enumerate(low) if "expression" in c), name_i)
-    time_i = next(i for i, c in enumerate(low) if "total time" in c and "us" in c)
-    cat_i = next((i for i, c in enumerate(low) if "category" in c), None)
     ops = defaultdict(float)
-    for row in rows:
-        name = str(row[name_i])
-        cat = str(row[cat_i]) if cat_i is not None else ""
-        ops[(name, cat, str(row[expr_i])[:120])] += float(row[time_i] or 0)
+    for op in hlo_op_table(trace_dir):
+        ops[(op["name"], op["category"], op["expression"][:120])] += op["time_us"]
     return ops
 
 

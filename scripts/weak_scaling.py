@@ -25,19 +25,29 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# fixed per-device batch for the weak-scaling points; the comm-free
+# control must use the SAME global batch (PER_DEVICE_BATCH * n on one
+# device) or the overhead ratio compares different computations
+PER_DEVICE_BATCH = 2
 
-def run_point(n: int, steps: int) -> dict:
+
+def run_point(
+    n: int, steps: int, profile: bool = False, gbs: int = 0, devices: int = 0
+) -> dict:
     env = dict(os.environ)
     flags = [
         f
         for f in env.get("XLA_FLAGS", "").split()
         if "xla_force_host_platform_device_count" not in f
     ]
-    flags.append(f"--xla_force_host_platform_device_count={n}")
+    flags.append(f"--xla_force_host_platform_device_count={devices or n}")
     env["XLA_FLAGS"] = " ".join(flags)
     env["JAX_PLATFORMS"] = "cpu"
     env["_DTPU_SCALING_N"] = str(n)
     env["_DTPU_SCALING_STEPS"] = str(steps)
+    env["_DTPU_SCALING_PROFILE"] = "1" if profile else "0"
+    if gbs:
+        env["_DTPU_SCALING_GBS"] = str(gbs)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--child"],
@@ -66,16 +76,17 @@ def child() -> None:
     from determined_tpu.models.transformer import LMTrial
     from determined_tpu.parallel.mesh import MeshConfig
 
-    per_device_batch = 2
+    per_device_batch = PER_DEVICE_BATCH
+    gbs_override = os.environ.get("_DTPU_SCALING_GBS")
     hp = {
         "lr": 1e-3,
-        "global_batch_size": per_device_batch * n,
+        "global_batch_size": int(gbs_override) if gbs_override else per_device_batch * n,
         "seq_len": 128,
         "vocab_size": 1024,
         "d_model": 128,
         "n_layers": 2,
         "n_heads": 4,
-        "dataset_size": 4 * per_device_batch * n,
+        "dataset_size": 4 * (int(gbs_override) if gbs_override else per_device_batch * n),
         "bf16": False,
         "attention": "reference",
         "warmup_steps": 1,
@@ -104,16 +115,74 @@ def child() -> None:
     jax.device_get(trainer.state.metric_count)
     dt = time.perf_counter() - t0
     tokens = steps * hp["global_batch_size"] * hp["seq_len"]
-    print(
-        json.dumps(
-            {
-                "n": n,
-                "tokens_per_sec": round(tokens / dt, 1),
-                "step_ms": round(dt / steps * 1000, 2),
-                "mesh": f"data={mesh.data},fsdp={mesh.fsdp}",
-            }
+    row = {
+        "n": n,
+        "tokens_per_sec": round(tokens / dt, 1),
+        "step_ms": round(dt / steps * 1000, 2),
+        "mesh": f"data={mesh.data},fsdp={mesh.fsdp}",
+    }
+    if os.environ.get("_DTPU_SCALING_PROFILE") == "1" and n > 1:
+        # Attribute the emulated-collective term by MEASURING the step's
+        # collectives in isolation at their real shapes (CPU xplanes carry
+        # no per-HLO device events, so a trace can't do this):
+        #  - all-reduce of the full gradient tree over the batch axes (the
+        #    collective the dp axis inserts every step)
+        #  - all-gather of the fsdp-sharded params (what ZeRO-style
+        #    sharding inserts around each matmul)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        try:
+            shard_map = jax.shard_map
+            smap_kw = {"check_vma": False}
+        except AttributeError:  # pragma: no cover - older jax flag name
+            from jax.experimental.shard_map import shard_map
+
+            smap_kw = {"check_rep": False}
+
+        params = trainer.state.params
+        jmesh = trainer.mesh
+        rep = jax.tree.map(lambda _: P(), params)
+        psum_fn = jax.jit(
+            shard_map(
+                lambda t: jax.tree.map(
+                    lambda a: jax.lax.psum(a, ("data", "fsdp")), t
+                ),
+                mesh=jmesh,
+                in_specs=(rep,),
+                out_specs=rep,
+                **smap_kw,
+            )
         )
-    )
+        rep_params = jax.device_put(
+            params, jax.tree.map(lambda _: NamedSharding(jmesh, P()), params)
+        )
+
+        def timed(fn, arg):
+            out = fn(arg)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(arg)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / steps * 1000
+
+        row["comm_allreduce_ms"] = round(timed(psum_fn, rep_params), 2)
+
+        # fsdp all-gather at param shapes (sharded -> replicated)
+        shardings = trainer._param_specs
+        from determined_tpu.parallel.sharding import param_shardings
+
+        sharded = jax.device_put(
+            params, param_shardings(shardings, jmesh, trainer.context.rules)
+        )
+        gather_fn = jax.jit(
+            lambda t: t,
+            out_shardings=jax.tree.map(
+                lambda _: NamedSharding(jmesh, P()), params
+            ),
+        )
+        row["comm_allgather_ms"] = round(timed(gather_fn, sharded), 2)
+    print(json.dumps(row))
 
 
 def main() -> None:
@@ -121,6 +190,13 @@ def main() -> None:
     ap.add_argument("--ns", default="1,2,4,8,16,32")
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--child", action="store_true")
+    ap.add_argument(
+        "--attribute",
+        action="store_true",
+        help="per-n xplane attribution (collective vs compute) + a "
+        "communication-free control (same global batch, ONE device) so the "
+        "emulation term is measured, not asserted",
+    )
     args = ap.parse_args()
     if args.child:
         child()
@@ -128,10 +204,29 @@ def main() -> None:
     ns = [int(x) for x in args.ns.split(",")]
     rows = []
     for n in ns:
-        r = run_point(n, args.steps)
+        r = run_point(n, args.steps, profile=args.attribute)
+        if args.attribute:
+            # control: identical global computation, 1 device, 0 collectives
+            ctrl = run_point(1, args.steps, gbs=PER_DEVICE_BATCH * n, devices=1)
+            r["control_step_ms"] = ctrl["step_ms"]
+            r["overhead_vs_control"] = round(r["step_ms"] / ctrl["step_ms"], 2)
         rows.append(r)
         print(json.dumps(r), flush=True)
     base = rows[0]["tokens_per_sec"] / rows[0]["n"]
+    if args.attribute:
+        print(
+            "\n| devices | step ms | comm-free control ms | overhead | "
+            "grad all-reduce ms | fsdp all-gather ms |"
+        )
+        print("|---|---|---|---|---|---|")
+        for r in rows:
+            print(
+                f"| {r['n']} | {r['step_ms']} | {r['control_step_ms']} "
+                f"| {r['overhead_vs_control']}x "
+                f"| {r.get('comm_allreduce_ms', '-')} "
+                f"| {r.get('comm_allgather_ms', '-')} |"
+            )
+        return
     print("\n| devices | tokens/s | step ms | per-device tokens/s | weak-scaling eff |")
     print("|---|---|---|---|---|")
     for r in rows:
