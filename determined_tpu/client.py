@@ -433,17 +433,27 @@ class Determined:
 
     def open_shell_ws(self, task_id: str):
         """Open the shell task's websocket through the master proxy; returns
-        a connected ``determined_tpu.common.ws.WebSocket``."""
+        a connected ``determined_tpu.common.ws.WebSocket``.  https masters
+        get wss with the session's CA bundle (DTPU_MASTER_CERT / --cert)."""
+        import os
         from urllib.parse import urlparse
 
         from determined_tpu.common import ws as wslib
 
         u = urlparse(self.master)
+        https = u.scheme == "https"
+        tls_ca = None
+        if https:
+            verify = getattr(self._session._http, "verify", None)
+            tls_ca = verify if isinstance(verify, str) else os.environ.get(
+                "DTPU_MASTER_CERT"
+            )
         return wslib.connect(
             u.hostname or "127.0.0.1",
-            u.port or 80,
+            u.port or (443 if https else 80),
             f"/proxy/{task_id}/ws",
             headers={"Authorization": f"Bearer {self._session.token}"},
+            tls_ca=tls_ca,
         )
 
     def get_task(self, task_id: str) -> Dict[str, Any]:

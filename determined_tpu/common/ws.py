@@ -184,10 +184,20 @@ def connect(
     path: str,
     headers: Optional[Dict[str, str]] = None,
     timeout: float = 30.0,
+    tls_ca: Optional[str] = None,
 ) -> WebSocket:
-    """Client handshake; raises on a non-101 response."""
+    """Client handshake; raises on a non-101 response.
+
+    ``tls_ca``: connect over TLS (wss) verifying against the CA bundle —
+    used when the master proxy serves HTTPS.
+    """
     sock = socket.create_connection((host, port), timeout=timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    if tls_ca:
+        import ssl
+
+        ctx = ssl.create_default_context(cafile=tls_ca)
+        sock = ctx.wrap_socket(sock, server_hostname=host)
     key = base64.b64encode(os.urandom(16)).decode()
     req = [
         f"GET {path} HTTP/1.1",

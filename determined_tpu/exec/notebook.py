@@ -20,6 +20,8 @@ import sys
 import time
 import urllib.request
 
+from determined_tpu.exec._tls import urlopen as _tls_urlopen
+
 
 def main() -> int:
     task_id = os.environ.get("DTPU_TASK_ID", "task")
@@ -64,9 +66,7 @@ def main() -> int:
     ready = False
     while time.time() < deadline and proc.poll() is None:
         try:
-            with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}{base_url}api", timeout=2
-            ) as resp:
+            with _tls_urlopen(f"http://127.0.0.1:{port}{base_url}api", timeout=2) as resp:
                 if resp.status == 200:
                     ready = True
                     break
@@ -83,7 +83,7 @@ def main() -> int:
         headers={"Authorization": f"Bearer {token}"},
         method="POST",
     )
-    urllib.request.urlopen(req, timeout=30).read()
+    _tls_urlopen(req, timeout=30).read()
     print(f"notebook task {task_id} ready on :{port}{base_url} "
           f"(jupyter token = task session token)", flush=True)
     return proc.wait()

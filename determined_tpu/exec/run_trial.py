@@ -20,6 +20,18 @@ import os
 import sys
 
 
+def _tls_urlopen(req, timeout: float = 30.0):
+    """urlopen trusting DTPU_MASTER_CERT.  Self-contained on purpose:
+    importing determined_tpu.exec._tls would pull the package (and jax)
+    before ``_apply_environment_early`` has fixed XLA_FLAGS/JAX_PLATFORMS."""
+    import ssl
+    import urllib.request
+
+    ca = os.environ.get("DTPU_MASTER_CERT")
+    ctx = ssl.create_default_context(cafile=ca) if ca else None
+    return urllib.request.urlopen(req, timeout=timeout, context=ctx)
+
+
 def _apply_environment_early() -> None:
     """Env vars from exp config must land BEFORE jax is imported
     (XLA_FLAGS, JAX_PLATFORMS and friends are read at import time).
@@ -83,7 +95,7 @@ def _prepare_context(logger) -> None:
     for attempt in range(4):
         try:
             req = urllib.request.Request(url, headers=headers)
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            with _tls_urlopen(req, timeout=60) as resp:
                 data = resp.read()
             break
         except Exception as e:  # noqa: BLE001 - transient master hiccups
@@ -162,7 +174,7 @@ def _install_log_shipper() -> None:
             },
         )
         try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
+            with _tls_urlopen(req, timeout=10) as resp:
                 resp.read()
             return True
         except Exception:  # noqa: BLE001 - retried by the next flush
@@ -248,7 +260,7 @@ def _self_report_exit(code: int) -> None:
         },
     )
     try:
-        with urllib.request.urlopen(req, timeout=10) as resp:
+        with _tls_urlopen(req, timeout=10) as resp:
             resp.read()
     except Exception:  # noqa: BLE001 - master poll catches silent deaths
         pass

@@ -31,6 +31,8 @@ import termios
 import threading
 import urllib.request
 
+from determined_tpu.exec._tls import urlopen as _tls_urlopen
+
 from determined_tpu.common import ws as wslib
 
 
@@ -150,7 +152,7 @@ def main() -> int:
         headers={"Authorization": f"Bearer {token}"},
         method="POST",
     )
-    urllib.request.urlopen(req, timeout=30).read()
+    _tls_urlopen(req, timeout=30).read()
     print(f"shell task {task_id} ready on :{port} (ws endpoint)", flush=True)
 
     def on_term(_sig, _frame):

@@ -35,6 +35,8 @@ import signal
 import sys
 import urllib.request
 
+from determined_tpu.exec._tls import urlopen as _tls_urlopen
+
 _PAGE = """<!DOCTYPE html>
 <html><head><title>dtpu tensorboard</title>
 <style>
@@ -56,21 +58,25 @@ function chart(title, points) {
   const px = x => pad + (x - xmin) / (xmax - xmin) * (w - 2 * pad);
   const py = y => h - pad - (y - ymin) / (ymax - ymin) * (h - 2 * pad);
   const pts = points.map(p => px(p[0]) + "," + py(p[1])).join(" ");
-  return `<h2>${title}</h2><svg class="chart" width="${w}" height="${h}">` +
+  return `<h2>${esc(title)}</h2><svg class="chart" width="${w}" height="${h}">` +
     `<polyline points="${pts}"/>` +
     `<text class="label" x="${pad}" y="${h-8}">${xmin}</text>` +
     `<text class="label" x="${w-pad-30}" y="${h-8}">${xmax}</text>` +
     `<text class="label" x="2" y="${py(ymax)+4}">${ymax.toPrecision(4)}</text>` +
     `<text class="label" x="2" y="${py(ymin)+4}">${ymin.toPrecision(4)}</text></svg>`;
 }
+function esc(v) {
+  return String(v).replace(/[&<>"']/g,
+    c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+}
 function opTable(p) {
-  if (p.error) return `<p class="label">${p.error}</p>`;
+  if (p.error) return `<p class="label">${esc(p.error)}</p>`;
   let rows = p.ops.slice(0, 20).map(o =>
-    `<tr><td>${o.name}</td><td>${o.category}</td>` +
+    `<tr><td>${esc(o.name)}</td><td>${esc(o.category)}</td>` +
     `<td style="text-align:right">${(o.time_us/1000).toFixed(3)}</td>` +
     `<td style="text-align:right">${o.pct}%</td></tr>`).join("");
   let cats = Object.entries(p.categories).map(([k, us]) =>
-    `<tr><td>${k}</td><td style="text-align:right">${(us/1000).toFixed(3)}</td>` +
+    `<tr><td>${esc(k)}</td><td style="text-align:right">${(us/1000).toFixed(3)}</td>` +
     `<td style="text-align:right">${(100*us/p.device_total_us).toFixed(1)}%</td></tr>`
   ).join("");
   return `<h3>profiler — trial ${p.trial_id} (device ${(p.device_total_us/1000).toFixed(1)} ms,` +
@@ -117,7 +123,7 @@ def _master_get(path: str) -> bytes:
     req = urllib.request.Request(
         master + path, headers={"Authorization": f"Bearer {token}"}
     )
-    with urllib.request.urlopen(req, timeout=30) as resp:
+    with _tls_urlopen(req, timeout=30) as resp:
         return resp.read()
 
 
@@ -272,7 +278,7 @@ def main() -> int:
         headers={"Authorization": f"Bearer {token}"},
         method="POST",
     )
-    urllib.request.urlopen(req, timeout=30).read()
+    _tls_urlopen(req, timeout=30).read()
     print(f"tensorboard task {task_id} serving on :{port}", flush=True)
     server.serve_forever()
     return 0

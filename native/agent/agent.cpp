@@ -450,5 +450,13 @@ int main(int argc, char** argv) {
     fprintf(stderr, "agent %s: detected %d %s slot(s)\n", opts.id.c_str(),
             opts.slots, opts.slot_type.c_str());
   }
+  if (opts.master_tls && opts.master_cert.empty()) {
+    // this client loads NO system trust roots: TLS without a CA bundle
+    // would be verification-free and hide a MITM behind a lock icon
+    fprintf(stderr,
+            "refusing --master-tls without --master-cert: unverified TLS "
+            "is worse than explicit plaintext\n");
+    return 2;
+  }
   return dtpu::Agent(opts).run();
 }

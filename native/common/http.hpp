@@ -46,10 +46,12 @@ struct HttpResponse {
   // extra response headers (e.g. Set-Cookie from the proxy auth)
   std::vector<std::pair<std::string, std::string>> headers;
   // Connection hijack (websocket upgrade passthrough): when set, the server
-  // writes NO response; the hijacker takes ownership of the client fd (and
-  // any bytes already read past the request) and must close it.  Reference
-  // analog: the Go proxy's ws hijack (master/internal/proxy/proxy.go).
-  std::function<void(int client_fd, std::string leftover)> hijack;
+  // writes NO response; the hijacker pumps the connection through the given
+  // stream (plaintext fd or TLS session — both work) plus any bytes already
+  // read past the request, and returns when the session ends (the server
+  // closes the fd afterwards).  Reference analog: the Go proxy's ws hijack
+  // (master/internal/proxy/proxy.go).
+  std::function<void(struct IoStream&, std::string leftover)> hijack;
 
   static HttpResponse json(const std::string& body, int status = 200) {
     HttpResponse r;
@@ -214,15 +216,8 @@ class HttpServer {
         resp = HttpResponse::error(500, e.what());
       }
       if (resp.hijack) {
-        if (stream.tls != nullptr) {
-          // raw-fd hijack (ws relay) does not compose with TLS framing yet
-          write_response(stream,
-                         HttpResponse::error(501, "websocket upgrade not "
-                                                  "supported over TLS"));
-          break;
-        }
-        resp.hijack(client, std::move(buffer));
-        return;  // hijacker owns + closes the fd
+        resp.hijack(stream, std::move(buffer));
+        break;  // session over; shutdown + close below
       }
       if (!write_response(stream, resp)) break;
       auto conn = req.headers.find("connection");
@@ -402,41 +397,54 @@ inline bool send_all(int fd, const char* data, size_t len) {
   return true;
 }
 
-// Pump bytes both ways between two sockets until either side closes.
-// ``on_activity`` (optional) is invoked at most every ``activity_period_sec``
-// while traffic flows — the proxy uses it to keep a task's idle clock fresh
-// during a long-lived websocket session.  Closes NEITHER fd.
-inline void relay_bidirectional(int a, int b,
+// Pump bytes both ways between a client stream (plaintext or TLS) and an
+// upstream socket until either side closes.  ``on_activity`` (optional) is
+// invoked at most every ``activity_period_sec`` while traffic flows — the
+// proxy uses it to keep a task's idle clock fresh during a long-lived
+// websocket session.  Closes NEITHER side.
+inline void relay_bidirectional(IoStream& client, int upstream,
                                 std::function<void()> on_activity = nullptr,
                                 int activity_period_sec = 15) {
   // clear any client-handshake timeouts: ws sessions idle legitimately
   timeval tv{0, 0};
-  setsockopt(a, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-  setsockopt(b, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(client.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(upstream, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   time_t last_touch = ::time(nullptr);
-  pollfd fds[2];
-  fds[0] = {a, POLLIN, 0};
-  fds[1] = {b, POLLIN, 0};
   char buf[16384];
+  auto touch = [&] {
+    if (!on_activity) return;
+    time_t now = ::time(nullptr);
+    if (now - last_touch >= activity_period_sec) {
+      last_touch = now;
+      on_activity();
+    }
+  };
   while (true) {
-    fds[0].revents = fds[1].revents = 0;
+    // TLS: bytes may already be decrypted inside the session where poll()
+    // cannot see them — drain before blocking
+    while (client.tls != nullptr && client.tls->pending() > 0) {
+      long n = client.read(buf, sizeof(buf));
+      if (n <= 0) return;
+      if (!send_all(upstream, buf, static_cast<size_t>(n))) return;
+      touch();
+    }
+    pollfd fds[2];
+    fds[0] = {client.fd, POLLIN, 0};
+    fds[1] = {upstream, POLLIN, 0};
     int rc = ::poll(fds, 2, 60000);
     if (rc < 0) break;
     if (rc == 0) continue;  // idle: keep the session open
-    for (int i = 0; i < 2; ++i) {
-      if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
-        ssize_t n = ::recv(fds[i].fd, buf, sizeof(buf), 0);
-        if (n <= 0) return;
-        int dst = (i == 0) ? b : a;
-        if (!send_all(dst, buf, static_cast<size_t>(n))) return;
-        if (on_activity) {
-          time_t now = ::time(nullptr);
-          if (now - last_touch >= activity_period_sec) {
-            last_touch = now;
-            on_activity();
-          }
-        }
-      }
+    if (fds[0].revents & (POLLIN | POLLHUP | POLLERR)) {
+      long n = client.read(buf, sizeof(buf));
+      if (n <= 0) return;
+      if (!send_all(upstream, buf, static_cast<size_t>(n))) return;
+      touch();
+    }
+    if (fds[1].revents & (POLLIN | POLLHUP | POLLERR)) {
+      ssize_t n = ::recv(upstream, buf, sizeof(buf), 0);
+      if (n <= 0) return;
+      if (!client.write_all(buf, static_cast<size_t>(n))) return;
+      touch();
     }
   }
 }

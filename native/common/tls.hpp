@@ -50,6 +50,7 @@ class TlsLib {
   int (*SSL_write)(SSL*, const void*, int) = nullptr;
   int (*SSL_shutdown)(SSL*) = nullptr;
   long (*SSL_get_verify_result)(SSL*) = nullptr;
+  int (*SSL_pending)(const SSL*) = nullptr;
   int (*SSL_set1_host)(SSL*, const char*) = nullptr;
   // IP peers verify against IP SANs via the verify param, not set1_host
   void* (*SSL_get0_param)(SSL*) = nullptr;
@@ -82,6 +83,7 @@ class TlsLib {
     load(SSL_write, "SSL_write");
     load(SSL_shutdown, "SSL_shutdown");
     load(SSL_get_verify_result, "SSL_get_verify_result");
+    load(SSL_pending, "SSL_pending");
     load(SSL_set1_host, "SSL_set1_host");
     load(SSL_get0_param, "SSL_get0_param");
     // lives in libcrypto (a dependency of libssl, loaded RTLD_GLOBAL)
@@ -206,6 +208,9 @@ class TlsSession {
   long read(char* buf, long n) {
     return TlsLib::instance().SSL_read(ssl_, buf, static_cast<int>(n));
   }
+  // bytes already decrypted inside the SSL object: poll() on the fd will
+  // NOT report them, so relays must drain pending before selecting
+  int pending() const { return TlsLib::instance().SSL_pending(ssl_); }
   bool write_all(const char* buf, size_t n) {
     auto& lib = TlsLib::instance();
     size_t sent = 0;

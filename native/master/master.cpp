@@ -2040,6 +2040,16 @@ class Master {
     j.set("latest_checkpoint", t.latest_checkpoint);
     j.set("allocation_id", t.allocation_id);
     j.set("progress", Json(t.progress));
+    if (!t.val_by_step.empty()) {
+      auto eit = experiments_.find(t.experiment_id);
+      bool sib = eit == experiments_.end() || eit->second.smaller_is_better;
+      double best = t.val_by_step.begin()->second;
+      for (const auto& [step, v] : t.val_by_step) {
+        if (sib ? v < best : v > best) best = v;
+      }
+      j.set("best_validation", Json(best));
+      j.set("latest_validation", Json(t.val_by_step.rbegin()->second));
+    }
     return j;
   }
 
@@ -2236,15 +2246,16 @@ class Master {
       std::string ref;
       bool ended;
       bool lingering;  // no allocation behind it (mid-submit kill remnant)
+      int missing_polls = 0;  // so diagnose() runs only on the acting poll
     };
     std::vector<Probe> probes;
     for (auto& [alloc_id, alloc] : allocations_) {
       if (alloc.external_kind.empty() || alloc.external_ref.empty()) continue;
       probes.push_back({alloc_id, alloc.external_pool, alloc.external_ref,
-                        alloc.ended, false});
+                        alloc.ended, false, alloc.external_missing_polls});
     }
     for (auto& [pool_name, ref] : lingering_external_) {
-      probes.push_back({"", pool_name, ref, true, true});
+      probes.push_back({"", pool_name, ref, true, true, 0});
     }
     lingering_external_.clear();
     if (probes.empty()) return;
@@ -2255,6 +2266,7 @@ class Master {
       ExternalJobState state;
       int exit_code;
       bool cleaned;  // the ended-branch remove/cancel actually ran
+      std::string diag;  // backend failure diagnostics (pod/sacct info)
     };
     std::vector<Result> results;
     size_t processed = 0;
@@ -2287,18 +2299,21 @@ class Master {
       }
       int exit_code = 1;
       ExternalJobState st = ExternalJobState::kRunning;
+      std::string diag;
       if (pool.type == "kubernetes") {
         // gang aggregate over the ref's jobs: any failure fails the
         // gang, any vanished job counts as gone, success only when every
         // job succeeded
         bool any_gone = false, any_failed = false, all_ok = true;
         int failed_code = 1;
+        std::string failed_job;
         for (const auto& name : split_ref(p.ref)) {
           int code = 1;
           ExternalJobState s = KubernetesBackend::status(pool, name, &code);
           if (s == ExternalJobState::kFailed) {
             any_failed = true;
             failed_code = code;
+            failed_job = name;
           }
           if (s == ExternalJobState::kGone) any_gone = true;
           if (s != ExternalJobState::kSucceeded) all_ok = false;
@@ -2306,6 +2321,9 @@ class Master {
         if (any_failed) {
           st = ExternalJobState::kFailed;
           exit_code = failed_code;
+          // pod termination reasons + log tail (the kubectl a human
+          // would run) so the trial error is more than "generic failure"
+          diag = KubernetesBackend::diagnose(pool, failed_job);
         } else if (any_gone) {
           st = ExternalJobState::kGone;
         } else if (all_ok) {
@@ -2314,8 +2332,15 @@ class Master {
         }
       } else if (pool.type == "slurm") {
         st = SlurmBackend::status(pool, p.ref);
+        if (st == ExternalJobState::kGone && p.missing_polls >= 1) {
+          // the accounting record (sacct) explains OOM-kill/timeout/
+          // preemption that squeue disappearance alone cannot; fetched
+          // only on the poll that will actually fail the allocation
+          // (the second consecutive gone)
+          diag = SlurmBackend::diagnose(pool, p.ref);
+        }
       }
-      results.push_back({p.alloc_id, st, exit_code, false});
+      results.push_back({p.alloc_id, st, exit_code, false, diag});
     }
     lk.lock();
     // probes abandoned by the early break: allocation-backed ones retry
@@ -2350,6 +2375,14 @@ class Master {
           on_trial_exit(alloc.trial_id, 0);
           break;
         case ExternalJobState::kFailed:
+          if (!r.diag.empty()) {
+            append_jsonl_striped(logs_path(alloc.trial_id),
+                         Json::object()
+                             .set("ts", Json(now_ms()))
+                             .set("level", "ERROR")
+                             .set("line", alloc.external_kind +
+                                              " failure diagnostics:\n" + r.diag));
+          }
           on_trial_exit(alloc.trial_id, r.exit_code == 0 ? 1 : r.exit_code);
           break;
         case ExternalJobState::kGone:
@@ -2357,13 +2390,15 @@ class Master {
           // polls with no exit means the job evaporated (node death,
           // scancel outside the master, admin delete)
           if (++alloc.external_missing_polls >= 2) {
+            std::string line = alloc.external_kind + " job " +
+                               alloc.external_ref +
+                               " disappeared; failing allocation";
+            if (!r.diag.empty()) line += "\naccounting: " + r.diag;
             append_jsonl_striped(logs_path(alloc.trial_id),
                          Json::object()
                              .set("ts", Json(now_ms()))
                              .set("level", "ERROR")
-                             .set("line", alloc.external_kind + " job " +
-                                              alloc.external_ref +
-                                              " disappeared; failing allocation"));
+                             .set("line", line));
             on_trial_exit(alloc.trial_id, 102);
           }
           break;
@@ -4232,14 +4267,13 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         hs << "\r\n";
         std::string handshake = hs.str();
         HttpResponse out;
-        out.hijack = [&m, host, port, handshake, task_id](int client,
+        out.hijack = [&m, host, port, handshake, task_id](IoStream& client,
                                                           std::string leftover) {
           int upstream = tcp_connect(host, port, 10);
           if (upstream < 0) {
             const char* err =
                 "HTTP/1.1 502 Bad Gateway\r\nContent-Length: 0\r\n\r\n";
-            send_all(client, err, strlen(err));
-            ::close(client);
+            client.write_all(err, strlen(err));
             return;
           }
           bool ok = send_all(upstream, handshake.data(), handshake.size());
@@ -4254,7 +4288,6 @@ void install_routes_impl(Master& m, HttpServer& srv) {
             });
           }
           ::close(upstream);
-          ::close(client);
         };
         return out;
       }

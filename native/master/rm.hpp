@@ -80,6 +80,7 @@ struct PoolConfig {
   std::string slurm_squeue = "squeue";
   std::string slurm_scancel = "scancel";
   std::string slurm_srun = "srun";
+  std::string slurm_sacct = "sacct";
   std::string slurm_partition;
   std::string slurm_spool = "/tmp/dtpu-slurm";
   // multi-node gangs: chips per Slurm node (0 = whole trial on one node).
@@ -114,6 +115,7 @@ struct PoolConfig {
       if (s["squeue"].is_string()) p.slurm_squeue = s["squeue"].as_string();
       if (s["scancel"].is_string()) p.slurm_scancel = s["scancel"].as_string();
       if (s["srun"].is_string()) p.slurm_srun = s["srun"].as_string();
+      if (s["sacct"].is_string()) p.slurm_sacct = s["sacct"].as_string();
       if (s["partition"].is_string()) p.slurm_partition = s["partition"].as_string();
       if (s["spool_dir"].is_string()) p.slurm_spool = s["spool_dir"].as_string();
       p.slurm_slots_per_node = static_cast<int>(s["slots_per_node"].as_int(0));
@@ -296,6 +298,46 @@ class KubernetesBackend {
         jobs_path(pool) + "/" + job_name + "?propagationPolicy=Background", "");
   }
 
+  // Failure diagnostics (the `kubectl describe/logs` a human would run):
+  // pod phases + container termination reasons (OOMKilled, Error, exit
+  // code) and a log tail for the job's pods.  Best-effort — apiservers
+  // (and the test fake) without pod routes just yield "".
+  static std::string diagnose(const PoolConfig& pool, const std::string& job_name) {
+    auto resp = api(pool, "GET",
+                    "/api/v1/namespaces/" + pool.k8s_namespace +
+                        "/pods?labelSelector=job-name%3D" + job_name,
+                    "");
+    if (!resp.ok()) return "";
+    Json list;
+    if (!Json::try_parse(resp.body, &list) || !list["items"].is_array()) return "";
+    std::string out;
+    for (const auto& pod : list["items"].elements()) {
+      const std::string pod_name = pod["metadata"]["name"].as_string();
+      out += "pod " + pod_name + ": phase=" +
+             pod["status"]["phase"].as_string();
+      for (const auto& cs : pod["status"]["containerStatuses"].elements()) {
+        const Json& term = cs["state"]["terminated"];
+        if (term.is_object()) {
+          out += " terminated(reason=" + term["reason"].as_string() +
+                 ", exit=" + std::to_string(term["exitCode"].as_int(-1)) + ")";
+          if (term["message"].is_string() && !term["message"].as_string().empty()) {
+            out += " msg=" + term["message"].as_string().substr(0, 200);
+          }
+        }
+      }
+      auto logs = api(pool, "GET",
+                      "/api/v1/namespaces/" + pool.k8s_namespace + "/pods/" +
+                          pod_name + "/log?tailLines=20",
+                      "");
+      if (logs.ok() && !logs.body.empty()) {
+        out += "\n--- pod " + pod_name + " log tail ---\n" +
+               logs.body.substr(logs.body.size() > 4000 ? logs.body.size() - 4000 : 0);
+      }
+      out += "\n";
+    }
+    return out;
+  }
+
  private:
   static std::string jobs_path(const PoolConfig& pool) {
     return "/apis/batch/v1/namespaces/" + pool.k8s_namespace + "/jobs";
@@ -422,6 +464,21 @@ class SlurmBackend {
   static void cancel(const PoolConfig& pool, const std::string& job_id) {
     rm_detail::run_capture(pool.slurm_scancel + " " +
                            rm_detail::shell_quote(job_id));
+  }
+
+  // Failure diagnostics: the accounting record a human would pull with
+  // `sacct -j` (state, exit code, OOM/timeout reasons).  Best-effort —
+  // sites without slurmdbd (or the test stubs) just yield "".
+  static std::string diagnose(const PoolConfig& pool, const std::string& job_id) {
+    int rc = 0;
+    std::string out = rm_detail::run_capture(
+        pool.slurm_sacct + " -j " + rm_detail::shell_quote(job_id) +
+            " --format=JobID,State,ExitCode,Reason%40 -P -n",
+        &rc, /*merge_stderr=*/true);
+    if (rc != 0) return "";
+    // trim trailing whitespace; bound the size for the log line
+    while (!out.empty() && (out.back() == '\n' || out.back() == ' ')) out.pop_back();
+    return out.substr(0, 2000);
   }
 };
 

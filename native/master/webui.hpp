@@ -133,6 +133,89 @@ function chart(points, w = 420, h = 110) {
     `<text x="2" y="12">${y1.toPrecision(3)}</text>` +
     `<text x="2" y="${h-4}">${y0.toPrecision(3)}</text></svg>`;
 }
+const PALETTE = ["#2d79c7","#2e9e5b","#c0392b","#b07d2b","#7d3cb5",
+                 "#148f8f","#c2527e","#5a6b2f","#444466","#996633"];
+function multiChart(seriesList, w = 640, h = 170) {
+  // seriesList: [{name, color, points:[[x,y]...]}]
+  const all = seriesList.flatMap(s => s.points);
+  if (all.length < 2) return "(not enough data)";
+  const pad = 30;
+  const xs = all.map(p => p[0]), ys = all.map(p => p[1]);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  const y0 = Math.min(...ys), y1 = Math.max(...ys, y0 + 1e-9);
+  const px = x => pad + (x - x0) / (x1 - x0 || 1) * (w - 2 * pad);
+  const py = y => h - pad - (y - y0) / (y1 - y0) * (h - 2 * pad);
+  const lines = seriesList.map(s =>
+    `<polyline style="stroke:${s.color}" points="` +
+    s.points.map(p => px(p[0]) + "," + py(p[1])).join(" ") + `"/>`).join("");
+  const legend = seriesList.map(s =>
+    `<span style="color:${s.color}">&#9632; ${esc(s.name)}</span>`).join(" ");
+  return `<svg class="chart" width="${w}" height="${h}">${lines}` +
+    `<text x="2" y="12">${y1.toPrecision(3)}</text>` +
+    `<text x="2" y="${h-4}">${y0.toPrecision(3)}</text></svg>` +
+    `<div class="hp">${legend}</div>`;
+}
+// cross-trial metric comparison (reference ExperimentDetails charts):
+// overlays every trial's validation curve for the searcher metric
+async function expCompare(expId, el) {
+  el.innerHTML = "loading…";
+  const e = await api(`/api/v1/experiments/${expId}`);
+  const metric = ((e.config || {}).searcher || {}).metric || "validation_loss";
+  const series = [];
+  for (const [i, t] of (e.trials || []).entries()) {
+    const rows = await api(`/api/v1/trials/${t.id}/metrics?group=validation`);
+    const pts = rows.filter(r => typeof (r.metrics || {})[metric] === "number")
+      .map(r => [r.steps_completed || 0, r.metrics[metric]]);
+    if (pts.length) series.push({name: `trial ${t.id}`,
+      color: PALETTE[i % PALETTE.length], points: pts});
+  }
+  el.innerHTML = `<b>${esc(metric)} across trials</b><br>` +
+    (series.length ? multiChart(series) : "(no validation metrics yet)");
+}
+// HP-search visualization (reference parallel-coordinates view): one
+// axis per numeric hyperparameter + the metric; one line per trial,
+// colored by metric rank (best = green)
+function expHpViz(e, el) {
+  const trials = (e.trials || []).filter(t => typeof t.best_validation === "number");
+  if (trials.length < 2) { el.innerHTML = "(need 2+ trials with validations)"; return; }
+  const keys = [...new Set(trials.flatMap(t => Object.keys(t.hparams || {})))]
+    .filter(k => trials.every(t => typeof (t.hparams || {})[k] === "number"))
+    .filter(k => new Set(trials.map(t => t.hparams[k])).size > 1);
+  const axes = [...keys, "best_validation"];
+  if (axes.length < 2) { el.innerHTML = "(no varying numeric hparams)"; return; }
+  const w = 680, h = 220, pad = 40;
+  const ax = i => pad + i * (w - 2 * pad) / (axes.length - 1);
+  const ranges = axes.map(k => {
+    const vs = trials.map(t => k === "best_validation" ? t.best_validation : t.hparams[k]);
+    return [Math.min(...vs), Math.max(...vs)];
+  });
+  const ay = (i, v) => {
+    const [lo, hi] = ranges[i];
+    return h - pad - (v - lo) / ((hi - lo) || 1) * (h - 2 * pad);
+  };
+  const sib = (((e.config || {}).searcher || {}).smaller_is_better) !== false;
+  const vals = trials.map(t => t.best_validation);
+  const vlo = Math.min(...vals), vhi = Math.max(...vals);
+  const goodness = v => (vhi - vlo) < 1e-12 ? 0.5
+    : (sib ? (vhi - v) / (vhi - vlo) : (v - vlo) / (vhi - vlo));
+  const lines = trials.map(t => {
+    const g = goodness(t.best_validation);
+    const hue = Math.round(g * 120);  // 0 red .. 120 green
+    const pts = axes.map((k, i) =>
+      ax(i) + "," + ay(i, k === "best_validation" ? t.best_validation : t.hparams[k])
+    ).join(" ");
+    return `<polyline style="stroke:hsl(${hue},70%,45%);opacity:.8" points="${pts}"/>`;
+  }).join("");
+  const axisMarks = axes.map((k, i) =>
+    `<line x1="${ax(i)}" y1="${pad-6}" x2="${ax(i)}" y2="${h-pad+6}" stroke="#ccc"/>` +
+    `<text x="${ax(i)}" y="${h-8}" text-anchor="middle">${esc(k)}</text>` +
+    `<text x="${ax(i)}" y="${pad-12}" text-anchor="middle">${ranges[i][1].toPrecision(3)}</text>` +
+    `<text x="${ax(i)}" y="${h-pad+18}" text-anchor="middle">${ranges[i][0].toPrecision(3)}</text>`
+  ).join("");
+  el.innerHTML = `<b>hyperparameter search (green = best ${esc(
+    ((e.config || {}).searcher || {}).metric || "metric")})</b><br>` +
+    `<svg class="chart" width="${w}" height="${h}">${axisMarks}${lines}</svg>`;
+}
 async function trialDetail(tid, el) {
   const rows = await api(`/api/v1/trials/${tid}/metrics?group=validation`);
   const series = {};
@@ -200,6 +283,7 @@ async function refresh() {
         return `<tr><td>${Number(t.id)}</td><td>${badge(t.state)}</td>` +
           `<td>${Number(t.restarts)}</td>` +
           `<td>${Math.round((t.progress||0)*100)}%</td>` +
+          `<td>${typeof t.best_validation === "number" ? t.best_validation.toPrecision(4) : ""}</td>` +
           `<td class="hp">${hpline(t.hparams)}</td>` +
           `<td><a href="#" onclick="event.preventDefault();` +
           `trialDetail(${Number(t.id)}, this.closest('details').querySelector('.td'))">metrics</a> ` +
@@ -209,9 +293,15 @@ async function refresh() {
       return `<details><summary>#${Number(e.id)} <b>${esc(e.name)}</b> ${badge(e.state)} ` +
         `${Math.round((e.progress||0)*100)}% — ${esc(e.owner)} ` +
         `<span class="hp">${esc(e.workspace || "")}${e.project ? " / " + esc(e.project) : ""}</span>` +
-        `${actions(e)}</summary>` +
+        `${actions(e)}` +
+        `<button class="mini" onclick="event.stopPropagation();event.preventDefault();` +
+        `expCompare(${Number(e.id)}, this.closest('details').querySelector('.td'))">compare</button>` +
+        `<button class="mini" onclick="event.stopPropagation();event.preventDefault();` +
+        `(async()=>{expHpViz(await api('/api/v1/experiments/${Number(e.id)}'),` +
+        `this.closest('details').querySelector('.td'))})()">hp-viz</button>` +
+        `</summary>` +
         `<table><tr><th>trial</th><th>state</th><th>restarts</th>` +
-        `<th>progress</th><th>hparams</th><th></th></tr>${trials}</table><div class="td"></div></details>`;
+        `<th>progress</th><th>best val</th><th>hparams</th><th></th></tr>${trials}</table><div class="td"></div></details>`;
     }).join("") || "<p>(none)</p>";
     $("queue").innerHTML = table(queue.map(j => ({trial: j.trial_id,
       exp: j.experiment_id, state: badge(j.state), _raw_state: 1,

@@ -1123,6 +1123,23 @@ def test_webui_served_and_uses_live_routes(cluster):
             continue
         assert resp.status_code == 200, f"{p} -> {resp.status_code}"
 
+    # model-dev surfaces are present (hp-search parallel coordinates,
+    # cross-trial metric comparison — reference ExperimentDetails pages)
+    for marker in ("expHpViz", "expCompare", "best_validation", "multiChart"):
+        assert marker in html, f"webui missing {marker}"
+
+
+def test_trial_json_reports_best_validation(cluster):
+    """trial rows carry best/latest validation of the searcher metric
+    (feeds the WebUI hp-viz without per-trial metric fetches)."""
+    exp_id = cluster.submit(exp_config(cluster.ckpt_dir))
+    final = cluster.wait_for_state(exp_id)
+    t = final["trials"][0]
+    assert isinstance(t.get("best_validation"), float), t
+    assert isinstance(t.get("latest_validation"), float), t
+    # smaller_is_better=False for validation_accuracy: best >= latest-ish
+    assert t["best_validation"] >= t["latest_validation"] - 1e-9
+
 
 def test_api_load_p95_under_threshold(cluster):
     """k6-analog API latency suite (reference performance/k6): read-path
@@ -1952,6 +1969,41 @@ def test_full_lifecycle_over_tls(tmp_path):
             assert d.get_experiment(exp_id).state == "COMPLETED"
         finally:
             os.environ.pop("DTPU_MASTER_CERT", None)
+
+        # websocket passthrough works over TLS too: a shell PTY executes
+        # a command through the ENCRYPTED proxy (wss)
+        from determined_tpu.common import ws as wslib
+
+        r = c.http.post(
+            c.url + "/api/v1/tasks",
+            json={"type": "shell", "config": {"shell": "/bin/sh"}},
+        )
+        assert r.status_code == 201, r.text
+        shell_id = r.json()["id"]
+        _wait_task_ready(c, shell_id, timeout=60)
+        ws = wslib.connect(
+            "127.0.0.1",
+            c.port,
+            f"/proxy/{shell_id}/ws",
+            headers={"Authorization": f"Bearer {c.token}"},
+            timeout=30,
+            tls_ca=str(ca),
+        )
+        ws.send_binary(b"echo tls-$((40+2))\n")
+        seen = b""
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline:
+            op, data = ws.recv_message()
+            if op == wslib.OP_CLOSE:
+                break
+            seen += data
+            if b"tls-42" in seen.replace(b"$((40+2))", b""):
+                ok = True
+                break
+        assert ok, f"shell output not seen over TLS: {seen[-400:]!r}"
+        ws.send_binary(b"exit\n")
+        ws.close()
     finally:
         subprocess.run(
             ["pkill", "-9", "-f", "determined_tpu.exec.run_trial"],

@@ -94,7 +94,35 @@ class FakeKubeApiserver:
             def do_GET(self):
                 with server.lock:
                     server.requests.append(("GET", self.path))
-                    job = server.jobs.get(self.path.partition("?")[0].rsplit("/", 1)[-1])
+                path, _, query = self.path.partition("?")
+                # core/v1 pods surface for failure diagnostics: the pods of
+                # a job (terminated reason/exit) and a pod's log tail
+                if path.endswith("/pods") and "labelSelector=job-name%3D" in query:
+                    job_name = query.split("job-name%3D", 1)[1].split("&")[0]
+                    with server.lock:
+                        job = server.jobs.get(job_name)
+                    items = []
+                    if job is not None and job["proc"].poll() not in (None, 0):
+                        items = [{
+                            "metadata": {"name": f"{job_name}-pod"},
+                            "status": {
+                                "phase": "Failed",
+                                "containerStatuses": [{
+                                    "state": {"terminated": {
+                                        "reason": "OOMKilled",
+                                        "exitCode": 137,
+                                        "message": "",
+                                    }}
+                                }],
+                            },
+                        }]
+                    self._reply(200, json.dumps({"items": items}).encode())
+                    return
+                if "/pods/" in path and path.endswith("/log"):
+                    self._reply(200, b"fake pod log tail: container OOMKilled\n")
+                    return
+                with server.lock:
+                    job = server.jobs.get(path.rsplit("/", 1)[-1])
                 if job is None:
                     self._reply(404, b'{"kind":"Status","code":404}')
                     return
@@ -609,3 +637,34 @@ def test_slurm_multinode_gang(tmp_path):
             capture_output=True,
         )
         c.stop()
+
+
+def test_k8s_failure_diagnostics_in_trial_logs(tmp_path):
+    """When a pod dies without self-reporting (OOM-kill class), the master
+    pulls pod termination reasons + a log tail from the apiserver and
+    writes them to the trial log — the `kubectl describe/logs` a human
+    would run (reference kubernetesrm event/informer detail)."""
+    kube = FakeKubeApiserver()
+    c = _k8s_cluster(tmp_path, kube)
+    try:
+        config = exp_config(c.ckpt_dir, max_restarts=0)
+        config["resources"]["resource_pool"] = "k8s"
+        config["searcher"]["max_length"] = {"batches": 5000}  # long-running
+        exp_id = c.submit(config)
+        deadline = time.time() + 60
+        while time.time() < deadline and not kube.jobs:
+            time.sleep(0.2)
+        assert kube.jobs, "job never created"
+        name, job = next(iter(kube.jobs.items()))
+        # pod dies hard; the Job object REMAINS (unlike the node-death
+        # test) so the status poll sees failed:1 and runs diagnostics
+        os.killpg(job["proc"].pid, signal.SIGKILL)
+        exp = c.wait_for_state(exp_id, states=("ERROR",), timeout=60)
+        tid = exp["trials"][0]["id"]
+        logs = c.http.get(f"{c.url}/api/v1/trials/{tid}/logs").json()
+        text = "\n".join(l if isinstance(l, str) else l.get("line", "") for l in logs)
+        assert "OOMKilled" in text, text[-1500:]
+        assert "log tail" in text, text[-1500:]
+    finally:
+        c.stop()
+        kube.stop()
