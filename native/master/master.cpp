@@ -1510,6 +1510,11 @@ class Master {
     op.entrypoint = exp.config["entrypoint"].as_string();
     op.env = env;
     op.slots = exp.slots_per_trial;
+    // k8s pod-spec customization (reference expconf environment.pod_spec,
+    // master/pkg/tasks): experiment-declared overlay merged into the Job's
+    // pod template — nodeSelector, tolerations, volumes, etc.
+    const Json& pod_spec = exp.config["environment"]["pod_spec"];
+    if (pod_spec.is_object()) op.pod_spec = pod_spec;
     ext_ops_.push_back(std::move(op));
     ext_cv_.notify_all();
   }
@@ -1897,22 +1902,8 @@ class Master {
     return it == trials_.end() || exp_visible(user, it->second.experiment_id);
   }
 
-  // recursive dict merge, override wins — the template-application
-  // semantics shared with the Python side (config/experiment.py
-  // merge_configs; reference schemas.Merge)
-  static Json merge_json(const Json& base, const Json& override_) {
-    if (!base.is_object() || !override_.is_object()) return override_;
-    Json out = Json::object();
-    for (const auto& [k, v] : base.items()) out.set(k, v);
-    for (const auto& [k, v] : override_.items()) {
-      if (out.contains(k) && out[k].is_object() && v.is_object()) {
-        out.set(k, merge_json(out[k], v));
-      } else {
-        out.set(k, v);
-      }
-    }
-    return out;
-  }
+  // recursive dict merge lives in rm_detail::merge_json (rm.hpp) — one
+  // implementation for templates, config policies, and pod-spec overlays
 
   // Apply cluster + workspace config policies at submit (reference
   // master/internal/configpolicy/: task_container_defaults + invariant
@@ -1928,10 +1919,10 @@ class Master {
       if (it == config_policies_.end()) continue;
       const Json& pol = it->second;
       if (pol["defaults"].is_object()) {
-        *config = merge_json(pol["defaults"], *config);
+        *config = rm_detail::merge_json(pol["defaults"], *config);
       }
       if (pol["invariants"].is_object()) {
-        *config = merge_json(*config, pol["invariants"]);
+        *config = rm_detail::merge_json(*config, pol["invariants"]);
       }
     }
     for (const auto& scope : scopes) {
@@ -2109,6 +2100,7 @@ class Master {
     std::string entrypoint;  // launch only
     Json env;                // launch only
     int slots = 1;           // launch only
+    Json pod_spec;           // k8s: experiment pod-spec overlay (or null)
   };
 
   // caller holds lk; released around backend I/O
@@ -2165,7 +2157,7 @@ class Master {
                     pool.type + ":" + pool.name + "/r" + std::to_string(rank));
           }
           ok = KubernetesBackend::submit(pool, job_name, op.entrypoint, env,
-                                         slots, &err);
+                                         slots, &err, op.pod_spec);
           if (ok) names.push_back(job_name);
         }
         if (!ok) {
@@ -2802,7 +2794,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       if (tit == m.templates_.end()) {
         return R::error(400, "no such template: " + body["template"].as_string());
       }
-      config = Master::merge_json(tit->second, config);
+      config = rm_detail::merge_json(tit->second, config);
     }
     {
       // config policies: defaults under, invariants over, constraints veto
@@ -3121,7 +3113,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     }
     Json config = src.config;
     if (body.contains("config")) {
-      config = Master::merge_json(config, body["config"]);
+      config = rm_detail::merge_json(config, body["config"]);
     }
     {
       // same submit-time gates as POST /experiments: config policies,

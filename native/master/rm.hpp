@@ -177,6 +177,22 @@ inline std::string expand_pattern(std::string pat, const std::string& job,
   return pat;
 }
 
+// recursive dict merge, override wins (same semantics as the master's
+// template/config-policy merge) — used for pod-spec overlays
+inline Json merge_json(const Json& base, const Json& override_) {
+  if (!base.is_object() || !override_.is_object()) return override_;
+  Json out = Json::object();
+  for (const auto& [k, v] : base.items()) out.set(k, v);
+  for (const auto& [k, v] : override_.items()) {
+    if (out.contains(k) && out[k].is_object() && v.is_object()) {
+      out.set(k, merge_json(out[k], v));
+    } else {
+      out.set(k, v);
+    }
+  }
+  return out;
+}
+
 inline std::string shell_quote(const std::string& s) {
   std::string out = "'";
   for (char c : s) {
@@ -222,7 +238,7 @@ class KubernetesBackend {
   // sets the same).
   static bool submit(const PoolConfig& pool, const std::string& job_name,
                      const std::string& entrypoint, const Json& env, int slots,
-                     std::string* err) {
+                     std::string* err, const Json& pod_spec_overlay = Json()) {
     Json env_list = Json::array();
     for (const auto& [k, v] : env.items()) {
       env_list.push_back(Json::object().set("name", k).set("value", v));
@@ -231,6 +247,21 @@ class KubernetesBackend {
                          .set("name", "trial")
                          .set("image", pool.k8s_image)
                          .set("env", env_list);
+    // container-level customization (volumeMounts, securityContext,
+    // resource requests...): the overlay's FIRST container merges UNDER
+    // the platform's trial container — platform name/image/command/env
+    // win, user mounts survive (reference pod-spec semantics)
+    Json overlay = pod_spec_overlay;
+    if (overlay.is_object() && overlay["containers"].is_array() &&
+        !overlay["containers"].elements().empty()) {
+      container = rm_detail::merge_json(overlay["containers"].elements()[0],
+                                        container);
+      Json cleaned = Json::object();
+      for (const auto& [k, v] : overlay.items()) {
+        if (k != "containers") cleaned.set(k, v);
+      }
+      overlay = cleaned;
+    }
     Json cmd = Json::array();
     for (const std::string& c :
          {std::string("python"), std::string("-m"),
@@ -248,6 +279,13 @@ class KubernetesBackend {
     Json containers = Json::array();
     containers.push_back(container);
     pod_spec.set("containers", containers);
+    if (overlay.is_object()) {
+      // pod-level overlay (environment.pod_spec): nodeSelector,
+      // tolerations, serviceAccountName, volumes...  The platform's
+      // containers/restartPolicy win on conflict — the overlay merges
+      // UNDER them so a user cannot unhook the trial container
+      pod_spec = rm_detail::merge_json(overlay, pod_spec);
+    }
     Json job = Json::object()
                    .set("apiVersion", "batch/v1")
                    .set("kind", "Job")

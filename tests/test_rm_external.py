@@ -668,3 +668,53 @@ def test_k8s_failure_diagnostics_in_trial_logs(tmp_path):
     finally:
         c.stop()
         kube.stop()
+
+
+def test_k8s_pod_spec_overlay(tmp_path):
+    """expconf environment.pod_spec merges into the submitted Job's pod
+    template (reference master/pkg/tasks pod-spec customization) — with
+    the platform's containers/restartPolicy winning on conflict."""
+    kube = FakeKubeApiserver()
+    c = _k8s_cluster(tmp_path, kube)
+    try:
+        config = exp_config(c.ckpt_dir)
+        config["resources"]["resource_pool"] = "k8s"
+        config["environment"]["pod_spec"] = {
+            "nodeSelector": {"cloud.google.com/gke-tpu-topology": "2x2"},
+            "tolerations": [{"key": "tpu", "operator": "Exists"}],
+            "restartPolicy": "Always",  # must NOT override the platform's
+            "volumes": [{"name": "scratch", "emptyDir": {}}],
+            "containers": [{
+                "volumeMounts": [{"name": "scratch", "mountPath": "/scratch"}],
+                "command": ["evil"],  # must NOT override the platform's
+            }],
+        }
+        exp_id = c.submit(config)
+        # capture the manifest while the Job is LIVE: the master DELETEs
+        # completed jobs, so reading after COMPLETED races the cleanup
+        deadline = time.time() + 60
+        manifest = None
+        while time.time() < deadline and manifest is None:
+            with kube.lock:
+                if kube.jobs:
+                    manifest = next(iter(kube.jobs.values()))["manifest"]
+            time.sleep(0.2)
+        assert manifest is not None, "job never created"
+        assert c.wait_for_state(exp_id, timeout=180)["state"] == "COMPLETED"
+        spec = manifest["spec"]["template"]["spec"]
+        assert spec["nodeSelector"] == {
+            "cloud.google.com/gke-tpu-topology": "2x2"
+        }
+        assert spec["tolerations"] == [{"key": "tpu", "operator": "Exists"}]
+        assert spec["restartPolicy"] == "Never", "platform fields must win"
+        assert spec["volumes"] == [{"name": "scratch", "emptyDir": {}}]
+        (trial_container,) = spec["containers"]
+        # container-level merge: user mounts survive, platform command wins
+        assert trial_container["volumeMounts"] == [
+            {"name": "scratch", "mountPath": "/scratch"}
+        ]
+        assert trial_container["command"][0] != "evil"
+        assert trial_container["name"] == "trial"
+    finally:
+        c.stop()
+        kube.stop()
