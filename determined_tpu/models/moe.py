@@ -96,6 +96,12 @@ class MoE(nn.Module):
     group_size: int = 4096
     dtype: Any = jnp.bfloat16
     partition: bool = True  # False under manual-SPMD pipeline stages
+    # Manual-SPMD expert parallelism (inside pipeline-stage shard_map):
+    # expert weights arrive sharded over this axis (only e/n local experts
+    # per device); routing/gating stays replicated, each device computes
+    # the FFN for ITS experts against the full token set, and the combine
+    # is a psum over the axis — the intra-stage expert "all-to-all".
+    expert_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -136,6 +142,16 @@ class MoE(nn.Module):
         )(gates)
         aux = aux.mean()
 
+        # under manual SPMD the params hold only this device's experts
+        e_param = e
+        my_expert0 = None
+        if self.expert_axis_name is not None:
+            n_exp = jax.lax.axis_size(self.expert_axis_name)
+            if e % n_exp:
+                raise ValueError(f"num_experts={e} not divisible by axis {n_exp}")
+            e_param = e // n_exp
+            my_expert0 = jax.lax.axis_index(self.expert_axis_name) * e_param
+
         def expert_param(name, shape, logical):
             return self.param(
                 name,
@@ -146,9 +162,15 @@ class MoE(nn.Module):
                 jnp.float32,
             )
 
-        w_in = expert_param("w_in", (e, d, self.d_ff), ("expert", "embed", "mlp"))
-        w_gate = expert_param("w_gate", (e, d, self.d_ff), ("expert", "embed", "mlp"))
-        w_out = expert_param("w_out", (e, self.d_ff, d), ("expert", "mlp", "embed"))
+        w_in = expert_param("w_in", (e_param, d, self.d_ff), ("expert", "embed", "mlp"))
+        w_gate = expert_param("w_gate", (e_param, d, self.d_ff), ("expert", "embed", "mlp"))
+        w_out = expert_param("w_out", (e_param, self.d_ff, d), ("expert", "mlp", "embed"))
+
+        if my_expert0 is not None:
+            # keep only the dispatch/combine slices for MY experts; the
+            # cross-device combine is the psum below
+            dispatch = jax.lax.dynamic_slice_in_dim(dispatch, my_expert0, e_param, axis=2)
+            combine = jax.lax.dynamic_slice_in_dim(combine, my_expert0, e_param, axis=2)
 
         cd = self.dtype
         # dispatch: [n,g,e,c] x [n,g,d] -> [n,e,c,d]; under an
@@ -162,5 +184,7 @@ class MoE(nn.Module):
         h = nn.silu(gate) * h
         expert_out = jnp.einsum("necf,efd->necd", h, w_out.astype(cd))
         y = jnp.einsum("ngec,necd->ngd", combine.astype(cd), expert_out)
+        if self.expert_axis_name is not None:
+            y = jax.lax.psum(y, self.expert_axis_name)
         y = y.reshape(n_groups * grp, d)[:g]
         return y.reshape(b, s, d), aux.astype(jnp.float32)

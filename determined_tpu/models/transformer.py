@@ -56,6 +56,12 @@ class TransformerConfig:
     # are meaningless (and invalid) inside shard_map, where placement is
     # explicit
     partition_params: bool = True
+    # Manual-SPMD axis names, set ONLY inside pipeline stages (shard_map):
+    # seq_axis_name routes attention through ring_attention_local over that
+    # axis (with globally-offset rope positions); expert_axis_name makes
+    # MoE blocks run local-expert compute + psum-combine over that axis.
+    seq_axis_name: Optional[str] = None
+    expert_axis_name: Optional[str] = None
 
     def __post_init__(self):
         if self.moe_experts > 0 and self.moe_every < 1:
@@ -137,6 +143,10 @@ class Attention(nn.Module):
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
 
         positions = jnp.arange(s)
+        if cfg.seq_axis_name is not None:
+            # manual SPMD inside a pipeline stage: s is the LOCAL shard
+            # length; rope positions are global (contiguous assignment)
+            positions = positions + jax.lax.axis_index(cfg.seq_axis_name) * s
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
@@ -149,7 +159,15 @@ class Attention(nn.Module):
                 and self.mesh.shape.get(MeshAxes.SEQUENCE, 1) > 1
             )
         )
-        if use_ring:
+        if cfg.seq_axis_name is not None:
+            # already inside shard_map over the seq axis: run the ring on
+            # local shards (zigzag-balanced for causal)
+            from determined_tpu.ops.ring_attention import ring_attention_local
+
+            out = ring_attention_local(
+                q, k, v, axis_name=cfg.seq_axis_name, causal=True
+            )
+        elif use_ring:
             if self.mesh is None:
                 raise ValueError("ring attention requires the mesh")
             out = ring_attention(q, k, v, self.mesh, causal=True)
@@ -216,6 +234,7 @@ class Block(nn.Module):
                 capacity_factor=self.cfg.moe_capacity_factor,
                 dtype=self.cfg.dtype,
                 partition=self.cfg.partition_params,
+                expert_axis_name=self.cfg.expert_axis_name,
                 name="moe",
             )(RMSNorm(partition=self.cfg.partition_params, name="ln2")(x))
             x = x + y
@@ -291,11 +310,15 @@ def split_pipeline_params(boxed_params: Any, n_stages: int) -> Dict[str, Any]:
     """Restructure a plain ``TransformerLM`` param tree for pipeline stages.
 
     Input: the tree from ``TransformerLM.init`` (possibly flax-``Partitioned``
-    boxed).  Output: ``{"outer": <embed/ln_f/lm_head, boxes kept>,
-    "blocks": <stacked [P, layers_per_stage, ...], unboxed>}``.  Because the
-    stacked leaves are built from the SAME initialized values as the flat
-    ``block_i`` subtrees, a pipe>1 trial initializes identically to pipe=1 —
-    the basis of the loss-parity tests.
+    boxed).  Output: ``{"outer": <embed/ln_f/lm_head, boxes kept>, "blocks":
+    {"layer_j": <layer j of every stage stacked on a leading [P, ...] dim>}}``
+    for j in [0, layers_per_stage) — the per-layer dict (instead of an extra
+    stacked lps dim) lets DENSE and MOE layers coexist in one stage: layer j
+    must have the same param structure across stages (requiring the MoE
+    period to divide layers-per-stage), but different j's may differ.
+    Because the stacked leaves are built from the SAME initialized values as
+    the flat ``block_i`` subtrees, a pipe>1 trial initializes identically to
+    pipe=1 — the basis of the loss-parity tests.
     """
     from flax.core import meta as flax_meta
 
@@ -310,11 +333,16 @@ def split_pipeline_params(boxed_params: Any, n_stages: int) -> Dict[str, Any]:
         )
     lps = n_layers // n_stages
     blocks = [flax_meta.unbox(tree.pop(k)) for k in block_keys]
-    stages = [
-        jax.tree.map(lambda *ls: jnp.stack(ls), *blocks[s * lps : (s + 1) * lps])
-        for s in range(n_stages)
-    ]
-    stacked = jax.tree.map(lambda *ss: jnp.stack(ss), *stages)
+    stacked = {}
+    for j in range(lps):
+        layer_j = [blocks[s * lps + j] for s in range(n_stages)]
+        structures = {jax.tree.structure(t) for t in layer_j}
+        if len(structures) > 1:
+            raise ValueError(
+                f"layer {j} differs in structure across pipeline stages "
+                "(is the MoE period a divisor of layers-per-stage?)"
+            )
+        stacked[f"layer_{j}"] = jax.tree.map(lambda *ls: jnp.stack(ls), *layer_j)
     outer = {"params": tree}
     extra = {k: v for k, v in boxed_params.items() if k != "params"}
     if extra:
@@ -330,25 +358,37 @@ def pipeline_forward(
     num_microbatches: int,
     return_hidden: bool = False,
     rules: Any = None,
-) -> jax.Array:
+    return_aux: bool = False,
+) -> Any:
     """Forward pass with the transformer blocks pipelined over ``pipe``.
 
     ``params`` is the ``split_pipeline_params`` layout.  Embed / final norm /
     lm_head run as ordinary SPMD computation outside the pipeline (sharded by
     their logical annotations); only the block stack rides the GPipe schedule
     (``parallel/pipeline.py``).  Stage block params are sharded over ``pipe``
-    and replicated over data/fsdp inside the schedule's ``shard_map``; the
-    batch stays sharded over data/fsdp (pipeline composes with DP/FSDP on the
-    batch — FSDP sharding of block *params* does not compose yet).
+    (expert weights additionally over ``expert``) inside the schedule's
+    ``shard_map``; the batch stays sharded over data/fsdp and the sequence
+    over ``seq`` — ring attention runs inside each stage over the seq axis,
+    and MoE combine psums over the expert axis intra-stage.  (FSDP sharding
+    of block *params* does not compose yet.)  The reference's DeepSpeed grid
+    composes PP only with DP/TP (``deepspeed/_mpu.py:9-50``).
     """
     from flax.core import meta as flax_meta
 
     from determined_tpu.parallel.pipeline import pipeline_apply
 
-    if mesh is not None and mesh.shape.get(MeshAxes.SEQUENCE, 1) > 1:
-        raise ValueError("pipeline parallelism does not compose with the seq axis yet")
     outer = flax_meta.unbox(params["outer"])["params"]
     blocks = params["blocks"]
+    lps = len(blocks)
+    layer_keys = [f"layer_{j}" for j in range(lps)]
+    has_moe = [isinstance(blocks[k], dict) and "moe" in blocks[k] for k in layer_keys]
+
+    seq_n = mesh.shape.get(MeshAxes.SEQUENCE, 1) if mesh is not None else 1
+    exp_n = mesh.shape.get(MeshAxes.EXPERT, 1) if mesh is not None else 1
+    if exp_n > 1 and any(has_moe) and cfg.moe_experts % exp_n:
+        raise ValueError(
+            f"moe_experts={cfg.moe_experts} not divisible by expert axis {exp_n}"
+        )
 
     emb = nn.Embed(
         cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32
@@ -360,29 +400,42 @@ def pipeline_forward(
         cfg,
         partition_params=False,
         attention_impl="auto" if cfg.attention_impl == "ring" else cfg.attention_impl,
+        seq_axis_name=MeshAxes.SEQUENCE if seq_n > 1 else None,
+        expert_axis_name=MeshAxes.EXPERT if exp_n > 1 else None,
     )
-    blk = Block(stage_cfg)
-    lps = jax.tree.leaves(blocks)[0].shape[1]
 
-    def block_step(p, h):
-        return blk.apply({"params": p}, h)[0]
+    def make_block_step(use_moe: bool):
+        blk = Block(stage_cfg, use_moe=use_moe)
 
-    if cfg.remat:
-        block_step = jax.checkpoint(block_step, prevent_cse=False)
+        def block_step(p, h):
+            return blk.apply({"params": p}, h)
+
+        if cfg.remat:
+            block_step = jax.checkpoint(block_step, prevent_cse=False)
+        return block_step
+
+    steps = [make_block_step(m) for m in has_moe]
+    want_aux = any(has_moe)
 
     def stage_fn(stage_params, h):
-        for l in range(lps):
-            h = block_step(jax.tree.map(lambda a: a[l], stage_params), h)
-        return h
+        aux = jnp.zeros((), jnp.float32)
+        for j, key in enumerate(layer_keys):
+            h, a = steps[j](stage_params[key], h)
+            aux = aux + a
+        return (h, aux) if want_aux else h
 
-    x = pipeline_apply(stage_fn, blocks, x, mesh, num_microbatches)
+    out = pipeline_apply(
+        stage_fn, blocks, x, mesh, num_microbatches, with_aux=want_aux
+    )
+    x, aux = out if want_aux else (out, jnp.zeros((), jnp.float32))
     x = RMSNorm(partition=False).apply({"params": outer["ln_f"]}, x)
     if return_hidden:
-        return x
+        return (x, aux) if return_aux else x
     head = nn.Dense(
         cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32
     )
-    return head.apply({"params": outer["lm_head"]}, x).astype(jnp.float32)
+    logits = head.apply({"params": outer["lm_head"]}, x).astype(jnp.float32)
+    return (logits, aux) if return_aux else logits
 
 
 class LMTrial(JaxTrial):
@@ -415,8 +468,16 @@ class LMTrial(JaxTrial):
 
     def _cfg(self) -> TransformerConfig:
         g = self.context.get_hparam
-        if self._pipe_stages() > 1 and int(g("moe_experts", 0)) > 0:
-            raise ValueError("MoE blocks do not compose with pipeline stages yet")
+        pipe = self._pipe_stages()
+        if pipe > 1 and int(g("moe_experts", 0)) > 0:
+            # MoE composes with pipe when every stage sees the same layer
+            # pattern: the MoE period must divide layers-per-stage
+            lps = int(g("n_layers", 2)) // pipe
+            if lps == 0 or lps % int(g("moe_every", 2)):
+                raise ValueError(
+                    f"pipe={pipe} with MoE needs moe_every ({g('moe_every', 2)}) "
+                    f"to divide layers-per-stage ({lps})"
+                )
         return TransformerConfig(
             vocab_size=int(g("vocab_size", 2048)),
             d_model=int(g("d_model", 256)),
@@ -518,9 +579,14 @@ class LMTrial(JaxTrial):
         outer = _specs_from_flax_metadata(params["outer"])
         if outer is None:
             outer = jax.tree.map(lambda _: None, flax_meta.unbox(params["outer"]))
-        blocks = jax.tree.map(
-            lambda a: ("stage",) + (None,) * (a.ndim - 1), params["blocks"]
-        )
+        from determined_tpu.parallel.pipeline import _path_has_expert_leaf
+
+        def block_spec(path, a):
+            if _path_has_expert_leaf(path):
+                return ("stage", "expert") + (None,) * (a.ndim - 2)
+            return ("stage",) + (None,) * (a.ndim - 1)
+
+        blocks = jax.tree_util.tree_map_with_path(block_spec, params["blocks"])
         return {"outer": outer, "blocks": blocks}
 
     def loss(
@@ -579,9 +645,9 @@ class LMTrial(JaxTrial):
 
             from determined_tpu.ops.cross_entropy import fused_cross_entropy
 
-            hidden = pipeline_forward(
+            hidden, moe_aux = pipeline_forward(
                 model.cfg, self.context.mesh, params, inputs, mb,
-                return_hidden=True, rules=self.context.rules,
+                return_hidden=True, rules=self.context.rules, return_aux=True,
             )
             kernel = flax_meta.unbox(params["outer"]["params"]["lm_head"]["kernel"])
             chunk = g("ce_chunk", None)
@@ -596,12 +662,16 @@ class LMTrial(JaxTrial):
                 bf16_residual=bool(g("ce_bf16_residual", False)),
             )
         else:
-            logits = pipeline_forward(
+            logits, moe_aux = pipeline_forward(
                 model.cfg, self.context.mesh, params, inputs, mb,
-                rules=self.context.rules,
+                rules=self.context.rules, return_aux=True,
             )
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
-        return loss, {"perplexity": jnp.exp(loss)}
+        metrics = {"perplexity": jnp.exp(loss)}
+        if model.cfg.moe_experts > 0:
+            metrics["moe_aux_loss"] = moe_aux
+            loss = loss + model.cfg.moe_aux_weight * moe_aux
+        return loss, metrics
 
     def evaluate_batch(
         self, model: TransformerLM, params: Any, batch: Dict[str, jax.Array]

@@ -13,15 +13,24 @@ ticks (the GPipe bubble); reverse-mode AD differentiates straight through
 the scan + ppermute (its transpose is the reverse rotation), so the same
 function trains.
 
-Composition: the batch dim may simultaneously be sharded over data/fsdp
-axes — specs below only partition ``pipe``; other mesh axes pass through
-untouched (activations replicate across them exactly as in the non-pipelined
-model).
+Composition — the pipe axis composes with every other mesh axis (the
+"one mesh subsumes the zoo" design claim, SURVEY §7):
+- **data/fsdp**: microbatch rows stay sharded over the batch axes inside
+  the schedule (specs below partition both pipe and batch);
+- **seq**: the sequence dim of activations stays sharded over the seq
+  axis; ring attention runs INSIDE each stage's blocks (the ring is over
+  seq shards, orthogonal to the stage rotation over pipe) — see
+  ``models/transformer.py`` ``seq_axis_name``;
+- **expert**: MoE expert weights are sharded over the expert axis WITHIN
+  each stage (``expert_leaf_paths``), and the expert combine is a psum
+  over the expert axis inside the stage — the all-to-all never crosses a
+  stage boundary.  The reference's DeepSpeed grid composes PP only with
+  DP/TP (``deepspeed/_mpu.py:9-50``); seq and expert composition is net-new.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,22 +38,40 @@ from jax.sharding import PartitionSpec as P
 
 from determined_tpu.parallel.mesh import MeshAxes
 
+# MoE expert-weight param names: leading dim (after the stage stack) is the
+# expert dim, shardable over the expert mesh axis.
+_EXPERT_PARAM_NAMES = frozenset({"w_in", "w_gate", "w_out"})
+
+
+def _path_has_expert_leaf(path) -> bool:
+    keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
+    return any(k == "moe" for k in keys) and keys[-1] in _EXPERT_PARAM_NAMES
+
 
 def pipeline_apply(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, jax.Array], Any],
     stacked_params: Any,
     x: jax.Array,
     mesh,
     num_microbatches: int,
-) -> jax.Array:
+    with_aux: bool = False,
+) -> Any:
     """Run ``stage_fn`` across the mesh's ``pipe`` stages.
 
     - ``stacked_params``: pytree whose leaves have leading dim P (one slice
       per stage), placed with the leading dim sharded over ``pipe``;
+      MoE expert-weight leaves (``.../moe/w_*``) are additionally sharded
+      over the expert axis on their dim 1;
     - ``x``: ``[batch, ...]`` global input; batch must divide into
-      ``num_microbatches``;
-    - returns ``[batch, ...]`` outputs, as if the stages were applied
-      sequentially to each microbatch.
+      ``num_microbatches``; when the mesh has a seq axis, dim 1 of ``x``
+      is the (sharded) sequence dim;
+    - ``with_aux``: ``stage_fn`` returns ``(y, aux_scalar)``; the schedule
+      accumulates aux over VALID ticks only (warm-up/drain garbage is
+      gated out) and returns ``(out, aux)`` with aux averaged over
+      microbatches and summed over stages — matching the unpipelined
+      per-layer aux sum;
+    - returns ``[batch, ...]`` outputs (plus aux), as if the stages were
+      applied sequentially to each microbatch.
     """
     n_stages = mesh.shape.get(MeshAxes.PIPELINE, 1)
     if n_stages == 1:
@@ -67,7 +94,16 @@ def pipeline_apply(
     except AttributeError:  # pragma: no cover
         from jax.experimental.shard_map import shard_map  # type: ignore
 
-    pspec = jax.tree.map(lambda _: P(MeshAxes.PIPELINE), stacked_params)
+    expert_ax = (
+        MeshAxes.EXPERT if mesh.shape.get(MeshAxes.EXPERT, 1) > 1 else None
+    )
+
+    def leaf_spec(path, leaf):
+        if expert_ax is not None and _path_has_expert_leaf(path):
+            return P(MeshAxes.PIPELINE, expert_ax)
+        return P(MeshAxes.PIPELINE)
+
+    pspec = jax.tree_util.tree_map_with_path(leaf_spec, stacked_params)
     # microbatch rows shard over the batch axes present in the mesh, so
     # data/fsdp parallelism composes through the pipeline instead of being
     # silently all-gathered away by a replicated in_spec; microbatches too
@@ -77,7 +113,16 @@ def pipeline_apply(
     )
     if mb % bshards:
         batch_axes = ()
-    xspec = P(None, batch_axes or None, *([None] * (x.ndim - 1)))
+    # seq axis: dim 1 of the original x (dim 2 of xm) stays sharded — ring
+    # attention inside the stage works on the local shard
+    seq_ax = (
+        MeshAxes.SEQUENCE
+        if (x.ndim >= 2 and mesh.shape.get(MeshAxes.SEQUENCE, 1) > 1)
+        else None
+    )
+    xspec = P(None, batch_axes or None, seq_ax, *([None] * (x.ndim - 2)))
+
+    fn = stage_fn if with_aux else (lambda p, h: (stage_fn(p, h), jnp.zeros((), jnp.float32)))
 
     def per_device(params, xm_local):
         # params leaves: [1, ...] (my stage); xm_local: [M, mb, ...]
@@ -89,9 +134,10 @@ def pipeline_apply(
 
         zero = jnp.zeros_like(xm_local[0])
         outputs = jnp.zeros_like(xm_local)
+        aux0 = jnp.zeros((), jnp.float32)
 
         def tick(carry, t):
-            state_in, outs = carry
+            state_in, outs, aux_sum = carry
             # stage 0 ingests microbatch t while it exists; later stages
             # consume the rotated activation from the previous tick
             fresh = jax.lax.dynamic_index_in_dim(
@@ -99,7 +145,12 @@ def pipeline_apply(
             )
             use_fresh = jnp.logical_and(p == 0, t < m)
             x_in = jnp.where(use_fresh, fresh, state_in)
-            y = stage_fn(my, x_in)
+            y, aux = fn(my, x_in)
+            # stage p processes microbatch t - p at tick t; outside [0, m)
+            # the input is warm-up/drain garbage — gate its aux out
+            mb_idx = t - p
+            work_valid = jnp.logical_and(mb_idx >= 0, mb_idx < m)
+            aux_sum = aux_sum + jnp.where(work_valid, aux, 0.0)
             # last stage emits microbatch t - (n - 1)
             out_idx = t - (n - 1)
             prev = jax.lax.dynamic_index_in_dim(
@@ -115,21 +166,31 @@ def pipeline_apply(
             state_out = jax.lax.ppermute(
                 y, MeshAxes.PIPELINE, [(i, (i + 1) % n) for i in range(n)]
             )
-            return (state_out, outs), None
+            return (state_out, outs, aux_sum), None
 
-        (_, outputs), _ = jax.lax.scan(tick, (zero, outputs), jnp.arange(ticks))
+        (_, outputs, aux_sum), _ = jax.lax.scan(
+            tick, (zero, outputs, aux0), jnp.arange(ticks)
+        )
         # outputs accumulated on the last stage only (zeros elsewhere):
         # psum replicates the final result across the pipe axis
-        return jax.lax.psum(outputs, MeshAxes.PIPELINE)
+        out = jax.lax.psum(outputs, MeshAxes.PIPELINE)
+        # aux: sum over stages (≡ the unpipelined per-layer sum), averaged
+        # over microbatches and over the batch/seq shards each aux saw
+        aux = jax.lax.psum(aux_sum, MeshAxes.PIPELINE) / m
+        norm_axes = tuple(a for a in (*batch_axes, seq_ax) if a)
+        if norm_axes:
+            aux = jax.lax.pmean(aux, norm_axes)
+        return out, aux
 
-    out = shard_map(
+    out, aux = shard_map(
         per_device,
         mesh=mesh,
         in_specs=(pspec, xspec),
-        out_specs=xspec,
+        out_specs=(xspec, P()),
         check_vma=False,
     )(stacked_params, xm)
-    return out.reshape(batch, *x.shape[1:])
+    out = out.reshape(batch, *x.shape[1:])
+    return (out, aux) if with_aux else out
 
 
 def stack_stage_params(param_list) -> Any:
