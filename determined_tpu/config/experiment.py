@@ -215,6 +215,11 @@ class OptimizationsConfig:
 
     aggregation_frequency: int = 1
     average_aggregated_gradients: bool = True
+    # Overlapped checkpointing (on by default — a beat-the-reference item,
+    # SURVEY §7(b)): array serialization runs on a background thread while
+    # training continues; the collective finalize lands at the next save,
+    # preemption, or exit.  False restores fully synchronous saves.
+    async_checkpointing: bool = True
 
     def __post_init__(self):
         if self.aggregation_frequency < 1:

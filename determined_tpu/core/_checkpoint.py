@@ -250,7 +250,10 @@ class CheckpointContext:
             finally:
                 if self._dist.is_local_chief:
                     shutil.rmtree(path, ignore_errors=True)
-        gathered = self._dist.gather((resources, digests, dict(metadata or {})))
+        self._merge_and_finalize(storage_id, resources, digests, dict(metadata or {}))
+
+    def _merge_and_finalize(self, storage_id, resources, digests, metadata) -> None:
+        gathered = self._dist.gather((resources, digests, metadata))
         if self._dist.is_chief:
             assert gathered is not None
             # With a true shared fs all ranks report overlapping dir trees;
@@ -259,6 +262,82 @@ class CheckpointContext:
             merged_md = merge_metadata([g[2] for g in gathered])
             self._finalize(storage_id, merged, merged_md)
         self._dist.barrier()
+
+    def store_path_async(
+        self, metadata: Optional[Dict[str, Any]] = None, *, shard: bool = False
+    ):
+        """Overlapped-checkpointing variant of ``store_path``: returns
+        ``(path, storage_id, finish)``.
+
+        The caller may write into ``path`` from a BACKGROUND thread while
+        training continues; once the writes are done, ``finish()`` must be
+        called from the MAIN thread at a point where every rank reaches it
+        in the same loop position (the next save, preemption, or exit) — it
+        runs the same collective merge/upload/report as ``store_path``'s
+        exit.  Keeping the control-plane collectives on the main thread at
+        deterministic points is what makes overlap safe: background threads
+        never touch the distributed context, so an in-flight save can never
+        interleave with a preemption broadcast.  SURVEY §7(b) names async
+        checkpointing as a hard part of the TPU build; the reference blocks
+        through serialize+upload (``core/_checkpoint.py`` ``_upload_sharded``).
+        """
+        metadata = dict(metadata or {})
+        if not shard:
+            if not self._dist.is_chief:
+                raise RuntimeError("store_path(shard=False) must only be called on the chief")
+            storage_id = str(uuid_mod.uuid4())
+            cm = self._storage.store_path(storage_id, self._staging_dir)
+            path = cm.__enter__()
+
+            def finish() -> None:
+                try:
+                    resources = list_directory(path)
+                finally:
+                    cm.__exit__(None, None, None)
+                self._finalize(storage_id, resources, metadata)
+
+            return path, storage_id, finish
+
+        storage_id = self._dist.broadcast(
+            str(uuid_mod.uuid4()) if self._dist.is_chief else None
+        )
+        if self._storage.direct_store:
+            cm = self._storage.store_path(storage_id, self._staging_dir)
+            path = cm.__enter__()
+
+            def finish() -> None:
+                try:
+                    self._dist.barrier()
+                    resources, digests = (
+                        self._list_and_digest(path)
+                        if self._dist.is_local_chief
+                        else ({}, {})
+                    )
+                finally:
+                    cm.__exit__(None, None, None)
+                self._merge_and_finalize(storage_id, resources, digests, metadata)
+
+            return path, storage_id, finish
+
+        path = self._storage.stage_path(storage_id, self._staging_dir)
+
+        def finish() -> None:
+            try:
+                self._dist.barrier()
+                resources, digests = (
+                    self._list_and_digest(path)
+                    if self._dist.is_local_chief
+                    else ({}, {})
+                )
+                if self._dist.is_local_chief:
+                    self._storage.upload(path, storage_id)
+                self._dist.barrier()
+            finally:
+                if self._dist.is_local_chief:
+                    shutil.rmtree(path, ignore_errors=True)
+            self._merge_and_finalize(storage_id, resources, digests, metadata)
+
+        return path, storage_id, finish
 
     def _list_and_digest(self, path: str):
         # Called by local chiefs only: every rank on a host shares the

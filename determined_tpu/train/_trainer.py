@@ -17,9 +17,11 @@ redesigned for XLA:
 
 from __future__ import annotations
 
+import dataclasses
 import logging
+import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable as TCallable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +43,19 @@ from determined_tpu.train._trial import Callback, JaxTrial, TrialContext
 from determined_tpu.train import serialization
 
 logger = logging.getLogger("determined_tpu.train")
+
+
+@dataclasses.dataclass
+class _PendingSave:
+    """An in-flight background checkpoint: the writer thread serializes the
+    on-device snapshot; ``finish`` (collective merge/upload/report) runs on
+    the main thread at the next drain point."""
+
+    thread: threading.Thread
+    finish: TCallable[[], None]
+    storage_id: str
+    step: int
+    errors: list
 
 
 def init(
@@ -165,6 +180,8 @@ class Trainer:
         self._searcher_metric: Optional[str] = None
         self._smaller_is_better = True
         self.agg = 1  # aggregation_frequency, set from exp config in _setup
+        self._pending_save: Optional[_PendingSave] = None
+        self._snapshot_jit: Any = None
 
     # -- setup -------------------------------------------------------------
 
@@ -370,7 +387,48 @@ class Trainer:
 
     # -- checkpoint --------------------------------------------------------
 
-    def _save_checkpoint(self) -> str:
+    def _async_checkpointing(self) -> bool:
+        opt = self.context.exp_config.optimizations if self.context.exp_config else None
+        return opt.async_checkpointing if opt is not None else True
+
+    def _snapshot_arrays(self, tree: Any) -> Any:
+        """On-device copy of the array state.  The train step donates its
+        input state (``donate_argnums=0``), so the buffers a background
+        writer reads would be invalidated by the NEXT step — the copy
+        (one HBM pass, ~ms) decouples them."""
+
+        def copy_one(x):
+            if jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+                return jax.random.wrap_key_data(
+                    jnp.copy(jax.random.key_data(x)), impl=jax.random.key_impl(x)
+                )
+            return jnp.copy(x)
+
+        if self._snapshot_jit is None:
+            self._snapshot_jit = jax.jit(lambda t: jax.tree.map(copy_one, t))
+        return self._snapshot_jit(tree)
+
+    def _drain_pending_save(self) -> Optional[str]:
+        """Wait for the in-flight background save (if any) and run its
+        collective finalize.  Must be called from the main thread at a
+        point every rank reaches identically (next save / preempt / exit)."""
+        p = self._pending_save
+        if p is None:
+            return None
+        self._pending_save = None
+        p.thread.join()
+        if p.errors:
+            raise RuntimeError(
+                f"async checkpoint {p.storage_id} failed"
+            ) from p.errors[0]
+        p.finish()
+        for cb in self.callbacks.values():
+            cb.on_checkpoint_write_end(p.storage_id)
+        logger.info("checkpoint %s at step %d", p.storage_id, p.step)
+        return p.storage_id
+
+    def _save_checkpoint(self, asynchronous: bool = True) -> str:
+        self._drain_pending_save()  # at most one save in flight
         dist = self.core.distributed
         shard = dist.size > 1
         array_state = {
@@ -395,15 +453,42 @@ class Trainer:
             "steps_completed": self.steps_completed,
             "framework": "determined_tpu",
         }
-        with self.core.checkpoint.store_path(metadata, shard=shard) as (path, sid):
+        if not (asynchronous and self._async_checkpointing()):
+            with self.core.checkpoint.store_path(metadata, shard=shard) as (path, sid):
+                for cb in self.callbacks.values():
+                    cb.on_checkpoint_write_start(path)
+                serialization.save_arrays(path, array_state)
+                if dist.is_chief:
+                    serialization.save_trainer_state(path, trainer_state)
             for cb in self.callbacks.values():
-                cb.on_checkpoint_write_start(path)
-            serialization.save_arrays(path, array_state)
-            if dist.is_chief:
-                serialization.save_trainer_state(path, trainer_state)
+                cb.on_checkpoint_write_end(sid)
+            logger.info("checkpoint %s at step %d", sid, self.steps_completed)
+            return sid
+
+        # overlapped save: snapshot on device, serialize on a background
+        # thread, collective finalize at the next drain point (SURVEY §7(b))
+        path, sid, finish = self.core.checkpoint.store_path_async(metadata, shard=shard)
         for cb in self.callbacks.values():
-            cb.on_checkpoint_write_end(sid)
-        logger.info("checkpoint %s at step %d", sid, self.steps_completed)
+            cb.on_checkpoint_write_start(path)
+        snapshot = self._snapshot_arrays(array_state)
+        is_chief = dist.is_chief
+        errors: list = []
+
+        def work() -> None:
+            try:
+                serialization.save_arrays(path, snapshot)
+                if is_chief:
+                    serialization.save_trainer_state(path, trainer_state)
+            except BaseException as e:  # surfaced at the drain point
+                errors.append(e)
+
+        thread = threading.Thread(target=work, name="dtpu-ckpt-writer", daemon=True)
+        thread.start()
+        self._pending_save = _PendingSave(
+            thread=thread, finish=finish, storage_id=sid,
+            step=self.steps_completed, errors=errors,
+        )
+        logger.info("async checkpoint %s started at step %d", sid, self.steps_completed)
         return sid
 
     def _restore_checkpoint(self, storage_id: str) -> None:
@@ -616,15 +701,31 @@ class Trainer:
             if preempted:
                 want_ckpt = True
             if want_ckpt:
-                last_ckpt_sid = self._save_checkpoint()
+                pending = self._pending_save
+                if (
+                    preempted
+                    and pending is not None
+                    and pending.step == self.steps_completed
+                    and not pending.errors
+                ):
+                    # a save of this exact step is already in flight:
+                    # wait for it instead of writing a duplicate
+                    last_ckpt_sid = self._drain_pending_save()
+                else:
+                    # on preemption the save must be durable before exit,
+                    # so skip the overlap and write synchronously
+                    last_ckpt_sid = self._save_checkpoint(asynchronous=not preempted)
             if preempted:
                 logger.info("preempted at step %d; exiting cleanly", self.steps_completed)
                 stopped_early = True
                 break
 
+        # a save still in flight must land before we exit or report completion
+        self._drain_pending_save()
+
         # final: always leave at least one checkpoint unless policy is none
         if checkpoint_policy != "none" and last_ckpt_sid is None:
-            last_ckpt_sid = self._save_checkpoint()
+            last_ckpt_sid = self._save_checkpoint(asynchronous=False)
 
         for cb in self.callbacks.values():
             cb.on_trial_shutdown()

@@ -146,8 +146,8 @@ def test_checkpoint_policy_best_only_saves_improvements(tmp_path):
     saves = []
     orig = trainer._save_checkpoint
 
-    def counting_save():
-        sid = orig()
+    def counting_save(asynchronous=True):
+        sid = orig(asynchronous=asynchronous)
         saves.append(trainer.steps_completed)
         return sid
 
