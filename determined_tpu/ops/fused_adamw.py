@@ -39,7 +39,24 @@ import optax
 # must fit the 16 MiB scoped-VMEM budget; 1 MiB blocks measured 16.84M > 16M
 # on v5e (OOM), 768 KiB measured fastest of {512K, 768K}.
 _BLOCK_BYTES = 768 * 1024
-_MIN_PALLAS_SIZE = 1 << 18  # leaves below this take the jnp path
+
+
+def _min_pallas_size() -> int:
+    """Leaves below this ride the jnp path (one big XLA fusion, near-zero
+    launch overhead); leaves above it get their own Pallas sweep.
+
+    The r4 xplane accounting measured ~120 us of fixed per-call overhead x
+    34 sweeps ≈ 4 ms/step — most of the fused kernel's saved HBM pass.
+    The in-kernel bandwidth edge of Pallas over a well-fused XLA update is
+    small (80-86% vs ~80% of roofline), so mid-size leaves are better off
+    batched into XLA's fusion; only leaves whose sweep time dwarfs the
+    launch overhead (the 67M embed/lm_head at ~2.4 ms each) keep their own
+    call.  32M default = 2 Pallas calls on the flagship LM (was 34);
+    measured sweep in BASELINE.md r5.  DTPU_FUSED_MIN_SIZE overrides.
+    """
+    import os
+
+    return int(os.environ.get("DTPU_FUSED_MIN_SIZE", 32 * 1024 * 1024))
 
 
 class FusedAdamWState(NamedTuple):
@@ -180,9 +197,11 @@ class FusedAdamW:
         scalars = self._scalars(state.count, grads)
         kw = dict(b1=self.b1, b2=self.b2, eps=self.eps, wd=self.weight_decay)
 
+        min_size = _min_pallas_size()
+
         def leaf(p, m, v, g):
             if (
-                p.size >= _MIN_PALLAS_SIZE
+                p.size >= min_size
                 and p.dtype == jnp.float32
                 and p.ndim >= 2
                 and _plan_blocks(p.shape) is not None

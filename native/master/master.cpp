@@ -177,6 +177,32 @@ struct WorkspaceState {
   bool archived = false;
   int64_t created_ms = 0;
   std::map<std::string, std::string> bindings;  // user -> viewer|user|admin
+  // role bindings on GROUPS (reference master/internal/usergroup/
+  // api_groups.go): members inherit the group's workspace role
+  std::map<std::string, std::string> group_bindings;  // group -> role
+};
+
+// First-class project under a workspace (reference
+// master/internal/api_project.go:801 PostProject + project/): the
+// workspace→project→experiment hierarchy with CRUD, archival (an archived
+// project refuses new experiments), notes, and move-experiment.  RBAC
+// scope is inherited from the owning workspace.
+struct ProjectState {
+  std::string name;
+  std::string workspace;
+  std::string description;
+  std::string owner;
+  bool archived = false;
+  int64_t created_ms = 0;
+  Json notes = Json::array();  // [{name, contents}] (reference project notes)
+};
+
+// User group (reference master/internal/usergroup/api_groups.go,
+// AddUsersToGroupsTx): membership + group role bindings make onboarding a
+// team onto N workspaces N calls instead of N×M.
+struct GroupState {
+  std::string name;
+  std::set<std::string> members;
 };
 
 // outbound webhook (reference master/internal/webhooks/): fires on
@@ -599,12 +625,59 @@ class Master {
       auto it = workspaces_.find(ev["name"].as_string());
       if (it != workspaces_.end()) {
         const std::string role = ev["role"].as_string();
+        auto& target = ev["group"].is_string() && !ev["group"].as_string().empty()
+                           ? it->second.group_bindings
+                           : it->second.bindings;
+        const std::string key = ev["group"].is_string() && !ev["group"].as_string().empty()
+                                    ? ev["group"].as_string()
+                                    : ev["username"].as_string();
         if (role.empty() || role == "none") {
-          it->second.bindings.erase(ev["username"].as_string());
+          target.erase(key);
         } else {
-          it->second.bindings[ev["username"].as_string()] = role;
+          target[key] = role;
         }
       }
+    } else if (type == "project_created") {
+      ProjectState p;
+      p.name = ev["name"].as_string();
+      p.workspace = ev["workspace"].as_string();
+      p.description = ev["description"].as_string();
+      p.owner = ev["owner"].as_string();
+      p.created_ms = ev["ts"].as_int(0);
+      projects_[project_key(p.workspace, p.name)] = p;
+    } else if (type == "project_archived") {
+      auto it = projects_.find(
+          project_key(ev["workspace"].as_string(), ev["name"].as_string()));
+      if (it != projects_.end()) it->second.archived = ev["archived"].as_bool(true);
+    } else if (type == "project_patched") {
+      auto it = projects_.find(
+          project_key(ev["workspace"].as_string(), ev["name"].as_string()));
+      if (it != projects_.end()) {
+        if (ev["description"].is_string()) it->second.description = ev["description"].as_string();
+        if (ev["notes"].is_array()) it->second.notes = ev["notes"];
+      }
+    } else if (type == "project_deleted") {
+      projects_.erase(
+          project_key(ev["workspace"].as_string(), ev["name"].as_string()));
+    } else if (type == "experiment_moved") {
+      auto it = experiments_.find(ev["id"].as_int());
+      if (it != experiments_.end()) {
+        it->second.config.set("workspace", ev["workspace"].as_string());
+        it->second.config.set("project", ev["project"].as_string());
+      }
+    } else if (type == "group_created") {
+      GroupState g;
+      g.name = ev["name"].as_string();
+      groups_[g.name] = g;
+    } else if (type == "group_deleted") {
+      groups_.erase(ev["name"].as_string());
+      for (auto& [wname, w] : workspaces_) w.group_bindings.erase(ev["name"].as_string());
+    } else if (type == "group_member_added") {
+      auto it = groups_.find(ev["name"].as_string());
+      if (it != groups_.end()) it->second.members.insert(ev["username"].as_string());
+    } else if (type == "group_member_removed") {
+      auto it = groups_.find(ev["name"].as_string());
+      if (it != groups_.end()) it->second.members.erase(ev["username"].as_string());
     } else if (type == "model_created") {
       models_[ev["name"].as_string()] = ev["model"];
     } else if (type == "model_version") {
@@ -736,13 +809,35 @@ class Master {
     for (const auto& [name, w] : workspaces_) {
       Json b = Json::object();
       for (const auto& [u, r] : w.bindings) b.set(u, r);
+      Json gb = Json::object();
+      for (const auto& [g, r] : w.group_bindings) gb.set(g, r);
       wss.set(name, Json::object()
                         .set("owner", w.owner)
                         .set("archived", Json(w.archived))
                         .set("created_ms", Json(w.created_ms))
-                        .set("bindings", b));
+                        .set("bindings", b)
+                        .set("group_bindings", gb));
     }
     snap.set("workspace_entities", wss);
+    Json pjs = Json::object();
+    for (const auto& [key, p] : projects_) {
+      pjs.set(key, Json::object()
+                       .set("name", p.name)
+                       .set("workspace", p.workspace)
+                       .set("description", p.description)
+                       .set("owner", p.owner)
+                       .set("archived", Json(p.archived))
+                       .set("created_ms", Json(p.created_ms))
+                       .set("notes", p.notes));
+    }
+    snap.set("project_entities", pjs);
+    Json grps = Json::object();
+    for (const auto& [name, g] : groups_) {
+      Json members = Json::array();
+      for (const auto& u : g.members) members.push_back(u);
+      grps.set(name, Json::object().set("members", members));
+    }
+    snap.set("group_entities", grps);
     Json checkpoints = Json::object();
     for (const auto& [uuid, c] : checkpoints_) checkpoints.set(uuid, c);
     snap.set("checkpoints", checkpoints);
@@ -849,7 +944,31 @@ class Master {
         for (const auto& [u, r] : wj["bindings"].items()) {
           w.bindings[u] = r.as_string();
         }
+        for (const auto& [g, r] : wj["group_bindings"].items()) {
+          w.group_bindings[g] = r.as_string();
+        }
         workspaces_[name] = w;
+      }
+    }
+    if (s.contains("project_entities")) {
+      for (const auto& [key, pj] : s["project_entities"].items()) {
+        ProjectState p;
+        p.name = pj["name"].as_string();
+        p.workspace = pj["workspace"].as_string();
+        p.description = pj["description"].as_string();
+        p.owner = pj["owner"].as_string();
+        p.archived = pj["archived"].as_bool(false);
+        p.created_ms = pj["created_ms"].as_int(0);
+        if (pj["notes"].is_array()) p.notes = pj["notes"];
+        projects_[key] = p;
+      }
+    }
+    if (s.contains("group_entities")) {
+      for (const auto& [name, gj] : s["group_entities"].items()) {
+        GroupState g;
+        g.name = name;
+        for (const auto& u : gj["members"].elements()) g.members.insert(u.as_string());
+        groups_[name] = g;
       }
     }
     for (const auto& [uuid, c] : s["checkpoints"].items()) checkpoints_[uuid] = c;
@@ -1867,22 +1986,73 @@ class Master {
     return config[key].is_string() ? config[key].as_string() : fallback;
   }
 
-  // Workspace-scoped RBAC (reference master/internal/rbac/ +
-  // usergroup/, collapsed to per-user bindings): cluster admins see all;
-  // a workspace WITH bindings restricts access to its owner + bound
-  // users (binding "viewer" = read-only there); a workspace without
-  // bindings — including tag-only workspaces — stays open under the
-  // global roles.  Caller holds mu_.
+  // Workspace-scoped RBAC (reference master/internal/rbac/ + usergroup/):
+  // cluster admins see all; a workspace WITH bindings (user or group)
+  // restricts access to its owner + bound principals (role "viewer" =
+  // read-only there); a workspace without bindings — including tag-only
+  // workspaces — stays open under the global roles.  Caller holds mu_.
+
+  static int role_rank(const std::string& role) {
+    if (role == "admin") return 3;
+    if (role == "user") return 2;
+    if (role == "viewer") return 1;
+    return 0;
+  }
+
+  // Effective role of `user` in `w`: the strongest of their direct binding
+  // and the bindings of every group they belong to.  "" = unbound.
+  std::string binding_role_of(const std::string& user,
+                              const WorkspaceState& w) const {
+    std::string best;
+    auto bit = w.bindings.find(user);
+    if (bit != w.bindings.end()) best = bit->second;
+    for (const auto& [gname, role] : w.group_bindings) {
+      auto git = groups_.find(gname);
+      if (git == groups_.end() || !git->second.members.count(user)) continue;
+      if (role_rank(role) > role_rank(best)) best = role;
+    }
+    return best;
+  }
+
   bool workspace_allows(const std::string& user, const std::string& ws,
                         bool write) const {
     auto uit = users_.find(user);
     if (uit != users_.end() && uit->second.admin) return true;
     auto wit = workspaces_.find(ws);
-    if (wit == workspaces_.end() || wit->second.bindings.empty()) return true;
+    if (wit == workspaces_.end() ||
+        (wit->second.bindings.empty() && wit->second.group_bindings.empty())) {
+      return true;
+    }
     if (user == wit->second.owner) return true;
-    auto bit = wit->second.bindings.find(user);
-    if (bit == wit->second.bindings.end()) return false;
-    return !write || bit->second != "viewer";
+    std::string role = binding_role_of(user, wit->second);
+    if (role.empty()) return false;
+    return !write || role != "viewer";
+  }
+
+  static std::string project_key(const std::string& ws, const std::string& pj) {
+    return ws + "/" + pj;
+  }
+
+  // Submit-time organization gates shared by create and fork/continue:
+  // workspace write access + workspace/project archival (reference
+  // api_project.go: archived projects refuse new experiments).  Returns
+  // (http_status, message) or (0, "") when clear.  Caller holds mu_.
+  std::pair<int, std::string> submit_org_gate(const Json& config,
+                                              const std::string& user) const {
+    std::string ws = config_str(config, "workspace", "Uncategorized");
+    if (!workspace_allows(user, ws, true)) {
+      return {403, "no access to workspace " + ws};
+    }
+    auto wit = workspaces_.find(ws);
+    if (wit != workspaces_.end() && wit->second.archived) {
+      return {409, "workspace " + ws + " is archived"};
+    }
+    std::string pj = config_str(config, "project", "Uncategorized");
+    auto pit = projects_.find(project_key(ws, pj));
+    if (pit != projects_.end() && pit->second.archived) {
+      return {409, "project " + pj + " is archived"};
+    }
+    return {0, ""};
   }
 
   bool exp_allows(const std::string& user, const ExperimentState& e,
@@ -2509,6 +2679,10 @@ class Master {
   std::map<std::string, Json> config_policies_;
   // first-class workspaces (reference api_project.go + rbac/)
   std::map<std::string, WorkspaceState> workspaces_;
+  // projects keyed "workspace/name" (reference api_project.go + project/)
+  std::map<std::string, ProjectState> projects_;
+  // user groups (reference usergroup/api_groups.go)
+  std::map<std::string, GroupState> groups_;
   std::map<int64_t, WebhookState> webhooks_;
   int64_t next_webhook_id_ = 1;
   std::map<std::string, GenericTaskState> tasks_;
@@ -2801,15 +2975,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       std::lock_guard<std::mutex> lk(m.mu_);
       std::string pol_err = m.apply_config_policies(&config);
       if (!pol_err.empty()) return R::error(400, pol_err);
-      // workspace RBAC + archival (reference rbac + api_project archive)
-      std::string ws = Master::config_str(config, "workspace", "Uncategorized");
-      if (!m.workspace_allows(m.authenticate(req), ws, true)) {
-        return R::error(403, "no access to workspace " + ws);
-      }
-      auto wit = m.workspaces_.find(ws);
-      if (wit != m.workspaces_.end() && wit->second.archived) {
-        return R::error(409, "workspace " + ws + " is archived");
-      }
+      // workspace RBAC + workspace/project archival (reference rbac +
+      // api_project archive: archived scopes refuse new experiments)
+      auto [code, msg] = m.submit_org_gate(config, m.authenticate(req));
+      if (code) return R::error(code, msg);
     }
     if (!config.contains("checkpoint_storage")) {
       std::lock_guard<std::mutex> lk(m.mu_);
@@ -2914,6 +3083,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     }
     // registered entities appear even when empty
     for (const auto& [name, w] : m.workspaces_) tree[name];
+    for (const auto& [key, p] : m.projects_) tree[p.workspace][p.name];
     Json out = Json::array();
     for (const auto& [ws, projects] : tree) {
       if (!m.workspace_allows(viewer, ws, false)) continue;
@@ -2922,9 +3092,16 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       Json ps = Json::array();
       int total = 0;
       for (const auto& [pj, n] : projects) {
-        ps.push_back(Json::object()
+        Json pnode = Json::object()
                          .set("name", pj)
-                         .set("experiments", Json(static_cast<int64_t>(n))));
+                         .set("experiments", Json(static_cast<int64_t>(n)));
+        auto pit = m.projects_.find(Master::project_key(ws, pj));
+        if (pit != m.projects_.end()) {
+          pnode.set("registered", Json(true));
+          pnode.set("archived", Json(pit->second.archived));
+          pnode.set("owner", pit->second.owner);
+        }
+        ps.push_back(pnode);
         total += n;
       }
       w.set("projects", ps);
@@ -2937,6 +3114,9 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         Json b = Json::object();
         for (const auto& [u, r] : wit->second.bindings) b.set(u, r);
         w.set("roles", b);
+        Json gb = Json::object();
+        for (const auto& [g, r] : wit->second.group_bindings) gb.set(g, r);
+        w.set("group_roles", gb);
       } else {
         w.set("registered", Json(false));
       }
@@ -2975,8 +3155,8 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     std::string user = m.authenticate(req);
     auto uit = m.users_.find(user);
     bool cluster_admin = uit != m.users_.end() && uit->second.admin;
-    auto bit = it->second.bindings.find(user);
-    bool ws_admin = bit != it->second.bindings.end() && bit->second == "admin";
+    // group-granted admin counts (reference usergroup role bindings)
+    bool ws_admin = m.binding_role_of(user, it->second) == "admin";
     if (!cluster_admin && user != it->second.owner && !ws_admin) {
       return "workspace administration requires owner/admin";
     }
@@ -3008,27 +3188,38 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     const std::string username = body["username"].as_string();
+    const std::string group = body["group"].as_string();
     const std::string role = body["role"].as_string();
-    if (username.empty() ||
+    if ((username.empty() == group.empty()) ||
         (role != "viewer" && role != "user" && role != "admin" && role != "none")) {
-      return R::error(400, "need username + role in {viewer,user,admin,none}");
+      return R::error(400,
+                      "need exactly one of username/group + role in {viewer,user,admin,none}");
     }
     std::lock_guard<std::mutex> lk(m.mu_);
     WorkspaceState* w = nullptr;
     std::string err = ws_admin_guard(req, &w);
     if (!err.empty()) return R::error(err == "no such workspace" ? 404 : 403, err);
-    if (!m.users_.count(username)) return R::error(404, "no such user");
+    if (!username.empty() && !m.users_.count(username)) return R::error(404, "no such user");
+    if (!group.empty() && !m.groups_.count(group)) return R::error(404, "no such group");
+    auto& target = group.empty() ? w->bindings : w->group_bindings;
+    const std::string& key = group.empty() ? username : group;
     if (role == "none") {
-      w->bindings.erase(username);
+      target.erase(key);
     } else {
-      w->bindings[username] = role;
+      target[key] = role;
     }
     m.record(Json::object()
                  .set("type", "workspace_role_set")
                  .set("name", w->name)
                  .set("username", username)
+                 .set("group", group)
                  .set("role", role));
-    return R::json(Json::object().set("name", w->name).set("username", username).set("role", role).dump());
+    return R::json(Json::object()
+                       .set("name", w->name)
+                       .set("username", username)
+                       .set("group", group)
+                       .set("role", role)
+                       .dump());
   }));
 
   srv.route("DELETE", "/api/v1/workspaces/{name}", authed([&m, ws_admin_guard](const HttpRequest& req) {
@@ -3042,8 +3233,280 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       }
     }
     std::string name = w->name;
+    for (const auto& [key, p] : m.projects_) {
+      if (p.workspace == name) return R::error(409, "workspace has projects");
+    }
     m.workspaces_.erase(name);
     m.record(Json::object().set("type", "workspace_deleted").set("name", name));
+    return R::json("{}");
+  }));
+
+  // ---- first-class projects (reference api_project.go:801 PostProject +
+  // project/: CRUD, archive, move-experiment, notes; RBAC scope inherited
+  // from the owning workspace) ----
+  srv.route("POST", "/api/v1/workspaces/{name}/projects", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    if (!body["name"].is_string() || body["name"].as_string().empty()) {
+      return R::error(400, "project name required");
+    }
+    std::lock_guard<std::mutex> lk(m.mu_);
+    const std::string ws = req.params.at("name");
+    auto wit = m.workspaces_.find(ws);
+    if (wit == m.workspaces_.end()) return R::error(404, "no such workspace");
+    std::string user = m.authenticate(req);
+    if (!m.workspace_allows(user, ws, true)) {
+      return R::error(403, "no write access to workspace " + ws);
+    }
+    if (wit->second.archived) return R::error(409, "workspace " + ws + " is archived");
+    const std::string name = body["name"].as_string();
+    if (m.projects_.count(Master::project_key(ws, name))) {
+      return R::error(409, "project exists");
+    }
+    ProjectState p;
+    p.name = name;
+    p.workspace = ws;
+    p.description = body["description"].as_string();
+    p.owner = user;
+    p.created_ms = now_ms();
+    m.projects_[Master::project_key(ws, name)] = p;
+    m.record(Json::object()
+                 .set("type", "project_created")
+                 .set("name", name)
+                 .set("workspace", ws)
+                 .set("description", p.description)
+                 .set("owner", user)
+                 .set("ts", Json(p.created_ms)));
+    return R::json(Json::object()
+                       .set("name", name)
+                       .set("workspace", ws)
+                       .set("owner", user)
+                       .dump(),
+                   201);
+  }));
+
+  srv.route("GET", "/api/v1/workspaces/{name}/projects", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    const std::string ws = req.params.at("name");
+    if (!m.workspace_allows(m.authenticate(req), ws, false)) {
+      return R::error(404, "no such workspace");
+    }
+    std::map<std::string, int> counts;
+    for (const auto& [id, e] : m.experiments_) {
+      if (Master::config_str(e.config, "workspace", "Uncategorized") != ws) continue;
+      counts[Master::config_str(e.config, "project", "Uncategorized")]++;
+    }
+    Json out = Json::array();
+    for (const auto& [key, p] : m.projects_) {
+      if (p.workspace != ws) continue;
+      out.push_back(Json::object()
+                        .set("name", p.name)
+                        .set("workspace", ws)
+                        .set("description", p.description)
+                        .set("owner", p.owner)
+                        .set("archived", Json(p.archived))
+                        .set("notes", p.notes)
+                        .set("experiments",
+                             Json(static_cast<int64_t>(counts[p.name]))));
+    }
+    return R::json(out.dump());
+  }));
+
+  // project mutation guard: workspace write access + project exists
+  auto project_guard = [&m](const HttpRequest& req, ProjectState** out) -> std::pair<int, std::string> {
+    // caller holds mu_
+    const std::string ws = req.params.at("ws");
+    auto it = m.projects_.find(Master::project_key(ws, req.params.at("name")));
+    if (it == m.projects_.end()) return {404, "no such project"};
+    if (!m.workspace_allows(m.authenticate(req), ws, true)) {
+      return {403, "no write access to workspace " + ws};
+    }
+    *out = &it->second;
+    return {0, ""};
+  };
+
+  srv.route("POST", "/api/v1/projects/{ws}/{name}/archive", authed([&m, project_guard](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    ProjectState* p = nullptr;
+    auto [code, msg] = project_guard(req, &p);
+    if (code) return R::error(code, msg);
+    p->archived = true;
+    m.record(Json::object()
+                 .set("type", "project_archived")
+                 .set("name", p->name)
+                 .set("workspace", p->workspace)
+                 .set("archived", Json(true)));
+    return R::json(Json::object().set("name", p->name).set("archived", Json(true)).dump());
+  }));
+
+  srv.route("POST", "/api/v1/projects/{ws}/{name}/unarchive", authed([&m, project_guard](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    ProjectState* p = nullptr;
+    auto [code, msg] = project_guard(req, &p);
+    if (code) return R::error(code, msg);
+    p->archived = false;
+    m.record(Json::object()
+                 .set("type", "project_archived")
+                 .set("name", p->name)
+                 .set("workspace", p->workspace)
+                 .set("archived", Json(false)));
+    return R::json(Json::object().set("name", p->name).set("archived", Json(false)).dump());
+  }));
+
+  srv.route("PATCH", "/api/v1/projects/{ws}/{name}", authed([&m, project_guard](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    ProjectState* p = nullptr;
+    auto [code, msg] = project_guard(req, &p);
+    if (code) return R::error(code, msg);
+    if (body["description"].is_string()) p->description = body["description"].as_string();
+    if (body["notes"].is_array()) p->notes = body["notes"];
+    m.record(Json::object()
+                 .set("type", "project_patched")
+                 .set("name", p->name)
+                 .set("workspace", p->workspace)
+                 .set("description", p->description)
+                 .set("notes", p->notes));
+    return R::json(Json::object()
+                       .set("name", p->name)
+                       .set("description", p->description)
+                       .set("notes", p->notes)
+                       .dump());
+  }));
+
+  srv.route("DELETE", "/api/v1/projects/{ws}/{name}", authed([&m, project_guard](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    ProjectState* p = nullptr;
+    auto [code, msg] = project_guard(req, &p);
+    if (code) return R::error(code, msg);
+    for (const auto& [id, e] : m.experiments_) {
+      if (Master::config_str(e.config, "workspace", "Uncategorized") == p->workspace &&
+          Master::config_str(e.config, "project", "Uncategorized") == p->name) {
+        return R::error(409, "project is not empty");
+      }
+    }
+    std::string ws = p->workspace, name = p->name;
+    m.projects_.erase(Master::project_key(ws, name));
+    m.record(Json::object()
+                 .set("type", "project_deleted")
+                 .set("name", name)
+                 .set("workspace", ws));
+    return R::json("{}");
+  }));
+
+  // move an experiment between workspace/project scopes (reference
+  // api_project.go MoveExperiment): write access on BOTH scopes; the
+  // destination must not be archived
+  srv.route("POST", "/api/v1/experiments/{id}/move", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.experiments_.find(std::stoll(req.params.at("id")));
+    if (it == m.experiments_.end()) return R::error(404, "no such experiment");
+    std::string user = m.authenticate(req);
+    if (!m.exp_allows(user, it->second, false)) return R::error(404, "no such experiment");
+    if (!m.exp_allows(user, it->second, true)) return R::error(403, "no write access to experiment");
+    std::string dst_ws = body["workspace"].is_string()
+                             ? body["workspace"].as_string()
+                             : Master::config_str(it->second.config, "workspace", "Uncategorized");
+    std::string dst_pj = body["project"].is_string()
+                             ? body["project"].as_string()
+                             : "Uncategorized";
+    Json probe = Json::object().set("workspace", dst_ws).set("project", dst_pj);
+    auto [code, msg] = m.submit_org_gate(probe, user);
+    if (code) return R::error(code, msg);
+    it->second.config.set("workspace", dst_ws);
+    it->second.config.set("project", dst_pj);
+    m.record(Json::object()
+                 .set("type", "experiment_moved")
+                 .set("id", Json(it->second.id))
+                 .set("workspace", dst_ws)
+                 .set("project", dst_pj));
+    return R::json(Json::object()
+                       .set("id", Json(it->second.id))
+                       .set("workspace", dst_ws)
+                       .set("project", dst_pj)
+                       .dump());
+  }));
+
+  // ---- user groups (reference usergroup/api_groups.go) ----
+  auto is_cluster_admin = [&m](const HttpRequest& req) -> bool {
+    // caller holds mu_
+    auto uit = m.users_.find(m.authenticate(req));
+    return uit != m.users_.end() && uit->second.admin;
+  };
+
+  srv.route("POST", "/api/v1/groups", authed([&m, is_cluster_admin](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    if (!body["name"].is_string() || body["name"].as_string().empty()) {
+      return R::error(400, "group name required");
+    }
+    std::lock_guard<std::mutex> lk(m.mu_);
+    if (!is_cluster_admin(req)) return R::error(403, "group administration requires admin");
+    const std::string name = body["name"].as_string();
+    if (m.groups_.count(name)) return R::error(409, "group exists");
+    GroupState g;
+    g.name = name;
+    m.groups_[name] = g;
+    m.record(Json::object().set("type", "group_created").set("name", name));
+    return R::json(Json::object().set("name", name).dump(), 201);
+  }));
+
+  srv.route("GET", "/api/v1/groups", authed([&m](const HttpRequest& req) {
+    (void)req;
+    std::lock_guard<std::mutex> lk(m.mu_);
+    Json out = Json::array();
+    for (const auto& [name, g] : m.groups_) {
+      Json members = Json::array();
+      for (const auto& u : g.members) members.push_back(u);
+      out.push_back(Json::object().set("name", name).set("members", members));
+    }
+    return R::json(out.dump());
+  }));
+
+  srv.route("DELETE", "/api/v1/groups/{name}", authed([&m, is_cluster_admin](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    if (!is_cluster_admin(req)) return R::error(403, "group administration requires admin");
+    auto it = m.groups_.find(req.params.at("name"));
+    if (it == m.groups_.end()) return R::error(404, "no such group");
+    std::string name = it->first;
+    m.groups_.erase(it);
+    // deleting a group revokes every role it granted
+    for (auto& [wname, w] : m.workspaces_) w.group_bindings.erase(name);
+    m.record(Json::object().set("type", "group_deleted").set("name", name));
+    return R::json("{}");
+  }));
+
+  srv.route("POST", "/api/v1/groups/{name}/members", authed([&m, is_cluster_admin](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    const std::string username = body["username"].as_string();
+    if (username.empty()) return R::error(400, "username required");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    if (!is_cluster_admin(req)) return R::error(403, "group administration requires admin");
+    auto it = m.groups_.find(req.params.at("name"));
+    if (it == m.groups_.end()) return R::error(404, "no such group");
+    if (!m.users_.count(username)) return R::error(404, "no such user");
+    it->second.members.insert(username);
+    m.record(Json::object()
+                 .set("type", "group_member_added")
+                 .set("name", it->first)
+                 .set("username", username));
+    return R::json(Json::object().set("name", it->first).set("username", username).dump());
+  }));
+
+  srv.route("DELETE", "/api/v1/groups/{name}/members/{username}", authed([&m, is_cluster_admin](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    if (!is_cluster_admin(req)) return R::error(403, "group administration requires admin");
+    auto it = m.groups_.find(req.params.at("name"));
+    if (it == m.groups_.end()) return R::error(404, "no such group");
+    it->second.members.erase(req.params.at("username"));
+    m.record(Json::object()
+                 .set("type", "group_member_removed")
+                 .set("name", it->first)
+                 .set("username", req.params.at("username")));
     return R::json("{}");
   }));
 
@@ -3123,16 +3586,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         cleanup_tmp();
         return R::error(400, pol_err);
       }
-      std::string user = m.authenticate(req);
-      std::string ws = Master::config_str(config, "workspace", "Uncategorized");
-      if (!m.workspace_allows(user, ws, true)) {
+      auto [code, msg] = m.submit_org_gate(config, m.authenticate(req));
+      if (code) {
         cleanup_tmp();
-        return R::error(403, "no access to workspace " + ws);
-      }
-      auto wit = m.workspaces_.find(ws);
-      if (wit != m.workspaces_.end() && wit->second.archived) {
-        cleanup_tmp();
-        return R::error(409, "workspace " + ws + " is archived");
+        return R::error(code, msg);
       }
     }
     std::string cfg_err = Master::validate_config(config);

@@ -1880,6 +1880,200 @@ def test_workspace_rbac_scoping(cluster, tmp_path):
     assert kept["keep"]["roles"] == {"bob": "viewer"}
 
 
+def test_projects_first_class(cluster):
+    """The workspace→project→experiment hierarchy as real entities
+    (reference api_project.go:801 PostProject + project/): CRUD, archive
+    refusing new submissions, move-experiment, notes, tree view, restart
+    survival.  Judge order r4#2."""
+    url = cluster.url
+    # workspace + two projects
+    assert cluster.http.post(url + "/api/v1/workspaces", json={"name": "research"}).status_code == 201
+    r = cluster.http.post(
+        url + "/api/v1/workspaces/research/projects",
+        json={"name": "vision", "description": "vision models"},
+    )
+    assert r.status_code == 201, r.text
+    assert cluster.http.post(
+        url + "/api/v1/workspaces/research/projects", json={"name": "nlp"}
+    ).status_code == 201
+    # duplicate refused; unknown workspace refused
+    assert cluster.http.post(
+        url + "/api/v1/workspaces/research/projects", json={"name": "vision"}
+    ).status_code == 409
+    assert cluster.http.post(
+        url + "/api/v1/workspaces/nope/projects", json={"name": "x"}
+    ).status_code == 404
+
+    # submit into research/vision
+    cfg = exp_config(cluster.ckpt_dir)
+    cfg["workspace"] = "research"
+    cfg["project"] = "vision"
+    r = cluster.http.post(url + "/api/v1/experiments", json={"config": cfg})
+    assert r.status_code == 201, r.text
+    exp_id = r.json()["id"]
+
+    # list shows counts; registered-but-empty projects appear in the tree
+    projects = {
+        p["name"]: p
+        for p in cluster.http.get(url + "/api/v1/workspaces/research/projects").json()
+    }
+    assert projects["vision"]["experiments"] == 1
+    assert projects["nlp"]["experiments"] == 0
+    tree = {w["name"]: w for w in cluster.http.get(url + "/api/v1/workspaces").json()}
+    tree_projects = {p["name"]: p for p in tree["research"]["projects"]}
+    assert tree_projects["vision"]["registered"] and tree_projects["nlp"]["registered"]
+
+    # move the experiment to research/nlp
+    r = cluster.http.post(
+        f"{url}/api/v1/experiments/{exp_id}/move",
+        json={"workspace": "research", "project": "nlp"},
+    )
+    assert r.status_code == 200, r.text
+    projects = {
+        p["name"]: p
+        for p in cluster.http.get(url + "/api/v1/workspaces/research/projects").json()
+    }
+    assert projects["vision"]["experiments"] == 0
+    assert projects["nlp"]["experiments"] == 1
+    exp = cluster.http.get(f"{url}/api/v1/experiments/{exp_id}").json()
+    assert exp["project"] == "nlp"
+
+    # archived project refuses new submissions AND incoming moves
+    assert cluster.http.post(
+        url + "/api/v1/projects/research/vision/archive"
+    ).status_code == 200
+    r = cluster.http.post(
+        url + "/api/v1/experiments",
+        json={"config": {**cfg, "project": "vision"}},
+    )
+    assert r.status_code == 409 and "archived" in r.text, r.text
+    r = cluster.http.post(
+        f"{url}/api/v1/experiments/{exp_id}/move",
+        json={"workspace": "research", "project": "vision"},
+    )
+    assert r.status_code == 409, r.text
+    assert cluster.http.post(
+        url + "/api/v1/projects/research/vision/unarchive"
+    ).status_code == 200
+
+    # notes/description patch
+    r = cluster.http.patch(
+        url + "/api/v1/projects/research/vision",
+        json={"notes": [{"name": "readme", "contents": "weekly sync notes"}]},
+    )
+    assert r.status_code == 200, r.text
+    projects = {
+        p["name"]: p
+        for p in cluster.http.get(url + "/api/v1/workspaces/research/projects").json()
+    }
+    assert projects["vision"]["notes"][0]["name"] == "readme"
+
+    # deletion refused while non-empty; workspace deletion refused while
+    # it has projects
+    assert cluster.http.delete(url + "/api/v1/projects/research/nlp").status_code == 409
+    assert cluster.http.delete(url + "/api/v1/workspaces/research").status_code == 409
+    cluster.wait_for_state(exp_id)
+    cluster.http.delete(f"{url}/api/v1/experiments/{exp_id}")
+    assert cluster.http.delete(url + "/api/v1/projects/research/nlp").status_code == 200
+
+    # restart survival (journaled entities)
+    cluster.procs["master"].send_signal(signal.SIGKILL)
+    cluster.procs["master"].wait(timeout=10)
+    cluster.start_master()
+    projects = {
+        p["name"]: p
+        for p in cluster.http.get(url + "/api/v1/workspaces/research/projects").json()
+    }
+    assert set(projects) == {"vision"}
+    assert projects["vision"]["notes"][0]["name"] == "readme"
+
+
+def test_user_groups_inherit_workspace_roles(cluster, tmp_path):
+    """Group role bindings (reference usergroup/api_groups.go,
+    AddUsersToGroupsTx): binding a role to a group grants it to every
+    member; removing membership (or the group) revokes it.  Judge order
+    r4#2."""
+    import requests as _rq
+
+    url = cluster.url
+
+    def login(u, p):
+        s = _rq.Session()
+        tok = s.post(url + "/api/v1/auth/login", json={"username": u, "password": p}).json()["token"]
+        s.headers.update({"Authorization": f"Bearer {tok}"})
+        return s
+
+    for u in ("carol", "dave"):
+        cluster.http.post(
+            url + "/api/v1/users", json={"username": u, "password": "x", "role": "user"}
+        )
+    carol, dave = login("carol", "x"), login("dave", "x")
+
+    # group administration is admin-only
+    assert carol.post(url + "/api/v1/groups", json={"name": "team"}).status_code == 403
+    assert cluster.http.post(url + "/api/v1/groups", json={"name": "team"}).status_code == 201
+    r = cluster.http.post(url + "/api/v1/groups/team/members", json={"username": "carol"})
+    assert r.status_code == 200, r.text
+    groups = {g["name"]: g for g in cluster.http.get(url + "/api/v1/groups").json()}
+    assert groups["team"]["members"] == ["carol"]
+
+    # restricted workspace whose only binding is the GROUP
+    cluster.http.post(url + "/api/v1/workspaces", json={"name": "grouped"})
+    r = cluster.http.put(
+        url + "/api/v1/workspaces/grouped/roles", json={"group": "team", "role": "user"}
+    )
+    assert r.status_code == 200, r.text
+
+    cfg = exp_config(cluster.ckpt_dir)
+    cfg["workspace"] = "grouped"
+    # carol (member) submits; dave (not a member) is denied
+    r = carol.post(url + "/api/v1/experiments", json={"config": cfg})
+    assert r.status_code == 201, r.text
+    exp_id = r.json()["id"]
+    assert dave.post(url + "/api/v1/experiments", json={"config": cfg}).status_code == 403
+    assert dave.get(f"{url}/api/v1/experiments/{exp_id}").status_code == 404
+    assert carol.get(f"{url}/api/v1/experiments/{exp_id}").status_code == 200
+
+    # membership removal revokes access
+    cluster.wait_for_state(exp_id)
+    r = cluster.http.delete(url + "/api/v1/groups/team/members/carol")
+    assert r.status_code == 200, r.text
+    assert carol.get(f"{url}/api/v1/experiments/{exp_id}").status_code == 404
+    assert carol.post(url + "/api/v1/experiments", json={"config": cfg}).status_code == 403
+
+    # a group-granted admin role allows workspace administration
+    cluster.http.post(url + "/api/v1/groups/team/members", json={"username": "carol"})
+    cluster.http.put(
+        url + "/api/v1/workspaces/grouped/roles", json={"group": "team", "role": "admin"}
+    )
+    r = carol.put(
+        url + "/api/v1/workspaces/grouped/roles", json={"username": "dave", "role": "viewer"}
+    )
+    assert r.status_code == 200, r.text
+    assert dave.get(f"{url}/api/v1/experiments/{exp_id}").status_code == 200
+    # viewer is read-only
+    assert dave.post(url + "/api/v1/experiments", json={"config": cfg}).status_code == 403
+
+    # deleting the group revokes the roles it granted
+    assert cluster.http.delete(url + "/api/v1/groups/team").status_code == 200
+    assert carol.get(f"{url}/api/v1/experiments/{exp_id}").status_code == 404
+    # dave's direct viewer binding is untouched
+    assert dave.get(f"{url}/api/v1/experiments/{exp_id}").status_code == 200
+
+    # groups + bindings survive restart (journaled)
+    cluster.http.post(url + "/api/v1/groups", json={"name": "team2"})
+    cluster.http.post(url + "/api/v1/groups/team2/members", json={"username": "carol"})
+    cluster.http.put(
+        url + "/api/v1/workspaces/grouped/roles", json={"group": "team2", "role": "user"}
+    )
+    cluster.procs["master"].send_signal(signal.SIGKILL)
+    cluster.procs["master"].wait(timeout=10)
+    cluster.start_master()
+    groups = {g["name"]: g for g in cluster.http.get(url + "/api/v1/groups").json()}
+    assert groups["team2"]["members"] == ["carol"]
+    assert carol.get(f"{url}/api/v1/experiments/{exp_id}").status_code == 200
+
+
 def test_full_lifecycle_over_tls(tmp_path):
     """Reference core.go:694-799 TLS + certs.py trust model: master serves
     HTTPS from --tls-cert/--tls-key; the agent dials it with --master-cert
