@@ -430,8 +430,46 @@ def shell_open(args) -> int:
     return 0
 
 
+def cmd_run(args) -> int:
+    """Run an arbitrary command as a scheduler-placed task and stream its
+    logs until it finishes (reference ``det cmd run``)."""
+    argv = list(args.cmd or [])
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        print("usage: dtpu cmd run [--pool P] [--slots N] -- <command...>")
+        return 2
+    d = _client(args)
+    info = d.run_command(
+        argv if len(argv) > 1 else argv[0],
+        resource_pool=args.pool,
+        slots=args.slots,
+    )
+    tid = info["id"]
+    print(f"command {tid} submitted to pool {info.get('resource_pool', 'default')}"
+          + (" (queued)" if info.get("queued") else f" on {info.get('agent_id')}"))
+    if args.detach:
+        return 0
+    import time as _time
+
+    shown = 0
+    while True:
+        state = d.get_task(tid).get("state")
+        logs = d.task_logs(tid)
+        for rec in logs[shown:]:
+            line = rec.get("line", "") if isinstance(rec, dict) else str(rec)
+            print(line, flush=True)
+        shown = len(logs)
+        if state == "TERMINATED":
+            return 0
+        _time.sleep(0.5)
+
+
 def task_list(args) -> int:
-    _table(_client(args).list_tasks(), ["id", "type", "state", "ready", "agent_id"])
+    _table(
+        _client(args).list_tasks(),
+        ["id", "type", "state", "ready", "queued", "resource_pool", "slots", "agent_id"],
+    )
     return 0
 
 
@@ -766,6 +804,15 @@ def build_parser() -> argparse.ArgumentParser:
     tk = task.add_parser("kill")
     tk.add_argument("id")
     tk.set_defaults(fn=task_kill)
+
+    cmd = sub.add_parser("cmd").add_subparsers(dest="verb", required=True)
+    cr = cmd.add_parser("run")
+    cr.add_argument("--pool", default=None, help="resource pool (incl. k8s/slurm pools)")
+    cr.add_argument("--slots", type=int, default=0)
+    cr.add_argument("--detach", action="store_true")
+    cr.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to run (prefix with --)")
+    cr.set_defaults(fn=cmd_run)
 
     cl = sub.add_parser("cluster").add_subparsers(dest="verb", required=True)
     cu = cl.add_parser("up")

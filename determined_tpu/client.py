@@ -413,14 +413,57 @@ class Determined:
         )
         return resp.json()
 
-    def start_notebook(self, work_dir: Optional[str] = None) -> Dict[str, Any]:
+    def start_notebook(
+        self, work_dir: Optional[str] = None, resource_pool: Optional[str] = None
+    ) -> Dict[str, Any]:
         """Launch a Jupyter notebook task behind the proxy (reference:
         ``det notebook start``)."""
-        resp = self._session.post(
-            "/api/v1/tasks",
-            json={"type": "notebook", "config": {"work_dir": work_dir or ""}},
-        )
+        body: Dict[str, Any] = {
+            "type": "notebook", "config": {"work_dir": work_dir or ""},
+        }
+        if resource_pool:
+            body["resource_pool"] = resource_pool
+        resp = self._session.post("/api/v1/tasks", json=body)
         return resp.json()
+
+    def run_command(
+        self,
+        entrypoint: Any,
+        *,
+        resource_pool: Optional[str] = None,
+        slots: int = 0,
+        env: Optional[Dict[str, str]] = None,
+        work_dir: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Run an arbitrary command as a scheduler-placed task (reference:
+        ``det cmd run``, ``master/internal/command/command.go``).
+        ``entrypoint`` is an argv list or a shell string."""
+        config: Dict[str, Any] = {"entrypoint": entrypoint}
+        if env:
+            config["env"] = dict(env)
+        if work_dir:
+            config["work_dir"] = work_dir
+        if slots:
+            config["resources"] = {"slots": int(slots)}
+        body: Dict[str, Any] = {"type": "command", "config": config}
+        if resource_pool:
+            body["resource_pool"] = resource_pool
+        return self._session.post("/api/v1/tasks", json=body).json()
+
+    def task_logs(self, task_id: str) -> List[Dict[str, Any]]:
+        return self._session.get(f"/api/v1/tasks/{task_id}/logs").json()
+
+    def wait_task_done(self, task_id: str, timeout: float = 120.0) -> Dict[str, Any]:
+        """Wait until the task reaches TERMINATED (commands run to
+        completion; viewers terminate on kill/idle)."""
+        deadline = time.time() + timeout
+        while True:
+            info = self.get_task(task_id)
+            if info.get("state") == "TERMINATED":
+                return info
+            if time.time() > deadline:
+                raise TimeoutError(f"task {task_id} still running after {timeout}s")
+            time.sleep(0.5)
 
     def start_shell(self, shell: Optional[str] = None) -> Dict[str, Any]:
         """Launch a shell task (PTY behind a websocket through the proxy;

@@ -1,0 +1,94 @@
+"""Generic command task: run an arbitrary entrypoint under the platform.
+
+Reference: ``master/internal/command/command.go`` + ``api_command.go`` —
+the fourth NTSC type, an arbitrary user command scheduled like any other
+task (slots, queueing, any pool).  The agent (or the external-RM pod via
+``exec.run_trial``'s task dispatch) execs this module; it spawns the
+configured entrypoint, relays its output line-by-line to stdout (the agent
+pipe or the in-pod log shipper carries it to the master's task log), marks
+the task ready once the child is up, and exits with the child's code.
+
+``DTPU_TASK_CONFIG`` fields:
+  entrypoint   argv list, or a string run through the shell
+  work_dir     optional cwd for the child
+  env          optional {name: value} overrides
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import urllib.request
+
+from determined_tpu.exec._tls import urlopen as _tls_urlopen
+
+
+def _report_ready() -> None:
+    master = os.environ.get("DTPU_MASTER_URL")
+    task_id = os.environ.get("DTPU_TASK_ID")
+    if not master or not task_id:
+        return
+    req = urllib.request.Request(
+        master.rstrip("/") + f"/api/v1/tasks/{task_id}/ready",
+        data=b"{}",
+        headers={
+            "Authorization": f"Bearer {os.environ.get('DTPU_SESSION_TOKEN', '')}",
+            "Content-Type": "application/json",
+        },
+    )
+    try:
+        with _tls_urlopen(req, timeout=10) as resp:
+            resp.read()
+    except Exception:  # noqa: BLE001 - command still runs; state stays PENDING
+        pass
+
+
+def main() -> int:
+    cfg = json.loads(os.environ.get("DTPU_TASK_CONFIG", "{}") or "{}")
+    entry = cfg.get("entrypoint")
+    if isinstance(entry, str):
+        argv = ["/bin/sh", "-c", entry]
+    elif isinstance(entry, list) and entry:
+        argv = [str(a) for a in entry]
+    else:
+        print("command task: config.entrypoint must be a string or argv list",
+              file=sys.stderr)
+        return 2
+
+    child_env = dict(os.environ)
+    for k, v in (cfg.get("env") or {}).items():
+        child_env[str(k)] = str(v)
+    cwd = cfg.get("work_dir") or None
+
+    try:
+        proc = subprocess.Popen(
+            argv,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=child_env,
+            cwd=cwd,
+            text=True,
+            bufsize=1,
+        )
+    except OSError as e:
+        print(f"command task: failed to exec {argv[0]}: {e}", file=sys.stderr)
+        return 127
+
+    # forward termination so DELETE /tasks/{id} kills the child too
+    def _term(signum, frame):  # noqa: ARG001
+        proc.terminate()
+
+    signal.signal(signal.SIGTERM, _term)
+    _report_ready()
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        sys.stdout.write(line)
+        sys.stdout.flush()
+    return proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

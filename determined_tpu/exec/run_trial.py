@@ -129,7 +129,9 @@ def _install_log_shipper() -> None:
     """
     master = os.environ.get("DTPU_MASTER_URL")
     trial_id = os.environ.get("DTPU_TRIAL_ID")
-    if not master or not trial_id:
+    # NTSC tasks on external pools ship with task_id instead of trial_id
+    task_id = os.environ.get("DTPU_TASK_ID")
+    if not master or not (trial_id or task_id):
         return
     import threading
     import time
@@ -161,10 +163,13 @@ def _install_log_shipper() -> None:
         # retry loop at-least-once-safe: if the master stored a batch but
         # answered too slowly, the identical re-send carries the same seq
         # and is dropped server-side
-        body = json.dumps(
-            {"trial_id": int(trial_id), "agent": agent, "lines": lines,
-             "allocation_id": alloc_id, "batch_seq": batch_seq}
-        ).encode()
+        payload = {"agent": agent, "lines": lines,
+                   "allocation_id": alloc_id, "batch_seq": batch_seq}
+        if trial_id:
+            payload["trial_id"] = int(trial_id)
+        else:
+            payload["task_id"] = task_id
+        body = json.dumps(payload).encode()
         req = urllib.request.Request(
             url,
             data=body,
@@ -240,7 +245,8 @@ def _self_report_exit(code: int) -> None:
     """
     master = os.environ.get("DTPU_MASTER_URL")
     trial_id = os.environ.get("DTPU_TRIAL_ID")
-    if not master or not trial_id:
+    task_id = os.environ.get("DTPU_TASK_ID")
+    if not master or not (trial_id or task_id):
         return
     import time
     import urllib.request
@@ -251,8 +257,11 @@ def _self_report_exit(code: int) -> None:
     body = json.dumps(
         {"exit_code": code, "allocation_id": os.environ.get("DTPU_ALLOCATION_ID", "")}
     ).encode()
+    path = (
+        f"/api/v1/trials/{trial_id}/exit" if trial_id else f"/api/v1/tasks/{task_id}/exit"
+    )
     req = urllib.request.Request(
-        master.rstrip("/") + f"/api/v1/trials/{trial_id}/exit",
+        master.rstrip("/") + path,
         data=body,
         headers={
             "Authorization": f"Bearer {os.environ.get('DTPU_SESSION_TOKEN', '')}",
@@ -315,6 +324,13 @@ def main() -> int:
         level=logging.INFO, format="%(asctime)s [%(levelname)s] %(name)s: %(message)s"
     )
     logger = logging.getLogger("determined_tpu.exec")
+    if os.environ.get("DTPU_TASK_TYPE"):
+        # NTSC task placed on an external-RM pool: the pod runs the same
+        # container entry as trials (the reference wraps every task type
+        # through entrypoint.sh too); dispatch to the task module instead
+        # of the trial machinery
+        task_mod = importlib.import_module(os.environ["DTPU_TASK_MODULE"])
+        return int(task_mod.main() or 0)
     if len(sys.argv) < 2 or ":" not in sys.argv[1]:
         print("usage: python -m determined_tpu.exec.run_trial pkg.module:TrialClass")
         return 2

@@ -48,15 +48,16 @@ def _min_pallas_size() -> int:
     The r4 xplane accounting measured ~120 us of fixed per-call overhead x
     34 sweeps ≈ 4 ms/step — most of the fused kernel's saved HBM pass.
     The in-kernel bandwidth edge of Pallas over a well-fused XLA update is
-    small (80-86% vs ~80% of roofline), so mid-size leaves are better off
-    batched into XLA's fusion; only leaves whose sweep time dwarfs the
-    launch overhead (the 67M embed/lm_head at ~2.4 ms each) keep their own
-    call.  32M default = 2 Pallas calls on the flagship LM (was 34);
-    measured sweep in BASELINE.md r5.  DTPU_FUSED_MIN_SIZE overrides.
+    small, so small/mid leaves are better off batched into XLA's fusion;
+    only leaves whose sweep time dwarfs the launch overhead keep their own
+    call.  Measured sweep on the v5e chip (BASELINE.md r5): 256K (34
+    calls) 0.693 MFU, 4M 0.696, 8M 0.699-0.701, 16M 0.701, 32M (2 calls)
+    0.698, pure-jnp 0.688 — 8M default = embed/lm_head (67M) + the 24
+    16M swiglu leaves, 26 Pallas calls.  DTPU_FUSED_MIN_SIZE overrides.
     """
     import os
 
-    return int(os.environ.get("DTPU_FUSED_MIN_SIZE", 32 * 1024 * 1024))
+    return int(os.environ.get("DTPU_FUSED_MIN_SIZE", 8 * 1024 * 1024))
 
 
 class FusedAdamWState(NamedTuple):
