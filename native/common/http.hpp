@@ -540,4 +540,105 @@ inline ClientResponse http_request(const std::string& host, int port,
   return out;
 }
 
+// Streaming GET: invoke ``on_line`` for every newline-terminated line of
+// the response body AS IT ARRIVES (kubernetes watch API: one JSON event
+// per line on a long-lived response).  Handles identity and chunked
+// transfer-encodings; returns the HTTP status (0 = connect/read failure).
+// ``timeout_sec`` bounds each read, so a silent server ends the stream.
+inline int http_stream_lines(
+    const std::string& host, int port, const std::string& target,
+    const std::function<void(const std::string&)>& on_line,
+    int timeout_sec = 30,
+    const std::vector<std::pair<std::string, std::string>>& extra_headers = {},
+    bool use_tls = false, const std::string& tls_ca = "") {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 0;
+  timeval tv{timeout_sec, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return 0;
+  }
+  TlsSession tls;
+  IoStream stream{fd, nullptr};
+  if (use_tls) {
+    if (!tls.connect(fd, tls_ca, host)) {
+      ::close(fd);
+      return 0;
+    }
+    stream.tls = &tls;
+  }
+  std::ostringstream req;
+  req << "GET " << target << " HTTP/1.1\r\nHost: " << host << "\r\n";
+  for (const auto& [k, v] : extra_headers) req << k << ": " << v << "\r\n";
+  req << "Connection: close\r\n\r\n";
+  std::string data = req.str();
+  if (!stream.write_all(data.data(), data.size())) {
+    ::close(fd);
+    return 0;
+  }
+  std::string buf;
+  char chunk[8192];
+  long n;
+  // read headers
+  size_t he;
+  while ((he = buf.find("\r\n\r\n")) == std::string::npos) {
+    n = stream.read(chunk, sizeof(chunk));
+    if (n <= 0) {
+      tls.close();
+      ::close(fd);
+      return 0;
+    }
+    buf.append(chunk, static_cast<size_t>(n));
+  }
+  auto sp = buf.find(' ');
+  int status = sp == std::string::npos ? 0 : std::atoi(buf.c_str() + sp + 1);
+  std::string head = buf.substr(0, he);
+  for (auto& c : head) c = static_cast<char>(tolower(c));
+  bool chunked = head.find("transfer-encoding: chunked") != std::string::npos;
+  std::string body = buf.substr(he + 4);
+  std::string line_acc;
+  std::string chunk_acc;  // chunked framing accumulator
+
+  auto emit_bytes = [&](const char* p, size_t len) {
+    line_acc.append(p, len);
+    size_t nl;
+    while ((nl = line_acc.find('\n')) != std::string::npos) {
+      std::string line = line_acc.substr(0, nl);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (!line.empty()) on_line(line);
+      line_acc.erase(0, nl + 1);
+    }
+  };
+  auto feed = [&](const char* p, size_t len) {
+    if (!chunked) {
+      emit_bytes(p, len);
+      return;
+    }
+    chunk_acc.append(p, len);
+    for (;;) {
+      size_t eol = chunk_acc.find("\r\n");
+      if (eol == std::string::npos) return;
+      size_t size = std::strtoul(chunk_acc.substr(0, eol).c_str(), nullptr, 16);
+      if (chunk_acc.size() < eol + 2 + size + 2) return;  // partial chunk
+      if (size == 0) return;
+      emit_bytes(chunk_acc.data() + eol + 2, size);
+      chunk_acc.erase(0, eol + 2 + size + 2);
+    }
+  };
+  if (!body.empty()) feed(body.data(), body.size());
+  while ((n = stream.read(chunk, sizeof(chunk))) > 0) {
+    feed(chunk, static_cast<size_t>(n));
+  }
+  if (!line_acc.empty()) on_line(line_acc);
+  tls.close();
+  ::close(fd);
+  return status;
+}
+
 }  // namespace dtpu

@@ -76,6 +76,7 @@ struct AllocationState {
   std::string id;
   int64_t trial_id = 0;
   std::string task_id;  // set when this allocation backs an NTSC task
+  int slots = 0;        // gang size (namespace quota accounting)
   // process groups: agent_id -> {node_rank, num_slots}
   std::vector<std::pair<std::string, int>> groups;
   bool preempt = false;
@@ -1603,6 +1604,11 @@ class Master {
       if (t.state != "PENDING" || !t.agent_id.empty()) continue;
       const PoolConfig* pool = pool_config(t.pool);
       if (pool != nullptr && pool->external()) {
+        if (pool->k8s_quota_slots > 0 &&
+            external_pool_used_slots(pool->name) + t.slots >
+                pool->k8s_quota_slots) {
+          continue;  // queued until namespace quota frees
+        }
         place_task_external(t, *pool);
       } else {
         place_task_agent(t);
@@ -1675,6 +1681,7 @@ class Master {
     AllocationState alloc;
     alloc.id = alloc_id;
     alloc.task_id = t.id;
+    alloc.slots = t.slots;
     alloc.external_kind = pool.type;
     alloc.external_pool = pool.name;
     t.session_token = issue_token(t.owner);
@@ -1712,6 +1719,16 @@ class Master {
   // queueing and placement — every pending trial is handed off
   // immediately, exactly the reference kubernetesrm/dispatcherrm split
   // (they build Jobs / batch scripts and let k8s / Slurm schedule them).
+  // In-flight slots on an external pool (namespace quota accounting,
+  // reference kubernetesrm/jobs.go:710).  Caller holds mu_.
+  int external_pool_used_slots(const std::string& pool_name) const {
+    int used = 0;
+    for (const auto& [aid, alloc] : allocations_) {
+      if (!alloc.ended && alloc.external_pool == pool_name) used += alloc.slots;
+    }
+    return used;
+  }
+
   void schedule_external() {
     for (auto& [tid, t] : trials_) {
       if (t.state != "PENDING") continue;
@@ -1721,6 +1738,14 @@ class Master {
       if (exp.unmanaged) continue;
       const PoolConfig* pool = pool_config(exp.resource_pool);
       if (pool == nullptr || !pool->external()) continue;
+      // namespace quota: a gang that would overflow the in-flight total
+      // queues until quota frees (gangs LARGER than the quota are already
+      // rejected at submit)
+      if (pool->k8s_quota_slots > 0 &&
+          external_pool_used_slots(pool->name) + exp.slots_per_trial >
+              pool->k8s_quota_slots) {
+        continue;
+      }
       place_external(tid, t, exp, *pool);
     }
   }
@@ -1731,6 +1756,7 @@ class Master {
     AllocationState alloc;
     alloc.id = alloc_id;
     alloc.trial_id = tid;
+    alloc.slots = exp.slots_per_trial;
     alloc.external_kind = pool.type;
     alloc.external_pool = pool.name;
     std::string session_token = issue_token(exp.owner);
@@ -2129,6 +2155,22 @@ class Master {
     return config[key].is_string() ? config[key].as_string() : fallback;
   }
 
+  // Gang size of a submitted config: mesh product when a mesh is declared,
+  // else resources.slots_per_trial.  Shared by config-policy constraints
+  // and namespace-quota checks (must agree with build_experiment).
+  static int64_t slots_from_config(const Json& config) {
+    const Json& res = config["resources"];
+    if (res.contains("mesh")) {
+      int64_t slots = 1;
+      for (const auto& [axis, size] : res["mesh"].items()) {
+        (void)axis;
+        slots *= std::max<int64_t>(size.as_int(1), 1);
+      }
+      return slots;
+    }
+    return res["slots_per_trial"].as_int(1);
+  }
+
   // Workspace-scoped RBAC (reference master/internal/rbac/ + usergroup/):
   // cluster admins see all; a workspace WITH bindings (user or group)
   // restricts access to its owner + bound principals (role "viewer" =
@@ -2245,16 +2287,7 @@ class Master {
       if (!con.is_object()) continue;
       int64_t max_slots = con["max_slots"].as_int(0);
       if (max_slots > 0) {
-        const Json& res = (*config)["resources"];
-        int64_t slots = 1;
-        if (res.contains("mesh")) {
-          for (const auto& [axis, size] : res["mesh"].items()) {
-            (void)axis;
-            slots *= std::max<int64_t>(size.as_int(1), 1);
-          }
-        } else {
-          slots = res["slots_per_trial"].as_int(1);
-        }
+        int64_t slots = slots_from_config(*config);
         if (slots > max_slots) {
           return "config policy (" + scope + ") rejects: slots_per_trial " +
                  std::to_string(slots) + " > max_slots " +
@@ -2392,9 +2425,13 @@ class Master {
   // (the launch is what learns the backend's job handle).
   void run_external_worker() {
     using namespace std::chrono_literals;
+    start_k8s_watchers();
     std::unique_lock<std::mutex> lk(mu_);
     while (true) {
-      ext_cv_.wait_for(lk, 2s, [&] { return !ext_ops_.empty(); });
+      ext_cv_.wait_for(lk, 2s, [&] {
+        return !ext_ops_.empty() || ext_poll_now_.load();
+      });
+      ext_poll_now_.store(false);
       while (!ext_ops_.empty()) {
         ExternalOp op = std::move(ext_ops_.front());
         ext_ops_.pop_front();
@@ -2402,6 +2439,51 @@ class Master {
       }
       poll_external_jobs(lk);
       provision_tick(lk);
+    }
+  }
+
+  // Watch-based informers (reference kubernetesrm/informer.go:17): one
+  // thread per kubernetes pool holds a long-lived watch on the namespace's
+  // Jobs; every event for a job we own triggers an IMMEDIATE status
+  // resolve on the worker (the 2s poll remains as the resync safety net —
+  // the informer pattern).  Pod failure reaches the trial record in watch
+  // latency, not poll cadence.
+  void start_k8s_watchers() {
+    std::vector<PoolConfig> k8s_pools;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (const auto& [name, pool] : pools_) {
+        if (pool.type == "kubernetes") k8s_pools.push_back(pool);
+      }
+    }
+    for (const auto& pool : k8s_pools) {
+      std::thread([this, pool] {
+        using namespace std::chrono_literals;
+        while (true) {
+          KubernetesBackend::watch(pool, 30, [this](const std::string& job) {
+            bool ours = false;
+            {
+              std::lock_guard<std::mutex> g(mu_);
+              for (const auto& [aid, alloc] : allocations_) {
+                if (alloc.ended || alloc.external_ref.empty()) continue;
+                for (const auto& name : split_ref(alloc.external_ref)) {
+                  if (name == job) {
+                    ours = true;
+                    break;
+                  }
+                }
+                if (ours) break;
+              }
+            }
+            if (ours) {
+              ext_poll_now_.store(true);
+              ext_cv_.notify_all();
+            }
+          });
+          // stream ended (timeoutSeconds / apiserver hiccup): reconnect
+          std::this_thread::sleep_for(200ms);
+        }
+      }).detach();
     }
   }
 
@@ -2819,6 +2901,8 @@ class Master {
 
   std::deque<ExternalOp> ext_ops_;
   std::condition_variable ext_cv_;
+  // set by the k8s watch threads: a job we own changed — resolve now
+  std::atomic<bool> ext_poll_now_{false};
   std::vector<std::pair<std::string, std::string>> lingering_external_;
 
  public:
@@ -3162,6 +3246,20 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       // api_project archive: archived scopes refuse new experiments)
       auto [code, msg] = m.submit_org_gate(config, m.authenticate(req));
       if (code) return R::error(code, msg);
+      // namespace quota: a gang that can NEVER fit the quota is rejected
+      // here; gangs that merely overflow current usage queue instead
+      // (reference kubernetesrm/jobs.go:710-716)
+      const PoolConfig* pc = m.pool_config(
+          Master::config_str(config["resources"], "resource_pool", "default"));
+      if (pc != nullptr && pc->k8s_quota_slots > 0) {
+        int64_t slots = Master::slots_from_config(config);
+        if (slots > pc->k8s_quota_slots) {
+          return R::error(
+              400, "resources exceed namespace quota: " + std::to_string(slots) +
+                       " slots > quota " + std::to_string(pc->k8s_quota_slots) +
+                       " in pool " + pc->name);
+        }
+      }
     }
     if (!config.contains("checkpoint_storage")) {
       std::lock_guard<std::mutex> lk(m.mu_);

@@ -74,6 +74,11 @@ struct PoolConfig {
   // with a matching headless Service + pod hostname/subdomain); the
   // test's fake apiserver runs pods locally and uses "127.0.0.1".
   std::string k8s_coordinator_pattern = "{job}";
+  // per-namespace slot quota (reference kubernetesrm/jobs.go:710-716):
+  // total in-flight slots in this pool's namespace may not exceed it.
+  // Gangs larger than the quota are rejected at submit; gangs that would
+  // overflow the in-flight total queue until quota frees.  0 = unlimited.
+  int k8s_quota_slots = 0;
 
   // slurm backend (binaries overridable for tests / site wrappers)
   std::string slurm_sbatch = "sbatch";
@@ -108,6 +113,7 @@ struct PoolConfig {
       if (k["coordinator_pattern"].is_string()) {
         p.k8s_coordinator_pattern = k["coordinator_pattern"].as_string();
       }
+      p.k8s_quota_slots = static_cast<int>(k["quota_slots"].as_int(0));
     }
     const Json& s = j["slurm"];
     if (s.is_object()) {
@@ -379,6 +385,34 @@ class KubernetesBackend {
  private:
   static std::string jobs_path(const PoolConfig& pool) {
     return "/apis/batch/v1/namespaces/" + pool.k8s_namespace + "/jobs";
+  }
+
+ public:
+  // Watch-based job events (reference kubernetesrm/informer.go:17-30): a
+  // long-lived GET on the Jobs watch API; every event line invokes
+  // ``on_event(job_name)``.  The caller reacts by resolving that job's
+  // status immediately instead of waiting for the next resync poll.
+  // Returns when the server closes the stream (timeoutSeconds) or on
+  // error; the caller's watch loop reconnects.
+  static void watch(const PoolConfig& pool, int timeout_sec,
+                    const std::function<void(const std::string&)>& on_event) {
+    std::string host;
+    int port = 0;
+    if (!rm_detail::split_url(pool.k8s_api, &host, &port)) return;
+    std::vector<std::pair<std::string, std::string>> headers;
+    if (!pool.k8s_token.empty()) {
+      headers.push_back({"Authorization", "Bearer " + pool.k8s_token});
+    }
+    http_stream_lines(
+        host, port,
+        jobs_path(pool) + "?watch=1&timeoutSeconds=" + std::to_string(timeout_sec),
+        [&](const std::string& line) {
+          Json ev;
+          if (!Json::try_parse(line, &ev)) return;
+          const std::string name = ev["object"]["metadata"]["name"].as_string();
+          if (!name.empty()) on_event(name);
+        },
+        timeout_sec + 5, headers);
   }
 
   static ClientResponse api(const PoolConfig& pool, const std::string& method,

