@@ -149,6 +149,38 @@ class ProfilerContext:
 
             jax.profiler.stop_trace()
             self._tracing = False
+            self._report_trace_summary()
+
+    def _report_trace_summary(self) -> None:
+        """Parse the just-captured xplane into an op table + category
+        totals and report them as a ``profile`` metrics row, so the WebUI
+        experiment page renders the profiler surface without launching the
+        viewer task (reference: profiler charts on the experiment detail
+        page, ``webui/react/src/pages/``).  Chief-only; best-effort — a
+        missing xprof toolchain must never fail the trial."""
+        if getattr(self._dist, "rank", 0) != 0:
+            return
+        trace_dir = self._trace_dir or os.path.join(os.getcwd(), "xplane_traces")
+        try:
+            from determined_tpu.utils import xplane
+
+            ops = xplane.hlo_op_table(trace_dir)
+            if not ops:
+                return
+            totals = xplane.category_totals(ops)
+            self._metrics.report(
+                "profile",
+                self._steps_fn(),
+                {
+                    # top ops only: the row is a UI artifact, not an archive
+                    "op_table": ops[:25],
+                    "category_totals": totals,
+                },
+            )
+        except Exception as e:  # noqa: BLE001
+            logging.getLogger("determined_tpu.profiler").warning(
+                "trace summary not reported: %s", e
+            )
 
     def off(self) -> None:
         if self._thread is not None:

@@ -71,6 +71,18 @@ inline const char* kWebUIHtml = R"HTML(<!DOCTYPE html>
   <h2>checkpoints</h2><div id="ckpts"></div>
  </div>
  <div class="page" data-page="admin">
+  <h2>workspaces &amp; projects</h2>
+  <div>
+   <input id="nws" placeholder="new workspace">
+   <button class="mini" onclick="wsCreate()">create workspace</button>
+  </div>
+  <div id="wsadmin"></div>
+  <h2>user groups</h2>
+  <div>
+   <input id="ngrp" placeholder="new group">
+   <button class="mini" onclick="groupCreate()">create group</button>
+  </div>
+  <div id="groups"></div>
   <h2>users</h2><div id="users"></div>
   <h2>webhooks</h2><div id="webhooks"></div>
  </div>
@@ -216,6 +228,85 @@ function expHpViz(e, el) {
     ((e.config || {}).searcher || {}).metric || "metric")})</b><br>` +
     `<svg class="chart" width="${w}" height="${h}">${axisMarks}${lines}</svg>`;
 }
+// profiler surface on the experiment page (reference profiler charts on
+// ExperimentDetails): renders the op table + category totals the trial's
+// ProfilerContext reported after its xplane capture window closed
+async function expProfile(expId, el) {
+  el.innerHTML = "loading profile…";
+  const e = await api(`/api/v1/experiments/${expId}`);
+  for (const t of (e.trials || [])) {
+    const rows = await api(`/api/v1/trials/${t.id}/metrics?group=profile`);
+    const last = rows[rows.length - 1];
+    if (!last || !(last.metrics || {}).op_table) continue;
+    const ops = last.metrics.op_table;
+    const cats = Object.entries(last.metrics.category_totals || {})
+      .sort((a, b) => b[1] - a[1]);
+    const total = cats.reduce((s, c) => s + c[1], 0) || 1;
+    const bars = cats.map(([k, us]) =>
+      `<div><span class="hp">${esc(k)} ${(us/1000).toFixed(2)}ms</span>` +
+      `<div style="background:#2d79c7;height:6px;width:${Math.round(us/total*420)}px"></div></div>`
+    ).join("");
+    el.innerHTML = `<b>trial ${Number(t.id)} profile (step ${Number(last.steps_completed||0)})</b>` +
+      `<div>${bars}</div>` +
+      table(ops.map(o => ({op: o.name, category: o.category,
+        "time ms": (o.time_us/1000).toFixed(3)})), ["op", "category", "time ms"]);
+    return;
+  }
+  el.innerHTML = "(no profile rows — enable profiling.trace in the experiment config)";
+}
+// workspace / project / group admin (reference workspace admin + rbac
+// pages): forms post to the same routes the CLI uses
+async function wsCreate() {
+  const name = $("nws").value.trim();
+  if (name) { await api("/api/v1/workspaces", {method: "POST", body: JSON.stringify({name})}); refresh(); }
+}
+// names flow into onclick='...' strings: uri-encode them there (jsarg —
+// also encodes the quote) and decode on entry, so a hostile workspace
+// name cannot break out of the attribute
+function jsarg(s) { return encodeURIComponent(s).replace(/'/g, "%27"); }
+async function wsArchive(encName, undo) {
+  await api(`/api/v1/workspaces/${jsarg(decodeURIComponent(encName))}/${undo ? "unarchive" : "archive"}`, {method: "POST"});
+  refresh();
+}
+async function wsAssign(encName) {
+  const who = $(`rb-${encName}`).value.trim(), role = $(`rr-${encName}`).value;
+  if (!who) return;
+  const body = {role};
+  if (who.startsWith("group:")) body.group = who.slice(6); else body.username = who;
+  await api(`/api/v1/workspaces/${jsarg(decodeURIComponent(encName))}/roles`,
+            {method: "PUT", body: JSON.stringify(body)});
+  refresh();
+}
+async function projCreate(encWs) {
+  const name = $(`np-${encWs}`).value.trim();
+  if (name) {
+    await api(`/api/v1/workspaces/${jsarg(decodeURIComponent(encWs))}/projects`,
+              {method: "POST", body: JSON.stringify({name})});
+    refresh();
+  }
+}
+async function projArchive(encWs, encName, undo) {
+  await api(`/api/v1/projects/${jsarg(decodeURIComponent(encWs))}/${jsarg(decodeURIComponent(encName))}/${undo ? "unarchive" : "archive"}`,
+            {method: "POST"});
+  refresh();
+}
+async function groupCreate() {
+  const name = $("ngrp").value.trim();
+  if (name) { await api("/api/v1/groups", {method: "POST", body: JSON.stringify({name})}); refresh(); }
+}
+async function groupAddMember(encName) {
+  const u = $(`gm-${encName}`).value.trim();
+  if (u) {
+    await api(`/api/v1/groups/${jsarg(decodeURIComponent(encName))}/members`,
+              {method: "POST", body: JSON.stringify({username: u})});
+    refresh();
+  }
+}
+async function groupRmMember(encName, encU) {
+  await api(`/api/v1/groups/${jsarg(decodeURIComponent(encName))}/members/${jsarg(decodeURIComponent(encU))}`,
+            {method: "DELETE"});
+  refresh();
+}
 async function trialDetail(tid, el) {
   const rows = await api(`/api/v1/trials/${tid}/metrics?group=validation`);
   const series = {};
@@ -299,6 +390,8 @@ async function refresh() {
         `<button class="mini" onclick="event.stopPropagation();event.preventDefault();` +
         `(async()=>{expHpViz(await api('/api/v1/experiments/${Number(e.id)}'),` +
         `this.closest('details').querySelector('.td'))})()">hp-viz</button>` +
+        `<button class="mini" onclick="event.stopPropagation();event.preventDefault();` +
+        `expProfile(${Number(e.id)}, this.closest('details').querySelector('.td'))">profile</button>` +
         `</summary>` +
         `<table><tr><th>trial</th><th>state</th><th>restarts</th>` +
         `<th>progress</th><th>best val</th><th>hparams</th><th></th></tr>${trials}</table><div class="td"></div></details>`;
@@ -334,8 +427,44 @@ async function refresh() {
       state: badge(c.state || "COMPLETED"), _raw_state: 1})),
       ["uuid", "trial", "step", "state"]);
   } else if (PAGE === "admin") {
-    const [users, hooks] = await Promise.all([
-      api("/api/v1/users"), api("/api/v1/webhooks")]);
+    const [users, hooks, wss, groups] = await Promise.all([
+      api("/api/v1/users"), api("/api/v1/webhooks"),
+      api("/api/v1/workspaces"), api("/api/v1/groups")]);
+    // workspace -> project tree with archival + role-binding controls
+    $("wsadmin").innerHTML = wss.map(w => {
+      const enc = jsarg(w.name);
+      const roles = Object.entries(w.roles || {}).map(([u, r]) => `${esc(u)}:${esc(r)}`)
+        .concat(Object.entries(w.group_roles || {}).map(([g, r]) => `group:${esc(g)}:${esc(r)}`))
+        .join(" ") || "(open)";
+      const projects = (w.projects || []).map(p =>
+        `<tr><td style="padding-left:1.6rem">${esc(p.name)}${p.archived ? " (archived)" : ""}</td>` +
+        `<td>${Number(p.experiments || 0)} exp</td><td>` +
+        (p.registered
+          ? `<button class="mini" onclick="projArchive('${enc}','${jsarg(p.name)}',${p.archived})">${p.archived ? "unarchive" : "archive"}</button>`
+          : "") + `</td></tr>`).join("");
+      return `<details open><summary><b>${esc(w.name)}</b>` +
+        `${w.archived ? " (archived)" : ""} <span class="hp">${roles}</span>` +
+        (w.registered
+          ? ` <button class="mini" onclick="event.preventDefault();wsArchive('${enc}',${!!w.archived})">${w.archived ? "unarchive" : "archive"}</button>`
+          : "") +
+        `</summary><table>${projects}</table>` +
+        `<div class="hp"><input id="np-${enc}" placeholder="new project">` +
+        `<button class="mini" onclick="projCreate('${enc}')">add project</button>  ` +
+        `<input id="rb-${enc}" placeholder="user or group:NAME">` +
+        `<select id="rr-${enc}"><option>viewer</option><option>user</option>` +
+        `<option>admin</option><option>none</option></select>` +
+        `<button class="mini" onclick="wsAssign('${enc}')">set role</button></div>` +
+        `</details>`;
+    }).join("") || "<p>(none)</p>";
+    $("groups").innerHTML = groups.map(g => {
+      const enc = jsarg(g.name);
+      const members = (g.members || []).map(u =>
+        `${esc(u)} <button class="mini" onclick="groupRmMember('${enc}','${jsarg(u)}')">x</button>`
+      ).join(" ") || "(empty)";
+      return `<div><b>${esc(g.name)}</b>: ${members} ` +
+        `<input id="gm-${enc}" placeholder="username">` +
+        `<button class="mini" onclick="groupAddMember('${enc}')">add</button></div>`;
+    }).join("") || "<p>(none)</p>";
     $("users").innerHTML = table(users.map(u => ({username: u.username,
       role: u.role || (u.admin ? "admin" : "user")})), ["username", "role"]);
     $("webhooks").innerHTML = table(hooks.map(w => ({id: w.id, name: w.name,
