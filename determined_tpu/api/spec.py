@@ -40,7 +40,26 @@ ROUTES = [
     ("POST", "/api/v1/workspaces/{name}/archive", "token", {"name", "archived"}),
     ("POST", "/api/v1/workspaces/{name}/unarchive", "token", {"name", "archived"}),
     ("PUT", "/api/v1/workspaces/{name}/roles", "token", {"name", "username", "role"}),
+    # first-class projects (workspace -> project -> experiment hierarchy)
+    ("POST", "/api/v1/workspaces/{name}/projects", "token",
+     {"name", "workspace", "owner"}),
+    ("GET", "/api/v1/workspaces/{name}/projects", "token", "[]"),
+    ("PATCH", "/api/v1/projects/{ws}/{project}", "token",
+     {"name", "description", "notes"}),
+    ("POST", "/api/v1/projects/{ws}/{project}/archive", "token",
+     {"name", "archived"}),
+    ("POST", "/api/v1/projects/{ws}/{project}/unarchive", "token",
+     {"name", "archived"}),
+    ("POST", "/api/v1/experiments/{id}/move", "token",
+     {"id", "workspace", "project"}),
+    ("DELETE", "/api/v1/projects/{ws}/{project}", "token", set()),
     ("DELETE", "/api/v1/workspaces/{name}", "token", set()),
+    # user groups (role bindings may target groups; members inherit)
+    ("POST", "/api/v1/groups", "token", {"name"}),
+    ("GET", "/api/v1/groups", "token", "[]"),
+    ("POST", "/api/v1/groups/{group}/members", "token", {"name", "username"}),
+    ("DELETE", "/api/v1/groups/{group}/members/{username}", "token", set()),
+    ("DELETE", "/api/v1/groups/{group}", "token", set()),
     ("POST", "/api/v1/experiments/{id}/fork", "token", {"id", "forked_from"}),
     ("POST", "/api/v1/experiments/{id}/continue", "token",
      {"id", "forked_from", "continued_from_checkpoint"}),

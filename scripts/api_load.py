@@ -76,7 +76,8 @@ def run(master: str, clients: int, requests: int, thresholds_ms: float):
         worst = max(worst, r["p95_ms"])
     ok = worst <= thresholds_ms and all(r["errors"] == 0 for r in rows)
     print(json.dumps({"metric": "api_p95_worst_ms", "value": worst,
-                      "threshold_ms": thresholds_ms, "pass": ok}))
+                      "threshold_ms": thresholds_ms, "pass": ok,
+                      "groups": rows}))
     return 0 if ok else 1
 
 

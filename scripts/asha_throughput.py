@@ -31,6 +31,11 @@ def main() -> None:
     ap.add_argument("--trials", type=int, default=16)
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--concurrent", type=int, default=4)
+    ap.add_argument(
+        "--api-load", action="store_true",
+        help="run the api_load p95 suite CONCURRENTLY with the search "
+             "(r3 order #6 / r4 order #8: latency under the north-star "
+             "load, not against an idle master)")
     args = ap.parse_args()
 
     os.environ.setdefault("DTPU_AUTH_PATH", tempfile.mktemp())
@@ -58,8 +63,36 @@ def main() -> None:
         cfg["min_validation_period"] = {"batches": 2}
         t0 = time.time()
         exp_id = c.submit(cfg)
+        api_load_result = {}
+        api_thread = None
+        if args.api_load:
+            import subprocess
+            import threading
+
+            def run_api_load():
+                # let the search ramp to full concurrency first
+                time.sleep(20)
+                env = dict(os.environ)
+                env["DTPU_TOKEN"] = c.token
+                out = subprocess.run(
+                    [sys.executable, os.path.join(REPO, "scripts", "api_load.py"),
+                     "--master", c.url, "--clients", "8", "--requests", "80",
+                     "--threshold-ms", "2000"],
+                    capture_output=True, text=True, timeout=1800, env=env,
+                )
+                for line in reversed(out.stdout.strip().splitlines()):
+                    try:
+                        api_load_result.update(json.loads(line))
+                        break
+                    except json.JSONDecodeError:
+                        continue
+
+            api_thread = threading.Thread(target=run_api_load, daemon=True)
+            api_thread.start()
         final = c.wait_for_state(exp_id, timeout=3600)
         dt = time.time() - t0
+        if api_thread is not None:
+            api_thread.join(timeout=1800)
         assert final["state"] == "COMPLETED", final["state"]
         n_trials = len(final["trials"])
         states = {}
@@ -76,6 +109,8 @@ def main() -> None:
                     "trial_states": states,
                     "slots": args.slots,
                     "concurrent": args.concurrent,
+                    **({"api_load_under_search": api_load_result}
+                       if api_load_result else {}),
                 }
             )
         )

@@ -78,6 +78,15 @@ BODIES = {
         "username": "determined",
         "role": "admin",
     },
+    ("POST", "/api/v1/workspaces/{name}/projects"): {"name": "contract-proj"},
+    ("PATCH", "/api/v1/projects/{ws}/{project}"): {"description": "d"},
+    # a no-op move: the seeded experiment stays in Uncategorized, so the
+    # contract-proj project stays empty and its DELETE below succeeds
+    ("POST", "/api/v1/experiments/{id}/move"): {
+        "workspace": "Uncategorized", "project": "Uncategorized",
+    },
+    ("POST", "/api/v1/groups"): {"name": "contract-group"},
+    ("POST", "/api/v1/groups/{group}/members"): {"username": "determined"},
 }
 
 
@@ -93,6 +102,10 @@ def test_every_route_conforms(cluster, tmp_path):
         "name": "contract-model",
         "path": "x",
         "scope": "cluster",
+        "ws": "contract-model",
+        "project": "contract-proj",
+        "group": "contract-group",
+        "username": "determined",
     }
 
     bodies = dict(BODIES)
