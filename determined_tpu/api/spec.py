@@ -60,6 +60,10 @@ ROUTES = [
     ("POST", "/api/v1/groups/{group}/members", "token", {"name", "username"}),
     ("DELETE", "/api/v1/groups/{group}/members/{username}", "token", set()),
     ("DELETE", "/api/v1/groups/{group}", "token", set()),
+    # named access tokens (secret shown once; list/revoke by id)
+    ("POST", "/api/v1/tokens", "token", {"id", "name", "username", "token"}),
+    ("GET", "/api/v1/tokens", "token", "[]"),
+    ("DELETE", "/api/v1/tokens/{token_id}", "token", set()),
     ("POST", "/api/v1/experiments/{id}/fork", "token", {"id", "forked_from"}),
     ("POST", "/api/v1/experiments/{id}/continue", "token",
      {"id", "forked_from", "continued_from_checkpoint"}),

@@ -220,9 +220,10 @@ def deploy_local_status(args) -> int:
 def register(sub) -> None:
     deploy = sub.add_parser("deploy").add_subparsers(dest="verb", required=True)
 
-    from determined_tpu.cli import deploy_gcp
+    from determined_tpu.cli import deploy_gcp, deploy_gke
 
     deploy_gcp.register(deploy)
+    deploy_gke.register(deploy)
     local = deploy.add_parser("local").add_subparsers(dest="action", required=True)
     up = local.add_parser("up")
     up.add_argument("--agents", type=int, default=1)

@@ -1,0 +1,224 @@
+"""``dtpu deploy gke``: generate a GKE deployment (kubernetes pool).
+
+Reference: ``det deploy gke`` (``harness/determined/deploy/gke/``, which
+drives gcloud+kubectl against a GKE cluster).  TPU redesign: the master
+runs IN the cluster as a Deployment and schedules trials onto the
+cluster's TPU node pools through its kubernetes resource-pool backend
+(``native/master/rm.hpp``) — pods request ``google.com/tpu`` and GKE
+places them.  Apiserver access rides a ``kubectl proxy`` sidecar
+(plaintext on localhost, auth handled by the pod's serviceaccount), so
+no token ever lands in a config file.  Zero egress from this tool;
+everything is reviewable text the operator applies with kubectl.
+
+    dtpu deploy gke --image gcr.io/my-proj/determined-tpu:latest \
+        --namespace dtpu --out ./deploy-gke
+    cd deploy-gke && ./up.sh
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+
+NAMESPACE_YAML = """apiVersion: v1
+kind: Namespace
+metadata:
+  name: {namespace}
+"""
+
+# the master's serviceaccount may manage Jobs/Pods in its own namespace
+# (the watch-based informer also needs watch on jobs)
+RBAC_YAML = """apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: dtpu-master
+  namespace: {namespace}
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: Role
+metadata:
+  name: dtpu-master
+  namespace: {namespace}
+rules:
+- apiGroups: ["batch"]
+  resources: ["jobs"]
+  verbs: ["create", "get", "list", "watch", "delete"]
+- apiGroups: [""]
+  resources: ["pods", "pods/log"]
+  verbs: ["get", "list", "watch"]
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: RoleBinding
+metadata:
+  name: dtpu-master
+  namespace: {namespace}
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: Role
+  name: dtpu-master
+subjects:
+- kind: ServiceAccount
+  name: dtpu-master
+  namespace: {namespace}
+"""
+
+MASTER_YAML = """apiVersion: v1
+kind: PersistentVolumeClaim
+metadata:
+  name: dtpu-state
+  namespace: {namespace}
+spec:
+  accessModes: ["ReadWriteOnce"]
+  resources:
+    requests:
+      storage: {state_storage}
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: dtpu-master
+  namespace: {namespace}
+spec:
+  replicas: 1
+  strategy:
+    type: Recreate   # the journal dir is RWO; never two masters on it
+  selector:
+    matchLabels: {{app: dtpu-master}}
+  template:
+    metadata:
+      labels: {{app: dtpu-master}}
+    spec:
+      serviceAccountName: dtpu-master
+      containers:
+      - name: master
+        image: {image}
+        command: ["/opt/dtpu/dtpu-master",
+                  "--port", "{port}",
+                  "--state-dir", "/var/lib/dtpu/state",
+                  "--checkpoint-dir", "{checkpoint_dir}",
+                  "--pools", "/etc/dtpu/pools.json",
+                  "--advertised-url",
+                  "http://dtpu-master.{namespace}.svc:{port}"]
+        ports:
+        - containerPort: {port}
+        volumeMounts:
+        - {{name: state, mountPath: /var/lib/dtpu}}
+        - {{name: pools, mountPath: /etc/dtpu}}
+      # apiserver access without tokens-in-files: the sidecar proxies
+      # localhost:8001 -> apiserver using the pod's serviceaccount
+      - name: kubectl-proxy
+        image: {kubectl_image}
+        command: ["kubectl", "proxy", "--port=8001"]
+      volumes:
+      - name: state
+        persistentVolumeClaim: {{claimName: dtpu-state}}
+      - name: pools
+        configMap: {{name: dtpu-pools}}
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: dtpu-master
+  namespace: {namespace}
+spec:
+  type: {service_type}
+  selector: {{app: dtpu-master}}
+  ports:
+  - port: {port}
+    targetPort: {port}
+---
+# headless service for trial pods: gives rank-0 pods stable DNS the
+# other ranks dial for jax.distributed rendezvous (coordinator_pattern)
+apiVersion: v1
+kind: Service
+metadata:
+  name: trainers
+  namespace: {namespace}
+spec:
+  clusterIP: None
+  selector: {{app: dtpu-trial}}
+"""
+
+UP_SH = """#!/bin/bash
+set -euo pipefail
+kubectl apply -f manifests/namespace.yaml
+kubectl apply -f manifests/rbac.yaml
+kubectl -n {namespace} create configmap dtpu-pools \\
+  --from-file=pools.json --dry-run=client -o yaml | kubectl apply -f -
+kubectl apply -f manifests/master.yaml
+kubectl -n {namespace} rollout status deploy/dtpu-master
+echo "master service:"
+kubectl -n {namespace} get svc dtpu-master
+"""
+
+DOWN_SH = """#!/bin/bash
+set -uo pipefail
+kubectl delete namespace {namespace}
+"""
+
+
+def deploy_gke(args) -> int:
+    out = os.path.abspath(args.out)
+    os.makedirs(os.path.join(out, "manifests"), exist_ok=True)
+    subs = {
+        "namespace": args.namespace,
+        "image": args.image,
+        "kubectl_image": args.kubectl_image,
+        "port": args.port,
+        "checkpoint_dir": args.checkpoint_dir,
+        "state_storage": args.state_storage,
+        "service_type": args.service_type,
+    }
+    pools = [
+        {
+            "name": "default",
+            "type": "kubernetes",
+            "kubernetes": {
+                # the kubectl-proxy sidecar: no token in this file
+                "apiserver": "http://127.0.0.1:8001",
+                "namespace": args.namespace,
+                "image": args.image,
+                "slots_per_node": args.slots_per_node,
+                "coordinator_pattern": "{job}.trainers.{namespace}.svc",
+                **({"quota_slots": args.quota_slots} if args.quota_slots else {}),
+            },
+        }
+    ]
+    files = {
+        "manifests/namespace.yaml": NAMESPACE_YAML.format(**subs),
+        "manifests/rbac.yaml": RBAC_YAML.format(**subs),
+        "manifests/master.yaml": MASTER_YAML.format(**subs),
+        "pools.json": json.dumps(pools, indent=2) + "\n",
+        "up.sh": UP_SH.format(**subs),
+        "down.sh": DOWN_SH.format(**subs),
+    }
+    for fname, content in files.items():
+        path = os.path.join(out, fname)
+        with open(path, "w") as f:
+            f.write(content)
+        if fname.endswith(".sh"):
+            os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR | stat.S_IXGRP)
+    print(f"wrote {len(files)} files to {out}")
+    print(f"review them, then: cd {out} && ./up.sh")
+    return 0
+
+
+def register(deploy_sub) -> None:
+    gke = deploy_sub.add_parser("gke")
+    gke.add_argument("--image", required=True,
+                     help="determined-tpu image (master+agent binaries + harness)")
+    gke.add_argument("--namespace", default="dtpu")
+    gke.add_argument("--port", type=int, default=8080)
+    gke.add_argument("--slots-per-node", type=int, default=4,
+                     help="TPU chips per GKE node (google.com/tpu per pod)")
+    gke.add_argument("--quota-slots", type=int, default=0,
+                     help="per-namespace in-flight slot quota (0 = unlimited)")
+    gke.add_argument("--checkpoint-dir", default="/var/lib/dtpu/checkpoints",
+                     help="shared checkpoint path (GCS fuse / Filestore mount)")
+    gke.add_argument("--state-storage", default="10Gi")
+    gke.add_argument("--service-type", default="ClusterIP",
+                     choices=["ClusterIP", "LoadBalancer"])
+    gke.add_argument("--kubectl-image", default="bitnami/kubectl:latest")
+    gke.add_argument("--out", default="./deploy-gke")
+    gke.set_defaults(fn=deploy_gke)

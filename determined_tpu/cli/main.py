@@ -465,6 +465,27 @@ def cmd_run(args) -> int:
         _time.sleep(0.5)
 
 
+def token_create(args) -> int:
+    info = _client(args).create_token(args.name, ttl_days=args.ttl_days,
+                                      username=args.username)
+    print(f"token {info['id']} ({info['name']}) for {info['username']} — "
+          f"save the secret now, it is not shown again:")
+    print(info["token"])
+    return 0
+
+
+def token_list(args) -> int:
+    _table(_client(args).list_tokens(),
+           ["id", "name", "username", "created_ms", "expires_ms"])
+    return 0
+
+
+def token_revoke(args) -> int:
+    _client(args).revoke_token(args.id)
+    print(f"revoked {args.id}")
+    return 0
+
+
 def task_list(args) -> int:
     _table(
         _client(args).list_tasks(),
@@ -804,6 +825,17 @@ def build_parser() -> argparse.ArgumentParser:
     tk = task.add_parser("kill")
     tk.add_argument("id")
     tk.set_defaults(fn=task_kill)
+
+    tok = sub.add_parser("token").add_subparsers(dest="verb", required=True)
+    tc = tok.add_parser("create")
+    tc.add_argument("name")
+    tc.add_argument("--ttl-days", type=int, default=30)
+    tc.add_argument("--username", default=None, help="admin: issue for another user")
+    tc.set_defaults(fn=token_create)
+    tok.add_parser("list").set_defaults(fn=token_list)
+    tr = tok.add_parser("revoke")
+    tr.add_argument("id")
+    tr.set_defaults(fn=token_revoke)
 
     cmd = sub.add_parser("cmd").add_subparsers(dest="verb", required=True)
     cr = cmd.add_parser("run")

@@ -520,6 +520,23 @@ class Determined:
                 raise TimeoutError(f"task {task_id} not ready after {timeout}s")
             time.sleep(0.5)
 
+    # -- named access tokens (reference internal/token/) --
+    def create_token(
+        self, name: str, ttl_days: int = 30, username: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Create a named access token.  The returned dict's ``token`` is
+        the only time the secret is shown; list/revoke use ``id``."""
+        body: Dict[str, Any] = {"name": name, "ttl_days": ttl_days}
+        if username:
+            body["username"] = username
+        return self._session.post("/api/v1/tokens", json=body).json()
+
+    def list_tokens(self) -> List[Dict[str, Any]]:
+        return self._session.get("/api/v1/tokens").json()
+
+    def revoke_token(self, token_id: str) -> None:
+        self._session.delete(f"/api/v1/tokens/{token_id}")
+
     # -- workspaces (reference api_project.go + rbac/) --
     def create_workspace(self, name: str) -> Dict[str, Any]:
         return self._session.post("/api/v1/workspaces", json={"name": name}).json()

@@ -134,6 +134,12 @@ struct UserState {
 struct TokenInfo {
   std::string username;
   int64_t expires_ms = 0;  // 0 = no expiry (legacy journal entries)
+  // named access tokens (reference master/internal/token/): listable and
+  // revocable per user WITHOUT exposing the secret again.  Session/
+  // allocation tokens keep name/id empty and never list.
+  std::string name;
+  std::string id;
+  int64_t created_ms = 0;
 };
 
 // regex monitor on task logs (reference logpattern.go): action is
@@ -598,8 +604,13 @@ class Master {
                    : (u.admin ? "admin" : "user");
       users_[ev["username"].as_string()] = u;
     } else if (type == "token_issued") {
-      tokens_[ev["token"].as_string()] = {ev["username"].as_string(),
-                                          ev["expires_ms"].as_int(0)};
+      TokenInfo info;
+      info.username = ev["username"].as_string();
+      info.expires_ms = ev["expires_ms"].as_int(0);
+      info.name = ev["name"].as_string();
+      info.id = ev["id"].as_string();
+      info.created_ms = ev["created_ms"].as_int(0);
+      tokens_[ev["token"].as_string()] = info;
     } else if (type == "token_revoked") {
       tokens_.erase(ev["token"].as_string());
     } else if (type == "log_policy") {
@@ -819,9 +830,14 @@ class Master {
     snap.set("users", users);
     Json tokens = Json::object();
     for (const auto& [tok, info] : tokens_) {
-      tokens.set(tok, Json::object()
-                          .set("username", info.username)
-                          .set("expires_ms", Json(info.expires_ms)));
+      Json t = Json::object()
+                   .set("username", info.username)
+                   .set("expires_ms", Json(info.expires_ms));
+      if (!info.id.empty()) {
+        t.set("name", info.name).set("id", info.id)
+            .set("created_ms", Json(info.created_ms));
+      }
+      tokens.set(tok, t);
     }
     snap.set("tokens", tokens);
     Json models = Json::object();
@@ -950,7 +966,13 @@ class Master {
       if (info.is_string()) {
         tokens_[tok] = {info.as_string(), 0};  // pre-expiry snapshot format
       } else {
-        tokens_[tok] = {info["username"].as_string(), info["expires_ms"].as_int(0)};
+        TokenInfo ti;
+        ti.username = info["username"].as_string();
+        ti.expires_ms = info["expires_ms"].as_int(0);
+        ti.name = info["name"].as_string();
+        ti.id = info["id"].as_string();
+        ti.created_ms = info["created_ms"].as_int(0);
+        tokens_[tok] = ti;
       }
     }
     for (const auto& [name, model] : s["models"].items()) models_[name] = model;
@@ -1105,6 +1127,31 @@ class Master {
   void revoke_token(const std::string& tok) {
     if (tok.empty() || tokens_.erase(tok) == 0) return;
     record(Json::object().set("type", "token_revoked").set("token", tok));
+  }
+
+  // Named access token (reference internal/token/postgres_token.go): the
+  // secret is returned ONCE; afterwards the token is referenced by id
+  // (list/revoke).  Caller holds mu_.
+  std::pair<std::string, std::string> issue_named_token(
+      const std::string& username, const std::string& name, int64_t ttl_ms) {
+    std::string tok = random_hex(16);
+    std::string id = "tok-" + random_hex(6);
+    TokenInfo info;
+    info.username = username;
+    info.expires_ms = ttl_ms > 0 ? now_ms() + ttl_ms : 0;
+    info.name = name;
+    info.id = id;
+    info.created_ms = now_ms();
+    tokens_[tok] = info;
+    record(Json::object()
+               .set("type", "token_issued")
+               .set("token", tok)
+               .set("username", username)
+               .set("expires_ms", Json(info.expires_ms))
+               .set("name", name)
+               .set("id", id)
+               .set("created_ms", Json(info.created_ms)));
+    return {tok, id};
   }
 
   // drop expired tokens at compaction so tokens_ / the snapshot stay
@@ -3789,6 +3836,69 @@ void install_routes_impl(Master& m, HttpServer& srv) {
                  .set("name", it->first)
                  .set("username", req.params.at("username")));
     return R::json("{}");
+  }));
+
+  // ---- named access tokens (reference internal/token/: list/revoke per
+  // user without re-exposing the secret) ----
+  srv.route("POST", "/api/v1/tokens", authed([&m, is_cluster_admin](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    const std::string name = body["name"].as_string();
+    if (name.empty()) return R::error(400, "token name required");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    std::string caller = m.authenticate(req);
+    std::string target = body["username"].is_string() && !body["username"].as_string().empty()
+                             ? body["username"].as_string()
+                             : caller;
+    if (target != caller && !is_cluster_admin(req)) {
+      return R::error(403, "creating tokens for other users requires admin");
+    }
+    if (!m.users_.count(target)) return R::error(404, "no such user");
+    int64_t ttl_ms = body["ttl_days"].as_int(30) * 24LL * 3600 * 1000;
+    auto [tok, id] = m.issue_named_token(target, name, ttl_ms);
+    // the ONLY response that ever carries the secret
+    return R::json(Json::object()
+                       .set("id", id)
+                       .set("name", name)
+                       .set("username", target)
+                       .set("token", tok)
+                       .dump(),
+                   201);
+  }));
+
+  srv.route("GET", "/api/v1/tokens", authed([&m, is_cluster_admin](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    std::string caller = m.authenticate(req);
+    bool admin = is_cluster_admin(req);
+    Json out = Json::array();
+    for (const auto& [tok, info] : m.tokens_) {
+      if (info.id.empty()) continue;  // session tokens never list
+      if (!admin && info.username != caller) continue;
+      out.push_back(Json::object()
+                        .set("id", info.id)
+                        .set("name", info.name)
+                        .set("username", info.username)
+                        .set("created_ms", Json(info.created_ms))
+                        .set("expires_ms", Json(info.expires_ms)));
+    }
+    return R::json(out.dump());
+  }));
+
+  srv.route("DELETE", "/api/v1/tokens/{id}", authed([&m, is_cluster_admin](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    std::string caller = m.authenticate(req);
+    bool admin = is_cluster_admin(req);
+    const std::string id = req.params.at("id");
+    for (const auto& [tok, info] : m.tokens_) {
+      if (info.id != id) continue;
+      if (!admin && info.username != caller) {
+        return R::error(403, "not your token");
+      }
+      std::string doomed = tok;
+      m.revoke_token(doomed);
+      return R::json("{}");
+    }
+    return R::error(404, "no such token");
   }));
 
   srv.route("GET", "/api/v1/experiments/{id}", authed([&m](const HttpRequest& req) {

@@ -55,6 +55,8 @@ EXEMPT_SUCCESS = {
     # long-polls / allocation-scoped: existence asserted only
     ("GET", "/api/v1/allocations/{id}/signals/preemption"),
     ("POST", "/api/v1/allocations/{id}/signals/ack_preemption"),
+    # revoke needs the id minted by the POST above; e2e-covered instead
+    ("DELETE", "/api/v1/tokens/{token_id}"),
 }
 
 BODIES = {
@@ -87,6 +89,7 @@ BODIES = {
     },
     ("POST", "/api/v1/groups"): {"name": "contract-group"},
     ("POST", "/api/v1/groups/{group}/members"): {"username": "determined"},
+    ("POST", "/api/v1/tokens"): {"name": "contract-token", "ttl_days": 1},
 }
 
 
@@ -106,6 +109,7 @@ def test_every_route_conforms(cluster, tmp_path):
         "project": "contract-proj",
         "group": "contract-group",
         "username": "determined",
+        "token_id": "tok-none",
     }
 
     bodies = dict(BODIES)

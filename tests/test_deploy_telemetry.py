@@ -229,3 +229,46 @@ def test_deploy_gcp_pure_autoscale_creates_no_static_agents(tmp_path):
     # the provisioner bootstraps agents from the master-side template
     master = (out / "master-startup.sh").read_text()
     assert "agent-startup.tmpl" in master
+
+
+def test_deploy_gke_generates_manifests(tmp_path):
+    """`dtpu deploy gke` emits reviewable kubernetes manifests wiring the
+    master's kubernetes pool at the cluster it runs in (reference:
+    harness/determined/deploy/gke/)."""
+    out = tmp_path / "gke"
+    r = _cli(
+        [
+            "deploy", "gke",
+            "--image", "gcr.io/p/determined-tpu:latest",
+            "--namespace", "trainers-ns",
+            "--slots-per-node", "8",
+            "--quota-slots", "64",
+            "--out", str(out),
+        ]
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    names = {p.name for p in out.iterdir()}
+    assert names == {"manifests", "pools.json", "up.sh", "down.sh"}
+    mnames = {p.name for p in (out / "manifests").iterdir()}
+    assert mnames == {"namespace.yaml", "rbac.yaml", "master.yaml"}
+
+    pools = json.loads((out / "pools.json").read_text())
+    k8s = pools[0]["kubernetes"]
+    # apiserver access rides the kubectl-proxy sidecar: NO token in files
+    assert k8s["apiserver"] == "http://127.0.0.1:8001"
+    assert "token" not in k8s
+    assert k8s["namespace"] == "trainers-ns"
+    assert k8s["slots_per_node"] == 8
+    assert k8s["quota_slots"] == 64
+    assert k8s["coordinator_pattern"] == "{job}.trainers.{namespace}.svc"
+
+    master = (out / "manifests" / "master.yaml").read_text()
+    assert "kubectl-proxy" in master
+    assert "google.com/tpu" not in master  # master pod needs no chips
+    assert "serviceAccountName: dtpu-master" in master
+    assert "clusterIP: None" in master  # headless rendezvous service
+    rbac = (out / "manifests" / "rbac.yaml").read_text()
+    assert '"jobs"' in rbac and '"watch"' in rbac  # informer needs watch
+    up = (out / "up.sh").read_text()
+    assert "kubectl apply" in up and "configmap dtpu-pools" in up
+    assert os.access(out / "up.sh", os.X_OK)

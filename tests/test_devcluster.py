@@ -2164,6 +2164,53 @@ def test_user_groups_inherit_workspace_roles(cluster, tmp_path):
     assert carol.get(f"{url}/api/v1/experiments/{exp_id}").status_code == 200
 
 
+def test_named_access_tokens(cluster):
+    """Named revocable tokens (reference master/internal/token/): the
+    secret authenticates like a session token, lists by id without the
+    secret, revocation cuts access immediately, and non-admins see only
+    their own tokens."""
+    import requests as _rq
+
+    url = cluster.url
+    r = cluster.http.post(url + "/api/v1/tokens",
+                          json={"name": "ci-bot", "ttl_days": 1})
+    assert r.status_code == 201, r.text
+    info = r.json()
+    secret, tok_id = info["token"], info["id"]
+
+    # the secret authenticates
+    s = _rq.Session()
+    s.headers.update({"Authorization": f"Bearer {secret}"})
+    assert s.get(url + "/api/v1/auth/whoami").json()["username"] == "determined"
+
+    # listing shows metadata, never the secret
+    listed = cluster.http.get(url + "/api/v1/tokens").json()
+    mine = [t for t in listed if t["id"] == tok_id]
+    assert mine and mine[0]["name"] == "ci-bot"
+    assert "token" not in mine[0]
+
+    # a non-admin user sees only their own tokens and cannot revoke others'
+    cluster.http.post(url + "/api/v1/users",
+                      json={"username": "erin", "password": "x", "role": "user"})
+    erin = _rq.Session()
+    et = erin.post(url + "/api/v1/auth/login",
+                   json={"username": "erin", "password": "x"}).json()["token"]
+    erin.headers.update({"Authorization": f"Bearer {et}"})
+    assert erin.get(url + "/api/v1/tokens").json() == []
+    assert erin.delete(f"{url}/api/v1/tokens/{tok_id}").status_code == 403
+
+    # tokens survive master restart (journaled)
+    cluster.procs["master"].send_signal(signal.SIGKILL)
+    cluster.procs["master"].wait(timeout=10)
+    cluster.start_master()
+    assert s.get(url + "/api/v1/auth/whoami").status_code == 200
+
+    # revocation cuts access immediately
+    assert cluster.http.delete(f"{url}/api/v1/tokens/{tok_id}").status_code == 200
+    assert s.get(url + "/api/v1/auth/whoami").status_code == 401
+    assert cluster.http.delete(f"{url}/api/v1/tokens/{tok_id}").status_code == 404
+
+
 def test_full_lifecycle_over_tls(tmp_path):
     """Reference core.go:694-799 TLS + certs.py trust model: master serves
     HTTPS from --tls-cert/--tls-key; the agent dials it with --master-cert
