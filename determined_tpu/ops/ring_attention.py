@@ -77,14 +77,15 @@ def _block_logits(q, k, scale, causal, q_pos, k_pos):
 # ---------------------------------------------------------------------------
 
 
-def _ring_fwd_local(q, k, v, *, axis_name, causal, scale, n_rep, count=False):
+def _ring_fwd_local(q, k, v, *, axis_name, causal, scale, n_rep):
     """Forward ring sweep; returns (out, lse, cnt) with local seq shards.
 
     k/v carry ``h_kv`` heads around the ring; expansion to the full head
     count happens per step inside the block compute.  ``cnt`` counts
-    executed half-block-equivalents (each full-shard compute = 4) when
-    ``count`` — the increments live inside the cond branches, so the
-    counter reports what actually ran.
+    executed half-block-equivalents (each full-shard compute = 4); the
+    increments live inside the cond branches, so the counter reports
+    what actually ran (``ring_block_counts`` surfaces it; the vjp
+    wrappers drop it).
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -306,7 +307,7 @@ def _attn_update(qf, k_half, v_half, q_pos, k_pos, m, l, acc, scale, n_rep):
     return m_new, l_new, acc_new
 
 
-def _zz_fwd_local(q, k, v, *, axis_name, scale, n_rep, count=False):
+def _zz_fwd_local(q, k, v, *, axis_name, scale, n_rep):
     """Zigzag causal forward.  Local shards are (lo, hi) half-chunks; per
     ring step each rank runs: hi-q × lo-k (always, fully unmasked),
     lo-q × lo-k (iff src ≤ idx), hi-q × hi-k (iff src ≥ idx) — so every
@@ -627,13 +628,13 @@ def ring_block_counts(
         if assignment == "zigzag":
             q, k, v = (zigzag_redistribute(t, seq_axis) for t in (q, k, v))
             out, _, cnt = _zz_fwd_local(
-                q, k, v, axis_name=seq_axis, scale=scale, n_rep=n_rep, count=True
+                q, k, v, axis_name=seq_axis, scale=scale, n_rep=n_rep
             )
             out = zigzag_redistribute(out, seq_axis, inverse=True)
         else:
             out, _, cnt = _ring_fwd_local(
                 q, k, v, axis_name=seq_axis, causal=causal, scale=scale,
-                n_rep=n_rep, count=True,
+                n_rep=n_rep,
             )
         return out, cnt[None]
 

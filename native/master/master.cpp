@@ -421,7 +421,7 @@ class Master {
       if (ait != allocations_.end() && !ait->second.ended) {
         if (send_kill) kill_allocation(ait->second);
         ait->second.ended = true;
-        external_cv_notify();
+        ext_cv_.notify_all();  // the worker's poll reaps the backend job
       }
     } else if (send_kill) {
       auto ait = agents_.find(t.agent_id);
@@ -447,8 +447,6 @@ class Master {
     // a task ending may unblock a queued one
     schedule_tasks();
   }
-
-  void external_cv_notify() { ext_cv_.notify_all(); }
 
   // Kill ready tasks whose proxy has been idle past their declared
   // idle_timeout_seconds (reference NTSC idle-timeout service).  The
