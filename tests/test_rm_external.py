@@ -903,13 +903,16 @@ def test_kubernetes_namespace_quota(tmp_path):
         config_a["resources"]["resource_pool"] = "k8s"
         config_a["searcher"]["max_length"] = {"batches": 500}
         exp_a = c.submit(config_a)
+        # wait on the jobs dict, not the request log: the fake records the
+        # POST before the job entry lands (a saw()-then-len race)
         deadline = time.time() + 60
+        jobs_after_a = 0
         while time.time() < deadline:
-            if kube.saw("POST", "/apis/batch/v1/namespaces/dtpu/jobs"):
+            with kube.lock:
+                jobs_after_a = len(kube.jobs)
+            if jobs_after_a >= 1:
                 break
             time.sleep(0.2)
-        with kube.lock:
-            jobs_after_a = len(kube.jobs)
         assert jobs_after_a >= 1
 
         # ...so a second 2-slot gang queues (trial PENDING, no job created)
