@@ -8,12 +8,21 @@ SDK, and the CLI.
 from __future__ import annotations
 
 import logging
+import random
 import time
 from typing import Any, Dict, Optional
 
 import requests
 
+from determined_tpu.utils import faults
+
 logger = logging.getLogger("determined_tpu.api")
+
+# Methods safe to send twice when the first attempt's fate is unknown.
+# POST is excluded by default — a duplicated POST can double-create — and
+# must opt in per call site (``retry=True``) when the endpoint is known
+# idempotent (e.g. checkpoint reports keyed by uuid).
+IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "PUT", "DELETE", "OPTIONS"})
 
 
 class APIError(Exception):
@@ -79,6 +88,17 @@ class Session:
             h["Authorization"] = f"Bearer {self.token}"
         return h
 
+    def _backoff_delay(self, attempt: int, retry_after: Optional[str] = None) -> float:
+        """Exponential backoff with +/-50% jitter so a gang of trial
+        processes retrying the same master outage doesn't stampede in
+        lockstep; an explicit ``Retry-After`` (seconds form) wins."""
+        if retry_after:
+            try:
+                return max(float(retry_after), 0.0)
+            except ValueError:
+                pass  # HTTP-date form: fall through to backoff
+        return self.BACKOFF * (2**attempt) * random.uniform(0.5, 1.5)
+
     def request(
         self,
         method: str,
@@ -87,11 +107,27 @@ class Session:
         params: Optional[Dict[str, Any]] = None,
         stream: bool = False,
         timeout: Optional[float] = None,
+        retry: Optional[bool] = None,
     ) -> requests.Response:
+        """One master request with bounded retries.
+
+        Only idempotent methods retry by default; ``retry`` overrides in
+        either direction (a POST to an idempotent endpoint may opt in, a
+        GET that must not repeat may opt out).  429 responses are retried
+        for every method — rate-limited requests were not executed — and
+        429/503 honor the server's ``Retry-After``.
+        """
         url = self.master_url + (path if path.startswith("/") else "/" + path)
+        retryable = retry if retry is not None else method.upper() in IDEMPOTENT_METHODS
+        attempts = self.RETRIES if retryable else 1
         last: Optional[Exception] = None
-        for attempt in range(self.RETRIES):
+        attempt = 0
+        rate_limited = 0  # 429s retry for every method, on their own counter
+        while attempt < attempts:
             try:
+                # inside the try so an injected ConnectionError exercises
+                # the same retry machinery the real fault would
+                faults.fire("api.request", method=method, path=path, attempt=attempt)
                 resp = self._http.request(
                     method,
                     url,
@@ -103,15 +139,35 @@ class Session:
                 )
             except requests.ConnectionError as e:
                 last = e
-                if attempt < self.RETRIES - 1:
-                    time.sleep(self.BACKOFF * (2**attempt))
+                attempt += 1
+                if attempt < attempts:
+                    time.sleep(self._backoff_delay(attempt - 1))
                 continue
             if resp.status_code == 404:
                 raise NotFoundError(404, resp.text)
+            if resp.status_code == 429:
+                # not executed server-side: safe to retry any method —
+                # unless the caller explicitly opted out of all retries
+                last = APIError(429, resp.text)
+                if retry is False:
+                    raise last
+                rate_limited += 1
+                if rate_limited >= self.RETRIES:
+                    raise last
+                time.sleep(
+                    self._backoff_delay(rate_limited - 1, resp.headers.get("Retry-After"))
+                )
+                continue
             if resp.status_code >= 500:
                 last = APIError(resp.status_code, resp.text)
-                if attempt < self.RETRIES - 1:
-                    time.sleep(self.BACKOFF * (2**attempt))
+                attempt += 1
+                if attempt < attempts:
+                    retry_after = (
+                        resp.headers.get("Retry-After")
+                        if resp.status_code == 503
+                        else None
+                    )
+                    time.sleep(self._backoff_delay(attempt - 1, retry_after))
                 continue
             if resp.status_code >= 400:
                 raise APIError(resp.status_code, resp.text)
@@ -135,8 +191,14 @@ class Session:
 
 
 def login(master_url: str, username: str = "determined", password: str = "") -> Session:
-    """Authenticate and return a token-carrying Session."""
+    """Authenticate and return a token-carrying Session.  Login is safe to
+    repeat (each attempt just mints a token), so the POST opts into
+    retries — masters are commonly still coming up when clients connect."""
     s = Session(master_url)
-    resp = s.post("/api/v1/auth/login", json={"username": username, "password": password})
+    resp = s.post(
+        "/api/v1/auth/login",
+        json={"username": username, "password": password},
+        retry=True,
+    )
     token = resp.json().get("token")
     return Session(master_url, token=token)

@@ -3,6 +3,7 @@
 from determined_tpu.config.experiment import (
     CheckpointStorageConfig,
     ExperimentConfig,
+    FaultToleranceConfig,
     InvalidExperimentConfig,
     Length,
     ReproducibilityConfig,
@@ -27,6 +28,7 @@ from determined_tpu.config.hyperparameters import (
 __all__ = [
     "CheckpointStorageConfig",
     "ExperimentConfig",
+    "FaultToleranceConfig",
     "InvalidExperimentConfig",
     "Length",
     "ReproducibilityConfig",

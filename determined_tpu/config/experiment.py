@@ -237,6 +237,45 @@ class OptimizationsConfig:
         return cls(**raw)
 
 
+@dataclasses.dataclass(frozen=True)
+class FaultToleranceConfig:
+    """Supervised-restart + checkpoint-integrity knobs.
+
+    ``max_restarts`` (top-level, reference expconf) bounds how many
+    TRANSIENT failures the trial supervisor absorbs; these fields shape
+    the behavior of each restart: exponential backoff (base * 2^restarts,
+    capped, jittered so a gang's processes don't stampede the master) and
+    whether resume requires a verified integrity manifest.
+    """
+
+    restart_backoff_base: float = 1.0     # seconds before the first restart
+    restart_backoff_cap: float = 60.0     # ceiling on any single delay
+    restart_backoff_jitter: float = 0.25  # +/- fraction applied to the delay
+    verify_checkpoints: bool = True       # manifest-verify on resume
+    heartbeat_failure_threshold: int = 5  # consecutive misses -> master_unreachable
+
+    def __post_init__(self):
+        if self.restart_backoff_base < 0 or self.restart_backoff_cap < 0:
+            raise InvalidExperimentConfig("fault_tolerance backoff values must be >= 0")
+        if not (0 <= self.restart_backoff_jitter <= 1):
+            raise InvalidExperimentConfig(
+                "fault_tolerance.restart_backoff_jitter must be in [0, 1]"
+            )
+        if self.heartbeat_failure_threshold < 1:
+            raise InvalidExperimentConfig(
+                "fault_tolerance.heartbeat_failure_threshold must be >= 1"
+            )
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "FaultToleranceConfig":
+        raw = dict(raw or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise InvalidExperimentConfig(f"unknown fault_tolerance fields: {sorted(unknown)}")
+        return cls(**raw)
+
+
 _LOG_POLICY_ACTIONS = ("cancel_retries", "exclude_node")
 
 
@@ -301,6 +340,9 @@ class ExperimentConfig:
     min_checkpoint_period: Optional[Length] = None
     records_per_epoch: int = 0
     max_restarts: int = 5
+    fault_tolerance: FaultToleranceConfig = dataclasses.field(
+        default_factory=FaultToleranceConfig
+    )
     reproducibility: ReproducibilityConfig = dataclasses.field(
         default_factory=ReproducibilityConfig
     )
@@ -367,6 +409,8 @@ class ExperimentConfig:
             kwargs["reproducibility"] = ReproducibilityConfig(**raw.pop("reproducibility"))
         if "optimizations" in raw:
             kwargs["optimizations"] = OptimizationsConfig.parse(raw.pop("optimizations"))
+        if "fault_tolerance" in raw:
+            kwargs["fault_tolerance"] = FaultToleranceConfig.parse(raw.pop("fault_tolerance"))
         if "log_policies" in raw:
             policies = raw.pop("log_policies") or []
             if not isinstance(policies, list):

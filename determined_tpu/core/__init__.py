@@ -5,10 +5,14 @@ from determined_tpu.core._distributed import (  # noqa: F401
     allocate_port,
 )
 from determined_tpu.core._checkpoint import (  # noqa: F401
+    MANIFEST_FILE,
+    METADATA_FILE,
     CheckpointContext,
     DummyCheckpointContext,
+    build_manifest,
     merge_metadata,
     merge_resources,
+    verify_manifest,
 )
 from determined_tpu.core._metrics import MetricsContext  # noqa: F401
 from determined_tpu.core._train import TrainContext, EarlyExitReason  # noqa: F401

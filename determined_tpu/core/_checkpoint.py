@@ -26,11 +26,85 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from determined_tpu.core._distributed import DistributedContext
 from determined_tpu.storage.base import StorageManager, file_md5, list_directory
-from determined_tpu.utils.errors import ShardMergeConflictError
+from determined_tpu.utils.errors import CheckpointCorruptError, ShardMergeConflictError
 
 logger = logging.getLogger("determined_tpu.core.checkpoint")
 
 METADATA_FILE = "metadata.json"
+MANIFEST_FILE = "manifest.json"
+MANIFEST_VERSION = 1
+
+
+def build_manifest(
+    resources: Dict[str, int],
+    digests: Dict[str, str],
+    parent: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Integrity manifest for one checkpoint: per-file sizes + md5 digests.
+
+    Uploaded as the ATOMIC LAST step of finalize, so its presence asserts
+    every listed file landed completely — a trial killed mid-upload leaves
+    no manifest and the checkpoint is visibly incomplete (the reference
+    never resumes from a checkpoint the master hasn't recorded as
+    COMPLETED; the manifest is the storage-plane analog of that record).
+    ``parent`` names the previous good checkpoint so a verifier that
+    rejects this one can fall back.
+    """
+    files: Dict[str, Any] = {}
+    for rel, size in resources.items():
+        if rel.endswith("/") or rel == MANIFEST_FILE:
+            continue
+        entry: Dict[str, Any] = {"size": int(size)}
+        if digests.get(rel):
+            entry["md5"] = digests[rel]
+        files[rel] = entry
+    return {"version": MANIFEST_VERSION, "parent": parent, "files": files}
+
+
+def verify_manifest(path: str, require_manifest: bool = False) -> bool:
+    """Check a local checkpoint directory against its manifest.
+
+    Returns True when verified, False when no manifest exists (legacy /
+    foreign checkpoint) and ``require_manifest`` is unset.  Raises
+    ``CheckpointCorruptError`` on a missing-but-required manifest, an
+    unreadable manifest, or any size/digest mismatch — the caller must
+    treat the checkpoint as poison and fall back.
+    """
+    mf = os.path.join(path, MANIFEST_FILE)
+    if not os.path.exists(mf):
+        if require_manifest:
+            raise CheckpointCorruptError(
+                f"checkpoint at {path} has no {MANIFEST_FILE}: finalize never "
+                "completed (killed mid-upload?)"
+            )
+        logger.warning(
+            "checkpoint at %s has no %s; skipping integrity verification", path, MANIFEST_FILE
+        )
+        return False
+    try:
+        with open(mf) as f:
+            manifest = json.load(f)
+        files = dict(manifest["files"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise CheckpointCorruptError(f"unreadable manifest at {mf}: {e}") from e
+    problems: List[str] = []
+    for rel, entry in files.items():
+        full = os.path.join(path, rel)
+        if not os.path.isfile(full):
+            problems.append(f"{rel}: missing")
+            continue
+        size = os.path.getsize(full)
+        if size != entry.get("size"):
+            problems.append(f"{rel}: size {size} != manifest {entry.get('size')}")
+            continue
+        want = entry.get("md5")
+        if want and file_md5(full) != want:
+            problems.append(f"{rel}: md5 mismatch")
+    if problems:
+        raise CheckpointCorruptError(
+            f"checkpoint at {path} failed manifest verification: {'; '.join(problems)}"
+        )
+    return True
 
 
 def merge_resources(
@@ -62,6 +136,16 @@ def merge_resources(
             merged[rel] = size
             owner[rel] = rank
             digests[rel] = rank_digests.get(rel, "")
+    return merged
+
+
+def _merge_digests(all_digests: List[Dict[str, str]]) -> Dict[str, str]:
+    """First-writer-wins union of per-rank digest maps; conflicts were
+    already rejected by ``merge_resources``."""
+    merged: Dict[str, str] = {}
+    for rank_digests in all_digests:
+        for rel, digest in rank_digests.items():
+            merged.setdefault(rel, digest)
     return merged
 
 
@@ -124,7 +208,12 @@ class CheckpointContext:
             selected = set(paths)
             self._storage.upload(ckpt_dir, storage_id, paths=paths)
             resources = {p: sz for p, sz in list_directory(ckpt_dir).items() if p in selected}
-            self._finalize(storage_id, resources, dict(metadata or {}))
+            digests = {
+                p: file_md5(os.path.join(ckpt_dir, p))
+                for p in paths
+                if not p.endswith("/") and p != METADATA_FILE
+            }
+            self._finalize(storage_id, resources, dict(metadata or {}), digests)
             return storage_id
         return self._upload_sharded(ckpt_dir, metadata, selector)
 
@@ -156,7 +245,9 @@ class CheckpointContext:
             assert gathered is not None
             merged = merge_resources([g[0] for g in gathered], [g[1] for g in gathered])
             merged_md = merge_metadata([g[2] for g in gathered])
-            self._finalize(storage_id, merged, merged_md)
+            self._finalize(
+                storage_id, merged, merged_md, _merge_digests([g[1] for g in gathered])
+            )
         self._dist.barrier()
         return storage_id
 
@@ -166,8 +257,16 @@ class CheckpointContext:
             return names
         return [n for n in names if n.endswith("/") or selector(n)]
 
-    def _finalize(self, storage_id: str, resources: Dict[str, int], metadata: Dict[str, Any]) -> None:
-        """Write merged metadata into the checkpoint and report to master."""
+    def _finalize(
+        self,
+        storage_id: str,
+        resources: Dict[str, int],
+        metadata: Dict[str, Any],
+        digests: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Write merged metadata, then the integrity manifest (the ATOMIC
+        last step — its presence certifies the whole upload), then report
+        to the master."""
         metadata = dict(metadata)
         metadata.setdefault("format", "determined_tpu")
         with tempfile.TemporaryDirectory() as td:
@@ -175,6 +274,19 @@ class CheckpointContext:
             with open(md_path, "w") as f:
                 json.dump(metadata, f, indent=2, sort_keys=True)
             self._storage.upload(td, storage_id, paths=[METADATA_FILE])
+            # the manifest covers the data files AND the metadata file just
+            # written; anything that dies between here and the manifest
+            # upload leaves a checkpoint that verification rejects
+            full = dict(resources)
+            full[METADATA_FILE] = os.path.getsize(md_path)
+            all_digests = dict(digests or {})
+            all_digests[METADATA_FILE] = file_md5(md_path)
+            manifest = build_manifest(
+                full, all_digests, parent=metadata.get("parent_storage_id")
+            )
+            with open(os.path.join(td, MANIFEST_FILE), "w") as f:
+                json.dump(manifest, f, indent=2, sort_keys=True)
+            self._storage.upload(td, storage_id, paths=[MANIFEST_FILE])
         self._report_checkpoint(storage_id, resources, metadata)
 
     def _report_checkpoint(
@@ -185,6 +297,8 @@ class CheckpointContext:
         if self._session is None:
             return
         try:
+            # keyed by uuid server-side, so a duplicate report is a no-op:
+            # safe to opt this POST into transport retries
             self._session.post(
                 "/api/v1/checkpoints",
                 json={
@@ -193,6 +307,7 @@ class CheckpointContext:
                     "resources": resources,
                     "metadata": metadata,
                 },
+                retry=True,
             )
         except Exception:  # noqa: BLE001 - reporting must not kill training
             logger.exception("failed to report checkpoint %s to master", storage_id)
@@ -209,8 +324,8 @@ class CheckpointContext:
             storage_id = str(uuid_mod.uuid4())
             with self._storage.store_path(storage_id, self._staging_dir) as path:
                 yield path, storage_id
-                resources = list_directory(path)
-            self._finalize(storage_id, resources, dict(metadata or {}))
+                resources, digests = self._list_and_digest(path)
+            self._finalize(storage_id, resources, dict(metadata or {}), digests)
             return
         storage_id = self._dist.broadcast(
             str(uuid_mod.uuid4()) if self._dist.is_chief else None
@@ -260,7 +375,9 @@ class CheckpointContext:
             # md5 equality keeps that legal while catching real conflicts.
             merged = merge_resources([g[0] for g in gathered], [g[1] for g in gathered])
             merged_md = merge_metadata([g[2] for g in gathered])
-            self._finalize(storage_id, merged, merged_md)
+            self._finalize(
+                storage_id, merged, merged_md, _merge_digests([g[1] for g in gathered])
+            )
         self._dist.barrier()
 
     def store_path_async(
@@ -291,10 +408,10 @@ class CheckpointContext:
 
             def finish() -> None:
                 try:
-                    resources = list_directory(path)
+                    resources, digests = self._list_and_digest(path)
                 finally:
                     cm.__exit__(None, None, None)
-                self._finalize(storage_id, resources, metadata)
+                self._finalize(storage_id, resources, metadata, digests)
 
             return path, storage_id, finish
 
@@ -365,13 +482,25 @@ class CheckpointContext:
 
     @contextlib.contextmanager
     def restore_path(
-        self, storage_id: str, selector: Optional[Callable[[str], bool]] = None
+        self,
+        storage_id: str,
+        selector: Optional[Callable[[str], bool]] = None,
+        *,
+        verify: bool = True,
+        require_manifest: bool = False,
     ) -> Iterator[str]:
         """Yield a local path containing the checkpoint.
 
         Download-once-per-host semantics (reference ``DownloadMode`` /
         ``restore_path:599``): the local chief downloads (or direct-mounts
         for shared_fs), others wait on the local star.
+
+        The local chief verifies the integrity manifest before any rank
+        sees the path (skipped for partial ``selector`` restores).  With
+        ``require_manifest`` a manifest-less checkpoint — e.g. one whose
+        writer was killed mid-upload, before finalize — is rejected as
+        corrupt rather than trusted; resume paths set this so a partial
+        upload can never poison a resume.
         """
         if self._dist.is_local_chief:
             try:
@@ -382,6 +511,13 @@ class CheckpointContext:
                 # leaving them hanging on the local star until timeout.
                 self._dist.broadcast_local(("error", f"{type(e).__name__}: {e}"))
                 raise
+            if verify and selector is None:
+                try:
+                    verify_manifest(path, require_manifest=require_manifest)
+                except Exception as e:
+                    self._dist.broadcast_local(("error", f"{type(e).__name__}: {e}"))
+                    cm.__exit__(None, None, None)
+                    raise
             try:
                 self._dist.broadcast_local(("ok", path))
                 try:
@@ -394,6 +530,20 @@ class CheckpointContext:
         else:
             status, payload = self._dist.broadcast_local(None)
             if status == "error":
+                # corruption must surface as the same type on every rank so
+                # the fallback walk (Trainer._restore_checkpoint) stays in
+                # lockstep across the gang
+                if str(payload).startswith(
+                    ("CheckpointCorruptError", "CheckpointNotFoundError")
+                ):
+                    from determined_tpu.utils.errors import CheckpointNotFoundError
+
+                    cls = (
+                        CheckpointCorruptError
+                        if str(payload).startswith("CheckpointCorruptError")
+                        else CheckpointNotFoundError
+                    )
+                    raise cls(f"local chief failed to restore checkpoint: {payload}")
                 raise RuntimeError(f"local chief failed to restore checkpoint: {payload}")
             try:
                 yield payload
@@ -403,19 +553,44 @@ class CheckpointContext:
     def delete(self, storage_id: str, globs: Optional[List[str]] = None) -> Dict[str, int]:
         if not self._dist.is_chief:
             raise RuntimeError("delete must only be called on the chief")
+        if globs is not None:
+            # a partial delete invalidates the integrity manifest; drop it
+            # too so the checkpoint reads as "unverified" rather than
+            # "corrupt" (resume paths with require_manifest still reject it)
+            globs = list(globs) + [MANIFEST_FILE]
         return self._storage.delete(storage_id, globs)
 
     def get_metadata(self, storage_id: str) -> Dict[str, Any]:
+        return self._fetch_json(storage_id, METADATA_FILE)
+
+    def get_manifest(self, storage_id: str) -> Dict[str, Any]:
+        """The integrity manifest alone ({} when absent/unreadable)."""
+        return self._fetch_json(storage_id, MANIFEST_FILE)
+
+    def get_checkpoint_parent(self, storage_id: str) -> Optional[str]:
+        """Previous good checkpoint in this trial's lineage, for fallback
+        after a failed verification.  Manifest first; the metadata copy
+        covers a checkpoint killed between its metadata and manifest
+        uploads."""
+        parent = self.get_manifest(storage_id).get("parent")
+        if parent:
+            return parent
+        return self.get_metadata(storage_id).get("parent_storage_id") or None
+
+    def _fetch_json(self, storage_id: str, name: str) -> Dict[str, Any]:
         with tempfile.TemporaryDirectory() as td:
             try:
-                self._storage.download(storage_id, td, selector=lambda p: p == METADATA_FILE)
+                self._storage.download(storage_id, td, selector=lambda p: p == name)
             except Exception:
                 return {}
-            md = os.path.join(td, METADATA_FILE)
-            if not os.path.exists(md):
+            full = os.path.join(td, name)
+            if not os.path.exists(full):
                 return {}
-            with open(md) as f:
-                return json.load(f)
+            try:
+                with open(full) as f:
+                    return json.load(f)
+            except ValueError:
+                return {}
 
 
 class DummyCheckpointContext(CheckpointContext):

@@ -55,6 +55,19 @@ class Context:
         self._heartbeat = heartbeat
         self._log_shipper = log_shipper
 
+    @property
+    def metrics(self) -> MetricsContext:
+        """Public handle for out-of-band reporters (e.g. the trial
+        supervisor's restart counts)."""
+        return self._metrics
+
+    @property
+    def master_unreachable(self) -> bool:
+        """True while the heartbeat reporter has latched a failure streak
+        (``_heartbeat.py``); False off-cluster.  The trial supervisor and
+        preemption path consult this to act locally during a partition."""
+        return bool(self._heartbeat is not None and self._heartbeat.master_unreachable)
+
     def alert(
         self,
         title: Optional[str] = None,
@@ -179,8 +192,15 @@ def init(
             storage_manager.base_path, "traces", f"trial_{info.trial_id}"
         )
     profiler = ProfilerContext(distributed, metrics, trace_dir=trace_dir)
+    hb_threshold = None
+    if info is not None:
+        hb_threshold = (
+            ((info.exp_config or {}).get("fault_tolerance") or {}).get(
+                "heartbeat_failure_threshold"
+            )
+        )
     heartbeat = (
-        HeartbeatReporter(session, info.trial_id)
+        HeartbeatReporter(session, info.trial_id, failure_threshold=hb_threshold)
         if session is not None and info is not None and info.trial_id is not None
         else None
     )

@@ -27,9 +27,17 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from determined_tpu.utils import faults
+from determined_tpu.utils.errors import PeerLostError
+
 logger = logging.getLogger("determined_tpu.core.distributed")
 
 _LEN = struct.Struct(">Q")
+
+# A connection that never sends its hello is half-open (SYN landed, the
+# process died, or a port scanner poked us): give it this long, then drop
+# it without consuming a worker slot.
+HELLO_TIMEOUT = 30.0
 
 
 def allocate_port(host: str = "127.0.0.1") -> int:
@@ -82,22 +90,47 @@ class _StarServer:
             self._accept_thread.start()
 
     def _accept_loop(self) -> None:
+        """Accept until all workers have identified themselves.
+
+        Each accepted connection handshakes on its OWN thread with a hello
+        deadline, so one half-open connection (peer died after SYN, or a
+        stray scanner) is dropped and logged instead of serially blocking
+        every later worker's rendezvous.
+        """
         try:
-            while True:
-                with self._lock:
-                    if len(self._conns) >= self.n_workers:
-                        break
-                conn, _ = self._listener.accept()
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                hello = _recv_msg(conn)
-                with self._lock:
-                    self._conns[hello["rank"]] = conn
-                    done = len(self._conns) >= self.n_workers
-                if done:
-                    break
+            while not self._ready.is_set():
+                conn, addr = self._listener.accept()
+                threading.Thread(
+                    target=self._handshake, args=(conn, addr), daemon=True
+                ).start()
         except OSError:
             return  # listener closed during shutdown
-        self._ready.set()
+
+    def _handshake(self, conn: socket.socket, addr: Any) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(HELLO_TIMEOUT)
+        try:
+            hello = _recv_msg(conn)
+            rank = int(hello["rank"])
+        except Exception as e:  # noqa: BLE001 - drop, log, keep the slot free
+            logger.warning(
+                "dropping half-open/garbled connection from %s (no hello within "
+                "%.0fs: %s)",
+                addr,
+                HELLO_TIMEOUT,
+                e,
+            )
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        conn.settimeout(None)  # collectives set their own deadlines
+        with self._lock:
+            self._conns[rank] = conn
+            done = len(self._conns) >= self.n_workers
+        if done:
+            self._ready.set()
 
     def wait_ready(self, timeout: float) -> None:
         if not self._ready.wait(timeout):
@@ -112,14 +145,32 @@ class _StarServer:
         self.wait_ready(timeout)
         out: Dict[int, Any] = {0: own} if 0 not in self._conns else {}
         for rank, conn in self._conns.items():
-            out[rank] = _recv_msg(conn)
+            # hard deadline: a dead peer must surface as PeerLostError, not
+            # hang the gang forever on a blocking recv
+            conn.settimeout(timeout)
+            try:
+                out[rank] = _recv_msg(conn)
+            except socket.timeout as e:
+                raise PeerLostError(
+                    f"gather: rank {rank} sent nothing within {timeout:.0f}s"
+                ) from e
+            except (ConnectionError, OSError) as e:
+                raise PeerLostError(f"gather: rank {rank} connection lost: {e}") from e
         # ranks of workers + chief's own slot; caller supplies ordering map
         return [out[k] for k in sorted(out)]
 
     def scatter_same(self, value: Any, timeout: float) -> None:
         self.wait_ready(timeout)
-        for conn in self._conns.values():
-            _send_msg(conn, value)
+        for rank, conn in self._conns.items():
+            conn.settimeout(timeout)
+            try:
+                _send_msg(conn, value)
+            except socket.timeout as e:
+                raise PeerLostError(
+                    f"scatter: rank {rank} not draining within {timeout:.0f}s"
+                ) from e
+            except (ConnectionError, OSError) as e:
+                raise PeerLostError(f"scatter: rank {rank} connection lost: {e}") from e
 
     def close(self) -> None:
         for c in self._conns.values():
@@ -146,13 +197,26 @@ class _StarClient:
         else:
             raise ConnectionError(f"could not reach chief at {addr}:{port}: {last_err}")
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # create_connection left the connect timeout installed as the socket
+        # timeout: every later send/recv inherits the deadline, so a dead
+        # chief surfaces as PeerLostError instead of an eternal block
         _send_msg(self._sock, {"rank": rank})
 
     def send(self, obj: Any) -> None:
-        _send_msg(self._sock, obj)
+        try:
+            _send_msg(self._sock, obj)
+        except socket.timeout as e:
+            raise PeerLostError(f"send to chief timed out: {e}") from e
+        except (ConnectionError, OSError) as e:
+            raise PeerLostError(f"chief connection lost during send: {e}") from e
 
     def recv(self) -> Any:
-        return _recv_msg(self._sock)
+        try:
+            return _recv_msg(self._sock)
+        except socket.timeout as e:
+            raise PeerLostError(f"no reply from chief within deadline: {e}") from e
+        except (ConnectionError, OSError) as e:
+            raise PeerLostError(f"chief connection lost during recv: {e}") from e
 
     def close(self) -> None:
         try:
@@ -192,6 +256,7 @@ class _Star:
         self.client = _StarClient(addr, port, self.group_rank, self.timeout)
 
     def gather(self, obj: Any) -> Optional[List[Any]]:
+        faults.fire("distributed.gather", rank=self.group_rank)
         if self.size <= 1:
             return [obj]
         self._ensure_connected()
@@ -202,6 +267,7 @@ class _Star:
         return None
 
     def allgather(self, obj: Any) -> List[Any]:
+        faults.fire("distributed.allgather", rank=self.group_rank)
         if self.size <= 1:
             return [obj]
         self._ensure_connected()
@@ -214,6 +280,7 @@ class _Star:
         return self.client.recv()
 
     def broadcast(self, obj: Any) -> Any:
+        faults.fire("distributed.broadcast", rank=self.group_rank)
         if self.size <= 1:
             return obj
         self._ensure_connected()
@@ -291,9 +358,21 @@ class DistributedContext:
     @classmethod
     def from_jax(cls, timeout: float = 600.0) -> "DistributedContext":
         """Build from an initialized ``jax.distributed`` runtime plus the
-        DTPU_* rendezvous env vars written by the launch layer."""
+        DTPU_* rendezvous env vars written by the launch layer.
+
+        The timeout doubles as the collective I/O deadline (a silent peer
+        past it raises PeerLostError).  Deployments whose checkpoints take
+        longer than 10 minutes to restore/upload — workers legitimately
+        sit in a barrier that long — raise DTPU_COLLECTIVE_TIMEOUT.
+        """
         import jax
 
+        env_timeout = os.environ.get("DTPU_COLLECTIVE_TIMEOUT")
+        if env_timeout:
+            try:
+                timeout = float(env_timeout)
+            except ValueError:
+                logger.warning("ignoring malformed DTPU_COLLECTIVE_TIMEOUT=%r", env_timeout)
         size = jax.process_count()
         rank = jax.process_index()
         chief_addr = os.environ.get("DTPU_CHIEF_ADDR", "127.0.0.1")

@@ -20,12 +20,34 @@ logger = logging.getLogger("determined_tpu.core.heartbeat")
 
 class HeartbeatReporter:
     INTERVAL = 30.0
+    # consecutive failures before the master is declared unreachable; a
+    # single dropped POST is routine, a streak means a partition/outage
+    FAILURE_THRESHOLD = 5
 
-    def __init__(self, session: Any, trial_id: int) -> None:
+    def __init__(
+        self, session: Any, trial_id: int, failure_threshold: Optional[int] = None
+    ) -> None:
         self._session = session
         self._trial_id = trial_id
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True, name="heartbeat")
+        self._failure_threshold = failure_threshold or self.FAILURE_THRESHOLD
+        self._failure_streak = 0
+        self._unreachable = threading.Event()
+
+    @property
+    def failure_streak(self) -> int:
+        """Consecutive heartbeat failures (0 after any success)."""
+        return self._failure_streak
+
+    @property
+    def master_unreachable(self) -> bool:
+        """Latched after ``failure_threshold`` consecutive failures; the
+        supervisor / preemption path observes this to make local decisions
+        (e.g. checkpoint without waiting on a master ack) instead of
+        treating a partition as business as usual.  Cleared when a
+        heartbeat lands again."""
+        return self._unreachable.is_set()
 
     def start(self) -> "HeartbeatReporter":
         self._thread.start()
@@ -33,10 +55,38 @@ class HeartbeatReporter:
 
     def _run(self) -> None:
         while not self._stop.wait(self.INTERVAL):
-            try:
-                self._session.post(f"/api/v1/trials/{self._trial_id}/heartbeat")
-            except Exception:  # noqa: BLE001
-                logger.debug("heartbeat failed", exc_info=True)
+            self._beat()
+
+    def _beat(self) -> bool:
+        """One heartbeat attempt; returns success.  Split from the thread
+        loop so the failure-streak accounting is directly testable."""
+        try:
+            self._session.post(f"/api/v1/trials/{self._trial_id}/heartbeat")
+        except Exception:  # noqa: BLE001 - counted, not swallowed silently
+            self._failure_streak += 1
+            if self._failure_streak >= self._failure_threshold and not self._unreachable.is_set():
+                self._unreachable.set()
+                logger.warning(
+                    "master unreachable: %d consecutive heartbeat failures "
+                    "(threshold %d); latching master_unreachable",
+                    self._failure_streak,
+                    self._failure_threshold,
+                )
+            else:
+                logger.warning(
+                    "heartbeat failed (streak %d/%d)",
+                    self._failure_streak,
+                    self._failure_threshold,
+                    exc_info=True,
+                )
+            return False
+        if self._unreachable.is_set():
+            logger.warning(
+                "master reachable again after %d missed heartbeats", self._failure_streak
+            )
+        self._failure_streak = 0
+        self._unreachable.clear()
+        return True
 
     def close(self) -> None:
         self._stop.set()

@@ -63,8 +63,11 @@ class TrainContext:
         self._last_progress = progress
         if self._session is not None and self._trial_id is not None:
             try:
+                # progress is a last-writer-wins scalar: idempotent, opt in
                 self._session.post(
-                    f"/api/v1/trials/{self._trial_id}/progress", json={"progress": progress}
+                    f"/api/v1/trials/{self._trial_id}/progress",
+                    json={"progress": progress},
+                    retry=True,
                 )
             except Exception:  # noqa: BLE001
                 logger.exception("failed to report progress")

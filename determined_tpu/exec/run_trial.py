@@ -275,6 +275,95 @@ def _self_report_exit(code: int) -> None:
         pass
 
 
+class TrialSupervisor:
+    """Supervised trial execution: one attempt = one fresh ``Trainer``
+    driven through ``fit``; failures are classified (``utils/errors.py``)
+    and TRANSIENT ones re-enter ``fit(latest_checkpoint=...)`` from the
+    newest FINALIZED checkpoint with exponential backoff, up to the
+    experiment's ``max_restarts``.
+
+    This is the harness-side analog of the reference master's allocation
+    restart policy (``master/internal/trial.go``): on a TPU VM the agent
+    execs the trial directly, so the retry loop that the master's
+    allocation services provide for container jobs runs in-process here.
+    Restart counts ship through the metrics context (group ``restarts``)
+    so the master/UI can surface them against the trial record.
+
+    Imports of the training stack are deferred: this class must be
+    constructible before ``_apply_environment_early`` has run (jax reads
+    XLA_FLAGS/JAX_PLATFORMS at import time).
+    """
+
+    def __init__(
+        self,
+        trainer_factory,
+        *,
+        policy=None,
+        metrics=None,
+        master_unreachable=None,
+        sleep=None,
+    ) -> None:
+        self._trainer_factory = trainer_factory
+        self._policy = policy
+        self._metrics = metrics
+        self._master_unreachable = master_unreachable
+        self._sleep = sleep
+        self._trainer = None
+        self.restarts = 0
+
+    def run(self, max_length, *, latest_checkpoint=None, **fit_kwargs):
+        import time
+
+        from determined_tpu.train._restart import RestartPolicy, run_with_restarts
+
+        policy = self._policy or RestartPolicy()
+        logger = logging.getLogger("determined_tpu.exec.supervisor")
+
+        def attempt(latest):
+            self._trainer = self._trainer_factory()
+            return self._trainer.fit(
+                max_length, latest_checkpoint=latest, **fit_kwargs
+            )
+
+        def get_latest_checkpoint():
+            return self._trainer.latest_checkpoint if self._trainer is not None else None
+
+        def on_failure(att) -> None:
+            self.restarts = att.restarts
+            unreachable = bool(self._master_unreachable and self._master_unreachable())
+            if unreachable:
+                logger.warning(
+                    "master unreachable (heartbeat streak latched) while handling "
+                    "trial failure; restart decisions proceed locally"
+                )
+            if self._metrics is not None:
+                steps = self._trainer.steps_completed if self._trainer is not None else 0
+                try:
+                    self._metrics.report(
+                        "restarts",
+                        steps,
+                        {
+                            "restarts": att.restarts,
+                            "failure_kind": att.kind.value,
+                            "error": repr(att.exc),
+                            "resume_checkpoint": att.latest_checkpoint,
+                            "backoff_seconds": att.delay,
+                            "master_unreachable": unreachable,
+                        },
+                    )
+                except Exception:  # noqa: BLE001 - reporting must not mask the failure
+                    logger.exception("failed to report restart metrics")
+
+        return run_with_restarts(
+            attempt,
+            policy=policy,
+            initial_checkpoint=latest_checkpoint,
+            get_latest_checkpoint=get_latest_checkpoint,
+            on_failure=on_failure,
+            sleep=self._sleep or time.sleep,
+        )
+
+
 class _RankPrefixStream:
     """Line-wise rank prefixer over a text stream — the analog of the
     reference's per-rank log wrapper (``launch/wrap_rank.py``), so
@@ -378,27 +467,43 @@ def main() -> int:
         prof = exp_config.profiling or {}
         if prof.get("enabled"):
             core_ctx.profiler.on(sampling=True, trace=bool(prof.get("trace", False)))
-        ctx = train.init(
-            hparams=cluster.hparams,
-            exp_config=exp_config,
-            core_context=core_ctx,
-            seed=cluster.trial_seed,
-        )
-        trainer = train.Trainer(trial_cls(ctx))
+
+        def make_trainer():
+            # one fresh Trainer per attempt: params/opt state re-init and
+            # are immediately overwritten by the checkpoint restore; loop
+            # and loader state never leak across a crashed attempt
+            ctx = train.init(
+                hparams=cluster.hparams,
+                exp_config=exp_config,
+                core_context=core_ctx,
+                seed=cluster.trial_seed,
+            )
+            return train.Trainer(trial_cls(ctx))
+
         scfg = exp_config.searcher
         max_length = scfg.max_length or exp_config.min_validation_period
         if max_length is None:
             from determined_tpu.config.experiment import Length
 
             max_length = Length.batches(scfg.max_time or 100)
-        summary = trainer.fit(
+        from determined_tpu.train._restart import RestartPolicy
+
+        supervisor = TrialSupervisor(
+            make_trainer,
+            policy=RestartPolicy.from_exp_config(exp_config),
+            metrics=core_ctx.metrics,
+            master_unreachable=lambda: core_ctx.master_unreachable,
+        )
+        summary = supervisor.run(
             max_length,
             validation_period=exp_config.min_validation_period,
             checkpoint_period=exp_config.min_checkpoint_period,
             latest_checkpoint=cluster.latest_checkpoint,
             checkpoint_policy=exp_config.checkpoint_policy,
         )
-        logger.info("trial finished: %s", summary)
+        logger.info(
+            "trial finished: %s (restarts=%d)", summary, summary.get("restarts", 0)
+        )
         return 0
     finally:
         core_ctx.close()

@@ -16,6 +16,8 @@ import os
 import shutil
 from typing import Callable, Dict, Iterator, List, Optional
 
+from determined_tpu.utils import faults
+
 
 def file_md5(path: str, chunk: int = 1 << 20) -> str:
     h = hashlib.md5()
@@ -43,14 +45,44 @@ def list_directory(root: str) -> Dict[str, int]:
 
 
 class StorageManager(abc.ABC):
-    """Upload/download whole checkpoint directories keyed by storage_id."""
+    """Upload/download whole checkpoint directories keyed by storage_id.
+
+    ``upload``/``download`` are template methods wrapping the backend
+    ``_upload``/``_download`` implementations so every backend shares the
+    fault-injection hook points (``utils/faults.py``) — a test can fail
+    the Nth put or drop a get on any backend without patching it.
+    """
 
     # True when store_path/restore_path expose the durable directory itself
     # (shared_fs): no staging copy, and every rank may use the same path.
     direct_store = False
 
-    @abc.abstractmethod
     def upload(
+        self,
+        src: str,
+        storage_id: str,
+        paths: Optional[List[str]] = None,
+        progress: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        faults.fire(
+            "storage.upload", manager=self, src=src, storage_id=storage_id, paths=paths
+        )
+        self._upload(src, storage_id, paths=paths, progress=progress)
+        faults.fire(
+            "storage.upload.done", manager=self, src=src, storage_id=storage_id, paths=paths
+        )
+
+    def download(
+        self,
+        storage_id: str,
+        dst: str,
+        selector: Optional[Callable[[str], bool]] = None,
+    ) -> None:
+        faults.fire("storage.download", manager=self, storage_id=storage_id, dst=dst)
+        self._download(storage_id, dst, selector=selector)
+
+    @abc.abstractmethod
+    def _upload(
         self,
         src: str,
         storage_id: str,
@@ -60,7 +92,7 @@ class StorageManager(abc.ABC):
         ...
 
     @abc.abstractmethod
-    def download(
+    def _download(
         self,
         storage_id: str,
         dst: str,

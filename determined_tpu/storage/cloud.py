@@ -46,7 +46,7 @@ class _BlobStorageManager(StorageManager):
     def _delete(self, keys: List[str]) -> None:
         raise NotImplementedError
 
-    def upload(self, src, storage_id, paths=None, progress=None) -> None:
+    def _upload(self, src, storage_id, paths=None, progress=None) -> None:
         names = paths if paths is not None else list(list_directory(src))
         done = 0
         for rel in names:
@@ -57,7 +57,7 @@ class _BlobStorageManager(StorageManager):
             if progress:
                 progress(done)
 
-    def download(self, storage_id, dst, selector=None) -> None:
+    def _download(self, storage_id, dst, selector=None) -> None:
         base = self._key(storage_id)
         files = self._list(base)
         if not files:

@@ -22,7 +22,7 @@ class SharedFSStorageManager(StorageManager):
     def _ckpt_dir(self, storage_id: str) -> str:
         return os.path.join(self.base_path, storage_id)
 
-    def upload(self, src, storage_id, paths=None, progress=None) -> None:
+    def _upload(self, src, storage_id, paths=None, progress=None) -> None:
         dst = self._ckpt_dir(storage_id)
         os.makedirs(dst, exist_ok=True)
         names = paths if paths is not None else list(list_directory(src))
@@ -38,7 +38,7 @@ class SharedFSStorageManager(StorageManager):
             if progress:
                 progress(done)
 
-    def download(
+    def _download(
         self, storage_id: str, dst: str, selector: Optional[Callable[[str], bool]] = None
     ) -> None:
         src = self._ckpt_dir(storage_id)

@@ -2,20 +2,24 @@
 
 from determined_tpu.train._load import load_trial_from_checkpoint
 from determined_tpu.train._reducer import MetricReducer, get_reducer
+from determined_tpu.train._restart import Attempt, RestartPolicy, run_with_restarts
 from determined_tpu.train._state import TrainState
 from determined_tpu.train._trainer import Trainer, init
 from determined_tpu.train._trial import Callback, JaxTrial, TrialContext
 from determined_tpu.train import serialization
 
 __all__ = [
+    "Attempt",
     "Callback",
     "JaxTrial",
     "MetricReducer",
+    "RestartPolicy",
     "TrainState",
     "Trainer",
     "TrialContext",
     "get_reducer",
     "init",
     "load_trial_from_checkpoint",
+    "run_with_restarts",
     "serialization",
 ]

@@ -41,6 +41,8 @@ from determined_tpu.parallel.sharding import (
 from determined_tpu.train._state import TrainState
 from determined_tpu.train._trial import Callback, JaxTrial, TrialContext
 from determined_tpu.train import serialization
+from determined_tpu.utils import faults
+from determined_tpu.utils.errors import CheckpointCorruptError, CheckpointNotFoundError
 
 logger = logging.getLogger("determined_tpu.train")
 
@@ -182,6 +184,12 @@ class Trainer:
         self.agg = 1  # aggregation_frequency, set from exp config in _setup
         self._pending_save: Optional[_PendingSave] = None
         self._snapshot_jit: Any = None
+        # Newest FINALIZED checkpoint (manifest written, master reported).
+        # An async save still in flight is deliberately excluded: until its
+        # drain-point finalize runs it has no manifest and must never be
+        # offered as a resume point.  The supervisor reads this after a
+        # crash to know where the next attempt resumes from.
+        self.latest_checkpoint: Optional[str] = None
 
     # -- setup -------------------------------------------------------------
 
@@ -234,13 +242,16 @@ class Trainer:
         #    propagates the param shardings into mirror leaves (adam mu/nu);
         # 3. replicate every remaining leaf (scalars, rng) over the mesh so
         #    the whole TrainState lives on one consistent device set.
-        with self.mesh:
-            params = jax.jit(
-                lambda r: flax_meta.unbox(self.trial.init_params(self.model, r, sample)),
-                out_shardings=shardings,  # init directly sharded: no single-
-            )(init_rng)                   # device materialization at FSDP scale
-        with self.mesh:
-            opt_state = jax.jit(self.tx.init)(params)
+        # NO ambient mesh here: flax >= 0.10 applies each Partitioned box's
+        # LOGICAL names as a sharding constraint whenever a global mesh is
+        # active, and logical names are not mesh axes.  out_shardings carry
+        # the mesh explicitly, so init still materializes directly sharded
+        # (no single-device materialization at FSDP scale).
+        params = jax.jit(
+            lambda r: flax_meta.unbox(self.trial.init_params(self.model, r, sample)),
+            out_shardings=shardings,
+        )(init_rng)
+        opt_state = jax.jit(self.tx.init)(params)
         self.state = TrainState.create(params, opt_state, state_rng, metric_keys)
         self.state = self._place_on_mesh(self.state)
 
@@ -422,6 +433,7 @@ class Trainer:
                 f"async checkpoint {p.storage_id} failed"
             ) from p.errors[0]
         p.finish()
+        self.latest_checkpoint = p.storage_id
         for cb in self.callbacks.values():
             cb.on_checkpoint_write_end(p.storage_id)
         logger.info("checkpoint %s at step %d", p.storage_id, p.step)
@@ -452,6 +464,10 @@ class Trainer:
         metadata = {
             "steps_completed": self.steps_completed,
             "framework": "determined_tpu",
+            # lineage pointer: lets a resume that finds THIS checkpoint
+            # corrupt fall back to the previous good one (the manifest
+            # carries a copy; this survives a kill before the manifest)
+            "parent_storage_id": self.latest_checkpoint,
         }
         if not (asynchronous and self._async_checkpointing()):
             with self.core.checkpoint.store_path(metadata, shard=shard) as (path, sid):
@@ -460,6 +476,7 @@ class Trainer:
                 serialization.save_arrays(path, array_state)
                 if dist.is_chief:
                     serialization.save_trainer_state(path, trainer_state)
+            self.latest_checkpoint = sid
             for cb in self.callbacks.values():
                 cb.on_checkpoint_write_end(sid)
             logger.info("checkpoint %s at step %d", sid, self.steps_completed)
@@ -491,10 +508,54 @@ class Trainer:
         logger.info("async checkpoint %s started at step %d", sid, self.steps_completed)
         return sid
 
+    def _verify_on_restore(self) -> bool:
+        cfg = self.context.exp_config
+        ft = getattr(cfg, "fault_tolerance", None) if cfg is not None else None
+        return ft.verify_checkpoints if ft is not None else True
+
     def _restore_checkpoint(self, storage_id: str) -> None:
-        with self.core.checkpoint.restore_path(storage_id) as path:
-            self.restore_from_path(path)
-        logger.info("restored checkpoint %s at step %d", storage_id, self.steps_completed)
+        """Restore with manifest verification, walking the parent lineage
+        on corruption.
+
+        Trainer-written checkpoints always end finalize with a manifest,
+        so resume requires one (``require_manifest=True``): a checkpoint
+        whose writer died mid-upload has no manifest and is rejected, and
+        a truncated/bit-flipped file fails the size/md5 check — either way
+        the restore falls back to the checkpoint's recorded parent instead
+        of silently resuming from poison (reference: the master only ever
+        resumes from checkpoints it recorded as COMPLETED).
+        """
+        verify = self._verify_on_restore()
+        sid: Optional[str] = storage_id
+        tried = []
+        while sid:
+            try:
+                with self.core.checkpoint.restore_path(
+                    sid, verify=verify, require_manifest=verify
+                ) as path:
+                    self.restore_from_path(path)
+                self.latest_checkpoint = sid
+                if tried:
+                    logger.warning(
+                        "resumed from fallback checkpoint %s (rejected: %s)",
+                        sid,
+                        ", ".join(tried),
+                    )
+                logger.info("restored checkpoint %s at step %d", sid, self.steps_completed)
+                return
+            except (CheckpointCorruptError, CheckpointNotFoundError) as e:
+                logger.warning("checkpoint %s unusable for resume: %s", sid, e)
+                tried.append(sid)
+                parent = self.core.checkpoint.get_checkpoint_parent(sid)
+                if parent in tried:
+                    break  # defensive: a lineage cycle must not loop forever
+                sid = parent
+        raise CheckpointCorruptError(
+            f"no usable checkpoint in lineage of {storage_id} "
+            f"(tried: {', '.join(tried)}); checkpoints written before the "
+            "manifest era can be resumed by setting "
+            "fault_tolerance.verify_checkpoints: false"
+        )
 
     def restore_from_path(self, path: str) -> None:
         """Load arrays + trainer state from an already-local checkpoint dir
@@ -636,6 +697,9 @@ class Trainer:
             # for models that annotate activations without an explicit mesh
             with self.mesh:
                 while self.steps_completed < next_stop:
+                    # fault-injection hook: tests crash a step here to
+                    # exercise the supervised-restart path (no-op in prod)
+                    faults.fire("train.step", step=self.steps_completed)
                     if self.agg > 1:
                         micros = [next(train_iter) for _ in range(self.agg)]
                         host_batch = {

@@ -26,12 +26,59 @@ ARRAY_SUBDIR = "state"
 TRAINER_STATE_FILE = "trainer_state.json"
 
 
+def _is_key_dtype(dtype: Any) -> bool:
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(dtype, jax.dtypes.prng_key)
+
+
+def _unkey(tree: Any) -> Any:
+    """Replace PRNG-key leaves with their uint32 key data.  Orbax's array
+    serializer cannot np.array() extended-dtype key arrays, so keys ride
+    as raw counter words and are re-wrapped on restore."""
+
+    def one(x):
+        if isinstance(x, jax.Array) and _is_key_dtype(x.dtype):
+            return jax.random.key_data(x)
+        return x
+
+    return jax.tree.map(one, tree)
+
+
+def _unkey_abstract(abstract_tree: Any) -> Any:
+    """The data-plane aval tree matching ``_unkey``'s output: key leaves
+    become their key-data ShapeDtypeStructs (same sharding; trailing
+    counter dims are unconstrained by a PartitionSpec prefix)."""
+
+    def one(a):
+        if _is_key_dtype(getattr(a, "dtype", None)):
+            data = jax.eval_shape(jax.random.key_data, jax.ShapeDtypeStruct(a.shape, a.dtype))
+            return jax.ShapeDtypeStruct(
+                data.shape, data.dtype, sharding=getattr(a, "sharding", None)
+            )
+        return a
+
+    return jax.tree.map(one, abstract_tree)
+
+
+def _rekey(restored: Any, abstract_tree: Any) -> Any:
+    """Re-wrap restored key-data leaves into key arrays of the impl the
+    abstract tree's dtype carries."""
+
+    def one(x, a):
+        if _is_key_dtype(getattr(a, "dtype", None)):
+            return jax.random.wrap_key_data(x, impl=a.dtype._impl)
+        return x
+
+    return jax.tree.map(one, restored, abstract_tree)
+
+
 def save_arrays(ckpt_dir: str, tree: Any) -> None:
     """Write a pytree of (possibly sharded) jax arrays; collective across
     processes — every process must call with the same tree structure."""
     path = os.path.join(os.path.abspath(ckpt_dir), ARRAY_SUBDIR)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, tree)
+        ckptr.save(path, _unkey(tree))
         ckptr.wait_until_finished()
 
 
@@ -41,7 +88,7 @@ def restore_arrays(ckpt_dir: str, abstract_tree: Any) -> Any:
     shardings)."""
     path = os.path.join(os.path.abspath(ckpt_dir), ARRAY_SUBDIR)
     with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(path, abstract_tree)
+        restored = _rekey(ckptr.restore(path, _unkey_abstract(abstract_tree)), abstract_tree)
     # Belt-and-braces: guarantee placement matches the requested shardings
     # (a replicated scalar must span the mesh, not sit on one device, or the
     # next jitted step sees incompatible device sets).  No-op when already

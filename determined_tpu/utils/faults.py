@@ -1,0 +1,56 @@
+"""Fault-injection hook points.
+
+Production code calls ``fire(site, **info)`` at the places where real
+infrastructure fails: the training step, storage puts/gets, master API
+requests, control-plane collectives.  With no injector installed (the
+default, always in production) a fire is one ``is None`` check — safe in
+hot paths.  Tests install an injector (``tests/faults.py FaultInjector``)
+that can raise at a site to simulate a crash, drop a peer, or fail a
+storage put; the exception then propagates exactly like the real fault
+would, exercising the supervised-restart / manifest-fallback machinery
+end to end.
+
+Sites currently wired (a site is just a dotted string; injectors may
+glob-match):
+
+- ``train.step``          before each optimizer step (``step=``)
+- ``storage.upload``      before a StorageManager upload (``manager=, src=, storage_id=, paths=``)
+- ``storage.upload.done`` after a successful upload (same info)
+- ``storage.download``    before a StorageManager download (``manager=, storage_id=, dst=``)
+- ``api.request``         before each master HTTP request (``method=, path=``)
+- ``distributed.gather`` / ``distributed.allgather`` / ``distributed.broadcast``
+                          before each control-plane collective (``rank=``)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Protocol
+
+
+class Injector(Protocol):
+    def fire(self, site: str, **info: Any) -> None: ...
+
+
+_injector: Optional[Injector] = None
+_lock = threading.Lock()
+
+
+def set_fault_injector(injector: Optional[Injector]) -> None:
+    """Install (or with None, remove) the process-global injector."""
+    global _injector
+    with _lock:
+        _injector = injector
+
+
+def get_fault_injector() -> Optional[Injector]:
+    return _injector
+
+
+def fire(site: str, **info: Any) -> None:
+    """Hook point: no-op unless an injector is installed.  An injector's
+    ``fire`` may raise — the exception propagates to the call site like
+    the real fault it simulates."""
+    inj = _injector
+    if inj is not None:
+        inj.fire(site, **info)

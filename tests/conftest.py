@@ -22,6 +22,26 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection tests (crash/corrupt/drop-peer; tier-1, tight timeouts)",
+    )
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_injector():
+    """A test that forgets to uninstall its FaultInjector must not poison
+    the rest of the suite."""
+    from determined_tpu.utils import faults
+
+    yield
+    faults.set_fault_injector(None)
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

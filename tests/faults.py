@@ -1,0 +1,191 @@
+"""Reusable fault-injection harness for the determined_tpu test suite.
+
+``FaultInjector`` installs itself as the process-global injector that the
+production hook points (``determined_tpu/utils/faults.py``) consult:
+``Trainer`` fires before every optimizer step, ``StorageManager`` before
+and after every upload/download, ``Session`` before every master request,
+and the control-plane ``_Star`` before every collective.  Rules match
+sites by glob, optionally gate on the fire's info dict, run a bounded
+number of times, and either raise (simulating the fault) or run an
+arbitrary side effect (e.g. truncating a checkpoint file "mid-upload").
+
+Typical use::
+
+    inj = FaultInjector()
+    inj.kill_at_step(10)            # crash the 11th optimizer step once
+    inj.fail_storage_puts(2)        # first two uploads raise OSError
+    with inj.installed():
+        run_with_restarts(...)
+    assert inj.count("train.step") > 0
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import fnmatch
+import os
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from determined_tpu.utils import faults
+from determined_tpu.utils.errors import PeerLostError, TransientError
+
+
+class SimulatedCrash(TransientError):
+    """Stands in for a worker crash / TPU preemption mid-step: classified
+    TRANSIENT so the supervised-restart path handles it."""
+
+
+@dataclasses.dataclass
+class _Rule:
+    site_glob: str
+    action: Callable[[Dict[str, Any]], None]
+    remaining: Optional[int]  # None = unlimited
+    when: Optional[Callable[[Dict[str, Any]], bool]]
+    fired: int = 0
+
+
+class FaultInjector:
+    def __init__(self, seed: Optional[int] = None) -> None:
+        self._rules: List[_Rule] = []
+        self._counts: Dict[str, int] = {}
+        self.rng = random.Random(seed)
+
+    # -- the hook the production code calls --------------------------------
+
+    def fire(self, site: str, **info: Any) -> None:
+        self._counts[site] = self._counts.get(site, 0) + 1
+        for rule in self._rules:
+            if rule.remaining == 0:
+                continue
+            if not fnmatch.fnmatch(site, rule.site_glob):
+                continue
+            if rule.when is not None and not rule.when(info):
+                continue
+            if rule.remaining is not None:
+                rule.remaining -= 1
+            rule.fired += 1
+            rule.action(info)
+
+    def count(self, site: str) -> int:
+        """How many times a site fired (matched or not)."""
+        return self._counts.get(site, 0)
+
+    # -- rule registration --------------------------------------------------
+
+    def on(
+        self,
+        site_glob: str,
+        action: Callable[[Dict[str, Any]], None],
+        *,
+        times: Optional[int] = 1,
+        when: Optional[Callable[[Dict[str, Any]], bool]] = None,
+    ) -> _Rule:
+        rule = _Rule(site_glob, action, times, when)
+        self._rules.append(rule)
+        return rule
+
+    def raise_at(
+        self,
+        site_glob: str,
+        exc_factory: Callable[[], BaseException],
+        *,
+        times: Optional[int] = 1,
+        when: Optional[Callable[[Dict[str, Any]], bool]] = None,
+    ) -> _Rule:
+        def action(info: Dict[str, Any]) -> None:
+            raise exc_factory()
+
+        return self.on(site_glob, action, times=times, when=when)
+
+    # -- canned faults -------------------------------------------------------
+
+    def kill_at_step(self, step: int, *, times: Optional[int] = 1) -> _Rule:
+        """Crash the training loop when it reaches optimizer step ``step``."""
+        return self.raise_at(
+            "train.step",
+            lambda: SimulatedCrash(f"injected crash at step {step}"),
+            times=times,
+            when=lambda info: info.get("step") == step,
+        )
+
+    def kill_every_step_from(self, step: int) -> _Rule:
+        """Crash EVERY attempt once it reaches ``step`` — the trial can
+        never finish; used to exhaust the restart budget."""
+        return self.raise_at(
+            "train.step",
+            lambda: SimulatedCrash(f"injected persistent crash at step {step}"),
+            times=None,
+            when=lambda info: info.get("step", -1) >= step,
+        )
+
+    def fail_storage_puts(self, n: int) -> _Rule:
+        """The next ``n`` storage uploads raise (transient blob-store 5xx)."""
+        return self.raise_at(
+            "storage.upload",
+            lambda: OSError("injected storage put failure"),
+            times=n,
+        )
+
+    def fail_storage_gets(self, n: int) -> _Rule:
+        return self.raise_at(
+            "storage.download",
+            lambda: OSError("injected storage get failure"),
+            times=n,
+        )
+
+    def fail_api_requests(self, n: int, *, path_glob: str = "*") -> _Rule:
+        """The next ``n`` master API requests raise a ConnectionError."""
+        import requests
+
+        return self.raise_at(
+            "api.request",
+            lambda: requests.ConnectionError("injected master outage"),
+            times=n,
+            when=lambda info: fnmatch.fnmatch(info.get("path", ""), path_glob),
+        )
+
+    def drop_peer(self, rank: int, *, times: Optional[int] = 1) -> _Rule:
+        """A control-plane collective on ``rank`` dies as if the process
+        were lost — the surviving ranks' deadline surfaces PeerLostError."""
+        return self.raise_at(
+            "distributed.*",
+            lambda: PeerLostError(f"injected loss of rank {rank}"),
+            times=times,
+            when=lambda info: info.get("rank") == rank,
+        )
+
+    # -- direct corruption helpers (no hook needed) -------------------------
+
+    @staticmethod
+    def truncate_file(path: str, keep_bytes: Optional[int] = None) -> None:
+        """Chop a file as a kill-mid-upload would: keep half by default."""
+        size = os.path.getsize(path)
+        keep = size // 2 if keep_bytes is None else keep_bytes
+        with open(path, "rb+") as f:
+            f.truncate(keep)
+
+    @staticmethod
+    def bit_flip(path: str, offset: Optional[int] = None) -> None:
+        """Flip one bit in place (size-preserving corruption: only a
+        digest check can catch it)."""
+        size = os.path.getsize(path)
+        assert size > 0, f"cannot bit-flip empty file {path}"
+        pos = size // 2 if offset is None else offset
+        with open(path, "rb+") as f:
+            f.seek(pos)
+            byte = f.read(1)
+            f.seek(pos)
+            f.write(bytes([byte[0] ^ 0x01]))
+
+    # -- installation --------------------------------------------------------
+
+    @contextlib.contextmanager
+    def installed(self) -> Iterator["FaultInjector"]:
+        prev = faults.get_fault_injector()
+        faults.set_fault_injector(self)
+        try:
+            yield self
+        finally:
+            faults.set_fault_injector(prev)

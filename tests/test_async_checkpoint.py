@@ -210,3 +210,44 @@ def test_async_write_failure_surfaces_at_drain(tmp_path, monkeypatch):
             checkpoint_period=Length.batches(2),
             checkpoint_policy="none",
         )
+
+
+def test_async_written_checkpoint_corruption_falls_back(tmp_path):
+    """Corruption of an async-written checkpoint is caught by its manifest
+    on resume, and the restore falls back to its parent (also async-written)
+    — the fault-tolerance guarantees hold on the overlapped save path."""
+    import os
+
+    from tests.faults import FaultInjector
+
+    ctx = make_context(tmp_path)
+    trainer = train.Trainer(MnistTrial(ctx))
+    result = trainer.fit(
+        Length.batches(8),
+        checkpoint_period=Length.batches(4),
+        report_period=Length.batches(4),
+        checkpoint_policy="none",
+    )
+    sid_b = result["latest_checkpoint"]  # step-8 save (async, drained at exit)
+    store = str(tmp_path / "ckpts")
+    ckpt_ctx = core._dummy_init(checkpoint_dir=store).checkpoint
+    sid_a = ckpt_ctx.get_checkpoint_parent(sid_b)
+    assert sid_a is not None
+    assert ckpt_ctx.get_metadata(sid_a)["steps_completed"] == 4
+
+    # corrupt the biggest file of the newest checkpoint
+    root = os.path.join(store, sid_b)
+    files = [
+        os.path.join(dp, f)
+        for dp, _d, fs in os.walk(root)
+        for f in fs
+        if f != "manifest.json" and os.path.getsize(os.path.join(dp, f)) > 0
+    ]
+    FaultInjector.truncate_file(max(files, key=os.path.getsize))
+
+    ctx2 = make_context(tmp_path)
+    trainer2 = train.Trainer(MnistTrial(ctx2))
+    trainer2._setup()
+    trainer2._restore_checkpoint(sid_b)
+    assert trainer2.steps_completed == 4  # fell back to the step-4 parent
+    assert trainer2.latest_checkpoint == sid_a

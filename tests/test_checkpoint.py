@@ -158,3 +158,110 @@ def test_non_chief_plain_upload_raises(tmp_path):
         return True
 
     assert Execution(2).run(fn) == [True, True]
+
+
+# -- integrity manifests (fault-tolerance satellite) -------------------------
+
+
+def _finalized_ckpt(tmp_path, content="weights" * 100):
+    dist = DummyDistributedContext()
+    store = str(tmp_path / "store")
+    ctx = CheckpointContext(dist, SharedFSStorageManager(store))
+    src = tmp_path / "src"
+    _write(str(src / "model.bin"), content)
+    uuid = ctx.upload(str(src), metadata={"steps_completed": 3})
+    return ctx, store, uuid
+
+
+def test_manifest_written_as_finalize_last_step(tmp_path):
+    from determined_tpu.core import MANIFEST_FILE, verify_manifest
+
+    ctx, store, uuid = _finalized_ckpt(tmp_path)
+    ckpt_dir = os.path.join(store, uuid)
+    manifest = json.load(open(os.path.join(ckpt_dir, MANIFEST_FILE)))
+    assert manifest["version"] == 1
+    files = manifest["files"]
+    # data file AND the metadata file are covered, with sizes + md5s
+    assert set(files) == {"model.bin", "metadata.json"}
+    assert files["model.bin"]["size"] == os.path.getsize(os.path.join(ckpt_dir, "model.bin"))
+    assert len(files["model.bin"]["md5"]) == 32
+    assert verify_manifest(ckpt_dir) is True
+
+
+def test_truncated_checkpoint_rejected_by_manifest(tmp_path):
+    from determined_tpu.utils.errors import CheckpointCorruptError
+    from tests.faults import FaultInjector
+
+    ctx, store, uuid = _finalized_ckpt(tmp_path)
+    FaultInjector.truncate_file(os.path.join(store, uuid, "model.bin"))
+    with pytest.raises(CheckpointCorruptError, match="size"):
+        with ctx.restore_path(uuid):
+            raise AssertionError("must not yield a corrupt checkpoint")
+    # verification can be bypassed explicitly (e.g. forensic download)
+    with ctx.restore_path(uuid, verify=False) as path:
+        assert os.path.exists(os.path.join(path, "model.bin"))
+
+
+def test_bit_flipped_checkpoint_rejected_by_manifest(tmp_path):
+    """Size-preserving corruption: only the md5 digest can catch it."""
+    from determined_tpu.utils.errors import CheckpointCorruptError
+    from tests.faults import FaultInjector
+
+    ctx, store, uuid = _finalized_ckpt(tmp_path)
+    victim = os.path.join(store, uuid, "model.bin")
+    size_before = os.path.getsize(victim)
+    FaultInjector.bit_flip(victim)
+    assert os.path.getsize(victim) == size_before
+    with pytest.raises(CheckpointCorruptError, match="md5"):
+        with ctx.restore_path(uuid):
+            raise AssertionError("must not yield a corrupt checkpoint")
+
+
+def test_missing_manifest_lenient_by_default_rejected_when_required(tmp_path):
+    from determined_tpu.core import MANIFEST_FILE
+    from determined_tpu.utils.errors import CheckpointCorruptError
+
+    ctx, store, uuid = _finalized_ckpt(tmp_path)
+    os.remove(os.path.join(store, uuid, MANIFEST_FILE))
+    # lenient default: legacy/foreign checkpoints still restore (warned)
+    with ctx.restore_path(uuid) as path:
+        assert os.path.exists(os.path.join(path, "model.bin"))
+    # resume paths demand the manifest: absence = killed mid-upload
+    with pytest.raises(CheckpointCorruptError, match="manifest"):
+        with ctx.restore_path(uuid, require_manifest=True):
+            raise AssertionError("must not yield an unfinalized checkpoint")
+
+
+def test_partial_delete_drops_stale_manifest(tmp_path):
+    from determined_tpu.core import MANIFEST_FILE
+
+    dist = DummyDistributedContext()
+    ctx = CheckpointContext(dist, SharedFSStorageManager(str(tmp_path / "store")))
+    src = tmp_path / "src"
+    _write(str(src / "keep.txt"), "k")
+    _write(str(src / "drop.log"), "d")
+    uuid = ctx.upload(str(src))
+    remaining = ctx.delete(uuid, globs=["*.log"])
+    # the manifest no longer matches the survivors; it must go too so the
+    # checkpoint reads as unverified, not corrupt
+    assert MANIFEST_FILE not in remaining
+    assert "keep.txt" in remaining
+
+
+def test_sharded_store_path_writes_verifiable_manifest(tmp_path):
+    from determined_tpu.core import verify_manifest
+
+    store = str(tmp_path / "store")
+
+    def fn(dist, rank):
+        ctx = CheckpointContext(dist, SharedFSStorageManager(store))
+        with ctx.store_path(metadata={"steps_completed": 3}, shard=True) as (path, uuid):
+            _write(os.path.join(path, f"part-{rank}"), str(rank) * 50)
+        return uuid
+
+    uuids = Execution(2).run(fn)
+    assert len(set(uuids)) == 1
+    ckpt_dir = os.path.join(store, uuids[0])
+    assert verify_manifest(ckpt_dir, require_manifest=True) is True
+    manifest = json.load(open(os.path.join(ckpt_dir, "manifest.json")))
+    assert {"part-0", "part-1", "metadata.json"} <= set(manifest["files"])

@@ -1,0 +1,666 @@
+"""Fault-tolerant trial execution, end to end.
+
+The scenarios the reference platform survives in production (PAPER.md
+fault tolerance: restart-from-checkpoint up to max_restarts, never resume
+from a partial checkpoint) exercised locally through the fault-injection
+harness (``tests/faults.py``):
+
+- a trial killed mid-step resumes from the latest FINALIZED checkpoint and
+  reaches the same final step count as an uninterrupted run;
+- preemption checkpoints, exits cleanly, and a relaunch resumes;
+- a truncated checkpoint is rejected by manifest verification and resume
+  falls back to the previous good checkpoint;
+- restarts stop after ``max_restarts`` with a FATAL classification;
+- plus unit coverage of the taxonomy, backoff policy, heartbeat streak,
+  idempotent-only session retries, and control-plane peer-loss deadlines.
+"""
+
+import os
+import socket
+
+import pytest
+import requests
+
+from determined_tpu import core, train
+from determined_tpu.api.session import APIError, Session
+from determined_tpu.config import ExperimentConfig, Length
+from determined_tpu.core import _distributed as dist_mod
+from determined_tpu.core._distributed import _StarClient, _StarServer
+from determined_tpu.core._heartbeat import HeartbeatReporter
+from determined_tpu.exec.run_trial import TrialSupervisor
+from determined_tpu.models.mnist import MnistTrial
+from determined_tpu.parallel.mesh import MeshConfig
+from determined_tpu.train._restart import RestartPolicy, run_with_restarts
+from determined_tpu.utils.errors import (
+    CheckpointCorruptError,
+    FailureKind,
+    FatalTrialError,
+    InvalidConfigError,
+    PeerLostError,
+    PreemptedError,
+    RestartBudgetExhaustedError,
+    TransientError,
+    classify_failure,
+)
+from tests.faults import FaultInjector, SimulatedCrash
+from tests.parallel_utils import Execution
+
+pytestmark = pytest.mark.faults
+
+HPARAMS = {"lr": 1e-2, "hidden": 16, "global_batch_size": 16, "dataset_size": 64}
+
+SYNC_CKPT = ExperimentConfig.parse({"optimizations": {"async_checkpointing": False}})
+
+
+def make_factory(base_dir, exp_config=None, trainers=None):
+    """Trainer factory over ONE durable checkpoint dir, as the supervisor
+    uses: every attempt gets a fresh Trainer against the same storage."""
+
+    def factory():
+        core_ctx = core._dummy_init(checkpoint_dir=str(base_dir / "ckpts"))
+        ctx = train.init(
+            hparams=dict(HPARAMS),
+            mesh_config=MeshConfig(data=2),
+            core_context=core_ctx,
+            exp_config=exp_config,
+            seed=7,
+        )
+        t = train.Trainer(MnistTrial(ctx))
+        if trainers is not None:
+            trainers.append(t)
+        return t
+
+    return factory
+
+
+def fast_policy(max_restarts=2):
+    return RestartPolicy(max_restarts=max_restarts, backoff_base=0.0, jitter=0.0)
+
+
+# ---------------------------------------------------------------------------
+# acceptance scenario 1: crash mid-step -> resume -> same final step count
+# ---------------------------------------------------------------------------
+
+
+def test_crash_mid_step_resumes_to_same_final_step_count(tmp_path):
+    # uninterrupted reference run
+    ref = make_factory(tmp_path / "ref", SYNC_CKPT)()
+    ref_summary = ref.fit(
+        Length.batches(12),
+        checkpoint_period=Length.batches(4),
+        report_period=Length.batches(4),
+    )
+    assert ref_summary["steps_completed"] == 12
+
+    inj = FaultInjector()
+    inj.kill_at_step(6)
+    supervisor = TrialSupervisor(
+        make_factory(tmp_path / "sup", SYNC_CKPT),
+        policy=fast_policy(),
+        sleep=lambda s: None,
+    )
+    with inj.installed():
+        summary = supervisor.run(
+            Length.batches(12),
+            checkpoint_period=Length.batches(4),
+            report_period=Length.batches(4),
+        )
+    assert summary["steps_completed"] == ref_summary["steps_completed"] == 12
+    assert summary["restarts"] == 1
+    # attempt 1 fired steps 0..6 (7; the 7th raised); attempt 2 resumed from
+    # the step-4 checkpoint and fired 4..11 (8).  A from-scratch restart
+    # would have fired 19 times — 15 proves checkpoint resume.
+    assert inj.count("train.step") == 15
+
+
+def test_crash_with_async_save_in_flight_resumes_from_finalized_only(tmp_path):
+    """An async save that never reached its drain-point finalize has no
+    manifest and must NOT be the resume point; the last FINALIZED save is."""
+    trainers = []
+    inj = FaultInjector()
+    inj.kill_at_step(5)
+    resume_points = []
+
+    factory = make_factory(tmp_path, exp_config=None, trainers=trainers)
+
+    def attempt(latest):
+        resume_points.append(latest)
+        t = factory()
+        return t.fit(
+            Length.batches(8),
+            checkpoint_period=Length.batches(2),
+            report_period=Length.batches(8),
+            checkpoint_policy="none",
+        )
+
+    with inj.installed():
+        summary = run_with_restarts(
+            attempt,
+            policy=fast_policy(),
+            get_latest_checkpoint=lambda: trainers[-1].latest_checkpoint,
+            sleep=lambda s: None,
+        )
+    assert summary["steps_completed"] == 8
+    assert summary["restarts"] == 1
+    # attempt 1: step-2 save finalized at the step-4 boundary drain; the
+    # step-4 save was still in flight at the kill -> resume is the step-2 sid
+    sid = resume_points[1]
+    assert sid is not None and sid == trainers[0].latest_checkpoint
+    ckpt_ctx = core._dummy_init(checkpoint_dir=str(tmp_path / "ckpts")).checkpoint
+    assert ckpt_ctx.get_metadata(sid)["steps_completed"] == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance scenario 2: preemption -> clean exit -> relaunch resumes
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_checkpoints_exits_and_relaunch_resumes(tmp_path):
+    trainers = []
+    factory = make_factory(tmp_path, SYNC_CKPT, trainers=trainers)
+    inj = FaultInjector()
+    inj.on(
+        "train.step",
+        lambda info: trainers[-1].core.preempt.simulate(),
+        when=lambda info: info.get("step") == 5,
+        times=1,
+    )
+    supervisor = TrialSupervisor(factory, policy=fast_policy(), sleep=lambda s: None)
+    with inj.installed():
+        summary = supervisor.run(
+            Length.batches(12),
+            checkpoint_period=Length.batches(4),
+            report_period=Length.batches(4),
+        )
+    assert summary["stopped_early"]
+    assert summary["restarts"] == 0  # preemption is not a failure
+    sid = summary["latest_checkpoint"]
+    assert sid is not None
+
+    # the master relaunches the allocation with the recorded checkpoint
+    relaunch = TrialSupervisor(factory, policy=fast_policy(), sleep=lambda s: None)
+    summary2 = relaunch.run(
+        Length.batches(12),
+        checkpoint_period=Length.batches(4),
+        report_period=Length.batches(4),
+        latest_checkpoint=sid,
+    )
+    assert summary2["steps_completed"] == 12
+    assert summary2["restarts"] == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance scenario 3: corrupt checkpoint -> manifest rejects -> fallback
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_largest_file(store_dir: str, sid: str, how) -> str:
+    root = os.path.join(store_dir, sid)
+    candidates = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            if fn in ("manifest.json",):
+                continue
+            full = os.path.join(dirpath, fn)
+            candidates.append((os.path.getsize(full), full))
+    size, victim = max(candidates)
+    assert size > 0
+    how(victim)
+    return victim
+
+
+def test_truncated_checkpoint_falls_back_to_previous_good(tmp_path):
+    factory = make_factory(tmp_path, SYNC_CKPT)
+    t1 = factory()
+    s1 = t1.fit(
+        Length.batches(8),
+        checkpoint_period=Length.batches(4),
+        report_period=Length.batches(4),
+        checkpoint_policy="none",
+    )
+    sid_b = s1["latest_checkpoint"]  # step-8 checkpoint
+    store = str(tmp_path / "ckpts")
+    ckpt_ctx = core._dummy_init(checkpoint_dir=store).checkpoint
+    sid_a = ckpt_ctx.get_checkpoint_parent(sid_b)
+    assert sid_a is not None and sid_a != sid_b
+    assert ckpt_ctx.get_metadata(sid_a)["steps_completed"] == 4
+
+    _corrupt_largest_file(store, sid_b, FaultInjector.truncate_file)
+
+    # direct restore: walks the lineage and lands on A at step 4
+    t2 = factory()
+    t2._setup()
+    t2._restore_checkpoint(sid_b)
+    assert t2.steps_completed == 4
+    assert t2.latest_checkpoint == sid_a
+
+    # full resume path: completes the run from the fallback
+    t3 = factory()
+    s3 = t3.fit(
+        Length.batches(12),
+        latest_checkpoint=sid_b,
+        report_period=Length.batches(12),
+        checkpoint_policy="none",
+    )
+    assert s3["steps_completed"] == 12
+
+
+def test_checkpoint_killed_before_manifest_never_poisons_resume(tmp_path):
+    """A kill between data upload and manifest write leaves a manifest-less
+    checkpoint: resume must reject it and fall back via the metadata's
+    parent pointer."""
+    factory = make_factory(tmp_path, SYNC_CKPT)
+    t1 = factory()
+    s1 = t1.fit(
+        Length.batches(8),
+        checkpoint_period=Length.batches(4),
+        report_period=Length.batches(4),
+        checkpoint_policy="none",
+    )
+    sid_b = s1["latest_checkpoint"]
+    store = str(tmp_path / "ckpts")
+    os.remove(os.path.join(store, sid_b, "manifest.json"))  # "killed mid-finalize"
+
+    t2 = factory()
+    t2._setup()
+    t2._restore_checkpoint(sid_b)
+    assert t2.steps_completed == 4  # fell back to the parent, not poisoned
+
+
+def test_no_usable_checkpoint_in_lineage_is_fatal(tmp_path):
+    factory = make_factory(tmp_path, SYNC_CKPT)
+    t1 = factory()
+    s1 = t1.fit(
+        Length.batches(4),
+        checkpoint_period=Length.batches(4),
+        report_period=Length.batches(4),
+        checkpoint_policy="none",
+    )
+    sid = s1["latest_checkpoint"]
+    store = str(tmp_path / "ckpts")
+    _corrupt_largest_file(store, sid, FaultInjector.truncate_file)
+
+    t2 = factory()
+    t2._setup()
+    with pytest.raises(CheckpointCorruptError):
+        t2._restore_checkpoint(sid)  # no parent: first checkpoint of the trial
+    assert classify_failure(CheckpointCorruptError("x")) == FailureKind.FATAL
+
+
+# ---------------------------------------------------------------------------
+# acceptance scenario 4: restart budget exhausts -> fatal classification
+# ---------------------------------------------------------------------------
+
+
+def test_restart_budget_exhausted_goes_fatal(tmp_path):
+    inj = FaultInjector()
+    inj.kill_every_step_from(2)
+    supervisor = TrialSupervisor(
+        make_factory(tmp_path, SYNC_CKPT),
+        policy=fast_policy(max_restarts=2),
+        sleep=lambda s: None,
+    )
+    with inj.installed():
+        with pytest.raises(RestartBudgetExhaustedError) as ei:
+            supervisor.run(
+                Length.batches(8),
+                checkpoint_period=Length.batches(4),
+                report_period=Length.batches(4),
+            )
+    assert supervisor.restarts == 2
+    assert classify_failure(ei.value) == FailureKind.FATAL
+    assert isinstance(ei.value, FatalTrialError)
+
+
+def test_transient_storage_put_failure_is_survived(tmp_path):
+    """A flaky blob store fails one upload; the save blows up the attempt,
+    the supervisor restarts, and the trial still completes."""
+    inj = FaultInjector()
+    inj.fail_storage_puts(1)
+    supervisor = TrialSupervisor(
+        make_factory(tmp_path, SYNC_CKPT),
+        policy=fast_policy(),
+        sleep=lambda s: None,
+    )
+    with inj.installed():
+        summary = supervisor.run(
+            Length.batches(8),
+            checkpoint_period=Length.batches(4),
+            report_period=Length.batches(4),
+            checkpoint_policy="none",
+        )
+    assert summary["steps_completed"] == 8
+    assert summary["restarts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# unit: failure taxonomy + restart policy
+# ---------------------------------------------------------------------------
+
+
+def test_classify_failure_taxonomy():
+    assert classify_failure(PreemptedError("pre")) == FailureKind.PREEMPTED
+    assert classify_failure(SimulatedCrash("boom")) == FailureKind.TRANSIENT
+    assert classify_failure(TransientError("t")) == FailureKind.TRANSIENT
+    assert classify_failure(PeerLostError("gone")) == FailureKind.TRANSIENT
+    assert classify_failure(ConnectionError("net")) == FailureKind.TRANSIENT
+    assert classify_failure(OSError("disk")) == FailureKind.TRANSIENT
+    assert classify_failure(RuntimeError("??")) == FailureKind.TRANSIENT  # default
+    assert classify_failure(InvalidConfigError("bad")) == FailureKind.FATAL
+    assert classify_failure(TypeError("bug")) == FailureKind.FATAL
+    assert classify_failure(ImportError("bug")) == FailureKind.FATAL
+    assert classify_failure(CheckpointCorruptError("poison")) == FailureKind.FATAL
+    from determined_tpu.config import InvalidExperimentConfig
+
+    assert classify_failure(InvalidExperimentConfig("bad")) == FailureKind.FATAL
+
+
+def test_restart_policy_backoff_and_config():
+    p = RestartPolicy(max_restarts=3, backoff_base=1.0, backoff_cap=5.0, jitter=0.0)
+    assert [p.delay(n) for n in range(4)] == [1.0, 2.0, 4.0, 5.0]  # capped
+    jittered = RestartPolicy(backoff_base=1.0, backoff_cap=64.0, jitter=0.25)
+    for n in range(5):
+        d = jittered.delay(n)
+        assert 0.75 * 2**n <= d <= 1.25 * 2**n
+
+    exp = ExperimentConfig.parse(
+        {
+            "max_restarts": 7,
+            "fault_tolerance": {
+                "restart_backoff_base": 0.5,
+                "restart_backoff_cap": 10.0,
+                "restart_backoff_jitter": 0.0,
+            },
+        }
+    )
+    p2 = RestartPolicy.from_exp_config(exp)
+    assert p2.max_restarts == 7
+    assert p2.delay(0) == 0.5
+    assert exp.fault_tolerance.verify_checkpoints
+
+
+def test_run_with_restarts_fatal_raises_immediately():
+    attempts = []
+
+    def attempt(latest):
+        attempts.append(latest)
+        raise TypeError("deterministic user bug")
+
+    with pytest.raises(TypeError):
+        run_with_restarts(attempt, policy=fast_policy(5), sleep=lambda s: None)
+    assert len(attempts) == 1  # no restart burned on a fatal failure
+
+
+def test_run_with_restarts_preempted_returns_clean():
+    def attempt(latest):
+        raise PreemptedError("maintenance event")
+
+    summary = run_with_restarts(attempt, policy=fast_policy(), sleep=lambda s: None)
+    assert summary["stopped_early"] and summary.get("preempted")
+    assert summary["restarts"] == 0
+
+
+def test_run_with_restarts_backoff_sleeps_between_attempts():
+    slept = []
+    calls = []
+
+    def attempt(latest):
+        calls.append(latest)
+        if len(calls) < 3:
+            raise SimulatedCrash("flaky")
+        return {"steps_completed": 1}
+
+    policy = RestartPolicy(max_restarts=5, backoff_base=1.0, backoff_cap=8.0, jitter=0.0)
+    summary = run_with_restarts(
+        attempt, policy=policy, sleep=slept.append, initial_checkpoint="ck0"
+    )
+    assert summary["restarts"] == 2
+    assert slept == [1.0, 2.0]  # exponential
+    assert calls == ["ck0", "ck0", "ck0"]  # resume point carried through
+
+
+# ---------------------------------------------------------------------------
+# unit: heartbeat failure streak -> master_unreachable latch
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedSession:
+    """post() consults a script of booleans: True = succeed."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def post(self, path, **kw):
+        self.calls += 1
+        ok = self.script.pop(0) if self.script else True
+        if not ok:
+            raise requests.ConnectionError("injected heartbeat failure")
+
+
+def test_heartbeat_streak_latches_master_unreachable():
+    sess = _ScriptedSession([False, False, False, True, False])
+    hb = HeartbeatReporter(sess, trial_id=1, failure_threshold=3)
+    assert hb._beat() is False and hb.failure_streak == 1
+    assert not hb.master_unreachable
+    hb._beat()
+    assert hb.failure_streak == 2 and not hb.master_unreachable
+    hb._beat()
+    assert hb.failure_streak == 3 and hb.master_unreachable  # latched at N
+    assert hb._beat() is True  # master back
+    assert hb.failure_streak == 0 and not hb.master_unreachable
+    hb._beat()
+    assert hb.failure_streak == 1 and not hb.master_unreachable
+
+
+def test_dummy_context_master_reachable(tmp_path):
+    ctx = core._dummy_init(checkpoint_dir=str(tmp_path))
+    assert ctx.master_unreachable is False
+
+
+# ---------------------------------------------------------------------------
+# unit: session retries only idempotent methods; jitter; Retry-After
+# ---------------------------------------------------------------------------
+
+
+class _Resp:
+    def __init__(self, status, headers=None, text=""):
+        self.status_code = status
+        self.headers = headers or {}
+        self.text = text
+
+    def json(self):
+        return {}
+
+
+def _no_sleep(monkeypatch):
+    import determined_tpu.api.session as session_mod
+
+    sleeps = []
+    monkeypatch.setattr(session_mod.time, "sleep", sleeps.append)
+    return sleeps
+
+
+def test_session_retries_idempotent_only(monkeypatch):
+    _no_sleep(monkeypatch)
+    s = Session("http://master")
+    calls = []
+
+    def flaky(method, url, **kw):
+        calls.append(method)
+        raise requests.ConnectionError("down")
+
+    monkeypatch.setattr(s._http, "request", flaky)
+    with pytest.raises(requests.ConnectionError):
+        s.get("/x")
+    assert len(calls) == Session.RETRIES  # GET retried
+
+    calls.clear()
+    with pytest.raises(requests.ConnectionError):
+        s.post("/x")
+    assert len(calls) == 1  # POST not retried by default
+
+    calls.clear()
+    with pytest.raises(requests.ConnectionError):
+        s.post("/x", retry=True)
+    assert len(calls) == Session.RETRIES  # explicit opt-in
+
+    calls.clear()
+    with pytest.raises(requests.ConnectionError):
+        s.put("/x")
+    assert len(calls) == Session.RETRIES
+
+    calls.clear()
+    with pytest.raises(requests.ConnectionError):
+        s.delete("/x")
+    assert len(calls) == Session.RETRIES
+
+
+def test_session_5xx_retries_only_idempotent(monkeypatch):
+    _no_sleep(monkeypatch)
+    s = Session("http://master")
+    calls = []
+
+    def always_500(method, url, **kw):
+        calls.append(method)
+        return _Resp(500)
+
+    monkeypatch.setattr(s._http, "request", always_500)
+    with pytest.raises(APIError):
+        s.post("/x")
+    assert len(calls) == 1
+
+    calls.clear()
+    with pytest.raises(APIError):
+        s.get("/x")
+    assert len(calls) == Session.RETRIES
+
+
+def test_session_429_honors_retry_after_for_any_method(monkeypatch):
+    sleeps = _no_sleep(monkeypatch)
+    s = Session("http://master")
+    responses = [_Resp(429, headers={"Retry-After": "7"}), _Resp(200)]
+    calls = []
+
+    def scripted(method, url, **kw):
+        calls.append(method)
+        return responses.pop(0)
+
+    monkeypatch.setattr(s._http, "request", scripted)
+    # POST: normally single-attempt, but a 429 was never executed -> retried
+    resp = s.post("/x")
+    assert resp.status_code == 200
+    assert len(calls) == 2
+    assert sleeps == [7.0]  # server's Retry-After wins over backoff
+
+
+def test_session_503_retry_after(monkeypatch):
+    sleeps = _no_sleep(monkeypatch)
+    s = Session("http://master")
+    responses = [_Resp(503, headers={"Retry-After": "3"}), _Resp(200)]
+    monkeypatch.setattr(s._http, "request", lambda *a, **kw: responses.pop(0))
+    assert s.get("/x").status_code == 200
+    assert sleeps == [3.0]
+
+
+def test_session_backoff_jitter_bounds():
+    s = Session("http://master")
+    for attempt in range(4):
+        base = s.BACKOFF * 2**attempt
+        for _ in range(20):
+            d = s._backoff_delay(attempt)
+            assert 0.5 * base <= d <= 1.5 * base
+
+
+# ---------------------------------------------------------------------------
+# unit: control-plane deadlines -> PeerLostError, half-open conn dropped
+# ---------------------------------------------------------------------------
+
+
+def test_dead_peer_raises_peer_lost_not_hang():
+    def fn(dist, rank):
+        dist.allgather("hello")  # both ranks join the star
+        if rank == 1:
+            return "bailed"  # rank 1 "dies" (its socket closes on exit)
+        try:
+            dist.allgather("second")
+        except PeerLostError:
+            return "peer-lost"
+        return "hung-or-succeeded"
+
+    out = Execution(2, timeout=3).run(fn)
+    assert out == ["peer-lost", "bailed"]
+
+
+def test_injected_peer_drop_surfaces_peer_lost():
+    inj = FaultInjector()
+    # let the rendezvous collective through, kill rank 1's second one
+    fires = {"n": 0}
+
+    def second_collective_of_rank1(info):
+        if info.get("rank") != 1:
+            return False
+        fires["n"] += 1
+        return fires["n"] >= 2
+
+    inj.raise_at(
+        "distributed.allgather",
+        lambda: PeerLostError("injected loss of rank 1"),
+        times=1,
+        when=second_collective_of_rank1,
+    )
+
+    def fn(dist, rank):
+        dist.allgather("join")
+        try:
+            dist.allgather("x")
+            return "ok"
+        except PeerLostError:
+            return "dropped" if rank == 1 else "peer-lost"
+
+    with inj.installed():
+        out = Execution(2, timeout=3).run(fn)
+    assert out == ["peer-lost", "dropped"]
+
+
+def test_half_open_connection_dropped_and_rendezvous_completes(monkeypatch):
+    monkeypatch.setattr(dist_mod, "HELLO_TIMEOUT", 0.3)
+    server = _StarServer(0, 1, host="127.0.0.1")
+    try:
+        # a connection that never says hello (peer died after SYN)
+        raw = socket.create_connection(("127.0.0.1", server.port))
+        # the real worker must still rendezvous despite the half-open conn
+        client = _StarClient("127.0.0.1", server.port, rank=1, timeout=5)
+        server.wait_ready(5)  # would TimeoutError if the half-open conn stalled it
+        raw.close()
+        client.close()
+    finally:
+        server.close()
+
+
+def test_session_429_respects_explicit_retry_optout(monkeypatch):
+    sleeps = _no_sleep(monkeypatch)
+    s = Session("http://master")
+    monkeypatch.setattr(
+        s._http, "request", lambda *a, **kw: _Resp(429, headers={"Retry-After": "9"})
+    )
+    with pytest.raises(APIError):
+        s.get("/x", retry=False)  # explicit opt-out: exactly one attempt
+    assert sleeps == []
+
+
+def test_injected_api_fault_goes_through_retry_machinery(monkeypatch):
+    """An injected ConnectionError must exercise the same retry path the
+    real fault would (the hook fires inside the try block)."""
+    _no_sleep(monkeypatch)
+    s = Session("http://master")
+    calls = []
+    monkeypatch.setattr(
+        s._http, "request", lambda *a, **kw: (calls.append(1), _Resp(200))[1]
+    )
+    inj = FaultInjector()
+    inj.fail_api_requests(2)  # first two attempts die "on the wire"
+    with inj.installed():
+        resp = s.get("/x")
+    assert resp.status_code == 200
+    assert len(calls) == 1  # two injected failures absorbed, third landed
