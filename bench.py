@@ -105,18 +105,37 @@ def main() -> None:
         # value fetch is the only true sync point
         jax.device_get(trainer.state.metric_count)
 
-    it = iter(trainer.train_loader)
+    # A/B switch for the overlapped input pipeline (docs/input-pipeline.md):
+    # DTPU_BENCH_PREFETCH=1 (default) feeds through the background-fetch +
+    # double-buffered pipeline; =0 is the synchronous fetch->transfer->step
+    # loop for like-for-like comparison on the same machine
+    prefetch = os.environ.get("DTPU_BENCH_PREFETCH", "1")
+    if prefetch not in ("0", "1"):
+        raise SystemExit("DTPU_BENCH_PREFETCH must be 0 or 1")
+    if prefetch == "1":
+        from determined_tpu.data import InputPipeline
+
+        pipeline = InputPipeline(
+            trainer.train_loader, trainer.mesh, prefetch_depth=2, device_buffer=2
+        )
+        next_batch = lambda: next(pipeline)  # noqa: E731
+    else:
+        it = iter(trainer.train_loader)
+        next_batch = lambda: to_global(next(it), trainer.mesh)  # noqa: E731
+
     step = trainer._train_step
     for _ in range(5):  # warmup/compile
-        trainer.state = step(trainer.state, to_global(next(it), trainer.mesh))
+        trainer.state = step(trainer.state, next_batch())
     sync()
 
     measured = 30
     t0 = time.perf_counter()
     for _ in range(measured):
-        trainer.state = step(trainer.state, to_global(next(it), trainer.mesh))
+        trainer.state = step(trainer.state, next_batch())
     sync()
     dt = time.perf_counter() - t0
+    if prefetch == "1":
+        pipeline.close()
 
     tps = measured * gbs * seq / dt
     achieved = tps * flops_per_token
@@ -132,6 +151,7 @@ def main() -> None:
                 "mfu": round(achieved / peak, 3),
                 "chip": getattr(jax.devices()[0], "device_kind", "unknown"),
                 "model": f"d{d}-L{L}-V{V}-seq{seq}-bs{gbs}",
+                "prefetch": int(prefetch),
             }
         )
     )

@@ -220,12 +220,30 @@ class OptimizationsConfig:
     # training continues; the collective finalize lands at the next save,
     # preemption, or exit.  False restores fully synchronous saves.
     async_checkpointing: bool = True
+    # Overlapped input pipeline (docs/input-pipeline.md).  prefetch_depth:
+    # how many host batches the background fetch worker may run ahead of
+    # the trainer (0 = fetch synchronously on the main thread, the
+    # reference DataLoader's num_workers=0 analog).  device_prefetch: how
+    # many batches to hold on-device ahead of the step (2 = double
+    # buffering; <=1 = synchronous host->device transfer).  fetch_workers:
+    # thread-pool width for per-item map-style dataset reads (0 = the
+    # sequential loop; irrelevant for InMemoryDataset's columnar gather).
+    prefetch_depth: int = 2
+    device_prefetch: int = 2
+    fetch_workers: int = 0
+    # Persistent XLA compilation cache directory (also DTPU_COMPILATION_CACHE
+    # env): a supervised restart after a crash re-jits from disk instead of
+    # paying the full compile.  None disables.
+    compilation_cache_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.aggregation_frequency < 1:
             raise InvalidExperimentConfig(
                 "optimizations.aggregation_frequency must be >= 1"
             )
+        for knob in ("prefetch_depth", "device_prefetch", "fetch_workers"):
+            if getattr(self, knob) < 0:
+                raise InvalidExperimentConfig(f"optimizations.{knob} must be >= 0")
 
     @classmethod
     def parse(cls, raw: Dict[str, Any]) -> "OptimizationsConfig":

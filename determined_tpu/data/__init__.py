@@ -1,4 +1,5 @@
-"""Data pipeline: datasets, deterministic samplers, shard-aware loader."""
+"""Data pipeline: datasets, deterministic samplers, shard-aware loader,
+overlapped prefetch stages (``docs/input-pipeline.md``)."""
 
 from determined_tpu.data._dataset import (
     Dataset,
@@ -6,16 +7,32 @@ from determined_tpu.data._dataset import (
     SyntheticDataset,
     mnist_like,
 )
-from determined_tpu.data._loader import DataLoader, batch_spec, to_global
+from determined_tpu.data._loader import (
+    DataLoader,
+    batch_spec,
+    cached_batch_sharding,
+    to_global,
+)
+from determined_tpu.data._prefetch import (
+    EpochFeed,
+    InputPipeline,
+    PrefetchingIterator,
+    device_prefetch,
+)
 from determined_tpu.data._sampler import IndexSampler, SamplerState
 
 __all__ = [
     "Dataset",
+    "EpochFeed",
     "InMemoryDataset",
+    "InputPipeline",
+    "PrefetchingIterator",
     "SyntheticDataset",
     "mnist_like",
     "DataLoader",
     "batch_spec",
+    "cached_batch_sharding",
+    "device_prefetch",
     "to_global",
     "IndexSampler",
     "SamplerState",

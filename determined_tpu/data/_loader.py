@@ -14,6 +14,7 @@ Replaces the reference's ``pytorch.DataLoader`` wrapper
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -25,11 +26,27 @@ from determined_tpu.data._sampler import IndexSampler, SamplerState
 from determined_tpu.parallel.mesh import MeshAxes
 
 
-def _fetch(dataset: Dataset, indices: np.ndarray) -> Dict[str, np.ndarray]:
+def _fetch(
+    dataset: Dataset, indices: np.ndarray, pool: Optional[Any] = None
+) -> Dict[str, np.ndarray]:
     if isinstance(dataset, InMemoryDataset):
         return dataset.gather(indices)
-    items = [dataset[int(i)] for i in indices]
-    return {k: np.stack([it[k] for it in items]) for k in items[0]}
+    # map-style dataset: the per-item loop is the slow path (disk reads,
+    # decode); a thread pool overlaps the item I/O when the loader has a
+    # `fetch_workers` budget
+    idx = [int(i) for i in indices]
+    if pool is not None:
+        items = list(pool.map(dataset.__getitem__, idx))
+    else:
+        items = [dataset[i] for i in idx]
+    keys = list(items[0])
+    if len(keys) == 1:
+        # single-key short-circuit: skip the per-key comprehension and the
+        # repeated item-dict walks; np.stack semantics (raise on ragged,
+        # promote on dtype mismatch) are kept by construction
+        k = keys[0]
+        return {k: np.stack([it[k] for it in items])}
+    return {k: np.stack([it[k] for it in items]) for k in keys}
 
 
 class DataLoader:
@@ -48,8 +65,11 @@ class DataLoader:
         seed: int = 0,
         shard_rank: Optional[int] = None,
         num_shards: Optional[int] = None,
+        fetch_workers: int = 0,
     ) -> None:
         self.dataset = dataset
+        self.fetch_workers = fetch_workers
+        self._pool: Optional[Any] = None
         if shard_rank is None:
             shard_rank = jax.process_index()
         if num_shards is None:
@@ -82,18 +102,72 @@ class DataLoader:
 
     # -- iteration ---------------------------------------------------------
 
+    def _fetch_pool(self) -> Optional[Any]:
+        """Lazily built thread pool for the map-style fetch path.  Only
+        non-InMemory datasets ever touch it (the columnar gather needs no
+        threads), so construction waits for the first such fetch."""
+        if self.fetch_workers and self.fetch_workers > 0 and self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=int(self.fetch_workers),
+                thread_name_prefix="dtpu-fetch",
+            )
+        return self._pool
+
+    def _fetch_batch(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        pool = None if isinstance(self.dataset, InMemoryDataset) else self._fetch_pool()
+        return _fetch(self.dataset, idx, pool)
+
+    def close(self) -> None:
+        """Release the fetch pool (if one was built).  The loader stays
+        usable — a later fetch lazily rebuilds it."""
+        if self._pool is not None:
+            # cancel_futures: a preempted trial must not sit in the atexit
+            # join while queued slow item reads of an abandoned batch drain
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         """Infinite stream of host-local batches, advancing resume state."""
         for state, idx in self.sampler.iter_from(self._state):
-            batch = _fetch(self.dataset, idx)
+            batch = self._fetch_batch(idx)
             self._state = state
             yield batch
+
+    def iter_pairs(
+        self, agg: int = 1
+    ) -> Iterator[Tuple[SamplerState, Dict[str, np.ndarray]]]:
+        """Infinite ``(state_after, batch)`` stream; does NOT advance the
+        loader's resume state — the consumer commits via ``commit_state``
+        when it actually takes the batch (the prefetch pipeline's
+        consumed-vs-fetched invariant, ``data/_prefetch.py``).
+
+        ``agg`` > 1 groups that many microbatches into one stacked
+        ``[agg, batch, ...]`` batch (gradient accumulation); the state is
+        that after the LAST microbatch, so one optimizer step = one commit.
+        """
+        it = self.sampler.iter_from(self._state)
+        if agg <= 1:
+            for state, idx in it:
+                yield state, self._fetch_batch(idx)
+            return
+        while True:
+            micros = []
+            for _ in range(agg):
+                state, idx = next(it)
+                micros.append(self._fetch_batch(idx))
+            yield state, {k: np.stack([m[k] for m in micros]) for k in micros[0]}
+
+    def commit_state(self, state: SamplerState) -> None:
+        """Record that the consumer has taken every batch up to ``state``."""
+        self._state = state
 
     def iter_epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         """One full pass (e.g. a validation sweep); resume state untouched."""
         batches = self.sampler.epoch_batches(epoch)
         for b in range(self.sampler.batches_per_epoch):
-            yield _fetch(self.dataset, batches[b])
+            yield self._fetch_batch(batches[b])
 
 
 def batch_spec(mesh: Mesh, ndim: int) -> PartitionSpec:
@@ -101,6 +175,22 @@ def batch_spec(mesh: Mesh, ndim: int) -> PartitionSpec:
     batch_axes = tuple(a for a in (MeshAxes.DATA, MeshAxes.FSDP) if mesh.shape.get(a, 1) > 1)
     first = batch_axes if batch_axes else None
     return PartitionSpec(first, *([None] * (ndim - 1)))
+
+
+@functools.lru_cache(maxsize=64)
+def cached_batch_sharding(mesh: Mesh, ndim: int, micro_dim: bool) -> NamedSharding:
+    """The NamedSharding ``to_global`` uses for a rank-``ndim`` leaf.
+
+    Building a PartitionSpec + NamedSharding per key per step is pure
+    overhead on the input hot path — the result depends only on
+    ``(mesh, ndim, micro_dim)``, so it is memoized (Mesh is hashable, and
+    a trial touches a handful of (mesh, ndim) combinations for its
+    lifetime).
+    """
+    spec = batch_spec(mesh, ndim - 1 if micro_dim else ndim)
+    if micro_dim:
+        spec = PartitionSpec(None, *spec)
+    return NamedSharding(mesh, spec)
 
 
 def to_global(
@@ -115,9 +205,6 @@ def to_global(
     """
     out: Dict[str, jax.Array] = {}
     for k, v in batch.items():
-        spec = batch_spec(mesh, v.ndim - 1 if micro_dim else v.ndim)
-        if micro_dim:
-            spec = PartitionSpec(None, *spec)
-        sharding = NamedSharding(mesh, spec)
+        sharding = cached_batch_sharding(mesh, v.ndim, micro_dim)
         out[k] = jax.make_array_from_process_local_data(sharding, v)
     return out

@@ -1,0 +1,281 @@
+"""Overlapped input pipeline: background host prefetch + device double buffering.
+
+The synchronous loop costs one full input latency per step: the device
+waits while the host gathers/stacks the next batch, then the host waits
+while the device computes.  This module decouples the three stages the way
+tf.data / DALI do, adapted to JAX global arrays and the resumable sampler:
+
+1. **host fetch** — a background worker thread runs the sampler + ``_fetch``
+   (and, under gradient accumulation, the microbatch stacking) into a
+   bounded queue (``PrefetchingIterator``);
+2. **host→device** — ``to_global`` is called eagerly on batch N+1 while the
+   device executes step N (``device_prefetch``); JAX transfers are
+   asynchronous, so the copy rides under the compute;
+3. **device compute** — the trainer's jitted step, unchanged.
+
+Exact-resume invariant (the part PyTorch's DataLoader gets for free by
+re-creating workers on restore): the sampler state checkpointed must be
+that of the batch the *trainer consumed*, not the batch the worker
+*fetched*.  Every stage therefore carries ``(SamplerState, batch)`` pairs,
+and only ``InputPipeline.__next__`` — on the consumer thread, at the moment
+the trainer takes the batch — commits the state back to the loader.  A
+crash/restore then replays zero and skips zero batches no matter how far
+ahead the worker ran.
+
+Failure semantics: an exception on the worker (including one injected at
+the ``data.prefetch.fetch`` fault site) is queued and re-raised from the
+consumer's next ``__next__`` with its original type, so the supervised
+restart path classifies it exactly like a synchronous input failure.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Dict, Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from determined_tpu.utils import faults
+
+
+class _WorkerError:
+    """Envelope carrying a worker exception across the queue."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException) -> None:
+        self.exc = exc
+
+
+_DONE = object()
+
+
+class PrefetchingIterator:
+    """Run any iterator on a background thread behind a bounded queue.
+
+    ``depth`` bounds how far the worker may run ahead of the consumer
+    (memory bound = depth batches + one in flight).  ``close()`` is
+    idempotent, never blocks on a full queue, and joins the worker; an
+    un-closed iterator's worker parks on the stop event and dies with the
+    process (daemon thread).
+    """
+
+    def __init__(
+        self,
+        source: Iterable[Any],
+        *,
+        depth: int = 2,
+        name: str = "dtpu-prefetch",
+        fault_site: str = "data.prefetch.fetch",
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._source = source
+        self._fault_site = fault_site
+        self._queue: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    # -- worker side ---------------------------------------------------------
+
+    def _put(self, item: Any) -> bool:
+        """Blocking put that wakes up if the consumer closes us."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self) -> None:
+        produced = 0
+        try:
+            it = iter(self._source)
+            while not self._stop.is_set():
+                # fault-injection hook: tests kill the worker mid-stream here
+                # to exercise exception propagation + supervised restart
+                faults.fire(self._fault_site, batches=produced)
+                try:
+                    item = next(it)
+                except StopIteration:
+                    self._put(_DONE)
+                    return
+                produced += 1
+                if not self._put(item):
+                    return
+        except BaseException as e:  # noqa: BLE001 - re-raised on the consumer
+            self._put(_WorkerError(e))
+
+    # -- consumer side -------------------------------------------------------
+
+    def __iter__(self) -> "PrefetchingIterator":
+        return self
+
+    def __next__(self) -> Any:
+        if self._done:
+            raise StopIteration
+        while True:
+            try:
+                item = self._queue.get(timeout=0.5)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive() and self._queue.empty():
+                    # worker died without queueing a sentinel (should be
+                    # impossible; defensive against a hard thread kill)
+                    self._done = True
+                    raise RuntimeError("prefetch worker died without a result")
+        if item is _DONE:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _WorkerError):
+            self._done = True
+            raise item.exc
+        return item
+
+    def close(self) -> None:
+        """Stop the worker and join it.  Safe to call more than once, from
+        any state (mid-stream, exhausted, after an error)."""
+        self._done = True
+        self._stop.set()
+        # drain so a worker blocked on put() sees the stop event promptly
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "PrefetchingIterator":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def __del__(self) -> None:  # belt-and-braces: never leak a live worker
+        stop = getattr(self, "_stop", None)  # absent if __init__ raised
+        if stop is not None:
+            stop.set()
+
+
+def device_prefetch(
+    pairs: Iterable[Tuple[Any, Dict[str, np.ndarray]]],
+    mesh: Any,
+    *,
+    size: int = 2,
+    micro_dim: bool = False,
+) -> Iterator[Tuple[Any, Dict[str, Any]]]:
+    """Eager ``to_global`` stage: keep ``size`` device batches in flight.
+
+    Yields ``(state, global_batch)`` pairs.  With ``size`` >= 2 the
+    host→device transfer of batch N+1 is dispatched before batch N is
+    consumed, so it overlaps the device step (JAX transfers are async).
+    ``size`` <= 1 degrades to synchronous conversion.
+    """
+    from determined_tpu.data._loader import to_global
+
+    if size <= 1:
+        for state, host_batch in pairs:
+            yield state, to_global(host_batch, mesh, micro_dim=micro_dim)
+        return
+    buf: collections.deque = collections.deque()
+    for state, host_batch in pairs:
+        buf.append((state, to_global(host_batch, mesh, micro_dim=micro_dim)))
+        if len(buf) >= size:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
+
+
+class EpochFeed:
+    """Overlapped feed over one finite pass (a validation sweep): the same
+    host-prefetch + device-prefetch stages as ``InputPipeline``, minus the
+    resume-state commit (``iter_epoch`` never touches sampler state)."""
+
+    def __init__(
+        self,
+        host_iter: Iterable[Dict[str, np.ndarray]],
+        mesh: Any,
+        *,
+        prefetch_depth: int = 2,
+        device_buffer: int = 2,
+        micro_dim: bool = False,
+    ) -> None:
+        self._host_stage: Optional[PrefetchingIterator] = None
+        if prefetch_depth > 0:
+            host_iter = self._host_stage = PrefetchingIterator(
+                host_iter, depth=prefetch_depth, name="dtpu-prefetch-epoch"
+            )
+        self._it = device_prefetch(
+            ((None, hb) for hb in host_iter),
+            mesh,
+            size=device_buffer,
+            micro_dim=micro_dim,
+        )
+
+    def __iter__(self) -> "EpochFeed":
+        return self
+
+    def __next__(self) -> Dict[str, Any]:
+        return next(self._it)[1]
+
+    def close(self) -> None:
+        if self._host_stage is not None:
+            self._host_stage.close()
+
+    def __enter__(self) -> "EpochFeed":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class InputPipeline:
+    """The full three-stage feed bound to one resumable DataLoader.
+
+    ``__next__`` returns a device-global batch (stacked ``[agg, batch, ...]``
+    microbatches when ``agg`` > 1) and commits the loader's resume state to
+    the position *after* the consumed batch — ``loader.state_dict()`` at any
+    point between two ``__next__`` calls is an exact resume point.
+    """
+
+    def __init__(
+        self,
+        loader: Any,
+        mesh: Any,
+        *,
+        agg: int = 1,
+        prefetch_depth: int = 2,
+        device_buffer: int = 2,
+    ) -> None:
+        self.loader = loader
+        self._host_stage: Optional[PrefetchingIterator] = None
+        source: Iterable[Tuple[Any, Dict[str, np.ndarray]]] = loader.iter_pairs(agg=agg)
+        if prefetch_depth > 0:
+            source = self._host_stage = PrefetchingIterator(source, depth=prefetch_depth)
+        self._it = device_prefetch(
+            source, mesh, size=device_buffer, micro_dim=agg > 1
+        )
+
+    def __iter__(self) -> "InputPipeline":
+        return self
+
+    def __next__(self) -> Dict[str, Any]:
+        state, batch = next(self._it)
+        self.loader.commit_state(state)
+        return batch
+
+    def close(self) -> None:
+        if self._host_stage is not None:
+            self._host_stage.close()
+
+    def __enter__(self) -> "InputPipeline":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

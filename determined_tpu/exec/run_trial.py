@@ -454,6 +454,14 @@ def main() -> int:
         return 2
 
     exp_config = ExperimentConfig.parse(cluster.exp_config or {})
+
+    # persistent XLA compilation cache: a supervised restart (or a relaunch
+    # after a crash) re-jits from disk instead of paying the full compile;
+    # from optimizations.compilation_cache_dir or DTPU_COMPILATION_CACHE
+    from determined_tpu.utils.compilation_cache import setup_compilation_cache
+
+    setup_compilation_cache(exp_config.optimizations.compilation_cache_dir)
+
     module_name, _, class_name = sys.argv[1].partition(":")
     _prepare_context(logger)
     sys.path.insert(0, os.getcwd())

@@ -33,6 +33,7 @@ from flax.core import meta as flax_meta
 from determined_tpu.config.experiment import ExperimentConfig, Length
 from determined_tpu.core import _context as core_context_mod
 from determined_tpu.data._loader import DataLoader, to_global
+from determined_tpu.data._prefetch import EpochFeed, InputPipeline
 from determined_tpu.parallel.mesh import MeshAxes, MeshConfig, make_mesh
 from determined_tpu.parallel.sharding import (
     DEFAULT_RULES,
@@ -88,6 +89,11 @@ def init(
         mesh_config = mesh_config or exp_config.resources.mesh
         if seed is None:
             seed = exp_config.reproducibility.experiment_seed
+    from determined_tpu.utils.compilation_cache import setup_compilation_cache
+
+    setup_compilation_cache(
+        exp_config.optimizations.compilation_cache_dir if exp_config else None
+    )
     core = core_context or core_context_mod.init()
     mesh = make_mesh(mesh_config or MeshConfig.data_parallel(-1))
     return TrialContext(
@@ -204,6 +210,12 @@ class Trainer:
         if cfg is not None:
             self._searcher_metric = cfg.searcher.metric
             self._smaller_is_better = cfg.searcher.smaller_is_better
+            if cfg.optimizations.fetch_workers:
+                # config-level fetch_workers applies to loaders the trial
+                # built without an explicit per-loader setting
+                for ld in (self.train_loader, self.val_loader):
+                    if ld is not None and not ld.fetch_workers:
+                        ld.fetch_workers = cfg.optimizations.fetch_workers
 
         rng = jax.random.key(ctx.seed)
         init_rng, state_rng = jax.random.split(rng)
@@ -376,6 +388,16 @@ class Trainer:
             return jax.device_put(x, repl)
 
         return jax.tree.map(fix, tree)
+
+    # -- input pipeline ----------------------------------------------------
+
+    def _input_opts(self) -> Tuple[int, int]:
+        """(prefetch_depth, device_prefetch) from config, defaulting to the
+        overlapped pipeline (2/2 = background fetch + double buffering)."""
+        opt = self.context.exp_config.optimizations if self.context.exp_config else None
+        if opt is None:
+            return 2, 2
+        return opt.prefetch_depth, opt.device_prefetch
 
     # -- length arithmetic -------------------------------------------------
 
@@ -587,10 +609,18 @@ class Trainer:
             cb.on_validation_start()
         acc: Dict[str, jax.Array] = {}
         count = jnp.zeros((), jnp.float32)
-        with self.mesh:
-            for host_batch in self.val_loader.iter_epoch(0):
-                batch = to_global(host_batch, self.mesh)
-                acc, count = self._eval_step(self.state.params, batch, acc, count)
+        # the validation sweep gets the same overlap as training: host fetch
+        # on a worker, eager to_global one batch ahead of the eval step
+        prefetch_depth, device_buffer = self._input_opts()
+        with EpochFeed(
+            self.val_loader.iter_epoch(0),
+            self.mesh,
+            prefetch_depth=prefetch_depth,
+            device_buffer=device_buffer,
+        ) as feed:
+            with self.mesh:
+                for batch in feed:
+                    acc, count = self._eval_step(self.state.params, batch, acc, count)
         from determined_tpu.train._reducer import MEAN
 
         acc_host, n = jax.device_get((acc, count))
@@ -665,14 +695,70 @@ class Trainer:
         for cb in self.callbacks.values():
             cb.on_training_start(self)
 
-        train_iter = iter(self.train_loader)
+        # overlapped input feed (docs/input-pipeline.md): host fetch runs on
+        # a background worker, to_global on batch N+1 dispatches while step N
+        # executes; __next__ commits the loader's CONSUMED position, so the
+        # state_dict a checkpoint captures is exact regardless of how far
+        # ahead the worker fetched
+        prefetch_depth, device_buffer = self._input_opts()
+        pipeline = InputPipeline(
+            self.train_loader,
+            self.mesh,
+            agg=self.agg,
+            prefetch_depth=prefetch_depth,
+            device_buffer=device_buffer,
+        )
         gbs = self.train_loader.sampler.global_batch * self.agg
+
+        try:
+            self._fit_loop(
+                pipeline, max_steps, val_sched, ckpt_sched, rep_sched,
+                checkpoint_policy, gbs,
+            )
+        finally:
+            # the worker must die with the loop: on clean exit, preemption,
+            # AND a crash unwinding toward the supervisor restart path —
+            # restarts build fresh Trainers, so anything left running here
+            # would accumulate across attempts
+            pipeline.close()
+            for ld in (self.train_loader, self.val_loader):
+                if ld is not None:
+                    ld.close()
+
+        # a save still in flight must land before we exit or report completion
+        self._drain_pending_save()
+
+        # final: always leave at least one checkpoint unless policy is none
+        if checkpoint_policy != "none" and self._last_ckpt_sid is None:
+            self._last_ckpt_sid = self._save_checkpoint(asynchronous=False)
+
+        for cb in self.callbacks.values():
+            cb.on_trial_shutdown()
+
+        return {
+            "steps_completed": self.steps_completed,
+            "latest_checkpoint": self._last_ckpt_sid,
+            "validation_metrics": self._last_val_metrics,
+            "stopped_early": self._stopped_early,
+            "best_validation": self.best_validation,
+        }
+
+    def _fit_loop(
+        self,
+        pipeline: InputPipeline,
+        max_steps: int,
+        val_sched: _BoundarySchedule,
+        ckpt_sched: _BoundarySchedule,
+        rep_sched: _BoundarySchedule,
+        checkpoint_policy: str,
+        gbs: int,
+    ) -> None:
         hot_time = 0.0  # train-segment wall time since last report (excludes
         # validation/checkpoint so samples_per_second tracks training only)
         steps_since_report = 0
-        last_ckpt_sid: Optional[str] = None
-        last_val_metrics: Dict[str, float] = {}
-        stopped_early = False
+        self._last_ckpt_sid = None
+        self._last_val_metrics = {}
+        self._stopped_early = False
         epoch_seen = self.train_loader.epoch
 
         while self.steps_completed < max_steps:
@@ -700,15 +786,9 @@ class Trainer:
                     # fault-injection hook: tests crash a step here to
                     # exercise the supervised-restart path (no-op in prod)
                     faults.fire("train.step", step=self.steps_completed)
-                    if self.agg > 1:
-                        micros = [next(train_iter) for _ in range(self.agg)]
-                        host_batch = {
-                            k: np.stack([m[k] for m in micros]) for k in micros[0]
-                        }
-                        batch = to_global(host_batch, self.mesh, micro_dim=True)
-                    else:
-                        host_batch = next(train_iter)
-                        batch = to_global(host_batch, self.mesh)
+                    # already a device-global array; the pipeline stacked
+                    # microbatches (agg > 1) and committed consumed state
+                    batch = next(pipeline)
                     self.state = self._train_step(self.state, batch)
                     self.steps_completed += 1
                     steps_since_report += 1
@@ -749,7 +829,7 @@ class Trainer:
             if val_sched.period is not None and (
                 val_sched.is_boundary(self.steps_completed) or at_end
             ):
-                last_val_metrics = self._validate()
+                self._last_val_metrics = self._validate()
                 validated = True
 
             # ---- CHECKPOINT ----------------------------------------------
@@ -758,7 +838,7 @@ class Trainer:
             )
             if validated and checkpoint_policy == "all":
                 want_ckpt = True
-            if validated and checkpoint_policy == "best" and self._is_best(last_val_metrics):
+            if validated and checkpoint_policy == "best" and self._is_best(self._last_val_metrics):
                 want_ckpt = True
             # ---- PREEMPT --------------------------------------------------
             preempted = self.core.preempt.should_preempt()
@@ -774,30 +854,12 @@ class Trainer:
                 ):
                     # a save of this exact step is already in flight:
                     # wait for it instead of writing a duplicate
-                    last_ckpt_sid = self._drain_pending_save()
+                    self._last_ckpt_sid = self._drain_pending_save()
                 else:
                     # on preemption the save must be durable before exit,
                     # so skip the overlap and write synchronously
-                    last_ckpt_sid = self._save_checkpoint(asynchronous=not preempted)
+                    self._last_ckpt_sid = self._save_checkpoint(asynchronous=not preempted)
             if preempted:
                 logger.info("preempted at step %d; exiting cleanly", self.steps_completed)
-                stopped_early = True
+                self._stopped_early = True
                 break
-
-        # a save still in flight must land before we exit or report completion
-        self._drain_pending_save()
-
-        # final: always leave at least one checkpoint unless policy is none
-        if checkpoint_policy != "none" and last_ckpt_sid is None:
-            last_ckpt_sid = self._save_checkpoint(asynchronous=False)
-
-        for cb in self.callbacks.values():
-            cb.on_trial_shutdown()
-
-        return {
-            "steps_completed": self.steps_completed,
-            "latest_checkpoint": last_ckpt_sid,
-            "validation_metrics": last_val_metrics,
-            "stopped_early": stopped_early,
-            "best_validation": self.best_validation,
-        }

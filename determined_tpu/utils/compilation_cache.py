@@ -1,0 +1,76 @@
+"""Persistent XLA compilation cache wiring.
+
+A supervised restart (``exec/run_trial.py`` TrialSupervisor) builds a fresh
+Trainer, whose jitted step closures are new Python objects — jax's
+in-process jit cache misses and the attempt pays a full XLA compile.  With
+a persistent cache directory configured, the recompile is a disk read
+instead (the compiled executable is keyed on the HLO, which is identical
+across attempts), which on a large LM is minutes saved per restart.
+
+The directory comes from ``optimizations.compilation_cache_dir`` (the
+experiment's declaration, authoritative) or the ``DTPU_COMPILATION_CACHE``
+env var (operator-level fallback).  Setup is idempotent per process.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("determined_tpu.utils.compilation_cache")
+
+# path already applied this process (repeat init() calls must not re-log)
+_configured: Optional[str] = None
+
+
+def resolve_cache_dir(config_dir: Optional[str] = None) -> Optional[str]:
+    return config_dir or os.environ.get("DTPU_COMPILATION_CACHE") or None
+
+
+def setup_compilation_cache(config_dir: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache at the configured directory.
+
+    Returns the active cache path (None when unconfigured).  Logs one
+    warm/cold line so operators can tell from the task log whether a
+    restart will hit the cache.
+    """
+    global _configured
+    path = resolve_cache_dir(config_dir)
+    if not path:
+        return _configured
+    path = os.path.abspath(path)
+    if _configured == path:
+        return path
+
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    entries = sum(1 for e in os.scandir(path) if e.is_file())
+    jax.config.update("jax_compilation_cache_dir", path)
+    min_secs = os.environ.get("DTPU_COMPILATION_CACHE_MIN_COMPILE_SECS")
+    if min_secs is not None:
+        # jax's default threshold (1s) is kept unless explicitly overridden:
+        # every real TPU step-graph compile clears it, and caching the
+        # sub-second CPU executables below it exercises a deserialization
+        # path that corrupts the heap on this jax build (observed
+        # "corrupted double-linked list" aborts when a warm cache serves a
+        # second in-process Trainer on the CPU backend)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_secs)
+        )
+    if entries:
+        logger.info(
+            "compilation cache HIT candidate: %s is warm (%d entries); "
+            "restart recompiles load from disk",
+            path,
+            entries,
+        )
+    else:
+        logger.info(
+            "compilation cache MISS: %s is cold (first run); compiles will "
+            "populate it",
+            path,
+        )
+    _configured = path
+    return path

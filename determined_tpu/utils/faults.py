@@ -14,6 +14,10 @@ Sites currently wired (a site is just a dotted string; injectors may
 glob-match):
 
 - ``train.step``          before each optimizer step (``step=``)
+- ``data.prefetch.fetch`` on the prefetch WORKER thread, before each host
+                          batch fetch (``batches=`` produced so far); a raise
+                          kills the worker and surfaces at the consumer's
+                          next ``__next__`` with the original exception type
 - ``storage.upload``      before a StorageManager upload (``manager=, src=, storage_id=, paths=``)
 - ``storage.upload.done`` after a successful upload (same info)
 - ``storage.download``    before a StorageManager download (``manager=, storage_id=, dst=``)

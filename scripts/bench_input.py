@@ -1,0 +1,113 @@
+"""Input-pipeline microbenchmark: sync vs prefetched loader throughput.
+
+Isolates the HOST stages of the input pipeline (sampler + fetch + stack —
+no device, runs anywhere incl. CPU CI) against a synthetic slow dataset
+whose per-item latency models disk/decode cost, with a simulated consumer
+whose per-batch latency models the device step.  A correctly overlapped
+pipeline approaches ``max(fetch, step)`` per batch; the synchronous loop
+pays ``fetch + step``.
+
+Prints ONE JSON line: sync wall time, prefetch wall time, speedup.
+
+    JAX_PLATFORMS=cpu python scripts/bench_input.py
+    python scripts/bench_input.py --batches 50 --item-ms 0.2 --step-ms 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class SlowDataset:
+    """Map-style dataset with a fixed per-item fetch latency."""
+
+    def __init__(self, size: int, item_ms: float) -> None:
+        self._size = size
+        self._delay = item_ms / 1000.0
+        self._data = np.random.default_rng(0).standard_normal((size, 32)).astype(np.float32)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        time.sleep(self._delay)
+        return {"x": self._data[idx]}
+
+
+def run(loader, n_batches: int, step_s: float, *, prefetch_depth: int) -> float:
+    from determined_tpu.data import PrefetchingIterator
+
+    source = loader.iter_pairs()
+    it = PrefetchingIterator(source, depth=prefetch_depth) if prefetch_depth else source
+    t0 = time.perf_counter()
+    try:
+        for _ in range(n_batches):
+            state, _batch = next(it)
+            loader.commit_state(state)
+            time.sleep(step_s)  # the "device step"
+    finally:
+        if prefetch_depth:
+            it.close()
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batches", type=int, default=30)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--item-ms", type=float, default=0.5, help="per-item fetch latency")
+    p.add_argument("--step-ms", type=float, default=10.0, help="simulated device step")
+    p.add_argument("--depth", type=int, default=2, help="prefetch_depth for the async run")
+    p.add_argument("--fetch-workers", type=int, default=0)
+    args = p.parse_args()
+
+    from determined_tpu.data import DataLoader
+
+    def make_loader():
+        ds = SlowDataset(max(args.batches * args.batch_size, args.batch_size), args.item_ms)
+        return DataLoader(
+            ds,
+            args.batch_size,
+            shuffle=False,
+            shard_rank=0,
+            num_shards=1,
+            fetch_workers=args.fetch_workers,
+        )
+
+    step_s = args.step_ms / 1000.0
+    # warm both paths once (thread pool spin-up, numpy first-touch)
+    run(make_loader(), 2, step_s, prefetch_depth=0)
+    run(make_loader(), 2, step_s, prefetch_depth=args.depth)
+
+    sync_s = run(make_loader(), args.batches, step_s, prefetch_depth=0)
+    pre_s = run(make_loader(), args.batches, step_s, prefetch_depth=args.depth)
+
+    print(
+        json.dumps(
+            {
+                "metric": "input_pipeline_overlap",
+                "batches": args.batches,
+                "batch_size": args.batch_size,
+                "item_ms": args.item_ms,
+                "step_ms": args.step_ms,
+                "prefetch_depth": args.depth,
+                "fetch_workers": args.fetch_workers,
+                "sync_s": round(sync_s, 4),
+                "prefetch_s": round(pre_s, 4),
+                "speedup": round(sync_s / pre_s, 3) if pre_s else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
