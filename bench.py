@@ -38,14 +38,35 @@ def chip_peak_flops(device) -> float:
 
 
 def main() -> None:
+    import os
+
+    # A/B hook for the search scheduler (docs/search-scheduler.md):
+    # DTPU_BENCH_SEARCH=1 benchmarks serial vs mesh-packed hyperparameter
+    # search (scripts/bench_search.py) instead of the single-trial step —
+    # same one-line JSON contract, serial execution as the baseline
+    if os.environ.get("DTPU_BENCH_SEARCH", "0") not in ("0", ""):
+        import subprocess
+        import sys
+
+        raise SystemExit(
+            subprocess.call(
+                [
+                    sys.executable,
+                    os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "scripts",
+                        "bench_search.py",
+                    ),
+                ]
+            )
+        )
+
     import jax
 
     from determined_tpu import core, train
     from determined_tpu.data import to_global
     from determined_tpu.models.transformer import LMTrial
     from determined_tpu.parallel.mesh import MeshConfig
-
-    import os
 
     n = len(jax.devices())
     # env overrides for tuning sweeps (defaults are the tuned config)

@@ -235,6 +235,11 @@ class OptimizationsConfig:
     # env): a supervised restart after a crash re-jits from disk instead of
     # paying the full compile.  None disables.
     compilation_cache_dir: Optional[str] = None
+    # Cross-trial jit-reuse cache (train/_jit_cache.py): same-architecture
+    # trials in one process share compiled train/eval steps instead of
+    # re-tracing identical programs.  In-process complement of the
+    # persistent cache above (which covers cross-process reuse).
+    jit_cache: bool = True
 
     def __post_init__(self):
         if self.aggregation_frequency < 1:

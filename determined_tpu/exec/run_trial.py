@@ -512,6 +512,12 @@ def main() -> int:
         logger.info(
             "trial finished: %s (restarts=%d)", summary, summary.get("restarts", 0)
         )
+        # each supervised restart builds a fresh Trainer; its _setup hits the
+        # in-process jit-reuse cache (train/_jit_cache.py), so hits here mean
+        # restarts re-entered fit without re-tracing the step — the log line
+        # tells operators which tier (step cache vs persistent XLA cache vs
+        # full compile) the attempts actually paid
+        logger.info("jit-reuse cache: %s", train.step_cache_stats())
         return 0
     finally:
         core_ctx.close()

@@ -1,5 +1,19 @@
-"""Experiment orchestration: local searcher-driven runner."""
+"""Experiment orchestration: local searcher-driven runner + gang scheduler."""
 
 from determined_tpu.experiment.local import LocalExperiment, TrialResult, run_experiment
+from determined_tpu.experiment.scheduler import (
+    SchedulerOutcome,
+    SlotAllocation,
+    SlotPool,
+    TrialScheduler,
+)
 
-__all__ = ["LocalExperiment", "TrialResult", "run_experiment"]
+__all__ = [
+    "LocalExperiment",
+    "SchedulerOutcome",
+    "SlotAllocation",
+    "SlotPool",
+    "TrialResult",
+    "TrialScheduler",
+    "run_experiment",
+]

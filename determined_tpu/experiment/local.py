@@ -4,12 +4,20 @@ The reference can only run searches through the master
 (``master/internal/experiment.go`` drives ``searcher``); off-cluster users
 get single trials.  On a TPU VM the single-host case is common enough that
 the search loop itself is part of the harness: this runner drives the SAME
-``Searcher``/``SearchMethod`` machinery the master uses, executing trials
-sequentially (or a caller-supplied executor) with checkpoint/metrics flowing
-through the normal Core API dummy contexts.
+``Searcher``/``SearchMethod`` machinery the master uses, with checkpoint/
+metrics flowing through the normal Core API dummy contexts.
 
-It is also the reference implementation the C++ master's experiment engine
-mirrors (same event order: create -> validations -> stop/exit).
+Execution is trial-parallel by default: when ``searcher.
+max_concurrent_trials``, the trial mesh size, and the visible device count
+allow, the runner packs concurrent trials onto disjoint device submeshes
+via the gang scheduler (``experiment/scheduler.py``) — each trial gets its
+own ``resources.mesh``-shaped block of ``jax.devices()``, its own thread,
+and a namespaced checkpoint directory; ASHA stops free their slots for
+immediate backfill, and same-architecture trials share compiled steps
+through the jit-reuse cache (``train/_jit_cache.py``).  ``run(serial=True)``
+forces the reference-equivalent sequential loop (same event order:
+create -> validations -> stop/exit), which is also the parity oracle the
+concurrent path is tested against.
 """
 
 from __future__ import annotations
@@ -17,16 +25,15 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
-from typing import Any, Callable, Dict, List, Optional, Type
+from typing import Any, Dict, List, Optional, Type
 
 from determined_tpu import core
-from determined_tpu.config.experiment import ExperimentConfig, Length
-from determined_tpu.searcher import (
-    Create,
-    Searcher,
-    Stop,
-    method_from_config,
+from determined_tpu.config.experiment import (
+    ExperimentConfig,
+    InvalidExperimentConfig,
+    Length,
 )
+from determined_tpu.searcher import Create, Searcher, method_from_config
 from determined_tpu.train import Trainer, TrialContext
 from determined_tpu.train._trial import JaxTrial
 
@@ -53,6 +60,7 @@ class LocalExperiment:
         *,
         checkpoint_dir: Optional[str] = None,
         seed: Optional[int] = None,
+        devices: Optional[List[Any]] = None,
     ) -> None:
         self.config = config
         self.trial_cls = trial_cls
@@ -60,66 +68,93 @@ class LocalExperiment:
             os.getcwd(), "local_experiment_checkpoints"
         )
         self.seed = seed if seed is not None else config.reproducibility.experiment_seed
+        self.devices = devices  # None = jax.devices() at run time
         self.searcher = Searcher(
             method_from_config(config.searcher, config.hyperparameters),
             config.hyperparameters,
             seed=self.seed,
         )
         self.results: Dict[int, TrialResult] = {}
+        self.scheduler_stats: Optional[Dict[str, Any]] = None
 
     # -- single-trial execution -------------------------------------------
 
-    def _run_trial(self, create: Create) -> TrialResult:
+    def _trial_checkpoint_dir(self, request_id: int) -> str:
+        """Per-trial namespace: concurrent trials must never interleave
+        storage ids in one flat directory, and a search's checkpoints stay
+        attributable to their trial afterwards."""
+        return os.path.join(self.checkpoint_dir, f"trial_{request_id}")
+
+    def _run_trial(
+        self, create: Create, devices: Optional[List[Any]] = None
+    ) -> TrialResult:
         """Train one trial; report validations into the searcher as they
-        happen so ASHA can stop it between validation boundaries."""
+        happen so ASHA can stop it between validation boundaries.
+
+        ``devices``: the gang-allocated submesh for this trial (concurrent
+        path); None uses the full default device set (serial path).
+        Thread-safe: everything here is per-trial state except the searcher
+        calls, which serialize internally.
+        """
         from determined_tpu import train as train_mod
 
         cfg = self.config
         scfg = cfg.searcher
         max_length = scfg.max_length or Length.batches(scfg.max_time or 100)
-        core_ctx = core._dummy_init(checkpoint_dir=self.checkpoint_dir)
-        ctx = train_mod.init(
-            hparams=create.hparams,
-            mesh_config=cfg.resources.mesh,
-            core_context=core_ctx,
-            exp_config=cfg,
-            seed=self.seed + create.request_id,
-        )
-        trial = self.trial_cls(ctx)
-        trainer = Trainer(trial)
-
         rid = create.request_id
+        core_ctx = core._dummy_init(checkpoint_dir=self._trial_checkpoint_dir(rid))
+        orig_report = core_ctx.train.report_validation_metrics
         searcher = self.searcher
         runner = self
-
-        orig_report = core_ctx.train.report_validation_metrics
-
-        def report_validation(steps_completed: int, metrics: Dict[str, Any]) -> None:
-            orig_report(steps_completed, metrics)
-            payload = dict(metrics)
-            payload.setdefault(scfg.time_metric or "batches", steps_completed)
-            searcher.on_validation(rid, payload)
-            rec = searcher.trials.get(rid)
-            if rec is not None and rec.stopped_by_searcher:
-                # cooperative stop through the preemption path: the trainer
-                # checkpoints and exits at the next boundary
-                core_ctx.preempt.simulate()
-            searcher.set_trial_progress(
-                rid, min(steps_completed / runner._max_steps(trainer, max_length), 1.0)
+        try:
+            ctx = train_mod.init(
+                hparams=create.hparams,
+                mesh_config=cfg.resources.mesh,
+                core_context=core_ctx,
+                exp_config=cfg,
+                seed=self.seed + rid,
+                devices=devices,
             )
+            trial = self.trial_cls(ctx)
+            trainer = Trainer(trial)
 
-        core_ctx.train.report_validation_metrics = report_validation
+            def report_validation(
+                steps_completed: int, metrics: Dict[str, Any]
+            ) -> None:
+                orig_report(steps_completed, metrics)
+                payload = dict(metrics)
+                payload.setdefault(scfg.time_metric or "batches", steps_completed)
+                searcher.on_validation(rid, payload)
+                if searcher.is_stopped(rid):
+                    # cooperative stop through the preemption path: the
+                    # trainer checkpoints and exits at the next boundary,
+                    # the scheduler then releases this trial's slots for
+                    # backfill
+                    core_ctx.preempt.simulate()
+                searcher.set_trial_progress(
+                    rid,
+                    min(steps_completed / runner._max_steps(trainer, max_length), 1.0),
+                )
 
-        validation_period = cfg.min_validation_period or Length.batches(
-            max(1, (max_length.units if max_length.unit == "batches" else 100) // 4)
-        )
-        summary = trainer.fit(
-            max_length,
-            validation_period=validation_period,
-            checkpoint_period=cfg.min_checkpoint_period,
-            report_period=validation_period,
-            checkpoint_policy=cfg.checkpoint_policy,
-        )
+            core_ctx.train.report_validation_metrics = report_validation
+
+            validation_period = cfg.min_validation_period or Length.batches(
+                max(1, (max_length.units if max_length.unit == "batches" else 100) // 4)
+            )
+            summary = trainer.fit(
+                max_length,
+                validation_period=validation_period,
+                checkpoint_period=cfg.min_checkpoint_period,
+                report_period=validation_period,
+                checkpoint_policy=cfg.checkpoint_policy,
+            )
+        finally:
+            # the hook must not outlive the trial: anything else reusing
+            # this context (restarts, callers holding core_ctx) would keep
+            # feeding a finished trial's searcher record — and a failed
+            # build must still close the context it was handed
+            core_ctx.train.report_validation_metrics = orig_report
+            core_ctx.close()
         return TrialResult(
             request_id=rid,
             hparams=create.hparams,
@@ -130,35 +165,127 @@ class LocalExperiment:
         )
 
     def _max_steps(self, trainer: Trainer, max_length: Length) -> int:
+        """Optimizer-step horizon for progress reporting.
+
+        The epoch/record conversions need loader state that a half-built
+        trainer may not have yet — fall back to raw units for those
+        structural gaps only.  A malformed config must surface as
+        ``InvalidExperimentConfig``, not be silently clamped to a bogus
+        progress denominator.
+        """
         try:
             return trainer._to_batches(max_length) or 1
-        except Exception:
+        except InvalidExperimentConfig:
+            raise
+        except (AttributeError, TypeError, ZeroDivisionError):
             return max(max_length.units, 1)
 
     # -- the search loop ---------------------------------------------------
 
-    def run(self, max_trials: Optional[int] = None) -> Dict[str, Any]:
-        """Run the search to completion (sequential execution)."""
+    def _slots_per_trial(self, n_devices: int) -> int:
+        """Devices one trial's mesh occupies; a wildcard (-1) axis means
+        'the whole host', which forces serial execution."""
+        mesh_cfg = self.config.resources.mesh
+        if -1 in mesh_cfg.sizes():
+            return n_devices
+        return mesh_cfg.num_devices
+
+    def run(
+        self,
+        max_trials: Optional[int] = None,
+        *,
+        serial: bool = False,
+        max_concurrency: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Run the search to completion.
+
+        Trials run concurrently on disjoint submeshes when
+        ``searcher.max_concurrent_trials`` (> 1), the per-trial mesh size,
+        and the device count allow; ``serial=True`` forces the sequential
+        reference loop and ``max_concurrency`` caps (never raises) the
+        config-derived gang count.
+        """
+        import jax
+
+        devices = list(self.devices if self.devices is not None else jax.devices())
+        slots = self._slots_per_trial(len(devices))
+        if slots > len(devices):
+            raise InvalidExperimentConfig(
+                f"resources.mesh wants {slots} devices per trial, "
+                f"only {len(devices)} visible"
+            )
+        limit = self.config.searcher.max_concurrent_trials
+        if limit <= 0:
+            # 0 = no explicit cap (the adaptive searcher's "auto" value):
+            # bound by device capacity alone
+            limit = len(devices)
+        concurrency = min(limit, max(1, len(devices) // slots))
+        if max_concurrency is not None:
+            concurrency = min(concurrency, max(1, max_concurrency))
+        if serial or concurrency <= 1:
+            return self._run_serial(max_trials)
+        return self._run_concurrent(max_trials, devices, slots, concurrency)
+
+    def _run_serial(self, max_trials: Optional[int] = None) -> Dict[str, Any]:
+        """Sequential execution — the reference event order, and the parity
+        oracle for the concurrent scheduler."""
         self.searcher.start()
         executed = 0
         while self.searcher.shutdown is None:
             pending = [
                 t
-                for t in self.searcher.trials.values()
-                if t.running and t.request_id not in self.results
+                for t in self.searcher.runnable_trials()
+                if t.request_id not in self.results
             ]
             if not pending:
                 break
-            rec = pending[0]
+            rec = min(pending, key=lambda t: t.request_id)
             if max_trials is not None and executed >= max_trials:
                 break
             logger.info(
                 "trial %d starting with hparams %s", rec.request_id, rec.hparams
             )
-            result = self._run_trial(Create(rec.request_id, rec.hparams))
+            # an explicit device grant binds the serial path too, not just
+            # the packed scheduler
+            result = self._run_trial(
+                Create(rec.request_id, rec.hparams), devices=self.devices
+            )
             self.results[rec.request_id] = result
             executed += 1
             self.searcher.on_trial_exited(rec.request_id)
+        return self.summary()
+
+    def _run_concurrent(
+        self,
+        max_trials: Optional[int],
+        devices: List[Any],
+        slots: int,
+        concurrency: int,
+    ) -> Dict[str, Any]:
+        from determined_tpu.experiment.scheduler import SlotPool, TrialScheduler
+
+        logger.info(
+            "concurrent search: %d devices / %d per trial -> up to %d trials in parallel",
+            len(devices),
+            slots,
+            concurrency,
+        )
+        scheduler = TrialScheduler(
+            self.searcher,
+            SlotPool(devices),
+            self._run_trial,
+            slots_per_trial=slots,
+            max_concurrent=concurrency,
+        )
+        outcome = scheduler.run(max_trials=max_trials)
+        self.results.update(outcome.results)
+        self.scheduler_stats = outcome.stats
+        if outcome.errors:
+            rid, exc = outcome.errors[0]
+            # original exception type, same as the serial path (callers
+            # classifying failures must not see a mode-dependent wrapper)
+            logger.error("trial %d failed during concurrent search", rid)
+            raise exc
         return self.summary()
 
     def summary(self) -> Dict[str, Any]:
@@ -174,7 +301,7 @@ class LocalExperiment:
             bval = best.metrics.get(scfg.metric)
             if (val < bval) == scfg.smaller_is_better:
                 best = r
-        return {
+        out = {
             "trials": len(self.results),
             "best_trial": best.request_id if best else None,
             "best_hparams": best.hparams if best else None,
@@ -182,6 +309,9 @@ class LocalExperiment:
             "total_steps": sum(r.steps_completed for r in self.results.values()),
             "progress": self.searcher.progress(),
         }
+        if self.scheduler_stats is not None:
+            out["scheduler"] = dict(self.scheduler_stats)
+        return out
 
 
 def run_experiment(

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from determined_tpu.config.experiment import ExperimentConfig, SearcherConfig
@@ -77,7 +78,14 @@ class TrialRecord:
 
 
 class Searcher:
-    """Stateful wrapper the experiment engine drives."""
+    """Stateful wrapper the experiment engine drives.
+
+    Event entry points serialize on an internal lock: the concurrent trial
+    scheduler fires ``on_validation``/``set_trial_progress`` from trial
+    threads while the dispatcher thread drives exits and reads pending
+    creates, and SearchMethod implementations are written single-threaded
+    (rung lists, rng draws, id counters).
+    """
 
     def __init__(
         self, method: SearchMethod, hparams: Dict[str, Any], seed: int = 0
@@ -87,6 +95,8 @@ class Searcher:
         self.trials: Dict[RequestID, TrialRecord] = {}
         self.shutdown: Optional[Shutdown] = None
         self._trial_progress: Dict[RequestID, float] = {}
+        # RLock: _absorb recurses through trial_created events
+        self._lock = threading.RLock()
 
     # -- event entry points (called by the experiment engine) --------------
 
@@ -109,42 +119,65 @@ class Searcher:
         return actions
 
     def start(self) -> List[Action]:
-        return self._absorb(self.method.initial_trials(self.ctx))
+        with self._lock:
+            return self._absorb(self.method.initial_trials(self.ctx))
 
     def on_validation(
         self, request_id: RequestID, metrics: Dict[str, Any]
     ) -> List[Action]:
-        if request_id in self.trials:
-            self.trials[request_id].metrics = dict(metrics)
-        return self._absorb(
-            self.method.validation_completed(self.ctx, request_id, metrics)
-        )
+        with self._lock:
+            if request_id in self.trials:
+                self.trials[request_id].metrics = dict(metrics)
+            return self._absorb(
+                self.method.validation_completed(self.ctx, request_id, metrics)
+            )
 
     def on_trial_exited(self, request_id: RequestID) -> List[Action]:
-        if request_id in self.trials:
-            rec = self.trials[request_id]
-            rec.running = False
-            rec.exited = True
-        return self._absorb(self.method.trial_exited(self.ctx, request_id))
+        with self._lock:
+            if request_id in self.trials:
+                rec = self.trials[request_id]
+                rec.running = False
+                rec.exited = True
+            return self._absorb(self.method.trial_exited(self.ctx, request_id))
 
     def on_trial_exited_early(self, request_id: RequestID, reason: str) -> List[Action]:
-        if request_id in self.trials:
-            self.trials[request_id].running = False
-            self.trials[request_id].exited = True
-        return self._absorb(
-            self.method.trial_exited_early(self.ctx, request_id, reason)
-        )
+        with self._lock:
+            if request_id in self.trials:
+                self.trials[request_id].running = False
+                self.trials[request_id].exited = True
+            return self._absorb(
+                self.method.trial_exited_early(self.ctx, request_id, reason)
+            )
 
     def set_trial_progress(self, request_id: RequestID, progress: float) -> None:
-        self._trial_progress[request_id] = progress
+        with self._lock:
+            self._trial_progress[request_id] = progress
 
     def progress(self) -> float:
-        closed = {rid: t.exited for rid, t in self.trials.items()}
-        return self.method.progress(self._trial_progress, closed)
+        with self._lock:
+            closed = {rid: t.exited for rid, t in self.trials.items()}
+            return self.method.progress(self._trial_progress, closed)
+
+    # -- thread-safe views (the concurrent scheduler's read surface) -------
+
+    def runnable_trials(self) -> List[TrialRecord]:
+        """Snapshot of trials that are created and not yet exited."""
+        with self._lock:
+            return [t for t in self.trials.values() if t.running and not t.exited]
+
+    def is_stopped(self, request_id: RequestID) -> bool:
+        """Whether the method has asked this trial to stop early."""
+        with self._lock:
+            rec = self.trials.get(request_id)
+            return bool(rec is not None and rec.stopped_by_searcher)
 
     # -- snapshot ----------------------------------------------------------
 
     def state_json(self) -> str:
+        with self._lock:
+            return self._state_json_locked()
+
+    def _state_json_locked(self) -> str:
         return json.dumps(
             {
                 "method": self.method.state_dict(),
@@ -162,6 +195,10 @@ class Searcher:
         )
 
     def restore_json(self, text: str) -> None:
+        with self._lock:
+            self._restore_json_locked(text)
+
+    def _restore_json_locked(self, text: str) -> None:
         state = json.loads(text)
         self.method.load_state_dict(state["method"])
         if "ctx" in state:

@@ -1,5 +1,10 @@
 """Training engine: JaxTrial + Trainer boundary loop + serialization."""
 
+from determined_tpu.train._jit_cache import (
+    clear_step_cache,
+    get_step_cache,
+    step_cache_stats,
+)
 from determined_tpu.train._load import load_trial_from_checkpoint
 from determined_tpu.train._reducer import MetricReducer, get_reducer
 from determined_tpu.train._restart import Attempt, RestartPolicy, run_with_restarts
@@ -17,9 +22,12 @@ __all__ = [
     "TrainState",
     "Trainer",
     "TrialContext",
+    "clear_step_cache",
     "get_reducer",
+    "get_step_cache",
     "init",
     "load_trial_from_checkpoint",
+    "step_cache_stats",
     "run_with_restarts",
     "serialization",
 ]

@@ -69,11 +69,15 @@ def init(
     core_context: Optional[Any] = None,
     seed: Optional[int] = None,
     rules: Optional[Dict[str, Any]] = None,
+    devices: Optional[List[Any]] = None,
 ) -> TrialContext:
     """Build a TrialContext — reference ``pytorch.init`` (``_trainer.py:282``).
 
     Off-cluster this produces a fully local context (dummy core services);
     on-cluster the same call picks up rendezvous + master connection.
+    ``devices`` restricts the trial's mesh to an explicit device subset —
+    the concurrent scheduler passes each trial its gang-allocated submesh
+    (default: all of ``jax.devices()``).
     """
     if exp_config is not None:
         if hparams is None:
@@ -95,7 +99,7 @@ def init(
         exp_config.optimizations.compilation_cache_dir if exp_config else None
     )
     core = core_context or core_context_mod.init()
-    mesh = make_mesh(mesh_config or MeshConfig.data_parallel(-1))
+    mesh = make_mesh(mesh_config or MeshConfig.data_parallel(-1), devices=devices)
     return TrialContext(
         core=core,
         mesh=mesh,
@@ -354,8 +358,49 @@ class Trainer:
                 new_acc[k] = red.accumulate(carry, v.astype(jnp.float32))
             return new_acc, count + 1.0
 
-        self._train_step = jax.jit(train_step, donate_argnums=0)
-        self._eval_step = jax.jit(eval_step, donate_argnums=2)
+        # ---- cross-trial jit reuse ---------------------------------------
+        # Same-architecture trials in one process (the concurrent search
+        # scheduler, sequential ASHA backfills) share ONE jitted callable
+        # per step signature instead of re-tracing/re-compiling identical
+        # programs — see train/_jit_cache.py for exactly what keys the
+        # signature and why sharing is sound.
+        from determined_tpu.train import _jit_cache
+
+        use_cache = opt.jit_cache if opt is not None else True
+        if use_cache:
+            key = _jit_cache.step_cache_key(
+                trial=trial,
+                hparams=ctx.hparams,
+                mesh=self.mesh,
+                agg=agg,
+                average_grads=average_grads,
+                sample_batch=sample,
+                metric_keys=metric_keys,
+                rules=ctx.rules,
+            )
+            cache = _jit_cache.get_step_cache()
+            entry = cache.lookup(key)
+            if entry is None:
+                entry = cache.insert(
+                    key,
+                    _jit_cache.CachedSteps(
+                        train_step=jax.jit(train_step, donate_argnums=0),
+                        eval_step=jax.jit(eval_step, donate_argnums=2),
+                        trial_class=f"{type(trial).__module__}:{type(trial).__qualname__}",
+                    ),
+                )
+            else:
+                logger.info(
+                    "jit-reuse cache hit for %s (key %s…): sharing compiled "
+                    "train/eval steps",
+                    type(trial).__qualname__,
+                    key[:12],
+                )
+            self._train_step = entry.train_step
+            self._eval_step = entry.eval_step
+        else:
+            self._train_step = jax.jit(train_step, donate_argnums=0)
+            self._eval_step = jax.jit(eval_step, donate_argnums=2)
 
     def _place_on_mesh(self, tree: Any) -> Any:
         """Replicate any leaf not already sharded over THIS mesh.

@@ -202,6 +202,22 @@ class JaxTrial(abc.ABC):
             return (batch["x"],)
         return (next(iter(batch.values())),)
 
+    def compile_cache_runtime_hparams(self) -> Tuple[str, ...]:
+        """Hyperparameters that do NOT shape the compiled step.
+
+        The cross-trial jit-reuse cache (``train/_jit_cache.py``) keys the
+        shared train/eval steps on every hyperparameter by default, because
+        a Python scalar closed over by ``loss``/``build_optimizer`` bakes
+        into the HLO as a constant.  A trial that routes an hparam through
+        runtime state instead — e.g. a learning rate via
+        ``optax.inject_hyperparams`` (it then lives in ``opt_state`` and is
+        read by the traced step at run time) — can name it here so trials
+        differing only in that hparam share one compiled step.  Naming an
+        hparam that actually IS baked into the trace silently reuses the
+        first trial's value; only declare hparams you know are runtime.
+        """
+        return ()
+
     def param_logical_specs(self, params: Any) -> Optional[Any]:
         """Logical sharding spec pytree for params; None -> infer.
 

@@ -10,6 +10,12 @@ across attempts), which on a large LM is minutes saved per restart.
 The directory comes from ``optimizations.compilation_cache_dir`` (the
 experiment's declaration, authoritative) or the ``DTPU_COMPILATION_CACHE``
 env var (operator-level fallback).  Setup is idempotent per process.
+
+In-process, the cross-trial jit-reuse cache (``train/_jit_cache.py``) sits
+a tier above this one: a fresh Trainer in the SAME process (in-process
+restart, concurrent/sequential search trials) shares the jitted callable
+itself — no retrace, no disk read.  This persistent cache covers the
+cross-process half (new attempt process, relaunch after a crash).
 """
 
 from __future__ import annotations
