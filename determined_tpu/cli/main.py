@@ -579,6 +579,62 @@ def cluster_up(args) -> int:
                 p.kill()
 
 
+# ---- lint ------------------------------------------------------------------
+
+
+def lint_cmd(args) -> int:
+    """Static preflight analysis of trial code — no master required.
+
+    Targets are .py files, directories (recursive), or
+    ``pkg.module:TrialClass`` entrypoints.  Exit status: 0 clean, 1 on
+    error-severity findings (any finding with ``--strict``), 2 on usage /
+    unloadable target.
+    """
+    from determined_tpu import lint as lint_mod
+
+    sys.path.insert(0, os.getcwd())
+    diags = []
+    for target in args.target:
+        try:
+            if os.path.exists(target):
+                diags.extend(
+                    lint_mod.analyze_path(
+                        target, rules=args.rule or None, disabled=args.suppress or None
+                    )
+                )
+            elif ":" in target or "." in target:
+                diags.extend(
+                    lint_mod.analyze_entrypoint(
+                        target, rules=args.rule or None, disabled=args.suppress or None
+                    )
+                )
+            else:
+                print(f"error: no such file, directory, or module: {target}",
+                      file=sys.stderr)
+                return 2
+        except Exception as e:  # noqa: BLE001 - the entrypoint import runs
+            # arbitrary user module code; ANY failure there is "target
+            # unloadable" (exit 2), never "findings present" (exit 1)
+            print(f"error: cannot lint {target}: {e}", file=sys.stderr)
+            return 2
+    if args.json:
+        _print_json(lint_mod.to_json_payload(diags))
+    else:
+        for d in diags:
+            print(d.format())
+        errors = sum(1 for d in diags if d.severity == lint_mod.ERROR)
+        warnings = len(diags) - errors
+        print(
+            f"{len(diags)} finding(s): {errors} error(s), {warnings} warning(s)"
+            if diags
+            else "clean: no findings"
+        )
+    failing = [
+        d for d in diags if d.severity == lint_mod.ERROR or args.strict
+    ]
+    return 1 if failing else 0
+
+
 # ---- search preview + local run -------------------------------------------
 
 
@@ -856,6 +912,30 @@ def build_parser() -> argparse.ArgumentParser:
     cu.add_argument("--state-dir", default="/tmp/dtpu-master")
     cu.add_argument("--checkpoint-dir", default="/tmp/dtpu-checkpoints")
     cu.set_defaults(fn=cluster_up)
+
+    ln = sub.add_parser(
+        "lint",
+        help="static preflight analysis of trial code (docs/lint.md)",
+    )
+    ln.add_argument(
+        "target",
+        nargs="+",
+        help=".py file, directory, or pkg.module:TrialClass entrypoint",
+    )
+    ln.add_argument("--json", action="store_true", help="machine-readable output")
+    ln.add_argument(
+        "--strict", action="store_true",
+        help="exit non-zero on ANY finding (default: errors only)",
+    )
+    ln.add_argument(
+        "--rule", action="append",
+        help="restrict to specific rule ids (repeatable)",
+    )
+    ln.add_argument(
+        "--suppress", action="append",
+        help="disable specific rule ids (repeatable)",
+    )
+    ln.set_defaults(fn=lint_cmd)
 
     ps = sub.add_parser("preview-search")
     ps.add_argument("config")

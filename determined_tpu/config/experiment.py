@@ -299,6 +299,59 @@ class FaultToleranceConfig:
         return cls(**raw)
 
 
+@dataclasses.dataclass(frozen=True)
+class LintConfig:
+    """Trial preflight analyzer knobs (``determined_tpu/lint``).
+
+    ``preflight``: run the static AST pass over the trial class before any
+    device is allocated (LocalExperiment; the trial supervisor does the
+    same before building the Trainer).  Warn-only unless ``strict``, which
+    fails the experiment on ANY finding — the cheap way to protect a
+    search's TPU-hours from a host-syncing or retrace-prone trial.
+    ``retrace_sentinel``: wrap the jitted step functions and warn when one
+    logical step compiles more than once (guards the jit-reuse cache's
+    throughput win).  ``thread_sentinel``: run the trial under the
+    thread-leak checker (warn mode) so leaked prefetch/scheduler workers
+    surface in logs.  ``suppress``: rule ids disabled for this experiment
+    (the per-line ``# dtpu: lint-ok[rule]`` comment is preferred — it keeps
+    the audit local).
+    """
+
+    preflight: bool = True
+    strict: bool = False
+    retrace_sentinel: bool = False
+    thread_sentinel: bool = False
+    suppress: List[str] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        # validate rule ids at parse time: a typo'd suppression silently
+        # linting everything would defeat the audit
+        from determined_tpu.lint.rules import all_rules
+
+        suppress = self.suppress
+        if suppress is None:  # YAML `suppress:` with no value
+            suppress = []
+            object.__setattr__(self, "suppress", suppress)
+        if isinstance(suppress, str) or not isinstance(suppress, (list, tuple)):
+            raise InvalidExperimentConfig(
+                f"lint.suppress must be a list of rule ids, got {suppress!r}"
+            )
+        unknown = set(suppress) - set(all_rules())
+        if unknown:
+            raise InvalidExperimentConfig(
+                f"lint.suppress names unknown rules: {sorted(unknown)}"
+            )
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "LintConfig":
+        raw = dict(raw or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise InvalidExperimentConfig(f"unknown lint fields: {sorted(unknown)}")
+        return cls(**raw)
+
+
 _LOG_POLICY_ACTIONS = ("cancel_retries", "exclude_node")
 
 
@@ -366,6 +419,7 @@ class ExperimentConfig:
     fault_tolerance: FaultToleranceConfig = dataclasses.field(
         default_factory=FaultToleranceConfig
     )
+    lint: LintConfig = dataclasses.field(default_factory=LintConfig)
     reproducibility: ReproducibilityConfig = dataclasses.field(
         default_factory=ReproducibilityConfig
     )
@@ -434,6 +488,8 @@ class ExperimentConfig:
             kwargs["optimizations"] = OptimizationsConfig.parse(raw.pop("optimizations"))
         if "fault_tolerance" in raw:
             kwargs["fault_tolerance"] = FaultToleranceConfig.parse(raw.pop("fault_tolerance"))
+        if "lint" in raw:
+            kwargs["lint"] = LintConfig.parse(raw.pop("lint"))
         if "log_policies" in raw:
             policies = raw.pop("log_policies") or []
             if not isinstance(policies, list):

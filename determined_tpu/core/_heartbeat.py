@@ -63,6 +63,10 @@ class HeartbeatReporter:
         try:
             self._session.post(f"/api/v1/trials/{self._trial_id}/heartbeat")
         except Exception:  # noqa: BLE001 - counted, not swallowed silently
+            # single writer (the reporter thread); the main thread only
+            # READS the streak for monitoring, and an int-reference store is
+            # GIL-atomic — worst case a read sees the previous streak value
+            # dtpu: lint-ok[unlocked-shared-state]
             self._failure_streak += 1
             if self._failure_streak >= self._failure_threshold and not self._unreachable.is_set():
                 self._unreachable.set()
@@ -84,6 +88,8 @@ class HeartbeatReporter:
             logger.warning(
                 "master reachable again after %d missed heartbeats", self._failure_streak
             )
+        # same single-writer argument as the failure branch above
+        # dtpu: lint-ok[unlocked-shared-state]
         self._failure_streak = 0
         self._unreachable.clear()
         return True

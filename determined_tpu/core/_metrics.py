@@ -104,6 +104,9 @@ class MetricsContext:
                         # batch, keep the thread alive for the next one.
                         logger.exception("failed to ship %d metrics; dropped", len(batch))
         except BaseException as e:  # noqa: BLE001
+            # terminal single write as the shipper dies; consumers observe
+            # it only after noticing the thread is gone (GIL-atomic store)
+            # dtpu: lint-ok[unlocked-shared-state]
             self._error = e
             logger.exception("metrics shipper thread failed")
 

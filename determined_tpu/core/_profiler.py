@@ -119,6 +119,9 @@ class ProfilerContext:
         self._steps_fn = lambda: None  # trainer installs a steps provider
 
     def set_steps_fn(self, fn) -> None:
+        # rebinding a callable attr the sampler reads: a reference store is
+        # GIL-atomic; the sampler uses either the old or new provider
+        # dtpu: lint-ok[unlocked-shared-state]
         self._steps_fn = fn
 
     def on(self, sampling: bool = True, trace: bool = False) -> None:

@@ -467,6 +467,29 @@ def main() -> int:
     sys.path.insert(0, os.getcwd())
     trial_cls = getattr(importlib.import_module(module_name), class_name)
 
+    # preflight (determined_tpu/lint): vet the trial's source before any
+    # Trainer is built — the allocation is already placed by this point,
+    # but a strict-mode reject still saves the whole training run (and the
+    # master's restart budget) from a host-syncing or retrace-prone trial
+    lint_cfg = exp_config.lint
+    if lint_cfg.retrace_sentinel:
+        from determined_tpu.lint import get_retrace_sentinel
+
+        get_retrace_sentinel().enable()
+    if lint_cfg.preflight:
+        from determined_tpu import lint as lint_mod
+
+        diags = lint_mod.check_trial(trial_cls, disabled=lint_cfg.suppress or None)
+        for d in diags:
+            logger.warning("preflight: %s", d.format())
+        if lint_cfg.strict and diags:
+            logger.error(
+                "preflight rejected %s (lint.strict): %d finding(s)",
+                trial_cls.__qualname__,
+                len(diags),
+            )
+            return 3
+
     core_ctx = core.init()
     try:
         # expconf-driven profiling (reference exec/harness.py:211): system
@@ -502,13 +525,31 @@ def main() -> int:
             metrics=core_ctx.metrics,
             master_unreachable=lambda: core_ctx.master_unreachable,
         )
-        summary = supervisor.run(
-            max_length,
-            validation_period=exp_config.min_validation_period,
-            checkpoint_period=exp_config.min_checkpoint_period,
-            latest_checkpoint=cluster.latest_checkpoint,
-            checkpoint_policy=exp_config.checkpoint_policy,
-        )
+
+        def run_supervised():
+            return supervisor.run(
+                max_length,
+                validation_period=exp_config.min_validation_period,
+                checkpoint_period=exp_config.min_checkpoint_period,
+                latest_checkpoint=cluster.latest_checkpoint,
+                checkpoint_policy=exp_config.checkpoint_policy,
+            )
+
+        if lint_cfg.thread_sentinel:
+            # warn-mode leak check over the whole supervised run: every
+            # harness worker (prefetch, checkpoint writer, restart
+            # attempts' loaders) must be gone when fit returns — leaked
+            # workers across supervised restarts compound
+            from determined_tpu.lint import ThreadLeakChecker
+
+            with ThreadLeakChecker(
+                watch=("dtpu-*",),
+                raise_on_leak=False,
+                scope=f"trial {cluster.trial_id}",
+            ):
+                summary = run_supervised()
+        else:
+            summary = run_supervised()
         logger.info(
             "trial finished: %s (restarts=%d)", summary, summary.get("restarts", 0)
         )

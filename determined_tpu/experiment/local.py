@@ -61,9 +61,12 @@ class LocalExperiment:
         checkpoint_dir: Optional[str] = None,
         seed: Optional[int] = None,
         devices: Optional[List[Any]] = None,
+        preflight: Optional[bool] = None,
     ) -> None:
         self.config = config
         self.trial_cls = trial_cls
+        # None = follow config.lint.preflight (on by default)
+        self.preflight = preflight
         self.checkpoint_dir = checkpoint_dir or os.path.join(
             os.getcwd(), "local_experiment_checkpoints"
         )
@@ -180,6 +183,42 @@ class LocalExperiment:
         except (AttributeError, TypeError, ZeroDivisionError):
             return max(max_length.units, 1)
 
+    # -- preflight ---------------------------------------------------------
+
+    def _preflight_check(self) -> None:
+        """Static lint of the trial class before any device work.
+
+        Also arms the runtime sentinels the config asks for, so the
+        Trainers this experiment builds pick them up.
+        """
+        from determined_tpu import lint as lint_mod
+
+        lint_cfg = getattr(self.config, "lint", None)
+        if lint_cfg is None:
+            return
+        if lint_cfg.retrace_sentinel:
+            lint_mod.get_retrace_sentinel().enable()
+        enabled = (
+            self.preflight if self.preflight is not None else lint_cfg.preflight
+        )
+        if not enabled:
+            return
+        diags = lint_mod.check_trial(
+            self.trial_cls, disabled=lint_cfg.suppress or None
+        )
+        if not diags:
+            return
+        if lint_cfg.strict:
+            raise lint_mod.LintError(
+                diags,
+                context=(
+                    f"preflight rejected {self.trial_cls.__qualname__} "
+                    f"(lint.strict): {len(diags)} finding(s)"
+                ),
+            )
+        for d in diags:
+            logger.warning("preflight: %s", d.format())
+
     # -- the search loop ---------------------------------------------------
 
     def _slots_per_trial(self, n_devices: int) -> int:
@@ -204,7 +243,13 @@ class LocalExperiment:
         and the device count allow; ``serial=True`` forces the sequential
         reference loop and ``max_concurrency`` caps (never raises) the
         config-derived gang count.
+
+        Preflight runs FIRST — before jax touches devices or the scheduler
+        allocates a single slot: a host-syncing or retrace-prone trial is
+        cheapest to reject while it is still just source code.  Warn-only
+        by default; ``lint.strict`` (config) fails fast with a LintError.
         """
+        self._preflight_check()
         import jax
 
         devices = list(self.devices if self.devices is not None else jax.devices())

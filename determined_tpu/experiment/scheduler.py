@@ -202,13 +202,18 @@ class TrialScheduler:
     # -- worker ------------------------------------------------------------
 
     def _worker(self, create: Create, alloc: SlotAllocation) -> None:
+        # Lock-free by design: each worker writes only ITS request_id's
+        # slots of results/_errored/errors (GIL-atomic container ops), and
+        # the dispatcher reads them only after `_done.get()` + `join()` on
+        # this thread — the queue handoff establishes the happens-before.
         try:
+            # dtpu: lint-ok[unlocked-shared-state]
             self.results[create.request_id] = self.run_trial(
                 create, list(alloc.devices)
             )
         except BaseException as e:  # noqa: BLE001 - surfaced by the dispatcher
-            self._errored.add(create.request_id)
-            self.errors.append((create.request_id, e))
+            self._errored.add(create.request_id)  # dtpu: lint-ok[unlocked-shared-state]
+            self.errors.append((create.request_id, e))  # dtpu: lint-ok[unlocked-shared-state]
             logger.exception("trial %d failed", create.request_id)
         finally:
             self._done.put(create.request_id)

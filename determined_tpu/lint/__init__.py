@@ -1,0 +1,82 @@
+"""Trial preflight analyzer: static JAX-footgun lint + runtime sentinels.
+
+The reference platform keeps its master/agent concurrency honest with Go's
+race detector and vet passes; the harness side here has no analog, yet it
+is deeply concurrent (prefetch workers, per-trial scheduler threads,
+background checkpoint writers) and its scheduler's throughput depends on
+trial code that neither retraces nor syncs the host mid-step.  This
+package vets trial code BEFORE devices are allocated:
+
+- static pass (``_ast.py`` + ``rules/``): AST analysis of a JaxTrial
+  subclass or a source tree, typed diagnostics with rule ids and
+  ``file:line`` anchors, ``# dtpu: lint-ok[rule]`` suppressions;
+- runtime sentinels (``_runtime.py``): a retrace detector wrapping the
+  jitted step functions, and a thread-leak checker for tests and the trial
+  supervisor.
+
+Surfaces: ``dtpu lint <path|module:Class>`` (``cli/main.py``),
+``LocalExperiment`` preflight (warn by default, ``lint.strict`` fails
+fast), ``scripts/lint.sh`` in CI.  Rule catalog: ``docs/lint.md``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from determined_tpu.lint._ast import (
+    analyze_class,
+    analyze_entrypoint,
+    analyze_file,
+    analyze_path,
+    analyze_source,
+)
+from determined_tpu.lint._diag import (
+    ERROR,
+    SCHEMA_VERSION,
+    WARNING,
+    Diagnostic,
+    LintError,
+    to_json_payload,
+)
+from determined_tpu.lint._runtime import (
+    RetraceSentinel,
+    ThreadLeakChecker,
+    ThreadLeakError,
+    get_retrace_sentinel,
+)
+from determined_tpu.lint.rules import all_rules
+
+
+def check_trial(
+    trial_cls: type,
+    *,
+    disabled: Optional[Sequence[str]] = None,
+) -> List[Diagnostic]:
+    """Preflight a trial class; unavailable source yields zero findings
+    (warn-mode callers log; strict callers still pass vacuously rather
+    than rejecting code the analyzer simply cannot read)."""
+    try:
+        return analyze_class(trial_cls, disabled=disabled)
+    except (OSError, TypeError):
+        return []
+
+
+__all__ = [
+    "Diagnostic",
+    "ERROR",
+    "LintError",
+    "RetraceSentinel",
+    "SCHEMA_VERSION",
+    "ThreadLeakChecker",
+    "ThreadLeakError",
+    "WARNING",
+    "all_rules",
+    "analyze_class",
+    "analyze_entrypoint",
+    "analyze_file",
+    "analyze_path",
+    "analyze_source",
+    "check_trial",
+    "get_retrace_sentinel",
+    "to_json_payload",
+]

@@ -1,0 +1,65 @@
+"""Typed diagnostics for the trial preflight analyzer.
+
+A ``Diagnostic`` is one finding: rule id, severity, message, and the
+``file:line:col`` anchor.  The same record feeds the CLI's text and JSON
+output, the preflight warn-log, and ``LintError`` (the strict-mode
+failure), so every surface agrees on what was found.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Sequence
+
+ERROR = "error"
+WARNING = "warning"
+SEVERITIES = (ERROR, WARNING)
+
+#: JSON output schema version (bump on breaking field changes)
+SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One lint finding, anchored to source."""
+
+    rule: str
+    severity: str
+    message: str
+    file: str
+    line: int
+    col: int = 0
+
+    def format(self) -> str:
+        return (
+            f"{self.file}:{self.line}:{self.col}: "
+            f"{self.severity} [{self.rule}] {self.message}"
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def to_json_payload(diagnostics: Sequence[Diagnostic]) -> Dict[str, Any]:
+    """The ``dtpu lint --json`` document: versioned, with per-severity and
+    per-rule counts so CI can gate without re-aggregating."""
+    by_rule: Dict[str, int] = {}
+    by_severity: Dict[str, int] = {s: 0 for s in SEVERITIES}
+    for d in diagnostics:
+        by_rule[d.rule] = by_rule.get(d.rule, 0) + 1
+        by_severity[d.severity] = by_severity.get(d.severity, 0) + 1
+    return {
+        "version": SCHEMA_VERSION,
+        "findings": [d.to_dict() for d in diagnostics],
+        "counts": {"total": len(diagnostics), "by_severity": by_severity, "by_rule": by_rule},
+    }
+
+
+class LintError(Exception):
+    """Strict preflight failure: carries the diagnostics that caused it."""
+
+    def __init__(self, diagnostics: Sequence[Diagnostic], context: str = "") -> None:
+        self.diagnostics: List[Diagnostic] = list(diagnostics)
+        lines = [d.format() for d in self.diagnostics]
+        head = context or f"{len(lines)} lint finding(s)"
+        super().__init__(head + ("\n" + "\n".join(lines) if lines else ""))

@@ -1,0 +1,223 @@
+"""Runtime sentinels: retrace detection + thread-leak checking.
+
+The static pass (``_ast.py``) catches what it can read; these two catch
+what only shows up live:
+
+- **RetraceSentinel** — wraps the pre-jit step functions the Trainer
+  installs (``train/_trainer.py`` / ``train/_jit_cache.py``).  jax calls
+  the wrapped Python function once per TRACE, so the call count IS the
+  compile count for that jitted callable: more than ``allowed`` traces of
+  one logical step means the step is retrace-prone (shape-unstable
+  batches, python branching on traced values, weak cache keying) and every
+  extra trace is a silent full XLA compile eaten by the benchmark.  With
+  the jit-reuse cache on, a healthy search stays at one trace per step
+  signature — which is exactly what the sentinel asserts.
+- **ThreadLeakChecker** — a context manager that snapshots live threads on
+  entry and reports threads (matching ``watch`` patterns, default the
+  harness's own ``dtpu-*`` workers) still alive on exit.  Tests use it to
+  assert scheduler/prefetch workers die with their owners; the supervisor
+  (``exec/run_trial.py``) runs trials under it in warn mode when
+  ``lint.thread_sentinel`` is set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import functools
+import gc
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger("determined_tpu.lint.runtime")
+
+
+# ---------------------------------------------------------------------------
+# retrace sentinel
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TraceRecord:
+    """Compile accounting for one wrapped step callable."""
+
+    label: str
+    allowed: int
+    traces: int = 0
+    violations: int = 0
+
+
+class RetraceSentinel:
+    """Registry of wrapped step functions and their trace counts.
+
+    ``wrap`` must be applied to the function BEFORE ``jax.jit``: jit then
+    invokes the wrapper exactly once per trace/compile of that callable.
+    Thread-safe (concurrent trials trace in parallel).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._records: Dict[int, TraceRecord] = {}
+        self._seq = 0
+        self._enabled = False
+
+    # -- enablement (config-driven; tests flip it directly) ----------------
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -- wrapping ----------------------------------------------------------
+
+    def wrap(
+        self, label: str, fn: Callable[..., Any], *, allowed: int = 1
+    ) -> Callable[..., Any]:
+        """Count executions of ``fn`` (= traces once jitted) under ``label``.
+
+        ``allowed``: traces that are expected for this callable.  One for a
+        train step; an eval step legitimately traces twice (the metric
+        accumulator starts empty on the first validation batch, populated
+        after).
+        """
+        with self._lock:
+            self._seq += 1
+            rec = TraceRecord(label=label, allowed=allowed)
+            self._records[self._seq] = rec
+
+        @functools.wraps(fn)
+        def traced(*args: Any, **kwargs: Any) -> Any:
+            with self._lock:
+                rec.traces += 1
+                over = rec.traces > rec.allowed
+                if over:
+                    rec.violations += 1
+            if over:
+                logger.warning(
+                    "retrace sentinel: %s traced %d times (allowed %d) — the "
+                    "step is recompiling; look for shape-unstable batches, "
+                    "python branching on traced values, or hparams that "
+                    "should key the jit cache (docs/lint.md)",
+                    rec.label,
+                    rec.traces,
+                    rec.allowed,
+                )
+            return fn(*args, **kwargs)
+
+        return traced
+
+    # -- queries -----------------------------------------------------------
+
+    def records(self) -> List[TraceRecord]:
+        with self._lock:
+            return [dataclasses.replace(r) for r in self._records.values()]
+
+    def violations(self) -> Dict[str, int]:
+        """label -> excess trace count, only for offenders."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for r in self._records.values():
+                if r.violations:
+                    out[r.label] = out.get(r.label, 0) + r.violations
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._seq = 0
+
+
+_retrace_sentinel = RetraceSentinel()
+
+
+def get_retrace_sentinel() -> RetraceSentinel:
+    """The process-global sentinel (one process = one jit cache = one
+    compile ledger)."""
+    return _retrace_sentinel
+
+
+# ---------------------------------------------------------------------------
+# thread-leak checker
+# ---------------------------------------------------------------------------
+
+
+class ThreadLeakError(RuntimeError):
+    """Threads outlived the scope that owned them."""
+
+    def __init__(self, leaked: Sequence[threading.Thread], scope: str) -> None:
+        self.leaked = list(leaked)
+        names = ", ".join(f"{t.name} (daemon={t.daemon})" for t in self.leaked)
+        super().__init__(
+            f"{len(self.leaked)} thread(s) leaked from {scope}: {names}"
+        )
+
+
+class ThreadLeakChecker:
+    """Assert that threads started inside the block die with it.
+
+    ``watch``: fnmatch patterns of thread names that count as leaks
+    (default: the harness's own worker prefix).  Unmatched new threads —
+    interpreter pools, grpc/orbax internals — are ignored: they are
+    process-lifetime by design and would make the check unusable.
+    ``grace``: seconds to wait (joining, after a gc pass to trigger
+    ``__del__``-based cleanup) before declaring a leak.
+    """
+
+    def __init__(
+        self,
+        *,
+        watch: Sequence[str] = ("dtpu-*",),
+        grace: float = 5.0,
+        raise_on_leak: bool = True,
+        scope: str = "scope",
+    ) -> None:
+        self.watch = tuple(watch)
+        self.grace = grace
+        self.raise_on_leak = raise_on_leak
+        self.scope = scope
+        self.leaked: List[threading.Thread] = []
+        self._before: Optional[Tuple[threading.Thread, ...]] = None
+
+    def _new_watched(self, before: Tuple[threading.Thread, ...]) -> List[threading.Thread]:
+        return [
+            t
+            for t in threading.enumerate()
+            if t not in before
+            and t.is_alive()
+            and any(fnmatch.fnmatch(t.name, p) for p in self.watch)
+        ]
+
+    def __enter__(self) -> "ThreadLeakChecker":
+        self._before = tuple(threading.enumerate())
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> None:
+        assert self._before is not None
+        # a del-based cleanup (un-closed PrefetchingIterator) should count
+        # as "died with the scope", not as a leak
+        gc.collect()
+        deadline = time.monotonic() + self.grace
+        leaked = self._new_watched(self._before)
+        while leaked and time.monotonic() < deadline:
+            for t in leaked:
+                t.join(timeout=max(0.0, min(0.2, deadline - time.monotonic())))
+            leaked = self._new_watched(self._before)
+        self.leaked = leaked
+        if not leaked:
+            return
+        # an in-flight exception takes precedence; don't mask it
+        if self.raise_on_leak and exc_type is None:
+            raise ThreadLeakError(leaked, self.scope)
+        logger.warning(
+            "thread sentinel: %d thread(s) leaked from %s: %s",
+            len(leaked),
+            self.scope,
+            ", ".join(t.name for t in leaked),
+        )

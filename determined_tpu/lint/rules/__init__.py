@@ -1,0 +1,83 @@
+"""Rule registry for the trial preflight analyzer.
+
+Each rule is a class with a stable ``id`` (the name users put in
+``# dtpu: lint-ok[<id>]`` suppressions and ``lint.suppress`` config), a
+default ``severity``, and visitor hooks the AST walker dispatches to:
+
+- ``before_module(tree, ctx)`` — whole-module pre-pass (the concurrency
+  rule does its own cross-function analysis here);
+- ``visit_call / visit_assign / visit_augassign / visit_if / visit_while /
+  visit_for / visit_functiondef / visit_global (node, ctx)`` — per-node
+  hooks, called during the single walk with full scope context.
+
+Rules report through ``ctx.report(rule, node, message)``; suppression and
+severity handling live in the context, so rules only decide *what* is a
+finding.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+from determined_tpu.lint._diag import ERROR, WARNING
+
+
+class Rule:
+    """Base class: subclass, set ``id``/``severity``/``description``, and
+    implement whichever hooks the rule needs."""
+
+    id: str = ""
+    severity: str = WARNING
+    description: str = ""
+    #: True when the rule only fires inside traced step code (the walker
+    #: still calls the hooks; the rule checks ``ctx.in_step`` itself — this
+    #: flag is documentation + docs-table input)
+    step_scoped: bool = False
+
+    def before_module(self, tree, ctx) -> None:  # pragma: no cover - hook
+        pass
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    return dict(_REGISTRY)
+
+
+def build_rules(
+    only: Optional[Sequence[str]] = None,
+    disabled: Optional[Sequence[str]] = None,
+) -> List[Rule]:
+    """Instantiate the selected rule set (unknown ids raise: a typo'd
+    suppression list silently linting everything would be worse)."""
+    known = set(_REGISTRY)
+    for name in list(only or []) + list(disabled or []):
+        if name not in known:
+            raise ValueError(f"unknown lint rule {name!r}; known: {sorted(known)}")
+    ids = set(only) if only else known
+    ids -= set(disabled or [])
+    return [_REGISTRY[i]() for i in sorted(ids)]
+
+
+# importing the rule modules populates the registry
+from determined_tpu.lint.rules import (  # noqa: E402,F401
+    control_flow,
+    defaults,
+    host_sync,
+    randomness,
+    side_effects,
+    threads,
+    wall_clock,
+)
+
+__all__ = ["ERROR", "WARNING", "Rule", "all_rules", "build_rules", "register"]

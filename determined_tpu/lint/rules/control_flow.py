@@ -1,0 +1,54 @@
+"""Shape-/value-dependent Python control flow inside traced steps.
+
+``if``/``while`` on a traced VALUE raises ``ConcretizationTypeError``
+under jit; a traced value in a comparison that somehow concretizes (via a
+host sync the author added to "fix" the error) makes the Python branch a
+TRACE-TIME decision — the step recompiles whenever the branch flips, which
+is exactly the retrace storm the runtime sentinel
+(``lint/_runtime.py``) exists to catch.  ``for`` over a traced array
+unrolls the loop into the program (compile-time blowup) when it works at
+all.  Static metadata (``.shape``/``.dtype``/``len()``) is excluded: shape
+math is host arithmetic and legal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from determined_tpu.lint._ast import references_traced_value
+from determined_tpu.lint._diag import WARNING
+from determined_tpu.lint.rules import Rule, register
+
+
+@register
+class TracedControlFlowRule(Rule):
+    id = "traced-control-flow"
+    severity = WARNING
+    step_scoped = True
+    description = (
+        "Python `if`/`while`/`for` on traced array VALUES: "
+        "ConcretizationTypeError or a retrace per branch flip; use "
+        "`jnp.where`/`lax.cond`/`lax.scan` (shape-based branching is fine)"
+    )
+
+    def _check(self, expr: ast.AST, node: ast.AST, ctx, kind: str) -> None:
+        if not ctx.in_step:
+            return
+        if references_traced_value(expr, ctx.traced_names()):
+            ctx.report(
+                self,
+                node,
+                f"`{kind}` depends on a traced array value — under jit this "
+                "is a ConcretizationTypeError or a retrace per distinct "
+                "value; use `jnp.where`/`jax.lax.cond` (branch on `.shape`/"
+                "`.dtype` instead if the decision is structural)",
+            )
+
+    def visit_if(self, node: ast.If, ctx) -> None:
+        self._check(node.test, node, ctx, "if")
+
+    def visit_while(self, node: ast.While, ctx) -> None:
+        self._check(node.test, node, ctx, "while")
+
+    def visit_for(self, node: ast.For, ctx) -> None:
+        self._check(node.iter, node, ctx, "for ... in")

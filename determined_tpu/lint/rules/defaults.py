@@ -1,0 +1,48 @@
+"""Mutable default arguments in trial code.
+
+The classic Python footgun bites harder here: a trial class is
+instantiated once PER TRIAL by the scheduler, concurrently — a mutable
+default (``hparams={}``, ``metrics=[]``) is one shared object across every
+trial in the search, so trial B reads hyperparameters trial A wrote.
+Scoped to trial classes (module-wide it would re-litigate style choices
+this analyzer has no business in).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from determined_tpu.lint._diag import WARNING
+from determined_tpu.lint.rules import Rule, register
+
+
+@register
+class MutableDefaultRule(Rule):
+    id = "mutable-default"
+    severity = WARNING
+    description = (
+        "mutable default argument in a trial class: one shared object "
+        "across every (concurrent) trial instance"
+    )
+
+    def visit_functiondef(self, node: ast.AST, ctx) -> None:
+        if not ctx.in_trial_class:
+            return
+        args = getattr(node, "args", None)
+        if args is None:
+            return
+        for default in list(args.defaults) + [
+            d for d in args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.Dict, ast.List, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and isinstance(default.func, ast.Name)
+                and default.func.id in ("dict", "list", "set")
+            ):
+                ctx.report(
+                    self,
+                    default,
+                    f"mutable default in `{getattr(node, 'name', '<fn>')}`: "
+                    "evaluated once and shared by every trial instance the "
+                    "scheduler creates; default to None and build inside",
+                )

@@ -1,0 +1,134 @@
+"""Host-device synchronization rules.
+
+Inside a traced step every one of these forces a device->host round trip
+(or fails outright under jit): the device pipeline drains, the overlapped
+input feed stalls, and the "no host syncs in the hot loop" contract the
+Trainer is built around (``train/_trainer.py``) is silently broken.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from determined_tpu.lint._ast import call_name, references_traced_value
+from determined_tpu.lint._diag import ERROR, WARNING
+from determined_tpu.lint.rules import Rule, register
+
+#: dotted call names that materialize a traced value on the host
+_HOST_CALLS = {
+    "np.asarray": "np.asarray",
+    "numpy.asarray": "numpy.asarray",
+    "np.array": "np.array",
+    "numpy.array": "numpy.array",
+    "jax.device_get": "jax.device_get",
+}
+
+#: builtins that concretize a traced array to a python scalar
+_SCALAR_BUILTINS = {"float", "int", "bool"}
+
+
+@register
+class HostSyncRule(Rule):
+    id = "host-sync"
+    severity = ERROR
+    step_scoped = True
+    description = (
+        "host-device sync inside a traced step: `.item()`, `float()`/`int()` "
+        "on arrays, `np.asarray`/`jax.device_get` — blocks the device "
+        "pipeline or raises ConcretizationTypeError under jit"
+    )
+
+    def visit_call(self, node: ast.Call, ctx) -> None:
+        if not ctx.in_step:
+            return
+        # `.item()` anywhere in a chain (x.item(), x.mean().item(), ...)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            ctx.report(
+                self,
+                node,
+                "`.item()` concretizes a traced value on the host; return it "
+                "as a metric instead (the Trainer fetches metrics once per "
+                "REPORT boundary)",
+            )
+            return
+        name = call_name(node)
+        if name is None:
+            return
+        if name in _HOST_CALLS:
+            ctx.report(
+                self,
+                node,
+                f"`{name}` pulls a traced value to the host; use `jnp.asarray`"
+                " / keep the computation on device",
+            )
+            return
+        if name in _SCALAR_BUILTINS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant):
+                return
+            if references_traced_value(arg, ctx.traced_names()):
+                ctx.report(
+                    self,
+                    node,
+                    f"`{name}()` on a traced value is a host sync (or a "
+                    "ConcretizationTypeError); use `.astype`/`jnp` casts to "
+                    "stay on device",
+                )
+
+
+@register
+class BlockUntilReadyRule(Rule):
+    id = "block-until-ready"
+    severity = ERROR
+    step_scoped = True
+    description = (
+        "`.block_until_ready()` inside a traced step: stalls dispatch; it "
+        "belongs in benchmarks, never in step code"
+    )
+
+    def visit_call(self, node: ast.Call, ctx) -> None:
+        if not ctx.in_step:
+            return
+        name = call_name(node)
+        if name and name.endswith(".block_until_ready"):
+            ctx.report(
+                self,
+                node,
+                "`.block_until_ready()` blocks the host on device completion "
+                "inside the step; drop it (the Trainer syncs once per REPORT "
+                "boundary)",
+            )
+
+
+@register
+class TracedPrintRule(Rule):
+    id = "traced-print"
+    severity = WARNING
+    step_scoped = True
+    description = (
+        "`print` of traced values inside a step: prints a tracer (useless) "
+        "or forces a sync; use `jax.debug.print`"
+    )
+
+    def visit_call(self, node: ast.Call, ctx) -> None:
+        if not ctx.in_step:
+            return
+        name = call_name(node)
+        if name != "print":
+            return
+        # only prints OF TRACED VALUES: a static banner print is harmless
+        # (it runs once at trace time, which is also what it looks like)
+        traced = ctx.traced_names()
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        if any(references_traced_value(a, traced) for a in args):
+            ctx.report(
+                self,
+                node,
+                "`print` under trace runs once at trace time and shows "
+                "tracers, not values; use `jax.debug.print(...)` for "
+                "runtime values",
+            )

@@ -1,0 +1,46 @@
+"""Python-level randomness inside traced step code.
+
+``random.*`` / ``np.random.*`` draw ONCE at trace time and bake the value
+into the compiled program as a constant: every step then reuses the same
+"random" number, and two trials sharing a compiled step through the
+jit-reuse cache (``train/_jit_cache.py``) silently share the draw too.
+``jax.random`` with keys threaded through the step is the correct form —
+the Trainer already folds the step counter into the state rng.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from determined_tpu.lint._ast import call_name
+from determined_tpu.lint._diag import ERROR
+from determined_tpu.lint.rules import Rule, register
+
+_PY_RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+
+@register
+class PythonRngRule(Rule):
+    id = "python-rng"
+    severity = ERROR
+    step_scoped = True
+    description = (
+        "`random.*` / `np.random.*` in a traced step: draws once at trace "
+        "time and freezes into the compiled program; use `jax.random` with "
+        "a threaded key"
+    )
+
+    def visit_call(self, node: ast.Call, ctx) -> None:
+        if not ctx.in_step:
+            return
+        name = call_name(node)
+        if name is None:
+            return
+        if any(name.startswith(p) for p in _PY_RNG_PREFIXES):
+            ctx.report(
+                self,
+                node,
+                f"`{name}` is host randomness frozen at trace time; use "
+                "`jax.random.<dist>(rng, ...)` with the step's rng key "
+                "(the `rng` argument of `loss`)",
+            )

@@ -1,0 +1,107 @@
+"""Side effects under trace.
+
+A traced step runs its Python body ONCE; mutations of ``self``, globals,
+or closure containers happen at trace time only — they do not re-execute
+per step, and when the jit-reuse cache shares a compiled step across
+trials the mutation already happened against the FIRST trial's objects.
+Worse, a mutated ``self`` read by the scheduler/prefetch threads is a race
+the lock-hygiene rule can't even see.  State belongs in the TrainState or
+in metrics; host-side bookkeeping belongs in callbacks.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from determined_tpu.lint._ast import dotted_name, local_names
+from determined_tpu.lint._diag import WARNING
+from determined_tpu.lint.rules import Rule, register
+
+#: container mutators that leak trace-time writes into host objects
+_MUTATORS = frozenset(
+    {"append", "extend", "insert", "add", "update", "setdefault", "remove", "discard"}
+)
+
+
+@register
+class TraceSideEffectRule(Rule):
+    id = "trace-side-effect"
+    severity = WARNING
+    step_scoped = True
+    description = (
+        "mutating `self.*`/globals/closure containers inside a traced step: "
+        "runs once at trace time, not per step (and races scheduler/prefetch "
+        "threads)"
+    )
+
+    def visit_assign(self, node: ast.Assign, ctx) -> None:
+        if not ctx.in_step:
+            return
+        for target in node.targets:
+            self._check_target(target, node, ctx)
+
+    def visit_augassign(self, node: ast.AugAssign, ctx) -> None:
+        if not ctx.in_step:
+            return
+        self._check_target(node.target, node, ctx)
+
+    def _check_target(self, target: ast.AST, node: ast.AST, ctx) -> None:
+        # self.x = ... / self.x[...] = ... / self.x += ...
+        base = target
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        name = dotted_name(base)
+        if name and (name == "self" or name.startswith("self.")):
+            ctx.report(
+                self,
+                node,
+                f"write to `{name}` inside a traced step happens once at "
+                "trace time; carry state through the TrainState / return it "
+                "as a metric",
+            )
+
+    def visit_global(self, node: ast.Global, ctx) -> None:
+        if not ctx.in_step:
+            return
+        ctx.report(
+            self,
+            node,
+            f"`global {', '.join(node.names)}` in a traced step: the write "
+            "happens at trace time only",
+        )
+
+    def visit_call(self, node: ast.Call, ctx) -> None:
+        if not ctx.in_step:
+            return
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS):
+            return
+        name = dotted_name(fn.value)
+        if name is None:
+            return
+        root = name.split(".")[0]
+        if root == "self":
+            ctx.report(
+                self,
+                node,
+                f"`{name}.{fn.attr}(...)` mutates trial state under trace "
+                "(runs once, at trace time)",
+            )
+            return
+        # mutation of a name NOT local to any enclosing step function =
+        # closure/global container captured by the trace.  Statement
+        # position only: `x.update(...)` whose RESULT is consumed is the
+        # functional idiom (optax), not a side effect.
+        if id(node) not in ctx.stmt_calls:
+            return
+        step_fns = [f.node for f in ctx.func_stack if f.is_step]
+        if not step_fns:
+            return
+        local_anywhere = any(root in local_names(fn) for fn in step_fns)
+        if not local_anywhere:
+            ctx.report(
+                self,
+                node,
+                f"`{name}.{fn.attr}(...)` mutates a closure/global container "
+                "under trace; collect values as step outputs instead",
+            )

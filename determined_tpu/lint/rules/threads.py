@@ -1,0 +1,424 @@
+"""Lock-hygiene rule: unlocked mutation of state shared with threads.
+
+The harness side got deeply concurrent (prefetch workers, per-trial
+scheduler threads, background checkpoint writers) with no race detector —
+the reference platform leans on Go's ``-race`` for exactly this class of
+bug.  This rule is the static half of the answer (the runtime half is
+``lint/_runtime.py``): find every ``threading.Thread(target=...)`` (and
+``threading.Thread`` subclass ``run``), compute what state those thread
+bodies touch, and flag mutations of that state — anywhere in the same
+class, or inside the thread body itself for closure-captured names — that
+are not under a ``with <lock>`` the analyzer can see.
+
+Deliberately excluded as thread-safe by design: ``queue.Queue`` traffic
+(``put``/``get``), ``threading.Event`` flips (``set``/``clear`` on
+lockish-or-event names are method calls the rule does not treat as
+container mutation), and ``__init__`` writes (they precede thread start).
+A flagged site that is safe by a subtler argument (single-writer +
+join-before-read, GIL-atomic dict store handed off through a queue) should
+carry a ``# dtpu: lint-ok[unlocked-shared-state]`` suppression WITH a
+justifying comment — the suppression is the audit trail.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from determined_tpu.lint._ast import dotted_name, local_names
+from determined_tpu.lint._diag import WARNING
+from determined_tpu.lint.rules import Rule, register
+
+_LOCKISH = ("lock", "mutex", "sem", "cond")
+#: container mutations that are NOT internally synchronized
+_MUTATORS = frozenset(
+    {
+        "append",
+        "extend",
+        "insert",
+        "add",
+        "update",
+        "setdefault",
+        "remove",
+        "discard",
+        "pop",
+        "popitem",
+        "clear",
+    }
+)
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted_name(expr)
+    if not name:
+        return False
+    last = name.split(".")[-1].lower()
+    return any(t in last for t in _LOCKISH)
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    return bool(name) and name.split(".")[-1] == "Thread"
+
+
+def _direct_functions(body: List[ast.stmt]) -> Dict[str, ast.AST]:
+    return {
+        s.name: s
+        for s in body
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+#: constructor names whose instances are internally synchronized — method
+#: calls on them (Event.clear, Queue.put, Lock.acquire) are not races
+_SYNC_CTORS = frozenset(
+    {
+        "Event",
+        "Queue",
+        "LifoQueue",
+        "PriorityQueue",
+        "SimpleQueue",
+        "Lock",
+        "RLock",
+        "Condition",
+        "Semaphore",
+        "BoundedSemaphore",
+        "Barrier",
+    }
+)
+
+
+def _sync_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes ``__init__`` binds to threading/queue sync primitives."""
+    init = _direct_functions(cls.body).get("__init__")
+    if init is None:
+        return set()
+    out: Set[str] = set()
+    for sub in ast.walk(init):
+        if not isinstance(sub, ast.Assign) or not isinstance(sub.value, ast.Call):
+            continue
+        ctor = dotted_name(sub.value.func)
+        if ctor and ctor.split(".")[-1] in _SYNC_CTORS:
+            for t in sub.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.add(t.attr)
+    return out
+
+
+def _self_attrs_referenced(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(fn):
+        if (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+        ):
+            out.add(sub.attr)
+    return out
+
+
+def _self_method_calls(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(fn):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and isinstance(sub.func.value, ast.Name)
+            and sub.func.value.id == "self"
+        ):
+            out.add(sub.func.attr)
+    return out
+
+
+def _local_fn_calls(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+            out.add(sub.func.id)
+    return out
+
+
+class _Creation:
+    def __init__(
+        self,
+        target_expr: ast.AST,
+        class_node: Optional[ast.ClassDef],
+        fn_stack: List[ast.AST],
+    ) -> None:
+        self.target_expr = target_expr
+        self.class_node = class_node
+        self.fn_stack = list(fn_stack)
+
+
+class _Collector(ast.NodeVisitor):
+    """Find Thread(target=...) creations + Thread-subclass run methods,
+    remembering lexical scope for target resolution."""
+
+    def __init__(self) -> None:
+        self.creations: List[_Creation] = []
+        self.thread_subclass_runs: List[Tuple[ast.ClassDef, ast.AST]] = []
+        self._class: Optional[ast.ClassDef] = None
+        self._fns: List[ast.AST] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev, self._class = self._class, node
+        for base in node.bases:
+            name = dotted_name(base)
+            if name and name.split(".")[-1] == "Thread":
+                run = _direct_functions(node.body).get("run")
+                if run is not None:
+                    self.thread_subclass_runs.append((node, run))
+        self.generic_visit(node)
+        self._class = prev
+
+    def _visit_fn(self, node: ast.AST) -> None:
+        self._fns.append(node)
+        self.generic_visit(node)
+        self._fns.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_thread_ctor(node):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    self.creations.append(
+                        _Creation(kw.value, self._class, self._fns)
+                    )
+        self.generic_visit(node)
+
+
+@register
+class UnlockedSharedStateRule(Rule):
+    id = "unlocked-shared-state"
+    severity = WARNING
+    description = (
+        "write to state a `threading.Thread` target also touches, with no "
+        "lock in sight: a data race unless a subtler handoff argument holds "
+        "(if one does, suppress WITH the argument as a comment)"
+    )
+
+    # -- module pre-pass ---------------------------------------------------
+
+    def before_module(self, tree: ast.AST, ctx) -> None:
+        collector = _Collector()
+        collector.visit(tree)
+
+        class_targets: Dict[ast.ClassDef, List[ast.AST]] = {}
+        local_targets: List[Tuple[ast.AST, List[ast.AST]]] = []
+
+        for cls, run in collector.thread_subclass_runs:
+            class_targets.setdefault(cls, []).append(run)
+
+        for cr in collector.creations:
+            expr = cr.target_expr
+            # self.method target
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and cr.class_node is not None
+            ):
+                method = _direct_functions(cr.class_node.body).get(expr.attr)
+                if method is not None:
+                    class_targets.setdefault(cr.class_node, []).append(method)
+                continue
+            # local function target: resolve lexically outward
+            if isinstance(expr, ast.Name):
+                for scope in reversed(cr.fn_stack):
+                    fn = _direct_functions(scope.body).get(expr.id)
+                    if fn is not None:
+                        local_targets.append((fn, cr.fn_stack))
+                        if cr.class_node is not None:
+                            # a closure target may still touch self.*
+                            class_targets.setdefault(
+                                cr.class_node, []
+                            ).append(fn)
+                        break
+
+        for cls, targets in class_targets.items():
+            self._check_class(cls, targets, ctx)
+        for fn, stack in local_targets:
+            self._check_local_target(fn, stack, ctx)
+
+    # -- class-attribute sharing ------------------------------------------
+
+    def _expand_targets(
+        self, cls: ast.ClassDef, targets: List[ast.AST]
+    ) -> List[ast.AST]:
+        """Targets plus the class methods they (transitively) call — a
+        worker that does its writes through ``self._put`` still shares
+        ``self._queue``."""
+        methods = _direct_functions(cls.body)
+        seen: List[ast.AST] = []
+        work = list(targets)
+        while work:
+            fn = work.pop()
+            if fn in seen:
+                continue
+            seen.append(fn)
+            for called in _self_method_calls(fn):
+                m = methods.get(called)
+                if m is not None and m not in seen:
+                    work.append(m)
+        return seen
+
+    def _check_class(
+        self, cls: ast.ClassDef, targets: List[ast.AST], ctx
+    ) -> None:
+        target_set = self._expand_targets(cls, targets)
+        shared_attrs: Set[str] = set()
+        for fn in target_set:
+            shared_attrs |= _self_attrs_referenced(fn)
+        if not shared_attrs:
+            return
+        sync = _sync_attrs(cls)
+        target_names = sorted({getattr(t, "name", "?") for t in targets})
+        for fn in _direct_functions(cls.body).values():
+            if getattr(fn, "name", "") in ("__init__", "__post_init__", "__del__"):
+                continue  # runs before threads start / after they matter
+            self._scan_writes(
+                fn,
+                ctx,
+                self_attrs=shared_attrs,
+                closure_names=None,
+                sync_attrs=sync,
+                because=(
+                    f"also touched by thread target(s) "
+                    f"{', '.join(target_names)} of {cls.name}"
+                ),
+            )
+
+    # -- closure sharing ---------------------------------------------------
+
+    def _check_local_target(
+        self, fn: ast.AST, stack: List[ast.AST], ctx
+    ) -> None:
+        """Mutations of closure-captured names inside a thread body: the
+        enclosing function (the other thread) shares every free name."""
+        bodies = [fn]
+        # expand through sibling local functions the target calls
+        # (sender -> flush in the log shipper)
+        i = 0
+        while i < len(bodies):
+            for called in _local_fn_calls(bodies[i]):
+                for scope in reversed(stack):
+                    peer = _direct_functions(scope.body).get(called)
+                    if peer is not None and peer not in bodies:
+                        bodies.append(peer)
+                        break
+            i += 1
+        for body in bodies:
+            free = set()
+            local = local_names(body)
+            for sub in ast.walk(body):
+                if isinstance(sub, ast.Name) and sub.id not in local:
+                    free.add(sub.id)
+            if free:
+                self._scan_writes(
+                    body,
+                    ctx,
+                    self_attrs=None,
+                    closure_names=free,
+                    sync_attrs=set(),
+                    because=(
+                        f"closure shared between thread target "
+                        f"`{getattr(fn, 'name', '?')}` and its enclosing scope"
+                    ),
+                )
+
+    # -- write scanning ----------------------------------------------------
+
+    def _scan_writes(
+        self,
+        fn: ast.AST,
+        ctx,
+        *,
+        self_attrs: Optional[Set[str]],
+        closure_names: Optional[Set[str]],
+        sync_attrs: Set[str],
+        because: str,
+    ) -> None:
+        reported: Set[int] = set()
+
+        def matches(expr: ast.AST, mutator_call: bool = False) -> Optional[str]:
+            base = expr
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            name = dotted_name(base)
+            if name is None:
+                return None
+            if name.startswith("self.") and name.split(".")[1] in sync_attrs:
+                # Event/Queue/Lock attribute: its methods synchronize
+                # internally; only REBINDING it is a write worth flagging
+                if mutator_call:
+                    return None
+            if (
+                self_attrs is not None
+                and name.startswith("self.")
+                and name.split(".")[1] in self_attrs
+            ):
+                return name
+            if closure_names is not None and "." not in name and name in closure_names:
+                # a free name being written: a plain-Store name would be a
+                # local (and thus not free), so anything matching here is a
+                # container mutation, subscript store, or a declared
+                # nonlocal/global rebind — all shared writes
+                return name
+            return None
+
+        def report(node: ast.AST, what: str) -> None:
+            if id(node) in reported:
+                return
+            reported.add(id(node))
+            ctx.report(
+                self,
+                node,
+                f"unlocked write to `{what}` ({because}); hold a lock, hand "
+                "off through a queue.Queue, or suppress with the safety "
+                "argument as a comment",
+            )
+
+        def walk(node: ast.AST, protected: bool) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                now = protected or any(
+                    _is_lockish(item.context_expr) for item in node.items
+                )
+                for item in node.items:
+                    walk(item.context_expr, protected)
+                for child in node.body:
+                    walk(child, now)
+                return
+            if not protected:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        hit = matches(t)
+                        if hit:
+                            report(node, hit)
+                elif isinstance(node, ast.AugAssign):
+                    hit = matches(node.target)
+                    if hit:
+                        report(node, hit)
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        hit = matches(t)
+                        if hit:
+                            report(node, hit)
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                        hit = matches(f.value, mutator_call=True)
+                        if hit:
+                            report(node, f"{hit}.{f.attr}(...)")
+            for child in ast.iter_child_nodes(node):
+                walk(child, protected)
+
+        for stmt in getattr(fn, "body", []):
+            walk(stmt, False)

@@ -1,0 +1,54 @@
+"""Wall-clock reads inside traced step code.
+
+``time.time()`` / ``datetime.now()`` under trace evaluate once at compile
+time; the "timestamp" every step then reports is the tracing instant,
+frozen into the executable — and shared across trials when the jit-reuse
+cache hands the compiled step to the next trial.  Timing belongs at the
+Trainer's boundaries (it already measures per-report wall time).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from determined_tpu.lint._ast import call_name
+from determined_tpu.lint._diag import WARNING
+from determined_tpu.lint.rules import Rule, register
+
+_CLOCK_CALLS = frozenset(
+    {
+        "time.time",
+        "time.perf_counter",
+        "time.monotonic",
+        "time.time_ns",
+        "datetime.now",
+        "datetime.utcnow",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+    }
+)
+
+
+@register
+class WallClockRule(Rule):
+    id = "wall-clock"
+    severity = WARNING
+    step_scoped = True
+    description = (
+        "`time.time()` / `datetime.now()` in a traced step: evaluates once "
+        "at trace time, so the value is the compile instant, not the step "
+        "time"
+    )
+
+    def visit_call(self, node: ast.Call, ctx) -> None:
+        if not ctx.in_step:
+            return
+        name = call_name(node)
+        if name in _CLOCK_CALLS:
+            ctx.report(
+                self,
+                node,
+                f"`{name}()` freezes the trace-time clock into the compiled "
+                "step; measure time at boundaries (callbacks / the Trainer's "
+                "report metrics) instead",
+            )

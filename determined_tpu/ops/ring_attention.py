@@ -49,12 +49,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.6 moved shard_map to jax.shard_map
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
-
 from determined_tpu.ops.attention import _repeat_kv
+from determined_tpu.parallel._compat import axis_size, shard_map
 from determined_tpu.parallel.mesh import MeshAxes
 
 NEG_INF = -1e30
@@ -87,8 +83,12 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale, n_rep):
     what actually ran (``ring_block_counts`` surfaces it; the vjp
     wrappers drop it).
     """
-    n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    n = axis_size(axis_name)
+    # positions (and the rank index feeding them) exist only for the causal
+    # mask; on the non-causal path axis_index must not be emitted at all —
+    # its dead value survives into the custom_vjp residual jaxpr and older
+    # XLA then refuses to SPMD-partition the PartitionId instruction
+    idx = jax.lax.axis_index(axis_name) if causal else 0
     b, h, sl, d = q.shape
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -105,8 +105,8 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale, n_rep):
         def compute(m, l, acc, cnt):
             k_exp = _repeat_kv(k_cur, n_rep)
             v_exp = _repeat_kv(v_cur, n_rep)
-            q_pos = idx * sl + jnp.arange(sl)
-            k_pos = src * sl + jnp.arange(sl)
+            q_pos = (idx * sl + jnp.arange(sl)) if causal else None
+            k_pos = (src * sl + jnp.arange(sl)) if causal else None
             s = _block_logits(qf, k_exp, scale, causal, q_pos, k_pos)
             m_cur = jnp.max(s, axis=-1, keepdims=True)
             m_new = jnp.maximum(m, m_cur)
@@ -145,8 +145,9 @@ def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale, n_rep):
     """Backward ring sweep: dk/dv rotate WITH their k/v shards, arriving
     home after n steps; no per-step residuals are kept.  dk/dv travel with
     ``h_kv`` heads (group-summed from the expanded gradient each step)."""
-    n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    n = axis_size(axis_name)
+    # see _ring_fwd_local: no dead axis_index on the non-causal path
+    idx = jax.lax.axis_index(axis_name) if causal else 0
     b, h, sl, d = q.shape
     h_kv = k.shape[1]
     qf = q.astype(jnp.float32)
@@ -165,8 +166,8 @@ def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale, n_rep):
         def compute(dq, dk_cur, dv_cur):
             k_exp = _repeat_kv(k_cur, n_rep)
             v_exp = _repeat_kv(v_cur, n_rep)
-            q_pos = idx * sl + jnp.arange(sl)
-            k_pos = src * sl + jnp.arange(sl)
+            q_pos = (idx * sl + jnp.arange(sl)) if causal else None
+            k_pos = (src * sl + jnp.arange(sl)) if causal else None
             s = _block_logits(qf, k_exp, scale, causal, q_pos, k_pos)
             p = jnp.exp(s - lse)                              # [b,h,ql,kl]
             dp = jnp.einsum(
@@ -256,7 +257,7 @@ def zigzag_redistribute(x, axis_name, inverse: bool = False):
     decompose into exactly two ``ppermute``s — one carrying the even chunks,
     one the odd — plus a parity select on arrival.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return x
     sl = x.shape[-2]
@@ -313,7 +314,7 @@ def _zz_fwd_local(q, k, v, *, axis_name, scale, n_rep):
     lo-q × lo-k (iff src ≤ idx), hi-q × hi-k (iff src ≥ idx) — so every
     rank executes 2 half-computes per step (3 on the diagonal), vs the
     contiguous sweep's rank-(n-1) doing 4 per step."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, sl, d = q.shape
     hc = sl // 2
@@ -409,7 +410,7 @@ def _attn_bwd_half(qf, k_half, v_half, lse_h, do_f, delta_h, q_pos, k_pos,
 def _zz_bwd_local(q, k, v, out, lse, do, *, axis_name, scale, n_rep):
     """Zigzag causal backward: same balanced pair schedule as the forward;
     dk/dv rotate with their k/v shards and are home after n steps."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, sl, d = q.shape
     hc = sl // 2

@@ -1,0 +1,54 @@
+"""jax version-compatibility shims for the parallel/ops layers.
+
+The shard_map API has moved twice across the jax versions this repo must
+run on: the entry point migrated from ``jax.experimental.shard_map`` to
+``jax.shard_map`` (>= 0.6), and the replication-check kwarg was renamed
+``check_rep`` -> ``check_vma`` when varying-manual-axes tracking replaced
+the old rep-set analysis.  Callers here write the NEW spelling
+(``check_vma``) and this shim translates for older installs, so kernel
+code stays forward-looking without pinning jax.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import jax
+
+try:  # jax >= 0.6 moved shard_map to the top-level namespace
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SHARD_MAP_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def axis_size(axis_name: Any) -> int:
+    """Static size of a manual-collective axis.
+
+    ``jax.lax.axis_size`` only exists on newer jax; under older versions
+    ``psum`` of a literal 1 constant-folds to the same static size.
+    """
+    ax = getattr(jax.lax, "axis_size", None)
+    if ax is not None:
+        return ax(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(
+    f: Any,
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    check_vma: Any = None,
+) -> Any:
+    """``jax.shard_map`` with the modern kwarg spelling on any jax version."""
+    kwargs = {}
+    if check_vma is not None:
+        if "check_vma" in _SHARD_MAP_PARAMS:
+            kwargs["check_vma"] = check_vma
+        elif "check_rep" in _SHARD_MAP_PARAMS:
+            kwargs["check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

@@ -89,10 +89,7 @@ def pipeline_apply(
     for a in (MeshAxes.DATA, MeshAxes.FSDP):
         bshards *= mesh.shape.get(a, 1)
 
-    try:  # jax >= 0.6 moved shard_map to jax.shard_map
-        shard_map = jax.shard_map
-    except AttributeError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map  # type: ignore
+    from determined_tpu.parallel._compat import shard_map
 
     expert_ax = (
         MeshAxes.EXPERT if mesh.shape.get(MeshAxes.EXPERT, 1) > 1 else None

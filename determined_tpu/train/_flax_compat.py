@@ -1,0 +1,58 @@
+"""flax >= 0.10 compatibility: logical-name Partitioned boxes under a mesh.
+
+flax 0.10 made ``Partitioned.unbox`` apply the box's axis names as a
+``with_sharding_constraint`` whenever an ambient mesh is active — and the
+init-fn shape check in ``Scope.param`` unboxes during ``apply`` too, so the
+constraint fires on every traced step, not just at init.  This repo boxes
+params with LOGICAL names (embed/heads/kv/...) and the Trainer maps them to
+mesh axes itself (``param_logical_specs`` -> ``param_shardings`` through the
+context's logical-axis rules); flax's eager constraint then hands jax a
+PartitionSpec of names that are not mesh axes and every apply under
+``with mesh:`` dies with "Resource axis ... not found in mesh".
+
+The patch skips the constraint exactly when its names cannot resolve in the
+active mesh — boxes that DO name real mesh axes keep flax's behavior.
+Installed once from ``determined_tpu.train`` import.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from flax.core import meta as _meta
+
+_orig_unbox = _meta.Partitioned.unbox
+
+
+def _active_mesh(box: Any):
+    if box.mesh is not None:
+        return box.mesh
+    try:
+        from jax.interpreters import pxla
+
+        env_mesh = pxla.thread_resources.env.physical_mesh
+        return env_mesh if env_mesh.devices.shape != () else None
+    except Exception:  # noqa: BLE001 - jax internals moved; behave unpatched
+        return None
+
+
+def _unbox(self, apply_constraint: bool = True):
+    if apply_constraint:
+        mesh = _active_mesh(self)
+        if mesh is not None:
+            names = {
+                n
+                for n in jax.tree_util.tree_leaves(tuple(self.names))
+                if isinstance(n, str)
+            }
+            if not names <= set(str(a) for a in mesh.axis_names):
+                # logical (non-mesh) names: placement is the harness's job
+                return self.value
+    return _orig_unbox(self, apply_constraint=apply_constraint)
+
+
+def install() -> None:
+    """Idempotently patch ``Partitioned.unbox``."""
+    if _meta.Partitioned.unbox is not _unbox:
+        _meta.Partitioned.unbox = _unbox

@@ -358,6 +358,26 @@ class Trainer:
                 new_acc[k] = red.accumulate(carry, v.astype(jnp.float32))
             return new_acc, count + 1.0
 
+        # ---- retrace sentinel (lint/_runtime.py) -------------------------
+        # Wrapping happens BEFORE jit: jax then calls the wrapper once per
+        # trace, so the count is the compile count for this callable.  A
+        # train step traces once; an eval step twice (first validation
+        # batch sees an empty metric accumulator, later ones a populated
+        # one).  Anything beyond that is a silent recompile the sentinel
+        # logs — exactly what the jit-reuse cache exists to prevent.
+        from determined_tpu.lint._runtime import get_retrace_sentinel
+
+        sentinel = get_retrace_sentinel()
+        use_sentinel = sentinel.enabled or (
+            ctx.exp_config is not None
+            and getattr(ctx.exp_config, "lint", None) is not None
+            and ctx.exp_config.lint.retrace_sentinel
+        )
+        if use_sentinel:
+            label = f"{type(trial).__module__}:{type(trial).__qualname__}"
+            train_step = sentinel.wrap(f"{label}.train_step", train_step, allowed=1)
+            eval_step = sentinel.wrap(f"{label}.eval_step", eval_step, allowed=2)
+
         # ---- cross-trial jit reuse ---------------------------------------
         # Same-architecture trials in one process (the concurrent search
         # scheduler, sequential ASHA backfills) share ONE jitted callable
@@ -564,7 +584,9 @@ class Trainer:
                 if is_chief:
                     serialization.save_trainer_state(path, trainer_state)
             except BaseException as e:  # surfaced at the drain point
-                errors.append(e)
+                # single background writer; the drain point joins this
+                # thread BEFORE reading errors (happens-before via join)
+                errors.append(e)  # dtpu: lint-ok[unlocked-shared-state]
 
         thread = threading.Thread(target=work, name="dtpu-ckpt-writer", daemon=True)
         thread.start()

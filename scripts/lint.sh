@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Static preflight lint over the harness + examples — the Python-side
+# companion of scripts/sanitize.sh (which covers the native daemons with
+# TSAN/ASAN; SURVEY §5: the reference leans on Go's race detector, our
+# harness leans on determined_tpu/lint).
+#
+# Strict mode: ANY finding fails.  Findings that are safe by a subtler
+# argument carry inline `# dtpu: lint-ok[rule]` suppressions WITH the
+# argument as a comment — new findings mean new code needs the same
+# treatment (fix it, or argue it inline), so CI exits non-zero.
+#
+#   scripts/lint.sh            # lint the package + examples
+#   scripts/lint.sh --json     # machine-readable (same gate)
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+exec python -m determined_tpu.cli lint --strict "$@" determined_tpu examples bench.py scripts

@@ -30,6 +30,29 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 `-m 'not slow'` run"
     )
+    config.addinivalue_line(
+        "markers",
+        "no_thread_leaks: assert no dtpu-* worker threads survive the test "
+        "(lint.ThreadLeakChecker; opt in per module/test)",
+    )
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard(request):
+    """Autouse, opt-in: tests/modules marked ``no_thread_leaks`` fail if a
+    harness worker thread (dtpu-*) outlives them.  Leaked prefetch or
+    scheduler workers otherwise bleed between tests and turn unrelated
+    failures flaky — the runtime half of the preflight analyzer
+    (determined_tpu/lint) makes the leak the failure."""
+    if request.node.get_closest_marker("no_thread_leaks") is None:
+        yield
+        return
+    from determined_tpu.lint import ThreadLeakChecker
+
+    with ThreadLeakChecker(
+        watch=("dtpu-*",), grace=5.0, scope=request.node.nodeid
+    ):
+        yield
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,681 @@
+"""Trial preflight analyzer (``determined_tpu/lint``): per-rule bad/clean
+fixtures, suppressions, JSON schema, CLI exit codes, preflight integration
+(strict LocalExperiment rejects a host-syncing trial before any device
+work), and the runtime sentinels (retrace + thread leaks)."""
+
+import json
+import textwrap
+
+import pytest
+
+from determined_tpu.lint import (
+    ERROR,
+    Diagnostic,
+    LintError,
+    RetraceSentinel,
+    ThreadLeakChecker,
+    ThreadLeakError,
+    all_rules,
+    analyze_class,
+    analyze_source,
+    get_retrace_sentinel,
+    to_json_payload,
+)
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: one known-bad and one known-clean snippet per rule
+# ---------------------------------------------------------------------------
+
+BAD = {
+    "host-sync": """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        logits = model.apply(params, batch["x"])
+        return float(logits.mean()), {"v": logits.mean().item()}
+""",
+    "block-until-ready": """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        out = model.apply(params, batch["x"])
+        out.block_until_ready()
+        return out.mean(), {}
+""",
+    "traced-print": """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        out = model.apply(params, batch["x"])
+        print("loss is", out.mean())
+        return out.mean(), {}
+""",
+    "python-rng": """
+import numpy as np
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        noise = np.random.normal(size=(4,))
+        return model.apply(params, batch["x"] + noise).mean(), {}
+""",
+    "trace-side-effect": """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        out = model.apply(params, batch["x"])
+        self.last_loss = out.mean()
+        self.history.append(out.mean())
+        return out.mean(), {}
+""",
+    "wall-clock": """
+import time
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        t0 = time.time()
+        return model.apply(params, batch["x"]).mean(), {}
+""",
+    "traced-control-flow": """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        out = model.apply(params, batch["x"])
+        if out.mean() > 0:
+            out = out * 2
+        for row in out:
+            pass
+        return out.mean(), {}
+""",
+    "mutable-default": """
+class T(JaxTrial):
+    def __init__(self, context, hparams={}):
+        self.hparams = hparams
+""",
+    "unlocked-shared-state": """
+import threading
+class Pool:
+    def __init__(self):
+        self.jobs = []
+        self._lock = threading.Lock()
+    def start(self):
+        threading.Thread(target=self._worker).start()
+    def _worker(self):
+        while True:
+            self.jobs.pop()
+    def add(self, j):
+        self.jobs.append(j)
+""",
+}
+
+CLEAN = {
+    "host-sync": """
+import jax.numpy as jnp
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        logits = model.apply(params, batch["x"])
+        return logits.mean(), {"acc": (logits > 0).mean().astype(jnp.float32)}
+""",
+    "block-until-ready": """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        return model.apply(params, batch["x"]).mean(), {}
+""",
+    "traced-print": """
+import jax
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        out = model.apply(params, batch["x"])
+        jax.debug.print("loss {l}", l=out.mean())
+        return out.mean(), {}
+""",
+    "python-rng": """
+import jax
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        noise = jax.random.normal(rng, (4,))
+        return model.apply(params, batch["x"] + noise).mean(), {}
+""",
+    "trace-side-effect": """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        out = model.apply(params, batch["x"])
+        local = []
+        local.append(out.mean())
+        return out.mean(), {"loss_copy": out.mean()}
+""",
+    "wall-clock": """
+import time
+class T(JaxTrial):
+    def build_callbacks(self):
+        t0 = time.time()  # host-side, outside the traced step: fine
+        return {}
+    def loss(self, model, params, batch, rng):
+        return model.apply(params, batch["x"]).mean(), {}
+""",
+    "traced-control-flow": """
+import jax.numpy as jnp
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        out = model.apply(params, batch["x"])
+        out = jnp.where(out.mean() > 0, out * 2, out)
+        if batch["x"].shape[0] > 4:  # shape is static: legal
+            out = out + 1
+        for k, v in {"a": out}.items():  # structure iteration: legal
+            pass
+        return out.mean(), {}
+""",
+    "mutable-default": """
+class T(JaxTrial):
+    def __init__(self, context, hparams=None):
+        self.hparams = dict(hparams or {})
+""",
+    "unlocked-shared-state": """
+import threading
+class Pool:
+    def __init__(self):
+        self.jobs = []
+        self._lock = threading.Lock()
+    def start(self):
+        threading.Thread(target=self._worker).start()
+    def _worker(self):
+        while True:
+            with self._lock:
+                self.jobs.pop()
+    def add(self, j):
+        with self._lock:
+            self.jobs.append(j)
+""",
+}
+
+
+def _rules_hit(src: str) -> set:
+    return {d.rule for d in analyze_source(textwrap.dedent(src), "fixture.py")}
+
+
+def test_rule_catalog_has_at_least_eight_rules():
+    assert len(all_rules()) >= 8
+    assert set(BAD) == set(CLEAN) == set(all_rules())
+
+
+@pytest.mark.parametrize("rule", sorted(BAD))
+def test_bad_fixture_is_flagged(rule):
+    assert rule in _rules_hit(BAD[rule])
+
+
+@pytest.mark.parametrize("rule", sorted(CLEAN))
+def test_clean_fixture_passes(rule):
+    diags = analyze_source(textwrap.dedent(CLEAN[rule]), "fixture.py")
+    assert diags == [], [d.format() for d in diags]
+
+
+def test_diagnostics_carry_anchor_and_severity():
+    diags = analyze_source(textwrap.dedent(BAD["host-sync"]), "anchored.py")
+    assert diags, "expected findings"
+    for d in diags:
+        assert d.file == "anchored.py"
+        assert d.line > 0
+        assert d.severity in ("error", "warning")
+    assert any(d.severity == ERROR for d in diags)
+
+
+def test_static_print_in_step_is_not_flagged():
+    src = """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        print("using fused kernel")  # static banner: harmless
+        return model.apply(params, batch["x"]).mean(), {}
+"""
+    assert "traced-print" not in _rules_hit(src)
+
+
+def test_closure_container_mutation_in_thread_target_flagged():
+    """The log-shipper shape: a local-function thread target mutating a
+    closure-shared container must be flagged unless a lock protects it."""
+    src = """
+import threading
+def install():
+    batch = []
+    lock = threading.Lock()
+    def pump_unlocked():
+        batch.append(1)
+    def pump_locked():
+        with lock:
+            batch.append(1)
+    threading.Thread(target=pump_unlocked).start()
+    threading.Thread(target=pump_locked).start()
+"""
+    diags = [
+        d
+        for d in analyze_source(textwrap.dedent(src), "f.py")
+        if d.rule == "unlocked-shared-state"
+    ]
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert "batch.append" in diags[0].message
+
+
+def test_nonlocal_rebind_in_thread_target_flagged():
+    src = """
+import threading
+def install():
+    count = 0
+    def worker():
+        nonlocal count
+        count += 1
+    threading.Thread(target=worker).start()
+    return lambda: count
+"""
+    hits = {
+        d.rule for d in analyze_source(textwrap.dedent(src), "f.py")
+    }
+    assert "unlocked-shared-state" in hits
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_same_line():
+    src = """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        v = model.apply(params, batch["x"]).mean().item()  # dtpu: lint-ok[host-sync]
+        return v, {}
+"""
+    assert "host-sync" not in _rules_hit(src)
+
+
+def test_suppression_line_above():
+    src = """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        # dtpu: lint-ok[host-sync]
+        v = model.apply(params, batch["x"]).mean().item()
+        return v, {}
+"""
+    assert "host-sync" not in _rules_hit(src)
+
+
+def test_suppression_bare_covers_all_rules():
+    src = """
+import time
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        t = time.time()  # dtpu: lint-ok
+        return model.apply(params, batch["x"]).mean(), {}
+"""
+    assert _rules_hit(src) == set()
+
+
+def test_suppression_of_other_rule_does_not_hide():
+    src = """
+class T(JaxTrial):
+    def loss(self, model, params, batch, rng):
+        v = model.apply(params, batch["x"]).mean().item()  # dtpu: lint-ok[wall-clock]
+        return v, {}
+"""
+    assert "host-sync" in _rules_hit(src)
+
+
+# ---------------------------------------------------------------------------
+# JSON schema + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_json_payload_schema():
+    diags = analyze_source(textwrap.dedent(BAD["python-rng"]), "j.py")
+    payload = to_json_payload(diags)
+    assert payload["version"] == 1
+    assert payload["counts"]["total"] == len(diags) > 0
+    assert sum(payload["counts"]["by_severity"].values()) == len(diags)
+    assert sum(payload["counts"]["by_rule"].values()) == len(diags)
+    for f in payload["findings"]:
+        assert set(f) == {"rule", "severity", "message", "file", "line", "col"}
+        assert isinstance(f["line"], int)
+    # round-trips through json
+    assert json.loads(json.dumps(payload)) == payload
+
+
+def test_cli_lint_file_exit_codes(tmp_path, capsys):
+    from determined_tpu.cli.main import main as cli_main
+
+    bad = tmp_path / "bad_trial.py"
+    bad.write_text(textwrap.dedent(BAD["host-sync"]))
+    clean = tmp_path / "clean_trial.py"
+    clean.write_text(textwrap.dedent(CLEAN["host-sync"]))
+
+    assert cli_main(["lint", str(clean)]) == 0
+    out = capsys.readouterr().out
+    assert "clean" in out
+
+    assert cli_main(["lint", str(bad)]) == 1  # error-severity finding
+    out = capsys.readouterr().out
+    assert "host-sync" in out
+
+    # warning-only file: default passes, --strict fails
+    warn = tmp_path / "warn_trial.py"
+    warn.write_text(textwrap.dedent(BAD["wall-clock"]))
+    assert cli_main(["lint", str(warn)]) == 0
+    capsys.readouterr()
+    assert cli_main(["lint", "--strict", str(warn)]) == 1
+    capsys.readouterr()
+
+    # JSON output parses and carries the finding
+    assert cli_main(["lint", str(bad), "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["by_rule"].get("host-sync")
+
+
+def test_cli_lint_entrypoint(capsys):
+    from determined_tpu.cli.main import main as cli_main
+
+    assert cli_main(["lint", "determined_tpu.models.mnist:MnistTrial"]) == 0
+    assert cli_main(["lint", "no.such.module:Nope"]) == 2
+    capsys.readouterr()
+
+
+def _import_module_file(path, name):
+    import importlib.util
+    import sys
+
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    # inspect.getsource (analyze_class) resolves source through sys.modules
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_analyze_class_has_absolute_anchors(tmp_path):
+    mod = tmp_path / "offset_trial_mod.py"
+    mod.write_text(
+        "# padding line 1\n"
+        "# padding line 2\n"
+        "from determined_tpu.train import JaxTrial\n"
+        + textwrap.dedent(
+            """
+            class T(JaxTrial):
+                def build_model(self): ...
+                def build_optimizer(self): ...
+                def build_training_data_loader(self): ...
+                def build_validation_data_loader(self): ...
+                def loss(self, model, params, batch, rng):
+                    out = model.apply(params, batch["x"])
+                    return float(out.mean()), {}
+            """
+        )
+    )
+    module = _import_module_file(mod, "offset_trial_mod")
+    diags = analyze_class(module.T)
+    assert diags
+    src_lines = mod.read_text().splitlines()
+    for d in diags:
+        assert d.file.endswith("offset_trial_mod.py")
+        # the anchor points into the class body, past the padding
+        assert d.line > 4
+        assert "float(" in src_lines[d.line - 1]
+
+
+def test_unknown_rule_id_rejected():
+    with pytest.raises(ValueError, match="unknown lint rule"):
+        analyze_source("x = 1", disabled=["no-such-rule"])
+
+
+def test_lint_config_validates_suppress():
+    from determined_tpu.config import ExperimentConfig, InvalidExperimentConfig
+
+    with pytest.raises(InvalidExperimentConfig, match="unknown rules"):
+        ExperimentConfig.parse({"lint": {"suppress": ["definitely-not-a-rule"]}})
+
+
+# ---------------------------------------------------------------------------
+# preflight integration
+# ---------------------------------------------------------------------------
+
+
+def _strict_config(extra_lint=None):
+    from determined_tpu.config import ExperimentConfig
+
+    return ExperimentConfig.parse(
+        {
+            "hyperparameters": {"global_batch_size": 8},
+            "searcher": {
+                "name": "single",
+                "metric": "validation_loss",
+                "max_length": {"batches": 2},
+            },
+            "checkpoint_policy": "none",
+            "lint": {"strict": True, **(extra_lint or {})},
+        }
+    )
+
+
+def test_preflight_strict_rejects_host_syncing_trial(tmp_path, monkeypatch):
+    """A host-syncing trial dies in preflight — before any device query or
+    scheduler slot allocation."""
+    import jax
+
+    from determined_tpu.experiment import LocalExperiment
+
+    mod = tmp_path / "syncing_trial_mod.py"
+    mod.write_text(
+        textwrap.dedent(
+            """
+            from determined_tpu.train import JaxTrial
+
+            class SyncingTrial(JaxTrial):
+                def build_model(self): ...
+                def build_optimizer(self): ...
+                def build_training_data_loader(self): ...
+                def build_validation_data_loader(self): ...
+                def loss(self, model, params, batch, rng):
+                    out = model.apply(params, batch["x"])
+                    return float(out.mean()), {}
+            """
+        )
+    )
+    module = _import_module_file(mod, "syncing_trial_mod")
+
+    calls = []
+    monkeypatch.setattr(
+        jax, "devices", lambda *a, **k: calls.append(1) or jax.local_devices()
+    )
+    exp = LocalExperiment(
+        _strict_config(), module.SyncingTrial, checkpoint_dir=str(tmp_path / "ck")
+    )
+    with pytest.raises(LintError) as exc_info:
+        exp.run()
+    assert any(d.rule == "host-sync" for d in exc_info.value.diagnostics)
+    assert calls == [], "preflight must reject before any device query"
+    assert exp.results == {}
+
+
+def test_preflight_warn_mode_logs_but_runs(tmp_path, caplog):
+    """Default (non-strict) preflight only warns."""
+    import logging
+
+    from determined_tpu.experiment import LocalExperiment
+
+    mod = tmp_path / "warning_trial_mod.py"
+    mod.write_text(
+        textwrap.dedent(
+            """
+            import time
+            from determined_tpu.train import JaxTrial
+
+            class WarningTrial(JaxTrial):
+                def build_model(self): ...
+                def build_optimizer(self): ...
+                def build_training_data_loader(self): ...
+                def build_validation_data_loader(self): ...
+                def loss(self, model, params, batch, rng):
+                    t0 = time.time()
+                    return model.apply(params, batch["x"]).mean(), {}
+            """
+        )
+    )
+    module = _import_module_file(mod, "warning_trial_mod")
+
+    cfg = _strict_config()
+    import dataclasses
+
+    from determined_tpu.config import LintConfig
+
+    cfg = dataclasses.replace(cfg, lint=LintConfig(strict=False))
+    exp = LocalExperiment(cfg, module.WarningTrial, checkpoint_dir=str(tmp_path / "ck"))
+    with caplog.at_level(logging.WARNING, logger="determined_tpu.experiment"):
+        exp._preflight_check()
+    assert any("wall-clock" in r.message for r in caplog.records)
+
+
+def test_preflight_opt_out_knob(tmp_path):
+    from determined_tpu.experiment import LocalExperiment
+
+    class Irrelevant:  # source unavailable classes skip cleanly anyway
+        pass
+
+    exp = LocalExperiment(
+        _strict_config(), Irrelevant, checkpoint_dir=str(tmp_path / "ck"),
+        preflight=False,
+    )
+    exp._preflight_check()  # no error despite strict config: knob wins
+
+
+# ---------------------------------------------------------------------------
+# retrace sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_sentinel_flags_shape_unstable_trial():
+    """The canonical footgun: a trial whose batches change shape retraces
+    (recompiles) the step for every distinct shape — flagged on trace 2."""
+    import jax
+    import jax.numpy as jnp
+
+    s = RetraceSentinel()
+
+    def train_step(state, batch):
+        return state + batch["x"].sum()
+
+    wrapped = jax.jit(
+        s.wrap("ShapeUnstableTrial.train_step", train_step, allowed=1)
+    )
+    state = jnp.zeros(())
+    for n in (4, 5, 6):  # three shapes -> three traces, two over budget
+        state = wrapped(state, {"x": jnp.ones((n, 3))})
+    assert s.violations() == {"ShapeUnstableTrial.train_step": 2}
+    # stable shapes after the fact add no traces
+    state = wrapped(state, {"x": jnp.ones((6, 3))})
+    assert s.violations() == {"ShapeUnstableTrial.train_step": 2}
+
+
+def test_retrace_sentinel_allows_expected_trace_count():
+    import jax
+    import jax.numpy as jnp
+
+    s = RetraceSentinel()
+
+    def eval_step(acc, x):
+        return {k: v + x.sum() for k, v in acc.items()} or {"m": x.sum()}
+
+    wrapped = jax.jit(s.wrap("T.eval_step", eval_step, allowed=2))
+    acc = wrapped({}, jnp.ones(3))
+    acc = wrapped(acc, jnp.ones(3))  # second structure -> second trace: allowed
+    assert s.violations() == {}
+
+
+def test_retrace_sentinel_silent_on_normal_jit_cached_search(tmp_path):
+    """A healthy LocalExperiment with the jit-reuse cache on compiles each
+    step signature once — the sentinel must stay silent."""
+    from determined_tpu.config import ExperimentConfig
+    from determined_tpu.experiment import LocalExperiment
+    from determined_tpu.models.mnist import MnistTrial
+    from determined_tpu.train import clear_step_cache
+
+    sentinel = get_retrace_sentinel()
+    sentinel.reset()
+    clear_step_cache()
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": {
+                "lr": 0.01,
+                "hidden": 16,
+                "global_batch_size": 32,
+                "dataset_size": 64,
+            },
+            "searcher": {
+                "name": "random",
+                "metric": "validation_accuracy",
+                "smaller_is_better": False,
+                "max_trials": 2,
+                "max_length": {"batches": 4},
+                "max_concurrent_trials": 2,
+            },
+            "resources": {"mesh": {"data": 2}},
+            "checkpoint_policy": "none",
+            "lint": {"retrace_sentinel": True},
+        }
+    )
+    try:
+        exp = LocalExperiment(cfg, MnistTrial, checkpoint_dir=str(tmp_path / "ck"))
+        summary = exp.run()
+        assert summary["trials"] == 2
+        assert sentinel.violations() == {}, sentinel.violations()
+        assert any(r.traces >= 1 for r in sentinel.records())
+    finally:
+        sentinel.disable()
+        sentinel.reset()
+        clear_step_cache()
+
+
+# ---------------------------------------------------------------------------
+# thread-leak checker
+# ---------------------------------------------------------------------------
+
+
+def test_thread_leak_checker_flags_leaked_worker():
+    import threading
+
+    release = threading.Event()
+    try:
+        with pytest.raises(ThreadLeakError, match="dtpu-leaky"):
+            with ThreadLeakChecker(watch=("dtpu-*",), grace=0.3, scope="t"):
+                threading.Thread(
+                    target=release.wait, name="dtpu-leaky", daemon=True
+                ).start()
+    finally:
+        release.set()
+
+
+def test_thread_leak_checker_passes_when_threads_die():
+    import threading
+
+    with ThreadLeakChecker(watch=("dtpu-*",), grace=5.0, scope="t"):
+        t = threading.Thread(target=lambda: None, name="dtpu-shortlived")
+        t.start()
+        t.join()
+
+
+def test_thread_leak_checker_ignores_unwatched_threads():
+    import threading
+
+    release = threading.Event()
+    try:
+        with ThreadLeakChecker(watch=("dtpu-*",), grace=0.3, scope="t"):
+            threading.Thread(
+                target=release.wait, name="unrelated-pool-thread", daemon=True
+            ).start()
+    finally:
+        release.set()
+
+
+def test_thread_leak_checker_warn_mode_records(caplog):
+    import logging
+    import threading
+
+    release = threading.Event()
+    try:
+        with caplog.at_level(logging.WARNING, logger="determined_tpu.lint.runtime"):
+            with ThreadLeakChecker(
+                watch=("dtpu-*",), grace=0.3, raise_on_leak=False, scope="warnscope"
+            ) as checker:
+                threading.Thread(
+                    target=release.wait, name="dtpu-warn-leak", daemon=True
+                ).start()
+        assert [t.name for t in checker.leaked] == ["dtpu-warn-leak"]
+        assert any("warnscope" in r.message for r in caplog.records)
+    finally:
+        release.set()

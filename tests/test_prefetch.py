@@ -11,6 +11,10 @@ import numpy as np
 import pytest
 import jax
 
+# prefetch workers must die with their pipeline/test — leaks previously
+# bled between tests (conftest._thread_leak_guard + ThreadLeakChecker)
+pytestmark = pytest.mark.no_thread_leaks
+
 from determined_tpu import core, train
 from determined_tpu.config import ExperimentConfig, Length
 from determined_tpu.config.experiment import InvalidExperimentConfig

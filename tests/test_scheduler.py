@@ -18,6 +18,10 @@ import time
 import numpy as np
 import pytest
 
+# every test here spins scheduler/trial worker threads; none may outlive
+# its test (conftest._thread_leak_guard enforces via ThreadLeakChecker)
+pytestmark = pytest.mark.no_thread_leaks
+
 from determined_tpu.config import ExperimentConfig
 from determined_tpu.config.experiment import InvalidExperimentConfig, Length
 from determined_tpu.experiment import LocalExperiment, SlotPool, TrialScheduler
