@@ -187,6 +187,102 @@ def exp_signal(args) -> int:
     return 0
 
 
+# ---- local experiment recovery (journal-backed; no master required) ---------
+
+
+def exp_status_local(args) -> int:
+    """Digest a LocalExperiment's journal: what completed, what's in
+    flight, whether the directory is resumable (docs/fault-tolerance.md,
+    "Experiment recovery & preemption")."""
+    from determined_tpu.experiment import ExperimentJournalError, experiment_status
+
+    try:
+        st = experiment_status(args.checkpoint_dir)
+    except ExperimentJournalError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        _print_json(st)
+        return 0
+    print(f"experiment:  {st['name'] or '(unnamed)'}")
+    print(f"status:      {st['status']}" + ("  (resumable)" if st["resumable"] else ""))
+    print(f"entrypoint:  {st['entrypoint'] or '(unknown)'}")
+    print(
+        f"trials:      {st['trials_completed']} completed, "
+        f"{st['trials_in_flight']} in flight, {st['trials_created']} created"
+    )
+    _table(
+        [
+            {
+                "trial": t["request_id"],
+                "state": t["state"],
+                "steps": t["steps_completed"] if t["steps_completed"] is not None else "",
+                "checkpoint": t["checkpoint"] or "",
+            }
+            for t in st["trials"]
+        ],
+        ["trial", "state", "steps", "checkpoint"],
+    )
+    return 0
+
+
+def exp_resume_local(args) -> int:
+    """Resume a crashed/preempted LocalExperiment from its journal.
+
+    The journal records the experiment config and trial entrypoint, so the
+    directory alone is enough; ``--entrypoint`` overrides (e.g. after a
+    module rename).  Exits 75 (EX_TEMPFAIL) if the resumed run is itself
+    preempted — still resumable.
+    """
+    from determined_tpu.config.experiment import ExperimentConfig
+    from determined_tpu.experiment import (
+        PREEMPTED_EXIT_CODE,
+        ExperimentJournalError,
+        LocalExperiment,
+        journal_path,
+        read_journal,
+    )
+
+    try:
+        replay = read_journal(journal_path(args.checkpoint_dir))
+    except ExperimentJournalError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if replay.status == "completed":
+        print("experiment already completed; nothing to resume")
+        return 0
+    started = replay.started or {}
+    entrypoint = args.entrypoint or started.get("entrypoint")
+    if not entrypoint:
+        print(
+            "error: journal records no trial entrypoint; pass --entrypoint "
+            "pkg.module:TrialClass",
+            file=sys.stderr,
+        )
+        return 2
+    if not started.get("config"):
+        print("error: journal records no experiment config", file=sys.stderr)
+        return 2
+    cfg = ExperimentConfig.parse(started["config"])
+    module_name, _, class_name = entrypoint.partition(":")
+    sys.path.insert(0, os.getcwd())
+    trial_cls = getattr(importlib.import_module(module_name), class_name)
+    exp = LocalExperiment(
+        cfg,
+        trial_cls,
+        checkpoint_dir=args.checkpoint_dir,
+        seed=started.get("seed"),
+    )
+    try:
+        summary = exp.resume(serial=args.serial)
+    except ExperimentJournalError as e:
+        # e.g. the original driver is still alive and owns the journal
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    _print_json(summary)
+    return PREEMPTED_EXIT_CODE if summary.get("status") == "preempted" else 0
+
+
 # ---- trial -----------------------------------------------------------------
 
 
@@ -704,6 +800,12 @@ def run_local(args) -> int:
     exp = LocalExperiment(cfg, trial_cls, checkpoint_dir=args.checkpoint_dir)
     summary = exp.run()
     _print_json(summary)
+    if summary.get("status") == "preempted":
+        # EX_TEMPFAIL: the search drained to checkpoints; rerun with
+        # `dtpu experiment resume <checkpoint_dir>` to finish it
+        from determined_tpu.experiment import PREEMPTED_EXIT_CODE
+
+        return PREEMPTED_EXIT_CODE
     return 0
 
 
@@ -767,6 +869,24 @@ def build_parser() -> argparse.ArgumentParser:
     dl = exp.add_parser("delete")
     dl.add_argument("id", type=int)
     dl.set_defaults(fn=exp_delete)
+    st = exp.add_parser(
+        "status",
+        help="journal-backed status of a LOCAL experiment directory",
+    )
+    st.add_argument("checkpoint_dir")
+    st.add_argument("--json", action="store_true", help="machine-readable output")
+    st.set_defaults(fn=exp_status_local)
+    rs = exp.add_parser(
+        "resume",
+        help="resume a crashed/preempted LOCAL experiment from its journal",
+    )
+    rs.add_argument("checkpoint_dir")
+    rs.add_argument(
+        "--entrypoint",
+        help="pkg.module:TrialClass (default: recorded in the journal)",
+    )
+    rs.add_argument("--serial", action="store_true", help="force the sequential loop")
+    rs.set_defaults(fn=exp_resume_local)
 
     trial = sub.add_parser("trial", aliases=["t"]).add_subparsers(
         dest="verb", required=True

@@ -276,6 +276,21 @@ class FaultToleranceConfig:
     restart_backoff_jitter: float = 0.25  # +/- fraction applied to the delay
     verify_checkpoints: bool = True       # manifest-verify on resume
     heartbeat_failure_threshold: int = 5  # consecutive misses -> master_unreachable
+    # Experiment-level crash recovery (docs/fault-tolerance.md, "Experiment
+    # recovery & preemption"): write-ahead journal of searcher snapshots +
+    # trial lifecycle under checkpoint_dir/experiment.journal, enabling
+    # LocalExperiment.resume() after a driver crash/preemption.
+    journal: bool = True
+    journal_compact_interval: int = 64    # appends between compactions (0 = never)
+    # Graceful preemption: SIGTERM/SIGINT flags every in-flight trial's
+    # PreemptContext; the driver waits up to this long for trials to
+    # checkpoint-and-exit before journaling final state and exiting
+    # "preempted, resumable".
+    preempt_drain_seconds: float = 300.0
+    # Apply the checkpoint retention policy (exec/gc_checkpoints.py:
+    # latest-per-trial + top-k best, parents of kept checkpoints protected)
+    # at journal-compaction points.
+    gc_on_compaction: bool = True
 
     def __post_init__(self):
         if self.restart_backoff_base < 0 or self.restart_backoff_cap < 0:
@@ -287,6 +302,14 @@ class FaultToleranceConfig:
         if self.heartbeat_failure_threshold < 1:
             raise InvalidExperimentConfig(
                 "fault_tolerance.heartbeat_failure_threshold must be >= 1"
+            )
+        if self.journal_compact_interval < 0:
+            raise InvalidExperimentConfig(
+                "fault_tolerance.journal_compact_interval must be >= 0"
+            )
+        if self.preempt_drain_seconds < 0:
+            raise InvalidExperimentConfig(
+                "fault_tolerance.preempt_drain_seconds must be >= 0"
             )
 
     @classmethod

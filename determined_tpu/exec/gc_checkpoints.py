@@ -1,19 +1,174 @@
-"""Checkpoint-GC task body (reference ``harness/determined/exec/gc_checkpoints.py``).
+"""Checkpoint-GC task body + experiment retention policy.
 
-The master marks checkpoints DELETED and dispatches a ``gc`` work item to an
-agent; the agent runs this module with the work item in ``DTPU_GC_SPEC``.
-Deletion goes through the same StorageManager family the harness saves with,
-so every backend (shared_fs/directory/s3/gcs/azure) is covered.
+Reference ``harness/determined/exec/gc_checkpoints.py``: the master marks
+checkpoints DELETED and dispatches a ``gc`` work item to an agent; the
+agent runs this module with the work item in ``DTPU_GC_SPEC``.  Deletion
+goes through the same StorageManager family the harness saves with, so
+every backend (shared_fs/directory/s3/gcs/azure) is covered.
+
+The retention half (``RetentionPolicy`` / ``plan_retention`` /
+``apply_retention``) is the expconf ``save_trial_latest`` /
+``save_experiment_best`` contract applied to a LocalExperiment's
+checkpoint directory: keep the newest N checkpoints of every trial plus
+the latest checkpoint of the top-k trials by searcher metric, and NEVER
+delete (a) the manifest-referenced parent of any kept checkpoint — the
+verified-resume fallback needs one step of lineage — or (b) a directory
+without a manifest, which may be an upload still in flight.  The
+experiment driver invokes it at journal-compaction points
+(``experiment/local.py``).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import os
+import shutil
 import sys
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 logger = logging.getLogger("determined_tpu.gc")
+
+
+# -- retention policy --------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RetentionPolicy:
+    keep_trial_latest: int = 1       # newest checkpoints kept per trial
+    keep_experiment_best: int = 0    # top-k trials (by metric) keep latest
+    smaller_is_better: bool = True
+
+    def __post_init__(self) -> None:
+        if self.keep_trial_latest < 0 or self.keep_experiment_best < 0:
+            raise ValueError("retention keep counts must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointInfo:
+    """One checkpoint as the retention planner sees it."""
+
+    uuid: str
+    trial_id: int
+    steps_completed: int
+    parent: Optional[str] = None     # manifest/metadata lineage pointer
+    has_manifest: bool = True        # manifest-less = possibly mid-write
+
+
+def scan_experiment_checkpoints(checkpoint_dir: str) -> List[CheckpointInfo]:
+    """Walk a LocalExperiment's ``trial_<rid>/<uuid>/`` layout."""
+    infos: List[CheckpointInfo] = []
+    if not os.path.isdir(checkpoint_dir):
+        return infos
+    for entry in sorted(os.listdir(checkpoint_dir)):
+        if not entry.startswith("trial_"):
+            continue
+        try:
+            rid = int(entry.split("_", 1)[1])
+        except ValueError:
+            continue
+        trial_dir = os.path.join(checkpoint_dir, entry)
+        for uuid in sorted(os.listdir(trial_dir)):
+            path = os.path.join(trial_dir, uuid)
+            if not os.path.isdir(path):
+                continue
+            meta: Dict[str, Any] = {}
+            manifest: Dict[str, Any] = {}
+            for name, target in (("metadata.json", meta), ("manifest.json", manifest)):
+                try:
+                    with open(os.path.join(path, name)) as f:
+                        target.update(json.load(f))
+                except (OSError, ValueError):
+                    pass
+            infos.append(
+                CheckpointInfo(
+                    uuid=uuid,
+                    trial_id=rid,
+                    steps_completed=int(meta.get("steps_completed") or 0),
+                    parent=manifest.get("parent") or meta.get("parent_storage_id"),
+                    has_manifest=bool(manifest),
+                )
+            )
+    return infos
+
+
+def plan_retention(
+    checkpoints: List[CheckpointInfo],
+    policy: RetentionPolicy,
+    metric_by_trial: Optional[Dict[int, float]] = None,
+    protected: Optional[Set[str]] = None,
+) -> Tuple[Set[str], Set[str]]:
+    """Decide (keep, delete) uuid sets under the policy.
+
+    Kept: newest ``keep_trial_latest`` per trial (by steps_completed, uuid
+    as tiebreak), the latest checkpoint of the ``keep_experiment_best``
+    best trials by metric, every manifest-referenced parent of a kept
+    checkpoint, anything without a manifest (mid-write safety), and any
+    explicitly ``protected`` uuid (the experiment passes its journaled
+    resume points — the WAL references them by id, so deleting one would
+    turn a crash-resume into a from-scratch retrain).
+    """
+    metric_by_trial = metric_by_trial or {}
+    by_trial: Dict[int, List[CheckpointInfo]] = {}
+    for ci in checkpoints:
+        by_trial.setdefault(ci.trial_id, []).append(ci)
+    for infos in by_trial.values():
+        infos.sort(key=lambda c: (c.steps_completed, c.uuid), reverse=True)
+
+    keep: Set[str] = {c.uuid for c in checkpoints if c.uuid in (protected or set())}
+    for infos in by_trial.values():
+        keep.update(c.uuid for c in infos[: policy.keep_trial_latest])
+        # never delete an upload that may still be in flight
+        keep.update(c.uuid for c in infos if not c.has_manifest)
+
+    if policy.keep_experiment_best and metric_by_trial:
+        ranked = sorted(
+            (rid for rid in metric_by_trial if rid in by_trial),
+            key=lambda rid: metric_by_trial[rid],
+            reverse=not policy.smaller_is_better,
+        )
+        for rid in ranked[: policy.keep_experiment_best]:
+            keep.add(by_trial[rid][0].uuid)
+
+    # a kept checkpoint's manifest-referenced parent is its verified-resume
+    # fallback: protect it even when the per-trial count would drop it
+    by_uuid = {c.uuid: c for c in checkpoints}
+    for uuid in list(keep):
+        parent = by_uuid[uuid].parent if uuid in by_uuid else None
+        if parent and parent in by_uuid:
+            keep.add(parent)
+
+    delete = {c.uuid for c in checkpoints} - keep
+    return keep, delete
+
+
+def apply_retention(
+    checkpoint_dir: str,
+    policy: RetentionPolicy,
+    metric_by_trial: Optional[Dict[int, float]] = None,
+    protected: Optional[Set[str]] = None,
+) -> Dict[str, List[str]]:
+    """Scan, plan, and delete under ``checkpoint_dir``; returns what was
+    kept/deleted.  Deletion failures are logged and skipped — GC must
+    never take down the search it is cleaning up after."""
+    checkpoints = scan_experiment_checkpoints(checkpoint_dir)
+    keep, delete = plan_retention(checkpoints, policy, metric_by_trial, protected)
+    deleted: List[str] = []
+    by_uuid = {c.uuid: c for c in checkpoints}
+    for uuid in sorted(delete):
+        ci = by_uuid[uuid]
+        path = os.path.join(checkpoint_dir, f"trial_{ci.trial_id}", uuid)
+        try:
+            shutil.rmtree(path)
+            deleted.append(uuid)
+        except OSError:
+            logger.exception("retention: failed to delete checkpoint %s", uuid)
+    if deleted:
+        logger.info(
+            "retention: deleted %d checkpoint(s), kept %d", len(deleted), len(keep)
+        )
+    return {"kept": sorted(keep), "deleted": deleted}
 
 
 def storage_manager_from_spec(storage: dict, fallback_dir: str):

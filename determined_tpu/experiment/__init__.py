@@ -1,6 +1,20 @@
-"""Experiment orchestration: local searcher-driven runner + gang scheduler."""
+"""Experiment orchestration: local searcher-driven runner + gang scheduler
++ crash-recovery journal."""
 
-from determined_tpu.experiment.local import LocalExperiment, TrialResult, run_experiment
+from determined_tpu.experiment.journal import (
+    ExperimentJournal,
+    ExperimentJournalError,
+    JournaledSearcher,
+    experiment_status,
+    journal_path,
+    read_journal,
+)
+from determined_tpu.experiment.local import (
+    PREEMPTED_EXIT_CODE,
+    LocalExperiment,
+    TrialResult,
+    run_experiment,
+)
 from determined_tpu.experiment.scheduler import (
     SchedulerOutcome,
     SlotAllocation,
@@ -9,11 +23,18 @@ from determined_tpu.experiment.scheduler import (
 )
 
 __all__ = [
+    "ExperimentJournal",
+    "ExperimentJournalError",
+    "JournaledSearcher",
     "LocalExperiment",
+    "PREEMPTED_EXIT_CODE",
     "SchedulerOutcome",
     "SlotAllocation",
     "SlotPool",
     "TrialResult",
     "TrialScheduler",
+    "experiment_status",
+    "journal_path",
+    "read_journal",
     "run_experiment",
 ]

@@ -23,8 +23,11 @@ concurrent path is tested against.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
+import signal
+import threading
 from typing import Any, Dict, List, Optional, Type
 
 from determined_tpu import core
@@ -33,11 +36,21 @@ from determined_tpu.config.experiment import (
     InvalidExperimentConfig,
     Length,
 )
-from determined_tpu.searcher import Create, Searcher, method_from_config
+from determined_tpu.experiment.journal import (
+    ExperimentJournal,
+    ExperimentJournalError,
+    JournaledSearcher,
+    journal_path,
+    read_journal,
+)
+from determined_tpu.searcher import Create, method_from_config
 from determined_tpu.train import Trainer, TrialContext
 from determined_tpu.train._trial import JaxTrial
 
 logger = logging.getLogger("determined_tpu.experiment")
+
+# exit code for "preempted, resumable" (EX_TEMPFAIL: rerun later)
+PREEMPTED_EXIT_CODE = 75
 
 
 @dataclasses.dataclass
@@ -48,6 +61,10 @@ class TrialResult:
     metrics: Dict[str, float]
     checkpoint: Optional[str]
     stopped_early: bool
+    # the trial exited because the EXPERIMENT is draining for preemption
+    # (not because it finished or the searcher stopped it); its latest
+    # checkpoint is a resume point, not a final result
+    preempted: bool = False
 
 
 class LocalExperiment:
@@ -72,13 +89,26 @@ class LocalExperiment:
         )
         self.seed = seed if seed is not None else config.reproducibility.experiment_seed
         self.devices = devices  # None = jax.devices() at run time
-        self.searcher = Searcher(
+        self.searcher = JournaledSearcher(
             method_from_config(config.searcher, config.hyperparameters),
             config.hyperparameters,
             seed=self.seed,
         )
         self.results: Dict[int, TrialResult] = {}
         self.scheduler_stats: Optional[Dict[str, Any]] = None
+        # experiment-level crash recovery (docs/fault-tolerance.md)
+        self.journal: Optional[ExperimentJournal] = None
+        self.status = "pending"  # pending|running|completed|preempted
+        self._resume_checkpoints: Dict[int, Optional[str]] = {}
+        self._journaled_ckpts: Dict[int, str] = {}
+        # guards the two checkpoint maps above: trial threads write them
+        # mid-run while the GC pass and the drain path iterate them
+        self._ckpt_lock = threading.Lock()
+        self._gc_thread: Optional[threading.Thread] = None
+        self._active_trials: Dict[int, Any] = {}  # rid -> core Context
+        self._active_lock = threading.Lock()
+        self._preempt = threading.Event()
+        self._prev_handlers: Dict[int, Any] = {}
 
     # -- single-trial execution -------------------------------------------
 
@@ -109,7 +139,22 @@ class LocalExperiment:
         orig_report = core_ctx.train.report_validation_metrics
         searcher = self.searcher
         runner = self
+        with self._active_lock:
+            self._active_trials[rid] = core_ctx
+        if self._preempt.is_set():
+            # the drain request landed before this trial registered; flag it
+            # now so its very first boundary checkpoints-and-exits
+            core_ctx.preempt.simulate()
+        with self._ckpt_lock:
+            resume_ckpt = self._resume_checkpoints.get(rid)
         try:
+            if self.journal is not None:
+                self.journal.append(
+                    "trial_running",
+                    rid=rid,
+                    devices=[getattr(d, "id", str(d)) for d in (devices or [])],
+                    resume_checkpoint=resume_ckpt,
+                )
             ctx = train_mod.init(
                 hparams=create.hparams,
                 mesh_config=cfg.resources.mesh,
@@ -128,6 +173,9 @@ class LocalExperiment:
                 payload = dict(metrics)
                 payload.setdefault(scfg.time_metric or "batches", steps_completed)
                 searcher.on_validation(rid, payload)
+                # WAL the newest FINALIZED checkpoint so a driver crash
+                # knows this trial's resume point
+                runner._journal_trial_checkpoint(rid, trainer.latest_checkpoint)
                 if searcher.is_stopped(rid):
                     # cooperative stop through the preemption path: the
                     # trainer checkpoints and exits at the next boundary,
@@ -149,6 +197,7 @@ class LocalExperiment:
                 validation_period=validation_period,
                 checkpoint_period=cfg.min_checkpoint_period,
                 report_period=validation_period,
+                latest_checkpoint=resume_ckpt,
                 checkpoint_policy=cfg.checkpoint_policy,
             )
         finally:
@@ -158,14 +207,45 @@ class LocalExperiment:
             # build must still close the context it was handed
             core_ctx.train.report_validation_metrics = orig_report
             core_ctx.close()
-        return TrialResult(
+            with self._active_lock:
+                self._active_trials.pop(rid, None)
+        preempted = bool(
+            self._preempt.is_set()
+            and summary["stopped_early"]
+            and not searcher.is_stopped(rid)
+        )
+        result = TrialResult(
             request_id=rid,
             hparams=create.hparams,
             steps_completed=summary["steps_completed"],
             metrics=summary["validation_metrics"],
             checkpoint=summary["latest_checkpoint"],
             stopped_early=summary["stopped_early"],
+            preempted=preempted,
         )
+        if not preempted:
+            # the resume point is consumed: a finished trial must not be
+            # reported as in-flight by a later drain
+            with self._ckpt_lock:
+                self._resume_checkpoints.pop(rid, None)
+        if self.journal is not None:
+            if preempted:
+                # drained to a checkpoint, not finished: journal the resume
+                # point only — the trial stays in-flight for the next run
+                self._journal_trial_checkpoint(rid, result.checkpoint)
+            else:
+                self.journal.append(
+                    "trial_result",
+                    rid=rid,
+                    result={
+                        "hparams": result.hparams,
+                        "steps_completed": result.steps_completed,
+                        "metrics": result.metrics,
+                        "checkpoint": result.checkpoint,
+                        "stopped_early": result.stopped_early,
+                    },
+                )
+        return result
 
     def _max_steps(self, trainer: Trainer, max_length: Length) -> int:
         """Optimizer-step horizon for progress reporting.
@@ -235,14 +315,26 @@ class LocalExperiment:
         *,
         serial: bool = False,
         max_concurrency: Optional[int] = None,
+        resume: bool = False,
     ) -> Dict[str, Any]:
-        """Run the search to completion.
+        """Run the search to completion (or to a resumable preemption).
 
         Trials run concurrently on disjoint submeshes when
         ``searcher.max_concurrent_trials`` (> 1), the per-trial mesh size,
         and the device count allow; ``serial=True`` forces the sequential
         reference loop and ``max_concurrency`` caps (never raises) the
         config-derived gang count.
+
+        With ``fault_tolerance.journal`` (default on) every searcher event
+        and trial lifecycle transition is write-ahead-logged to
+        ``checkpoint_dir/experiment.journal``; ``resume=True`` replays that
+        journal instead of starting fresh — the searcher (including its
+        request-id counter and rng) is restored, completed trials are
+        skipped, and in-flight trials re-queue from their latest VERIFIED
+        checkpoint (manifest check + parent-lineage fallback).  SIGTERM/
+        SIGINT trigger a graceful drain: in-flight trials checkpoint and
+        exit, the final state is journaled, and the summary comes back with
+        ``status="preempted"`` (resumable) instead of ``"completed"``.
 
         Preflight runs FIRST — before jax touches devices or the scheduler
         allocates a single slot: a host-syncing or retrace-prone trial is
@@ -252,31 +344,382 @@ class LocalExperiment:
         self._preflight_check()
         import jax
 
-        devices = list(self.devices if self.devices is not None else jax.devices())
-        slots = self._slots_per_trial(len(devices))
-        if slots > len(devices):
-            raise InvalidExperimentConfig(
-                f"resources.mesh wants {slots} devices per trial, "
-                f"only {len(devices)} visible"
-            )
-        limit = self.config.searcher.max_concurrent_trials
-        if limit <= 0:
-            # 0 = no explicit cap (the adaptive searcher's "auto" value):
-            # bound by device capacity alone
-            limit = len(devices)
-        concurrency = min(limit, max(1, len(devices) // slots))
-        if max_concurrency is not None:
-            concurrency = min(concurrency, max(1, max_concurrency))
-        if serial or concurrency <= 1:
-            return self._run_serial(max_trials)
-        return self._run_concurrent(max_trials, devices, slots, concurrency)
+        ft = self.config.fault_tolerance
+        if ft.journal:
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            self.journal = ExperimentJournal(
+                journal_path(self.checkpoint_dir),
+                compact_interval=ft.journal_compact_interval,
+                on_compact=self._schedule_gc_retention if ft.gc_on_compaction else None,
+            ).open(fresh=not resume)
+            # Safe unlocked: the GC thread only calls the locked accessor
+            # searcher.trial_records() and never reads .journal; this
+            # attach happens before any trial (or GC) thread exists.
+            self.searcher.journal = self.journal  # dtpu: lint-ok[unlocked-shared-state]
+        try:
+            if resume:
+                self._load_resume_state()
+            elif self.journal is not None:
+                self.journal.append(
+                    "experiment_started",
+                    name=self.config.name,
+                    entrypoint=(
+                        f"{self.trial_cls.__module__}:{self.trial_cls.__qualname__}"
+                    ),
+                    config=self.config.raw or None,
+                    seed=self.seed,
+                )
 
-    def _run_serial(self, max_trials: Optional[int] = None) -> Dict[str, Any]:
+            devices = list(self.devices if self.devices is not None else jax.devices())
+            slots = self._slots_per_trial(len(devices))
+            if slots > len(devices):
+                raise InvalidExperimentConfig(
+                    f"resources.mesh wants {slots} devices per trial, "
+                    f"only {len(devices)} visible"
+                )
+            limit = self.config.searcher.max_concurrent_trials
+            if limit <= 0:
+                # 0 = no explicit cap (the adaptive searcher's "auto" value):
+                # bound by device capacity alone
+                limit = len(devices)
+            concurrency = min(limit, max(1, len(devices) // slots))
+            if max_concurrency is not None:
+                concurrency = min(concurrency, max(1, max_concurrency))
+
+            self.status = "running"
+            self._install_signal_handlers()
+            try:
+                if serial or concurrency <= 1:
+                    self._run_serial(max_trials)
+                else:
+                    self._run_concurrent(max_trials, devices, slots, concurrency)
+            finally:
+                self._restore_signal_handlers()
+            self.status = "preempted" if self._preempt.is_set() else "completed"
+            if self.journal is not None:
+                if self.status == "preempted":
+                    with self._ckpt_lock:
+                        in_flight = sorted(self._resume_checkpoints)
+                    self.journal.append("experiment_preempted", in_flight=in_flight)
+                else:
+                    self.journal.append("experiment_completed")
+            return self.summary()
+        finally:
+            gc_thread = self._gc_thread
+            if gc_thread is not None:
+                gc_thread.join(timeout=60)
+            if self.journal is not None:
+                # Safe unlocked: the GC thread was joined above and never
+                # reads .journal; trial threads are gone by this point.
+                self.searcher.journal = None  # dtpu: lint-ok[unlocked-shared-state]
+                self.journal.close()
+
+    def resume(self, max_trials: Optional[int] = None, **kwargs: Any) -> Dict[str, Any]:
+        """Replay the experiment journal and continue the search."""
+        return self.run(max_trials, resume=True, **kwargs)
+
+    # -- preemption drain --------------------------------------------------
+
+    def request_preemption(self) -> None:
+        """Begin a graceful drain: every in-flight trial's PreemptContext
+        is flagged so its Trainer checkpoints and exits at the next
+        boundary; no new trials dispatch; the run returns "preempted".
+        Called by the SIGTERM/SIGINT handlers, and directly by tests and
+        embedding orchestrators."""
+        if self._preempt.is_set():
+            return
+        logger.warning(
+            "preemption requested: draining in-flight trials to checkpoints "
+            "(deadline %.0fs)",
+            self.config.fault_tolerance.preempt_drain_seconds,
+        )
+        self._preempt.set()
+        with self._active_lock:
+            ctxs = list(self._active_trials.values())
+        for ctx in ctxs:
+            ctx.preempt.simulate()
+
+    def _install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful drain, chaining any prior handler.
+
+        Cloud TPU VMs deliver maintenance/preemption as SIGTERM on the
+        host (same signal path the trial-level PreemptContext latches);
+        at experiment scope the whole SEARCH must drain, not one trial.
+        Main-thread only — embedding callers on other threads use
+        ``request_preemption`` directly.
+        """
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev = signal.getsignal(sig)
+
+            def handler(signum: int, frame: Any, _prev: Any = prev) -> None:
+                self.request_preemption()
+                # chain a real prior handler; never the default SIGINT
+                # KeyboardInterrupt raiser — that would abort the drain
+                if callable(_prev) and _prev is not signal.default_int_handler:
+                    _prev(signum, frame)
+
+            self._prev_handlers[sig] = prev
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):  # non-main interpreter contexts
+                self._prev_handlers.pop(sig, None)
+                return
+
+    def _restore_signal_handlers(self) -> None:
+        for sig, prev in list(self._prev_handlers.items()):
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError, OSError):
+                pass
+        self._prev_handlers.clear()
+
+    # -- resume ------------------------------------------------------------
+
+    def _load_resume_state(self) -> None:
+        """Rebuild searcher + results + resume points from the journal."""
+        if self.journal is None:
+            raise ExperimentJournalError(
+                "resume requires fault_tolerance.journal: true"
+            )
+        replay = read_journal(journal_path(self.checkpoint_dir))
+        if replay.searcher_state is not None:
+            self.searcher.restore_json(json.dumps(replay.searcher_state))
+        # redeliver events orphaned between their append and the follow-up
+        # snapshot (at most the journal's final event)
+        for ev in replay.tail_events:
+            rid = int(ev["rid"])
+            rec = self.searcher.trials.get(rid)
+            if rec is None or rec.exited:
+                continue
+            if ev["type"] == "trial_validated":
+                self.searcher.on_validation(rid, ev.get("metrics") or {})
+            elif ev["type"] == "trial_exited":
+                self.searcher.on_trial_exited(rid)
+            else:
+                self.searcher.on_trial_exited_early(
+                    rid, ev.get("reason") or "errored"
+                )
+        # completed trials are skipped, not re-run; a result whose searcher
+        # exit event was lost in the crash gets the event redelivered
+        for rid, payload in replay.results.items():
+            self.results[rid] = TrialResult(
+                request_id=rid,
+                hparams=payload.get("hparams") or replay.created.get(rid, {}),
+                steps_completed=int(payload.get("steps_completed") or 0),
+                metrics=payload.get("metrics") or {},
+                checkpoint=payload.get("checkpoint"),
+                stopped_early=bool(payload.get("stopped_early")),
+            )
+            rec = self.searcher.trials.get(rid)
+            if rec is not None and not rec.exited:
+                self.searcher.on_trial_exited(rid)
+        # in-flight trials re-queue from their latest VERIFIED checkpoint
+        # (manifest check + parent-lineage fallback); with no usable
+        # checkpoint they restart from scratch
+        for rid in replay.in_flight:
+            sid = self._verified_resume_checkpoint(rid, replay.checkpoints.get(rid))
+            if sid:
+                with self._ckpt_lock:
+                    self._resume_checkpoints[rid] = sid
+                    self._journaled_ckpts[rid] = sid
+        # a trial the searcher had STOPPED but whose exit event was lost
+        # needs no re-training: its last reported state is its result
+        scfg = self.config.searcher
+        for rec in list(self.searcher.runnable_trials()):
+            rid = rec.request_id
+            if rid in self.results or not rec.stopped_by_searcher:
+                continue
+            metrics = dict(rec.metrics or {})
+            steps = int(metrics.get(scfg.time_metric or "batches", 0) or 0)
+            with self._ckpt_lock:
+                ckpt = self._resume_checkpoints.pop(rid, None)
+            result = TrialResult(
+                request_id=rid,
+                hparams=rec.hparams,
+                steps_completed=steps,
+                metrics=metrics,
+                checkpoint=ckpt,
+                stopped_early=True,
+            )
+            self.results[rid] = result
+            if self.journal is not None:
+                self.journal.append(
+                    "trial_result",
+                    rid=rid,
+                    result={
+                        "hparams": result.hparams,
+                        "steps_completed": result.steps_completed,
+                        "metrics": result.metrics,
+                        "checkpoint": result.checkpoint,
+                        "stopped_early": True,
+                    },
+                )
+            self.searcher.on_trial_exited(rid)
+        logger.info(
+            "resume: %d completed trial(s) restored, %d in-flight re-queued "
+            "(%d with verified checkpoints)",
+            len(self.results),
+            len([r for r in replay.in_flight if r not in self.results]),
+            len(self._resume_checkpoints),
+        )
+
+    def _verified_resume_checkpoint(
+        self, rid: int, sid: Optional[str]
+    ) -> Optional[str]:
+        """Newest usable checkpoint in the trial's lineage, or None.
+
+        Walks parent pointers (manifest first, metadata fallback — same
+        lineage contract the Trainer's restore uses) rejecting any
+        checkpoint that fails manifest verification, so a resume never
+        points a trial at poison.  When the journaled lineage yields
+        nothing — the journal only records validation-boundary saves, so
+        newer checkpoint-period saves may exist on disk, and GC may have
+        rotated the journaled uuid out — falls back to scanning the trial
+        directory for the newest checkpoint that verifies."""
+        from determined_tpu.core._checkpoint import verify_manifest
+        from determined_tpu.utils.errors import CheckpointCorruptError
+
+        trial_dir = self._trial_checkpoint_dir(rid)
+        verify = self.config.fault_tolerance.verify_checkpoints
+        tried: set = set()
+        while sid and sid not in tried:
+            tried.add(sid)
+            path = os.path.join(trial_dir, sid)
+            if os.path.isdir(path):
+                if not verify:
+                    return sid
+                try:
+                    verify_manifest(path, require_manifest=True)
+                    return sid
+                except CheckpointCorruptError as e:
+                    logger.warning(
+                        "resume: checkpoint %s of trial %d unusable (%s); "
+                        "walking to parent",
+                        sid,
+                        rid,
+                        e,
+                    )
+            sid = self._checkpoint_parent(path)
+
+        candidates = []
+        if os.path.isdir(trial_dir):
+            for uuid in os.listdir(trial_dir):
+                path = os.path.join(trial_dir, uuid)
+                if uuid in tried or not os.path.isdir(path):
+                    continue
+                try:
+                    with open(os.path.join(path, "metadata.json")) as f:
+                        steps = int(json.load(f).get("steps_completed") or 0)
+                except (OSError, ValueError, TypeError):
+                    continue
+                candidates.append((steps, uuid, path))
+        for steps, uuid, path in sorted(candidates, reverse=True):
+            if not verify:
+                return uuid
+            try:
+                verify_manifest(path, require_manifest=True)
+                logger.info(
+                    "resume: trial %d using on-disk checkpoint %s (step %d) "
+                    "found outside the journaled lineage",
+                    rid,
+                    uuid,
+                    steps,
+                )
+                return uuid
+            except CheckpointCorruptError:
+                continue
+        return None
+
+    @staticmethod
+    def _checkpoint_parent(path: str) -> Optional[str]:
+        from determined_tpu.core._checkpoint import MANIFEST_FILE, METADATA_FILE
+
+        for name, key in ((MANIFEST_FILE, "parent"), (METADATA_FILE, "parent_storage_id")):
+            try:
+                with open(os.path.join(path, name)) as f:
+                    parent = json.load(f).get(key)
+                if parent:
+                    return parent
+            except (OSError, ValueError):
+                continue
+        return None
+
+    # -- journal helpers ---------------------------------------------------
+
+    def _journal_trial_checkpoint(self, rid: int, sid: Optional[str]) -> None:
+        if self.journal is None or not sid:
+            return
+        with self._ckpt_lock:
+            if self._journaled_ckpts.get(rid) == sid:
+                return
+            self._journaled_ckpts[rid] = sid
+        self.journal.append("trial_checkpoint", rid=rid, uuid=sid)
+
+    def _schedule_gc_retention(self) -> None:
+        """Journal on_compact hook.  The hook can fire on a thread that
+        still holds the searcher lock (event append -> compaction), and
+        GC walks + deletes checkpoint trees — seconds of file I/O that
+        must not stall every other trial's searcher calls — so the pass
+        runs on its own short-lived thread; a pass still running when the
+        next compaction trips is simply not doubled up."""
+        t = self._gc_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(
+            target=self._apply_gc_retention, name="dtpu-exp-gc", daemon=True
+        )
+        self._gc_thread = t
+        t.start()
+
+    def _apply_gc_retention(self) -> None:
+        """Checkpoint GC at journal-compaction points: keep latest-per-
+        trial + top-k by searcher metric; parents of kept checkpoints,
+        journaled resume points, and manifest-less (possibly mid-write)
+        directories are never deleted."""
+        try:
+            if self.config.checkpoint_policy == "none":
+                return
+            from determined_tpu.exec import gc_checkpoints
+
+            scfg = self.config.searcher
+            store = self.config.checkpoint_storage
+            metric_by_trial: Dict[int, float] = {}
+            for rec in self.searcher.trial_records():
+                val = (rec.metrics or {}).get(scfg.metric)
+                if isinstance(val, (int, float)):
+                    metric_by_trial[rec.request_id] = float(val)
+            with self._ckpt_lock:
+                # the journal references these by uuid as resume points; a
+                # crash-resume must find them even when the per-trial
+                # count would rotate them out
+                protected = set(self._journaled_ckpts.values())
+            outcome = gc_checkpoints.apply_retention(
+                self.checkpoint_dir,
+                policy=gc_checkpoints.RetentionPolicy(
+                    keep_trial_latest=max(store.save_trial_latest, 1),
+                    keep_experiment_best=store.save_experiment_best,
+                    smaller_is_better=scfg.smaller_is_better,
+                ),
+                metric_by_trial=metric_by_trial,
+                protected=protected,
+            )
+            if outcome["deleted"]:
+                logger.info(
+                    "checkpoint gc: deleted %d, kept %d",
+                    len(outcome["deleted"]),
+                    len(outcome["kept"]),
+                )
+        except Exception:  # noqa: BLE001 - GC must never kill the search
+            logger.exception("checkpoint gc pass failed")
+
+    def _run_serial(self, max_trials: Optional[int] = None) -> None:
         """Sequential execution — the reference event order, and the parity
         oracle for the concurrent scheduler."""
         self.searcher.start()
         executed = 0
-        while self.searcher.shutdown is None:
+        while self.searcher.shutdown is None and not self._preempt.is_set():
             pending = [
                 t
                 for t in self.searcher.runnable_trials()
@@ -295,10 +738,16 @@ class LocalExperiment:
             result = self._run_trial(
                 Create(rec.request_id, rec.hparams), devices=self.devices
             )
+            if result.preempted:
+                # drained, not done: the trial stays in-flight, its
+                # checkpoint (None if no boundary was reached) is the
+                # resume point
+                with self._ckpt_lock:
+                    self._resume_checkpoints[rec.request_id] = result.checkpoint
+                break
             self.results[rec.request_id] = result
             executed += 1
             self.searcher.on_trial_exited(rec.request_id)
-        return self.summary()
 
     def _run_concurrent(
         self,
@@ -306,7 +755,7 @@ class LocalExperiment:
         devices: List[Any],
         slots: int,
         concurrency: int,
-    ) -> Dict[str, Any]:
+    ) -> None:
         from determined_tpu.experiment.scheduler import SlotPool, TrialScheduler
 
         logger.info(
@@ -321,17 +770,22 @@ class LocalExperiment:
             self._run_trial,
             slots_per_trial=slots,
             max_concurrent=concurrency,
+            stop_event=self._preempt,
+            drain_timeout=self.config.fault_tolerance.preempt_drain_seconds,
         )
         outcome = scheduler.run(max_trials=max_trials)
         self.results.update(outcome.results)
         self.scheduler_stats = outcome.stats
+        with self._ckpt_lock:
+            for rid, res in outcome.preempted.items():
+                if res is not None:
+                    self._resume_checkpoints[rid] = res.checkpoint
         if outcome.errors:
             rid, exc = outcome.errors[0]
             # original exception type, same as the serial path (callers
             # classifying failures must not see a mode-dependent wrapper)
             logger.error("trial %d failed during concurrent search", rid)
             raise exc
-        return self.summary()
 
     def summary(self) -> Dict[str, Any]:
         scfg = self.config.searcher
@@ -353,7 +807,12 @@ class LocalExperiment:
             "best_metrics": best.metrics if best else None,
             "total_steps": sum(r.steps_completed for r in self.results.values()),
             "progress": self.searcher.progress(),
+            "status": self.status,
+            "resumable": self.status == "preempted",
         }
+        if self.status == "preempted":
+            with self._ckpt_lock:
+                out["in_flight"] = sorted(self._resume_checkpoints)
         if self.scheduler_stats is not None:
             out["scheduler"] = dict(self.scheduler_stats)
         return out

@@ -152,6 +152,10 @@ class SchedulerOutcome:
     results: Dict[int, Any]
     errors: List[Tuple[int, BaseException]]
     stats: Dict[str, Any]
+    # trials that exited because the experiment is draining for preemption:
+    # rid -> the (partial) result carrying the resume checkpoint.  Never in
+    # ``results`` — they are unfinished work, not outcomes.
+    preempted: Dict[int, Any] = dataclasses.field(default_factory=dict)
 
 
 class TrialScheduler:
@@ -178,6 +182,8 @@ class TrialScheduler:
         slots_per_trial: int,
         max_concurrent: int,
         poll_interval: float = 0.05,
+        stop_event: Optional[threading.Event] = None,
+        drain_timeout: Optional[float] = None,
     ) -> None:
         if slots_per_trial < 1:
             raise ValueError("slots_per_trial must be >= 1")
@@ -194,10 +200,19 @@ class TrialScheduler:
             1, min(max_concurrent, pool.capacity // slots_per_trial)
         )
         self.poll_interval = poll_interval
+        # graceful preemption: when ``stop_event`` is set, dispatch halts
+        # and the scheduler waits up to ``drain_timeout`` seconds for the
+        # running trials to checkpoint-and-exit before abandoning them
+        self.stop_event = stop_event
+        self.drain_timeout = drain_timeout
         self.results: Dict[int, Any] = {}
         self.errors: List[Tuple[int, BaseException]] = []
+        self.preempted: Dict[int, Any] = {}
         self._errored: set = set()
         self._done: "queue.Queue[int]" = queue.Queue()
+
+    def _stopping(self) -> bool:
+        return self.stop_event is not None and self.stop_event.is_set()
 
     # -- worker ------------------------------------------------------------
 
@@ -239,12 +254,37 @@ class TrialScheduler:
         completed = 0
         backfills = 0
         peak_concurrency = 0
+        abandoned: List[int] = []
+        drain_deadline: Optional[float] = None
         t0 = time.monotonic()
 
+        def absorb_completion(rid: int) -> None:
+            nonlocal completed
+            thread, alloc = running.pop(rid)
+            thread.join()
+            # release BEFORE the searcher exit event: replacement creates
+            # the event produces can immediately take the freed block
+            self.pool.release(alloc)
+            completed += 1
+            if rid in self._errored:
+                self.searcher.on_trial_exited_early(rid, ExitedReason.ERRORED)
+            elif getattr(self.results.get(rid), "preempted", False):
+                # drained for preemption, not finished: no searcher exit
+                # event (the trial is still logically in-flight and resumes
+                # next run); move it out of results.  Safe unlocked: the
+                # worker wrote results[rid] before `_done.put`, and this
+                # pop runs only after `_done.get()` + `join()` on that
+                # thread — the queue handoff is the happens-before.
+                self.preempted[rid] = self.results.pop(rid)  # dtpu: lint-ok[unlocked-shared-state]
+            else:
+                self.searcher.on_trial_exited(rid)
+
         while True:
+            if self._stopping() and drain_deadline is None and self.drain_timeout is not None:
+                drain_deadline = time.monotonic() + self.drain_timeout
             # ---- dispatch: fill every free gang slot -----------------------
             dispatch_blocked = False
-            if not self.errors and self.searcher.shutdown is None:
+            if not self.errors and self.searcher.shutdown is None and not self._stopping():
                 for rec in self._dispatchable(scheduled):
                     if len(running) >= self.max_concurrent:
                         break
@@ -289,26 +329,40 @@ class TrialScheduler:
                     )
                 break
 
+            if drain_deadline is not None and time.monotonic() >= drain_deadline:
+                # absorb completions already sitting in the queue before
+                # declaring abandonment — a trial that finished but wasn't
+                # popped yet is done, not abandoned, and its (possibly
+                # preempted) result must be classified normally
+                while True:
+                    try:
+                        absorb_completion(self._done.get_nowait())
+                    except queue.Empty:
+                        break
+                if not running:
+                    break
+                # drain deadline blown: abandon what's still running (the
+                # worker threads are daemons) and surface which trials lost
+                # their checkpoint-on-preempt window
+                abandoned = sorted(running)
+                logger.warning(
+                    "preemption drain deadline exceeded; abandoning trials %s",
+                    abandoned,
+                )
+                break
+
             # ---- wait for a completion (short poll so creates that arrive
             # mid-validation while a gang sits free still dispatch promptly)
             try:
                 rid = self._done.get(timeout=self.poll_interval)
             except queue.Empty:
                 continue
-            thread, alloc = running.pop(rid)
-            thread.join()
-            # release BEFORE the searcher exit event: replacement creates
-            # the event produces can immediately take the freed block
-            self.pool.release(alloc)
-            completed += 1
-            if rid in self._errored:
-                self.searcher.on_trial_exited_early(rid, ExitedReason.ERRORED)
-            else:
-                self.searcher.on_trial_exited(rid)
+            absorb_completion(rid)
 
         return SchedulerOutcome(
             results=self.results,
             errors=self.errors,
+            preempted=self.preempted,
             stats={
                 "launched": launched,
                 "completed": completed,
@@ -317,6 +371,8 @@ class TrialScheduler:
                 "max_concurrent": self.max_concurrent,
                 "slots_per_trial": self.slots_per_trial,
                 "pool_capacity": self.pool.capacity,
+                "preempted": len(self.preempted),
+                "abandoned": abandoned,
                 "wall_clock_s": time.monotonic() - t0,
             },
         )

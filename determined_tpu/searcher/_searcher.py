@@ -95,6 +95,7 @@ class Searcher:
         self.trials: Dict[RequestID, TrialRecord] = {}
         self.shutdown: Optional[Shutdown] = None
         self._trial_progress: Dict[RequestID, float] = {}
+        self._started = False
         # RLock: _absorb recurses through trial_created events
         self._lock = threading.RLock()
 
@@ -120,6 +121,12 @@ class Searcher:
 
     def start(self) -> List[Action]:
         with self._lock:
+            if self._started:
+                # a restored (or restarted) searcher must not re-run
+                # initial_trials: the creates it would emit already exist,
+                # and the duplicate draws would burn request ids / rng state
+                return []
+            self._started = True
             return self._absorb(self.method.initial_trials(self.ctx))
 
     def on_validation(
@@ -165,6 +172,13 @@ class Searcher:
         with self._lock:
             return [t for t in self.trials.values() if t.running and not t.exited]
 
+    def trial_records(self) -> List[TrialRecord]:
+        """Locked snapshot of ALL trial records (e.g. for GC metric
+        ranking); iterating ``self.trials`` directly races concurrent
+        creates."""
+        with self._lock:
+            return list(self.trials.values())
+
     def is_stopped(self, request_id: RequestID) -> bool:
         """Whether the method has asked this trial to stop early."""
         with self._lock:
@@ -182,6 +196,7 @@ class Searcher:
             {
                 "method": self.method.state_dict(),
                 "ctx": self.ctx.state_dict(),
+                "started": self._started,
                 "trials": {
                     str(rid): dataclasses.asdict(t) for rid, t in self.trials.items()
                 },
@@ -203,6 +218,9 @@ class Searcher:
         self.method.load_state_dict(state["method"])
         if "ctx" in state:
             self.ctx.load_state_dict(state["ctx"])
+        # any snapshot implies the search had started (older snapshots
+        # predate the flag)
+        self._started = bool(state.get("started", True))
         self.trials = {
             int(rid): TrialRecord(**t) for rid, t in state["trials"].items()
         }

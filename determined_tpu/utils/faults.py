@@ -24,6 +24,12 @@ glob-match):
 - ``api.request``         before each master HTTP request (``method=, path=``)
 - ``distributed.gather`` / ``distributed.allgather`` / ``distributed.broadcast``
                           before each control-plane collective (``rank=``)
+- ``experiment.journal.append``
+                          before each experiment-journal record lands
+                          (``type=, seq=``); a raise here kills the
+                          EXPERIMENT DRIVER at the worst moment — the
+                          event happened but the WAL never saw it —
+                          exercising journal replay + searcher restore
 """
 
 from __future__ import annotations

@@ -120,6 +120,29 @@ class FaultInjector:
             when=lambda info: info.get("step", -1) >= step,
         )
 
+    def kill_driver_at_journal_event(
+        self, rec_type: str, occurrence: int = 1
+    ) -> _Rule:
+        """Crash the EXPERIMENT DRIVER at the ``occurrence``-th journal
+        append of ``rec_type`` — before the record lands, so the WAL never
+        sees the event (the worst-case crash point for resume)."""
+        seen = {"n": 0}
+
+        def when(info: Dict[str, Any]) -> bool:
+            if info.get("type") != rec_type:
+                return False
+            seen["n"] += 1
+            return seen["n"] == occurrence
+
+        return self.raise_at(
+            "experiment.journal.append",
+            lambda: SimulatedCrash(
+                f"injected driver kill at journal event {rec_type}#{occurrence}"
+            ),
+            times=1,
+            when=when,
+        )
+
     def fail_storage_puts(self, n: int) -> _Rule:
         """The next ``n`` storage uploads raise (transient blob-store 5xx)."""
         return self.raise_at(

@@ -1,5 +1,8 @@
-"""CLI tests against a live devcluster (reference: harness/tests/cli)."""
+"""CLI tests: journal-backed local experiment recovery (no master), plus
+lifecycle tests against a live devcluster (reference: harness/tests/cli).
+"""
 
+import json
 import os
 
 import pytest
@@ -15,7 +18,9 @@ from tests.test_devcluster import (  # noqa: F401  (fixture reuse)
     exp_config,
 )
 
-pytestmark = pytest.mark.skipif(
+# only the devcluster-backed tests need the native binaries; the local
+# experiment status/resume subcommands run masterless
+needs_cluster = pytest.mark.skipif(
     not (os.path.exists(MASTER_BIN) and os.path.exists(AGENT_BIN)),
     reason="native binaries not built",
 )
@@ -25,6 +30,90 @@ def run_cli(*argv) -> int:
     return cli_main(list(argv))
 
 
+# ---- local experiment recovery (journal-backed; no master) -----------------
+
+
+def _single_search_config():
+    from determined_tpu.config import ExperimentConfig
+
+    return ExperimentConfig.parse(
+        {
+            "name": "cli-recovery",
+            "hyperparameters": {
+                "lr": 0.01,
+                "hidden": 8,
+                "global_batch_size": 16,
+                "dataset_size": 64,
+            },
+            "searcher": {
+                "name": "single",
+                "metric": "validation_accuracy",
+                "smaller_is_better": False,
+                "max_length": {"batches": 4},
+            },
+            "resources": {"mesh": {"data": 1}},
+            "min_validation_period": {"batches": 2},
+            "min_checkpoint_period": {"batches": 2},
+            "optimizations": {"async_checkpointing": False},
+        }
+    )
+
+
+def test_cli_experiment_status_and_resume(tmp_path, capsys):
+    from determined_tpu.experiment import LocalExperiment
+    from determined_tpu.models.mnist import MnistTrial
+    from tests.faults import FaultInjector, SimulatedCrash
+
+    ckpt_dir = str(tmp_path / "ck")
+    cfg = _single_search_config()
+    inj = FaultInjector()
+    inj.kill_driver_at_journal_event("trial_validated", occurrence=1)
+    with inj.installed():
+        with pytest.raises(SimulatedCrash):
+            LocalExperiment(cfg, MnistTrial, checkpoint_dir=ckpt_dir).run(serial=True)
+    capsys.readouterr()
+
+    # status: text then json, both reporting the in-flight trial
+    assert run_cli("experiment", "status", ckpt_dir) == 0
+    out = capsys.readouterr().out
+    assert "cli-recovery" in out and "running" in out and "in flight" in out
+
+    assert run_cli("experiment", "status", ckpt_dir, "--json") == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["status"] == "running" and st["resumable"]
+    assert st["trials_in_flight"] == 1
+    assert st["entrypoint"] == "determined_tpu.models.mnist:MnistTrial"
+
+    # resume rebuilds config + trial class from the journal alone
+    assert run_cli("experiment", "resume", ckpt_dir, "--serial") == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["status"] == "completed" and summary["trials"] == 1
+
+    assert run_cli("experiment", "status", ckpt_dir, "--json") == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["status"] == "completed" and not st["resumable"]
+    assert st["trials"][0]["state"] == "completed"
+    assert st["trials"][0]["checkpoint"]
+
+    # resuming a completed experiment is a no-op, not an error
+    assert run_cli("experiment", "resume", ckpt_dir) == 0
+    assert "already completed" in capsys.readouterr().out
+
+
+def test_cli_experiment_status_without_journal(tmp_path, capsys):
+    assert run_cli("experiment", "status", str(tmp_path / "empty")) == 2
+    assert "no experiment journal" in capsys.readouterr().err
+
+
+def test_cli_experiment_resume_without_journal(tmp_path, capsys):
+    assert run_cli("experiment", "resume", str(tmp_path / "empty")) == 2
+    assert "no experiment journal" in capsys.readouterr().err
+
+
+# ---- devcluster-backed lifecycle -------------------------------------------
+
+
+@needs_cluster
 def test_cli_experiment_lifecycle(cluster, tmp_path, capsys):
     cfg_path = tmp_path / "exp.yaml"
     cfg_path.write_text(yaml.safe_dump(exp_config(cluster.ckpt_dir)))
@@ -54,6 +143,7 @@ def test_cli_experiment_lifecycle(cluster, tmp_path, capsys):
     assert "UUID" in capsys.readouterr().out
 
 
+@needs_cluster
 def test_cli_preview_search(tmp_path, capsys):
     cfg = {
         "hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -1}},

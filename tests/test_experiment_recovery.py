@@ -1,0 +1,562 @@
+"""Experiment-level crash recovery: journal WAL, driver-kill resume,
+graceful preemption drain (docs/fault-tolerance.md, "Experiment recovery
+& preemption").
+
+Layers:
+
+1. ``ExperimentJournal`` unit behavior — append/replay round-trip,
+   truncated-tail tolerance, atomic compaction.
+2. ``TrialScheduler`` drain semantics with synthetic trial bodies.
+3. End-to-end ``LocalExperiment``: a deterministic driver kill (injected
+   at the journal fault site) mid-ASHA-search, then ``resume()`` completes
+   the SAME trial set as an uninterrupted run with no trial re-trained
+   from step 0 when a verified checkpoint existed; SIGTERM on a running
+   experiment drains in-flight trials to checkpoints and exits resumable.
+4. A ``slow`` SIGKILL variant that kills a real driver subprocess and
+   resumes it through the CLI entry.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.no_thread_leaks
+
+from determined_tpu.config import ExperimentConfig
+from determined_tpu.experiment import (
+    ExperimentJournal,
+    ExperimentJournalError,
+    LocalExperiment,
+    SlotPool,
+    TrialScheduler,
+    experiment_status,
+    journal_path,
+    read_journal,
+)
+from determined_tpu.models.mnist import MnistTrial
+from determined_tpu.searcher import Searcher, method_from_config
+from tests.faults import FaultInjector, SimulatedCrash
+
+
+def asha_config(**overrides):
+    raw = {
+        "name": "recovery-test",
+        "hyperparameters": {
+            "lr": {"type": "log", "minval": -3, "maxval": -1},
+            "hidden": 8,
+            "global_batch_size": 16,
+            "dataset_size": 64,
+        },
+        "searcher": {
+            "name": "asha",
+            "metric": "validation_accuracy",
+            "smaller_is_better": False,
+            "max_trials": 3,
+            "max_length": {"batches": 8},
+            "num_rungs": 2,
+            "divisor": 4,
+            "max_concurrent_trials": 2,
+        },
+        "resources": {"mesh": {"data": 1}},
+        "min_validation_period": {"batches": 2},
+        "min_checkpoint_period": {"batches": 2},
+        # sync saves: every boundary leaves a durable resume point
+        "optimizations": {"async_checkpointing": False},
+    }
+    raw.update(overrides)
+    return ExperimentConfig.parse(raw)
+
+
+# ---------------------------------------------------------------------------
+# ExperimentJournal unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_journal_append_replay_roundtrip(tmp_path):
+    path = str(tmp_path / "experiment.journal")
+    j = ExperimentJournal(path).open(fresh=True)
+    j.append("experiment_started", name="x", entrypoint="m:C", config={"a": 1}, seed=3)
+    j.append("trial_created", rid=1, hparams={"lr": 0.1})
+    j.append("searcher_snapshot", state={"method": {}, "started": True})
+    j.append("trial_checkpoint", rid=1, uuid="u-old")
+    j.append("trial_checkpoint", rid=1, uuid="u-new")
+    j.append("trial_result", rid=1, result={"steps_completed": 8, "checkpoint": "u-new"})
+    j.close()
+
+    replay = read_journal(path)
+    assert replay.started["name"] == "x"
+    assert replay.started["seed"] == 3
+    assert replay.searcher_state == {"method": {}, "started": True}
+    assert replay.created == {1: {"lr": 0.1}}
+    assert replay.checkpoints == {1: "u-new"}  # latest wins
+    assert replay.results[1]["steps_completed"] == 8
+    assert replay.status == "running"
+    assert replay.in_flight == []
+
+
+def test_journal_tolerates_truncated_tail(tmp_path):
+    path = str(tmp_path / "experiment.journal")
+    j = ExperimentJournal(path).open(fresh=True)
+    j.append("experiment_started", name="x")
+    j.append("searcher_snapshot", state={"s": 1})
+    j.append("trial_validated", rid=2, metrics={"loss": 1.0})
+    j.close()
+    # a crash mid-write leaves a partial final line
+    with open(path, "a") as f:
+        f.write('{"v": 1, "seq": 4, "type": "trial_exi')
+
+    replay = read_journal(path)
+    assert replay.searcher_state == {"s": 1}
+    # the validated event after the snapshot is surfaced for redelivery
+    assert [e["type"] for e in replay.tail_events] == ["trial_validated"]
+
+
+def test_journal_missing_raises(tmp_path):
+    with pytest.raises(ExperimentJournalError):
+        read_journal(str(tmp_path / "nope.journal"))
+
+
+def test_journal_reopen_repairs_partial_trailing_line(tmp_path):
+    """Appending after a crash-truncated line must not merge two records
+    into one unparseable line mid-file (which would poison every read of
+    the records that follow it)."""
+    path = str(tmp_path / "experiment.journal")
+    j = ExperimentJournal(path).open(fresh=True)
+    j.append("experiment_started", name="x")
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"v": 1, "seq": 2, "type": "trial_cre')  # no newline
+
+    j2 = ExperimentJournal(path).open(fresh=False)
+    j2.append("trial_result", rid=1, result={"steps_completed": 4})
+    j2.append("experiment_completed")
+    j2.close()
+    replay = read_journal(path)
+    assert replay.started["name"] == "x"
+    assert replay.results[1]["steps_completed"] == 4
+    assert replay.status == "completed"
+
+
+def test_journal_owner_lock_blocks_second_live_driver(tmp_path):
+    """Resuming a directory whose driver is still alive must fail loudly,
+    not interleave two drivers into one WAL; the flock is released by the
+    kernel the instant the owner dies (the SIGKILLed-driver case), so a
+    dead owner's lock never blocks a resume."""
+    import subprocess as sp
+
+    path = str(tmp_path / "experiment.journal")
+    j = ExperimentJournal(path).open(fresh=True)
+    j.append("experiment_started", name="x")
+    j.close()
+    # a live driver in another process holds the flock
+    holder = sp.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import fcntl, os, sys, time\n"
+            f"fd = os.open({path + '.lock'!r}, os.O_CREAT | os.O_RDWR)\n"
+            "fcntl.flock(fd, fcntl.LOCK_EX)\n"
+            "print('locked', flush=True)\n"
+            "time.sleep(60)\n",
+        ],
+        stdout=sp.PIPE,
+        text=True,
+    )
+    try:
+        assert holder.stdout.readline().strip() == "locked"
+        with pytest.raises(ExperimentJournalError):
+            ExperimentJournal(path).open(fresh=False)
+    finally:
+        holder.kill()
+        holder.wait()
+    # owner dead -> kernel released the lock; resume proceeds
+    j2 = ExperimentJournal(path).open(fresh=False)
+    j2.append("experiment_completed")
+    j2.close()
+    assert read_journal(path).status == "completed"
+
+
+def test_journal_compaction_preserves_state_and_fires_hook(tmp_path):
+    path = str(tmp_path / "experiment.journal")
+    hooks = []
+    j = ExperimentJournal(path, compact_interval=8, on_compact=lambda: hooks.append(1))
+    j.open(fresh=True)
+    j.append("experiment_started", name="x", seed=0)
+    for i in range(12):
+        j.append("trial_validated", rid=1, metrics={"loss": float(i)})
+        j.append("searcher_snapshot", state={"i": i})
+    j.append("trial_result", rid=1, result={"steps_completed": 12})
+    j.append("trial_checkpoint", rid=2, uuid="u2")
+    j.close()
+
+    assert hooks, "compaction hook never fired"
+    records = read_journal(path).records
+    # compacted well below the raw append count, nothing essential lost
+    assert len(records) < 12
+    replay = read_journal(path)
+    assert replay.started["name"] == "x"
+    assert replay.results[1]["steps_completed"] == 12
+    assert replay.checkpoints[2] == "u2"
+    assert replay.searcher_state is not None
+
+
+def test_journal_reopen_appends_preserve_history(tmp_path):
+    path = str(tmp_path / "experiment.journal")
+    j = ExperimentJournal(path).open(fresh=True)
+    j.append("experiment_started", name="x")
+    j.append("trial_result", rid=1, result={"steps_completed": 4})
+    j.close()
+    # resumed run appends to the same file; compaction must keep the
+    # replayed history it never saw appended
+    j2 = ExperimentJournal(path, compact_interval=2).open(fresh=False)
+    j2.append("trial_result", rid=2, result={"steps_completed": 4})
+    j2.append("experiment_completed")
+    j2.close()
+    replay = read_journal(path)
+    assert set(replay.results) == {1, 2}
+    assert replay.started["name"] == "x"
+    assert replay.status == "completed"
+
+
+# ---------------------------------------------------------------------------
+# Scheduler drain semantics (synthetic trials, no jax)
+# ---------------------------------------------------------------------------
+
+
+class _SyntheticResult:
+    def __init__(self, rid, preempted, checkpoint=None):
+        self.request_id = rid
+        self.preempted = preempted
+        self.checkpoint = checkpoint
+
+
+def test_scheduler_stop_event_stops_dispatch_and_suppresses_exit_events():
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": {"lr": 0.1},
+            "searcher": {
+                "name": "random", "metric": "loss", "max_trials": 6,
+                "max_concurrent_trials": 2,
+            },
+        }
+    )
+    searcher = Searcher(
+        method_from_config(cfg.searcher, cfg.hyperparameters), cfg.hyperparameters
+    )
+    stop = threading.Event()
+    started = []
+
+    def run_trial(create, devices):
+        started.append(create.request_id)
+        if len(started) >= 2:
+            stop.set()  # preemption lands while both gangs are busy
+        # trials notice the flag at their next boundary and drain
+        time.sleep(0.05)
+        return _SyntheticResult(
+            create.request_id, preempted=stop.is_set(), checkpoint=f"ck-{create.request_id}"
+        )
+
+    sched = TrialScheduler(
+        searcher,
+        SlotPool(list(range(4))),
+        run_trial,
+        slots_per_trial=2,
+        max_concurrent=2,
+        stop_event=stop,
+        drain_timeout=30.0,
+    )
+    outcome = sched.run()
+    # nothing dispatched after the stop; drained trials are NOT results and
+    # their searcher records stay in-flight (no exit events delivered)
+    assert set(started) == set(outcome.preempted) | set(outcome.results)
+    assert outcome.preempted, "expected drained trials"
+    for rid in outcome.preempted:
+        assert searcher.trials[rid].running and not searcher.trials[rid].exited
+    assert outcome.stats["preempted"] == len(outcome.preempted)
+    assert outcome.stats["abandoned"] == []
+    assert len(started) <= 4  # initial fill only, never the full search
+
+
+def test_scheduler_drain_deadline_abandons_stuck_trials():
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": {"lr": 0.1},
+            "searcher": {
+                "name": "random", "metric": "loss", "max_trials": 2,
+                "max_concurrent_trials": 1,
+            },
+        }
+    )
+    searcher = Searcher(
+        method_from_config(cfg.searcher, cfg.hyperparameters), cfg.hyperparameters
+    )
+    stop = threading.Event()
+    release = threading.Event()
+
+    def run_trial(create, devices):
+        stop.set()
+        # a trial that never reaches its checkpoint boundary
+        release.wait(timeout=30)
+        return _SyntheticResult(create.request_id, preempted=True)
+
+    sched = TrialScheduler(
+        searcher,
+        SlotPool([0]),
+        run_trial,
+        slots_per_trial=1,
+        max_concurrent=1,
+        stop_event=stop,
+        drain_timeout=0.2,
+    )
+    try:
+        outcome = sched.run()
+        assert outcome.stats["abandoned"], "deadline should abandon the stuck trial"
+        assert not outcome.results
+    finally:
+        release.set()  # let the worker thread exit (leak guard)
+        time.sleep(0.1)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: driver kill -> resume (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+
+def _completed_steps(exp):
+    return {rid: r.steps_completed for rid, r in exp.results.items()}
+
+
+def test_driver_crash_resume_completes_same_trial_set(tmp_path):
+    """Kill the driver mid-ASHA-search at the journal fault site, resume,
+    and require: same completed request-id set as an uninterrupted run,
+    the in-flight trial resumed from its verified checkpoint (not step 0),
+    and no duplicate request ids."""
+    cfg = asha_config()
+
+    oracle = LocalExperiment(cfg, MnistTrial, checkpoint_dir=str(tmp_path / "oracle"))
+    oracle_summary = oracle.run(serial=True)
+    assert oracle_summary["status"] == "completed"
+
+    crash_dir = str(tmp_path / "crashed")
+    inj = FaultInjector()
+    # the 4th validation report: trial 1 completed, trial 2 mid-flight
+    # with at least one durable checkpoint behind it
+    inj.kill_driver_at_journal_event("trial_validated", occurrence=4)
+    exp = LocalExperiment(cfg, MnistTrial, checkpoint_dir=crash_dir)
+    with inj.installed():
+        with pytest.raises(SimulatedCrash):
+            exp.run(serial=True)
+
+    st = experiment_status(crash_dir)
+    assert st["status"] == "running"  # no terminal record: resumable
+    assert st["resumable"]
+    assert st["trials_in_flight"] >= 1
+
+    resumed = LocalExperiment(cfg, MnistTrial, checkpoint_dir=crash_dir)
+    summary = resumed.resume(serial=True)
+
+    assert summary["status"] == "completed"
+    assert sorted(resumed.results) == sorted(oracle.results)
+    assert _completed_steps(resumed) == _completed_steps(oracle)
+    # the in-flight trial had a verified checkpoint: the resume MUST have
+    # used it rather than retraining from step 0 (the journal's
+    # trial_running records carry the resume point each launch used)
+    records = read_journal(journal_path(crash_dir)).records
+    resumed_runs = [
+        r
+        for r in records
+        if r.get("type") == "trial_running" and r.get("resume_checkpoint")
+    ]
+    assert resumed_runs, "no trial was relaunched from a verified checkpoint"
+    for r in resumed_runs:
+        ckpts = [
+            c
+            for c in records
+            if c.get("type") == "trial_checkpoint" and c["rid"] == r["rid"]
+        ]
+        assert any(c["uuid"] == r["resume_checkpoint"] for c in ckpts)
+    # request ids are never reused across the crash/resume boundary
+    created = [r["rid"] for r in records if r.get("type") == "trial_created"]
+    assert len(created) == len(set(created))
+    assert experiment_status(crash_dir)["status"] == "completed"
+
+
+def test_resume_falls_back_to_on_disk_checkpoint_when_journaled_uuid_gone(tmp_path):
+    """The journal only records validation-boundary saves; if the
+    journaled uuid is gone (GC rotation) the resume must scan the trial
+    dir for the newest verified checkpoint instead of retraining from
+    step 0."""
+    import shutil
+
+    cfg = asha_config()
+    crash_dir = str(tmp_path / "ck")
+    inj = FaultInjector()
+    inj.kill_driver_at_journal_event("trial_validated", occurrence=4)
+    exp = LocalExperiment(cfg, MnistTrial, checkpoint_dir=crash_dir)
+    with inj.installed():
+        with pytest.raises(SimulatedCrash):
+            exp.run(serial=True)
+
+    replay = read_journal(journal_path(crash_dir))
+    assert replay.checkpoints, "precondition: a checkpoint was journaled"
+    # simulate GC having rotated the journaled uuid out: the newer
+    # unjournaled saves remain on disk
+    victims = 0
+    for rid, sid in replay.checkpoints.items():
+        path = os.path.join(crash_dir, f"trial_{rid}", sid)
+        if os.path.isdir(path):
+            others = [
+                u
+                for u in os.listdir(os.path.dirname(path))
+                if u != sid and os.path.isdir(os.path.join(os.path.dirname(path), u))
+            ]
+            if others:
+                shutil.rmtree(path)
+                victims += 1
+    if not victims:
+        pytest.skip("crash landed before a second checkpoint existed")
+
+    resumed = LocalExperiment(cfg, MnistTrial, checkpoint_dir=crash_dir)
+    summary = resumed.resume(serial=True)
+    assert summary["status"] == "completed"
+    resumed_runs = [
+        r
+        for r in read_journal(journal_path(crash_dir)).records
+        if r.get("type") == "trial_running" and r.get("resume_checkpoint")
+    ]
+    assert resumed_runs, (
+        "resume should have found an on-disk checkpoint outside the "
+        "journaled lineage"
+    )
+
+
+def test_crash_before_any_checkpoint_restarts_trial_from_scratch(tmp_path):
+    """With no durable checkpoint yet, the in-flight trial re-queues from
+    scratch — resume still completes the search."""
+    cfg = asha_config()
+    inj = FaultInjector()
+    inj.kill_driver_at_journal_event("trial_validated", occurrence=1)
+    exp = LocalExperiment(cfg, MnistTrial, checkpoint_dir=str(tmp_path / "ck"))
+    with inj.installed():
+        with pytest.raises(SimulatedCrash):
+            exp.run(serial=True)
+
+    resumed = LocalExperiment(cfg, MnistTrial, checkpoint_dir=str(tmp_path / "ck"))
+    summary = resumed.resume(serial=True)
+    assert summary["status"] == "completed"
+    assert len(resumed.results) >= cfg.searcher.max_trials
+
+
+# ---------------------------------------------------------------------------
+# Graceful preemption drain
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_drains_to_checkpoint_and_resumes(tmp_path):
+    """request_preemption mid-trial: the in-flight trial checkpoints at
+    its next boundary, the run exits "preempted, resumable", and a resume
+    finishes the search from that checkpoint."""
+    cfg = asha_config()
+    ckpt_dir = str(tmp_path / "ck")
+    exp = LocalExperiment(cfg, MnistTrial, checkpoint_dir=ckpt_dir)
+    inj = FaultInjector()
+    fired = []
+
+    def preempt(info):
+        if not fired and info.get("step", 0) >= 3:
+            fired.append(info["step"])
+            exp.request_preemption()
+
+    inj.on("train.step", preempt, times=None)
+    with inj.installed():
+        summary = exp.run(serial=True)
+
+    assert summary["status"] == "preempted"
+    assert summary["resumable"]
+    assert exp._resume_checkpoints, "drain must leave a checkpointed resume point"
+    st = experiment_status(ckpt_dir)
+    assert st["status"] == "preempted" and st["resumable"]
+
+    resumed = LocalExperiment(cfg, MnistTrial, checkpoint_dir=ckpt_dir)
+    summary2 = resumed.resume(serial=True)
+    assert summary2["status"] == "completed"
+    assert len(resumed.results) >= cfg.searcher.max_trials
+
+
+def test_sigterm_triggers_graceful_drain(tmp_path):
+    """A real SIGTERM at the process (what a TPU maintenance event
+    delivers) lands in the experiment's chained handler and drains the
+    search instead of killing it."""
+    cfg = asha_config()
+    exp = LocalExperiment(cfg, MnistTrial, checkpoint_dir=str(tmp_path / "ck"))
+    inj = FaultInjector()
+    sent = []
+
+    def send_sigterm(info):
+        if not sent and info.get("step", 0) >= 3:
+            sent.append(info["step"])
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    prev = signal.getsignal(signal.SIGTERM)
+    inj.on("train.step", send_sigterm, times=None)
+    with inj.installed():
+        summary = exp.run(serial=True)
+    assert summary["status"] == "preempted"
+    assert sent, "injector never delivered the signal"
+    # handlers restored on exit
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL chaos (real process death; slow)
+# ---------------------------------------------------------------------------
+
+_CHILD = os.path.join(os.path.dirname(__file__), "..", "scripts", "chaos_experiment.py")
+
+
+@pytest.mark.slow
+def test_sigkill_driver_and_resume_subprocess(tmp_path):
+    """SIGKILL an actual driver process mid-search, then resume it in a
+    fresh process; the search must complete with no duplicate request ids
+    (the full chaos loop lives in scripts/chaos_experiment.py)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ckpt_dir = str(tmp_path / "ck")
+    proc = subprocess.Popen(
+        [sys.executable, _CHILD, "--child", "--checkpoint-dir", ckpt_dir],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    # let it get through startup + at least one checkpoint, then SIGKILL
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            pytest.fail("driver finished before the kill window")
+        if os.path.exists(journal_path(ckpt_dir)):
+            try:
+                if read_journal(journal_path(ckpt_dir)).checkpoints:
+                    break
+            except ExperimentJournalError:
+                pass
+        time.sleep(0.5)
+    proc.kill()
+    proc.wait()
+
+    rc = subprocess.run(
+        [sys.executable, _CHILD, "--child", "--checkpoint-dir", ckpt_dir, "--resume"],
+        env=env,
+        timeout=300,
+    ).returncode
+    assert rc == 0
+    replay = read_journal(journal_path(ckpt_dir))
+    assert replay.status == "completed"
+    created = [
+        r["rid"] for r in replay.records if r.get("type") == "trial_created"
+    ]
+    assert len(created) == len(set(created))

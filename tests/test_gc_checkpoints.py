@@ -1,0 +1,138 @@
+"""Checkpoint GC: retention policy planning + filesystem application +
+the agent-dispatched deletion task body (first coverage for the module).
+"""
+
+import json
+import os
+
+import pytest
+
+from determined_tpu.exec import gc_checkpoints
+from determined_tpu.exec.gc_checkpoints import (
+    CheckpointInfo,
+    RetentionPolicy,
+    apply_retention,
+    plan_retention,
+    scan_experiment_checkpoints,
+)
+
+
+def ci(uuid, trial, steps, parent=None, manifest=True):
+    return CheckpointInfo(
+        uuid=uuid, trial_id=trial, steps_completed=steps, parent=parent,
+        has_manifest=manifest,
+    )
+
+
+def test_plan_keeps_latest_per_trial():
+    cks = [ci("a1", 1, 4), ci("a2", 1, 8), ci("b1", 2, 4)]
+    keep, delete = plan_retention(cks, RetentionPolicy(keep_trial_latest=1))
+    assert keep == {"a2", "b1"}
+    assert delete == {"a1"}
+
+
+def test_plan_keeps_n_latest_per_trial():
+    cks = [ci("a1", 1, 2), ci("a2", 1, 4), ci("a3", 1, 8)]
+    keep, _ = plan_retention(cks, RetentionPolicy(keep_trial_latest=2))
+    assert keep == {"a2", "a3"}
+
+
+def test_plan_protects_manifest_referenced_parent():
+    """The kept checkpoint's lineage parent is its verified-resume
+    fallback: it survives even when the per-trial count would drop it."""
+    cks = [ci("a1", 1, 2), ci("a2", 1, 4, parent="a1"), ci("a3", 1, 8, parent="a2")]
+    keep, delete = plan_retention(cks, RetentionPolicy(keep_trial_latest=1))
+    assert keep == {"a3", "a2"}  # a2 protected as a3's parent
+    assert delete == {"a1"}
+
+
+def test_plan_never_deletes_manifestless_dirs():
+    """No manifest = finalize may still be in flight; deleting would race
+    a live upload."""
+    cks = [ci("a1", 1, 2, manifest=False), ci("a2", 1, 8)]
+    keep, delete = plan_retention(cks, RetentionPolicy(keep_trial_latest=1))
+    assert "a1" in keep and not delete
+
+
+def test_plan_keeps_experiment_best_by_metric():
+    cks = [ci("a1", 1, 8), ci("b1", 2, 8), ci("c1", 3, 8), ci("c0", 3, 4)]
+    policy = RetentionPolicy(
+        keep_trial_latest=0, keep_experiment_best=2, smaller_is_better=True
+    )
+    keep, delete = plan_retention(
+        cks, policy, metric_by_trial={1: 0.5, 2: 0.1, 3: 0.9}
+    )
+    # best two trials by loss: 2 then 1 — their LATEST checkpoints kept
+    assert keep == {"b1", "a1"}
+    assert delete == {"c1", "c0"}
+
+
+def test_plan_protected_uuids_survive_rotation():
+    """The experiment journal references resume checkpoints by uuid; a
+    protected uuid survives even when the per-trial count rotates it out."""
+    cks = [ci("a1", 1, 2), ci("a2", 1, 4), ci("a3", 1, 8)]
+    keep, delete = plan_retention(
+        cks, RetentionPolicy(keep_trial_latest=1), protected={"a1"}
+    )
+    assert "a1" in keep and "a3" in keep
+    assert delete == {"a2"}
+
+
+def test_plan_zero_keep_rejects_negative():
+    with pytest.raises(ValueError):
+        RetentionPolicy(keep_trial_latest=-1)
+
+
+def _write_ckpt(base, trial, uuid, steps, parent=None, manifest=True):
+    d = os.path.join(base, f"trial_{trial}", uuid)
+    os.makedirs(d)
+    with open(os.path.join(d, "metadata.json"), "w") as f:
+        json.dump({"steps_completed": steps, "parent_storage_id": parent}, f)
+    if manifest:
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            json.dump({"version": 1, "parent": parent, "files": {}}, f)
+    return d
+
+
+def test_scan_and_apply_retention(tmp_path):
+    base = str(tmp_path)
+    _write_ckpt(base, 1, "a1", 2)
+    _write_ckpt(base, 1, "a2", 4, parent="a1")
+    kept_dir = _write_ckpt(base, 1, "a3", 8, parent="a2")
+    _write_ckpt(base, 2, "b1", 8)
+    inflight = _write_ckpt(base, 2, "b2", 0, manifest=False)
+
+    infos = scan_experiment_checkpoints(base)
+    assert {c.uuid for c in infos} == {"a1", "a2", "a3", "b1", "b2"}
+    assert next(c for c in infos if c.uuid == "a3").parent == "a2"
+    assert not next(c for c in infos if c.uuid == "b2").has_manifest
+
+    out = apply_retention(base, RetentionPolicy(keep_trial_latest=1))
+    assert out["deleted"] == ["a1"]
+    assert os.path.isdir(kept_dir) and os.path.isdir(inflight)
+    assert not os.path.exists(os.path.join(base, "trial_1", "a1"))
+
+
+def test_apply_retention_empty_dir(tmp_path):
+    out = apply_retention(str(tmp_path / "nope"), RetentionPolicy())
+    assert out == {"kept": [], "deleted": []}
+
+
+def test_gc_task_body_deletes_uuids(tmp_path, monkeypatch):
+    """The agent-dispatched task: DTPU_GC_SPEC drives StorageManager
+    deletes (shared_fs backend)."""
+    base = tmp_path / "store"
+    for uuid in ("u1", "u2"):
+        d = base / uuid
+        d.mkdir(parents=True)
+        (d / "data.bin").write_bytes(b"x" * 8)
+    spec = {"checkpoint_dir": str(base), "uuids": ["u1", "missing"]}
+    monkeypatch.setenv("DTPU_GC_SPEC", json.dumps(spec))
+    rc = gc_checkpoints.main()
+    assert rc == 1  # the missing uuid counts as a failure
+    assert not (base / "u1").exists()
+    assert (base / "u2").exists()
+
+    monkeypatch.setenv("DTPU_GC_SPEC", json.dumps({"checkpoint_dir": str(base), "uuids": ["u2"]}))
+    assert gc_checkpoints.main() == 0
+    assert not (base / "u2").exists()

@@ -190,6 +190,103 @@ def test_simulation_adaptive_asha_end_to_end():
     assert result["best_metric"] is not None
 
 
+def _drive_to_completion(searcher, scfg, trial_fn, trial_steps, period=4, max_time=64):
+    """Round-robin the remaining search to completion, returning the
+    ordered (event, rid, hparams-sample) trace — the determinism oracle."""
+    trace = []
+    guard = 0
+    while searcher.shutdown is None and guard < 10_000:
+        guard += 1
+        running = [t for t in searcher.trials.values() if t.running]
+        if not running:
+            break
+        for rec in sorted(running, key=lambda t: t.request_id):
+            if searcher.shutdown is not None:
+                break
+            step = trial_steps.get(rec.request_id, 0) + period
+            trial_steps[rec.request_id] = step
+            searcher.on_validation(
+                rec.request_id,
+                {scfg.metric: trial_fn(rec.hparams, step), "batches": step},
+            )
+            if rec.stopped_by_searcher or step >= max_time:
+                searcher.on_trial_exited(rec.request_id)
+                trace.append(("exit", rec.request_id))
+    for rid in sorted(searcher.trials):
+        trace.append(("trial", rid, searcher.trials[rid].hparams))
+    return trace
+
+
+@pytest.mark.parametrize("name", ["random", "asha", "adaptive_asha"])
+def test_mid_search_snapshot_restore_is_deterministic(name):
+    """A searcher restored from a mid-search snapshot must emit EXACTLY the
+    remaining trials (same request ids, same sampled hparams) as the
+    uninterrupted run: the SearcherContext request-id counter and rng state
+    round-trip through state_dict/load_state_dict."""
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": HPARAMS,
+            "searcher": {
+                "name": name, "metric": "loss", "max_trials": 8,
+                "max_length": {"batches": 64}, "num_rungs": 3, "divisor": 4,
+                "max_concurrent_trials": 4,
+            },
+        }
+    )
+
+    def trial_fn(hp, step):
+        return abs(np.log10(hp["lr"]) + 2.5) + 10.0 / step
+
+    def build():
+        return Searcher(
+            method_from_config(cfg.searcher, cfg.hyperparameters),
+            cfg.hyperparameters,
+            seed=7,
+        )
+
+    s1 = build()
+    creates = s1.start()
+    rids = [a.request_id for a in creates if isinstance(a, Create)]
+    steps1 = {}
+    # advance partway: two validations land, one trial exits
+    s1.on_validation(rids[0], {"loss": trial_fn(s1.trials[rids[0]].hparams, 4), "batches": 4})
+    steps1[rids[0]] = 4
+    s1.on_validation(rids[1], {"loss": trial_fn(s1.trials[rids[1]].hparams, 4), "batches": 4})
+    steps1[rids[1]] = 4
+    s1.on_trial_exited(rids[0])
+    snap = s1.state_json()
+    steps_snap = dict(steps1)
+
+    trace1 = _drive_to_completion(s1, cfg.searcher, trial_fn, steps1)
+
+    s2 = build()
+    s2.restore_json(snap)
+    # restored searchers must not re-run initial_trials (request ids and
+    # rng draws would be burned twice)
+    assert s2.start() == []
+    trace2 = _drive_to_completion(s2, cfg.searcher, trial_fn, dict(steps_snap))
+
+    assert trace1 == trace2
+    assert len(s2.trials) == len(s1.trials)
+    # no duplicate request ids after restore
+    new_rid = s2.ctx.next_request_id()
+    assert new_rid > max(s2.trials)
+
+
+def test_searcher_context_rng_and_counter_roundtrip():
+    ctx = SearcherContext(parse_space(), seed=13)
+    ctx.create()
+    ctx.create()
+    import json as json_mod
+
+    state = json_mod.loads(json_mod.dumps(ctx.state_dict()))
+    ctx2 = SearcherContext(parse_space(), seed=0)
+    ctx2.load_state_dict(state)
+    a, b = ctx.create(), ctx2.create()
+    assert a.request_id == b.request_id
+    assert a.hparams == b.hparams
+
+
 def test_searcher_snapshot_restore_mid_search():
     cfg = ExperimentConfig.parse(
         {
