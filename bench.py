@@ -17,24 +17,14 @@ from __future__ import annotations
 import json
 import time
 
-# bf16 peak FLOP/s by TPU generation (public spec sheets); matched
-# longest-prefix-first so "TPU v5 lite" wins over the "TPU v5" catch-all
-_PEAK_BY_KIND = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,  # v5e reports device_kind "TPU v5 lite"
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
 
 def chip_peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for prefix in sorted(_PEAK_BY_KIND, key=len, reverse=True):
-        if kind.startswith(prefix):
-            return _PEAK_BY_KIND[prefix]
-    return 197e12  # conservative default: v5e-class
+    # bf16 peak FLOP/s by TPU generation: the table lives with the goodput
+    # ledger (observability/_goodput.py), which needs the same roofline;
+    # conservative v5e-class default for unknown chips
+    from determined_tpu.observability import chip_peak_flops as peak_by_kind
+
+    return peak_by_kind(getattr(device, "device_kind", ""), default=197e12)
 
 
 def main() -> None:
@@ -155,6 +145,40 @@ def main() -> None:
         trainer.state = step(trainer.state, next_batch())
     sync()
     dt = time.perf_counter() - t0
+
+    # A/B hook for the observability layer (docs/observability.md):
+    # DTPU_BENCH_TRACE=1 re-runs the measured loop with the tracer's
+    # per-step instrumentation (the exact data.wait/step.dispatch records
+    # Trainer._fit_loop emits, plus a live shipper draining the rings) and
+    # reports the overhead — the <2% contract for spans-on training
+    trace = os.environ.get("DTPU_BENCH_TRACE", "0")
+    if trace not in ("0", "1"):
+        raise SystemExit("DTPU_BENCH_TRACE must be 0 or 1")
+    trace_fields = {}
+    if trace == "1":
+        from determined_tpu.observability import get_tracer
+
+        tracer = get_tracer()
+        tracer.configure(enabled=True)
+        tracer.start()
+        mono = time.monotonic
+        t0 = time.perf_counter()
+        for _ in range(measured):
+            w0 = mono()
+            batch = next_batch()
+            w1 = mono()
+            trainer.state = step(trainer.state, batch)
+            w2 = mono()
+            tracer.record_span("data.wait", "data", w0, w1)
+            tracer.record_span("step.dispatch", "step", w1, w2)
+        sync()
+        dt_traced = time.perf_counter() - t0
+        tracer.stop()
+        trace_fields = {
+            "trace_overhead_pct": round(100.0 * (dt_traced / dt - 1.0), 2),
+            "trace_spans": 2 * measured,
+            "trace_dropped": tracer.dropped(),
+        }
     if prefetch == "1":
         pipeline.close()
 
@@ -173,6 +197,7 @@ def main() -> None:
                 "chip": getattr(jax.devices()[0], "device_kind", "unknown"),
                 "model": f"d{d}-L{L}-V{V}-seq{seq}-bs{gbs}",
                 "prefetch": int(prefetch),
+                **trace_fields,
             }
         )
     )

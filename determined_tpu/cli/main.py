@@ -226,6 +226,66 @@ def exp_status_local(args) -> int:
     return 0
 
 
+def exp_profile_local(args) -> int:
+    """Goodput ledger for a LOCAL experiment directory: where every second
+    of wall-clock went (docs/observability.md).  Reads the Chrome trace
+    events exported under ``<dir>/traces/`` (``observability.trace_export:
+    true``); ``--xplane`` additionally summarizes a sampled jax.profiler
+    window so the host timeline can be checked against device truth."""
+    from determined_tpu.observability import (
+        compute_ledger,
+        format_ledger_text,
+        load_trace_events,
+    )
+
+    traces_dir = os.path.join(args.checkpoint_dir, "traces")
+    events = load_trace_events(traces_dir)
+    if not events:
+        print(
+            f"error: no trace events under {traces_dir} (run the experiment "
+            "with observability.trace_export: true)",
+            file=sys.stderr,
+        )
+        return 2
+    ledger = compute_ledger(events)
+
+    # optional device-side cross-check: a jax.profiler xplane window
+    # (profiling.trace) parsed into an op table via utils/xplane.py
+    xplane_summary = None
+    xplane_dir = args.xplane or os.path.join(traces_dir, "xplane")
+    if args.xplane and not os.path.isdir(args.xplane):
+        # an explicit request that cannot be honored must not be silent
+        # (the default-path probe, by contrast, is best-effort)
+        print(f"warning: --xplane {args.xplane} is not a directory", file=sys.stderr)
+    if os.path.isdir(xplane_dir):
+        try:
+            from determined_tpu.utils import xplane as xplane_mod
+
+            ops = xplane_mod.hlo_op_table(xplane_dir)
+            coll, other = xplane_mod.split_collectives(ops)
+            xplane_summary = {
+                "top_ops": ops[:10],
+                "category_totals": xplane_mod.category_totals(ops),
+                "collective_us": coll,
+                "compute_us": other,
+            }
+        except Exception as e:  # noqa: BLE001 - best effort
+            xplane_summary = {"error": str(e)}
+
+    if args.json:
+        out = {"ledger": ledger}
+        if xplane_summary is not None:
+            out["xplane"] = xplane_summary
+        _print_json(out)
+        return 0
+    print(format_ledger_text(ledger))
+    if xplane_summary and "category_totals" in xplane_summary:
+        print("\nxplane device-time categories (us):")
+        for cat, us in list(xplane_summary["category_totals"].items())[:8]:
+            print(f"  {cat:<24} {us:>12.1f}")
+    return 0
+
+
 def exp_resume_local(args) -> int:
     """Resume a crashed/preempted LocalExperiment from its journal.
 
@@ -887,6 +947,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     rs.add_argument("--serial", action="store_true", help="force the sequential loop")
     rs.set_defaults(fn=exp_resume_local)
+    pf = exp.add_parser(
+        "profile",
+        help="goodput ledger + phase breakdown from a LOCAL experiment's traces",
+    )
+    pf.add_argument("checkpoint_dir")
+    pf.add_argument("--json", action="store_true", help="machine-readable output")
+    pf.add_argument(
+        "--xplane",
+        help="directory holding a sampled jax.profiler window "
+        "(default: <dir>/traces/xplane)",
+    )
+    pf.set_defaults(fn=exp_profile_local)
 
     trial = sub.add_parser("trial", aliases=["t"]).add_subparsers(
         dest="verb", required=True

@@ -375,6 +375,52 @@ class LintConfig:
         return cls(**raw)
 
 
+@dataclasses.dataclass(frozen=True)
+class ObservabilityConfig:
+    """Experiment-wide tracing knobs (``determined_tpu/observability``).
+
+    ``enabled``: record spans/counters from every subsystem (trainer loop,
+    prefetch workers, scheduler, journal, checkpoint writers, restarts)
+    into per-thread ring buffers — lock-free, non-blocking, <2% step-time
+    overhead (the DTPU_BENCH_TRACE A/B).  ``trace_export``: additionally
+    stream the events as Chrome trace JSON under
+    ``checkpoint_dir/traces/`` (Perfetto-loadable; feeds
+    ``dtpu experiment profile``).  ``ring_capacity``: events buffered per
+    thread between shipper drains — overflow drops (counted) rather than
+    blocking.  ``flush_interval_s``: shipper drain cadence.
+    ``max_events``: in-memory event cap for the end-of-run ledger.
+    """
+
+    enabled: bool = True
+    trace_export: bool = False
+    ring_capacity: int = 8192
+    flush_interval_s: float = 0.5
+    max_events: int = 1_000_000
+
+    def __post_init__(self):
+        if self.ring_capacity < 16:
+            raise InvalidExperimentConfig(
+                "observability.ring_capacity must be >= 16"
+            )
+        if self.flush_interval_s <= 0:
+            raise InvalidExperimentConfig(
+                "observability.flush_interval_s must be > 0"
+            )
+        if self.max_events < 1:
+            raise InvalidExperimentConfig("observability.max_events must be >= 1")
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "ObservabilityConfig":
+        raw = dict(raw or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise InvalidExperimentConfig(
+                f"unknown observability fields: {sorted(unknown)}"
+            )
+        return cls(**raw)
+
+
 _LOG_POLICY_ACTIONS = ("cancel_retries", "exclude_node")
 
 
@@ -443,6 +489,9 @@ class ExperimentConfig:
         default_factory=FaultToleranceConfig
     )
     lint: LintConfig = dataclasses.field(default_factory=LintConfig)
+    observability: ObservabilityConfig = dataclasses.field(
+        default_factory=ObservabilityConfig
+    )
     reproducibility: ReproducibilityConfig = dataclasses.field(
         default_factory=ReproducibilityConfig
     )
@@ -513,6 +562,10 @@ class ExperimentConfig:
             kwargs["fault_tolerance"] = FaultToleranceConfig.parse(raw.pop("fault_tolerance"))
         if "lint" in raw:
             kwargs["lint"] = LintConfig.parse(raw.pop("lint"))
+        if "observability" in raw:
+            kwargs["observability"] = ObservabilityConfig.parse(
+                raw.pop("observability")
+            )
         if "log_policies" in raw:
             policies = raw.pop("log_policies") or []
             if not isinstance(policies, list):

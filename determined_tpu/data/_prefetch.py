@@ -33,10 +33,12 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 from typing import Any, Dict, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from determined_tpu.observability import get_tracer
 from determined_tpu.utils import faults
 
 
@@ -94,6 +96,7 @@ class PrefetchingIterator:
 
     def _run(self) -> None:
         produced = 0
+        tracer = get_tracer()
         try:
             it = iter(self._source)
             while not self._stop.is_set():
@@ -101,13 +104,21 @@ class PrefetchingIterator:
                 # to exercise exception propagation + supervised restart
                 faults.fire(self._fault_site, batches=produced)
                 try:
+                    # the fetch span lives on THIS worker thread's trace
+                    # track; the consumer's stall (if any) shows up as the
+                    # trainer's data.wait span instead
+                    t0 = time.monotonic()
                     item = next(it)
+                    tracer.record_span("data.fetch", "data", t0, time.monotonic())
                 except StopIteration:
                     self._put(_DONE)
                     return
                 produced += 1
                 if not self._put(item):
                     return
+                # depth after the put: how far ahead of the consumer the
+                # worker is running (0 sustained = input-bound training)
+                tracer.gauge("data.queue_depth", float(self._queue.qsize()))
         except BaseException as e:  # noqa: BLE001 - re-raised on the consumer
             self._put(_WorkerError(e))
 
@@ -179,13 +190,23 @@ def device_prefetch(
     """
     from determined_tpu.data._loader import to_global
 
+    tracer = get_tracer()
+
+    def _to_global_traced(host_batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        # transfer *dispatch* time (JAX copies asynchronously): runs on the
+        # consumer thread, so it nests inside the trainer's data.wait span
+        t0 = time.monotonic()
+        out = to_global(host_batch, mesh, micro_dim=micro_dim)
+        tracer.record_span("data.h2d", "h2d", t0, time.monotonic())
+        return out
+
     if size <= 1:
         for state, host_batch in pairs:
-            yield state, to_global(host_batch, mesh, micro_dim=micro_dim)
+            yield state, _to_global_traced(host_batch)
         return
     buf: collections.deque = collections.deque()
     for state, host_batch in pairs:
-        buf.append((state, to_global(host_batch, mesh, micro_dim=micro_dim)))
+        buf.append((state, _to_global_traced(host_batch)))
         if len(buf) >= size:
             yield buf.popleft()
     while buf:

@@ -490,6 +490,27 @@ def main() -> int:
             )
             return 3
 
+    # experiment-wide tracing (determined_tpu/observability): spans record
+    # from every harness thread; export (opt-in) writes Chrome trace JSON
+    # the `dtpu experiment profile` ledger reads
+    from determined_tpu.observability import get_tracer
+
+    obs = exp_config.observability
+    tracer = get_tracer()
+    tracer.configure(
+        enabled=obs.enabled,
+        ring_capacity=obs.ring_capacity,
+        flush_interval=obs.flush_interval_s,
+        max_events=obs.max_events,
+        out_dir=(
+            os.path.join(os.getcwd(), "traces", f"trial_{cluster.trial_id or 0}")
+            if obs.enabled and obs.trace_export
+            else None
+        ),
+    )
+    if obs.enabled:
+        tracer.start()
+
     core_ctx = core.init()
     try:
         # expconf-driven profiling (reference exec/harness.py:211): system
@@ -527,13 +548,17 @@ def main() -> int:
         )
 
         def run_supervised():
-            return supervisor.run(
-                max_length,
-                validation_period=exp_config.min_validation_period,
-                checkpoint_period=exp_config.min_checkpoint_period,
-                latest_checkpoint=cluster.latest_checkpoint,
-                checkpoint_policy=exp_config.checkpoint_policy,
-            )
+            # trial.run is the goodput ledger's attribution unit; the
+            # supervisor's restart backoffs and each attempt's setup/
+            # restore/step spans all nest inside it
+            with tracer.span("trial.run", cat="trial", trial=cluster.trial_id):
+                return supervisor.run(
+                    max_length,
+                    validation_period=exp_config.min_validation_period,
+                    checkpoint_period=exp_config.min_checkpoint_period,
+                    latest_checkpoint=cluster.latest_checkpoint,
+                    checkpoint_policy=exp_config.checkpoint_policy,
+                )
 
         if lint_cfg.thread_sentinel:
             # warn-mode leak check over the whole supervised run: every
@@ -562,6 +587,17 @@ def main() -> int:
         return 0
     finally:
         core_ctx.close()
+        tracer.stop()
+        if obs.enabled and obs.trace_export:
+            try:
+                tracer.export_chrome_trace(
+                    os.path.join(
+                        os.getcwd(), "traces", f"trial_{cluster.trial_id or 0}",
+                        "trace.json",
+                    )
+                )
+            except Exception:  # noqa: BLE001 - export must not mask the run
+                logger.exception("trace export failed")
 
 
 if __name__ == "__main__":

@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from determined_tpu.observability import get_tracer
 from determined_tpu.searcher import Create, Searcher
 from determined_tpu.utils import faults
 
@@ -190,9 +191,16 @@ class ExperimentJournal:
             # driver here, BEFORE the record lands — simulating a crash at
             # the worst moment (the event happened, the WAL never saw it)
             faults.fire("experiment.journal.append", type=rec_type, seq=self._seq)
+            io_t0 = time.monotonic()
             self._fh.write(json.dumps(rec, default=_json_default) + "\n")
             self._fh.flush()
             os.fsync(self._fh.fileno())
+            # append+fsync latency: trial threads block here inside their
+            # searcher events, so a slow disk shows up in the goodput
+            # ledger as journal time, not mystery "other"
+            get_tracer().record_span(
+                "journal.append", "journal", io_t0, time.monotonic(), {"type": rec_type}
+            )
             self._absorb(rec)
             self._since_compact += 1
             # compact ONLY on snapshot appends: every searcher event is

@@ -28,6 +28,7 @@ import logging
 import os
 import signal
 import threading
+import time
 from typing import Any, Dict, List, Optional, Type
 
 from determined_tpu import core
@@ -43,6 +44,7 @@ from determined_tpu.experiment.journal import (
     journal_path,
     read_journal,
 )
+from determined_tpu.observability import export_experiment_trace, get_tracer
 from determined_tpu.searcher import Create, method_from_config
 from determined_tpu.train import Trainer, TrialContext
 from determined_tpu.train._trial import JaxTrial
@@ -129,6 +131,17 @@ class LocalExperiment:
         Thread-safe: everything here is per-trial state except the searcher
         calls, which serialize internally.
         """
+        # the trial.run span is the goodput ledger's attribution unit:
+        # everything this thread records while inside it (setup, data wait,
+        # step dispatch, checkpoints, restarts) is this trial's wall-clock
+        with get_tracer().span(
+            "trial.run", cat="trial", trial=create.request_id
+        ):
+            return self._run_trial_inner(create, devices)
+
+    def _run_trial_inner(
+        self, create: Create, devices: Optional[List[Any]] = None
+    ) -> TrialResult:
         from determined_tpu import train as train_mod
 
         cfg = self.config
@@ -344,6 +357,33 @@ class LocalExperiment:
         self._preflight_check()
         import jax
 
+        # observability: spans are on by default (obs.enabled) at ~zero
+        # hot-loop cost; the shipper thread drains per-thread rings, and
+        # trace-file export (obs.trace_export) additionally writes Chrome
+        # trace events under checkpoint_dir/traces/ for Perfetto +
+        # `dtpu experiment profile`
+        obs = self.config.observability
+        tracer = get_tracer()
+        # reset BEFORE configure opens the export file: reset's drain must
+        # discard any stale pre-run events, not append them to this run's
+        # events.jsonl (the ledger prefers the JSONL over trace.json)
+        tracer.reset()
+        tracer.configure(
+            enabled=obs.enabled,
+            ring_capacity=obs.ring_capacity,
+            flush_interval=obs.flush_interval_s,
+            max_events=obs.max_events,
+            out_dir=(
+                os.path.join(self.checkpoint_dir, "traces")
+                if obs.enabled and obs.trace_export
+                else None
+            ),
+        )
+        exp_t0 = None
+        if obs.enabled:
+            tracer.start()
+            exp_t0 = time.monotonic()
+
         ft = self.config.fault_tolerance
         if ft.journal:
             os.makedirs(self.checkpoint_dir, exist_ok=True)
@@ -413,6 +453,29 @@ class LocalExperiment:
                 # reads .journal; trial threads are gone by this point.
                 self.searcher.journal = None  # dtpu: lint-ok[unlocked-shared-state]
                 self.journal.close()
+            if exp_t0 is not None:
+                tracer.record_span(
+                    "experiment.run",
+                    "experiment",
+                    exp_t0,
+                    time.monotonic(),
+                    {"name": self.config.name, "status": self.status},
+                )
+                tracer.stop()
+                if obs.trace_export:
+                    try:
+                        ledger = export_experiment_trace(
+                            tracer, os.path.join(self.checkpoint_dir, "traces")
+                        )
+                        logger.info(
+                            "trace exported to %s (goodput: %.1f%% attributed, "
+                            "%.1f%% productive)",
+                            ledger.get("trace_path"),
+                            ledger["experiment"]["attributed_pct"],
+                            ledger["experiment"]["productive_pct"],
+                        )
+                    except Exception:  # noqa: BLE001 - export must not mask the run
+                        logger.exception("trace export failed")
 
     def resume(self, max_trials: Optional[int] = None, **kwargs: Any) -> Dict[str, Any]:
         """Replay the experiment journal and continue the search."""

@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from determined_tpu.observability import get_tracer
 from determined_tpu.searcher import Create
 from determined_tpu.searcher._base import ExitedReason
 
@@ -257,6 +258,12 @@ class TrialScheduler:
         abandoned: List[int] = []
         drain_deadline: Optional[float] = None
         t0 = time.monotonic()
+        tracer = get_tracer()
+        # when each pending create was first seen runnable: the gap to its
+        # slot acquire is the "slot.wait" span (scheduling delay, not
+        # attributed to the trial's own wall-clock — args use rid, not
+        # trial, so the goodput ledger keeps it on the dispatcher track)
+        first_runnable: Dict[int, float] = {}
 
         def absorb_completion(rid: int) -> None:
             nonlocal completed
@@ -265,6 +272,7 @@ class TrialScheduler:
             # release BEFORE the searcher exit event: replacement creates
             # the event produces can immediately take the freed block
             self.pool.release(alloc)
+            tracer.gauge("scheduler.gangs_busy", float(len(running)))
             completed += 1
             if rid in self._errored:
                 self.searcher.on_trial_exited_early(rid, ExitedReason.ERRORED)
@@ -286,6 +294,7 @@ class TrialScheduler:
             dispatch_blocked = False
             if not self.errors and self.searcher.shutdown is None and not self._stopping():
                 for rec in self._dispatchable(scheduled):
+                    first_runnable.setdefault(rec.request_id, time.monotonic())
                     if len(running) >= self.max_concurrent:
                         break
                     if max_trials is not None and launched >= max_trials:
@@ -294,6 +303,19 @@ class TrialScheduler:
                     if alloc is None:
                         dispatch_blocked = True
                         break
+                    waited_since = first_runnable.pop(rec.request_id, None)
+                    if waited_since is not None:
+                        tracer.record_span(
+                            "slot.wait",
+                            "scheduler",
+                            waited_since,
+                            time.monotonic(),
+                            {"rid": rec.request_id},
+                        )
+                    if completed:
+                        tracer.instant(
+                            "slot.backfill", "scheduler", rid=rec.request_id
+                        )
                     create = Create(rec.request_id, rec.hparams)
                     thread = threading.Thread(
                         target=self._worker,
@@ -310,6 +332,7 @@ class TrialScheduler:
                         # alike), as opposed to the initial fill
                         backfills += 1
                     peak_concurrency = max(peak_concurrency, len(running))
+                    tracer.gauge("scheduler.gangs_busy", float(len(running)))
                     logger.info(
                         "trial %d starting on devices %s (%d/%d gangs busy)",
                         rec.request_id,

@@ -495,6 +495,22 @@ class LMTrial(JaxTrial):
             moe_aux_weight=float(g("moe_aux_weight", 0.01)),
         )
 
+    @property
+    def tokens_per_sample(self) -> int:
+        """Tokens one sample contributes per step — the goodput ledger's
+        tokens/s denominator (observability/_goodput.py)."""
+        return int(self.context.get_hparam("seq_len", 512))
+
+    @property
+    def flops_per_token(self) -> float:
+        """Fwd+bwd matmul FLOPs per token by the standard 6N + attention
+        convention (same accounting as bench.py), for the ledger's MFU
+        estimate."""
+        cfg = self._cfg()
+        d, L, V = cfg.d_model, cfg.n_layers, cfg.vocab_size
+        n_params = L * (4 * d * d + 12 * d * d) + V * d
+        return float(6 * n_params + 12 * L * cfg.max_seq_len * d)
+
     def build_model(self) -> TransformerLM:
         return TransformerLM(self._cfg(), mesh=self.context.mesh)
 

@@ -25,6 +25,7 @@ import random
 import time
 from typing import Any, Callable, Dict, Optional
 
+from determined_tpu.observability import get_tracer
 from determined_tpu.utils.errors import (
     FailureKind,
     RestartBudgetExhaustedError,
@@ -153,5 +154,14 @@ def run_with_restarts(
             )
             if on_failure is not None:
                 on_failure(Attempt(restarts, kind, e, latest, delay))
+            # supervisor spans: the failure marker + the backoff sleep are
+            # restart-recovery time in the goodput ledger (the re-setup and
+            # checkpoint-restore of the next attempt land in their own
+            # setup/restore buckets)
+            tracer = get_tracer()
+            tracer.instant(
+                "trial.failure", "restart", kind=kind.value, restarts=restarts
+            )
             if delay > 0:
-                sleep(delay)
+                with tracer.span("restart.backoff", cat="restart", restarts=restarts):
+                    sleep(delay)

@@ -34,6 +34,7 @@ from determined_tpu.config.experiment import ExperimentConfig, Length
 from determined_tpu.core import _context as core_context_mod
 from determined_tpu.data._loader import DataLoader, to_global
 from determined_tpu.data._prefetch import EpochFeed, InputPipeline
+from determined_tpu.observability import chip_peak_flops, get_tracer
 from determined_tpu.parallel.mesh import MeshAxes, MeshConfig, make_mesh
 from determined_tpu.parallel.sharding import (
     DEFAULT_RULES,
@@ -194,6 +195,7 @@ class Trainer:
         self.agg = 1  # aggregation_frequency, set from exp config in _setup
         self._pending_save: Optional[_PendingSave] = None
         self._snapshot_jit: Any = None
+        self._tokens_per_sample: Optional[int] = None  # set by _setup
         # Newest FINALIZED checkpoint (manifest written, master reported).
         # An async save still in flight is deliberately excluded: until its
         # drain-point finalize runs it has no manifest and must never be
@@ -404,8 +406,12 @@ class Trainer:
                 entry = cache.insert(
                     key,
                     _jit_cache.CachedSteps(
-                        train_step=jax.jit(train_step, donate_argnums=0),
-                        eval_step=jax.jit(eval_step, donate_argnums=2),
+                        train_step=_jit_cache.timed_first_call(
+                            jax.jit(train_step, donate_argnums=0), "jit.compile.train"
+                        ),
+                        eval_step=_jit_cache.timed_first_call(
+                            jax.jit(eval_step, donate_argnums=2), "jit.compile.eval"
+                        ),
                         trial_class=f"{type(trial).__module__}:{type(trial).__qualname__}",
                     ),
                 )
@@ -419,8 +425,35 @@ class Trainer:
             self._train_step = entry.train_step
             self._eval_step = entry.eval_step
         else:
-            self._train_step = jax.jit(train_step, donate_argnums=0)
-            self._eval_step = jax.jit(eval_step, donate_argnums=2)
+            self._train_step = _jit_cache.timed_first_call(
+                jax.jit(train_step, donate_argnums=0), "jit.compile.train"
+            )
+            self._eval_step = _jit_cache.timed_first_call(
+                jax.jit(eval_step, donate_argnums=2), "jit.compile.eval"
+            )
+
+        # ---- goodput-ledger context (observability/_goodput.py) ----------
+        # tokens/MFU in the ledger need per-step token counts and the
+        # device roofline; both are best-effort — a trial without a known
+        # tokens-per-sample simply reports samples/s only
+        self._tokens_per_sample = getattr(trial, "tokens_per_sample", None) or (
+            (ctx.hparams or {}).get("seq_len")
+            if isinstance((ctx.hparams or {}).get("seq_len"), int)
+            else None
+        )
+        tracer = get_tracer()
+        if tracer.enabled:
+            dev = self.mesh.devices.flat[0]
+            # default=0: an unknown chip (CPU tests) reports no roofline
+            # rather than a bogus MFU against a TPU peak
+            peak = chip_peak_flops(getattr(dev, "device_kind", ""), default=0.0)
+            if peak:
+                tracer.gauge(
+                    "device.peak_flops_total", peak * float(self.mesh.devices.size)
+                )
+            fpt = getattr(trial, "flops_per_token", None)
+            if fpt:
+                tracer.gauge("train.flops_per_token", float(fpt))
 
     def _place_on_mesh(self, tree: Any) -> Any:
         """Replicate any leaf not already sharded over THIS mesh.
@@ -509,17 +542,49 @@ class Trainer:
     def _drain_pending_save(self) -> Optional[str]:
         """Wait for the in-flight background save (if any) and run its
         collective finalize.  Must be called from the main thread at a
-        point every rank reaches identically (next save / preempt / exit)."""
+        point every rank reaches identically (next save / preempt / exit).
+
+        Multi-rank failure semantics: before entering the collective
+        finalize, every rank allgathers its writer's error flag.  A failed
+        background writer on ONE rank therefore fails ALL ranks here, fast
+        and together — without the exchange, the healthy ranks would enter
+        the finalize collective and hang on the dead rank until the 600s
+        collective timeout.  The ``checkpoint.stall`` span records how
+        long training sat blocked on the drain either way.
+        """
         p = self._pending_save
         if p is None:
             return None
         self._pending_save = None
+        tracer = get_tracer()
+        stall_t0 = time.monotonic()
         p.thread.join()
-        if p.errors:
+        failed = bool(p.errors)
+        dist = self.core.distributed
+        if dist.size > 1:
+            flags = dist.allgather(failed)
+            failed_ranks = [r for r, f in enumerate(flags) if f]
+        else:
+            failed_ranks = [0] if failed else []
+        tracer.record_span(
+            "checkpoint.stall",
+            "checkpoint",
+            stall_t0,
+            time.monotonic(),
+            {"storage_id": p.storage_id, "failed_ranks": failed_ranks},
+        )
+        if failed_ranks:
+            if p.errors:
+                raise RuntimeError(
+                    f"async checkpoint {p.storage_id} failed "
+                    f"(ranks {failed_ranks})"
+                ) from p.errors[0]
             raise RuntimeError(
-                f"async checkpoint {p.storage_id} failed"
-            ) from p.errors[0]
-        p.finish()
+                f"async checkpoint {p.storage_id} failed on rank(s) "
+                f"{failed_ranks}; failing fast before the collective finalize"
+            )
+        with tracer.span("checkpoint.finalize", cat="checkpoint", storage_id=p.storage_id):
+            p.finish()
         self.latest_checkpoint = p.storage_id
         for cb in self.callbacks.values():
             cb.on_checkpoint_write_end(p.storage_id)
@@ -557,12 +622,15 @@ class Trainer:
             "parent_storage_id": self.latest_checkpoint,
         }
         if not (asynchronous and self._async_checkpointing()):
-            with self.core.checkpoint.store_path(metadata, shard=shard) as (path, sid):
-                for cb in self.callbacks.values():
-                    cb.on_checkpoint_write_start(path)
-                serialization.save_arrays(path, array_state)
-                if dist.is_chief:
-                    serialization.save_trainer_state(path, trainer_state)
+            with get_tracer().span(
+                "checkpoint.save", cat="checkpoint", mode="sync", step=self.steps_completed
+            ):
+                with self.core.checkpoint.store_path(metadata, shard=shard) as (path, sid):
+                    for cb in self.callbacks.values():
+                        cb.on_checkpoint_write_start(path)
+                    serialization.save_arrays(path, array_state)
+                    if dist.is_chief:
+                        serialization.save_trainer_state(path, trainer_state)
             self.latest_checkpoint = sid
             for cb in self.callbacks.values():
                 cb.on_checkpoint_write_end(sid)
@@ -571,18 +639,24 @@ class Trainer:
 
         # overlapped save: snapshot on device, serialize on a background
         # thread, collective finalize at the next drain point (SURVEY §7(b))
-        path, sid, finish = self.core.checkpoint.store_path_async(metadata, shard=shard)
-        for cb in self.callbacks.values():
-            cb.on_checkpoint_write_start(path)
-        snapshot = self._snapshot_arrays(array_state)
+        with get_tracer().span(
+            "checkpoint.dispatch", cat="checkpoint", step=self.steps_completed
+        ):
+            path, sid, finish = self.core.checkpoint.store_path_async(metadata, shard=shard)
+            for cb in self.callbacks.values():
+                cb.on_checkpoint_write_start(path)
+            snapshot = self._snapshot_arrays(array_state)
         is_chief = dist.is_chief
         errors: list = []
 
         def work() -> None:
             try:
-                serialization.save_arrays(path, snapshot)
-                if is_chief:
-                    serialization.save_trainer_state(path, trainer_state)
+                with get_tracer().span(
+                    "checkpoint.write", cat="checkpoint", storage_id=sid
+                ):
+                    serialization.save_arrays(path, snapshot)
+                    if is_chief:
+                        serialization.save_trainer_state(path, trainer_state)
             except BaseException as e:  # surfaced at the drain point
                 # single background writer; the drain point joins this
                 # thread BEFORE reading errors (happens-before via join)
@@ -645,6 +719,12 @@ class Trainer:
             "manifest era can be resumed by setting "
             "fault_tolerance.verify_checkpoints: false"
         )
+
+    def _restore_checkpoint_traced(self, storage_id: str) -> None:
+        """Resume replay, recorded as a ``restore`` span — the goodput
+        ledger's "time spent re-reaching the pre-crash state" bucket."""
+        with get_tracer().span("checkpoint.restore", cat="restore", storage_id=storage_id):
+            self._restore_checkpoint(storage_id)
 
     def restore_from_path(self, path: str) -> None:
         """Load arrays + trainer state from an already-local checkpoint dir
@@ -731,7 +811,9 @@ class Trainer:
         checkpoint_policy: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Train until ``max_length``; returns a summary dict."""
-        self._setup()
+        tracer = get_tracer()
+        with tracer.span("trainer.setup", cat="setup"):
+            self._setup()
         if checkpoint_policy is None:
             cfg = self.context.exp_config
             checkpoint_policy = cfg.checkpoint_policy if cfg is not None else "best"
@@ -745,7 +827,7 @@ class Trainer:
         rep_sched = _BoundarySchedule(rep_period, max_steps)
 
         if latest_checkpoint:
-            self._restore_checkpoint(latest_checkpoint)
+            self._restore_checkpoint_traced(latest_checkpoint)
 
         # bounded trace window: a whole-run xplane capture grows without
         # limit, so tracing stops after profiling.end_after_batch steps —
@@ -820,6 +902,7 @@ class Trainer:
         checkpoint_policy: str,
         gbs: int,
     ) -> None:
+        tracer = get_tracer()
         hot_time = 0.0  # train-segment wall time since last report (excludes
         # validation/checkpoint so samples_per_second tracks training only)
         steps_since_report = 0
@@ -849,16 +932,34 @@ class Trainer:
             # the mesh context makes trace-time sharding constraints resolve
             # for models that annotate activations without an explicit mesh
             with self.mesh:
-                while self.steps_completed < next_stop:
-                    # fault-injection hook: tests crash a step here to
-                    # exercise the supervised-restart path (no-op in prod)
-                    faults.fire("train.step", step=self.steps_completed)
-                    # already a device-global array; the pipeline stacked
-                    # microbatches (agg > 1) and committed consumed state
-                    batch = next(pipeline)
-                    self.state = self._train_step(self.state, batch)
-                    self.steps_completed += 1
-                    steps_since_report += 1
+                if tracer.enabled:
+                    # traced twin of the loop below: two extra clock reads
+                    # + two lock-free ring pushes per step attribute the
+                    # step's wall-clock to input wait vs. step dispatch
+                    # (DTPU_BENCH_TRACE measures this at <2% step time);
+                    # the untraced branch stays byte-identical to before
+                    while self.steps_completed < next_stop:
+                        faults.fire("train.step", step=self.steps_completed)
+                        t0 = time.monotonic()
+                        batch = next(pipeline)
+                        t1 = time.monotonic()
+                        self.state = self._train_step(self.state, batch)
+                        t2 = time.monotonic()
+                        tracer.record_span("data.wait", "data", t0, t1)
+                        tracer.record_span("step.dispatch", "step", t1, t2)
+                        self.steps_completed += 1
+                        steps_since_report += 1
+                else:
+                    while self.steps_completed < next_stop:
+                        # fault-injection hook: tests crash a step here to
+                        # exercise the supervised-restart path (no-op in prod)
+                        faults.fire("train.step", step=self.steps_completed)
+                        # already a device-global array; the pipeline stacked
+                        # microbatches (agg > 1) and committed consumed state
+                        batch = next(pipeline)
+                        self.state = self._train_step(self.state, batch)
+                        self.steps_completed += 1
+                        steps_since_report += 1
             hot_time += time.monotonic() - seg_t0
             if self.train_loader.epoch != epoch_seen:
                 for e in range(epoch_seen, self.train_loader.epoch):
@@ -878,7 +979,20 @@ class Trainer:
             if rep_sched.is_boundary(self.steps_completed) or at_end:
                 sync_t0 = time.monotonic()
                 metrics = self.state.fetch_metrics()  # one host sync
-                hot_time += time.monotonic() - sync_t0
+                sync_t1 = time.monotonic()
+                hot_time += sync_t1 - sync_t0
+                # the boundary fetch is where the host finally waits for
+                # every dispatched step — the device-compute proxy on the
+                # host timeline (cat "step": productive in the ledger)
+                tracer.record_span("step.boundary_block", "step", sync_t0, sync_t1)
+                if steps_since_report:
+                    tracer.counter("train.steps", float(steps_since_report))
+                    tracer.counter("train.samples", float(steps_since_report * gbs))
+                    if self._tokens_per_sample:
+                        tracer.counter(
+                            "train.tokens",
+                            float(steps_since_report * gbs * self._tokens_per_sample),
+                        )
                 self.state = self.state.reset_metrics()
                 metrics["samples_per_second"] = steps_since_report * gbs / max(hot_time, 1e-9)
                 hot_time = 0.0
@@ -896,7 +1010,8 @@ class Trainer:
             if val_sched.period is not None and (
                 val_sched.is_boundary(self.steps_completed) or at_end
             ):
-                self._last_val_metrics = self._validate()
+                with tracer.span("validate", cat="validate", step=self.steps_completed):
+                    self._last_val_metrics = self._validate()
                 validated = True
 
             # ---- CHECKPOINT ----------------------------------------------

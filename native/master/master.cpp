@@ -2504,8 +2504,16 @@ class Master {
     for (const auto& pool : k8s_pools) {
       std::thread([this, pool] {
         using namespace std::chrono_literals;
+        // Reconnect policy (ADVICE r5: the old loop slept a flat 200ms and
+        // logged nothing, hammering a broken apiserver 5x/sec forever): a
+        // healthy rotation (HTTP 200 after timeoutSeconds) reconnects
+        // immediately; any other result — connect failure (0), auth/RBAC
+        // rejection (401/403), bad resource version (410), server errors —
+        // is logged and backed off exponentially, 200ms doubling to a 30s
+        // ceiling, reset on the next healthy stream.
+        int failures = 0;
         while (true) {
-          KubernetesBackend::watch(pool, 30, [this](const std::string& job) {
+          int status = KubernetesBackend::watch(pool, 30, [this](const std::string& job) {
             bool ours = false;
             {
               std::lock_guard<std::mutex> g(mu_);
@@ -2525,8 +2533,22 @@ class Master {
               ext_cv_.notify_all();
             }
           });
-          // stream ended (timeoutSeconds / apiserver hiccup): reconnect
-          std::this_thread::sleep_for(200ms);
+          if (status == 200) {
+            failures = 0;
+            // stream ended normally (timeoutSeconds): reconnect promptly
+            std::this_thread::sleep_for(200ms);
+            continue;
+          }
+          ++failures;
+          int shift = failures < 8 ? failures : 8;  // 200ms << 8 > the 30s cap
+          auto delay = std::min(std::chrono::milliseconds(200 * (1 << shift)),
+                                std::chrono::milliseconds(30000));
+          fprintf(stderr,
+                  "master: k8s watch on pool %s failed (http status %d, "
+                  "consecutive failures %d); reconnecting in %lldms\n",
+                  pool.name.c_str(), status, failures,
+                  static_cast<long long>(delay.count()));
+          std::this_thread::sleep_for(delay);
         }
       }).detach();
     }
@@ -3852,7 +3874,14 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       return R::error(403, "creating tokens for other users requires admin");
     }
     if (!m.users_.count(target)) return R::error(404, "no such user");
-    int64_t ttl_ms = body["ttl_days"].as_int(30) * 24LL * 3600 * 1000;
+    // ttl_days <= 0 used to mint never-expiring tokens (ADVICE r5): a
+    // non-positive TTL is a client bug, not a request for immortality,
+    // and even valid TTLs are capped so no token outlives a year
+    constexpr int64_t kMaxTokenTtlDays = 365;
+    int64_t ttl_days = body["ttl_days"].as_int(30);
+    if (ttl_days < 1) return R::error(400, "ttl_days must be >= 1");
+    if (ttl_days > kMaxTokenTtlDays) ttl_days = kMaxTokenTtlDays;
+    int64_t ttl_ms = ttl_days * 24LL * 3600 * 1000;
     auto [tok, id] = m.issue_named_token(target, name, ttl_ms);
     // the ONLY response that ever carries the secret
     return R::json(Json::object()

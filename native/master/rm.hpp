@@ -392,18 +392,20 @@ class KubernetesBackend {
   // long-lived GET on the Jobs watch API; every event line invokes
   // ``on_event(job_name)``.  The caller reacts by resolving that job's
   // status immediately instead of waiting for the next resync poll.
-  // Returns when the server closes the stream (timeoutSeconds) or on
-  // error; the caller's watch loop reconnects.
-  static void watch(const PoolConfig& pool, int timeout_sec,
-                    const std::function<void(const std::string&)>& on_event) {
+  // Returns the HTTP status of the stream (0 = connect/read failure)
+  // when the server closes it (timeoutSeconds) or on error, so the
+  // caller's reconnect loop can distinguish a healthy stream rotation
+  // (200) from an apiserver rejecting/refusing it and back off.
+  static int watch(const PoolConfig& pool, int timeout_sec,
+                   const std::function<void(const std::string&)>& on_event) {
     std::string host;
     int port = 0;
-    if (!rm_detail::split_url(pool.k8s_api, &host, &port)) return;
+    if (!rm_detail::split_url(pool.k8s_api, &host, &port)) return 0;
     std::vector<std::pair<std::string, std::string>> headers;
     if (!pool.k8s_token.empty()) {
       headers.push_back({"Authorization", "Bearer " + pool.k8s_token});
     }
-    http_stream_lines(
+    return http_stream_lines(
         host, port,
         jobs_path(pool) + "?watch=1&timeoutSeconds=" + std::to_string(timeout_sec),
         [&](const std::string& line) {

@@ -311,3 +311,83 @@ def test_lr_schedule_surfaced_in_metrics(tmp_path):
 
     first = float(np.asarray(trainer.state.metric_acc["lr"]))
     assert 0 < first <= 1e-2  # step-0 rate of the linear schedule
+
+
+# ---------------------------------------------------------------------------
+# async-checkpoint drain: per-rank error-flag allgather (fail fast together)
+# ---------------------------------------------------------------------------
+
+
+class _FakeDist:
+    """Stand-in multi-rank distributed context for the drain point: records
+    the allgather and returns a scripted set of per-rank error flags."""
+
+    def __init__(self, peer_flags, size=2):
+        self.size = size
+        self.is_chief = True
+        self.allgather_calls = []
+        self._peer_flags = peer_flags
+
+    def allgather(self, obj):
+        self.allgather_calls.append(obj)
+        return [obj] + list(self._peer_flags)
+
+
+def _trainer_with_pending_save(tmp_path, monkeypatch, local_write_fails=False):
+    from determined_tpu.train import serialization
+
+    ctx = make_context(tmp_path, MeshConfig(data=2))
+    trainer = train.Trainer(MnistTrial(ctx))
+    trainer._setup()
+    if local_write_fails:
+        def boom(path, tree):
+            raise OSError("disk gone")
+
+        monkeypatch.setattr(
+            "determined_tpu.train._trainer.serialization.save_arrays", boom
+        )
+    trainer._save_checkpoint()  # async dispatch; writer runs in background
+    assert trainer._pending_save is not None
+    return trainer
+
+
+def test_drain_fails_fast_when_remote_rank_writer_failed(tmp_path, monkeypatch):
+    """A healthy rank whose PEER's background writer died must raise at the
+    drain point instead of entering the collective finalize (where it would
+    hang into the 600s collective timeout waiting for the dead rank)."""
+    trainer = _trainer_with_pending_save(tmp_path, monkeypatch)
+    fake = _FakeDist(peer_flags=[True])
+    trainer.core.distributed = fake
+    finished = []
+    trainer._pending_save.finish = lambda: finished.append(True)
+    with pytest.raises(RuntimeError, match=r"rank\(s\) \[1\]"):
+        trainer._drain_pending_save()
+    assert fake.allgather_calls == [False]  # local writer was healthy
+    assert not finished  # never reached the collective finalize
+    assert trainer._pending_save is None  # drained, not retried
+
+
+def test_drain_local_failure_still_raises_with_cause(tmp_path, monkeypatch):
+    trainer = _trainer_with_pending_save(tmp_path, monkeypatch, local_write_fails=True)
+    fake = _FakeDist(peer_flags=[False])
+    trainer.core.distributed = fake
+    with pytest.raises(RuntimeError, match="failed") as ei:
+        trainer._drain_pending_save()
+    assert isinstance(ei.value.__cause__, OSError)
+    assert fake.allgather_calls == [True]  # the local failure was exchanged
+
+
+def test_drain_healthy_ranks_finalize_and_emit_stall_span(tmp_path, monkeypatch):
+    from determined_tpu.observability import get_tracer
+
+    tracer = get_tracer()
+    tracer.reset()
+    trainer = _trainer_with_pending_save(tmp_path, monkeypatch)
+    fake = _FakeDist(peer_flags=[False])
+    trainer.core.distributed = fake
+    sid = trainer._drain_pending_save()
+    assert sid is not None and trainer.latest_checkpoint == sid
+    assert fake.allgather_calls == [False]
+    # the stall span is emitted either way (healthy drain included)
+    names = [e["name"] for e in tracer.chrome_events() if e.get("ph") == "X"]
+    assert "checkpoint.stall" in names
