@@ -750,14 +750,14 @@ def lint_cmd(args) -> int:
 
     sys.path.insert(0, os.getcwd())
     diags = []
+    # path targets lint together as ONE program: the concurrency pass
+    # builds a single cross-module lock graph spanning every target, so a
+    # script taking package locks in the wrong order still forms a cycle
+    path_targets = []
     for target in args.target:
         try:
             if os.path.exists(target):
-                diags.extend(
-                    lint_mod.analyze_path(
-                        target, rules=args.rule or None, disabled=args.suppress or None
-                    )
-                )
+                path_targets.append(target)
             elif ":" in target or "." in target:
                 diags.extend(
                     lint_mod.analyze_entrypoint(
@@ -772,6 +772,18 @@ def lint_cmd(args) -> int:
             # arbitrary user module code; ANY failure there is "target
             # unloadable" (exit 2), never "findings present" (exit 1)
             print(f"error: cannot lint {target}: {e}", file=sys.stderr)
+            return 2
+    if path_targets:
+        try:
+            diags.extend(
+                lint_mod.analyze_paths(
+                    path_targets, rules=args.rule or None,
+                    disabled=args.suppress or None,
+                )
+            )
+        except Exception as e:  # noqa: BLE001 - unreadable file, bad rule id
+            print(f"error: cannot lint {' '.join(path_targets)}: {e}",
+                  file=sys.stderr)
             return 2
     if args.json:
         _print_json(lint_mod.to_json_payload(diags))

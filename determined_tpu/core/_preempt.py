@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import enum
 import logging
+import os
 import signal
 import threading
 import time
@@ -86,7 +87,12 @@ class PreemptContext:
         self._allocation_id = allocation_id
         self._mode = mode
         self._watcher: Optional[_PreemptionWatcher] = None
-        self._local_flag = threading.Event()
+        # a plain bool, NOT an Event: it is set from the SIGTERM handler,
+        # and Event.set takes the Event's internal Condition lock — if the
+        # signal interrupts the main thread inside simulate()'s own set()
+        # (serial-mode trials run ON the main thread) the handler would
+        # self-deadlock.  A GIL-atomic attribute write has no lock to hold.
+        self._local_flag = False
         self._acked = False
         self._started = False
         self._register_signal_handler = register_signal_handler
@@ -110,15 +116,22 @@ class PreemptContext:
         return self
 
     def _on_sigterm(self, signum, frame) -> None:
-        logger.warning("SIGTERM received: latching preemption flag")
-        self._local_flag.set()
-        if self._watcher is not None:
-            self._watcher.latch()
+        # flag-set pattern ONLY: the handler interrupts the main thread at
+        # an arbitrary bytecode boundary, so it must not touch the logging
+        # module lock or any Event's Condition lock the interrupted frame
+        # might hold.  os.write to stderr is the async-signal-tolerable way
+        # to stay visible; the watcher latch happens when the flag is next
+        # OBSERVED on a normal thread (_flag below).
+        self._local_flag = True
+        os.write(2, b"determined-tpu: SIGTERM received, latching preemption flag\n")
         if callable(self._prev_sigterm):
             self._prev_sigterm(signum, frame)
 
     def _flag(self) -> bool:
-        if self._local_flag.is_set():
+        if self._local_flag:
+            if self._watcher is not None:
+                # normal-thread context: stop the long-poll loop early
+                self._watcher.latch()
             return True
         return self._watcher.preempted if self._watcher is not None else False
 
@@ -143,8 +156,10 @@ class PreemptContext:
         return out
 
     def simulate(self) -> None:
-        """Programmatically trigger preemption (tests / local orchestrator)."""
-        self._local_flag.set()
+        """Programmatically trigger preemption (tests / local orchestrator).
+        A plain flag write, so the experiment-level signal path may call it
+        from a handler without lock-reentrancy hazards."""
+        self._local_flag = True
 
     def acknowledge_preemption_signal(self) -> None:
         """Tell the master we saw the signal and will checkpoint+exit

@@ -122,17 +122,24 @@ class ExperimentJournal:
         with self._lock:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             self._acquire_owner_lock()
-            if not fresh and os.path.exists(self.path):
-                records = _read_records(self.path)
+        # repair + replay I/O runs OUTSIDE the journal lock: a long journal
+        # is megabytes of read/rewrite/fsync, and holding _lock across it
+        # would stall any early appender for the whole repair.  Exclusion
+        # is already total here — the flock above bars other processes, and
+        # no thread of THIS process can append before open() returns.
+        records: List[Dict[str, Any]] = []
+        if not fresh and os.path.exists(self.path):
+            records = _read_records(self.path)
+            tmp = self.path + ".repair"
+            with open(tmp, "w", encoding="utf-8") as f:
                 for rec in records:
-                    self._absorb(rec)
-                tmp = self.path + ".repair"
-                with open(tmp, "w", encoding="utf-8") as f:
-                    for rec in records:
-                        f.write(json.dumps(rec, default=_json_default) + "\n")
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, self.path)
+                    f.write(json.dumps(rec, default=_json_default) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        with self._lock:
+            for rec in records:
+                self._absorb(rec)
             self._fh = open(self.path, "w" if fresh else "a", encoding="utf-8")
             return self
 
@@ -194,6 +201,11 @@ class ExperimentJournal:
             io_t0 = time.monotonic()
             self._fh.write(json.dumps(rec, default=_json_default) + "\n")
             self._fh.flush()
+            # The fsync IS the append: a record the caller saw land must
+            # survive SIGKILL (WAL contract), and appenders must serialize
+            # behind the same durability point or seq order and file order
+            # could diverge.  Bounded (one record) + traced (journal.append).
+            # dtpu: lint-ok[blocking-under-lock]
             os.fsync(self._fh.fileno())
             # append+fsync latency: trial threads block here inside their
             # searcher events, so a slow disk shows up in the goodput
@@ -214,6 +226,11 @@ class ExperimentJournal:
                 and self._since_compact >= self.compact_interval
                 and rec_type == "searcher_snapshot"
             ):
+                # Compaction must swap the file while NO append is
+                # mid-write — the lock is the atomicity, not an accident;
+                # it runs once per compact_interval appends and the heavy
+                # follow-up work (GC) already happens outside on_compact.
+                # dtpu: lint-ok[blocking-under-lock]
                 self._compact_locked()
                 compacted = True
         if compacted and self._on_compact is not None:
@@ -439,17 +456,29 @@ class JournaledSearcher(Searcher):
                 )
         self.journal.append("searcher_snapshot", state=json.loads(self._state_json_locked()))
 
+    # The four lifecycle methods below append (fsync) INSIDE the searcher
+    # lock on purpose — it is the journal's consistency model (class
+    # docstring): event + snapshot must be strictly ordered with the state
+    # change they describe, or a crash could persist a snapshot that
+    # contradicts its own event stream.  The cost is one bounded, traced
+    # fsync per searcher event; the lock order stays one-way
+    # (searcher -> journal), which the lock-order-cycle rule verifies.
+
     def start(self) -> List[Any]:
         with self._lock:
             already = self._started
             actions = super().start()
             if not already:
+                # fsync-under-searcher-lock is the WAL ordering contract
+                # dtpu: lint-ok[blocking-under-lock]
                 self._journal_event(None, {}, actions)
             return actions
 
     def on_validation(self, request_id: int, metrics: Dict[str, Any]) -> List[Any]:
         with self._lock:
             actions = super().on_validation(request_id, metrics)
+            # fsync-under-searcher-lock is the WAL ordering contract
+            # dtpu: lint-ok[blocking-under-lock]
             self._journal_event(
                 "trial_validated",
                 {"rid": request_id, "metrics": dict(metrics)},
@@ -460,12 +489,16 @@ class JournaledSearcher(Searcher):
     def on_trial_exited(self, request_id: int) -> List[Any]:
         with self._lock:
             actions = super().on_trial_exited(request_id)
+            # fsync-under-searcher-lock is the WAL ordering contract
+            # dtpu: lint-ok[blocking-under-lock]
             self._journal_event("trial_exited", {"rid": request_id}, actions)
             return actions
 
     def on_trial_exited_early(self, request_id: int, reason: str) -> List[Any]:
         with self._lock:
             actions = super().on_trial_exited_early(request_id, reason)
+            # fsync-under-searcher-lock is the WAL ordering contract
+            # dtpu: lint-ok[blocking-under-lock]
             self._journal_event(
                 "trial_exited_early", {"rid": request_id, "reason": reason}, actions
             )

@@ -55,6 +55,31 @@ logger = logging.getLogger("determined_tpu.experiment")
 PREEMPTED_EXIT_CODE = 75
 
 
+class _PreemptFlag:
+    """Event-shaped flag that is safe to SET from a signal handler.
+
+    ``threading.Event.set`` takes the Event's internal Condition lock; a
+    SIGTERM handler runs on the main thread at an arbitrary bytecode
+    boundary, and in serial mode the main thread IS the trial thread — if
+    the signal lands while that frame is inside the same Event's ``set``
+    (searcher-stop path) the handler deadlocks the process.  A plain
+    attribute write is GIL-atomic and holds nothing.  Only the surface the
+    drain path uses (``set``/``is_set``) exists — nothing ``wait``s on
+    experiment preemption; the scheduler polls.
+    """
+
+    __slots__ = ("_flag",)
+
+    def __init__(self) -> None:
+        self._flag = False
+
+    def set(self) -> None:
+        self._flag = True
+
+    def is_set(self) -> bool:
+        return self._flag
+
+
 @dataclasses.dataclass
 class TrialResult:
     request_id: int
@@ -107,9 +132,13 @@ class LocalExperiment:
         # mid-run while the GC pass and the drain path iterate them
         self._ckpt_lock = threading.Lock()
         self._gc_thread: Optional[threading.Thread] = None
-        self._active_trials: Dict[int, Any] = {}  # rid -> core Context
+        # rid -> core Context.  COPY-ON-WRITE: writers (trial threads)
+        # rebind a fresh dict under _active_lock; readers — including the
+        # SIGTERM handler, which must not block on any lock — snapshot the
+        # binding without locking and iterate an immutable dict.
+        self._active_trials: Dict[int, Any] = {}
         self._active_lock = threading.Lock()
-        self._preempt = threading.Event()
+        self._preempt = _PreemptFlag()
         self._prev_handlers: Dict[int, Any] = {}
 
     # -- single-trial execution -------------------------------------------
@@ -153,7 +182,9 @@ class LocalExperiment:
         searcher = self.searcher
         runner = self
         with self._active_lock:
-            self._active_trials[rid] = core_ctx
+            actives = dict(self._active_trials)
+            actives[rid] = core_ctx
+            self._active_trials = actives  # COW: readers never lock
         if self._preempt.is_set():
             # the drain request landed before this trial registered; flag it
             # now so its very first boundary checkpoints-and-exits
@@ -221,7 +252,9 @@ class LocalExperiment:
             core_ctx.train.report_validation_metrics = orig_report
             core_ctx.close()
             with self._active_lock:
-                self._active_trials.pop(rid, None)
+                actives = dict(self._active_trials)
+                actives.pop(rid, None)
+                self._active_trials = actives  # COW: readers never lock
         preempted = bool(
             self._preempt.is_set()
             and summary["stopped_early"]
@@ -487,8 +520,9 @@ class LocalExperiment:
         """Begin a graceful drain: every in-flight trial's PreemptContext
         is flagged so its Trainer checkpoints and exits at the next
         boundary; no new trials dispatch; the run returns "preempted".
-        Called by the SIGTERM/SIGINT handlers, and directly by tests and
-        embedding orchestrators."""
+        Called directly by tests and embedding orchestrators (normal
+        threads, so logging is fine); the SIGTERM/SIGINT handlers use
+        ``_request_preemption_from_signal`` instead."""
         if self._preempt.is_set():
             return
         logger.warning(
@@ -496,10 +530,33 @@ class LocalExperiment:
             "(deadline %.0fs)",
             self.config.fault_tolerance.preempt_drain_seconds,
         )
+        self._flag_active_trials()
+
+    def _request_preemption_from_signal(self) -> None:
+        """Handler-safe drain trigger: flag writes and an ``os.write`` only.
+
+        The handler interrupts the main thread mid-bytecode; in serial
+        mode the main thread IS the trial thread, so ``request_preemption``
+        — which logs (the logging module lock is not reentrant) — could
+        deadlock against the very frame it interrupted.  Everything here
+        is a plain attribute write: ``_PreemptFlag.set``, the COW
+        ``_active_trials`` snapshot, and ``PreemptContext.simulate``
+        (also a bare flag since the same hardening pass).
+        """
+        if self._preempt.is_set():
+            return
+        os.write(
+            2,
+            b"determined-tpu: preemption signal received, draining in-flight "
+            b"trials to checkpoints\n",
+        )
+        self._flag_active_trials()
+
+    def _flag_active_trials(self) -> None:
         self._preempt.set()
-        with self._active_lock:
-            ctxs = list(self._active_trials.values())
-        for ctx in ctxs:
+        # COW snapshot: _active_trials is rebound, never mutated in place,
+        # so iterating the current binding needs no lock (signal-safe)
+        for ctx in list(self._active_trials.values()):
             ctx.preempt.simulate()
 
     def _install_signal_handlers(self) -> None:
@@ -517,7 +574,7 @@ class LocalExperiment:
             prev = signal.getsignal(sig)
 
             def handler(signum: int, frame: Any, _prev: Any = prev) -> None:
-                self.request_preemption()
+                self._request_preemption_from_signal()
                 # chain a real prior handler; never the default SIGINT
                 # KeyboardInterrupt raiser — that would abort the drain
                 if callable(_prev) and _prev is not signal.default_int_handler:

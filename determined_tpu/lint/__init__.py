@@ -28,6 +28,7 @@ from determined_tpu.lint._ast import (
     analyze_entrypoint,
     analyze_file,
     analyze_path,
+    analyze_paths,
     analyze_source,
 )
 from determined_tpu.lint._diag import (
@@ -39,6 +40,8 @@ from determined_tpu.lint._diag import (
     to_json_payload,
 )
 from determined_tpu.lint._runtime import (
+    LockOrderSentinel,
+    LockOrderViolation,
     RetraceSentinel,
     ThreadLeakChecker,
     ThreadLeakError,
@@ -65,6 +68,8 @@ __all__ = [
     "Diagnostic",
     "ERROR",
     "LintError",
+    "LockOrderSentinel",
+    "LockOrderViolation",
     "RetraceSentinel",
     "SCHEMA_VERSION",
     "ThreadLeakChecker",
@@ -75,6 +80,7 @@ __all__ = [
     "analyze_entrypoint",
     "analyze_file",
     "analyze_path",
+    "analyze_paths",
     "analyze_source",
     "check_trial",
     "get_retrace_sentinel",

@@ -403,8 +403,15 @@ def analyze_source(
     disabled: Optional[Sequence[str]] = None,
     line_offset: int = 0,
     assume_trial_classes: Optional[Set[str]] = None,
+    _program: bool = True,
 ) -> List[Diagnostic]:
-    """Analyze one module's source; returns sorted diagnostics."""
+    """Analyze one module's source; returns sorted diagnostics.
+
+    Program-level rules (the concurrency pass) run over this one module
+    too, so a self-contained fixture shows its lock cycle without a
+    directory; ``analyze_path``/``analyze_paths`` pass ``_program=False``
+    per file and run ONE cross-module pass over the whole target instead.
+    """
     from determined_tpu.lint.rules import build_rules
 
     ctx = LintContext(
@@ -427,10 +434,23 @@ def analyze_source(
             )
         ]
     rule_objs = build_rules(only=rules, disabled=disabled)
-    for rule in rule_objs:
+    walker_rules = [r for r in rule_objs if not r.program_level]
+    program_rules = [r for r in rule_objs if r.program_level]
+    for rule in walker_rules:
         rule.before_module(tree, ctx)
-    _Walker(ctx, rule_objs).visit(tree)
-    return sorted(ctx.diagnostics, key=lambda d: (d.file, d.line, d.col, d.rule))
+    _Walker(ctx, walker_rules).visit(tree)
+    diags = list(ctx.diagnostics)
+    if _program and program_rules:
+        from determined_tpu.lint._concurrency import analyze_program_sources
+
+        diags.extend(
+            analyze_program_sources(
+                {filename: source},
+                program_rules,
+                line_offsets={filename: line_offset},
+            )
+        )
+    return sorted(diags, key=lambda d: (d.file, d.line, d.col, d.rule))
 
 
 def analyze_file(path: str, **kwargs: Any) -> List[Diagnostic]:
@@ -438,17 +458,68 @@ def analyze_file(path: str, **kwargs: Any) -> List[Diagnostic]:
         return analyze_source(f.read(), filename=path, **kwargs)
 
 
+def analyze_paths(
+    paths: Sequence[str],
+    *,
+    rules: Optional[Sequence[str]] = None,
+    disabled: Optional[Sequence[str]] = None,
+    **file_kwargs: Any,
+) -> List[Diagnostic]:
+    """Lint several files/directories as ONE program.
+
+    Per-module rules run file by file; the program-level concurrency pass
+    runs once over the union, so a lock bound in one target and acquired
+    under another target's lock still forms a graph edge (``scripts/`` and
+    ``bench.py`` import ``determined_tpu`` — their lock use belongs in the
+    package's graph, which is why ``scripts/lint.sh`` passes every target
+    in a single invocation).
+
+    Extra keyword args (``assume_trial_classes`` etc.) are forwarded to
+    the per-module ``analyze_source`` pass for every file, keeping
+    ``analyze_path``'s directory mode on its historical contract.
+    """
+    from determined_tpu.lint._concurrency import (
+        analyze_program_sources,
+        collect_py_files,
+    )
+    from determined_tpu.lint.rules import build_rules
+
+    rule_objs = build_rules(only=rules, disabled=disabled)
+    program_rules = [r.id for r in rule_objs if r.program_level]
+    files: List[str] = []
+    seen_real: Set[str] = set()
+    for path in paths:
+        for f in collect_py_files(path):
+            # overlapping targets can spell one physical file two ways
+            # (`dtpu lint pkg ./pkg/mod.py`); linting it twice doubles
+            # every finding and forks its module identity in the index
+            key = os.path.realpath(f)
+            if key not in seen_real:
+                seen_real.add(key)
+                files.append(f)
+    out: List[Diagnostic] = []
+    sources: Dict[str, str] = {}
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            sources[f] = fh.read()
+        out.extend(
+            analyze_source(
+                sources[f], filename=f, rules=rules, disabled=disabled,
+                _program=False, **file_kwargs,
+            )
+        )
+    if program_rules:
+        program_objs = [r for r in rule_objs if r.program_level]
+        out.extend(analyze_program_sources(sources, program_objs))
+    return sorted(out, key=lambda d: (d.file, d.line, d.col, d.rule))
+
+
 def analyze_path(path: str, **kwargs: Any) -> List[Diagnostic]:
-    """Lint a .py file or recursively every .py file under a directory."""
+    """Lint a .py file or recursively every .py file under a directory
+    (one whole-program concurrency pass across the directory)."""
     if os.path.isfile(path):
         return analyze_file(path, **kwargs)
-    out: List[Diagnostic] = []
-    for root, dirs, files in os.walk(path):
-        dirs[:] = sorted(d for d in dirs if d != "__pycache__" and not d.startswith("."))
-        for name in sorted(files):
-            if name.endswith(".py"):
-                out.extend(analyze_file(os.path.join(root, name), **kwargs))
-    return out
+    return analyze_paths([path], **kwargs)
 
 
 def analyze_class(trial_cls: type, **kwargs: Any) -> List[Diagnostic]:

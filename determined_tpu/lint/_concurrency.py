@@ -1,0 +1,1167 @@
+"""Cross-module concurrency analysis: lock graphs, blocking-under-lock,
+signal-handler safety.
+
+This is the whole-program half of the preflight analyzer.  The per-module
+walker (``_ast.py``) sees one file at a time; a lock-order inversion is a
+property of the PROGRAM — the ``searcher -> journal`` invariant involves a
+lock in ``searcher/_searcher.py`` and one in ``experiment/journal.py``,
+connected by calls in ``experiment/local.py``.  So this pass:
+
+1. indexes every module in the lint target: lock objects (``threading.Lock
+   / RLock / Condition / Semaphore`` bound in ``__init__``, at module
+   scope, or to function locals — the same ctor inference
+   ``unlocked-shared-state`` uses), class attribute types
+   (``self._journal = ExperimentJournal(...)``), imports, methods, nested
+   functions, and ``signal.signal`` registrations;
+2. resolves ``with lock:`` regions and the calls made inside them ACROSS
+   module boundaries (``self.method``, ``self.attr.method`` via the
+   attr-type map, module functions, ``from x import y`` / ``import x.y``
+   targets, base-class methods), building a lock-acquisition graph whose
+   edges carry witness call chains;
+3. reports cycles in that graph (``lock-order-cycle``), blocking calls
+   reached while a lock is held (``blocking-under-lock``, one diagnostic
+   per site with the held-lock chain), and signal handlers whose bodies
+   go beyond the flag-set pattern (``signal-handler-unsafe``).
+
+Resolution is deliberately conservative: a call the index cannot resolve
+contributes nothing (no guessed edges, no guessed blocking), so every
+finding names a concrete path.  The runtime ``LockOrderSentinel``
+(``_runtime.py``) covers the dynamic dispatch this pass cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from determined_tpu.lint._ast import dotted_name, parse_suppressions
+from determined_tpu.lint._diag import Diagnostic
+
+#: ctor basenames -> primitive kind (lock kinds participate in the graph)
+_LOCK_CTORS = {
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+    "Semaphore": "Semaphore",
+    "BoundedSemaphore": "Semaphore",
+}
+_QUEUE_CTORS = frozenset({"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"})
+_EVENT_CTORS = frozenset({"Event"})
+_THREAD_CTORS = frozenset({"Thread", "Timer"})
+
+#: calls that block by dotted name (exact match)
+_BLOCKING_CALLS = {
+    "os.fsync": "fsync",
+    "os.replace": "atomic-replace",
+    "os.rename": "atomic-replace",
+    "shutil.rmtree": "tree-io",
+    "shutil.copytree": "tree-io",
+    "shutil.move": "tree-io",
+    "time.sleep": "sleep",
+    "subprocess.run": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "subprocess.Popen": "subprocess",
+    "jax.device_get": "device-sync",
+    "jax.block_until_ready": "device-sync",
+}
+#: calls that block when the callable's LAST name segment contains the key
+#: (catches wrappers like ``_tls_urlopen`` alongside ``urllib.request.urlopen``)
+_BLOCKING_LAST_SEGMENT = {
+    "block_until_ready": "device-sync",
+    "urlopen": "net-io",
+    "getresponse": "net-io",
+}
+_REQUESTS_METHODS = frozenset(
+    {"get", "post", "put", "delete", "head", "patch", "request", "send"}
+)
+_LOG_METHODS = frozenset(
+    {"debug", "info", "warning", "warn", "error", "exception", "critical", "log"}
+)
+#: handler calls that ARE the flag-set pattern (async-signal-tolerable)
+_HANDLER_SAFE_CALLS = frozenset(
+    {
+        "os.write",
+        "os.kill",
+        "os._exit",
+        "sys.exit",
+        "signal.signal",
+        "signal.getsignal",
+        "callable",
+        "getattr",
+        "setattr",
+        "list",
+        "dict",
+        "tuple",
+    }
+)
+
+_MAX_CALL_DEPTH = 8
+
+
+class LockDef:
+    """One lock object, identified by where it is BOUND (not where it is
+    used), so every use site across modules maps to the same node."""
+
+    def __init__(self, lock_id: str, kind: str, module: str, line: int) -> None:
+        self.id = lock_id
+        self.kind = kind  # Lock | RLock | Condition | Semaphore
+        self.module = module
+        self.line = line
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"LockDef({self.id})"
+
+
+class FuncInfo:
+    """One function/method (including nested defs) with its lexical home."""
+
+    def __init__(
+        self,
+        qname: str,
+        node: ast.AST,
+        module: "ModuleInfo",
+        cls: Optional["ClassInfo"],
+        parent: Optional["FuncInfo"],
+    ) -> None:
+        self.qname = qname
+        self.node = node
+        self.module = module
+        self.cls = cls
+        self.parent = parent
+        self.children: Dict[str, "FuncInfo"] = {}
+        #: locals bound to sync primitives / threads inside this function
+        self.local_kinds: Dict[str, str] = {}
+        self.local_locks: Dict[str, LockDef] = {}
+
+
+class ClassInfo:
+    def __init__(self, name: str, node: ast.ClassDef, module: "ModuleInfo") -> None:
+        self.name = name
+        self.node = node
+        self.module = module
+        self.bases: List[str] = [
+            b for b in (dotted_name(base) for base in node.bases) if b
+        ]
+        self.methods: Dict[str, FuncInfo] = {}
+        self.lock_attrs: Dict[str, LockDef] = {}
+        #: self.<attr> -> primitive kind ("Queue"/"Event"/"Thread"/...)
+        self.attr_kinds: Dict[str, str] = {}
+        #: self.<attr> -> dotted ctor name, for cross-class call resolution
+        self.attr_ctors: Dict[str, str] = {}
+
+
+class ModuleInfo:
+    def __init__(self, name: str, filename: str, source: str, tree: ast.Module) -> None:
+        self.name = name
+        self.filename = filename
+        self.source = source
+        self.tree = tree
+        self.line_offset = 0
+        self.suppressions = parse_suppressions(source)
+        #: local alias -> imported module name (``import x.y as z``)
+        self.imports: Dict[str, str] = {}
+        #: local name -> (from-module, original name)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.module_locks: Dict[str, LockDef] = {}
+        self.module_kinds: Dict[str, str] = {}
+        #: (call node, handler expr, enclosing FuncInfo or None)
+        self.signal_registrations: List[
+            Tuple[ast.Call, ast.AST, Optional[FuncInfo]]
+        ] = []
+
+
+def _ctor_kind(value: ast.AST) -> Optional[str]:
+    """Primitive kind for ``<name> = <Ctor>(...)`` assignments."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    if not name:
+        return None
+    base = name.split(".")[-1]
+    if base in _LOCK_CTORS:
+        return _LOCK_CTORS[base]
+    if base in _QUEUE_CTORS:
+        return "Queue"
+    if base in _EVENT_CTORS:
+        return "Event"
+    if base in _THREAD_CTORS:
+        return "Thread"
+    return None
+
+
+def _annotation_class(ann: Optional[ast.AST]) -> Optional[str]:
+    """Dotted class name out of an attribute annotation, unwrapping
+    ``Optional[...]``-style typing wrappers — ``self.journal:
+    Optional[ExperimentJournal] = None`` types the attr for call
+    resolution even though its ctor runs later."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Subscript):
+        base = dotted_name(ann.value)
+        if base and base.split(".")[-1] in ("Optional", "Final", "ClassVar"):
+            return _annotation_class(ann.slice)
+        return None
+    name = dotted_name(ann)
+    if name and name.split(".")[-1] not in ("Any", "None", "object"):
+        return name
+    return None
+
+
+def _walk_pruning_defs(root: ast.AST):
+    """``ast.walk`` minus nested function/lambda SUBTREES (the root may
+    itself be a def — only NESTED defs are pruned).  ``ast.walk`` with an
+    isinstance-``continue`` only skips the def node itself and still
+    yields its children, so a name rebound inside a nested def would leak
+    into the enclosing scope's bindings (splitting one lock into two
+    graph identities)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        if node is not root and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _assign_pairs(stmt: ast.stmt) -> List[Tuple[ast.AST, ast.AST]]:
+    """(target, value) pairs for Assign/AnnAssign statements."""
+    if isinstance(stmt, ast.Assign) and stmt.value is not None:
+        return [(t, stmt.value) for t in stmt.targets]
+    if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        return [(stmt.target, stmt.value)]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# index construction
+# ---------------------------------------------------------------------------
+
+
+class _ModuleIndexer(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo) -> None:
+        self.mod = mod
+        self._cls: Optional[ClassInfo] = None
+        self._fn: Optional[FuncInfo] = None
+
+    # -- imports (collected at any depth: local imports resolve calls too)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.asname:
+                self.mod.imports[alias.asname] = alias.name
+            else:
+                top = alias.name.split(".")[0]
+                self.mod.imports[top] = top
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module is None or node.level:
+            return  # relative imports: not used in this codebase
+        for alias in node.names:
+            self.mod.from_imports[alias.asname or alias.name] = (
+                node.module,
+                alias.name,
+            )
+
+    # -- scopes
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if self._cls is not None or self._fn is not None:
+            return  # nested classes: out of scope
+        cls = ClassInfo(node.name, node, self.mod)
+        self.mod.classes[node.name] = cls
+        self._cls = cls
+        for stmt in node.body:
+            self.visit(stmt)
+        self._cls = None
+
+    def _visit_fn(self, node: ast.AST) -> None:
+        name = getattr(node, "name", "<lambda>")
+        if self._fn is not None:
+            qname = f"{self._fn.qname}.{name}"
+            fn = FuncInfo(qname, node, self.mod, self._fn.cls, self._fn)
+            self._fn.children[name] = fn
+        elif self._cls is not None:
+            qname = f"{self.mod.name}:{self._cls.name}.{name}"
+            fn = FuncInfo(qname, node, self.mod, self._cls, None)
+            self._cls.methods[name] = fn
+        else:
+            qname = f"{self.mod.name}:{name}"
+            fn = FuncInfo(qname, node, self.mod, None, None)
+            self.mod.functions[name] = fn
+
+        if self._cls is not None and name == "__init__" and self._fn is None:
+            self._scan_init(node, self._cls)
+        self._scan_locals(node, fn)
+
+        prev, self._fn = self._fn, fn
+        for stmt in getattr(node, "body", []):
+            self.visit(stmt)
+        self._fn = prev
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _scan_init(self, init: ast.AST, cls: ClassInfo) -> None:
+        for stmt in _walk_pruning_defs(init):
+            for target, value in _assign_pairs(stmt):
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    continue
+                kind = _ctor_kind(value)
+                if kind in _LOCK_CTORS.values():
+                    cls.lock_attrs[target.attr] = LockDef(
+                        f"{self.mod.name}:{cls.name}.{target.attr}",
+                        kind,
+                        self.mod.name,
+                        getattr(stmt, "lineno", 1),
+                    )
+                elif kind is not None:
+                    cls.attr_kinds[target.attr] = kind
+                elif isinstance(value, ast.Call):
+                    ctor = dotted_name(value.func)
+                    if ctor:
+                        cls.attr_ctors[target.attr] = ctor
+            # annotation-only / None-initialized attrs: the annotation is
+            # the only type evidence (`self.journal: Optional[Journal]`)
+            if (
+                isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Attribute)
+                and isinstance(stmt.target.value, ast.Name)
+                and stmt.target.value.id == "self"
+                and stmt.target.attr not in cls.attr_ctors
+                and stmt.target.attr not in cls.lock_attrs
+                and _ctor_kind(stmt.value) is None
+            ):
+                ctor = _annotation_class(stmt.annotation)
+                if ctor:
+                    cls.attr_ctors[stmt.target.attr] = ctor
+
+    def _scan_locals(self, node: ast.AST, fn: FuncInfo) -> None:
+        """Function-local primitive bindings (NOT descending into nested
+        defs — those get their own FuncInfo; closures look upward)."""
+        for sub in _walk_pruning_defs(node):
+            for target, value in _assign_pairs(sub):
+                if not isinstance(target, ast.Name):
+                    continue
+                kind = _ctor_kind(value)
+                if kind in _LOCK_CTORS.values():
+                    fn.local_locks[target.id] = LockDef(
+                        f"{fn.qname}.{target.id}",
+                        kind,
+                        self.mod.name,
+                        getattr(sub, "lineno", 1),
+                    )
+                elif kind is not None:
+                    fn.local_kinds[target.id] = kind
+
+    # -- module-level locks + signal registrations
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._module_binding(node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._module_binding(node)
+        self.generic_visit(node)
+
+    def _module_binding(self, node: ast.stmt) -> None:
+        if self._cls is not None or self._fn is not None:
+            return
+        for target, value in _assign_pairs(node):
+            if not isinstance(target, ast.Name):
+                continue
+            kind = _ctor_kind(value)
+            if kind in _LOCK_CTORS.values():
+                self.mod.module_locks[target.id] = LockDef(
+                    f"{self.mod.name}:{target.id}",
+                    kind,
+                    self.mod.name,
+                    getattr(node, "lineno", 1),
+                )
+            elif kind is not None:
+                self.mod.module_kinds[target.id] = kind
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        if name and name.split(".")[-1] == "signal" and len(node.args) >= 2:
+            base = name.rsplit(".", 1)[0] if "." in name else ""
+            if base in ("signal", "", "_signal"):
+                self.mod.signal_registrations.append(
+                    (node, node.args[1], self._fn)
+                )
+        self.generic_visit(node)
+
+
+def _module_name_for(path: str) -> str:
+    """Dotted module name by walking up through ``__init__.py`` packages;
+    plain scripts keep their stem (they import the package, never the
+    reverse — ``ProgramIndex.add_source`` de-collides stems that repeat)."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    name = ".".join(reversed(parts))
+    return name[: -len(".__init__")] if name.endswith(".__init__") else name
+
+
+# ---------------------------------------------------------------------------
+# program index + resolution
+# ---------------------------------------------------------------------------
+
+
+class ProgramIndex:
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_file: Dict[str, ModuleInfo] = {}
+
+    def add_source(
+        self, filename: str, source: str, *, line_offset: int = 0
+    ) -> Optional[ModuleInfo]:
+        try:
+            tree = ast.parse(source, filename=filename)
+        except SyntaxError:
+            return None  # the per-module pass already reports parse errors
+        name = _module_name_for(filename)
+        if name in self.modules and self.modules[name].filename != filename:
+            # plain scripts can share a stem (examples/*/model_def.py);
+            # each must stay in the index — they are never import targets,
+            # so a mangled key loses no resolution, only collisions
+            serial = 2
+            while f"{name}~{serial}" in self.modules:
+                serial += 1
+            name = f"{name}~{serial}"
+        mod = ModuleInfo(name, filename, source, tree)
+        mod.line_offset = line_offset
+        _ModuleIndexer(mod).visit(tree)
+        self.modules[mod.name] = mod
+        self.by_file[filename] = mod
+        return mod
+
+    # -- name resolution ---------------------------------------------------
+
+    def resolve_module(self, mod: ModuleInfo, alias: str) -> Optional[ModuleInfo]:
+        if alias in mod.imports:
+            return self.modules.get(mod.imports[alias])
+        if alias in mod.from_imports:
+            base, orig = mod.from_imports[alias]
+            # ``from pkg import submodule`` imports a MODULE object
+            return self.modules.get(f"{base}.{orig}")
+        return None
+
+    def resolve_class(self, mod: ModuleInfo, dotted: str) -> Optional[ClassInfo]:
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            name = parts[0]
+            if name in mod.classes:
+                return mod.classes[name]
+            if name in mod.from_imports:
+                base, orig = mod.from_imports[name]
+                target = self.modules.get(base)
+                if target is not None and orig in target.classes:
+                    return target.classes[orig]
+                # ``from pkg import Name`` re-exported through __init__
+                for cand in self.modules.values():
+                    if cand.name.startswith(base + ".") and orig in cand.classes:
+                        return cand.classes[orig]
+            return None
+        owner = self.resolve_module(mod, parts[0])
+        if owner is not None and len(parts) == 2:
+            return owner.classes.get(parts[1])
+        return None
+
+    def class_lock_attr(self, cls: ClassInfo, attr: str) -> Optional[LockDef]:
+        """Lock attr on the class or a resolvable base (JournaledSearcher
+        uses the RLock its Searcher base binds)."""
+        seen: Set[str] = set()
+        work = [cls]
+        while work:
+            c = work.pop(0)
+            if c.name in seen:
+                continue
+            seen.add(c.name)
+            if attr in c.lock_attrs:
+                return c.lock_attrs[attr]
+            for base in c.bases:
+                b = self.resolve_class(c.module, base)
+                if b is not None:
+                    work.append(b)
+        return None
+
+    def class_attr_kind(self, cls: ClassInfo, attr: str) -> Optional[str]:
+        seen: Set[str] = set()
+        work = [cls]
+        while work:
+            c = work.pop(0)
+            if c.name in seen:
+                continue
+            seen.add(c.name)
+            if attr in c.attr_kinds:
+                return c.attr_kinds[attr]
+            for base in c.bases:
+                b = self.resolve_class(c.module, base)
+                if b is not None:
+                    work.append(b)
+        return None
+
+    def class_method(self, cls: ClassInfo, name: str) -> Optional[FuncInfo]:
+        seen: Set[str] = set()
+        work = [cls]
+        while work:
+            c = work.pop(0)
+            if c.name in seen:
+                continue
+            seen.add(c.name)
+            if name in c.methods:
+                return c.methods[name]
+            for base in c.bases:
+                b = self.resolve_class(c.module, base)
+                if b is not None:
+                    work.append(b)
+        return None
+
+    def resolve_lock(self, fn: FuncInfo, expr: ast.AST) -> Optional[LockDef]:
+        """LockDef for a ``with``-item / ``.acquire()`` receiver, or None."""
+        name = dotted_name(expr)
+        if not name:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2 and fn.cls is not None:
+            return self.class_lock_attr(fn.cls, parts[1])
+        if len(parts) == 1:
+            scope: Optional[FuncInfo] = fn
+            while scope is not None:  # closure: locks bound in outer defs
+                if parts[0] in scope.local_locks:
+                    return scope.local_locks[parts[0]]
+                scope = scope.parent
+            return fn.module.module_locks.get(parts[0])
+        if len(parts) == 2:
+            owner = self.resolve_module(fn.module, parts[0])
+            if owner is not None:
+                return owner.module_locks.get(parts[1])
+        return None
+
+    def receiver_kind(self, fn: FuncInfo, expr: ast.AST) -> Optional[str]:
+        """Primitive kind of a method-call receiver (Queue/Event/Thread/
+        lock kinds), resolved through self attrs, locals, closures, and
+        module bindings."""
+        name = dotted_name(expr)
+        if not name:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2 and fn.cls is not None:
+            lock = self.class_lock_attr(fn.cls, parts[1])
+            if lock is not None:
+                return lock.kind
+            return self.class_attr_kind(fn.cls, parts[1])
+        if len(parts) == 1:
+            scope: Optional[FuncInfo] = fn
+            while scope is not None:
+                if parts[0] in scope.local_locks:
+                    return scope.local_locks[parts[0]].kind
+                if parts[0] in scope.local_kinds:
+                    return scope.local_kinds[parts[0]]
+                scope = scope.parent
+            if parts[0] in fn.module.module_locks:
+                return fn.module.module_locks[parts[0]].kind
+            return fn.module.module_kinds.get(parts[0])
+        return None
+
+    def resolve_call(self, fn: FuncInfo, node: ast.Call) -> Optional[FuncInfo]:
+        """Callee FuncInfo for a call expression, or None (conservative)."""
+        func = node.func
+        name = dotted_name(func)
+        if not name:
+            return None
+        parts = name.split(".")
+        # self.method() / self.attr.method()
+        if parts[0] == "self" and fn.cls is not None:
+            if len(parts) == 2:
+                return self.class_method(fn.cls, parts[1])
+            if len(parts) == 3:
+                ctor = fn.cls.attr_ctors.get(parts[1])
+                if ctor:
+                    target = self.resolve_class(fn.module, ctor)
+                    if target is not None:
+                        return self.class_method(target, parts[2])
+            return None
+        if len(parts) == 1:
+            scope: Optional[FuncInfo] = fn
+            while scope is not None:  # nested defs call siblings/outer
+                if parts[0] in scope.children:
+                    return scope.children[parts[0]]
+                scope = scope.parent
+            if parts[0] in fn.module.functions:
+                return fn.module.functions[parts[0]]
+            if parts[0] in fn.module.from_imports:
+                base, orig = fn.module.from_imports[parts[0]]
+                target = self.modules.get(base)
+                if target is not None:
+                    if orig in target.functions:
+                        return target.functions[orig]
+                    if orig in target.classes:  # Ctor() runs __init__
+                        return target.classes[orig].methods.get("__init__")
+            if parts[0] in fn.module.classes:
+                return fn.module.classes[parts[0]].methods.get("__init__")
+            return None
+        if len(parts) == 2:
+            owner = self.resolve_module(fn.module, parts[0])
+            if owner is not None:
+                if parts[1] in owner.functions:
+                    return owner.functions[parts[1]]
+                if parts[1] in owner.classes:
+                    return owner.classes[parts[1]].methods.get("__init__")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# per-function event extraction + transitive summaries
+# ---------------------------------------------------------------------------
+
+
+class _Event:
+    """One acquire / blocking-call / resolvable-call inside a function,
+    with the locks lexically held at that point IN THIS FUNCTION."""
+
+    __slots__ = (
+        "kind", "node", "held", "lock", "category", "label", "callee", "exempt",
+    )
+
+    def __init__(self, kind: str, node: ast.AST, held: Tuple[LockDef, ...]) -> None:
+        self.kind = kind  # "acquire" | "blocking" | "call"
+        self.node = node
+        self.held = held
+        self.lock: Optional[LockDef] = None
+        self.category = ""
+        self.label = ""
+        self.callee: Optional[FuncInfo] = None
+        #: excluded from the direct per-site report (e.g. the CV-wait
+        #: idiom) but still visible to transitive summaries
+        self.exempt = False
+
+
+def _blocking_category(
+    index: ProgramIndex, fn: FuncInfo, node: ast.Call
+) -> Optional[Tuple[str, str]]:
+    """(category, label) when this call blocks, else None."""
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    if name in _BLOCKING_CALLS:
+        return _BLOCKING_CALLS[name], name
+    last = name.split(".")[-1]
+    for needle, cat in _BLOCKING_LAST_SEGMENT.items():
+        if needle in last:
+            return cat, name
+    parts = name.split(".")
+    if parts[0] == "requests" and len(parts) == 2 and parts[1] in _REQUESTS_METHODS:
+        return "net-io", name
+    if len(parts) >= 2 and isinstance(node.func, ast.Attribute):
+        attr = parts[-1]
+        recv = node.func.value
+        kind = index.receiver_kind(fn, recv)
+        if attr in ("get", "put") and kind == "Queue":
+            for kw in node.keywords:
+                if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is False:
+                    return None
+            # positional block flag: get(block, ...) / put(item, block, ...)
+            block_idx = 0 if attr == "get" else 1
+            if len(node.args) > block_idx \
+                    and isinstance(node.args[block_idx], ast.Constant) \
+                    and node.args[block_idx].value is False:
+                return None
+            return "queue-block", name
+        if attr == "wait" and kind in ("Event", "Condition"):
+            return "sync-wait", name
+        if attr == "join" and (
+            kind == "Thread"
+            or "thread" in parts[-2].lower()
+            or "worker" in parts[-2].lower()
+        ):
+            return "thread-join", name
+    return None
+
+
+def _is_cv_wait_on_held(
+    index: ProgramIndex,
+    fn: FuncInfo,
+    node: ast.Call,
+    held: Tuple[LockDef, ...],
+) -> bool:
+    """True for ``cond.wait()`` while ``cond`` itself is among the held
+    locks — the canonical condition-variable idiom (``with self._cond:
+    while not pred: self._cond.wait()``).  ``wait`` RELEASES the lock it
+    blocks on, so this is not blocking-under-lock; waiting on a condition
+    while ALSO holding some other lock still is (only the condition's own
+    lock is released for the duration of the wait)."""
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    lock = index.resolve_lock(fn, node.func.value)
+    return lock is not None and all(h.id == lock.id for h in held) and bool(held)
+
+
+def _is_logging_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if not name or "." not in name:
+        return False
+    parts = name.split(".")
+    return parts[-1] in _LOG_METHODS and parts[-2] in ("logger", "logging", "log")
+
+
+def _function_events(index: ProgramIndex, fn: FuncInfo) -> List[_Event]:
+    events: List[_Event] = []
+
+    def walk(node: ast.AST, held: Tuple[LockDef, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested defs run later, under whatever locks THEIR caller holds
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                walk(item.context_expr, held)
+                lock = index.resolve_lock(fn, item.context_expr)
+                if lock is not None:
+                    ev = _Event("acquire", item.context_expr, inner)
+                    ev.lock = lock
+                    events.append(ev)
+                    inner = inner + (lock,)
+            for child in node.body:
+                walk(child, inner)
+            return
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name.endswith(".acquire"):
+                recv = node.func.value if isinstance(node.func, ast.Attribute) else None
+                lock = index.resolve_lock(fn, recv) if recv is not None else None
+                if lock is not None:
+                    ev = _Event("acquire", node, held)
+                    ev.lock = lock
+                    events.append(ev)
+            blocking = _blocking_category(index, fn, node)
+            if blocking is not None:
+                ev = _Event("blocking", node, held)
+                ev.category, ev.label = blocking
+                if blocking[0] == "sync-wait" and _is_cv_wait_on_held(
+                    index, fn, node, held
+                ):
+                    ev.exempt = True
+                events.append(ev)
+            if _is_logging_call(node):
+                ev = _Event("blocking", node, held)
+                ev.category, ev.label = "logging", dotted_name(node.func) or "log"
+                events.append(ev)
+            callee = index.resolve_call(fn, node)
+            if callee is not None and callee is not fn:
+                ev = _Event("call", node, held)
+                ev.callee = callee
+                events.append(ev)
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in getattr(fn.node, "body", []):
+        walk(stmt, ())
+    return events
+
+
+class _Summary:
+    """Transitive view of a function: locks it may acquire and blocking
+    operations it may perform, each with a witness call chain."""
+
+    __slots__ = ("acquires", "blocking")
+
+    def __init__(self) -> None:
+        #: lock id -> (LockDef, chain of "qname:line" hops)
+        self.acquires: Dict[str, Tuple[LockDef, Tuple[str, ...]]] = {}
+        #: (category, label, chain) — logging excluded (signal rule only)
+        self.blocking: List[Tuple[str, str, Tuple[str, ...]]] = []
+
+
+class ConcurrencyAnalyzer:
+    def __init__(self, index: ProgramIndex) -> None:
+        self.index = index
+        self._events: Dict[int, List[_Event]] = {}
+        self._summaries: Dict[int, _Summary] = {}
+        self._in_progress: Set[int] = set()
+
+    def events(self, fn: FuncInfo) -> List[_Event]:
+        key = id(fn)
+        if key not in self._events:
+            self._events[key] = _function_events(self.index, fn)
+        return self._events[key]
+
+    def summary(self, fn: FuncInfo, depth: int = 0) -> _Summary:
+        return self._summary_impl(fn, depth)[0]
+
+    def _summary_impl(self, fn: FuncInfo, depth: int) -> Tuple[_Summary, bool]:
+        """(summary, complete).  A summary truncated by the depth cap or
+        the recursion guard is returned for THIS query but never cached:
+        caching it would let the pruned view of a mutually recursive
+        function shadow the full one on every later query (a sticky
+        false negative).  Incomplete components are simply recomputed —
+        the depth cap bounds the work."""
+        key = id(fn)
+        cached = self._summaries.get(key)
+        if cached is not None:
+            return cached, True
+        out = _Summary()
+        if depth > _MAX_CALL_DEPTH or key in self._in_progress:
+            return out, False  # recursion / depth cap: contribute nothing
+        complete = True
+        self._in_progress.add(key)
+        try:
+            for ev in self.events(fn):
+                site = f"{fn.qname}:{getattr(ev.node, 'lineno', 0)}"
+                if ev.kind == "acquire" and ev.lock is not None:
+                    out.acquires.setdefault(ev.lock.id, (ev.lock, (site,)))
+                elif ev.kind == "blocking" and ev.category != "logging":
+                    out.blocking.append((ev.category, ev.label, (site,)))
+                elif ev.kind == "call" and ev.callee is not None:
+                    sub, sub_complete = self._summary_impl(ev.callee, depth + 1)
+                    complete = complete and sub_complete
+                    for lock_id, (lock, chain) in sub.acquires.items():
+                        out.acquires.setdefault(lock_id, (lock, (site,) + chain))
+                    for cat, label, chain in sub.blocking:
+                        out.blocking.append((cat, label, (site,) + chain))
+        finally:
+            self._in_progress.discard(key)
+        if complete:
+            self._summaries[key] = out
+        return out, complete
+
+    def all_functions(self) -> List[FuncInfo]:
+        out: List[FuncInfo] = []
+
+        def add(fn: FuncInfo) -> None:
+            out.append(fn)
+            for child in fn.children.values():
+                add(child)
+
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                add(fn)
+            for cls in mod.classes.values():
+                for fn in cls.methods.values():
+                    add(fn)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the pass: graph edges, cycles, blocking, signal handlers
+# ---------------------------------------------------------------------------
+
+
+class _Reporter:
+    """LintContext.report's suppression semantics, per source module."""
+
+    def __init__(self, index: ProgramIndex) -> None:
+        self.index = index
+        self.diagnostics: List[Diagnostic] = []
+        self._seen: Set[Tuple[str, str, int, str]] = set()
+
+    def report(self, rule: Any, mod: ModuleInfo, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        sup = mod.suppressions.get(line)
+        if sup is None and line in mod.suppressions:
+            return
+        if sup is not None and rule.id in sup:
+            return
+        key = (rule.id, mod.filename, line, message.split(";")[0])
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.diagnostics.append(
+            Diagnostic(
+                rule=rule.id,
+                severity=rule.severity,
+                message=message,
+                file=mod.filename,
+                line=line + mod.line_offset,
+                col=getattr(node, "col_offset", 0),
+            )
+        )
+
+
+def _chain_str(chain: Sequence[str]) -> str:
+    return " -> ".join(chain)
+
+
+def _held_str(held: Sequence[LockDef]) -> str:
+    return " -> ".join(lock.id for lock in held)
+
+
+def run_concurrency_pass(
+    index: ProgramIndex, rules: Sequence[Any]
+) -> List[Diagnostic]:
+    by_id = {r.id: r for r in rules}
+    cycle_rule = by_id.get("lock-order-cycle")
+    blocking_rule = by_id.get("blocking-under-lock")
+    signal_rule = by_id.get("signal-handler-unsafe")
+    analyzer = ConcurrencyAnalyzer(index)
+    reporter = _Reporter(index)
+
+    # -- lock graph + blocking-under-lock, one sweep over every function --
+    # edge: (held lock id, acquired lock id) -> (witness mod, node, text)
+    edges: Dict[Tuple[str, str], Tuple[ModuleInfo, ast.AST, str]] = {}
+    locks_by_id: Dict[str, LockDef] = {}
+
+    for fn in analyzer.all_functions():
+        for ev in analyzer.events(fn):
+            if not ev.held:
+                continue
+            holder = ev.held[-1]  # innermost: outer edges exist transitively
+            locks_by_id[holder.id] = holder
+            site = f"{fn.qname}:{getattr(ev.node, 'lineno', 0)}"
+            if ev.kind == "acquire" and ev.lock is not None:
+                locks_by_id[ev.lock.id] = ev.lock
+                if ev.lock.id != holder.id:
+                    edges.setdefault(
+                        (holder.id, ev.lock.id),
+                        (fn.module, ev.node, site),
+                    )
+                elif ev.lock.kind == "Lock":
+                    # non-reentrant self-acquire: guaranteed self-deadlock
+                    if cycle_rule is not None:
+                        reporter.report(
+                            cycle_rule,
+                            fn.module,
+                            ev.node,
+                            f"re-acquire of non-reentrant lock `{ev.lock.id}` "
+                            f"already held at {site}: this thread deadlocks "
+                            "itself (use RLock only if re-entry is intended)",
+                        )
+            elif ev.kind == "blocking" and ev.category != "logging" \
+                    and not ev.exempt:
+                if blocking_rule is not None:
+                    reporter.report(
+                        blocking_rule,
+                        fn.module,
+                        ev.node,
+                        f"`{ev.label}` ({ev.category}) while holding "
+                        f"{_held_str(ev.held)}: every thread contending on "
+                        "that lock stalls for the call's duration; move the "
+                        "blocking work outside the critical section",
+                    )
+            elif ev.kind == "call" and ev.callee is not None:
+                sub = analyzer.summary(ev.callee, 1)
+                for lock_id, (lock, chain) in sub.acquires.items():
+                    locks_by_id[lock_id] = lock
+                    if lock_id != holder.id:
+                        edges.setdefault(
+                            (holder.id, lock_id),
+                            (fn.module, ev.node, _chain_str((site,) + chain)),
+                        )
+                    elif lock.kind == "Lock" and cycle_rule is not None:
+                        # the callee re-takes a non-reentrant lock this
+                        # frame already holds: guaranteed self-deadlock
+                        # (self.* resolution means same instance)
+                        reporter.report(
+                            cycle_rule,
+                            fn.module,
+                            ev.node,
+                            f"call chain re-acquires non-reentrant lock "
+                            f"`{lock.id}` already held "
+                            f"(via {_chain_str((site,) + chain)}): this "
+                            "thread deadlocks itself — split an unlocked "
+                            "`_foo_locked` helper or use RLock if re-entry "
+                            "is intended",
+                        )
+                if blocking_rule is not None and sub.blocking:
+                    # one diagnostic per call site: every blocking op the
+                    # callee can reach is the same decision for the reader
+                    # (and one line-level suppression either way)
+                    cat, label, chain = sub.blocking[0]
+                    extra = len(sub.blocking) - 1
+                    more = f" (+{extra} more blocking op(s) on this path)" if extra else ""
+                    reporter.report(
+                        blocking_rule,
+                        fn.module,
+                        ev.node,
+                        f"call chain reaches `{label}` ({cat}){more} while "
+                        f"holding {_held_str(ev.held)} "
+                        f"(via {_chain_str((site,) + chain)}); move the "
+                        "blocking work outside the critical section",
+                    )
+
+    # -- cycles ------------------------------------------------------------
+    if cycle_rule is not None:
+        adj: Dict[str, List[str]] = {}
+        for (u, v) in edges:
+            adj.setdefault(u, []).append(v)
+
+        def find_path(start: str, goal: str) -> Optional[List[str]]:
+            stack = [(start, [start])]
+            visited = {start}
+            while stack:
+                cur, path = stack.pop()
+                for nxt in adj.get(cur, ()):
+                    if nxt == goal:
+                        return path + [goal]
+                    if nxt not in visited:
+                        visited.add(nxt)
+                        stack.append((nxt, path + [nxt]))
+            return None
+
+        reported_cycles: Set[Tuple[str, ...]] = set()
+        for (u, v), (mod, node, witness) in sorted(edges.items()):
+            back = find_path(v, u)
+            if back is None:
+                continue
+            canon = tuple(sorted({u, *back}))  # the cycle's node set
+            if canon in reported_cycles:
+                continue
+            reported_cycles.add(canon)
+            legs = []
+            for a, b in zip([u] + back[:-1], back):
+                leg = edges.get((a, b))
+                legs.append(f"{a} -> {b}" + (f" at {leg[2]}" if leg else ""))
+            reporter.report(
+                cycle_rule,
+                mod,
+                node,
+                "lock-order cycle (potential deadlock): "
+                + "; ".join(legs)
+                + " — pick one order and hold to it everywhere "
+                "(docs/lint.md documents the intended hierarchy)",
+            )
+
+    # -- signal handlers ---------------------------------------------------
+    if signal_rule is not None:
+        for mod in index.modules.values():
+            for node, handler_expr, fn_ctx in mod.signal_registrations:
+                _check_signal_handler(
+                    index, analyzer, reporter, signal_rule, mod, node,
+                    handler_expr, fn_ctx,
+                )
+
+    return reporter.diagnostics
+
+
+def _resolve_handler(
+    index: ProgramIndex,
+    mod: ModuleInfo,
+    expr: ast.AST,
+    fn_ctx: Optional[FuncInfo],
+) -> Optional[FuncInfo]:
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    if name.startswith("signal.SIG") or name.endswith(("SIG_IGN", "SIG_DFL")):
+        return None
+    parts = name.split(".")
+    if parts[0] == "self" and len(parts) == 2 and fn_ctx is not None \
+            and fn_ctx.cls is not None:
+        return index.class_method(fn_ctx.cls, parts[1])
+    if len(parts) == 1:
+        scope = fn_ctx
+        while scope is not None:
+            if parts[0] in scope.children:
+                return scope.children[parts[0]]
+            scope = scope.parent
+        return mod.functions.get(parts[0])
+    return None
+
+
+def _check_signal_handler(
+    index: ProgramIndex,
+    analyzer: ConcurrencyAnalyzer,
+    reporter: _Reporter,
+    rule: Any,
+    mod: ModuleInfo,
+    reg_node: ast.Call,
+    handler_expr: ast.AST,
+    fn_ctx: Optional[FuncInfo],
+) -> None:
+    problems: List[str] = []
+    if isinstance(handler_expr, ast.Lambda):
+        # a lambda body only has room for the safe patterns (sys.exit,
+        # flag writes); scan its calls directly
+        for sub in ast.walk(handler_expr.body):
+            if isinstance(sub, ast.Call):
+                name = dotted_name(sub.func) or ""
+                if name in _HANDLER_SAFE_CALLS:
+                    continue
+                cat = _BLOCKING_CALLS.get(name)
+                if cat or _is_logging_call(sub):
+                    problems.append(f"calls `{name}`")
+    else:
+        handler = _resolve_handler(index, mod, handler_expr, fn_ctx)
+        if handler is None:
+            return  # unresolvable (prev-handler variable, C-level): no claim
+        summary = analyzer.summary(handler)
+        for lock_id, (lock, chain) in summary.acquires.items():
+            problems.append(
+                f"acquires `{lock_id}` (via {_chain_str(chain)})"
+            )
+        for cat, label, chain in summary.blocking:
+            problems.append(f"reaches `{label}` ({cat}) via {_chain_str(chain)}")
+        # logging: collected separately so blocking-under-lock stays quiet
+        # about it, but a handler logging IS a deadlock (non-reentrant
+        # logging module lock, possibly held by the interrupted frame)
+        for ev in analyzer.events(handler):
+            if ev.kind == "blocking" and ev.category == "logging":
+                problems.append(
+                    f"logs via `{ev.label}` at line {getattr(ev.node, 'lineno', 0)}"
+                )
+    if problems:
+        reporter.report(
+            rule,
+            mod,
+            reg_node,
+            "signal handler goes beyond the flag-set pattern: "
+            + "; ".join(sorted(set(problems))[:4])
+            + " — handlers interrupt the main thread mid-bytecode, so any "
+            "lock (including logging's) the interrupted frame holds "
+            "deadlocks; set a plain flag and do the work on a normal thread",
+        )
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def analyze_program_sources(
+    sources: Dict[str, str],
+    rules: Sequence[Any],
+    *,
+    line_offsets: Optional[Dict[str, int]] = None,
+) -> List[Diagnostic]:
+    """Run the concurrency pass over {filename: source}."""
+    if not rules:
+        return []
+    index = ProgramIndex()
+    for filename, source in sources.items():
+        index.add_source(
+            filename, source, line_offset=(line_offsets or {}).get(filename, 0)
+        )
+    return run_concurrency_pass(index, rules)
+
+
+def collect_py_files(path: str) -> List[str]:
+    if os.path.isfile(path):
+        return [path]
+    out: List[str] = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(
+            d for d in dirs if d != "__pycache__" and not d.startswith(".")
+        )
+        for name in sorted(files):
+            if name.endswith(".py"):
+                out.append(os.path.join(root, name))
+    return out

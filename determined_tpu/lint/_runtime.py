@@ -1,7 +1,8 @@
-"""Runtime sentinels: retrace detection + thread-leak checking.
+"""Runtime sentinels: retrace detection, thread-leak checking, lock-order
+tracking.
 
-The static pass (``_ast.py``) catches what it can read; these two catch
-what only shows up live:
+The static pass (``_ast.py``/``_concurrency.py``) catches what it can
+read; these catch what only shows up live:
 
 - **RetraceSentinel** — wraps the pre-jit step functions the Trainer
   installs (``train/_trainer.py`` / ``train/_jit_cache.py``).  jax calls
@@ -18,6 +19,14 @@ what only shows up live:
   assert scheduler/prefetch workers die with their owners; the supervisor
   (``exec/run_trial.py``) runs trials under it in warn mode when
   ``lint.thread_sentinel`` is set.
+- **LockOrderSentinel** — a test-time monkeypatch of ``threading.Lock`` /
+  ``threading.RLock`` (and therefore every ``Condition``/``Event`` built
+  on them afterwards) that records the process's ACTUAL lock-acquisition
+  DAG and reports an inversion the moment an edge closes a cycle — the
+  dynamic complement of the static ``lock-order-cycle`` rule, catching the
+  dispatch the AST cannot resolve.  ``tests/conftest.py`` exposes it as
+  the opt-in ``lock_order`` marker (scheduler, journal/recovery, GC, and
+  observability suites run under it).
 """
 
 from __future__ import annotations
@@ -146,6 +155,258 @@ def get_retrace_sentinel() -> RetraceSentinel:
 # ---------------------------------------------------------------------------
 # thread-leak checker
 # ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# lock-order sentinel
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LockOrderViolation:
+    """An observed acquisition-order inversion: taking ``acquired`` while
+    holding ``held`` closes a cycle against the edges in ``cycle``."""
+
+    thread: str
+    held: str
+    acquired: str
+    cycle: List[str]
+
+    def format(self) -> str:
+        return (
+            f"lock-order inversion on thread {self.thread}: acquired "
+            f"{self.acquired} while holding {self.held}, but the process "
+            f"already acquired them in the opposite order "
+            f"(cycle: {' -> '.join(self.cycle)})"
+        )
+
+
+class _TrackedLock:
+    """Wrapper a patched ``threading.Lock``/``RLock`` factory returns.
+
+    Delegates everything to the real primitive; ``acquire``/``release``
+    additionally maintain the sentinel's per-thread held stack and the
+    global acquisition DAG.  ``__getattr__`` forwards the private
+    ``_release_save``/``_acquire_restore``/``_is_owned`` trio, so
+    ``Condition`` built on a tracked RLock works unchanged (its ``wait``
+    then bypasses the bookkeeping — conservative: the lock stays "held"
+    on our stack through the wait, which can only ADD ordering edges the
+    thread really did establish before waiting).
+    """
+
+    def __init__(self, sentinel: "LockOrderSentinel", inner: Any, sid: int,
+                 label: str, reentrant: bool) -> None:
+        self._dtpu_sentinel = sentinel
+        self._dtpu_inner = inner
+        self._dtpu_sid = sid
+        self._dtpu_label = label
+        self._dtpu_reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._dtpu_inner.acquire(blocking, timeout)
+        if got:
+            self._dtpu_sentinel._note_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._dtpu_sentinel._note_release(self)
+        self._dtpu_inner.release()
+
+    def locked(self) -> bool:
+        return self._dtpu_inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._dtpu_inner, name)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<tracked {self._dtpu_label} wrapping {self._dtpu_inner!r}>"
+
+
+class LockOrderSentinel:
+    """Record the live acquisition DAG; flag inversions deterministically.
+
+    ``install()`` patches ``threading.Lock`` and ``threading.RLock`` so
+    every lock created AFTERWARDS is tracked (existing locks are not —
+    tests construct their subjects inside the sentinel's scope, which the
+    conftest ``lock_order`` marker guarantees).  On each acquire with
+    other tracked locks held, the edge ``innermost-held -> acquired`` is
+    added; an edge that completes a cycle records a
+    ``LockOrderViolation`` carrying both directions' witnesses.  The
+    check fires on the ORDER, not on an actual deadlock, so the inversion
+    is caught even when the interleaving happened to get away with it —
+    that is the point: the failure is deterministic where the deadlock is
+    a race.
+
+    Locks are labeled by allocation site (``file:line#serial``), which is
+    what the violation message shows.  Reentrant re-acquisition of an
+    RLock adds no edges.  Not re-entrant itself: one install per process
+    at a time (the conftest fixture serializes naturally).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()  # guards graph + labels + handoffs
+        self._edges: Dict[int, set] = {}
+        self._labels: Dict[int, str] = {}
+        self._violations: List[LockOrderViolation] = []
+        self._seq = 0
+        self._held = threading.local()
+        #: sid -> count of releases by threads that never acquired it
+        #: (the legal Lock handoff pattern); the acquiring thread purges
+        #: its stale stack entry lazily on its next acquire
+        self._foreign_releases: Dict[int, int] = {}
+        self._orig_lock: Optional[Any] = None
+        self._orig_rlock: Optional[Any] = None
+        self._installed = False
+
+    # -- patching ----------------------------------------------------------
+
+    def _alloc_site(self) -> str:
+        import sys
+
+        f = sys._getframe(2)
+        while f is not None and "threading" in (f.f_code.co_filename or ""):
+            f = f.f_back
+        if f is None:  # pragma: no cover - interpreter internals
+            return "<unknown>"
+        return f"{f.f_code.co_filename}:{f.f_lineno}"
+
+    def _make_factory(self, orig: Any, reentrant: bool) -> Any:
+        def factory(*args: Any, **kwargs: Any) -> _TrackedLock:
+            inner = orig(*args, **kwargs)
+            with self._lock:
+                self._seq += 1
+                sid = self._seq
+            label = f"{self._alloc_site()}#{sid}"
+            with self._lock:
+                self._labels[sid] = label
+            return _TrackedLock(self, inner, sid, label, reentrant)
+
+        return factory
+
+    def install(self) -> "LockOrderSentinel":
+        if self._installed:
+            return self
+        self._orig_lock = threading.Lock
+        self._orig_rlock = threading.RLock
+        threading.Lock = self._make_factory(self._orig_lock, False)  # type: ignore[misc]
+        threading.RLock = self._make_factory(self._orig_rlock, True)  # type: ignore[misc]
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.Lock = self._orig_lock  # type: ignore[misc]
+        threading.RLock = self._orig_rlock  # type: ignore[misc]
+        self._installed = False
+
+    def __enter__(self) -> "LockOrderSentinel":
+        return self.install()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.uninstall()
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _stack(self) -> List[int]:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = []
+            self._held.stack = stack
+        return stack
+
+    def _purge_foreign_releases(self, stack: List[int]) -> None:
+        """Drop stack entries for locks some OTHER thread has since
+        released (acquire-here / release-there is legal for Lock); without
+        this the handed-off lock looks held forever and every later
+        acquire on this thread grows a phantom ordering edge."""
+        if not self._foreign_releases:  # benign unlocked read
+            return
+        with self._lock:
+            for i in range(len(stack) - 1, -1, -1):
+                n = self._foreign_releases.get(stack[i], 0)
+                if n:
+                    sid = stack[i]
+                    del stack[i]
+                    if n == 1:
+                        del self._foreign_releases[sid]
+                    else:
+                        self._foreign_releases[sid] = n - 1
+
+    def _note_acquire(self, lock: _TrackedLock) -> None:
+        stack = self._stack()
+        self._purge_foreign_releases(stack)
+        sid = lock._dtpu_sid
+        if sid in stack:
+            # reentrant hold (RLock, or Condition re-entry): no new order
+            # information; push so the matching release pops symmetrically
+            stack.append(sid)
+            return
+        if stack:
+            holder = stack[-1]
+            with self._lock:
+                added = sid not in self._edges.setdefault(holder, set())
+                if added:
+                    self._edges[holder].add(sid)
+                    cycle = self._find_cycle(sid, holder)
+                    if cycle is not None:
+                        self._violations.append(
+                            LockOrderViolation(
+                                thread=threading.current_thread().name,
+                                held=self._labels.get(holder, str(holder)),
+                                acquired=self._labels.get(sid, str(sid)),
+                                cycle=[
+                                    self._labels.get(s, str(s))
+                                    for s in [holder, sid] + cycle[1:]
+                                ],
+                            )
+                        )
+        stack.append(sid)
+
+    def _note_release(self, lock: _TrackedLock) -> None:
+        stack = self._stack()
+        sid = lock._dtpu_sid
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == sid:
+                del stack[i]
+                return
+        # released by a thread that never acquired it: cross-thread
+        # handoff — the acquirer's stack entry is purged on its next
+        # acquire rather than mutated from here (stacks are thread-local)
+        with self._lock:
+            self._foreign_releases[sid] = self._foreign_releases.get(sid, 0) + 1
+
+    def _find_cycle(self, start: int, goal: int) -> Optional[List[int]]:
+        """Path start -> ... -> goal in the edge set (caller holds _lock);
+        combined with the just-added goal -> start edge it is a cycle."""
+        work = [(start, [start])]
+        seen = {start}
+        while work:
+            cur, path = work.pop()
+            for nxt in self._edges.get(cur, ()):
+                if nxt == goal:
+                    return path + [goal]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    work.append((nxt, path + [nxt]))
+        return None
+
+    # -- queries -----------------------------------------------------------
+
+    def violations(self) -> List[LockOrderViolation]:
+        with self._lock:
+            return list(self._violations)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._edges.clear()
+            self._violations.clear()
 
 
 class ThreadLeakError(RuntimeError):

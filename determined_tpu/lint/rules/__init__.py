@@ -33,6 +33,11 @@ class Rule:
     #: still calls the hooks; the rule checks ``ctx.in_step`` itself — this
     #: flag is documentation + docs-table input)
     step_scoped: bool = False
+    #: True for whole-program rules: they get no per-node walker hooks —
+    #: ``lint/_concurrency.py`` drives them over an index of EVERY module
+    #: in the lint target at once (lock graphs need cross-module edges).
+    #: Registry/suppression/config handling is identical to walker rules.
+    program_level: bool = False
 
     def before_module(self, tree, ctx) -> None:  # pragma: no cover - hook
         pass
@@ -71,6 +76,7 @@ def build_rules(
 
 # importing the rule modules populates the registry
 from determined_tpu.lint.rules import (  # noqa: E402,F401
+    concurrency,
     control_flow,
     defaults,
     host_sync,

@@ -1,0 +1,64 @@
+"""Whole-program concurrency rules: lock ordering, blocking under locks,
+signal-handler safety.
+
+The platform is now deeply threaded — scheduler trial threads, prefetch
+workers, the tracer shipper, the journal, the GC thread, signal handlers —
+and its lock-ordering invariants (``searcher -> journal``, the
+``_ckpt_lock`` leaf rule, the scheduler queue handoffs) were enforced only
+by code review; two hardening rounds each hand-caught a lock-order
+inversion, a multi-GB ``rmtree`` under the searcher lock, and
+fsync-under-lock stalls.  These three rules find that bug class
+mechanically.  They are ``program_level``: ``lint/_concurrency.py`` builds
+one cross-module index of every lock, ``with``-region, and resolvable call
+in the lint target and drives the rules over it — a cycle between a lock
+in ``experiment/journal.py`` and one in ``searcher/_searcher.py`` is only
+visible to a pass that sees both files.
+
+The runtime companion is ``lint/_runtime.py``'s ``LockOrderSentinel``,
+which checks the ACTUAL acquisition DAG of a test process the same way the
+retrace sentinel checks actual compiles.
+"""
+
+from __future__ import annotations
+
+from determined_tpu.lint._diag import WARNING
+from determined_tpu.lint.rules import Rule, register
+
+
+@register
+class LockOrderCycleRule(Rule):
+    id = "lock-order-cycle"
+    severity = WARNING
+    program_level = True
+    description = (
+        "cycle in the cross-module lock-acquisition graph: two code paths "
+        "take the same locks in opposite orders — a potential deadlock the "
+        "moment both paths run concurrently"
+    )
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    id = "blocking-under-lock"
+    severity = WARNING
+    program_level = True
+    description = (
+        "blocking call (fsync, os.replace, shutil.rmtree, blocking "
+        "queue.get/put, subprocess, network I/O, time.sleep, Thread.join, "
+        "jax device sync) while a lock is held — every other thread "
+        "touching that lock stalls for the call's full duration"
+    )
+
+
+@register
+class SignalHandlerUnsafeRule(Rule):
+    id = "signal-handler-unsafe"
+    severity = WARNING
+    program_level = True
+    description = (
+        "signal handler that acquires locks, logs, or does blocking I/O: "
+        "handlers run on the main thread at ANY bytecode boundary, so a "
+        "lock the interrupted frame already holds deadlocks the process — "
+        "only the flag-set pattern (plain attribute writes, os.write) is "
+        "reentrancy-safe"
+    )

@@ -7,6 +7,17 @@ run on: the entry point migrated from ``jax.experimental.shard_map`` to
 the old rep-set analysis.  Callers here write the NEW spelling
 (``check_vma``) and this shim translates for older installs, so kernel
 code stays forward-looking without pinning jax.
+
+It also backports the shard_map TRANSPOSE fix (``_fix_transpose_residual_
+misalignment`` below): jax 0.4.37's ``_shard_map_transpose`` zips the
+backward pass's outputs — which lead with the RESIDUAL cotangents of the
+partial-evaluated forward — directly against ``in_names``, so whenever
+partial eval hoists residual-producing computation the names misalign and
+the pipeline × expert/seq compositions die in ``_check_names`` with a
+``_SpecError`` on a scalar cotangent.  Later jax slices the residual
+cotangents off and re-merges explicit Zeros (jax-ml/jax: shard_map
+transpose residual fix); we install exactly that corrected rule when the
+buggy pattern is detected in the installed version.
 """
 
 from __future__ import annotations
@@ -22,6 +33,97 @@ except AttributeError:
     from jax.experimental.shard_map import shard_map as _shard_map
 
 _SHARD_MAP_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def _fix_transpose_residual_misalignment() -> bool:
+    """Re-register a corrected shard_map transpose on affected jax.
+
+    Returns True when the fix was installed (new jax either lacks the bug
+    or moved the internals, in which case this is a silent no-op — the
+    feature test is the buggy source pattern itself, not a version pin).
+    """
+    try:
+        import jax.experimental.shard_map as _sm
+        from jax._src import ad_util, dtypes
+        from jax._src import linear_util as lu
+        from jax._src.api_util import flatten_fun_nokwargs
+        from jax._src.interpreters import ad, partial_eval as pe
+        from jax._src.util import merge_lists, partition_list
+        from jax._src import core as jcore
+        from jax.tree_util import tree_flatten, tree_unflatten
+
+        buggy = "zip(in_names, out)" in inspect.getsource(_sm._shard_map_transpose)
+    except Exception:  # noqa: BLE001 - internals moved; nothing to patch
+        return False
+    if not buggy:
+        return False
+
+    from math import prod
+
+    _shard_aval = _sm._shard_aval
+    _unshard_aval = _sm._unshard_aval
+    _unmentioned2 = _sm._unmentioned2
+
+    def _fixed_transpose(out_cts, *args, jaxpr, mesh, in_names, out_names,
+                         check_rep, rewrite, auto):
+        mb_div = lambda x, y: x / y if y != 1 else x  # noqa: E731
+        out_cts = [
+            ad.Zero(_shard_aval(mesh, ns, x.aval)) if type(x) is ad.Zero
+            else x if rewrite or dtypes.dtype(x) == dtypes.float0
+            else mb_div(x, prod(map(mesh.shape.get, _unmentioned2(mesh, ns, auto))))
+            for ns, x in zip(out_names, out_cts)
+        ]
+        args = [x if type(x) is not ad.UndefinedPrimal else
+                ad.UndefinedPrimal(_shard_aval(mesh, ns, x.aval))
+                for ns, x in zip(in_names, args)]
+        all_args, in_tree = tree_flatten((out_cts, args))
+
+        @lu.wrap_init
+        def fun_trans(out_cts, args):
+            in_undef = list(map(ad.is_undefined_primal, args))
+            res, undefs = partition_list(in_undef, args)
+            jaxpr_known, jaxpr_unknown, _, _ = pe.partial_eval_jaxpr_nounits(
+                pe.close_jaxpr(jaxpr), in_undef, False)
+            res_reshaped = jcore.jaxpr_as_fun(jaxpr_known)(*res)
+            # the first len(res_reshaped) cotangents belong to the hoisted
+            # residuals, NOT to the original inputs: slice them off before
+            # pairing with in_names (the 0.4.37 bug is skipping this)
+            in_cts = ad.backward_pass(
+                jaxpr_unknown.jaxpr, False, (), (*res_reshaped, *undefs), out_cts
+            )[len(res_reshaped):]
+            _, in_ct_names = partition_list(in_undef, in_names)
+            in_cts = [
+                ad.Zero(_unshard_aval(mesh, ns, x.aval)) if type(x) is ad.Zero
+                else x if rewrite
+                else jax.lax.psum(x, tuple(_unmentioned2(mesh, ns, auto)))
+                for ns, x in zip(in_ct_names, in_cts)
+            ]
+            res_zeros = [ad_util.zero_from_primal(r) for r in res]
+            return merge_lists(in_undef, res_zeros, in_cts)
+
+        fun_trans, nz_arg_cts = ad.nonzero_outputs(fun_trans)
+        fun_trans_flat, out_tree = flatten_fun_nokwargs(fun_trans, in_tree)
+
+        new_in_names = (
+            [n for n, x in zip(out_names, out_cts) if type(x) is not ad.Zero]
+            + [n for n, x in zip(in_names, args)
+               if type(x) is not ad.UndefinedPrimal]
+        )
+
+        def new_out_names_thunk():
+            return tuple(names for names, nz in zip(in_names, nz_arg_cts()) if nz)
+
+        out_flat = _sm.shard_map_p.bind(
+            fun_trans_flat, *all_args, mesh=mesh, in_names=tuple(new_in_names),
+            out_names_thunk=new_out_names_thunk, check_rep=check_rep,
+            rewrite=rewrite, auto=auto)
+        return tree_unflatten(out_tree(), out_flat)
+
+    ad.primitive_transposes[_sm.shard_map_p] = _fixed_transpose
+    return True
+
+
+SHARD_MAP_TRANSPOSE_FIXED = _fix_transpose_residual_misalignment()
 
 
 def axis_size(axis_name: Any) -> int:

@@ -3802,11 +3802,22 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     return R::json(Json::object().set("name", name).dump(), 201);
   }));
 
-  srv.route("GET", "/api/v1/groups", authed([&m](const HttpRequest& req) {
-    (void)req;
+  // ADVICE round-5: the unscoped listing leaked the whole org's membership
+  // to any authenticated user.  Admins see everything; everyone else sees
+  // only the groups THEY belong to (a member already knows their own
+  // roster), and an explicit ?all=true from a non-admin is a 403, not a
+  // silently narrowed answer.
+  srv.route("GET", "/api/v1/groups", authed([&m, is_cluster_admin](const HttpRequest& req) {
     std::lock_guard<std::mutex> lk(m.mu_);
+    const bool admin = is_cluster_admin(req);
+    auto all_it = req.query.find("all");
+    if (!admin && all_it != req.query.end() && all_it->second != "false") {
+      return R::error(403, "listing all groups requires admin");
+    }
+    const std::string user = m.authenticate(req);
     Json out = Json::array();
     for (const auto& [name, g] : m.groups_) {
+      if (!admin && !g.members.count(user)) continue;
       Json members = Json::array();
       for (const auto& u : g.members) members.push_back(u);
       out.push_back(Json::object().set("name", name).set("members", members));

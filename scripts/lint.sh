@@ -1,8 +1,15 @@
 #!/usr/bin/env bash
 # Static preflight lint over the harness + examples — the Python-side
-# companion of scripts/sanitize.sh (which covers the native daemons with
-# TSAN/ASAN; SURVEY §5: the reference leans on Go's race detector, our
-# harness leans on determined_tpu/lint).
+# companion of scripts/native_check.sh (g++ -Wall gate over native/) and
+# scripts/sanitize.sh (TSAN/ASAN builds; SURVEY §5: the reference leans on
+# Go's race detector, our harness leans on determined_tpu/lint).
+#
+# All targets are passed in ONE invocation on purpose: the whole-program
+# concurrency pass (lock-order-cycle / blocking-under-lock /
+# signal-handler-unsafe) builds a single cross-module lock-acquisition
+# graph spanning the package, scripts, examples, and bench — a script that
+# takes package locks in the wrong order closes a cycle only a joint
+# graph can see.
 #
 # Strict mode: ANY finding fails.  Findings that are safe by a subtler
 # argument carry inline `# dtpu: lint-ok[rule]` suppressions WITH the

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Pre-merge syntax + warning gate over the native daemons — the C++
+# companion of scripts/lint.sh (Python static analysis) and the cheap
+# always-on sibling of scripts/sanitize.sh (TSAN/ASAN, which needs a full
+# build).  Every master/agent edit gets the same no-build check the
+# Python side already has: `g++ -fsyntax-only -Wall -Wextra -Werror`.
+#
+# -Wno-missing-field-initializers: the searcher's aggregate-init idiom
+# ({{SearchAction::Kind::Shutdown}}) intentionally default-initializes the
+# trailing members; everything else warns as an error.
+#
+#   scripts/native_check.sh            # check master + agent
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+CXX="${CXX:-g++}"
+FLAGS=(-fsyntax-only -std=c++17 -Wall -Wextra -Werror
+       -Wno-missing-field-initializers -Inative)
+
+status=0
+for src in native/master/master.cpp native/agent/agent.cpp; do
+  if "$CXX" "${FLAGS[@]}" "$src"; then
+    echo "ok: $src"
+  else
+    echo "FAIL: $src" >&2
+    status=1
+  fi
+done
+exit "$status"

@@ -35,6 +35,17 @@ def pytest_configure(config):
         "no_thread_leaks: assert no dtpu-* worker threads survive the test "
         "(lint.ThreadLeakChecker; opt in per module/test)",
     )
+    config.addinivalue_line(
+        "markers",
+        "lock_order: record the test's actual lock-acquisition DAG and fail "
+        "on an observed ordering inversion (lint.LockOrderSentinel; opt in "
+        "per module/test)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "no_lock_order: per-test opt-out from a module-level lock_order mark "
+        "(for wall-clock-ratio assertions the instrumentation would skew)",
+    )
 
 
 @pytest.fixture(autouse=True)
@@ -53,6 +64,29 @@ def _thread_leak_guard(request):
         watch=("dtpu-*",), grace=5.0, scope=request.node.nodeid
     ):
         yield
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_guard(request):
+    """Autouse, opt-in: tests/modules marked ``lock_order`` run with
+    ``threading.Lock``/``RLock`` patched to record the acquisition DAG;
+    an observed inversion (the dynamic form of the static
+    ``lock-order-cycle`` rule) fails the test deterministically — on the
+    ORDER being contradictory, not on whether this run happened to
+    interleave into the actual deadlock."""
+    if (
+        request.node.get_closest_marker("lock_order") is None
+        or request.node.get_closest_marker("no_lock_order") is not None
+    ):
+        yield
+        return
+    from determined_tpu.lint import LockOrderSentinel
+
+    sentinel = LockOrderSentinel()
+    with sentinel:
+        yield
+    violations = sentinel.violations()
+    assert not violations, "\n".join(v.format() for v in violations)
 
 
 @pytest.fixture(autouse=True)

@@ -2115,6 +2115,14 @@ def test_user_groups_inherit_workspace_roles(cluster, tmp_path):
     groups = {g["name"]: g for g in cluster.http.get(url + "/api/v1/groups").json()}
     assert groups["team"]["members"] == ["carol"]
 
+    # listing is scoped (ADVICE round-5 org-membership leak): a non-admin
+    # sees only their own groups; dave (member of none) sees nothing, and
+    # an explicit all=true from a non-admin is refused, not narrowed
+    assert [g["name"] for g in carol.get(url + "/api/v1/groups").json()] == ["team"]
+    assert dave.get(url + "/api/v1/groups").json() == []
+    assert dave.get(url + "/api/v1/groups", params={"all": "true"}).status_code == 403
+    assert len(cluster.http.get(url + "/api/v1/groups").json()) == 1  # admin: all
+
     # restricted workspace whose only binding is the GROUP
     cluster.http.post(url + "/api/v1/workspaces", json={"name": "grouped"})
     r = cluster.http.put(

@@ -26,7 +26,10 @@ import time
 
 import pytest
 
-pytestmark = pytest.mark.no_thread_leaks
+# lock_order: the runtime half of the lint concurrency pass — every
+# test in this suite runs with threading.Lock/RLock patched so an
+# acquisition-order inversion fails the test that exhibited it
+pytestmark = [pytest.mark.no_thread_leaks, pytest.mark.lock_order]
 
 from determined_tpu.config import ExperimentConfig
 from determined_tpu.experiment import (

@@ -16,6 +16,11 @@ from determined_tpu.exec.gc_checkpoints import (
     scan_experiment_checkpoints,
 )
 
+# lock_order: the GC pass runs off the journal's on_compact hook next to
+# the searcher/journal locks — run the suite under the acquisition-order
+# sentinel (runtime half of the lint concurrency pass)
+pytestmark = pytest.mark.lock_order
+
 
 def ci(uuid, trial, steps, parent=None, manifest=True):
     return CheckpointInfo(

@@ -4,6 +4,7 @@ fixtures, suppressions, JSON schema, CLI exit codes, preflight integration
 work), and the runtime sentinels (retrace + thread leaks)."""
 
 import json
+import os
 import textwrap
 
 import pytest
@@ -98,6 +99,42 @@ class Pool:
     def add(self, j):
         self.jobs.append(j)
 """,
+    "lock-order-cycle": """
+import threading
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass
+""",
+    "blocking-under-lock": """
+import os, threading
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def save(self, fh):
+        with self._lock:
+            os.fsync(fh.fileno())
+""",
+    "signal-handler-unsafe": """
+import signal, threading
+class Guard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits = 0
+    def arm(self):
+        def handler(signum, frame):
+            with self._lock:
+                self._hits += 1
+        signal.signal(signal.SIGTERM, handler)
+""",
 }
 
 CLEAN = {
@@ -177,6 +214,42 @@ class Pool:
     def add(self, j):
         with self._lock:
             self.jobs.append(j)
+""",
+    "lock-order-cycle": """
+import threading
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+    def also_forward(self):
+        with self._a:
+            with self._b:
+                pass
+""",
+    "blocking-under-lock": """
+import os, threading
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dirty = False
+    def save(self, fh):
+        with self._lock:
+            self._dirty = False
+        os.fsync(fh.fileno())  # durability point OUTSIDE the lock
+""",
+    "signal-handler-unsafe": """
+import signal
+class Guard:
+    def __init__(self):
+        self._hit = False
+    def arm(self):
+        def handler(signum, frame):
+            self._hit = True  # flag-set pattern: plain attribute write
+        signal.signal(signal.SIGTERM, handler)
 """,
 }
 
@@ -679,3 +752,509 @@ def test_thread_leak_checker_warn_mode_records(caplog):
         assert any("warnscope" in r.message for r in caplog.records)
     finally:
         release.set()
+
+
+# ---------------------------------------------------------------------------
+# concurrency pass: cross-module graphs, exact diagnostics, suppressions
+# ---------------------------------------------------------------------------
+
+
+def _concurrency_diags(src: str, rule: str):
+    return [
+        d
+        for d in analyze_source(textwrap.dedent(src), "fixture.py")
+        if d.rule == rule
+    ]
+
+
+def test_lock_cycle_bad_fixture_exactly_one_diagnostic():
+    diags = _concurrency_diags(BAD["lock-order-cycle"], "lock-order-cycle")
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert "fixture:Pair._a" in diags[0].message
+    assert "fixture:Pair._b" in diags[0].message
+
+
+def test_blocking_under_lock_bad_fixture_names_held_chain():
+    diags = _concurrency_diags(BAD["blocking-under-lock"], "blocking-under-lock")
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert "os.fsync" in diags[0].message
+    assert "Writer._lock" in diags[0].message
+
+
+def test_signal_handler_bad_fixture_names_lock():
+    diags = _concurrency_diags(BAD["signal-handler-unsafe"], "signal-handler-unsafe")
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert "Guard._lock" in diags[0].message
+
+
+def test_lock_cycle_across_two_modules(tmp_path):
+    """The tentpole case: each module is individually consistent; only the
+    cross-module pass sees the inversion."""
+    from determined_tpu.lint import analyze_paths
+
+    (tmp_path / "mod_a.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            from mod_b import poke_b
+            A = threading.Lock()
+            def poke_a():
+                with A:
+                    pass
+            def a_then_b():
+                with A:
+                    poke_b()
+            """
+        )
+    )
+    (tmp_path / "mod_b.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            from mod_a import poke_a
+            B = threading.Lock()
+            def poke_b():
+                with B:
+                    pass
+            def b_then_a():
+                with B:
+                    poke_a()
+            """
+        )
+    )
+    diags = [
+        d for d in analyze_paths([str(tmp_path)]) if d.rule == "lock-order-cycle"
+    ]
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert "mod_a:A" in diags[0].message and "mod_b:B" in diags[0].message
+    # and each file alone is clean: the cycle is a property of the program
+    for name in ("mod_a.py", "mod_b.py"):
+        alone = analyze_paths([str(tmp_path / name)])
+        assert [d for d in alone if d.rule == "lock-order-cycle"] == []
+
+
+def test_blocking_under_lock_transitive_through_calls():
+    src = """
+    import os, threading
+    class J:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def _write(self, fh):
+            fh.flush()
+            os.fsync(fh.fileno())
+        def append(self, fh):
+            with self._lock:
+                self._write(fh)
+    """
+    diags = _concurrency_diags(src, "blocking-under-lock")
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert "J._write" in diags[0].message  # the chain names the callee
+
+
+def test_blocking_queue_get_under_lock_flagged_nowait_clean():
+    src = """
+    import queue, threading
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+        def bad(self):
+            with self._lock:
+                return self._q.get()
+        def ok(self):
+            with self._lock:
+                return self._q.get_nowait()
+        def ok2(self):
+            with self._lock:
+                return self._q.get(block=False)
+    """
+    diags = _concurrency_diags(src, "blocking-under-lock")
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert diags[0].line == 9
+
+
+def test_rmtree_under_lock_flagged():
+    src = """
+    import shutil, threading
+    LOCK = threading.Lock()
+    def gc(path):
+        with LOCK:
+            shutil.rmtree(path)
+    """
+    diags = _concurrency_diags(src, "blocking-under-lock")
+    assert len(diags) == 1 and "shutil.rmtree" in diags[0].message
+
+
+def test_nonreentrant_self_acquire_flagged_rlock_clean():
+    src = """
+    import threading
+    class R:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rlock = threading.RLock()
+        def outer(self):
+            with self._lock:
+                self.inner()
+        def inner(self):
+            with self._lock:
+                pass
+        def outer_r(self):
+            with self._rlock:
+                self.inner_r()
+        def inner_r(self):
+            with self._rlock:
+                pass
+    """
+    diags = _concurrency_diags(src, "lock-order-cycle")
+    # the non-reentrant Lock chain (outer holds, inner re-takes) is a
+    # guaranteed self-deadlock; the identical RLock chain is legal
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert "R._lock" in diags[0].message
+    assert "_rlock" not in diags[0].message
+
+
+def test_concurrency_suppression_line_above():
+    src = """
+    import os, threading
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+        def save(self, fh):
+            with self._lock:
+                # durability point must be inside: WAL contract
+                # dtpu: lint-ok[blocking-under-lock]
+                os.fsync(fh.fileno())
+    """
+    assert _concurrency_diags(src, "blocking-under-lock") == []
+
+
+def test_concurrency_rules_in_json_payload():
+    diags = analyze_source(
+        textwrap.dedent(BAD["blocking-under-lock"]), "fixture.py"
+    )
+    payload = to_json_payload(diags)
+    assert payload["version"] == 1
+    assert payload["counts"]["by_rule"].get("blocking-under-lock", 0) >= 1
+    parsed = json.loads(json.dumps(payload))
+    assert parsed["findings"][0]["rule"] in set(all_rules())
+
+
+def test_queue_put_positional_nonblocking_clean():
+    src = """
+    import queue, threading
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+        def bad(self, item):
+            with self._lock:
+                self._q.put(item)
+        def ok(self, item):
+            with self._lock:
+                self._q.put(item, False)
+    """
+    diags = _concurrency_diags(src, "blocking-under-lock")
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert diags[0].line == 9
+
+
+def test_condition_wait_idiom_clean_under_other_lock_flagged():
+    """``with cond: cond.wait()`` is THE condition-variable idiom (wait
+    releases the lock it blocks on) — clean; the same wait reached while
+    some other lock is held really does stall that lock's contenders —
+    flagged, both directly and through a call chain."""
+    src = """
+    import threading
+    class CV:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._other = threading.Lock()
+            self._ready = False
+        def idiom(self):
+            with self._cond:
+                while not self._ready:
+                    self._cond.wait()
+        def bad_direct(self):
+            with self._other:
+                with self._cond:
+                    self._cond.wait()
+        def bad_transitive(self):
+            with self._other:
+                self.idiom()
+    """
+    diags = _concurrency_diags(src, "blocking-under-lock")
+    assert len(diags) == 2, [d.format() for d in diags]
+    assert all("CV._other" in d.message for d in diags)
+
+
+def test_same_stem_scripts_all_indexed(tmp_path):
+    """Non-package scripts sharing a stem (examples/*/model_def.py) must
+    each stay in the program index — a collision that drops one hides its
+    findings entirely."""
+    from determined_tpu.lint import analyze_paths
+
+    src = """
+        import shutil, threading
+        LOCK = threading.Lock()
+        def gc(path):
+            with LOCK:
+                shutil.rmtree(path)
+        """
+    for sub in ("alpha", "beta"):
+        (tmp_path / sub).mkdir()
+        (tmp_path / sub / "model_def.py").write_text(textwrap.dedent(src))
+    diags = [
+        d for d in analyze_paths([str(tmp_path)])
+        if d.rule == "blocking-under-lock"
+    ]
+    assert len(diags) == 2, [d.format() for d in diags]
+    assert {os.path.basename(os.path.dirname(d.file)) for d in diags} == {
+        "alpha",
+        "beta",
+    }
+
+
+def test_mutual_recursion_does_not_cache_truncated_summaries():
+    """A query that prunes a mutually recursive callee must not poison the
+    cache for later queries: `second` still owes the M -> L edge even
+    though `first` computed (and pruned) the same component earlier."""
+    src = """
+    import threading
+    L = threading.Lock()
+    M = threading.Lock()
+    N = threading.Lock()
+    def f(n):
+        with L:
+            pass
+        g(n)
+    def g(n):
+        if n:
+            f(n - 1)
+    def first():
+        with N:
+            f(0)
+    def second():
+        with M:
+            g(1)
+    def l_then_m():
+        with L:
+            with M:
+                pass
+    """
+    diags = _concurrency_diags(src, "lock-order-cycle")
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert "fixture:M" in diags[0].message and "fixture:L" in diags[0].message
+
+
+def test_nested_def_rebinding_does_not_shadow_module_lock():
+    """A lock ctor inside a NESTED def must not register as the enclosing
+    function's local — that phantom binding would shadow the module lock
+    and split one lock into two graph identities, silently hiding the
+    real cycle."""
+    src = """
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+    def a_then_b():
+        def make_private():
+            A = threading.Lock()
+            return A
+        with A:
+            with B:
+                pass
+    def b_then_a():
+        with B:
+            with A:
+                pass
+    """
+    diags = _concurrency_diags(src, "lock-order-cycle")
+    assert len(diags) == 1, [d.format() for d in diags]
+    assert "fixture:A" in diags[0].message and "fixture:B" in diags[0].message
+
+
+def test_analyze_paths_dedups_overlapping_targets(tmp_path):
+    """The same physical file reached through two target spellings must
+    lint exactly once (no doubled findings, no forked module identity)."""
+    from determined_tpu.lint import analyze_paths
+
+    (tmp_path / "m.py").write_text(
+        textwrap.dedent(
+            """
+            import shutil, threading
+            LOCK = threading.Lock()
+            def gc(path):
+                with LOCK:
+                    shutil.rmtree(path)
+            """
+        )
+    )
+    diags = [
+        d
+        for d in analyze_paths([str(tmp_path), str(tmp_path / "." / "m.py")])
+        if d.rule == "blocking-under-lock"
+    ]
+    assert len(diags) == 1, [d.format() for d in diags]
+
+
+def test_signal_handler_logging_flagged():
+    src = """
+    import logging, signal
+    logger = logging.getLogger("x")
+    def handler(signum, frame):
+        logger.warning("got signal")
+    def arm():
+        signal.signal(signal.SIGTERM, handler)
+    """
+    diags = _concurrency_diags(src, "signal-handler-unsafe")
+    assert len(diags) == 1 and "logs via" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# LockOrderSentinel: the runtime acquisition-order guard
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_sentinel_detects_inversion_deterministically():
+    """Two threads, opposite nesting, fully sequenced by joins: no actual
+    deadlock ever happens, yet the ORDER contradiction must be reported —
+    every time, not only on the unlucky interleaving."""
+    import threading
+
+    from determined_tpu.lint import LockOrderSentinel
+
+    sentinel = LockOrderSentinel()
+    with sentinel:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        def backward():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=forward)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=backward)
+        t2.start()
+        t2.join()
+    violations = sentinel.violations()
+    assert len(violations) == 1, [v.format() for v in violations]
+    msg = violations[0].format()
+    assert "inversion" in msg and "test_lint.py" in msg
+
+
+def test_lock_order_sentinel_consistent_order_is_silent():
+    import threading
+
+    from determined_tpu.lint import LockOrderSentinel
+
+    sentinel = LockOrderSentinel()
+    with sentinel:
+        a = threading.Lock()
+        b = threading.Lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+    assert sentinel.violations() == []
+
+
+def test_lock_order_sentinel_cross_thread_handoff_no_phantom_edges():
+    """``Lock`` legally supports acquire-in-A / release-in-B (gate
+    pattern); the handed-off lock must not stay on A's held stack and
+    manufacture phantom ordering edges for everything A takes later."""
+    import threading
+
+    from determined_tpu.lint import LockOrderSentinel
+
+    sentinel = LockOrderSentinel()
+    with sentinel:
+        gate = threading.Lock()
+        x = threading.Lock()
+        gate.acquire()  # main thread holds the gate
+
+        t = threading.Thread(target=gate.release)
+        t.start()
+        t.join()  # released by another thread: handoff complete
+
+        with x:  # without the purge: phantom gate->x edge
+            pass
+
+        def consistent():
+            with x:
+                with gate:  # x->gate: fine unless the phantom edge exists
+                    pass
+
+        t2 = threading.Thread(target=consistent)
+        t2.start()
+        t2.join()
+    assert sentinel.violations() == [], [
+        q.format() for q in sentinel.violations()
+    ]
+
+
+def test_lock_order_sentinel_rlock_reentry_is_not_an_edge():
+    import threading
+
+    from determined_tpu.lint import LockOrderSentinel
+
+    sentinel = LockOrderSentinel()
+    with sentinel:
+        r = threading.RLock()
+        a = threading.Lock()
+        with r:
+            with a:
+                with r:  # reentrant hold: no a->r ordering claim
+                    pass
+        with r:
+            pass
+    assert sentinel.violations() == []
+
+
+def test_lock_order_sentinel_condition_and_event_still_work():
+    """Condition/Event built on patched factories must behave normally
+    (wait/notify/set), exercising the _release_save passthrough."""
+    import threading
+
+    from determined_tpu.lint import LockOrderSentinel
+
+    sentinel = LockOrderSentinel()
+    with sentinel:
+        cond = threading.Condition()
+        done = threading.Event()
+        seen = []
+
+        def waiter():
+            with cond:
+                while not seen:
+                    cond.wait(timeout=5)
+            done.set()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cond:
+            seen.append(1)
+            cond.notify_all()
+        assert done.wait(timeout=5)
+        t.join(timeout=5)
+    assert sentinel.violations() == []
+
+
+def test_lock_order_sentinel_uninstall_restores_factories():
+    import threading
+
+    from determined_tpu.lint import LockOrderSentinel
+
+    orig_lock = threading.Lock
+    orig_rlock = threading.RLock
+    sentinel = LockOrderSentinel()
+    with sentinel:
+        assert threading.Lock is not orig_lock
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock

@@ -18,7 +18,10 @@ from determined_tpu.observability import (
     load_trace_events,
 )
 
-pytestmark = pytest.mark.no_thread_leaks
+# lock_order: the runtime half of the lint concurrency pass — every
+# test in this suite runs with threading.Lock/RLock patched so an
+# acquisition-order inversion fails the test that exhibited it
+pytestmark = [pytest.mark.no_thread_leaks, pytest.mark.lock_order]
 
 
 @pytest.fixture(autouse=True)
@@ -160,6 +163,9 @@ def _synthetic_run(tracer, rid, steps=5, step_s=0.004, data_s=0.002):
             time.sleep(0.005)
 
 
+@pytest.mark.no_lock_order  # asserts a step-vs-data WALL-CLOCK ratio on
+# millisecond sleeps; the lock-order sentinel's per-acquire bookkeeping on
+# the tracer/queue hot path skews exactly that ratio under suite load
 def test_goodput_ledger_attributes_wall_clock():
     """The ledger must attribute ~100% of a fully instrumented synthetic
     run: per-trial breakdowns sum to ~100% of trial wall-clock and the

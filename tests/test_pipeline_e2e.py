@@ -76,15 +76,16 @@ def test_pipe_trains_through_trainer(tmp_path, mesh_config):
     assert losses[-1] < losses[0]  # it actually learns
 
 
-_JAX_DRIFT_XFAIL = pytest.mark.xfail(
+_PARITY_DRIFT_XFAIL = pytest.mark.xfail(
     strict=False,
-    reason="known jax-0.4.37 drift (ROADMAP.md open items): shard_map "
-    "transpose _SpecError on expert/seq compositions and ~1.5% "
-    "pipe1-vs-pipe2 parity drift; tracked, not a regression gate",
+    reason="known ~1.5% pipe1-vs-pipe2 loss parity drift (ROADMAP.md open "
+    "items); the jax-0.4.37 shard_map transpose _SpecError that used to "
+    "mask this class is fixed by parallel/_compat.py's transpose shim — "
+    "what remains is numeric parity, tracked, not a regression gate",
 )
 
 
-@_JAX_DRIFT_XFAIL
+@_PARITY_DRIFT_XFAIL
 def test_pipe2_loss_parity_vs_pipe1(tmp_path):
     """Same seed, same data: the pipelined step must reproduce the plain
     step's loss trajectory (GPipe is mathematically exact; init is shared
@@ -131,7 +132,7 @@ def test_pipe_fused_ce_path(tmp_path):
     assert all(np.isfinite(losses))
 
 
-@_JAX_DRIFT_XFAIL
+@_PARITY_DRIFT_XFAIL
 def test_pipe_composes_with_seq_axis(tmp_path):
     """pipe2 × seq2 × dp2: ring attention runs INSIDE each pipeline stage
     (the ring is over seq shards, orthogonal to the stage rotation); loss
@@ -160,7 +161,7 @@ MOE_HPARAMS = dict(
 )
 
 
-@_JAX_DRIFT_XFAIL
+@_PARITY_DRIFT_XFAIL
 def test_pipe_composes_with_expert_axis(tmp_path):
     """pipe2 × expert2 × dp2: MoE blocks live inside stages with expert
     weights sharded over the expert axis and a psum combine intra-stage;
@@ -175,7 +176,6 @@ def test_pipe_composes_with_expert_axis(tmp_path):
     np.testing.assert_allclose(losses1, losses2, rtol=2e-4, atol=2e-5)
 
 
-@_JAX_DRIFT_XFAIL
 def test_pipe_moe_aux_loss_reported(tmp_path):
     """With a non-zero aux weight the pipelined MoE reports a finite
     moe_aux_loss metric (validity-gated over the GPipe bubble)."""
@@ -197,7 +197,6 @@ def test_pipe_moe_aux_loss_reported(tmp_path):
         assert 0.0 < m["moe_aux_loss"] < 4.0
 
 
-@_JAX_DRIFT_XFAIL
 def test_pipe_seq_expert_full_composition(tmp_path):
     """All axes at once: pipe2 × seq2 × expert2 trains with finite,
     decreasing loss (8 devices, every composition path exercised)."""

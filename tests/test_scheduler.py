@@ -20,7 +20,10 @@ import pytest
 
 # every test here spins scheduler/trial worker threads; none may outlive
 # its test (conftest._thread_leak_guard enforces via ThreadLeakChecker)
-pytestmark = pytest.mark.no_thread_leaks
+# lock_order: the runtime half of the lint concurrency pass — every
+# test in this suite runs with threading.Lock/RLock patched so an
+# acquisition-order inversion fails the test that exhibited it
+pytestmark = [pytest.mark.no_thread_leaks, pytest.mark.lock_order]
 
 from determined_tpu.config import ExperimentConfig
 from determined_tpu.config.experiment import InvalidExperimentConfig, Length
