@@ -207,6 +207,12 @@ def exp_status_local(args) -> int:
     print(f"experiment:  {st['name'] or '(unnamed)'}")
     print(f"status:      {st['status']}" + ("  (resumable)" if st["resumable"] else ""))
     print(f"entrypoint:  {st['entrypoint'] or '(unknown)'}")
+    if st.get("cluster"):
+        # a resumed operator needs the master this search is attached to
+        print(
+            f"cluster:     experiment {st['cluster']['experiment_id']} "
+            f"at {st['cluster']['master_url']}"
+        )
     print(
         f"trials:      {st['trials_completed']} completed, "
         f"{st['trials_in_flight']} in flight, {st['trials_created']} created"
@@ -287,16 +293,20 @@ def exp_profile_local(args) -> int:
 
 
 def exp_resume_local(args) -> int:
-    """Resume a crashed/preempted LocalExperiment from its journal.
+    """Resume a crashed/preempted driver experiment from its journal.
 
     The journal records the experiment config and trial entrypoint, so the
     directory alone is enough; ``--entrypoint`` overrides (e.g. after a
-    module rename).  Exits 75 (EX_TEMPFAIL) if the resumed run is itself
-    preempted — still resumable.
+    module rename).  A journal with a ``cluster_attached`` record resumes
+    as a ClusterExperiment — the driver re-attaches to its master
+    experiment (``-m`` overrides the journaled master url).  Exits 75
+    (EX_TEMPFAIL) if the resumed run is itself preempted — still
+    resumable.
     """
     from determined_tpu.config.experiment import ExperimentConfig
     from determined_tpu.experiment import (
         PREEMPTED_EXIT_CODE,
+        ClusterExperiment,
         ExperimentJournalError,
         LocalExperiment,
         journal_path,
@@ -324,17 +334,33 @@ def exp_resume_local(args) -> int:
         print("error: journal records no experiment config", file=sys.stderr)
         return 2
     cfg = ExperimentConfig.parse(started["config"])
-    module_name, _, class_name = entrypoint.partition(":")
-    sys.path.insert(0, os.getcwd())
-    trial_cls = getattr(importlib.import_module(module_name), class_name)
-    exp = LocalExperiment(
-        cfg,
-        trial_cls,
-        checkpoint_dir=args.checkpoint_dir,
-        seed=started.get("seed"),
-    )
     try:
-        summary = exp.resume(serial=args.serial)
+        if replay.cluster is not None:
+            # cluster-driven search: re-attach to the journaled master
+            ns = argparse.Namespace(
+                master=args.master or replay.cluster.get("master_url"),
+                user=getattr(args, "user", None),
+                cert=getattr(args, "cert", None),
+            )
+            exp = ClusterExperiment(
+                cfg,
+                entrypoint,
+                session=_client(ns).session,
+                checkpoint_dir=args.checkpoint_dir,
+                seed=started.get("seed"),
+            )
+            summary = exp.resume()
+        else:
+            module_name, _, class_name = entrypoint.partition(":")
+            sys.path.insert(0, os.getcwd())
+            trial_cls = getattr(importlib.import_module(module_name), class_name)
+            lexp = LocalExperiment(
+                cfg,
+                trial_cls,
+                checkpoint_dir=args.checkpoint_dir,
+                seed=started.get("seed"),
+            )
+            summary = lexp.resume(serial=args.serial)
     except ExperimentJournalError as e:
         # e.g. the original driver is still alive and owns the journal
         print(f"error: {e}", file=sys.stderr)
@@ -858,27 +884,60 @@ def preview_search(args) -> int:
     return 0
 
 
-def run_local(args) -> int:
+def exp_run(args) -> int:
+    """Drive a search from this process.
+
+    Default: the in-process ``LocalExperiment`` over ``jax.devices()``
+    (exactly ``dtpu run-local``).  ``--cluster``: the search loop still
+    runs HERE (journaled under ``--checkpoint-dir``), but every trial the
+    searcher creates is submitted to the master, which gang-fits its slots
+    across agents and launches one ``run_trial`` process per rank with
+    ``jax.distributed`` rendezvous env (docs/cluster.md).
+    """
     import yaml
 
     from determined_tpu.config.experiment import ExperimentConfig
-    from determined_tpu.experiment import LocalExperiment
+    from determined_tpu.experiment import PREEMPTED_EXIT_CODE
 
     with open(args.config) as f:
         cfg = ExperimentConfig.parse(yaml.safe_load(f))
-    module_name, _, class_name = args.entrypoint.partition(":")
-    sys.path.insert(0, os.getcwd())
-    trial_cls = getattr(importlib.import_module(module_name), class_name)
-    exp = LocalExperiment(cfg, trial_cls, checkpoint_dir=args.checkpoint_dir)
-    summary = exp.run()
+    entrypoint = getattr(args, "entrypoint", None) or cfg.entrypoint
+    if not entrypoint:
+        print(
+            "error: no entrypoint (pass pkg.module:TrialClass or set "
+            "`entrypoint:` in the config)",
+            file=sys.stderr,
+        )
+        return 2
+    if getattr(args, "cluster", False):
+        from determined_tpu.experiment import ClusterExperiment
+
+        exp = ClusterExperiment(
+            cfg,
+            entrypoint,
+            session=_client(args).session,
+            checkpoint_dir=args.checkpoint_dir,
+        )
+        summary = exp.run()
+    else:
+        from determined_tpu.experiment import LocalExperiment
+
+        module_name, _, class_name = entrypoint.partition(":")
+        sys.path.insert(0, os.getcwd())
+        trial_cls = getattr(importlib.import_module(module_name), class_name)
+        lexp = LocalExperiment(cfg, trial_cls, checkpoint_dir=args.checkpoint_dir)
+        summary = lexp.run()
     _print_json(summary)
     if summary.get("status") == "preempted":
-        # EX_TEMPFAIL: the search drained to checkpoints; rerun with
+        # EX_TEMPFAIL: the search drained to checkpoints (local) or
+        # detached from its running gangs (cluster); rerun with
         # `dtpu experiment resume <checkpoint_dir>` to finish it
-        from determined_tpu.experiment import PREEMPTED_EXIT_CODE
-
         return PREEMPTED_EXIT_CODE
     return 0
+
+
+# back-compat alias: `dtpu run-local` predates `dtpu experiment run`
+run_local = exp_run
 
 
 # ---- parser ----------------------------------------------------------------
@@ -941,6 +1000,30 @@ def build_parser() -> argparse.ArgumentParser:
     dl = exp.add_parser("delete")
     dl.add_argument("id", type=int)
     dl.set_defaults(fn=exp_delete)
+    rn = exp.add_parser(
+        "run",
+        help="drive a search from this process: in-process by default, "
+        "--cluster dispatches trials through the master (docs/cluster.md)",
+    )
+    rn.add_argument("config")
+    rn.add_argument(
+        "entrypoint",
+        nargs="?",
+        help="pkg.module:TrialClass (default: `entrypoint:` in the config)",
+    )
+    rn.add_argument(
+        "--cluster",
+        action="store_true",
+        help="submit searcher-created trials to the master for gang "
+        "dispatch across agents instead of running them in-process",
+    )
+    rn.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="driver directory (journal + traces; default: ./local_… or "
+        "./cluster_experiment_driver)",
+    )
+    rn.set_defaults(fn=exp_run)
     st = exp.add_parser(
         "status",
         help="journal-backed status of a LOCAL experiment directory",

@@ -74,7 +74,12 @@ class Length:
 class SearcherConfig:
     """Searcher section — reference ``schemas/expconf/v0/searcher.json``.
 
-    name: single | random | grid | asha | adaptive_asha
+    name: single | random | grid | asha | adaptive_asha | driver
+
+    ``driver`` is execution-only: the search loop lives in a remote
+    cluster-experiment driver (``experiment/cluster.py``), which submits
+    each trial it creates to the master; a driver config never builds a
+    local SearchMethod.
     """
 
     name: str = "single"
@@ -93,7 +98,7 @@ class SearcherConfig:
     source_trial_id: Optional[int] = None
 
     def __post_init__(self):
-        if self.name not in ("single", "random", "grid", "asha", "adaptive_asha"):
+        if self.name not in ("single", "random", "grid", "asha", "adaptive_asha", "driver"):
             raise InvalidExperimentConfig(f"unknown searcher {self.name!r}")
         if self.mode not in ("conservative", "standard", "aggressive"):
             raise InvalidExperimentConfig(f"unknown adaptive mode {self.mode!r}")

@@ -441,15 +441,54 @@ def main() -> int:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    # join the multi-host rendezvous before touching devices
+    # join the multi-host rendezvous before touching devices.  The wait is
+    # timed here (the tracer is not configured yet — that needs the parsed
+    # exp config) and recorded as a rendezvous.wait span once the tracer
+    # is up, so `dtpu experiment profile` attributes multi-host setup time
+    # instead of lumping it into "other".
+    rendezvous_window = None
+    info = None
     rdzv = os.environ.get("DTPU_RENDEZVOUS")
     if rdzv:
         info = json.loads(rdzv)
         if int(info.get("num_nodes", 1)) > 1:
+            import time as _time
+
+            # XLA:CPU has no cross-process collectives by default
+            # ("Multiprocess computations aren't implemented on the CPU
+            # backend") — the gloo implementation shipped with jaxlib is
+            # what makes devcluster CPU gangs real SPMD programs.  Must be
+            # set before the backend client exists.  Applied whenever cpu
+            # MAY be the backend: an explicit cpu in JAX_PLATFORMS, or the
+            # env var unset (the default resolution picks cpu on CPU-only
+            # hosts, and probing jax.default_backend() here would create
+            # the client before the flag takes effect).  The flag only
+            # configures the CPU client, so TPU/GPU gangs are unaffected.
+            platforms = os.environ.get("JAX_PLATFORMS", "")
+            if not platforms or "cpu" in platforms.split(","):
+                try:
+                    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+                except (AttributeError, ValueError):
+                    logger.warning(
+                        "jax %s has no gloo CPU collectives; multi-process "
+                        "CPU gangs may fail to compile", jax.__version__,
+                    )
+
+            logger.info(
+                "rendezvous: joining as rank %s/%s via coordinator %s",
+                info["node_rank"], info["num_nodes"], info["coordinator"],
+            )
+            rdzv_t0 = _time.monotonic()
             jax.distributed.initialize(
                 coordinator_address=info["coordinator"],
                 num_processes=int(info["num_nodes"]),
                 process_id=int(info["node_rank"]),
+            )
+            rendezvous_window = (rdzv_t0, _time.monotonic())
+            logger.info(
+                "rendezvous: joined in %.1fs (%d global devices)",
+                rendezvous_window[1] - rendezvous_window[0],
+                jax.device_count(),
             )
 
     from determined_tpu import core, train
@@ -518,6 +557,20 @@ def main() -> int:
     )
     if obs.enabled:
         tracer.start()
+        if rendezvous_window is not None:
+            # recorded against monotonic endpoints captured above, so the
+            # ledger sees the real wait even though the tracer came up later
+            tracer.record_span(
+                "rendezvous.wait",
+                "rendezvous",
+                rendezvous_window[0],
+                rendezvous_window[1],
+                {
+                    "coordinator": (info or {}).get("coordinator"),
+                    "num_nodes": (info or {}).get("num_nodes"),
+                    "node_rank": (info or {}).get("node_rank"),
+                },
+            )
 
     core_ctx = core.init()
     try:

@@ -1,6 +1,11 @@
-"""Experiment orchestration: local searcher-driven runner + gang scheduler
-+ crash-recovery journal."""
+"""Experiment orchestration: local searcher-driven runner, cluster-driven
+runner (trials dispatched through the master), gang scheduler, and the
+crash-recovery journal."""
 
+from determined_tpu.experiment.cluster import (
+    ClusterExperiment,
+    run_cluster_experiment,
+)
 from determined_tpu.experiment.journal import (
     ExperimentJournal,
     ExperimentJournalError,
@@ -23,6 +28,7 @@ from determined_tpu.experiment.scheduler import (
 )
 
 __all__ = [
+    "ClusterExperiment",
     "ExperimentJournal",
     "ExperimentJournalError",
     "JournaledSearcher",
@@ -36,5 +42,6 @@ __all__ = [
     "experiment_status",
     "journal_path",
     "read_journal",
+    "run_cluster_experiment",
     "run_experiment",
 ]

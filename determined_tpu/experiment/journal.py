@@ -9,6 +9,10 @@ single-host analog — an append-only, fsynced JSONL file at
 ``checkpoint_dir/experiment.journal`` that records:
 
 - ``experiment_started``   name, raw config, trial entrypoint, seed
+- ``cluster_attached``     master url + master experiment id, when the
+                           search is driven through the cluster
+                           (``experiment/cluster.py``) — lets a resumed
+                           driver re-attach instead of re-submitting
 - ``searcher_snapshot``    full ``Searcher.state_json`` (method + ctx
                            request-id counter/rng + trial records)
 - ``trial_created``        rid, hparams
@@ -104,6 +108,7 @@ class ExperimentJournal:
         self._since_compact = 0
         # rolling memory of what compaction must preserve
         self._started: Optional[Dict[str, Any]] = None
+        self._cluster: Optional[Dict[str, Any]] = None
         self._snapshot: Optional[Dict[str, Any]] = None
         self._created: Dict[int, Dict[str, Any]] = {}
         self._checkpoints: Dict[int, Dict[str, Any]] = {}
@@ -248,6 +253,8 @@ class ExperimentJournal:
         self._seq = max(self._seq, int(rec.get("seq", 0)))
         if t == "experiment_started":
             self._started = rec
+        elif t == "cluster_attached":
+            self._cluster = rec
         elif t == "searcher_snapshot":
             self._snapshot = rec
         elif t == "trial_created":
@@ -264,6 +271,8 @@ class ExperimentJournal:
         records: List[Dict[str, Any]] = []
         if self._started is not None:
             records.append(self._started)
+        if self._cluster is not None:
+            records.append(self._cluster)
         if self._snapshot is not None:
             records.append(self._snapshot)
         records.extend(self._created[r] for r in sorted(self._created))
@@ -328,6 +337,10 @@ class JournalReplay:
     checkpoints: Dict[int, str]                # rid -> latest ckpt uuid
     results: Dict[int, Dict[str, Any]]         # rid -> TrialResult payload
     status: str                                # running|preempted|completed
+    # cluster-driven searches (experiment/cluster.py): which master owns
+    # trial execution, so a resumed driver re-attaches to the same
+    # experiment instead of starting a new one
+    cluster: Optional[Dict[str, Any]] = None
 
     @property
     def in_flight(self) -> List[int]:
@@ -345,6 +358,7 @@ def read_journal(path: str) -> JournalReplay:
     if not records:
         raise ExperimentJournalError(f"experiment journal at {path} is empty")
     started: Optional[Dict[str, Any]] = None
+    cluster: Optional[Dict[str, Any]] = None
     snapshot: Optional[Dict[str, Any]] = None
     snapshot_seq = -1
     created: Dict[int, Dict[str, Any]] = {}
@@ -355,6 +369,8 @@ def read_journal(path: str) -> JournalReplay:
         t = rec.get("type")
         if t == "experiment_started":
             started = rec
+        elif t == "cluster_attached":
+            cluster = rec
         elif t == "searcher_snapshot":
             snapshot = rec
             snapshot_seq = int(rec.get("seq", -1))
@@ -383,6 +399,7 @@ def read_journal(path: str) -> JournalReplay:
         checkpoints=checkpoints,
         results=results,
         status=status,
+        cluster=cluster,
     )
 
 
@@ -412,6 +429,14 @@ def experiment_status(checkpoint_dir: str) -> Dict[str, Any]:
         "name": started.get("name"),
         "entrypoint": started.get("entrypoint"),
         "seed": started.get("seed"),
+        "cluster": (
+            None
+            if replay.cluster is None
+            else {
+                "master_url": replay.cluster.get("master_url"),
+                "experiment_id": replay.cluster.get("experiment_id"),
+            }
+        ),
         "status": replay.status,
         "resumable": replay.status != "completed",
         "checkpoint_dir": checkpoint_dir,

@@ -27,10 +27,20 @@ Categories (the ``cat=`` each instrumentation site passes):
 - ``checkpoint`` save/drain/stall/finalize
 - ``restore``    checkpoint restore (resume replay)
 - ``validate``   validation sweeps
-- ``scheduler``  slot wait/dispatch
+- ``scheduler``  slot wait/dispatch (incl. ``gang.dispatch`` — the wait
+                 between submitting a trial to the master and its gang
+                 holding slots)
+- ``rendezvous`` multi-host ``jax.distributed.initialize`` join wait
+                 (``exec/run_trial.py``)
+- ``remote``     cluster-experiment driver only: the gang's execution
+                 window on the master (``gang.remote``) — the ranks' own
+                 step/data attribution lives in their per-rank traces
 - ``journal``    experiment WAL append+fsync
 - ``restart``    supervisor backoff between attempts
 - ``other``      uninstrumented remainder inside a trial/experiment span
+
+``gang.teardown`` instants (category ``gang``) mark the master tearing
+down and rescheduling a whole gang after one rank died.
 """
 
 from __future__ import annotations

@@ -34,6 +34,18 @@ def method_from_config(
     max_time = cfg.max_time
     if max_time is None and cfg.max_length is not None:
         max_time = cfg.max_length.units
+    if cfg.name == "driver":
+        from determined_tpu.config.experiment import InvalidExperimentConfig
+
+        # the master-side stub for cluster-driven searches: the config the
+        # master stores has its searcher REWRITTEN to this name; a driver
+        # cannot reconstruct the original search method from it
+        raise InvalidExperimentConfig(
+            "searcher 'driver' is execution-only (the master-side stub for "
+            "cluster experiments); run the search with the ORIGINAL config "
+            "— the one holding the real method (asha/random/...) — not the "
+            "rewritten config fetched from the master"
+        )
     if cfg.name == "single":
         return SingleSearch()
     if cfg.name == "random":

@@ -520,7 +520,16 @@ class Trainer:
 
     def _async_checkpointing(self) -> bool:
         opt = self.context.exp_config.optimizations if self.context.exp_config else None
-        return opt.async_checkpointing if opt is not None else True
+        enabled = opt.async_checkpointing if opt is not None else True
+        # Multi-process CPU gangs (devcluster) run collectives over gloo,
+        # whose TCP pairs cannot carry two in-flight collectives from
+        # different threads: the background writer's sync_global_devices
+        # barrier interleaves with the training step's psum and aborts the
+        # process (gloo EnforceNotMet preamble.length mismatch).  TPU/GPU
+        # runtimes order concurrent collectives, so only CPU downgrades.
+        if enabled and jax.process_count() > 1 and jax.default_backend() == "cpu":
+            return False
+        return enabled
 
     def _snapshot_arrays(self, tree: Any) -> Any:
         """On-device copy of the array state.  The train step donates its

@@ -196,14 +196,29 @@ class Agent {
     }
   }
 
-  // fork failed (EAGAIN/ENOMEM): close the pipe and tell the master the
-  // launch died, so the trial/task is failed instead of RUNNING forever
-  void report_fork_failure(int64_t trial_id, const std::string& alloc_id,
-                           const std::string& task_id, int out_pipe[2]) {
-    close(out_pipe[0]);
-    close(out_pipe[1]);
-    fprintf(stderr, "agent %s: fork failed for %s\n", opts_.id.c_str(),
+  // Launch failed before the trial process existed (pipe() or fork()
+  // EMFILE/EAGAIN/ENOMEM): tell the master the launch died so the
+  // trial/task — and, for gangs, every OTHER rank's process via the
+  // master's gang teardown — is failed instead of sitting RUNNING
+  // forever.  A log line ships first so the trial log explains WHY this
+  // rank never produced output.
+  void report_launch_failure(int64_t trial_id, const std::string& alloc_id,
+                             const std::string& task_id, const char* what) {
+    fprintf(stderr, "agent %s: %s failed for %s\n", opts_.id.c_str(), what,
             (task_id.empty() ? alloc_id : task_id).c_str());
+    Json log = Json::object();
+    if (task_id.empty()) {
+      log.set("trial_id", Json(trial_id));
+    } else {
+      log.set("task_id", task_id);
+    }
+    log.set("agent", opts_.id);
+    Json lines = Json::array();
+    lines.push_back("agent " + opts_.id + ": " + what +
+                    " failed launching the trial process (allocation " +
+                    (task_id.empty() ? alloc_id : task_id) + ")");
+    log.set("lines", lines);
+    master_req("POST", "/api/v1/logs", log.dump(), 10);
     if (!task_id.empty()) {
       master_req("POST", "/api/v1/tasks/" + task_id + "/exit", "{}", 10);
       return;
@@ -215,11 +230,24 @@ class Agent {
                body.dump(), 10);
   }
 
+  void report_fork_failure(int64_t trial_id, const std::string& alloc_id,
+                           const std::string& task_id, int out_pipe[2]) {
+    close(out_pipe[0]);
+    close(out_pipe[1]);
+    report_launch_failure(trial_id, alloc_id, task_id, "fork");
+  }
+
   void launch(const Json& work) {
     int64_t trial_id = work["trial_id"].as_int();
     const std::string alloc_id = work["allocation_id"].as_string();
     int out_pipe[2];
-    if (pipe(out_pipe) != 0) return;
+    if (pipe(out_pipe) != 0) {
+      // fd exhaustion: a silent return here would leave THIS rank's
+      // allocation RUNNING forever while its gang peers block in
+      // rendezvous — same terminal report as a fork failure
+      report_launch_failure(trial_id, alloc_id, "", "pipe");
+      return;
+    }
 
     pid_t pid = fork();
     if (pid < 0) {
@@ -271,7 +299,10 @@ class Agent {
   void launch_task(const Json& work) {
     const std::string task_id = work["task_id"].as_string();
     int out_pipe[2];
-    if (pipe(out_pipe) != 0) return;
+    if (pipe(out_pipe) != 0) {
+      report_launch_failure(0, "", task_id, "pipe");
+      return;
+    }
     pid_t pid = fork();
     if (pid < 0) {
       report_fork_failure(0, "", task_id, out_pipe);

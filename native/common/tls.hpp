@@ -59,6 +59,9 @@ class TlsLib {
  private:
   TlsLib() {
     handle_ = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    // every symbol this shim loads exists unchanged in OpenSSL 1.1.1,
+    // still what many LTS images ship — fall back before giving up
+    if (!handle_) handle_ = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_GLOBAL);
     if (!handle_) handle_ = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
     if (!handle_) return;
     bool ok = true;
@@ -113,7 +116,7 @@ class TlsServerContext {
   // returns "" on success, else an error message
   std::string init(const std::string& cert_file, const std::string& key_file) {
     auto& lib = TlsLib::instance();
-    if (!lib.available()) return "libssl.so.3 not found on this host";
+    if (!lib.available()) return "libssl.so.3 / libssl.so.1.1 not found on this host";
     ctx_ = lib.SSL_CTX_new(lib.TLS_server_method());
     if (!ctx_) return "SSL_CTX_new failed";
     if (lib.SSL_CTX_use_certificate_chain_file(ctx_, cert_file.c_str()) != 1) {

@@ -583,6 +583,13 @@ class Master {
       do_trial_exited(ev["trial_id"].as_int(), static_cast<int>(ev["exit_code"].as_int()));
     } else if (type == "trial_restarted") {
       do_trial_restarted(ev["trial_id"].as_int());
+    } else if (type == "driver_trial") {
+      do_driver_create_trial(ev["experiment_id"].as_int(), ev["request_id"].as_int(),
+                             ev["hparams"], ev["trial_id"].as_int());
+    } else if (type == "trial_stop") {
+      do_trial_stop(ev["trial_id"].as_int());
+    } else if (type == "searcher_shutdown") {
+      do_searcher_shutdown(ev["id"].as_int());
     } else if (type == "trial_yielded") {
       do_trial_yielded(ev["trial_id"].as_int());
     } else if (type == "checkpoint") {
@@ -1496,8 +1503,36 @@ class Master {
     bool yielded = exit_code == 0 && !t.stop_requested &&
                    (t.sched_preempted ||
                     (preempt_signaled && exp.state == "PAUSED"));
+    // a pending stop wins over the restart budget: relaunching a gang the
+    // searcher already cut (it died before checkpointing the stop) would
+    // spend slots training a discarded trial
     bool restart = exit_code != 0 && exp.state != "PAUSED" &&
-                   t.restarts < exp.max_restarts && !t.dont_retry;
+                   t.restarts < exp.max_restarts && !t.dont_retry &&
+                   !t.stop_requested;
+    // Gang fault tolerance: one rank's exit is the whole allocation's exit.
+    // A multi-agent gang's surviving ranks are blocked inside collectives
+    // (or about to crash into their timeouts) the moment a peer dies —
+    // tear the rest of the gang down NOW so no rank sits RUNNING against a
+    // dead allocation, holding slots the reschedule needs.  SIGTERM first
+    // (agent-side grace), so a yielding/preempted gang still checkpoints.
+    {
+      auto ait = allocations_.find(t.allocation_id);
+      if (ait != allocations_.end() && !ait->second.ended &&
+          ait->second.groups.size() > 1) {
+        kill_allocation(ait->second);
+        if (exit_code != 0) {
+          append_jsonl_striped(
+              logs_path(trial_id),
+              Json::object()
+                  .set("ts", Json(now_ms()))
+                  .set("level", "ERROR")
+                  .set("line", "gang: rank exit (code " + std::to_string(exit_code) +
+                                   ") tears down the remaining " +
+                                   std::to_string(ait->second.groups.size() - 1) +
+                                   " rank(s) of allocation " + ait->second.id));
+        }
+      }
+    }
     if (yielded) {
       // preempted by the scheduler for a higher-priority gang: the harness
       // checkpointed and exited cleanly; back to PENDING, no restart burned
@@ -1544,6 +1579,66 @@ class Master {
     t.sched_preempted = false;
   }
 
+  // ---- driver-managed experiments (cluster-experiment driver) ------------
+  // The remote Python driver (determined_tpu/experiment/cluster.py) owns
+  // the search loop; these handlers own only trial lifecycle.  Each has a
+  // journal event so replay reconstructs driver-created trials exactly.
+
+  // Create (or idempotently find) the trial backing a driver request id.
+  // ``forced_tid`` replays the id the live path assigned, keeping
+  // checkpoint/metric records attached across a master restart.
+  int64_t do_driver_create_trial(int64_t exp_id, int64_t request_id,
+                                 const Json& hparams, int64_t forced_tid = 0) {
+    auto eit = experiments_.find(exp_id);
+    if (eit == experiments_.end()) return 0;
+    ExperimentState& exp = eit->second;
+    auto rit = exp.rid_to_trial.find(request_id);
+    if (rit != exp.rid_to_trial.end()) return rit->second;  // resubmit/retry
+    int64_t tid = forced_tid ? forced_tid : next_trial_id_++;
+    if (forced_tid) next_trial_id_ = std::max(next_trial_id_, forced_tid + 1);
+    TrialState t;
+    t.id = tid;
+    t.experiment_id = exp_id;
+    t.request_id = request_id;
+    t.hparams = hparams;
+    trials_[tid] = t;
+    exp.rid_to_trial[request_id] = tid;
+    auto actions = exp.method->trial_created(*exp.ctx, request_id);
+    handle_actions(exp, actions);
+    return tid;
+  }
+
+  // Searcher-style graceful early stop (the driver decided, e.g. an ASHA
+  // rung cut): the harness checkpoints at its next boundary and exits 0,
+  // which do_trial_exited records as STOPPED.
+  void do_trial_stop(int64_t trial_id) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return;
+    TrialState& t = tit->second;
+    t.stop_requested = true;
+    if (t.state == "PENDING") {
+      // not running anywhere (fresh submit, or between gang restarts):
+      // there is no allocation to preempt and the scheduler would happily
+      // (re)launch it later, training the full budget the stop meant to
+      // cut — resolve the stop NOW, as the experiment-cancel path does
+      t.state = "STOPPED";
+      auto eit = experiments_.find(t.experiment_id);
+      if (eit != experiments_.end()) {
+        auto actions = eit->second.method->trial_exited(*eit->second.ctx, t.request_id);
+        handle_actions(eit->second, actions);
+      }
+      return;
+    }
+    signal_preempt(t.allocation_id);
+  }
+
+  void do_searcher_shutdown(int64_t exp_id) {
+    auto eit = experiments_.find(exp_id);
+    if (eit == experiments_.end()) return;
+    eit->second.searcher_shutdown = true;
+    maybe_complete(eit->second);
+  }
+
   void do_trial_exited(int64_t trial_id, int exit_code) {
     auto tit = trials_.find(trial_id);
     if (tit == trials_.end()) return;
@@ -1563,7 +1658,9 @@ class Master {
       t.state = "PENDING";
       t.allocation_id.clear();
     } else {
-      t.state = "ERROR";
+      // a stopped-then-crashed trial is STOPPED, not ERROR: the searcher
+      // had already discarded it, so its death is not a trial failure
+      t.state = t.stop_requested ? "STOPPED" : "ERROR";
       auto actions = exp.method->trial_exited(*exp.ctx, t.request_id);
       handle_actions(exp, actions);
     }
@@ -2285,6 +2382,49 @@ class Master {
     return {0, ""};
   }
 
+  // resources.single_slice submit gate: a gang that declares "my
+  // collectives must stay on one ICI slice" but can NEVER fit any single
+  // host must be rejected with a clear error, not silently accepted —
+  // external pools would split it across nodes (k8s slots_per_node /
+  // slurm slots_per_node), and an agent pool whose biggest host is too
+  // small would queue it forever.  An EMPTY agent pool still queues: the
+  // provisioner (or an operator) may yet register a big-enough host.
+  // Caller holds mu_.  Returns "" or the rejection message.
+  std::string single_slice_gate(const Json& config) const {
+    const Json& res = config["resources"];
+    if (!res["single_slice"].as_bool(false)) return "";
+    int64_t slots = slots_from_config(config);
+    std::string pool_name = config_str(res, "resource_pool", "default");
+    const PoolConfig* pc = pool_config(pool_name);
+    if (pc != nullptr && pc->external()) {
+      int per_node = pc->type == "kubernetes" ? pc->k8s_slots_per_node
+                                              : pc->slurm_slots_per_node;
+      if (per_node > 0 && slots > per_node) {
+        return "resources.single_slice: a " + std::to_string(slots) +
+               "-slot gang would span " +
+               std::to_string((slots + per_node - 1) / per_node) +
+               " nodes in " + pc->type + " pool " + pool_name + " (" +
+               std::to_string(per_node) + " slots per node); shrink the "
+               "mesh, raise slots_per_node, or drop single_slice";
+      }
+      return "";
+    }
+    int max_host_slots = 0;
+    bool any_agent = false;
+    for (const auto& [aid, ag] : agents_) {
+      if (ag.pool != pool_name || ag.draining) continue;
+      any_agent = true;
+      max_host_slots = std::max(max_host_slots, ag.slots);
+    }
+    if (any_agent && slots > max_host_slots) {
+      return "resources.single_slice: no host in pool " + pool_name +
+             " has " + std::to_string(slots) + " slots (largest agent: " +
+             std::to_string(max_host_slots) + "); the gang would need a "
+             "DCN-spanning split, which single_slice forbids";
+    }
+    return "";
+  }
+
   bool exp_allows(const std::string& user, const ExperimentState& e,
                   bool write) const {
     return workspace_allows(user, config_str(e.config, "workspace", "Uncategorized"),
@@ -2422,6 +2562,9 @@ class Master {
     j.set("latest_checkpoint", t.latest_checkpoint);
     j.set("allocation_id", t.allocation_id);
     j.set("progress", Json(t.progress));
+    // in-memory validation count: pollers (the cluster-experiment driver)
+    // gate their O(metrics-file) /metrics reads on this changing
+    j.set("validations", Json(static_cast<int64_t>(t.val_by_step.size())));
     if (!t.val_by_step.empty()) {
       auto eit = experiments_.find(t.experiment_id);
       bool sib = eit == experiments_.end() || eit->second.smaller_is_better;
@@ -3327,6 +3470,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
                        " in pool " + pc->name);
         }
       }
+      // single_slice gangs that can never fit one host are config errors,
+      // not queueable work (ISSUE: no silent acceptance of DCN spans)
+      std::string ss_err = m.single_slice_gate(config);
+      if (!ss_err.empty()) return R::error(400, ss_err);
     }
     if (!config.contains("checkpoint_storage")) {
       std::lock_guard<std::mutex> lk(m.mu_);
@@ -4020,6 +4167,11 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         cleanup_tmp();
         return R::error(code, msg);
       }
+      std::string ss_err = m.single_slice_gate(config);
+      if (!ss_err.empty()) {
+        cleanup_tmp();
+        return R::error(400, ss_err);
+      }
     }
     std::string cfg_err = Master::validate_config(config);
     if (!cfg_err.empty()) {
@@ -4190,6 +4342,105 @@ void install_routes_impl(Master& m, HttpServer& srv) {
             authed([exp_signal](const HttpRequest& r) { return exp_signal(r, "cancel"); }));
   srv.route("POST", "/api/v1/experiments/{id}/kill",
             authed([exp_signal](const HttpRequest& r) { return exp_signal(r, "kill"); }));
+
+  // ---- driver-managed experiments (cluster-experiment driver) ----
+  // The search loop lives in a remote Python driver
+  // (determined_tpu/experiment/cluster.py, journaled on the driver side);
+  // the master owns gang dispatch, restarts, and rendezvous.  Trials
+  // arrive one at a time as the driver's searcher creates them.
+  srv.route("POST", "/api/v1/experiments/{id}/trials", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    int64_t eid = std::stoll(req.params.at("id"));
+    auto it = m.experiments_.find(eid);
+    if (it == m.experiments_.end()) return R::error(404, "no such experiment");
+    ExperimentState& exp = it->second;
+    if (!m.exp_allows(m.authenticate(req), exp, true)) {
+      return R::error(404, "no such experiment");
+    }
+    if (Master::config_str(exp.config["searcher"], "name", "single") !=
+        std::string("driver")) {
+      return R::error(409, "experiment " + std::to_string(eid) +
+                               " is not driver-managed (searcher.name must "
+                               "be \"driver\")");
+    }
+    if (exp.state != "ACTIVE" && exp.state != "PAUSED") {
+      return R::error(409, "experiment is " + exp.state);
+    }
+    if (!body["request_id"].is_number()) {
+      return R::error(400, "request_id (the driver searcher's trial id) is required");
+    }
+    int64_t rid = body["request_id"].as_int();
+    auto existing = exp.rid_to_trial.find(rid);
+    if (existing != exp.rid_to_trial.end()) {
+      // idempotent resubmit: a driver retry (the POST opts into retries)
+      // or a resumed driver re-attaching to its in-flight trials
+      Json out = Json::object();
+      out.set("id", Json(existing->second));
+      out.set("existing", Json(true));
+      return R::json(out.dump());
+    }
+    int64_t tid = m.do_driver_create_trial(eid, rid, body["hparams"]);
+    m.record(Json::object()
+                 .set("type", "driver_trial")
+                 .set("experiment_id", Json(eid))
+                 .set("request_id", Json(rid))
+                 .set("hparams", body["hparams"])
+                 .set("trial_id", Json(tid)));
+    m.schedule();
+    Json out = Json::object();
+    out.set("id", Json(tid));
+    return R::json(out.dump(), 201);
+  }));
+
+  // driver searcher finished creating trials: once every trial is
+  // terminal the experiment completes (same maybe_complete path the
+  // native searchers' Shutdown action takes)
+  srv.route("POST", "/api/v1/experiments/{id}/searcher/shutdown",
+            authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    int64_t eid = std::stoll(req.params.at("id"));
+    auto it = m.experiments_.find(eid);
+    if (it == m.experiments_.end()) return R::error(404, "no such experiment");
+    if (!m.exp_allows(m.authenticate(req), it->second, true)) {
+      return R::error(404, "no such experiment");
+    }
+    if (Master::config_str(it->second.config["searcher"], "name", "single") !=
+        std::string("driver")) {
+      return R::error(409, "not a driver-managed experiment");
+    }
+    if (!it->second.searcher_shutdown) {
+      m.record(Json::object().set("type", "searcher_shutdown").set("id", Json(eid)));
+      m.do_searcher_shutdown(eid);
+    }
+    Json out = Json::object();
+    out.set("state", it->second.state);
+    return R::json(out.dump());
+  }));
+
+  // graceful searcher-style early stop (driver ASHA rung cut): the
+  // harness sees the preempt signal, checkpoints, exits 0 -> STOPPED
+  srv.route("POST", "/api/v1/trials/{id}/stop", authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    int64_t tid = std::stoll(req.params.at("id"));
+    auto it = m.trials_.find(tid);
+    if (it == m.trials_.end()) return R::error(404, "no such trial");
+    auto eit = m.experiments_.find(it->second.experiment_id);
+    if (eit != m.experiments_.end() &&
+        !m.exp_allows(m.authenticate(req), eit->second, true)) {
+      return R::error(404, "no such trial");
+    }
+    if ((it->second.state == "PENDING" || it->second.state == "RUNNING") &&
+        !it->second.stop_requested) {
+      m.record(Json::object().set("type", "trial_stop").set("trial_id", Json(tid)));
+      m.do_trial_stop(tid);
+    }
+    Json out = Json::object();
+    out.set("state", it->second.state);
+    out.set("stop_requested", Json(it->second.stop_requested));
+    return R::json(out.dump());
+  }));
 
   // ---- trials ----
   srv.route("GET", "/api/v1/trials/{id}", authed([&m](const HttpRequest& req) {

@@ -204,6 +204,45 @@ class SingleSearch : public SearchMethod {
   bool closed_ = false;
 };
 
+// Driver-managed search (the cluster-experiment driver,
+// determined_tpu/experiment/cluster.py): the search LOOP runs in a remote
+// Python driver holding the journaled searcher; the master only owns
+// trial execution.  This method therefore creates nothing and never
+// shuts the experiment down on its own — trials arrive through
+// POST /experiments/{id}/trials and the terminal transition through
+// POST /experiments/{id}/searcher/shutdown.  Progress is closed/created.
+class DriverSearch : public SearchMethod {
+ public:
+  std::vector<SearchAction> initial_trials(SearchCtx&) override { return {}; }
+  std::vector<SearchAction> trial_created(SearchCtx&, int64_t) override {
+    ++created_;
+    return {};
+  }
+  std::vector<SearchAction> validation_completed(SearchCtx&, int64_t, double, int64_t) override {
+    return {};
+  }
+  std::vector<SearchAction> trial_exited(SearchCtx&, int64_t) override {
+    ++closed_;
+    return {};
+  }
+  double progress() const override {
+    return created_ == 0 ? 0.0
+                         : static_cast<double>(closed_) / static_cast<double>(created_);
+  }
+  Json snapshot() const override {
+    return Json::object()
+        .set("created", Json(static_cast<int64_t>(created_)))
+        .set("closed", Json(static_cast<int64_t>(closed_)));
+  }
+  void restore(const Json& s) override {
+    created_ = static_cast<int>(s["created"].as_int(0));
+    closed_ = static_cast<int>(s["closed"].as_int(0));
+  }
+
+ private:
+  int created_ = 0, closed_ = 0;
+};
+
 class RandomSearch : public SearchMethod {
  public:
   RandomSearch(int max_trials, int max_concurrent)
@@ -532,6 +571,7 @@ inline std::unique_ptr<SearchMethod> make_search_method(const Json& scfg,
   double divisor = scfg["divisor"].as_double(4.0);
 
   if (name == "single") return std::make_unique<SingleSearch>();
+  if (name == "driver") return std::make_unique<DriverSearch>();
   if (name == "random") return std::make_unique<RandomSearch>(max_trials, max_conc ? max_conc : 16);
   if (name == "grid") return std::make_unique<GridSearch>(hparams, max_conc ? max_conc : 16);
   if (name == "asha") {

@@ -1,0 +1,305 @@
+"""Devcluster harness: the native master + N agents as local processes.
+
+The reference develops against ``devcluster`` (a tmux-ish process manager
+driving master + agents from one YAML); this is the TPU-native analog,
+shared by three consumers:
+
+- **tests**: ``tests/test_devcluster.py`` / ``tests/test_cluster_experiment.py``
+  import :class:`DevCluster` as a fixture (marked ``devcluster`` — skipped
+  cleanly when the binaries are not built);
+- **CI smoke**: ``scripts/devcluster.sh`` builds the binaries and runs
+  ``python scripts/devcluster.py --smoke`` — master + 2 agents + one
+  2-process CPU gang through real ``jax.distributed`` rendezvous;
+- **humans**: ``python scripts/devcluster.py`` leaves a cluster up to poke
+  at with ``dtpu -m http://127.0.0.1:<port> ...`` (Ctrl-C tears it down).
+
+Binaries come from ``native/build`` (or ``DTPU_NATIVE_BUILD_DIR``, e.g. a
+TSAN build).  ``build_binaries()`` uses cmake when available and falls
+back to a direct g++ invocation (the tree is dependency-free on purpose).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Iterable, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# DTPU_NATIVE_BUILD_DIR points the whole suite at e.g. a TSAN build
+# (native/build-tsan; see native/CMakeLists.txt SANITIZE option)
+BUILD_DIR = os.environ.get(
+    "DTPU_NATIVE_BUILD_DIR", os.path.join(REPO, "native", "build")
+)
+MASTER_BIN = os.path.join(BUILD_DIR, "dtpu-master")
+AGENT_BIN = os.path.join(BUILD_DIR, "dtpu-agent")
+
+
+def binaries_built() -> bool:
+    return os.path.exists(MASTER_BIN) and os.path.exists(AGENT_BIN)
+
+
+def build_binaries(force: bool = False) -> None:
+    """Build dtpu-master + dtpu-agent into BUILD_DIR."""
+    if binaries_built() and not force:
+        return
+    os.makedirs(BUILD_DIR, exist_ok=True)
+    if shutil.which("cmake"):
+        subprocess.run(
+            ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD_DIR],
+            check=True,
+        )
+        subprocess.run(["cmake", "--build", BUILD_DIR, "-j"], check=True)
+        return
+    # no cmake: the tree has no third-party deps, a direct compile works
+    flags = ["-O2", "-std=c++17", "-pthread", "-Wall", "-Wextra"]
+    subprocess.run(
+        ["g++", *flags, "-Wno-missing-field-initializers",
+         os.path.join(REPO, "native", "master", "master.cpp"),
+         "-o", MASTER_BIN, "-ldl"],
+        check=True,
+    )
+    subprocess.run(
+        ["g++", *flags,
+         os.path.join(REPO, "native", "agent", "agent.cpp"),
+         "-o", AGENT_BIN, "-ldl"],
+        check=True,
+    )
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class DevCluster:
+    """master + agents as subprocesses (reference double.devcluster.yaml)."""
+
+    def __init__(self, tmp_path, agents=1, slots=2, master_args=()):
+        import requests
+
+        self.port = free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.tmp = tmp_path
+        self.state_dir = str(tmp_path / "state")
+        self.ckpt_dir = str(tmp_path / "ckpts")
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self.agents = agents
+        self.slots = slots
+        self.master_args = list(master_args)
+        # authenticated session (every API call except login/master-info
+        # requires a bearer token); filled in by start_master's login
+        self.http = requests.Session()
+        self.token = None
+
+    def start_master(self):
+        self.procs["master"] = subprocess.Popen(
+            [
+                MASTER_BIN,
+                "--host", "127.0.0.1",
+                "--port", str(self.port),
+                "--state-dir", self.state_dir,
+                "--checkpoint-dir", self.ckpt_dir,
+                *self.master_args,
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                # self.http carries the TLS verify bundle when the cluster
+                # runs over https (test_full_lifecycle_over_tls)
+                self.http.get(self.url + "/api/v1/master", timeout=1)
+                self.login()
+                return
+            except Exception:
+                time.sleep(0.1)
+        raise RuntimeError("master did not come up")
+
+    def login(self, username="determined", password=""):
+        r = self.http.post(
+            self.url + "/api/v1/auth/login",
+            json={"username": username, "password": password},
+            timeout=5,
+        )
+        assert r.status_code == 200, r.text
+        self.token = r.json()["token"]
+        self.http.headers.update({"Authorization": f"Bearer {self.token}"})
+
+    def start_agent(self, idx=0, *, pool: Optional[str] = None,
+                    slots: Optional[int] = None, python: Optional[str] = None,
+                    extra_args: Iterable[str] = ()):
+        """Start one agent.  ``python`` overrides the interpreter the agent
+        execs for trials — pointing it at a nonexistent binary is the
+        launch-failure chaos knob the gang-teardown tests use."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        argv = [
+            AGENT_BIN,
+            "--master-host", "127.0.0.1",
+            "--master-port", str(self.port),
+            "--id", f"agent-{idx}",
+            "--slots", str(self.slots if slots is None else slots),
+        ]
+        if pool is not None:
+            argv += ["--pool", pool]
+        if python is not None:
+            argv += ["--python", python]
+        argv += list(extra_args)
+        self.procs[f"agent-{idx}"] = subprocess.Popen(
+            argv,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+    def start(self):
+        self.start_master()
+        for i in range(self.agents):
+            self.start_agent(i)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if len(self.http.get(self.url + "/api/v1/agents", timeout=2).json()) >= self.agents:
+                return self
+            time.sleep(0.2)
+        raise RuntimeError("agents did not register")
+
+    def stop(self):
+        for name, p in self.procs.items():
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                pass
+
+    def submit(self, config) -> int:
+        r = self.http.post(self.url + "/api/v1/experiments", json={"config": config})
+        assert r.status_code == 201, r.text
+        return r.json()["id"]
+
+    def wait_for_state(self, exp_id, states=("COMPLETED",), timeout=180):
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            last = self.http.get(f"{self.url}/api/v1/experiments/{exp_id}", timeout=5).json()
+            if last["state"] in states:
+                return last
+            time.sleep(1.0)
+        raise AssertionError(f"experiment stuck in {last and last['state']}: {json.dumps(last)[:2000]}")
+
+
+def exp_config(ckpt_dir, *, searcher=None, slots=1, max_restarts=5) -> Dict[str, Any]:
+    """The suite's standard tiny-MNIST experiment (CPU backend)."""
+    return {
+        "name": "devcluster-exp",
+        "entrypoint": "determined_tpu.models.mnist:MnistTrial",
+        "hyperparameters": {
+            "lr": {"type": "log", "minval": -3, "maxval": -1},
+            "hidden": 16,
+            "global_batch_size": 16,
+            "dataset_size": 64,
+        },
+        "searcher": searcher
+        or {
+            "name": "single",
+            "metric": "validation_accuracy",
+            "smaller_is_better": False,
+            "max_length": {"batches": 6},
+        },
+        "resources": {"slots_per_trial": slots},
+        "checkpoint_storage": {"type": "shared_fs", "host_path": ckpt_dir},
+        "min_validation_period": {"batches": 3},
+        "max_restarts": max_restarts,
+        "environment": {
+            "env": {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            }
+        },
+    }
+
+
+def _smoke(cluster: "DevCluster") -> int:
+    """One 2-process gang across two 1-slot agents: proves gang dispatch,
+    rendezvous env, multi-host training, log shipping, and exit plumbing
+    end to end on the CPU backend."""
+    cfg = exp_config(cluster.ckpt_dir, slots=2)
+    cfg["environment"]["env"]["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    exp_id = cluster.submit(cfg)
+    print(f"smoke: submitted experiment {exp_id} (2-slot gang over 2 agents)")
+    final = cluster.wait_for_state(exp_id, timeout=420)
+    trial = final["trials"][0]
+    print(f"smoke: experiment {exp_id} -> {final['state']}, trial {trial['state']}")
+    logs = cluster.http.get(
+        f"{cluster.url}/api/v1/trials/{trial['id']}/logs"
+    ).json()
+    joined = any("rendezvous: joined" in str(line) for line in logs)
+    print(f"smoke: rendezvous log line present: {joined}")
+    ok = final["state"] == "COMPLETED" and trial["state"] == "COMPLETED" and joined
+    if not ok:
+        for line in logs[-40:]:
+            print(f"  | {line}")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+    import pathlib
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--build", action="store_true", help="(re)build the binaries first")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the 2-agent gang smoke test and exit")
+    ap.add_argument("--agents", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=1)
+    ap.add_argument("--dir", default=None, help="state/checkpoint root (default: temp)")
+    args = ap.parse_args(argv)
+
+    if args.build or not binaries_built():
+        build_binaries(force=args.build)
+    if not binaries_built():
+        print("error: native binaries missing and build failed", file=sys.stderr)
+        return 2
+
+    if args.dir:
+        root = pathlib.Path(args.dir)
+        root.mkdir(parents=True, exist_ok=True)
+    else:
+        import tempfile
+
+        root = pathlib.Path(tempfile.mkdtemp(prefix="dtpu-devcluster-"))
+    cluster = DevCluster(root, agents=args.agents, slots=args.slots)
+    cluster.start()
+    print(f"devcluster up: master {cluster.url}, "
+          f"{args.agents} agent(s) x {args.slots} slot(s), state under {root}")
+    try:
+        if args.smoke:
+            return _smoke(cluster)
+        print("Ctrl-C to tear down")
+        while all(p.poll() is None for p in cluster.procs.values()):
+            time.sleep(1)
+        print("a devcluster process exited; tearing down", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        subprocess.run(
+            ["pkill", "-9", "-f", "determined_tpu.exec.run_trial"],
+            capture_output=True,
+        )
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Build-and-smoke entry for the devcluster: compiles the native master +
+# agent (cmake when available, direct g++ otherwise) and drives one
+# 2-process CPU gang through real jax.distributed rendezvous — the
+# cheapest end-to-end proof that gang dispatch, the rendezvous env
+# contract (docs/cluster.md), log shipping, and exit plumbing all hold.
+#
+#   scripts/devcluster.sh            # build + smoke
+#   scripts/devcluster.sh --up       # build + leave a cluster running
+#
+# The pytest devcluster marker (tests/conftest.py) skips cleanly when the
+# binaries are absent; after this script they run:
+#   python -m pytest tests -m devcluster
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+MODE="--smoke"
+if [[ "${1:-}" == "--up" ]]; then
+  MODE=""
+fi
+
+exec python scripts/devcluster.py --build ${MODE}

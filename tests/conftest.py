@@ -46,6 +46,40 @@ def pytest_configure(config):
         "no_lock_order: per-test opt-out from a module-level lock_order mark "
         "(for wall-clock-ratio assertions the instrumentation would skew)",
     )
+    config.addinivalue_line(
+        "markers",
+        "devcluster: needs the native master+agent binaries (native/build or "
+        "DTPU_NATIVE_BUILD_DIR); skipped cleanly when they are not built — "
+        "scripts/devcluster.sh builds them",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-skip ``devcluster``-marked tests when the native binaries are
+    absent, the same way ``needs_cluster`` used to — but as a first-class
+    marker so `-m devcluster` selects the whole cluster suite."""
+    try:
+        from scripts.devcluster import binaries_built
+    except ImportError:
+        # pytest not launched from the repo root: fall back to the path probe
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        build = os.environ.get(
+            "DTPU_NATIVE_BUILD_DIR", os.path.join(repo, "native", "build")
+        )
+
+        def binaries_built():
+            return os.path.exists(os.path.join(build, "dtpu-master")) and os.path.exists(
+                os.path.join(build, "dtpu-agent")
+            )
+
+    if binaries_built():
+        return
+    skip = pytest.mark.skip(
+        reason="native binaries not built (scripts/devcluster.sh builds them)"
+    )
+    for item in items:
+        if item.get_closest_marker("devcluster") is not None:
+            item.add_marker(skip)
 
 
 @pytest.fixture(autouse=True)

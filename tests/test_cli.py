@@ -3,7 +3,6 @@ lifecycle tests against a live devcluster (reference: harness/tests/cli).
 """
 
 import json
-import os
 
 import pytest
 import yaml
@@ -19,11 +18,9 @@ from tests.test_devcluster import (  # noqa: F401  (fixture reuse)
 )
 
 # only the devcluster-backed tests need the native binaries; the local
-# experiment status/resume subcommands run masterless
-needs_cluster = pytest.mark.skipif(
-    not (os.path.exists(MASTER_BIN) and os.path.exists(AGENT_BIN)),
-    reason="native binaries not built",
-)
+# experiment status/resume subcommands run masterless.  The marker is
+# auto-skipped by conftest when the binaries are not built.
+needs_cluster = pytest.mark.devcluster
 
 
 def run_cli(*argv) -> int:

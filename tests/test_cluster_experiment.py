@@ -1,0 +1,570 @@
+"""ClusterExperiment driver tests.
+
+Two tiers:
+
+- **fake master** (tier-1, masterless): a minimal in-process HTTP master
+  implementing exactly the driver contract (driver experiment create,
+  idempotent trial submit, poll, metrics, stop, searcher shutdown) with a
+  poll-driven synthetic trial model — deterministic, no jax, no binaries.
+  This is where the driver's searcher plumbing, journaling, preemption,
+  resume/re-attach, and gang-teardown surfacing are pinned down.
+- **devcluster e2e** (``devcluster`` + ``slow`` marks): the acceptance
+  test — a 4-trial ASHA search across 2 local agent processes using
+  2-process CPU gangs through real ``jax.distributed`` rendezvous, with a
+  mid-trial rank kill, producing the same trial set as an equivalent
+  ``LocalExperiment`` run.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from determined_tpu.config.experiment import ExperimentConfig, InvalidExperimentConfig
+from determined_tpu.experiment import ClusterExperiment, journal_path, read_journal
+
+
+# ---- the fake master -------------------------------------------------------
+
+
+class _FakeTrial:
+    def __init__(self, tid, rid, hparams, plan):
+        self.id = tid
+        self.request_id = rid
+        self.hparams = hparams
+        self.plan = list(plan)       # [(steps, metrics_dict), ...] to reveal
+        self.revealed = []           # validation records already "reported"
+        self.state = "PENDING"
+        self.polls = 0
+        self.restarts = 0
+        self.restart_at_poll = None  # simulate a gang teardown+reschedule
+        self.stop_requested = False
+        self.gated = False           # True = never finish until released
+
+    def advance(self):
+        """One driver poll's worth of synthetic progress."""
+        self.polls += 1
+        if self.state == "PENDING":
+            if self.polls >= 2:
+                self.state = "RUNNING"
+            return
+        if self.state != "RUNNING":
+            return
+        if self.restart_at_poll is not None and self.polls == self.restart_at_poll:
+            self.restarts += 1  # the master tore the gang down + rescheduled
+        if self.stop_requested:
+            self.state = "STOPPED"
+            return
+        if self.plan:
+            steps, metrics = self.plan.pop(0)
+            self.revealed.append(
+                {"group": "validation", "steps_completed": steps, "metrics": metrics}
+            )
+        elif not self.gated:
+            self.state = "COMPLETED"
+
+    def json(self):
+        return {
+            "id": self.id,
+            "request_id": self.request_id,
+            "hparams": self.hparams,
+            "state": self.state,
+            "restarts": self.restarts,
+            "latest_checkpoint": f"ckpt-{self.id}-{len(self.revealed)}"
+            if self.revealed
+            else "",
+            "progress": 0.0,
+        }
+
+
+class FakeMaster:
+    """Just enough master to host one driver-managed experiment."""
+
+    def __init__(self, *, trial_plan, agents=()):
+        self.trial_plan = trial_plan  # hparams -> [(steps, metrics), ...]
+        self.agents = list(agents)
+        self.exp_config = None
+        self.exp_state = "ACTIVE"
+        self.searcher_shutdown = False
+        self.trials = {}          # tid -> _FakeTrial
+        self.rid_to_tid = {}
+        self.next_tid = 1
+        self.create_calls = []    # every POST .../trials body (idempotency)
+        self.stops = []           # tids that received POST /stop
+        self.lock = threading.Lock()
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: D401 - silence
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}") if n else {}
+                path = urlparse(self.path).path
+                with fake.lock:
+                    if path == "/api/v1/auth/login":
+                        return self._json({"token": "fake-token"})
+                    if path == "/api/v1/experiments":
+                        fake.exp_config = body.get("config")
+                        assert (
+                            fake.exp_config["searcher"]["name"] == "driver"
+                        ), fake.exp_config["searcher"]
+                        return self._json({"id": 1}, 201)
+                    if path == "/api/v1/experiments/1/trials":
+                        fake.create_calls.append(body)
+                        rid = int(body["request_id"])
+                        if rid in fake.rid_to_tid:
+                            return self._json(
+                                {"id": fake.rid_to_tid[rid], "existing": True}
+                            )
+                        tid = fake.next_tid
+                        fake.next_tid += 1
+                        t = _FakeTrial(
+                            tid, rid, body.get("hparams") or {},
+                            fake.trial_plan(body.get("hparams") or {}),
+                        )
+                        fake.customize(t)
+                        fake.trials[tid] = t
+                        fake.rid_to_tid[rid] = tid
+                        return self._json({"id": tid}, 201)
+                    if path == "/api/v1/experiments/1/searcher/shutdown":
+                        fake.searcher_shutdown = True
+                        if all(
+                            t.state in ("COMPLETED", "STOPPED", "ERROR")
+                            for t in fake.trials.values()
+                        ):
+                            fake.exp_state = "COMPLETED"
+                        return self._json({"state": fake.exp_state})
+                    if path.startswith("/api/v1/trials/") and path.endswith("/stop"):
+                        tid = int(path.split("/")[4])
+                        fake.stops.append(tid)
+                        fake.trials[tid].stop_requested = True
+                        return self._json({"state": fake.trials[tid].state})
+                return self._json({"error": f"no fake route {path}"}, 404)
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                path = parsed.path
+                q = parse_qs(parsed.query)
+                with fake.lock:
+                    if path == "/api/v1/agents":
+                        return self._json(fake.agents)
+                    if path == "/api/v1/experiments/1":
+                        return self._json(
+                            {
+                                "id": 1,
+                                "state": fake.exp_state,
+                                "trials": [t.json() for t in fake.trials.values()],
+                            }
+                        )
+                    if path.endswith("/metrics") and "/trials/" in path:
+                        tid = int(path.split("/")[4])
+                        offset = int(q.get("offset", ["0"])[0])
+                        return self._json(fake.trials[tid].revealed[offset:])
+                    if path.startswith("/api/v1/trials/"):
+                        tid = int(path.split("/")[4])
+                        t = fake.trials[tid]
+                        t.advance()
+                        return self._json(t.json())
+                return self._json({"error": f"no fake route {path}"}, 404)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True, name="fake-master"
+        )
+        self.thread.start()
+
+    def customize(self, trial):
+        """Per-test hook applied to each newly created trial."""
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def asha_config():
+    return ExperimentConfig.parse(
+        {
+            "name": "cluster-asha",
+            "entrypoint": "determined_tpu.models.mnist:MnistTrial",
+            "hyperparameters": {
+                "lr": {"type": "log", "minval": -4, "maxval": -1},
+            },
+            "searcher": {
+                "name": "asha",
+                "metric": "validation_loss",
+                "smaller_is_better": True,
+                "max_trials": 4,
+                "max_concurrent_trials": 4,
+                "max_time": 8,
+                "time_metric": "batches",
+                "num_rungs": 2,
+                "divisor": 2,
+            },
+            "resources": {"slots_per_trial": 2},
+        }
+    )
+
+
+def _loss_plan(hparams):
+    """Deterministic synthetic trial: validates every 2 'batches' up to 8,
+    loss == lr (so the ASHA ranking is the lr ordering)."""
+    lr = float(hparams.get("lr", 0.1))
+    return [(s, {"validation_loss": lr, "batches": s}) for s in (2, 4, 6, 8)]
+
+
+def _driver(config, url, tmp_path, **kw):
+    return ClusterExperiment(
+        config,
+        master_url=url,
+        checkpoint_dir=str(tmp_path / "driver"),
+        poll_interval=0.01,
+        **kw,
+    )
+
+
+# ---- fake-master tier ------------------------------------------------------
+
+
+def test_cluster_asha_search_completes(asha_config, tmp_path):
+    fake = FakeMaster(trial_plan=_loss_plan)
+    try:
+        exp = _driver(asha_config, fake.url, tmp_path)
+        summary = exp.run()
+    finally:
+        fake.close()
+
+    assert summary["status"] == "completed"
+    assert summary["trials"] == 4
+    assert summary["master_experiment_id"] == 1
+    # ASHA with divisor 2 cut the worse half at the rung: at least one
+    # trial was stopped through the master's graceful-stop route
+    assert fake.stops, "ASHA never posted an early stop"
+    assert fake.searcher_shutdown, "driver never shut the master searcher down"
+    assert fake.exp_state == "COMPLETED"
+    # the best trial is the smallest sampled lr (loss == lr)
+    lrs = {t.request_id: t.hparams["lr"] for t in fake.trials.values()}
+    assert summary["best_trial"] == min(lrs, key=lrs.get)
+    # driver journal is the durable record
+    replay = read_journal(journal_path(str(tmp_path / "driver")))
+    assert replay.status == "completed"
+    assert replay.cluster["experiment_id"] == 1
+    assert sorted(replay.results) == sorted(lrs)
+    # every master trial was created exactly once (idempotency guard)
+    created = [c["request_id"] for c in fake.create_calls]
+    assert len(set(created)) == len(fake.trials) == 4
+
+
+def test_cluster_trial_error_does_not_kill_search(asha_config, tmp_path):
+    """One trial exhausting its gang restart budget (state ERROR) is an
+    early exit for the searcher, not a search abort."""
+
+    class ErrFake(FakeMaster):
+        def customize(self, trial):
+            if trial.request_id == 1:
+                trial.plan = trial.plan[:1]
+                trial.gated = True
+
+    fake = ErrFake(trial_plan=_loss_plan)
+    done = threading.Event()
+
+    # flip the gated trial to ERROR once it has revealed its validation
+    def fail_gated():
+        while not done.is_set():
+            with fake.lock:
+                for t in fake.trials.values():
+                    if t.gated and not t.plan and t.state == "RUNNING":
+                        t.state = "ERROR"
+                        t.restarts = 2
+            time.sleep(0.02)
+
+    killer = threading.Thread(target=fail_gated, daemon=True)
+    killer.start()
+    try:
+        exp = _driver(asha_config, fake.url, tmp_path)
+        summary = exp.run()
+    finally:
+        done.set()
+        fake.close()
+
+    assert summary["status"] == "completed"
+    assert summary["trials"] == 4
+    # the errored trial is recorded, with whatever it achieved
+    assert 1 in exp.results
+    assert exp.results[1].stopped_early
+
+
+def test_cluster_gang_teardown_traced(asha_config, tmp_path):
+    """A master-side gang restart (one rank died, gang rescheduled) must
+    surface as a gang.teardown instant in the driver trace."""
+
+    class RestartFake(FakeMaster):
+        def customize(self, trial):
+            if trial.request_id == 1:
+                trial.restart_at_poll = 4
+
+    fake = RestartFake(trial_plan=_loss_plan)
+    try:
+        exp = _driver(asha_config, fake.url, tmp_path)
+        summary = exp.run()
+    finally:
+        fake.close()
+    assert summary["status"] == "completed"
+
+    from determined_tpu.observability import get_tracer
+
+    events = get_tracer().chrome_events()
+    teardowns = [e for e in events if e.get("name") == "gang.teardown"]
+    assert teardowns, "gang restart never traced"
+    assert any(e["args"].get("trial") == 1 for e in teardowns)
+    # and scheduling waits were attributed per trial
+    dispatches = [e for e in events if e.get("name") == "gang.dispatch"]
+    assert len(dispatches) == 4
+
+
+def test_cluster_preempt_detach_and_resume(asha_config, tmp_path):
+    """SIGTERM-style driver preemption detaches (master keeps training);
+    resume re-attaches to the SAME master experiment and finishes."""
+
+    class GatedFake(FakeMaster):
+        def customize(self, trial):
+            # truly in flight: reveal only batches=2 (below the first ASHA
+            # rung at 4) so the searcher never issues a Stop — a full plan
+            # reaches the top rung, where ASHA stops EVERY trial and the
+            # search completes before the preempt timer fires
+            trial.plan = trial.plan[:1]
+            trial.gated = True  # never finish until released
+
+    fake = GatedFake(trial_plan=_loss_plan)
+    try:
+        exp = _driver(asha_config, fake.url, tmp_path)
+        preempter = threading.Timer(0.5, exp.request_preemption)
+        preempter.start()
+        summary = exp.run()
+        preempter.cancel()
+        assert summary["status"] == "preempted"
+        assert summary["resumable"]
+        assert summary["in_flight"], "nothing recorded in flight"
+        st = read_journal(journal_path(str(tmp_path / "driver")))
+        assert st.status == "preempted"
+
+        # release the gate; a fresh driver process re-attaches
+        with fake.lock:
+            for t in fake.trials.values():
+                t.gated = False
+        exp2 = _driver(asha_config, fake.url, tmp_path)
+        summary2 = exp2.resume()
+        assert summary2["status"] == "completed"
+        assert summary2["trials"] == 4
+        assert summary2["master_experiment_id"] == 1
+        # re-attach used the idempotent submit: one master trial per rid
+        assert len(fake.trials) == 4
+    finally:
+        fake.close()
+
+
+def test_cluster_driver_crash_resume(tmp_path):
+    """Driver SIGKILL mid-search (journal fault injection): resume restores
+    the searcher from the journal and re-attaches without double-creating
+    master trials."""
+    from tests.faults import FaultInjector, SimulatedCrash
+
+    config = ExperimentConfig.parse(
+        {
+            "name": "cluster-crash",
+            "entrypoint": "determined_tpu.models.mnist:MnistTrial",
+            "hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -1}},
+            "searcher": {
+                "name": "random",
+                "metric": "validation_loss",
+                "max_trials": 3,
+                "max_concurrent_trials": 1,
+                "max_time": 4,
+            },
+            "resources": {"slots_per_trial": 1},
+        }
+    )
+    fake = FakeMaster(trial_plan=_loss_plan)
+    try:
+        inj = FaultInjector()
+        inj.kill_driver_at_journal_event("trial_validated", occurrence=2)
+        with inj.installed():
+            with pytest.raises(SimulatedCrash):
+                _driver(config, fake.url, tmp_path).run()
+
+        exp2 = _driver(config, fake.url, tmp_path)
+        summary = exp2.resume()
+        assert summary["status"] == "completed"
+        assert summary["trials"] == 3
+        assert len(fake.trials) == 3, "resume double-created master trials"
+    finally:
+        fake.close()
+
+
+def test_cluster_single_slice_preflight(tmp_path):
+    """A single_slice gang bigger than every registered host fails fast,
+    driver-side, before anything is submitted or journaled."""
+    config = ExperimentConfig.parse(
+        {
+            "name": "ss",
+            "entrypoint": "determined_tpu.models.mnist:MnistTrial",
+            "hyperparameters": {"lr": 0.1},
+            "searcher": {"name": "single", "metric": "m", "max_length": {"batches": 2}},
+            "resources": {"slots_per_trial": 4, "single_slice": True},
+        }
+    )
+    fake = FakeMaster(
+        trial_plan=_loss_plan,
+        agents=[
+            {"id": "a0", "pool": "default", "slots": 2, "used_slots": 0},
+            {"id": "a1", "pool": "default", "slots": 2, "used_slots": 0},
+        ],
+    )
+    try:
+        with pytest.raises(InvalidExperimentConfig, match="single_slice"):
+            _driver(config, fake.url, tmp_path).run()
+        assert fake.exp_config is None, "experiment was submitted despite the gate"
+    finally:
+        fake.close()
+
+
+# ---- devcluster e2e (the acceptance test) ----------------------------------
+
+
+@pytest.mark.devcluster
+@pytest.mark.slow
+def test_cluster_asha_e2e_with_rank_kill(tmp_path):
+    """END-TO-END acceptance: a 4-trial ASHA search driven by
+    ClusterExperiment completes across 2 local agent processes using
+    2-process gangs with real ``jax.distributed.initialize`` rendezvous
+    (CPU backend); one rank is SIGKILLed mid-trial and the master tears
+    down + reschedules the whole gang; the search still completes and
+    produces the same trial set as an equivalent LocalExperiment run."""
+    from scripts.devcluster import DevCluster
+
+    raw = {
+        "name": "cluster-e2e",
+        "entrypoint": "determined_tpu.models.mnist:MnistTrial",
+        "hyperparameters": {
+            "lr": {"type": "log", "minval": -3, "maxval": -1},
+            "hidden": 16,
+            "global_batch_size": 16,
+            "dataset_size": 64,
+        },
+        "searcher": {
+            "name": "asha",
+            "metric": "validation_accuracy",
+            "smaller_is_better": False,
+            "max_trials": 4,
+            "max_concurrent_trials": 4,
+            "max_time": 8,
+            "time_metric": "batches",
+            "num_rungs": 2,
+            "divisor": 2,
+        },
+        "resources": {"slots_per_trial": 2},
+        "min_validation_period": {"batches": 2},
+        "min_checkpoint_period": {"batches": 2},
+        "max_restarts": 5,
+        "environment": {
+            "env": {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            }
+        },
+    }
+    seed = 7
+
+    c = DevCluster(tmp_path, agents=2, slots=1)
+    c.start()
+    killed = threading.Event()
+
+    def kill_one_rank():
+        # wait for a 2-process gang, then SIGKILL exactly one rank once
+        deadline = time.time() + 300
+        while time.time() < deadline and not killed.is_set():
+            pids = subprocess.run(
+                ["pgrep", "-f", "determined_tpu.exec.run_trial"],
+                capture_output=True, text=True,
+            ).stdout.split()
+            if len(pids) >= 2:
+                try:
+                    os.kill(int(pids[0]), signal.SIGKILL)
+                except OSError:
+                    continue
+                killed.set()
+                return
+            time.sleep(1.0)
+
+    killer = threading.Thread(target=kill_one_rank, daemon=True)
+    try:
+        cfg = ExperimentConfig.parse(dict(raw, checkpoint_storage={
+            "type": "shared_fs", "host_path": c.ckpt_dir,
+        }))
+        exp = ClusterExperiment(
+            cfg,
+            master_url=c.url,
+            checkpoint_dir=str(tmp_path / "driver"),
+            seed=seed,
+        )
+        killer.start()
+        summary = exp.run()
+        assert summary["status"] == "completed", summary
+        assert summary["trials"] == 4
+        assert killed.is_set(), "the rank killer never found a gang to kill"
+
+        # the master saw the gang teardown: some trial burned >= 1 restart
+        mexp = c.http.get(
+            f"{c.url}/api/v1/experiments/{summary['master_experiment_id']}"
+        ).json()
+        assert mexp["state"] == "COMPLETED"
+        assert sum(t["restarts"] for t in mexp["trials"]) >= 1
+        # rendezvous really happened (2-process jax.distributed join)
+        some_tid = mexp["trials"][0]["id"]
+        logs = c.http.get(f"{c.url}/api/v1/trials/{some_tid}/logs").json()
+        assert any("rendezvous: joined" in str(l) for l in logs), logs[-20:]
+
+        # trial-set parity with an equivalent LocalExperiment: same seed,
+        # same searcher -> identical {rid: hparams} (all 4 ASHA creates
+        # are drawn up-front from the seeded rng)
+        from determined_tpu.experiment import LocalExperiment
+        from determined_tpu.models.mnist import MnistTrial
+
+        local_cfg = ExperimentConfig.parse(dict(raw, resources={"slots_per_trial": 2}))
+        local = LocalExperiment(
+            local_cfg, MnistTrial,
+            checkpoint_dir=str(tmp_path / "local"), seed=seed,
+        )
+        local.run(serial=True)
+        cluster_set = {
+            rid: rec.hparams for rid, rec in exp.searcher.trials.items()
+        }
+        local_set = {
+            rid: rec.hparams for rid, rec in local.searcher.trials.items()
+        }
+        assert cluster_set == local_set
+    finally:
+        killed.set()
+        subprocess.run(
+            ["pkill", "-9", "-f", "determined_tpu.exec.run_trial"],
+            capture_output=True,
+        )
+        c.stop()

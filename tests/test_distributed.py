@@ -1,3 +1,6 @@
+import json
+import os
+
 import pytest
 
 from tests.parallel_utils import Execution
@@ -60,3 +63,141 @@ def test_size_mismatch_raises():
     with pytest.raises(ValueError):
         DistributedContext(rank=0, size=4, local_size=3, cross_size=2,
                            chief_addr="127.0.0.1", chief_port=1)
+
+
+# ---- star-rendezvous edge paths (docs/cluster.md failure semantics) --------
+
+
+def test_star_timeout_message_names_missing_ranks():
+    """The chief's rendezvous timeout must say HOW MANY and WHICH ranks
+    made it — that message is what an operator debugging a wedged gang
+    reads in the trial log."""
+    from determined_tpu.core._distributed import _StarClient, _StarServer, allocate_port
+
+    port = allocate_port()
+    server = _StarServer(port, n_workers=3, host="127.0.0.1")
+    try:
+        # only rank 2 of the expected {1, 2, 3} joins
+        client = _StarClient("127.0.0.1", port, rank=2, timeout=5.0)
+        deadline = __import__("time").time() + 5
+        while __import__("time").time() < deadline:
+            with server._lock:
+                if 2 in server._conns:
+                    break
+        with pytest.raises(TimeoutError) as e:
+            server.wait_ready(timeout=0.3)
+        msg = str(e.value)
+        assert "1/3" in msg, msg
+        assert "[2]" in msg, msg
+        client.close()
+    finally:
+        server.close()
+
+
+def test_star_late_joiner_after_timeout_still_lands():
+    """A gather that timed out is an error for THAT collective, but the
+    accept loop keeps running: a straggler that joins afterwards completes
+    the star and the next collective succeeds (gang restarts rely on the
+    listener not wedging after one timeout)."""
+    import threading
+
+    from determined_tpu.core._distributed import _StarClient, _StarServer, allocate_port
+
+    port = allocate_port()
+    server = _StarServer(port, n_workers=2, host="127.0.0.1")
+    clients = []
+    try:
+        clients.append(_StarClient("127.0.0.1", port, rank=1, timeout=5.0))
+        with pytest.raises(TimeoutError):
+            server.wait_ready(timeout=0.2)
+
+        # the late rank joins after the timeout
+        clients.append(_StarClient("127.0.0.1", port, rank=2, timeout=5.0))
+
+        results = {}
+
+        def worker(i):
+            clients[i].send(f"from-{i + 1}")
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        results = server.gather("chief", timeout=5.0)
+        for t in threads:
+            t.join(timeout=5)
+        assert results == ["chief", "from-1", "from-2"]
+    finally:
+        for cl in clients:
+            cl.close()
+        server.close()
+
+
+def test_half_open_connection_does_not_consume_a_slot():
+    """A connection that never sends its hello (port scanner, peer died
+    after SYN) must not block the real workers' rendezvous."""
+    import socket as socketlib
+
+    from determined_tpu.core import _distributed as dist
+    from determined_tpu.core._distributed import _StarClient, _StarServer, allocate_port
+
+    port = allocate_port()
+    server = _StarServer(port, n_workers=1, host="127.0.0.1")
+    orig_timeout = dist.HELLO_TIMEOUT
+    dist.HELLO_TIMEOUT = 0.2
+    try:
+        # half-open: connect, say nothing
+        mute = socketlib.create_connection(("127.0.0.1", port), timeout=5)
+        client = _StarClient("127.0.0.1", port, rank=1, timeout=5.0)
+        server.wait_ready(timeout=5.0)  # the real worker got through
+        client.close()
+        mute.close()
+    finally:
+        dist.HELLO_TIMEOUT = orig_timeout
+        server.close()
+
+
+def test_cluster_info_rendezvous_env_round_trip(monkeypatch):
+    """ClusterInfo.to_env/from_env must round-trip the full rendezvous
+    contract (docs/cluster.md): DTPU_RENDEZVOUS json, num_slots, ids."""
+    from determined_tpu.core._cluster_info import (
+        ClusterInfo,
+        _reset_cluster_info_cache,
+        get_cluster_info,
+    )
+
+    info = ClusterInfo(
+        master_url="http://127.0.0.1:8080",
+        agent_id="agent-1",
+        allocation_id="alloc-7",
+        session_token="tok",
+        trial_id=42,
+        experiment_id=9,
+        trial_run_id=3,
+        hparams={"lr": 0.01},
+        latest_checkpoint="ckpt-uuid",
+        trial_seed=1234,
+        num_slots=2,
+        rendezvous={"coordinator": "10.0.0.1:17000", "num_nodes": 2, "node_rank": 1},
+        exp_config={"name": "rt"},
+    )
+    env = info.to_env()
+    assert json.loads(env["DTPU_RENDEZVOUS"])["num_nodes"] == 2
+
+    for k in list(os.environ):
+        if k.startswith("DTPU_"):
+            monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    _reset_cluster_info_cache()
+    try:
+        back = get_cluster_info()
+        assert back is not None
+        for attr in (
+            "master_url", "agent_id", "allocation_id", "session_token",
+            "trial_id", "experiment_id", "trial_run_id", "hparams",
+            "latest_checkpoint", "trial_seed", "num_slots", "rendezvous",
+            "exp_config",
+        ):
+            assert getattr(back, attr) == getattr(info, attr), attr
+    finally:
+        _reset_cluster_info_cache()
