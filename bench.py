@@ -51,6 +51,27 @@ def main() -> None:
             )
         )
 
+    # A/B hook for the serving tier (docs/serving.md): DTPU_BENCH_SERVE=1
+    # benchmarks continuous batching vs the naive static batch over one
+    # shared kernel set (scripts/bench_serve.py) — same one-line JSON
+    # contract, the static batch as the baseline
+    if os.environ.get("DTPU_BENCH_SERVE", "0") not in ("0", ""):
+        import subprocess
+        import sys
+
+        raise SystemExit(
+            subprocess.call(
+                [
+                    sys.executable,
+                    os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "scripts",
+                        "bench_serve.py",
+                    ),
+                ]
+            )
+        )
+
     import jax
 
     from determined_tpu import core, train

@@ -761,6 +761,100 @@ def cluster_up(args) -> int:
                 p.kill()
 
 
+# ---- serve (online inference replica; docs/serving.md) ---------------------
+
+
+class _ServeSignalFlag:
+    """Signal-handler-safe drain flag: a plain attribute write holds no
+    lock (the PR-7 signal-handler-unsafe rule; same pattern as
+    ``experiment/local.py _PreemptFlag``).  The serve main loop polls it
+    and runs the actual drain — which touches Events — on the main
+    thread, never in handler context."""
+
+    __slots__ = ("_flag",)
+
+    def __init__(self) -> None:
+        self._flag = False
+
+    def set(self) -> None:
+        self._flag = True
+
+    def is_set(self) -> bool:
+        return self._flag
+
+
+def serve_cmd(args) -> int:
+    """Run one online-serving replica from a trial checkpoint.
+
+    Loads the checkpoint (``train.load_trial_from_checkpoint``), compiles
+    the KV-cache prefill/decode steps, and serves ``POST /v1/generate``
+    (+ ``/healthz``, ``/stats``).  With ``--master`` the replica registers
+    under ``/api/v1/serving`` and heartbeats until shutdown.  SIGTERM or
+    SIGINT drains: new requests are rejected (503), queued + in-flight
+    requests finish, and the process exits 75 (EX_TEMPFAIL) so a
+    supervisor knows the stop was orderly, not a crash.
+    """
+    import signal as _signal
+    import time as _time
+
+    from determined_tpu.experiment import PREEMPTED_EXIT_CODE
+    from determined_tpu.serve import ServeConfig, ServeEngine, ServeWorker
+
+    try:
+        serve_cfg = ServeConfig(
+            block_size=args.block_size,
+            num_blocks=args.num_blocks,
+            max_batch=args.max_batch,
+            max_prompt_len=args.max_prompt_len,
+            max_new_tokens=args.max_new_tokens,
+            queue_depth=args.queue_depth,
+            host=args.host,
+            port=args.port,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    session = None
+    if args.master or os.environ.get("DTPU_MASTER"):
+        session = _client(args).session
+    print(f"loading checkpoint {args.checkpoint} ...", flush=True)
+    engine = ServeEngine.from_checkpoint(args.checkpoint, serve_cfg)
+    worker = ServeWorker(
+        engine,
+        host=serve_cfg.host,
+        port=serve_cfg.port,
+        session=session,
+        model=args.model_name or engine.model_label,
+        checkpoint=args.checkpoint,
+    )
+    url = worker.start()
+    # the parseable contract scripts/tests rely on: one line, stable prefix
+    print(f"serving on {url}", flush=True)
+
+    drain_flag = _ServeSignalFlag()
+    prev = {}
+
+    def _on_signal(signum, frame):  # noqa: ARG001 - signal handler shape
+        drain_flag.set()  # plain write: safe at any bytecode boundary
+
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        prev[sig] = _signal.signal(sig, _on_signal)
+    try:
+        while not drain_flag.is_set():
+            _time.sleep(0.2)
+        print("drain requested: rejecting new requests, finishing in-flight",
+              flush=True)
+        worker.request_drain()
+        clean = worker.wait_drained(timeout=serve_cfg.drain_grace_s)
+        worker.shutdown()
+        print(f"drained ({'clean' if clean else 'grace expired'}); exiting",
+              flush=True)
+        return PREEMPTED_EXIT_CODE
+    finally:
+        for sig, handler in prev.items():
+            _signal.signal(sig, handler)
+
+
 # ---- lint ------------------------------------------------------------------
 
 
@@ -1199,6 +1293,31 @@ def build_parser() -> argparse.ArgumentParser:
     cu.add_argument("--state-dir", default="/tmp/dtpu-master")
     cu.add_argument("--checkpoint-dir", default="/tmp/dtpu-checkpoints")
     cu.set_defaults(fn=cluster_up)
+
+    sv = sub.add_parser(
+        "serve",
+        help="run an online-serving replica from a trial checkpoint "
+        "(docs/serving.md)",
+    )
+    sv.add_argument("checkpoint", help="trial checkpoint directory to serve")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument(
+        "--port", type=int, default=0,
+        help="HTTP port (default 0: OS-assigned, printed at startup)",
+    )
+    sv.add_argument("--block-size", type=int, default=16,
+                    help="tokens per KV-cache block")
+    sv.add_argument("--num-blocks", type=int, default=256,
+                    help="KV-cache pool size in blocks")
+    sv.add_argument("--max-batch", type=int, default=8,
+                    help="decode lanes (max sequences in flight)")
+    sv.add_argument("--max-prompt-len", type=int, default=128)
+    sv.add_argument("--max-new-tokens", type=int, default=64)
+    sv.add_argument("--queue-depth", type=int, default=16,
+                    help="admission queue depth (full -> 429)")
+    sv.add_argument("--model-name", default=None,
+                    help="label shown in the master's replica listing")
+    sv.set_defaults(fn=serve_cmd)
 
     ln = sub.add_parser(
         "lint",

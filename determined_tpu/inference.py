@@ -95,13 +95,13 @@ def run_batch_inference(
                     start_batch = int(json.load(f)["batches_done"])
         logger.info("resuming batch inference at batch %d", start_batch)
 
+    from determined_tpu.data._loader import _fetch
+
     proc = processor_cls(ctx, dist.rank, dist.size)
     done = 0
     batches = loader.sampler.epoch_batches(0)
     total = loader.sampler.batches_per_epoch
     for idx in range(start_batch, total):
-        from determined_tpu.data._loader import _fetch
-
         batch = _fetch(dataset, batches[idx])
         proc.process_batch(batch, idx)
         done += 1
@@ -110,6 +110,13 @@ def run_batch_inference(
             if ctx.preempt.should_preempt():
                 logger.info("preempted at batch %d; progress checkpointed", idx + 1)
                 return done
+    # Final marker BEFORE on_finish: progress was only recorded every
+    # checkpoint_interval, so a rank preempted after its last batch but
+    # before on_finish would replay the whole tail on resume.  Skipped
+    # when the interval already recorded it (no redundant checkpoint) or
+    # when this worker processed nothing.
+    if done and done % checkpoint_interval != 0:
+        _record_progress(ctx, dist, total)
     proc.on_finish()
     return done
 

@@ -26,7 +26,12 @@ import optax
 from flax import linen as nn
 
 from determined_tpu.data import DataLoader, SyntheticDataset
-from determined_tpu.ops.attention import dot_product_attention
+from determined_tpu.ops.attention import (
+    NEG_INF,
+    _repeat_kv,
+    dot_product_attention,
+    reference_attention,
+)
 from determined_tpu.ops.ring_attention import ring_attention
 from determined_tpu.parallel.mesh import MeshAxes
 from determined_tpu.parallel.sharding import with_sharding_constraint
@@ -436,6 +441,210 @@ def pipeline_forward(
     )
     logits = head.apply({"params": outer["lm_head"]}, x).astype(jnp.float32)
     return (logits, aux) if return_aux else logits
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode path (online serving: determined_tpu/serve)
+# ---------------------------------------------------------------------------
+#
+# Training/eval run the full-sequence forward above; serving needs the
+# autoregressive form: prefill the prompt once, then one-token decode steps
+# reading/writing a **paged** KV cache (vLLM's PagedAttention layout, Kwon
+# et al., SOSP '23).  The cache is a pool of fixed-size blocks
+# ``[n_layers, num_blocks, block_size, kv_heads, head_dim]``; each sequence
+# owns a *block table* mapping its logical block index to a physical block
+# id.  Everything below is a pure function over the UNBOXED param tree that
+# ``TransformerLM.init`` produces (the ``["params"]`` subtree), so the
+# serve engine can jit prefill/decode with static shapes — batch lanes,
+# table width, and prompt padding are fixed by ServeConfig, and the decode
+# step traces exactly once no matter how request lengths mix (guarded by
+# the RetraceSentinel in ``serve/engine.py``).
+#
+# Physical block 0 is a scratch block the allocator never hands out:
+# padded prefill positions and inactive decode lanes write there, keeping
+# the scatter shape static without masking arithmetic inside the kernel.
+
+
+def kv_cache_shape(
+    cfg: TransformerConfig, num_blocks: int, block_size: int
+) -> Tuple[int, ...]:
+    return (cfg.n_layers, num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+
+
+def init_kv_cache(
+    cfg: TransformerConfig, num_blocks: int, block_size: int
+) -> Dict[str, jax.Array]:
+    """Zeroed paged K/V pool in the model's compute dtype (keys are stored
+    post-rope, i.e. exactly what attention consumes)."""
+    shape = kv_cache_shape(cfg, num_blocks, block_size)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _rms_apply(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm with the exact numerics of the ``RMSNorm`` module."""
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale.astype(x.dtype)
+
+
+def _attn_proj(
+    p: Dict[str, Any], x: jax.Array, dtype: Any
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """q/k/v projections as ``Attention`` computes them, to [b, heads, s, d]."""
+    q = jnp.einsum("bsd,dhk->bhsk", x, p["wq"]["kernel"].astype(dtype))
+    k = jnp.einsum("bsd,dhk->bhsk", x, p["wk"]["kernel"].astype(dtype))
+    v = jnp.einsum("bsd,dhk->bhsk", x, p["wv"]["kernel"].astype(dtype))
+    return q, k, v
+
+
+def _mlp_apply(p: Dict[str, Any], x: jax.Array, dtype: Any) -> jax.Array:
+    gate = x @ p["w_gate"]["kernel"].astype(dtype)
+    up = x @ p["w_up"]["kernel"].astype(dtype)
+    return (nn.silu(gate) * up) @ p["w_down"]["kernel"].astype(dtype)
+
+
+def _rope_batched(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings on [b, h, 1, d] with a per-sequence position [b]
+    (the decode step: every lane sits at its own offset)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [b, d/2]
+    cos = jnp.cos(angles)[:, None, None, :]
+    sin = jnp.sin(angles)[:, None, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x1 * sin + x2 * cos
+    return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _check_decodable(cfg: TransformerConfig) -> None:
+    if cfg.moe_experts > 0:
+        raise ValueError("KV-cache serving does not support MoE configs yet")
+    if cfg.seq_axis_name is not None or cfg.expert_axis_name is not None:
+        raise ValueError("KV-cache serving runs outside pipeline stages")
+
+
+def transformer_prefill(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    prompt_lens: jax.Array,
+    block_tables: jax.Array,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Full-prompt forward that also populates the paged cache.
+
+    ``tokens`` [B, S] is the prompt padded to a fixed S (one trace);
+    ``prompt_lens`` [B] the real lengths; ``block_tables`` [B, T] each
+    lane's physical block ids.  Returns (logits [B, S, vocab] f32, cache).
+    Logits at positions >= prompt_len are computed over padding — callers
+    sample at ``prompt_len - 1``.  Causality makes positions < prompt_len
+    match the full-sequence forward exactly (padding sits strictly after
+    them), which is what the parity tests in tests/test_transformer.py pin.
+    """
+    _check_decodable(cfg)
+    block_size = cache["k"].shape[2]
+    b, s = tokens.shape
+    dt = cfg.dtype
+    x = jnp.take(params["embed"]["embedding"].astype(dt), tokens, axis=0)
+    positions = jnp.arange(s)
+    # physical destination of every (lane, position): padded tail -> scratch
+    phys = jnp.where(
+        positions[None, :] < prompt_lens[:, None],
+        jnp.take_along_axis(
+            block_tables, jnp.broadcast_to(positions[None, :] // block_size, (b, s)),
+            axis=1,
+        ),
+        0,
+    )
+    slots = jnp.broadcast_to((positions % block_size)[None, :], (b, s))
+    k_cache, v_cache = cache["k"], cache["v"]
+    for i in range(cfg.n_layers):
+        blk = params[f"block_{i}"]
+        h = _rms_apply(x, blk["ln1"]["scale"])
+        q, k, v = _attn_proj(blk["attn"], h, dt)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        k_cache = k_cache.at[i, phys, slots].set(k.transpose(0, 2, 1, 3))
+        v_cache = v_cache.at[i, phys, slots].set(v.transpose(0, 2, 1, 3))
+        att = reference_attention(q, k, v, causal=True)
+        att = att.transpose(0, 2, 1, 3)  # [b, s, h, hd]
+        x = x + jnp.einsum(
+            "bshk,hkD->bsD", att, blk["attn"]["wo"]["kernel"].astype(dt)
+        )
+        x = x + _mlp_apply(blk["mlp"], _rms_apply(x, blk["ln2"]["scale"]), dt)
+    x = _rms_apply(x, params["ln_f"]["scale"])
+    logits = (x @ params["lm_head"]["kernel"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": k_cache, "v": v_cache}
+
+
+def transformer_decode(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    positions: jax.Array,
+    block_tables: jax.Array,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One decode step over the paged cache for every lane at once.
+
+    ``tokens`` [B] the token each lane just consumed; ``positions`` [B] its
+    global position (-1 marks an empty lane: it reads/writes the scratch
+    block and its logits are garbage the caller ignores); ``block_tables``
+    [B, T].  Returns (logits [B, vocab] f32, cache).  Shapes are lane-count
+    static, so a mixed stream of request lengths never retraces — the
+    continuous batcher joins and retires sequences by editing lane state,
+    not by reshaping the batch.
+    """
+    _check_decodable(cfg)
+    block_size = cache["k"].shape[2]
+    b = tokens.shape[0]
+    t = block_tables.shape[1]
+    kv_len = t * block_size
+    dt = cfg.dtype
+    active = positions >= 0
+    pos = jnp.maximum(positions, 0)
+    x = jnp.take(params["embed"]["embedding"].astype(dt), tokens[:, None], axis=0)
+    phys = jnp.where(
+        active,
+        jnp.take_along_axis(block_tables, (pos // block_size)[:, None], axis=1)[:, 0],
+        0,
+    )
+    slot = pos % block_size
+    k_pos = jnp.arange(kv_len)
+    # attend to every cache position up to and including the current token
+    mask = (k_pos[None, :] <= pos[:, None]) & active[:, None]  # [B, kv_len]
+    k_cache, v_cache = cache["k"], cache["v"]
+    n_rep = cfg.n_heads // cfg.kv_heads
+    scale = cfg.head_dim ** -0.5
+    for i in range(cfg.n_layers):
+        blk = params[f"block_{i}"]
+        h = _rms_apply(x, blk["ln1"]["scale"])
+        q, k, v = _attn_proj(blk["attn"], h, dt)  # [b, heads|kv, 1, hd]
+        q = _rope_batched(q, pos, cfg.rope_theta)
+        k = _rope_batched(k, pos, cfg.rope_theta)
+        # write this token's k/v, then attend against the updated pool so
+        # the step sees its own key (standard causal self-attention)
+        k_cache = k_cache.at[i, phys, slot].set(k[:, :, 0, :])
+        v_cache = v_cache.at[i, phys, slot].set(v[:, :, 0, :])
+        keys = k_cache[i][block_tables].reshape(b, kv_len, cfg.kv_heads, -1)
+        vals = v_cache[i][block_tables].reshape(b, kv_len, cfg.kv_heads, -1)
+        keys = _repeat_kv(keys.transpose(0, 2, 1, 3), n_rep)
+        vals = _repeat_kv(vals.transpose(0, 2, 1, 3), n_rep)
+        logits = (
+            jnp.einsum("bhqd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32)
+            * scale
+        )
+        logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        att = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vals.dtype), vals)
+        att = att.transpose(0, 2, 1, 3)  # [b, 1, h, hd]
+        x = x + jnp.einsum(
+            "bshk,hkD->bsD", att, blk["attn"]["wo"]["kernel"].astype(dt)
+        )
+        x = x + _mlp_apply(blk["mlp"], _rms_apply(x, blk["ln2"]["scale"]), dt)
+    x = _rms_apply(x, params["ln_f"]["scale"])
+    logits = (x[:, 0, :] @ params["lm_head"]["kernel"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": k_cache, "v": v_cache}
 
 
 class LMTrial(JaxTrial):

@@ -1,0 +1,91 @@
+"""ServeConfig: the sizing knobs of one serving replica.
+
+Every shape the jitted prefill/decode steps trace over comes from here —
+lane count, prompt padding, block-table width — so the config is also the
+retrace contract: two requests that differ only in length run through the
+same compiled program.  ``docs/serving.md`` explains how to size the cache
+(``num_blocks``) against HBM and expected sequence lengths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    # ---- paged KV cache ---------------------------------------------------
+    #: tokens per cache block (vLLM-style fixed-size pages)
+    block_size: int = 16
+    #: physical blocks in the pool; block 0 is the scratch block padded
+    #: writes land in, so usable capacity is (num_blocks - 1) * block_size
+    num_blocks: int = 256
+    # ---- continuous batching ----------------------------------------------
+    #: decode lanes: max sequences in flight per step (static batch shape)
+    max_batch: int = 8
+    #: prompts are padded to this length for the single prefill trace
+    max_prompt_len: int = 128
+    #: cap on tokens generated per request (requests may ask for fewer)
+    max_new_tokens: int = 64
+    # ---- admission --------------------------------------------------------
+    #: bounded request queue depth; a full queue rejects with 429
+    queue_depth: int = 16
+    # ---- http / replica ---------------------------------------------------
+    host: str = "127.0.0.1"
+    port: int = 8001
+    #: master heartbeat period (seconds) when registered
+    heartbeat_interval_s: float = 2.0
+    #: how long a SIGTERM drain waits for in-flight work before giving up
+    drain_grace_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {self.block_size}")
+        if self.num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is scratch), got {self.num_blocks}"
+            )
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_prompt_len < 1 or self.max_new_tokens < 1:
+            raise ValueError("max_prompt_len and max_new_tokens must be >= 1")
+        if self.queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {self.queue_depth}")
+        needed = self.blocks_for(self.max_prompt_len + self.max_new_tokens)
+        if needed > self.usable_blocks:
+            raise ValueError(
+                f"cache too small: a worst-case request needs {needed} blocks "
+                f"but only {self.usable_blocks} are usable "
+                "(raise num_blocks or lower max_prompt_len/max_new_tokens)"
+            )
+
+    # -- derived sizes -------------------------------------------------------
+
+    @property
+    def max_seq_len(self) -> int:
+        """Longest sequence a lane can hold (prompt + generated)."""
+        return self.max_prompt_len + self.max_new_tokens
+
+    @property
+    def blocks_per_seq(self) -> int:
+        """Block-table width: logical blocks a worst-case sequence spans."""
+        return self.blocks_for(self.max_seq_len)
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1  # scratch block 0 is never allocated
+
+    def blocks_for(self, n_tokens: int) -> int:
+        from determined_tpu.serve.kv_cache import blocks_for_tokens
+
+        return blocks_for_tokens(n_tokens, self.block_size)
+
+    @classmethod
+    def from_dict(cls, raw: Optional[Dict[str, Any]]) -> "ServeConfig":
+        raw = dict(raw or {})
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - fields
+        if unknown:
+            raise ValueError(f"unknown serve config keys: {sorted(unknown)}")
+        return cls(**raw)

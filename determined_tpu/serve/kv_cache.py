@@ -1,0 +1,119 @@
+"""Paged KV-cache block allocator: the host-side half of PagedAttention.
+
+The device arrays (``models/transformer.py init_kv_cache``) are a flat pool
+of fixed-size blocks; this module owns WHICH blocks belong to WHOM.  A
+free-list allocator hands out physical block ids all-or-nothing per
+sequence (admission either fits a whole worst-case request or rejects it —
+no mid-flight OOM aborting a half-generated response), and frees them the
+moment the sequence retires, so cache capacity — not lane count — is the
+real admission limit under long-context load.
+
+Block 0 is reserved as the scratch block padded prefill positions and
+inactive decode lanes write into (static scatter shapes, no masking in the
+kernel); it is never handed out and never freed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Sequence
+
+
+def blocks_for_tokens(n_tokens: int, block_size: int) -> int:
+    """Blocks a sequence of ``n_tokens`` spans — THE sizing formula.
+    ServeConfig validation and the allocator both call this one function,
+    so admission limits and placement can never disagree."""
+    return -(-max(int(n_tokens), 1) // block_size)
+
+
+class CacheOOM(Exception):
+    """Not enough free blocks to admit the sequence right now."""
+
+    def __init__(self, needed: int, free: int) -> None:
+        super().__init__(f"kv cache exhausted: need {needed} blocks, {free} free")
+        self.needed = needed
+        self.free = free
+
+
+class BlockAllocator:
+    """Thread-safe free-list over physical block ids ``1..num_blocks-1``.
+
+    LIFO reuse on purpose: a just-freed block is handed out next, so the
+    hot working set of physical blocks stays small and (on TPU) resident
+    in whatever cache hierarchy backs HBM reads.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is scratch), got {num_blocks}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))  # pop() -> 1 first
+        self._allocated: set = set()
+        self.peak_in_use = 0
+
+    # -- sizing --------------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        """Usable blocks (excludes the scratch block)."""
+        return self.num_blocks - 1
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return blocks_for_tokens(n_tokens, self.block_size)
+
+    # -- alloc / free --------------------------------------------------------
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` blocks or raise :class:`CacheOOM` taking none."""
+        if n < 1:
+            raise ValueError(f"alloc needs n >= 1, got {n}")
+        with self._lock:
+            if n > len(self._free):
+                raise CacheOOM(n, len(self._free))
+            blocks = [self._free.pop() for _ in range(n)]
+            self._allocated.update(blocks)
+            self.peak_in_use = max(self.peak_in_use, len(self._allocated))
+            return blocks
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Return blocks to the pool; double-free and foreign ids are
+        programming errors and raise (a silently recycled block would
+        corrupt another sequence's cache)."""
+        with self._lock:
+            for b in blocks:
+                if b not in self._allocated:
+                    raise ValueError(f"free of unallocated block {b}")
+                self._allocated.remove(b)
+                self._free.append(b)
+
+    # -- inspection ----------------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        with self._lock:
+            return len(self._allocated)
+
+    def utilization(self) -> float:
+        with self._lock:
+            return len(self._allocated) / max(1, self.capacity)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "used": len(self._allocated),
+                "free": len(self._free),
+                "peak": self.peak_in_use,
+                "block_size": self.block_size,
+            }

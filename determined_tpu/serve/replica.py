@@ -1,0 +1,122 @@
+"""Replica registration: make a serving worker discoverable via the master.
+
+The reference platform reverse-proxies NTSC tasks that register with the
+master (SURVEY §3.5); serving replicas follow the same contract one level
+simpler — a replica POSTs itself to ``/api/v1/serving/replicas`` with the
+URL it listens on, heartbeats on an interval, and the master prunes any
+replica whose heartbeat goes stale (crash, partition, SIGKILL), so
+``GET /api/v1/serving`` is always the live routing table.  A heartbeat
+answered 404 means the master forgot us (restart, prune race): the thread
+re-registers with the same payload rather than dying.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+from determined_tpu.api.session import APIError, NotFoundError, Session
+
+logger = logging.getLogger("determined_tpu.serve.replica")
+
+
+class ReplicaRegistration:
+    """Owns the replica's master-side lifecycle + the heartbeat thread."""
+
+    def __init__(
+        self,
+        session: Session,
+        *,
+        url: str,
+        model: str = "",
+        checkpoint: str = "",
+        heartbeat_interval_s: float = 2.0,
+        stats_fn: Optional[Any] = None,
+    ) -> None:
+        self._session = session
+        self._payload: Dict[str, Any] = {
+            "url": url,
+            "model": model,
+            "checkpoint": checkpoint,
+        }
+        self._interval = heartbeat_interval_s
+        #: zero-arg callable whose dict rides each heartbeat, surfacing
+        #: queue depth / kv utilization in the master's replica listing
+        self._stats_fn = stats_fn
+        self._lock = threading.Lock()  # guards replica_id across threads
+        self.replica_id: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registration --------------------------------------------------------
+
+    def register(self) -> str:
+        resp = self._session.post(
+            "/api/v1/serving/replicas", json=dict(self._payload), retry=True
+        )
+        rid = resp.json()["id"]
+        with self._lock:
+            self.replica_id = rid
+        logger.info("registered serving replica %s (%s)", rid, self._payload["url"])
+        return rid
+
+    def start(self) -> "ReplicaRegistration":
+        """Register and keep the registration alive in the background."""
+        self.register()
+        self._thread = threading.Thread(
+            target=self._run, name="dtpu-serve-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    # -- heartbeat loop ------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            with self._lock:
+                rid = self.replica_id
+            if rid is None:
+                continue
+            body: Dict[str, Any] = {}
+            if self._stats_fn is not None:
+                try:
+                    body["stats"] = self._stats_fn()
+                except Exception:  # noqa: BLE001 - stats must not kill liveness
+                    logger.exception("stats collection failed; heartbeat without")
+            try:
+                self._session.post(
+                    f"/api/v1/serving/replicas/{rid}/heartbeat",
+                    json=body,
+                    retry=False,
+                )
+            except NotFoundError:
+                # master forgot us (restart or prune race): re-register
+                logger.warning("replica %s unknown to master; re-registering", rid)
+                try:
+                    self.register()
+                except APIError:
+                    logger.exception("re-registration failed; will retry")
+            except APIError:
+                # transient master trouble: keep beating, the master-side
+                # TTL is several intervals wide
+                logger.warning("heartbeat failed for replica %s", rid)
+            except Exception:  # noqa: BLE001 - the heartbeat must survive
+                logger.exception("heartbeat error for replica %s", rid)
+
+    # -- shutdown ------------------------------------------------------------
+
+    def close(self, deregister: bool = True) -> None:
+        """Stop heartbeating; optionally remove the master-side record so
+        a drained replica disappears immediately instead of at TTL."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(5.0, 2 * self._interval))
+            self._thread = None
+        with self._lock:
+            rid, self.replica_id = self.replica_id, None
+        if deregister and rid is not None:
+            try:
+                self._session.delete(f"/api/v1/serving/replicas/{rid}")
+            except APIError:
+                logger.warning("deregistration of %s failed (master will prune)", rid)

@@ -181,6 +181,24 @@ struct GenericTaskState {
   std::string allocation_id;      // set for external-pool placements
 };
 
+// Online serving replica (determined_tpu/serve): an inference worker that
+// registered itself so replicas can be discovered/scaled the way NTSC
+// tasks are.  Liveness is heartbeat-driven — a replica whose heartbeat
+// goes stale (crash, partition, SIGKILL) is pruned from the listing, so
+// GET /api/v1/serving is always the live routing table.  Ephemeral like
+// GenericTaskState: not journaled; replicas re-register after a master
+// restart (their heartbeat 404s and the worker re-registers itself).
+struct ServeReplicaState {
+  std::string id;          // "replica-N"
+  std::string url;         // where the worker serves /v1/generate
+  std::string model;       // operator-facing label (trial class / name)
+  std::string checkpoint;  // checkpoint path/uuid the replica loaded
+  std::string owner;
+  int64_t registered_ms = 0;
+  int64_t last_heartbeat_ms = 0;
+  Json stats = Json::object();  // last heartbeat's stats payload, if any
+};
+
 // First-class workspace entity (reference master/internal/api_project.go +
 // rbac/: workspaces own experiments, carry archival state, and scope role
 // bindings).  A workspace with bindings is RESTRICTED: only bound users,
@@ -336,6 +354,7 @@ class Master {
   void install_routes(HttpServer& srv);
 
   void set_agent_timeout_ms(int64_t ms) { agent_timeout_ms_ = ms; }
+  void set_serve_replica_timeout_ms(int64_t ms) { serve_replica_timeout_ms_ = ms; }
   void set_scheduler(const std::string& mode) { scheduler_mode_ = mode; }
 
   // Anonymized usage telemetry (reference master/internal/telemetry/
@@ -461,6 +480,25 @@ class Master {
       printf("master: task %s idle-reaped after %lldms\n", t.id.c_str(),
              static_cast<long long>(t.idle_timeout_ms));
       fflush(stdout);
+    }
+  }
+
+  // Drop serving replicas whose heartbeat went stale: a crashed or
+  // partitioned inference worker must leave the GET /api/v1/serving
+  // routing table on its own (the serve worker heartbeats every ~2s;
+  // the TTL is several intervals wide).  Caller holds mu_.
+  void reap_dead_serve_replicas() {
+    if (serve_replica_timeout_ms_ <= 0) return;
+    int64_t now = now_ms();
+    for (auto it = serve_replicas_.begin(); it != serve_replicas_.end();) {
+      if (now - it->second.last_heartbeat_ms > serve_replica_timeout_ms_) {
+        printf("master: serving replica %s (%s) heartbeat-expired; pruned\n",
+               it->second.id.c_str(), it->second.url.c_str());
+        fflush(stdout);
+        it = serve_replicas_.erase(it);
+      } else {
+        ++it;
+      }
     }
   }
 
@@ -3164,6 +3202,10 @@ class Master {
   int64_t next_webhook_id_ = 1;
   std::map<std::string, GenericTaskState> tasks_;
   int64_t next_task_id_ = 1;
+  // online serving replicas (determined_tpu/serve): heartbeat-pruned
+  std::map<std::string, ServeReplicaState> serve_replicas_;
+  int64_t next_replica_id_ = 1;
+  int64_t serve_replica_timeout_ms_ = 15000;  // reap silent replicas
   std::deque<Json> events_;  // recent journal events for /api/v1/events
   std::map<std::string, int64_t> log_batch_seq_;  // trial/allocation -> last seq
   std::map<std::string, std::set<int>> coord_ports_in_use_;  // host -> ports
@@ -5258,6 +5300,71 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     return R::json(out.dump());
   }));
 
+  // ---- online serving replicas (determined_tpu/serve; SURVEY §3.5: the
+  // serve path registers with the master like NTSC tasks do) ----
+  srv.route("POST", "/api/v1/serving/replicas", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    const std::string url = body["url"].as_string();
+    if (url.empty()) return R::error(400, "replica registration needs url");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    ServeReplicaState rep;
+    rep.id = "replica-" + std::to_string(m.next_replica_id_++);
+    rep.url = url;
+    rep.model = body["model"].as_string();
+    rep.checkpoint = body["checkpoint"].as_string();
+    rep.owner = m.authenticate(req);
+    rep.registered_ms = now_ms();
+    rep.last_heartbeat_ms = rep.registered_ms;
+    m.serve_replicas_[rep.id] = rep;
+    Json out = Json::object();
+    out.set("id", rep.id);
+    out.set("heartbeat_ttl_ms", Json(m.serve_replica_timeout_ms_));
+    return R::json(out.dump(), 201);
+  }));
+
+  srv.route("POST", "/api/v1/serving/replicas/{id}/heartbeat",
+            authed([&m](const HttpRequest& req) {
+    Json body;
+    bool has_stats =
+        Json::try_parse(req.body, &body) && body.contains("stats");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.serve_replicas_.find(req.params.at("id"));
+    // 404 tells the worker to re-register (master restarted or pruned it)
+    if (it == m.serve_replicas_.end()) return R::error(404, "no such replica");
+    it->second.last_heartbeat_ms = now_ms();
+    if (has_stats) it->second.stats = body["stats"];
+    return R::json("{}");
+  }));
+
+  srv.route("DELETE", "/api/v1/serving/replicas/{id}",
+            authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.serve_replicas_.find(req.params.at("id"));
+    if (it == m.serve_replicas_.end()) return R::error(404, "no such replica");
+    m.serve_replicas_.erase(it);
+    return R::json("{}");
+  }));
+
+  srv.route("GET", "/api/v1/serving", authed([&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    int64_t now = now_ms();
+    Json out = Json::array();
+    for (const auto& [rid, rep] : m.serve_replicas_) {
+      Json j = Json::object();
+      j.set("id", rep.id);
+      j.set("url", rep.url);
+      j.set("model", rep.model);
+      j.set("checkpoint", rep.checkpoint);
+      j.set("owner", rep.owner);
+      j.set("registered_ms", Json(rep.registered_ms));
+      j.set("heartbeat_age_ms", Json(now - rep.last_heartbeat_ms));
+      j.set("stats", rep.stats);
+      out.push_back(j);
+    }
+    return R::json(out.dump());
+  }));
+
   // ---- reverse proxy to ready tasks (reference internal/proxy/) ----
   // HTTP passthrough + RFC6455 websocket upgrade relay (no TLS yet);
   // auth is the same bearer token as the API.
@@ -5643,6 +5750,7 @@ int main(int argc, char** argv) {
   int journal_limit = 4096;
   int log_retention_days = 0;
   int agent_timeout_sec = 90;
+  int serve_replica_timeout_sec = 15;
   std::string scheduler = "priority";
   std::string pools_file;
   std::string advertised_url;
@@ -5664,6 +5772,9 @@ int main(int argc, char** argv) {
       log_retention_days = std::atoi(next("--log-retention-days").c_str());
     else if (arg == "--agent-timeout-sec")
       agent_timeout_sec = std::atoi(next("--agent-timeout-sec").c_str());
+    else if (arg == "--serve-replica-timeout-sec")
+      serve_replica_timeout_sec =
+          std::atoi(next("--serve-replica-timeout-sec").c_str());
     else if (arg == "--scheduler") scheduler = next("--scheduler");
     else if (arg == "--pools") pools_file = next("--pools");
     else if (arg == "--advertised-url") advertised_url = next("--advertised-url");
@@ -5690,6 +5801,8 @@ int main(int argc, char** argv) {
 
   dtpu::Master master(state_dir, checkpoint_dir, journal_limit, log_retention_days);
   master.set_agent_timeout_ms(static_cast<int64_t>(agent_timeout_sec) * 1000);
+  master.set_serve_replica_timeout_ms(
+      static_cast<int64_t>(serve_replica_timeout_sec) * 1000);
   if (scheduler != "priority" && scheduler != "fair_share") {
     fprintf(stderr, "--scheduler must be priority or fair_share\n");
     return 2;
@@ -5768,6 +5881,7 @@ int main(int argc, char** argv) {
     master.work_cv_.notify_all();
     master.reap_dead_agents();
     master.reap_idle_tasks();
+    master.reap_dead_serve_replicas();
     if (++ticks >= 1800) {
       ticks = 0;
       master.retention_sweep();

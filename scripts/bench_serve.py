@@ -1,0 +1,189 @@
+"""Serving benchmark: continuous batching vs naive static batching.
+
+Drives the SAME synthetic request trace through both engines
+(``determined_tpu/serve/engine.py``) over one shared set of compiled
+prefill/decode kernels — identical model, cache, sampling, and admission
+machinery; the ONLY difference is the scheduling policy:
+
+- **continuous**: requests join the running decode batch between any two
+  steps and retire immediately (the production ``ServeEngine``);
+- **static**: a batch decodes until EVERY member finishes before the next
+  batch forms (``StaticBatchEngine``) — short requests idle their lane
+  behind the longest member.
+
+Workload: open-loop arrivals (Poisson at ``--rate``, or an instantaneous
+burst at the default ``--rate 0`` — the capacity measurement) with a
+bimodal output-length mix (mostly short completions, a long tail), which
+is exactly the mix static batching handles worst and production traffic
+actually looks like.
+
+Reports requests/s, p50/p95 end-to-end latency, and time-to-first-token
+per arm, plus the requests/s ratio as the headline metric — ONE JSON line,
+the ``bench.py`` schema family (DTPU_BENCH_SERVE=1 hooks it there).
+
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --rate 30 --requests 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def make_trace(args: argparse.Namespace) -> List[Dict[str, Any]]:
+    """The request trace both arms replay: arrival offsets + prompts +
+    output lengths.  Bimodal outputs: ``long_frac`` of requests generate
+    ``long_tokens``, the rest ``short_tokens``."""
+    rng = np.random.default_rng(args.seed)
+    trace = []
+    t = 0.0
+    for i in range(args.requests):
+        if args.rate > 0:
+            t += float(rng.exponential(1.0 / args.rate))
+        prompt_len = int(rng.integers(4, args.max_prompt_len - 1))
+        long = rng.random() < args.long_frac
+        trace.append(
+            {
+                "arrival": t,
+                "prompt": [int(x) for x in rng.integers(0, 64, size=prompt_len)],
+                "max_new_tokens": args.long_tokens if long else args.short_tokens,
+                "temperature": 0.0 if i % 2 else 0.7,
+                "seed": i,
+            }
+        )
+    return trace
+
+
+def percentile(xs: List[float], p: float) -> float:
+    return float(np.percentile(np.asarray(xs), p)) if xs else float("nan")
+
+
+def run_arm(engine: Any, trace: List[Dict[str, Any]]) -> Dict[str, Any]:
+    from determined_tpu.serve import AdmissionRejected
+
+    engine.start()
+    # warm both kernels outside the measurement (shared across arms anyway)
+    engine.generate(trace[0]["prompt"], max_new_tokens=2)
+    rejected = 0
+    reqs = []
+    t0 = time.monotonic()
+    for item in trace:
+        now = time.monotonic() - t0
+        if item["arrival"] > now:
+            time.sleep(item["arrival"] - now)
+        try:
+            reqs.append(
+                engine.submit(
+                    item["prompt"],
+                    max_new_tokens=item["max_new_tokens"],
+                    temperature=item["temperature"],
+                    seed=item["seed"],
+                )
+            )
+        except AdmissionRejected:
+            rejected += 1
+    for r in reqs:
+        assert r.done.wait(600), "request starved"
+        assert r.error is None, r.error
+    makespan = max(r.finished_at for r in reqs) - t0
+    engine.stop()
+    lat = [r.latency_s for r in reqs]
+    ttft = [r.ttft_s for r in reqs]
+    return {
+        "requests": len(reqs),
+        "rejected": rejected,
+        "makespan_s": round(makespan, 4),
+        "requests_per_s": round(len(reqs) / makespan, 3),
+        "tokens_generated": sum(len(r.output) for r in reqs),
+        "p50_latency_s": round(percentile(lat, 50), 4),
+        "p95_latency_s": round(percentile(lat, 95), 4),
+        "mean_ttft_s": round(float(np.mean(ttft)), 4),
+        "p95_ttft_s": round(percentile(ttft, 95), 4),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--requests", type=int, default=120)
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="Poisson arrivals/s; 0 = instantaneous burst "
+                        "(capacity measurement)")
+    p.add_argument("--long-frac", type=float, default=0.2)
+    p.add_argument("--short-tokens", type=int, default=2)
+    p.add_argument("--long-tokens", type=int, default=96)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-prompt-len", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta as flax_meta
+
+    from determined_tpu.models.transformer import TransformerConfig, TransformerLM
+    from determined_tpu.serve import (
+        DecodeKernels,
+        ServeConfig,
+        ServeEngine,
+        StaticBatchEngine,
+    )
+
+    model_cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        max_seq_len=128, dtype=jnp.float32, attention_impl="reference",
+    )
+    variables = flax_meta.unbox(
+        TransformerLM(model_cfg).init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    )
+    serve_cfg = ServeConfig(
+        block_size=4,
+        num_blocks=256,
+        max_batch=args.max_batch,
+        max_prompt_len=args.max_prompt_len,
+        max_new_tokens=args.long_tokens,
+        queue_depth=max(args.requests, 4),  # open loop: absorb the burst
+    )
+    kernels = DecodeKernels(model_cfg, variables, serve_cfg)
+    trace = make_trace(args)
+
+    static = run_arm(StaticBatchEngine(kernels), trace)
+    continuous = run_arm(ServeEngine(kernels), trace)
+    ratio = (
+        continuous["requests_per_s"] / static["requests_per_s"]
+        if static["requests_per_s"]
+        else None
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "serve_continuous_vs_static_requests_per_sec",
+                "value": round(ratio, 3) if ratio else None,
+                "unit": "x",
+                # the naive static batch IS the baseline for this metric
+                "vs_baseline": round(ratio, 3) if ratio else None,
+                "continuous": continuous,
+                "static": static,
+                "requests": args.requests,
+                "rate_per_s": args.rate,
+                "long_frac": args.long_frac,
+                "short_tokens": args.short_tokens,
+                "long_tokens": args.long_tokens,
+                "max_batch": args.max_batch,
+                "model": "d32-L2-h4kv2-v64 (CPU test config)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,10 @@
 # signal-handler-unsafe) builds a single cross-module lock-acquisition
 # graph spanning the package, scripts, examples, and bench — a script that
 # takes package locks in the wrong order closes a cycle only a joint
-# graph can see.
+# graph can see.  The serving tier (determined_tpu/serve: allocator
+# free-list, admission queue, lane table, replica heartbeat thread) lints
+# as part of the package target; its runtime counterpart is the
+# lock_order + no_thread_leaks marker set tests/test_serving.py runs under.
 #
 # Strict mode: ANY finding fails.  Findings that are safe by a subtler
 # argument carry inline `# dtpu: lint-ok[rule]` suppressions WITH the

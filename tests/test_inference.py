@@ -70,8 +70,36 @@ def test_batch_inference_processes_whole_shard(tmp_path):
     assert all(s[1] == 16 for s in seen[:-1])
 
 
+class _PreemptAfterMarker:
+    """Stub preemption context: flips to True so the run stops at its
+    first post-marker poll (the poll happens right after progress is
+    recorded, so the marker is always durable when we return)."""
+
+    def should_preempt(self, auto_ack: bool = True) -> bool:
+        return True
+
+
+def _latest_progress_checkpoint(ck_dir) -> str:
+    """Pick the marker with the highest batches_done (several checkpoints
+    may exist; directory order is uuid-arbitrary)."""
+    import json
+    import os
+
+    best, best_done = None, -1
+    for name in os.listdir(ck_dir):
+        marker = os.path.join(ck_dir, name, "inference_progress.json")
+        if not os.path.exists(marker):
+            continue
+        with open(marker) as f:
+            done = int(json.load(f)["batches_done"])
+        if done > best_done:
+            best, best_done = name, done
+    assert best is not None, "no progress checkpoint written"
+    return best
+
+
 def test_batch_inference_resumes_from_progress(tmp_path):
-    """A second run with latest_checkpoint resumes at the recorded batch."""
+    """A preempted run leaves a marker; the next run resumes there."""
     processed = []
 
     class Collector(inference.BatchProcessor):
@@ -80,20 +108,16 @@ def test_batch_inference_resumes_from_progress(tmp_path):
 
     ds = mnist_like(size=128, seed=0)
     ctx = core._dummy_init(checkpoint_dir=str(tmp_path / "ck"))
+    ctx.preempt = _PreemptAfterMarker()
     n = inference.run_batch_inference(
         Collector, ds, batch_size=16, core_context=ctx, checkpoint_interval=5
     )
-    assert n == 8 and processed == list(range(8))
+    assert n == 5 and processed == list(range(5))  # stopped at the marker
 
-    # find the recorded progress checkpoint and resume from it
-    import os
-
-    ckpts = os.listdir(tmp_path / "ck")
-    assert ckpts, "no progress checkpoint written"
     processed.clear()
 
     class Info:
-        latest_checkpoint = ckpts[-1]
+        latest_checkpoint = _latest_progress_checkpoint(tmp_path / "ck")
 
     ctx2 = core._dummy_init(checkpoint_dir=str(tmp_path / "ck"))
     ctx2.info = Info()
@@ -102,3 +126,33 @@ def test_batch_inference_resumes_from_progress(tmp_path):
     )
     assert processed and processed[0] == 5  # resumed after the marker
     assert n2 == 3
+
+
+def test_batch_inference_records_tail_progress(tmp_path):
+    """Regression: the shard end records a final marker even when it does
+    not land on a checkpoint_interval boundary — a rank preempted between
+    its last batch and on_finish must not replay the tail on resume."""
+    processed = []
+
+    class Collector(inference.BatchProcessor):
+        def process_batch(self, batch, batch_idx):
+            processed.append(batch_idx)
+
+    ds = mnist_like(size=128, seed=0)  # 8 batches; interval 5 leaves a 3-batch tail
+    ctx = core._dummy_init(checkpoint_dir=str(tmp_path / "ck"))
+    n = inference.run_batch_inference(
+        Collector, ds, batch_size=16, core_context=ctx, checkpoint_interval=5
+    )
+    assert n == 8 and processed == list(range(8))
+
+    processed.clear()
+
+    class Info:
+        latest_checkpoint = _latest_progress_checkpoint(tmp_path / "ck")
+
+    ctx2 = core._dummy_init(checkpoint_dir=str(tmp_path / "ck"))
+    ctx2.info = Info()
+    n2 = inference.run_batch_inference(
+        Collector, ds, batch_size=16, core_context=ctx2, checkpoint_interval=100
+    )
+    assert n2 == 0 and processed == []  # nothing replayed

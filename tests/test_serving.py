@@ -1,0 +1,714 @@
+"""Online serving tier (determined_tpu/serve): allocator invariants,
+continuous-batching semantics, backpressure, drain, and the devcluster
+replica-registration e2e.
+
+Runs under the lock_order + no_thread_leaks sentinels: the serve package
+has real lock structure (allocator free-list, admission queue, lane table,
+replica heartbeat thread) and its workers are dtpu-* named, so an
+inversion or a leaked engine thread fails deterministically here.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from determined_tpu.models.transformer import TransformerConfig, TransformerLM
+from determined_tpu.serve import (
+    AdmissionRejected,
+    BlockAllocator,
+    CacheOOM,
+    DecodeKernels,
+    LaneTable,
+    ServeConfig,
+    ServeEngine,
+    ServeWorker,
+    StaticBatchEngine,
+)
+from determined_tpu.serve.scheduler import ActiveSeq, GenRequest
+
+pytestmark = [pytest.mark.lock_order, pytest.mark.no_thread_leaks]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# kv block allocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_alloc_free_roundtrip():
+    a = BlockAllocator(num_blocks=9, block_size=4)
+    assert a.capacity == 8
+    got = a.alloc(5)
+    assert len(got) == 5 and len(set(got)) == 5
+    assert 0 not in got  # scratch block never handed out
+    assert a.used_blocks == 5 and a.free_blocks == 3
+    a.free(got)
+    assert a.used_blocks == 0 and a.free_blocks == 8
+
+
+def test_allocator_oom_is_all_or_nothing():
+    a = BlockAllocator(num_blocks=5, block_size=4)
+    a.alloc(3)
+    with pytest.raises(CacheOOM):
+        a.alloc(2)  # only 1 free
+    # the failed alloc took nothing
+    assert a.free_blocks == 1
+    a.alloc(1)
+
+
+def test_allocator_double_free_raises():
+    a = BlockAllocator(num_blocks=4, block_size=2)
+    got = a.alloc(2)
+    a.free(got)
+    with pytest.raises(ValueError):
+        a.free(got)
+    with pytest.raises(ValueError):
+        a.free([0])  # scratch block was never allocated
+
+
+def test_allocator_block_reuse_is_lifo():
+    """Freed blocks are handed out again first (hot working set)."""
+    a = BlockAllocator(num_blocks=16, block_size=4)
+    first = a.alloc(4)
+    a.free(first)
+    second = a.alloc(4)
+    assert set(second) == set(first)
+
+
+def test_allocator_no_fragmentation_under_interleaving():
+    """A free list has no contiguity requirement: any interleaving of
+    alloc/free with total <= capacity must succeed, and no id may be live
+    twice."""
+    a = BlockAllocator(num_blocks=17, block_size=4)  # capacity 16
+    rng = np.random.default_rng(0)
+    live = []
+    for _ in range(200):
+        if live and (len(live) >= 4 or rng.random() < 0.4):
+            a.free(live.pop(rng.integers(len(live))))
+        else:
+            n = int(rng.integers(1, 5))
+            if a.free_blocks >= n:
+                blocks = a.alloc(n)
+                flat = [b for g in live for b in g]
+                assert not set(blocks) & set(flat), "id allocated twice"
+                live.append(blocks)
+    for g in live:
+        a.free(g)
+    assert a.free_blocks == 16
+
+
+def test_allocator_utilization_and_stats():
+    a = BlockAllocator(num_blocks=11, block_size=2)
+    a.alloc(5)
+    assert a.utilization() == pytest.approx(0.5)
+    st = a.stats()
+    assert st["used"] == 5 and st["free"] == 5 and st["peak"] == 5
+
+
+# ---------------------------------------------------------------------------
+# lane table
+# ---------------------------------------------------------------------------
+
+
+def _dummy_seq(rid=0):
+    return ActiveSeq(
+        request=GenRequest(prompt=[1], max_new_tokens=1),
+        blocks=[1],
+        block_table=[1, 0],
+        pos=1,
+        next_token=0,
+    )
+
+
+def test_lane_table_join_retire():
+    lanes = LaneTable(2)
+    i0 = lanes.join(_dummy_seq())
+    i1 = lanes.join(_dummy_seq())
+    assert {i0, i1} == {0, 1}
+    assert not lanes.has_free_lane()
+    with pytest.raises(RuntimeError):
+        lanes.join(_dummy_seq())
+    lanes.retire(i0)
+    assert lanes.has_free_lane()
+    with pytest.raises(RuntimeError):
+        lanes.retire(i0)  # already empty
+    assert lanes.stats() == {"lanes": 2, "active": 1, "joined": 2, "retired": 1}
+
+
+# ---------------------------------------------------------------------------
+# engine fixtures: one compiled kernel set for the whole module
+# ---------------------------------------------------------------------------
+
+SERVE_CFG = ServeConfig(
+    block_size=4,
+    num_blocks=64,
+    max_batch=4,
+    max_prompt_len=16,
+    max_new_tokens=32,
+    queue_depth=4,
+)
+
+
+@pytest.fixture(scope="module")
+def lm_setup():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        max_seq_len=64, dtype=jnp.float32, attention_impl="reference",
+    )
+    from flax.core import meta as flax_meta
+
+    model = TransformerLM(cfg)
+    variables = flax_meta.unbox(
+        model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    )
+    return cfg, model, variables
+
+
+@pytest.fixture(scope="module")
+def kernels(lm_setup):
+    cfg, _model, variables = lm_setup
+    return DecodeKernels(cfg, variables, SERVE_CFG)
+
+
+@pytest.fixture()
+def engine(kernels):
+    eng = ServeEngine(kernels).start()
+    yield eng
+    eng.stop()
+
+
+def _submit_retry(eng, prompt, deadline_s=60.0, **kw):
+    """Engine-level submit with 429 backoff (tests race the compile)."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return eng.submit(prompt, **kw)
+        except AdmissionRejected as e:
+            assert e.status == 429
+            assert time.monotonic() < deadline, "queue never drained"
+            time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching semantics
+# ---------------------------------------------------------------------------
+
+
+def test_generate_greedy_matches_full_forward(engine, lm_setup):
+    _cfg, model, variables = lm_setup
+    prompt = [3, 14, 15, 9, 2, 6]
+    req = engine.generate(prompt, max_new_tokens=6)
+    assert req.error is None and len(req.output) == 6
+    seq = list(prompt)
+    for tok in req.output:
+        logits = model.apply(variables, jnp.asarray(seq, jnp.int32)[None, :])
+        assert tok == int(np.argmax(np.asarray(logits[0, -1])))
+        seq.append(tok)
+
+
+def test_join_mid_flight_and_retire_immediately(engine):
+    """A short request submitted while a long one decodes joins the
+    running batch and completes long before the long one finishes."""
+    long_req = engine.submit([1, 2, 3], max_new_tokens=32)
+    # wait until the long request is actually decoding (first token out)
+    deadline = time.monotonic() + 60
+    while long_req.first_token_at is None:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    short_req = engine.submit([4, 5], max_new_tokens=1)
+    assert short_req.done.wait(60)
+    assert short_req.error is None and len(short_req.output) == 1
+    # retire-immediately: the short one finished while the long one runs
+    # (or at worst in the same step its own decode finished)
+    assert long_req.done.wait(60)
+    assert short_req.finished_at <= long_req.finished_at
+    st = engine.stats()
+    assert st["completed"] == 2
+    assert st["lanes"]["joined"] >= 1  # short joined a running batch
+
+
+def test_fairness_under_mixed_prompt_lengths(kernels):
+    """FIFO admission with immediate retirement, driven step by step: a
+    long sequence monopolizes one lane for 32 steps while SIX short
+    requests (more than the remaining lanes) flow through the other
+    three — none of them waits for the long one."""
+    eng = ServeEngine(kernels)  # not started: the test drives step_once()
+    try:
+        long_req = eng.submit(list(range(14)), max_new_tokens=32)
+        shorts = [eng.submit([i, i + 1], max_new_tokens=2) for i in range(3)]
+        eng.step_once()  # admits long + shorts 0-2 (4 lanes), one decode
+        late = [eng.submit([9, i], max_new_tokens=2) for i in range(3)]
+        steps = 1
+        while not all(r.done.is_set() for r in shorts + late):
+            assert eng.step_once(), "scheduler stalled"
+            steps += 1
+            assert steps < 16, "shorts starved behind the long request"
+        # every short flowed through while the long one still decodes
+        assert not long_req.done.is_set()
+        assert len(long_req.output) < 16
+        # FIFO: the late batch was admitted in submission order
+        firsts = [r.first_token_at for r in late]
+        assert firsts == sorted(firsts)
+        while not long_req.done.is_set():
+            assert eng.step_once(), "long request starved"
+        assert long_req.error is None and len(long_req.output) == 32
+        assert eng.allocator.used_blocks == 0  # everything reclaimed
+    finally:
+        eng.stop()
+
+
+def test_backpressure_429_when_queue_saturated(kernels):
+    """An engine that is not consuming fills its queue and answers 429."""
+    eng = ServeEngine(kernels)  # never started: nothing drains the queue
+    try:
+        for _ in range(SERVE_CFG.queue_depth):
+            eng.submit([1, 2], max_new_tokens=1)
+        with pytest.raises(AdmissionRejected) as exc:
+            eng.submit([1, 2], max_new_tokens=1)
+        assert exc.value.status == 429
+        assert eng.stats()["rejected"] == 1
+    finally:
+        eng.stop()
+
+
+def test_oversized_request_rejected_413(kernels):
+    eng = ServeEngine(kernels)
+    try:
+        with pytest.raises(AdmissionRejected) as exc:
+            eng.submit(list(range(17)), max_new_tokens=1)  # > max_prompt_len
+        assert exc.value.status == 413
+    finally:
+        eng.stop()
+
+
+def test_cache_oom_delays_admission_not_correctness(lm_setup):
+    """A cache sized for ~one worst-case sequence serializes admission:
+    the second request parks at the queue head until the first frees its
+    blocks, and both complete."""
+    cfg, _model, variables = lm_setup
+    tight = ServeConfig(
+        block_size=4, num_blocks=14, max_batch=2, max_prompt_len=16,
+        max_new_tokens=32, queue_depth=4,
+    )  # capacity 13 blocks; a 16+32 request needs 12
+    eng = ServeEngine(DecodeKernels(cfg, variables, tight)).start()
+    try:
+        a = eng.submit(list(range(16)), max_new_tokens=32)
+        b = eng.submit(list(range(16)), max_new_tokens=32)
+        assert a.done.wait(120) and a.error is None
+        assert b.done.wait(120) and b.error is None
+        assert b.finished_at >= a.finished_at  # serialized by the cache
+        assert eng.allocator.stats()["peak"] <= 13
+    finally:
+        eng.stop()
+
+
+def test_drain_finishes_inflight_rejects_new(engine):
+    long_req = engine.submit([7, 8, 9], max_new_tokens=32)
+    deadline = time.monotonic() + 60
+    while long_req.first_token_at is None:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    engine.queue.start_drain()
+    engine._wake.set()
+    with pytest.raises(AdmissionRejected) as exc:
+        engine.submit([1], max_new_tokens=1)
+    assert exc.value.status == 503
+    assert engine.drain(timeout=60)
+    assert long_req.done.is_set() and long_req.error is None
+    assert len(long_req.output) == 32  # finished, not truncated
+
+
+def test_stop_token_ends_generation_early(engine, lm_setup):
+    """A request whose greedy first token IS its stop token retires after
+    one token, well under its max_new_tokens budget."""
+    _cfg, model, variables = lm_setup
+    prompt = [3, 14, 15]
+    logits = model.apply(variables, jnp.asarray(prompt, jnp.int32)[None, :])
+    first = int(np.argmax(np.asarray(logits[0, -1])))
+    req = engine.generate(prompt, max_new_tokens=8, stop_token=first)
+    assert req.error is None and req.output == [first]
+
+
+def test_max_new_tokens_zero_is_rejected_not_defaulted(kernels):
+    """Regression: 0 used to be falsy-coerced to the server default."""
+    eng = ServeEngine(kernels)
+    try:
+        with pytest.raises(AdmissionRejected) as exc:
+            eng.submit([1, 2], max_new_tokens=0)
+        assert exc.value.status == 400
+    finally:
+        eng.stop()
+
+
+class _CrashingKernels:
+    """Shared-kernel shim whose decode step blows up (an XLA error, a NaN
+    cascade): the loop guard must fail requests loudly, not strand them."""
+
+    def __init__(self, kernels):
+        self._kernels = kernels
+        self.serve_cfg = kernels.serve_cfg
+        self.model_cfg = kernels.model_cfg
+        self.prefill = kernels.prefill
+
+    def decode(self, *a, **kw):
+        raise RuntimeError("synthetic decode explosion")
+
+
+def test_engine_crash_fails_requests_and_flips_health(kernels):
+    """Regression: an unexpected engine-loop exception used to kill the
+    thread silently while /healthz kept answering ok and parked handlers
+    waited out their 600s timeout."""
+    requests = pytest.importorskip("requests")
+    eng = ServeEngine(_CrashingKernels(kernels))
+    worker = ServeWorker(eng)
+    url = worker.start()
+    try:
+        # needs >1 token so the request survives prefill and hits decode
+        req = eng.submit([1, 2, 3], max_new_tokens=4)
+        assert req.done.wait(30), "crash did not fail the in-flight request"
+        assert req.error and "engine crashed" in req.error
+        assert not eng.healthy
+        h = requests.get(url + "/healthz", timeout=5)
+        assert h.status_code == 500 and h.json()["status"] == "failed"
+    finally:
+        worker.shutdown()
+
+
+def test_http_malformed_fields_return_400(kernels):
+    requests = pytest.importorskip("requests")
+    worker = ServeWorker(ServeEngine(kernels))
+    url = worker.start()
+    try:
+        for body in (
+            {"prompt_tokens": [1], "temperature": "hot"},
+            {"prompt_tokens": [1], "max_new_tokens": "many"},
+            {"prompt_tokens": [1], "seed": "x"},
+            {"prompt_tokens": [1], "max_new_tokens": 0},
+        ):
+            r = requests.post(url + "/v1/generate", json=body, timeout=30)
+            assert r.status_code == 400, (body, r.status_code, r.text)
+    finally:
+        worker.shutdown()
+
+
+def test_static_batch_engine_completes(kernels):
+    """Baseline engine: same kernels, same results, batch-at-a-time."""
+    eng = StaticBatchEngine(kernels).start()
+    try:
+        a = eng.submit([1, 2, 3], max_new_tokens=3)
+        b = eng.submit([9, 8], max_new_tokens=6)
+        assert a.done.wait(60) and a.error is None and len(a.output) == 3
+        assert b.done.wait(60) and b.error is None and len(b.output) == 6
+    finally:
+        eng.stop()
+
+
+def test_retrace_sentinel_one_decode_trace(lm_setup):
+    """Acceptance: a mixed-length request stream compiles the decode step
+    exactly once (and prefill exactly once) — the paged layout keeps every
+    shape static."""
+    from determined_tpu.lint._runtime import get_retrace_sentinel
+
+    cfg, _model, variables = lm_setup
+    sentinel = get_retrace_sentinel()
+    sentinel.reset()
+    eng = ServeEngine(DecodeKernels(cfg, variables, SERVE_CFG)).start()
+    try:
+        rng = np.random.default_rng(2)
+        reqs = []
+        for i in range(5):
+            prompt = [int(t) for t in rng.integers(0, 64, size=int(rng.integers(1, 16)))]
+            reqs.append(
+                _submit_retry(eng, prompt, max_new_tokens=1 + i * 3,
+                              temperature=0.5 * (i % 2), seed=i)
+            )
+        for r in reqs:
+            assert r.done.wait(120) and r.error is None
+    finally:
+        eng.stop()
+    by_label = {r.label: r for r in sentinel.records()}
+    assert by_label["serve.decode_step"].traces == 1
+    assert by_label["serve.prefill_step"].traces == 1
+    assert sentinel.violations() == {}
+    sentinel.reset()
+
+
+def test_serve_spans_reach_tracer(lm_setup):
+    """serve.admit/prefill/decode/kv_alloc spans + queue/kv gauges land in
+    the process tracer (the profile CLI's input)."""
+    from determined_tpu.observability import get_tracer
+
+    cfg, _model, variables = lm_setup
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.configure(enabled=True)
+    eng = ServeEngine(DecodeKernels(cfg, variables, SERVE_CFG)).start()
+    try:
+        req = eng.generate([1, 2, 3], max_new_tokens=3)
+        assert req.error is None
+    finally:
+        eng.stop()
+    names = {e["name"] for e in tracer.chrome_events()}
+    for expected in ("serve.admit", "serve.prefill", "serve.decode",
+                     "serve.kv_alloc", "serve.queue_depth",
+                     "serve.kv_utilization"):
+        assert expected in names, f"missing {expected} in {sorted(names)}"
+
+
+# ---------------------------------------------------------------------------
+# HTTP worker (in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_http_generate_healthz_stats_and_drain(kernels):
+    requests = pytest.importorskip("requests")
+    worker = ServeWorker(ServeEngine(kernels))
+    url = worker.start()
+    try:
+        assert requests.get(url + "/healthz", timeout=5).json()["status"] == "ok"
+        r = requests.post(
+            url + "/v1/generate",
+            json={"prompt_tokens": [1, 2, 3], "max_new_tokens": 3},
+            timeout=60,
+        )
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert len(body["tokens"]) == 3
+        assert body["usage"] == {"prompt_tokens": 3, "completion_tokens": 3}
+        assert body["latency_ms"] >= body["ttft_ms"] >= 0
+        st = requests.get(url + "/stats", timeout=5).json()
+        assert st["completed"] >= 1
+        # malformed bodies
+        assert requests.post(url + "/v1/generate", json={"prompt_tokens": "x"},
+                             timeout=5).status_code == 400
+        assert requests.post(url + "/v1/generate", data=b"{", timeout=5).status_code == 400
+        # drain: healthz flips, new generations rejected 503
+        worker.request_drain()
+        h = requests.get(url + "/healthz", timeout=5)
+        assert h.status_code == 503 and h.json()["status"] == "draining"
+        r = requests.post(url + "/v1/generate",
+                          json={"prompt_tokens": [1]}, timeout=5)
+        assert r.status_code == 503
+        assert worker.wait_drained(timeout=30)
+    finally:
+        worker.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# subprocess: dtpu serve — SIGTERM drain exits 75
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm_checkpoint(tmp_path_factory):
+    """A real trained-LMTrial checkpoint for the from_checkpoint paths."""
+    from determined_tpu import core, train
+    from determined_tpu.config import Length
+    from determined_tpu.models.transformer import LMTrial
+    from determined_tpu.parallel.mesh import MeshConfig
+
+    root = tmp_path_factory.mktemp("serve-ckpt")
+    ctx = train.init(
+        hparams={
+            "lr": 1e-3, "global_batch_size": 8, "seq_len": 8, "vocab_size": 64,
+            "d_model": 32, "n_layers": 1, "n_heads": 2, "n_kv_heads": 2,
+            "dataset_size": 32, "bf16": False, "attention": "reference",
+            "warmup_steps": 1,
+        },
+        mesh_config=MeshConfig(data=1),
+        core_context=core._dummy_init(checkpoint_dir=str(root)),
+        seed=0,
+    )
+    trainer = train.Trainer(LMTrial(ctx))
+    result = trainer.fit(Length.batches(2))
+    assert result["latest_checkpoint"]
+    return str(root / result["latest_checkpoint"])
+
+
+def test_engine_from_checkpoint_serves(lm_checkpoint):
+    cfg = ServeConfig(block_size=4, num_blocks=32, max_batch=2,
+                      max_prompt_len=8, max_new_tokens=8, queue_depth=4)
+    eng = ServeEngine.from_checkpoint(lm_checkpoint, cfg).start()
+    try:
+        req = eng.generate([1, 2, 3], max_new_tokens=4)
+        assert req.error is None and len(req.output) == 4
+        assert all(0 <= t < 64 for t in req.output)
+    finally:
+        eng.stop()
+
+
+def _spawn_serve_worker(lm_checkpoint, extra_args=(), env_extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 1 virtual device: fastest startup
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "determined_tpu.cli", *extra_args,
+         "serve", lm_checkpoint, "--port", "0",
+         "--block-size", "16", "--num-blocks", "64", "--max-batch", "2",
+         "--max-prompt-len", "8", "--max-new-tokens", "512",
+         "--queue-depth", "4"],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    lines = []
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line.rstrip())
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    deadline = time.time() + 180
+    url = None
+    while time.time() < deadline and url is None:
+        for line in lines:
+            if line.startswith("serving on "):
+                url = line.split("serving on ", 1)[1].strip()
+                break
+        if proc.poll() is not None:
+            raise AssertionError(
+                "serve worker exited early:\n" + "\n".join(lines)
+            )
+        time.sleep(0.2)
+    assert url, "worker never announced its url:\n" + "\n".join(lines)
+    return proc, url, lines
+
+
+@pytest.mark.slow
+def test_sigterm_drain_exits_75(lm_checkpoint):
+    """SIGTERM: in-flight requests finish (200), new ones are rejected,
+    and the process exits 75 (EX_TEMPFAIL) — the orderly-preemption
+    contract shared with experiment drains."""
+    requests = pytest.importorskip("requests")
+    proc, url, lines = _spawn_serve_worker(lm_checkpoint)
+    try:
+        results = {}
+
+        def generate():
+            results["resp"] = requests.post(
+                url + "/v1/generate",
+                json={"prompt_tokens": [1, 2, 3], "max_new_tokens": 512},
+                timeout=180,
+            )
+
+        t = threading.Thread(target=generate, daemon=True)
+        t.start()
+        # let the request get admitted, then drain under it
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                if requests.get(url + "/stats", timeout=5).json()["submitted"] >= 1:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        # wait for the worker to acknowledge the drain (the signal flag is
+        # polled on its main loop) before probing rejection
+        deadline = time.time() + 30
+        while time.time() < deadline and not any(
+            line.startswith("drain requested") for line in lines
+        ):
+            time.sleep(0.05)
+        assert any(line.startswith("drain requested") for line in lines), lines
+        # new requests are rejected while draining (503), or the listener
+        # is already gone (connection refused) — both are rejections
+        try:
+            r = requests.post(url + "/v1/generate",
+                              json={"prompt_tokens": [4]}, timeout=10)
+            assert r.status_code == 503, r.text
+        except requests.ConnectionError:
+            pass
+        t.join(timeout=180)
+        assert not t.is_alive(), "in-flight request never completed"
+        resp = results["resp"]
+        assert resp.status_code == 200, resp.text
+        assert len(resp.json()["tokens"]) == 512  # finished, not truncated
+        rc = proc.wait(timeout=60)
+        assert rc == 75, "\n".join(lines)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# devcluster e2e: registration, serving under load, heartbeat-loss pruning
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.devcluster
+@pytest.mark.slow
+def test_replica_lifecycle_against_real_master(lm_checkpoint, tmp_path):
+    requests = pytest.importorskip("requests")
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from devcluster import DevCluster
+
+    cluster = DevCluster(
+        tmp_path, agents=0, master_args=["--serve-replica-timeout-sec", "3"]
+    )
+    cluster.start_master()
+    proc = None
+    try:
+        proc, url, lines = _spawn_serve_worker(
+            lm_checkpoint, extra_args=["-m", cluster.url]
+        )
+        # replica appears in the master's listing
+        deadline = time.time() + 60
+        replicas = []
+        while time.time() < deadline:
+            replicas = cluster.http.get(cluster.url + "/api/v1/serving",
+                                        timeout=5).json()
+            if replicas:
+                break
+            time.sleep(0.3)
+        assert len(replicas) == 1, lines
+        assert replicas[0]["url"] == url
+        assert replicas[0]["checkpoint"] == lm_checkpoint
+
+        # heartbeats carry the worker's stats into the listing
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            replicas = cluster.http.get(cluster.url + "/api/v1/serving",
+                                        timeout=5).json()
+            if replicas and replicas[0].get("stats"):
+                break
+            time.sleep(0.5)
+        assert "kv_cache" in replicas[0]["stats"], replicas
+
+        # serves under (a little) load through the registered url
+        for i in range(4):
+            r = requests.post(
+                replicas[0]["url"] + "/v1/generate",
+                json={"prompt_tokens": [i + 1, i + 2], "max_new_tokens": 3},
+                timeout=120,
+            )
+            assert r.status_code == 200, r.text
+            assert len(r.json()["tokens"]) == 3
+
+        # heartbeat loss (SIGKILL: no deregistration) -> master prunes
+        proc.kill()
+        proc.wait(timeout=10)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            replicas = cluster.http.get(cluster.url + "/api/v1/serving",
+                                        timeout=5).json()
+            if not replicas:
+                break
+            time.sleep(0.5)
+        assert replicas == [], "replica not pruned after heartbeat loss"
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        cluster.stop()

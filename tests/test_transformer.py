@@ -168,3 +168,159 @@ def test_hf_gpt2_trial_learns(tmp_path):
     # the ln(128)=4.85 uniform baseline
     assert vm["validation_loss"] < 0.8 * math.log(vocab), vm
     assert result["latest_checkpoint"]
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode path: step-for-step parity with the full-sequence forward
+# (pins the paged cache layout before anything serves from it)
+# ---------------------------------------------------------------------------
+
+import jax.numpy as jnp  # noqa: E402
+
+from determined_tpu.models.transformer import (  # noqa: E402
+    init_kv_cache,
+    transformer_decode,
+    transformer_prefill,
+)
+from determined_tpu.serve.engine import sample_token  # noqa: E402
+
+# bf16 keeps ~8 mantissa bits; logits here are O(1), so 1/32 absolute slack
+# covers the re-associated attention reductions without masking layout bugs
+_DECODE_TOL = {jnp.float32: dict(atol=2e-5, rtol=2e-4),
+               jnp.bfloat16: dict(atol=3e-2, rtol=3e-2)}
+
+
+def _tiny_lm(dtype, n_kv_heads=None, seed=0):
+    cfg = TransformerConfig(
+        vocab_size=101, d_model=32, n_layers=2, n_heads=4,
+        n_kv_heads=n_kv_heads, max_seq_len=64, dtype=dtype,
+        attention_impl="reference",
+    )
+    model = TransformerLM(cfg)
+    from flax.core import meta as flax_meta
+
+    variables = flax_meta.unbox(
+        model.init(jax.random.key(seed), jnp.zeros((1, 8), jnp.int32))
+    )
+    return cfg, model, variables
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16], ids=["f32", "bf16"])
+@pytest.mark.parametrize("n_kv_heads", [None, 2], ids=["mha", "gqa"])
+def test_decode_matches_full_forward_logits(dtype, n_kv_heads):
+    """Prefill + per-token decode logits == full-sequence forward logits,
+    at each generation step, for MHA and GQA (n_kv_heads < n_heads)."""
+    cfg, model, variables = _tiny_lm(dtype, n_kv_heads)
+    params = variables["params"]
+    block_size = 4
+    cache = init_kv_cache(cfg, num_blocks=16, block_size=block_size)
+    prompt = list(np.random.default_rng(1).integers(0, cfg.vocab_size, size=9))
+    prompt = [int(t) for t in prompt]
+    max_prompt = 16
+    table = np.arange(1, 1 + (32 // block_size), dtype=np.int32)[None, :]
+    padded = np.zeros((1, max_prompt), np.int32)
+    padded[0, : len(prompt)] = prompt
+    logits_pf, cache = transformer_prefill(
+        cfg, params, padded, jnp.asarray([len(prompt)]), table, cache
+    )
+    tol = _DECODE_TOL[dtype]
+
+    # every prompt position's logits match the full forward (causality:
+    # the padding after them cannot contribute)
+    full = model.apply(variables, jnp.asarray(prompt, jnp.int32)[None, :])
+    np.testing.assert_allclose(
+        np.asarray(logits_pf[0, : len(prompt)]), np.asarray(full[0]), **tol
+    )
+
+    seq = list(prompt)
+    tok = int(np.argmax(np.asarray(logits_pf[0, len(prompt) - 1])))
+    for _ in range(6):
+        seq.append(tok)
+        pos = len(seq) - 1
+        logits_dec, cache = transformer_decode(
+            cfg, params, jnp.asarray([tok], jnp.int32),
+            jnp.asarray([pos], jnp.int32), table, cache,
+        )
+        full = model.apply(variables, jnp.asarray(seq, jnp.int32)[None, :])
+        np.testing.assert_allclose(
+            np.asarray(logits_dec[0]), np.asarray(full[0, -1]), **tol
+        )
+        tok = int(np.argmax(np.asarray(logits_dec[0])))
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8], ids=["greedy", "temp0.8"])
+def test_decode_sampling_matches_full_forward(temperature):
+    """Seeded sampling over decode logits reproduces sampling over the
+    full-forward logits token for token (GQA config, f32)."""
+    cfg, model, variables = _tiny_lm(jnp.float32, n_kv_heads=2, seed=3)
+    params = variables["params"]
+    block_size = 4
+    cache = init_kv_cache(cfg, num_blocks=16, block_size=block_size)
+    prompt = [5, 17, 3, 99, 42]
+    table = np.arange(1, 9, dtype=np.int32)[None, :]
+    padded = np.zeros((1, 8), np.int32)
+    padded[0, : len(prompt)] = prompt
+    logits_pf, cache = transformer_prefill(
+        cfg, params, padded, jnp.asarray([len(prompt)]), table, cache
+    )
+
+    rng_dec = np.random.default_rng(7)
+    rng_full = np.random.default_rng(7)
+    dec_tokens = []
+    tok = sample_token(
+        np.asarray(logits_pf[0, len(prompt) - 1]), temperature, rng_dec
+    )
+    dec_tokens.append(tok)
+    seq = list(prompt)
+    for _ in range(5):
+        seq.append(tok)
+        logits_dec, cache = transformer_decode(
+            cfg, params, jnp.asarray([tok], jnp.int32),
+            jnp.asarray([len(seq) - 1], jnp.int32), table, cache,
+        )
+        tok = sample_token(np.asarray(logits_dec[0]), temperature, rng_dec)
+        dec_tokens.append(tok)
+
+    # oracle: same sampler over full-forward logits
+    full_tokens = []
+    seq = list(prompt)
+    for _ in range(6):
+        logits = model.apply(variables, jnp.asarray(seq, jnp.int32)[None, :])
+        tok = sample_token(np.asarray(logits[0, -1]), temperature, rng_full)
+        full_tokens.append(tok)
+        seq.append(tok)
+    assert dec_tokens == full_tokens
+
+
+def test_decode_inactive_lanes_do_not_disturb_active(devices8):
+    """A batch mixing active and empty (-1) lanes produces the same logits
+    for the active lane as a batch of one — the scratch-block writes of
+    idle lanes must never leak into real sequences."""
+    cfg, _model, variables = _tiny_lm(jnp.float32, n_kv_heads=2, seed=5)
+    params = variables["params"]
+    block_size = 4
+    prompt = [9, 8, 7, 6, 5, 4]
+
+    def run(batch_lanes):
+        cache = init_kv_cache(cfg, num_blocks=32, block_size=block_size)
+        tables = np.zeros((batch_lanes, 8), np.int32)
+        tables[0] = np.arange(1, 9)
+        padded = np.zeros((1, 8), np.int32)
+        padded[0, : len(prompt)] = prompt
+        logits_pf, cache = transformer_prefill(
+            cfg, params, padded, jnp.asarray([len(prompt)]), tables[:1], cache
+        )
+        tok = int(np.argmax(np.asarray(logits_pf[0, len(prompt) - 1])))
+        toks = np.zeros(batch_lanes, np.int32)
+        poss = np.full(batch_lanes, -1, np.int32)
+        toks[0] = tok
+        poss[0] = len(prompt)
+        logits_dec, cache = transformer_decode(
+            cfg, params, jnp.asarray(toks), jnp.asarray(poss),
+            jnp.asarray(tables), cache,
+        )
+        return np.asarray(logits_dec[0])
+
+    solo = run(1)
+    mixed = run(4)
+    np.testing.assert_allclose(mixed, solo, atol=1e-6, rtol=1e-6)
