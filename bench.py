@@ -51,6 +51,27 @@ def main() -> None:
             )
         )
 
+    # searcher-zoo hook (docs/searchers.md): DTPU_BENCH_SEARCHERS=1 runs
+    # the trial-free simulator comparison of random/ASHA/Hyperband/PBT at
+    # equal budget (scripts/bench_searchers.py) — same one-line JSON
+    # contract; costs milliseconds, no devices
+    if os.environ.get("DTPU_BENCH_SEARCHERS", "0") not in ("0", ""):
+        import subprocess
+        import sys
+
+        raise SystemExit(
+            subprocess.call(
+                [
+                    sys.executable,
+                    os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "scripts",
+                        "bench_searchers.py",
+                    ),
+                ]
+            )
+        )
+
     # A/B hook for the serving tier (docs/serving.md): DTPU_BENCH_SERVE=1
     # benchmarks continuous batching vs the naive static batch over one
     # shared kernel set (scripts/bench_serve.py) — same one-line JSON

@@ -187,6 +187,88 @@ def exp_signal(args) -> int:
     return 0
 
 
+# ---- searcher simulation (trial-free; no master required) -------------------
+
+
+def searcher_simulate(args) -> int:
+    """Replay search methods against a seeded learning-curve model and
+    print a best-metric-vs-budget table — method choice and bracket/
+    population math in milliseconds, no device time (docs/searchers.md)."""
+    import yaml
+
+    from determined_tpu import searcher as searcher_mod
+    from determined_tpu.config.experiment import (
+        ExperimentConfig,
+        InvalidExperimentConfig,
+    )
+
+    if args.config:
+        with open(args.config) as f:
+            cfg = ExperimentConfig.parse(yaml.safe_load(f))
+    else:
+        # built-in lr-search space, matched to the synthetic curve model
+        cfg = ExperimentConfig.parse(
+            {
+                "name": "searcher-simulate",
+                "hyperparameters": {
+                    "lr": {"type": "log", "minval": -4, "maxval": -1}
+                },
+                "searcher": {
+                    "name": "random",
+                    "metric": "validation_loss",
+                    "max_trials": 16,
+                    "max_time": 64,
+                    "num_rungs": 3,
+                    "divisor": 4,
+                },
+            }
+        )
+    methods = [m.strip() for m in args.methods.split(",") if m.strip()]
+    from determined_tpu.experiment import ExperimentJournalError
+
+    try:
+        if args.journal:
+            path = args.journal
+            if os.path.isdir(path):
+                from determined_tpu.experiment import journal_path
+
+                path = journal_path(path)
+            model = searcher_mod.JournalCurveModel.from_journal(
+                path, cfg.searcher.metric, cfg.searcher.time_metric or "batches"
+            )
+        else:
+            model = searcher_mod.SyntheticCurveModel(args.seed)
+        reports = searcher_mod.compare_methods(
+            cfg, methods, model, seed=args.seed, report_period=args.period
+        )
+    except (InvalidExperimentConfig, ExperimentJournalError, ValueError) as e:
+        # covers unknown methods AND a missing/empty --journal
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        _print_json(
+            [
+                {
+                    "method": r.method,
+                    "seed": r.seed,
+                    "trials_created": r.trials_created,
+                    "total_units": r.total_units,
+                    "best_metric": r.best_metric,
+                    "best_trial": r.best_trial,
+                    "best_hparams": r.best_hparams,
+                    "curve": r.curve[-32:],
+                    "lineage": {
+                        str(k): v for k, v in r.lineage.items() if v is not None
+                    },
+                }
+                for r in reports
+            ]
+        )
+        return 0
+    print(searcher_mod.format_comparison(reports))
+    return 0
+
+
 # ---- local experiment recovery (journal-backed; no master required) ---------
 
 
@@ -1163,6 +1245,30 @@ def build_parser() -> argparse.ArgumentParser:
     mt.add_argument("id", type=int)
     mt.add_argument("--group")
     mt.set_defaults(fn=trial_metrics)
+
+    srch = sub.add_parser("searcher").add_subparsers(dest="verb", required=True)
+    sim = srch.add_parser(
+        "simulate",
+        help="replay search methods against a learning-curve model "
+        "(trial-free, deterministic; docs/searchers.md)",
+    )
+    sim.add_argument("-c", "--config", help="experiment config yaml "
+                     "(default: a built-in lr search space)")
+    sim.add_argument(
+        "--methods",
+        default="random,asha,hyperband,pbt",
+        help="comma-separated method names to compare",
+    )
+    sim.add_argument("--seed", type=int, default=0)
+    sim.add_argument("--period", type=int, default=0,
+                     help="validation period in budget units (0 = per-method default)")
+    sim.add_argument(
+        "--journal",
+        help="replay recorded curves from an experiment journal "
+        "(file or checkpoint dir) instead of the synthetic model",
+    )
+    sim.add_argument("--json", action="store_true")
+    sim.set_defaults(fn=searcher_simulate)
 
     agent = sub.add_parser("agent", aliases=["a"]).add_subparsers(
         dest="verb", required=True

@@ -70,6 +70,26 @@ class Length:
         return cls(n, "records")
 
 
+def clone_extended_length(max_length: Length, inherited_steps: int,
+                          logger: Any = None, context: str = "") -> Length:
+    """A clone-resumed trial's budget is ``max_length`` BEYOND the steps
+    inherited from its source checkpoint: the trainer's step horizon is
+    absolute and the restored state already carries the parent's count.
+    One rule for both drivers (``experiment/local.py`` and the cluster
+    harness's ``DTPU_WARM_START_STEPS`` path) so they cannot diverge.
+    Only batch budgets extend; others stay absolute with a warning."""
+    if not inherited_steps or inherited_steps <= 0:
+        return max_length
+    if max_length.unit != "batches":
+        if logger is not None:
+            logger.warning(
+                "%sclone budget extension needs a batches max_length; "
+                "%s budget left absolute", context, max_length.unit,
+            )
+        return max_length
+    return Length.batches(max_length.units + int(inherited_steps))
+
+
 @dataclasses.dataclass(frozen=True)
 class SearcherConfig:
     """Searcher section — reference ``schemas/expconf/v0/searcher.json``.
@@ -88,22 +108,48 @@ class SearcherConfig:
     max_trials: int = 1
     max_length: Optional[Length] = None          # per-trial budget
     max_concurrent_trials: int = 16
-    # ASHA knobs (reference asha_stopping.go / adaptive_asha.go)
+    # ASHA knobs (reference asha_stopping.go / adaptive_asha.go); divisor
+    # doubles as hyperband's eta
     num_rungs: int = 5
     divisor: int = 4
     mode: str = "standard"                        # conservative|standard|aggressive
-    max_time: Optional[int] = None                # asha max resource units per trial
+    max_time: Optional[int] = None                # asha/hyperband max units per trial
     time_metric: Optional[str] = None
     bracket_rungs: Optional[List[int]] = None
     source_trial_id: Optional[int] = None
+    # PBT knobs (Jaderberg et al.; searcher/_pbt.py).  One generation's
+    # training budget is max_length — the same per-trial budget knob every
+    # other method uses.
+    population_size: Optional[int] = None         # default: max_trials
+    num_generations: int = 4
+    truncate_fraction: float = 0.25
+    perturb_factor: float = 1.2
+    resample_probability: float = 0.25
+
+    _NAMES = ("single", "random", "grid", "asha", "adaptive_asha",
+              "hyperband", "pbt", "driver")
 
     def __post_init__(self):
-        if self.name not in ("single", "random", "grid", "asha", "adaptive_asha", "driver"):
+        if self.name not in self._NAMES:
             raise InvalidExperimentConfig(f"unknown searcher {self.name!r}")
         if self.mode not in ("conservative", "standard", "aggressive"):
             raise InvalidExperimentConfig(f"unknown adaptive mode {self.mode!r}")
         if self.max_trials < 1:
             raise InvalidExperimentConfig("searcher.max_trials must be >= 1")
+        if self.population_size is not None and self.population_size < 1:
+            raise InvalidExperimentConfig("searcher.population_size must be >= 1")
+        if self.num_generations < 1:
+            raise InvalidExperimentConfig("searcher.num_generations must be >= 1")
+        if not 0.0 <= self.truncate_fraction <= 0.5:
+            raise InvalidExperimentConfig(
+                "searcher.truncate_fraction must be in [0, 0.5]"
+            )
+        if self.perturb_factor <= 1.0:
+            raise InvalidExperimentConfig("searcher.perturb_factor must be > 1")
+        if not 0.0 <= self.resample_probability <= 1.0:
+            raise InvalidExperimentConfig(
+                "searcher.resample_probability must be in [0, 1]"
+            )
 
     @classmethod
     def parse(cls, raw: Dict[str, Any]) -> "SearcherConfig":

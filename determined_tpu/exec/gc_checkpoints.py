@@ -98,16 +98,24 @@ def plan_retention(
     policy: RetentionPolicy,
     metric_by_trial: Optional[Dict[int, float]] = None,
     protected: Optional[Set[str]] = None,
+    protected_trials: Optional[Set[int]] = None,
 ) -> Tuple[Set[str], Set[str]]:
     """Decide (keep, delete) uuid sets under the policy.
 
     Kept: newest ``keep_trial_latest`` per trial (by steps_completed, uuid
     as tiebreak), the latest checkpoint of the ``keep_experiment_best``
     best trials by metric, every manifest-referenced parent of a kept
-    checkpoint, anything without a manifest (mid-write safety), and any
+    checkpoint, anything without a manifest (mid-write safety), any
     explicitly ``protected`` uuid (the experiment passes its journaled
     resume points — the WAL references them by id, so deleting one would
-    turn a crash-resume into a from-scratch retrain).
+    turn a crash-resume into a from-scratch retrain), and the latest
+    checkpoint of every ``protected_trials`` member — live PBT clone
+    sources: a current-generation survivor may be exploit-cloned at the
+    next turnover, and metric-ranked retention deleting its checkpoint
+    mid-generation would turn the clone into a from-scratch child.
+
+    A uuid shared across trials (a materialized PBT clone keeps its
+    source's uuid in the child's namespace) is kept or deleted as a unit.
     """
     metric_by_trial = metric_by_trial or {}
     by_trial: Dict[int, List[CheckpointInfo]] = {}
@@ -131,6 +139,12 @@ def plan_retention(
         for rid in ranked[: policy.keep_experiment_best]:
             keep.add(by_trial[rid][0].uuid)
 
+    # live clone sources: the newest checkpoint of each protected trial is
+    # a candidate PBT exploit parent until its generation turns over
+    for rid in protected_trials or set():
+        if rid in by_trial:
+            keep.add(by_trial[rid][0].uuid)
+
     # a kept checkpoint's manifest-referenced parent is its verified-resume
     # fallback: protect it even when the per-trial count would drop it
     by_uuid = {c.uuid: c for c in checkpoints}
@@ -148,22 +162,27 @@ def apply_retention(
     policy: RetentionPolicy,
     metric_by_trial: Optional[Dict[int, float]] = None,
     protected: Optional[Set[str]] = None,
+    protected_trials: Optional[Set[int]] = None,
 ) -> Dict[str, List[str]]:
     """Scan, plan, and delete under ``checkpoint_dir``; returns what was
     kept/deleted.  Deletion failures are logged and skipped — GC must
     never take down the search it is cleaning up after."""
     checkpoints = scan_experiment_checkpoints(checkpoint_dir)
-    keep, delete = plan_retention(checkpoints, policy, metric_by_trial, protected)
+    keep, delete = plan_retention(
+        checkpoints, policy, metric_by_trial, protected, protected_trials
+    )
     deleted: List[str] = []
-    by_uuid = {c.uuid: c for c in checkpoints}
-    for uuid in sorted(delete):
-        ci = by_uuid[uuid]
-        path = os.path.join(checkpoint_dir, f"trial_{ci.trial_id}", uuid)
+    # iterate the scan, not a uuid index: a clone-shared uuid names one
+    # directory per trial and every copy must go
+    for ci in sorted(checkpoints, key=lambda c: (c.uuid, c.trial_id)):
+        if ci.uuid not in delete:
+            continue
+        path = os.path.join(checkpoint_dir, f"trial_{ci.trial_id}", ci.uuid)
         try:
             shutil.rmtree(path)
-            deleted.append(uuid)
+            deleted.append(ci.uuid)
         except OSError:
-            logger.exception("retention: failed to delete checkpoint %s", uuid)
+            logger.exception("retention: failed to delete checkpoint %s", ci.uuid)
     if deleted:
         logger.info(
             "retention: deleted %d checkpoint(s), kept %d", len(deleted), len(keep)

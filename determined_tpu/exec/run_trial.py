@@ -244,6 +244,17 @@ def _install_log_shipper() -> None:
     _log_shipper_flush = flush
 
 
+def _warm_start_extended_length(max_length, logger):
+    """PBT exploit clones: the master seeds the trial with its parent's
+    checkpoint and advertises the inherited step count
+    (``DTPU_WARM_START_STEPS``); same horizon rule as the local driver
+    (``config.experiment.clone_extended_length``)."""
+    from determined_tpu.config.experiment import clone_extended_length
+
+    warm = int(os.environ.get("DTPU_WARM_START_STEPS", "0") or 0)
+    return clone_extended_length(max_length, warm, logger, context="warm-start ")
+
+
 def _self_report_exit(code: int) -> None:
     """POST this process's exit to the trials API.
 
@@ -599,6 +610,7 @@ def main() -> int:
             from determined_tpu.config.experiment import Length
 
             max_length = Length.batches(scfg.max_time or 100)
+        max_length = _warm_start_extended_length(max_length, logger)
         from determined_tpu.train._restart import RestartPolicy
 
         supervisor = TrialSupervisor(

@@ -218,13 +218,42 @@ class ClusterExperiment:
             raise
         return int(resp.json()["id"])
 
-    def _submit_trial(self, rid: int, hparams: Dict[str, Any]) -> int:
+    def _submit_trial(
+        self,
+        rid: int,
+        hparams: Dict[str, Any],
+        source_checkpoint: Optional[str] = None,
+    ) -> int:
+        payload: Dict[str, Any] = {"request_id": rid, "hparams": hparams}
+        if source_checkpoint:
+            # PBT exploit clone: the master seeds the trial's resume point
+            # with this uuid and the allocation restores it THROUGH the
+            # shared checkpoint storage (DTPU_LATEST_CHECKPOINT) — clone
+            # sources never travel as driver-local paths
+            payload["source_checkpoint"] = source_checkpoint
         resp = self.session.post(
             f"/api/v1/experiments/{self.master_experiment_id}/trials",
-            json={"request_id": rid, "hparams": hparams},
+            json=payload,
             retry=True,  # idempotent per request_id (master keeps the map)
         )
         return int(resp.json()["id"])
+
+    def _source_checkpoint_for(self, source_rid: Optional[int]) -> Optional[str]:
+        """The clone source's newest master-known checkpoint uuid."""
+        if source_rid is None:
+            return None
+        with self._state_lock:
+            result = self.results.get(source_rid)
+            watch = self._watches.get(source_rid)
+        if result is not None and result.checkpoint:
+            return result.checkpoint
+        tid = watch.master_trial_id if watch is not None else None
+        if tid is not None:
+            try:
+                return self._get_trial(tid).get("latest_checkpoint") or None
+            except APIError:
+                return None
+        return None
 
     def _get_trial(self, tid: int) -> Dict[str, Any]:
         return self.session.get(f"/api/v1/trials/{tid}").json()
@@ -264,12 +293,14 @@ class ClusterExperiment:
 
     # -- trial watchers ----------------------------------------------------
 
-    def _watch_trial(self, rid: int, hparams: Dict[str, Any]) -> None:
+    def _watch_trial(
+        self, rid: int, hparams: Dict[str, Any], source_rid: Optional[int] = None
+    ) -> None:
         # same attribution unit as LocalExperiment: everything this thread
         # records inside trial.run is this trial's wall-clock in the ledger
         with get_tracer().span("trial.run", cat="trial", trial=rid):
             try:
-                outcome = self._watch_trial_inner(rid, hparams)
+                outcome = self._watch_trial_inner(rid, hparams, source_rid)
             except BaseException as e:  # noqa: BLE001 - drained by run()
                 logger.exception("trial %d watcher failed", rid)
                 with self._state_lock:
@@ -302,7 +333,7 @@ class ClusterExperiment:
             self.searcher.on_trial_exited(rid)
 
     def _watch_trial_inner(
-        self, rid: int, hparams: Dict[str, Any]
+        self, rid: int, hparams: Dict[str, Any], source_rid: Optional[int] = None
     ) -> Optional[Tuple[TrialResult, str]]:
         tracer = get_tracer()
         scfg = self.config.searcher
@@ -310,7 +341,13 @@ class ClusterExperiment:
             watch = self._watches[rid]
         tid = watch.master_trial_id
         if tid is None:
-            tid = self._submit_trial(rid, hparams)
+            source_ckpt = self._source_checkpoint_for(source_rid)
+            if source_rid is not None and source_ckpt is None:
+                logger.warning(
+                    "trial %d: exploit source trial %d has no master-known "
+                    "checkpoint; the child starts from scratch", rid, source_rid,
+                )
+            tid = self._submit_trial(rid, hparams, source_checkpoint=source_ckpt)
             watch.master_trial_id = tid
             if self.journal is not None:
                 # Safe unlocked: append holds the journal's internal lock.
@@ -591,7 +628,7 @@ class ClusterExperiment:
                         self._watches.setdefault(rid, _Watch(request_id=rid))
                     t = threading.Thread(
                         target=self._watch_trial,
-                        args=(rid, rec.hparams),
+                        args=(rid, rec.hparams, rec.source_trial_id),
                         name=f"dtpu-cluster-{rid}",
                         daemon=True,
                     )

@@ -15,10 +15,13 @@ single-host analog — an append-only, fsynced JSONL file at
                            driver re-attach instead of re-submitting
 - ``searcher_snapshot``    full ``Searcher.state_json`` (method + ctx
                            request-id counter/rng + trial records)
-- ``trial_created``        rid, hparams
+- ``trial_created``        rid, hparams, source_trial_id (PBT clone parent)
 - ``trial_running``        rid, device ids (slot assignment)
 - ``trial_validated``      rid, steps, metrics
 - ``trial_checkpoint``     rid, latest FINALIZED checkpoint uuid
+- ``trial_cloned``         rid, source rid, materialized uuid, inherited
+                           steps (PBT exploit provenance: a resumed child
+                           re-derives the same budget horizon)
 - ``trial_result``         rid, the completed TrialResult payload
 - ``trial_exited`` / ``trial_exited_early``   searcher lifecycle events
 - ``experiment_preempted`` / ``experiment_completed``   terminal status
@@ -112,6 +115,7 @@ class ExperimentJournal:
         self._snapshot: Optional[Dict[str, Any]] = None
         self._created: Dict[int, Dict[str, Any]] = {}
         self._checkpoints: Dict[int, Dict[str, Any]] = {}
+        self._clones: Dict[int, Dict[str, Any]] = {}
         self._results: Dict[int, Dict[str, Any]] = {}
         self._status: Optional[Dict[str, Any]] = None
 
@@ -261,6 +265,8 @@ class ExperimentJournal:
             self._created[int(rec["rid"])] = rec
         elif t == "trial_checkpoint":
             self._checkpoints[int(rec["rid"])] = rec
+        elif t == "trial_cloned":
+            self._clones[int(rec["rid"])] = rec
         elif t == "trial_result":
             self._results[int(rec["rid"])] = rec
         elif t in ("experiment_preempted", "experiment_completed"):
@@ -276,6 +282,7 @@ class ExperimentJournal:
         if self._snapshot is not None:
             records.append(self._snapshot)
         records.extend(self._created[r] for r in sorted(self._created))
+        records.extend(self._clones[r] for r in sorted(self._clones))
         records.extend(self._checkpoints[r] for r in sorted(self._checkpoints))
         records.extend(self._results[r] for r in sorted(self._results))
         if self._status is not None:
@@ -335,6 +342,7 @@ class JournalReplay:
     tail_events: List[Dict[str, Any]]          # searcher events after it
     created: Dict[int, Dict[str, Any]]         # rid -> hparams
     checkpoints: Dict[int, str]                # rid -> latest ckpt uuid
+    clones: Dict[int, Dict[str, Any]]          # rid -> {source, uuid, steps}
     results: Dict[int, Dict[str, Any]]         # rid -> TrialResult payload
     status: str                                # running|preempted|completed
     # cluster-driven searches (experiment/cluster.py): which master owns
@@ -363,6 +371,7 @@ def read_journal(path: str) -> JournalReplay:
     snapshot_seq = -1
     created: Dict[int, Dict[str, Any]] = {}
     checkpoints: Dict[int, str] = {}
+    clones: Dict[int, Dict[str, Any]] = {}
     results: Dict[int, Dict[str, Any]] = {}
     status = "running"
     for rec in records:
@@ -378,6 +387,15 @@ def read_journal(path: str) -> JournalReplay:
             created[int(rec["rid"])] = rec.get("hparams") or {}
         elif t == "trial_checkpoint":
             if rec.get("uuid"):
+                checkpoints[int(rec["rid"])] = rec["uuid"]
+        elif t == "trial_cloned":
+            clones[int(rec["rid"])] = {
+                "source": rec.get("source"),
+                "uuid": rec.get("uuid"),
+                "steps": rec.get("steps") or 0,
+            }
+            if rec.get("uuid"):
+                # the materialized clone is the child's first resume point
                 checkpoints[int(rec["rid"])] = rec["uuid"]
         elif t == "trial_result":
             results[int(rec["rid"])] = rec.get("result") or {}
@@ -397,6 +415,7 @@ def read_journal(path: str) -> JournalReplay:
         tail_events=tail,
         created=created,
         checkpoints=checkpoints,
+        clones=clones,
         results=results,
         status=status,
         cluster=cluster,
@@ -416,6 +435,7 @@ def experiment_status(checkpoint_dir: str) -> Dict[str, Any]:
                 "request_id": rid,
                 "state": "completed" if result is not None else "in_flight",
                 "hparams": replay.created[rid],
+                "cloned_from": (replay.clones.get(rid) or {}).get("source"),
                 "steps_completed": (result or {}).get("steps_completed"),
                 "metrics": (result or {}).get("metrics"),
                 "checkpoint": (
@@ -477,7 +497,10 @@ class JournaledSearcher(Searcher):
         for a in actions:
             if isinstance(a, Create):
                 self.journal.append(
-                    "trial_created", rid=a.request_id, hparams=a.hparams
+                    "trial_created",
+                    rid=a.request_id,
+                    hparams=a.hparams,
+                    source_trial_id=a.source_trial_id,
                 )
         self.journal.append("searcher_snapshot", state=json.loads(self._state_json_locked()))
 

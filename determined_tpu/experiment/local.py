@@ -27,6 +27,7 @@ import json
 import logging
 import os
 import signal
+import tempfile
 import threading
 import time
 from typing import Any, Dict, List, Optional, Type
@@ -128,6 +129,11 @@ class LocalExperiment:
         self.status = "pending"  # pending|running|completed|preempted
         self._resume_checkpoints: Dict[int, Optional[str]] = {}
         self._journaled_ckpts: Dict[int, str] = {}
+        # rid -> steps_completed at its clone point (PBT exploit): the
+        # child's training budget is the generation length ON TOP of the
+        # inherited steps, and a crash-resume must re-derive the same
+        # horizon, so the value rides in the journal's trial_cloned record
+        self._clone_base_steps: Dict[int, int] = {}
         # guards the two checkpoint maps above: trial threads write them
         # mid-run while the GC pass and the drain path iterate them
         self._ckpt_lock = threading.Lock()
@@ -191,6 +197,11 @@ class LocalExperiment:
             core_ctx.preempt.simulate()
         with self._ckpt_lock:
             resume_ckpt = self._resume_checkpoints.get(rid)
+        if resume_ckpt is None and create.source_trial_id is not None:
+            # PBT exploit: materialize the parent's newest usable
+            # checkpoint into this trial's namespace and resume from it
+            resume_ckpt = self._materialize_clone(rid, create.source_trial_id)
+        max_length = self._clone_extended_length(max_length, rid)
         try:
             if self.journal is not None:
                 self.journal.append(
@@ -274,6 +285,12 @@ class LocalExperiment:
             # reported as in-flight by a later drain
             with self._ckpt_lock:
                 self._resume_checkpoints.pop(rid, None)
+        if not preempted:
+            # the FINAL checkpoint must be visible to clone-source
+            # resolution immediately: under the concurrent scheduler a PBT
+            # turnover dispatches children while this thread's result is
+            # still in the scheduler's outcome, not in self.results
+            self._journal_trial_checkpoint(rid, result.checkpoint)
         if self.journal is not None:
             if preempted:
                 # drained to a checkpoint, not finished: journal the resume
@@ -635,6 +652,11 @@ class LocalExperiment:
             rec = self.searcher.trials.get(rid)
             if rec is not None and not rec.exited:
                 self.searcher.on_trial_exited(rid)
+        # clone provenance: a resumed child's budget horizon must extend
+        # past its inherited steps exactly as the original run's did
+        for rid, clone in replay.clones.items():
+            with self._ckpt_lock:
+                self._clone_base_steps[rid] = int(clone.get("steps") or 0)
         # in-flight trials re-queue from their latest VERIFIED checkpoint
         # (manifest check + parent-lineage fallback); with no usable
         # checkpoint they restart from scratch
@@ -752,6 +774,109 @@ class LocalExperiment:
                 continue
         return None
 
+    # -- PBT clone materialization -----------------------------------------
+
+    def _clone_source_checkpoint(self, src_rid: int) -> Optional[str]:
+        """The exploit parent's newest USABLE checkpoint uuid: its recorded
+        result/journal checkpoint, walked through the manifest lineage the
+        same way crash-resume walks it."""
+        res = self.results.get(src_rid)
+        sid = res.checkpoint if res is not None else None
+        if sid is None:
+            with self._ckpt_lock:
+                sid = self._journaled_ckpts.get(src_rid)
+        return self._verified_resume_checkpoint(src_rid, sid)
+
+    def _materialize_clone(self, rid: int, src_rid: int) -> Optional[str]:
+        """Copy the clone source's checkpoint into trial ``rid``'s
+        namespace (same uuid) THROUGH the storage manager — never by local
+        path arithmetic, so shared-fs and cloud layouts behave alike — and
+        journal the provenance.  Returns the uuid to resume from, or None
+        (the child then starts from scratch, which is degraded but legal:
+        a GC'd or corrupt parent must not kill the search)."""
+        from determined_tpu.storage import from_string
+
+        with get_tracer().span(
+            "trial.clone", cat="searcher", trial=rid, source=src_rid
+        ):
+            sid = self._clone_source_checkpoint(src_rid)
+            if sid is None:
+                logger.warning(
+                    "trial %d: exploit source trial %d has no usable "
+                    "checkpoint; the child starts from scratch",
+                    rid, src_rid,
+                )
+                return None
+            dst = os.path.join(self._trial_checkpoint_dir(rid), sid)
+            steps = 0
+            try:
+                manager = from_string(self.checkpoint_dir)
+                with tempfile.TemporaryDirectory(prefix="dtpu-clone-") as staging:
+                    local = os.path.join(staging, sid)
+                    if os.path.isdir(dst) and self._clone_dir_usable(dst):
+                        local = dst  # already materialized (resume re-run)
+                    else:
+                        # a dir that exists but fails verification is a
+                        # half-written copy from a crash mid-materialize:
+                        # re-copy rather than resume the child from poison
+                        if os.path.isdir(dst):
+                            import shutil
+
+                            shutil.rmtree(dst, ignore_errors=True)
+                        manager.download(f"trial_{src_rid}/{sid}", local)
+                        manager.upload(local, f"trial_{rid}/{sid}")
+                    try:
+                        with open(os.path.join(local, "metadata.json")) as f:
+                            steps = int(json.load(f).get("steps_completed") or 0)
+                    except (OSError, ValueError, TypeError):
+                        steps = 0
+            except Exception:  # noqa: BLE001 - degrade to fresh init
+                logger.exception(
+                    "trial %d: failed to materialize clone of trial %d "
+                    "checkpoint %s; the child starts from scratch",
+                    rid, src_rid, sid,
+                )
+                return None
+            with self._ckpt_lock:
+                self._clone_base_steps[rid] = steps
+                already = self._journaled_ckpts.get(rid) == sid
+                self._journaled_ckpts[rid] = sid
+            if self.journal is not None and not already:
+                self.journal.append(
+                    "trial_cloned", rid=rid, source=src_rid, uuid=sid, steps=steps
+                )
+            get_tracer().counter("searcher.clones_materialized", 1.0)
+            logger.info(
+                "trial %d: cloned from trial %d checkpoint %s (step %d)",
+                rid, src_rid, sid, steps,
+            )
+            return sid
+
+    def _clone_dir_usable(self, path: str) -> bool:
+        """Manifest-verify an already-materialized clone, same contract as
+        the resume paths (a crash mid-copy leaves a manifest-less or
+        digest-failing dir)."""
+        if not self.config.fault_tolerance.verify_checkpoints:
+            return True
+        from determined_tpu.core._checkpoint import verify_manifest
+        from determined_tpu.utils.errors import CheckpointCorruptError
+
+        try:
+            verify_manifest(path, require_manifest=True)
+            return True
+        except CheckpointCorruptError as e:
+            logger.warning("clone at %s unusable (%s); re-copying", path, e)
+            return False
+
+    def _clone_extended_length(self, max_length: Length, rid: int) -> Length:
+        from determined_tpu.config.experiment import clone_extended_length
+
+        with self._ckpt_lock:
+            base = self._clone_base_steps.get(rid)
+        return clone_extended_length(
+            max_length, base or 0, logger, context=f"trial {rid}: "
+        )
+
     @staticmethod
     def _checkpoint_parent(path: str) -> Optional[str]:
         from determined_tpu.core._checkpoint import MANIFEST_FILE, METADATA_FILE
@@ -769,13 +894,14 @@ class LocalExperiment:
     # -- journal helpers ---------------------------------------------------
 
     def _journal_trial_checkpoint(self, rid: int, sid: Optional[str]) -> None:
-        if self.journal is None or not sid:
+        if not sid:
             return
         with self._ckpt_lock:
             if self._journaled_ckpts.get(rid) == sid:
                 return
             self._journaled_ckpts[rid] = sid
-        self.journal.append("trial_checkpoint", rid=rid, uuid=sid)
+        if self.journal is not None:
+            self.journal.append("trial_checkpoint", rid=rid, uuid=sid)
 
     def _schedule_gc_retention(self) -> None:
         """Journal on_compact hook.  The hook can fire on a thread that
@@ -824,6 +950,9 @@ class LocalExperiment:
                 ),
                 metric_by_trial=metric_by_trial,
                 protected=protected,
+                # live PBT clone sources: a current-generation member's
+                # checkpoint may be exploit-cloned at the next turnover
+                protected_trials=set(self.searcher.clone_source_trials()),
             )
             if outcome["deleted"]:
                 logger.info(
@@ -856,7 +985,8 @@ class LocalExperiment:
             # an explicit device grant binds the serial path too, not just
             # the packed scheduler
             result = self._run_trial(
-                Create(rec.request_id, rec.hparams), devices=self.devices
+                Create(rec.request_id, rec.hparams, rec.source_trial_id),
+                devices=self.devices,
             )
             if result.preempted:
                 # drained, not done: the trial stays in-flight, its

@@ -316,7 +316,9 @@ class TrialScheduler:
                         tracer.instant(
                             "slot.backfill", "scheduler", rid=rec.request_id
                         )
-                    create = Create(rec.request_id, rec.hparams)
+                    create = Create(
+                        rec.request_id, rec.hparams, rec.source_trial_id
+                    )
                     thread = threading.Thread(
                         target=self._worker,
                         args=(create, alloc),

@@ -85,7 +85,14 @@ class MnistTrial(JaxTrial):
 
     def build_optimizer(self) -> optax.GradientTransformation:
         lr = float(self.context.get_hparam("lr", 1e-3))
-        return optax.adam(lr)
+        # inject_hyperparams moves lr into opt_state (read by the traced
+        # step at run time) instead of baking it into the HLO: searches
+        # that vary ONLY lr — random/ASHA draws, PBT perturbations —
+        # share one compiled step through train/_jit_cache.py
+        return optax.inject_hyperparams(optax.adam)(learning_rate=lr)
+
+    def compile_cache_runtime_hparams(self) -> Tuple[str, ...]:
+        return ("lr",)
 
     def _dataset(self, train: bool):
         size = int(self.context.get_hparam("dataset_size", 4096))

@@ -1,4 +1,5 @@
-"""Hyperparameter search: single/random/grid/ASHA/adaptive-ASHA + simulation."""
+"""Hyperparameter search: single/random/grid/ASHA/adaptive-ASHA/Hyperband/PBT
++ a trial-free simulation harness (searcher/simulate.py)."""
 
 from determined_tpu.searcher._base import (
     Action,
@@ -19,8 +20,32 @@ from determined_tpu.searcher._searcher import (
 from determined_tpu.searcher.adaptive import TournamentSearch, make_adaptive_asha
 from determined_tpu.searcher.asha import ASHASearch
 from determined_tpu.searcher.methods import GridSearch, RandomSearch, SingleSearch
+from determined_tpu.searcher._hyperband import Bracket, HyperbandSearch, hyperband_brackets
+from determined_tpu.searcher._pbt import PBTSearch, perturb_hparams
+from determined_tpu.searcher.simulate import (
+    JournalCurveModel,
+    SimulationReport,
+    SyntheticCurveModel,
+    compare_methods,
+    format_comparison,
+    simulate_method,
+)
+# importing the simulate SUBMODULE above rebinds the package attribute
+# ``simulate`` to the module; the public name stays the legacy function
+from determined_tpu.searcher._searcher import simulate  # noqa: E402,F811
 
 __all__ = [
+    "Bracket",
+    "HyperbandSearch",
+    "hyperband_brackets",
+    "PBTSearch",
+    "perturb_hparams",
+    "JournalCurveModel",
+    "SimulationReport",
+    "SyntheticCurveModel",
+    "compare_methods",
+    "format_comparison",
+    "simulate_method",
     "Action",
     "Create",
     "ExitedReason",

@@ -24,6 +24,10 @@ RequestID = int
 class Create:
     request_id: RequestID
     hparams: Dict[str, Any]
+    # PBT exploit provenance: clone the new trial's initial state from the
+    # named trial's newest usable checkpoint (the driver resolves the uuid
+    # through the manifest lineage walk; the searcher only names the trial)
+    source_trial_id: Optional[RequestID] = None
 
 
 @dataclasses.dataclass
@@ -72,8 +76,16 @@ class SearcherContext:
     def sample(self) -> Dict[str, Any]:
         return sample_hyperparameters(self.hparams, self.rand)
 
-    def create(self, hparams: Optional[Dict[str, Any]] = None) -> Create:
-        return Create(self.next_request_id(), hparams if hparams is not None else self.sample())
+    def create(
+        self,
+        hparams: Optional[Dict[str, Any]] = None,
+        source_trial_id: Optional[RequestID] = None,
+    ) -> Create:
+        return Create(
+            self.next_request_id(),
+            hparams if hparams is not None else self.sample(),
+            source_trial_id,
+        )
 
 
 class SearchMethod(abc.ABC):
@@ -107,6 +119,16 @@ class SearchMethod(abc.ABC):
         trials_closed: Dict[RequestID, bool],
     ) -> float:
         ...
+
+    def clone_source_trials(self) -> List[RequestID]:
+        """Trials whose checkpoints are LIVE clone sources.
+
+        A method that clones from checkpoints (PBT exploit) names here
+        every trial a future ``Create.source_trial_id`` may still point
+        at; checkpoint GC must not delete those trials' latest checkpoints
+        mid-generation even when top-k-by-metric retention would.
+        """
+        return []
 
     # snapshot/restore (reference Snapshot/Restore json round-trip)
     def state_dict(self) -> Dict[str, Any]:

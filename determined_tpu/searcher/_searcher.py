@@ -25,6 +25,8 @@ from determined_tpu.searcher._base import (
 from determined_tpu.searcher.adaptive import make_adaptive_asha
 from determined_tpu.searcher.asha import ASHASearch
 from determined_tpu.searcher.methods import GridSearch, RandomSearch, SingleSearch
+from determined_tpu.searcher._hyperband import HyperbandSearch
+from determined_tpu.searcher._pbt import PBTSearch
 
 
 def method_from_config(
@@ -63,6 +65,27 @@ def method_from_config(
             max_trials=cfg.max_trials,
             max_concurrent_trials=cfg.max_concurrent_trials,
         )
+    if cfg.name == "hyperband":
+        return HyperbandSearch(
+            metric=cfg.metric,
+            smaller_is_better=cfg.smaller_is_better,
+            max_time=max_time or 100,
+            time_metric=cfg.time_metric or "batches",
+            divisor=cfg.divisor,
+            max_trials=cfg.max_trials,
+            max_concurrent_trials=cfg.max_concurrent_trials,
+        )
+    if cfg.name == "pbt":
+        return PBTSearch(
+            metric=cfg.metric,
+            smaller_is_better=cfg.smaller_is_better,
+            population_size=cfg.population_size or max(cfg.max_trials, 2),
+            num_generations=cfg.num_generations,
+            truncate_fraction=cfg.truncate_fraction,
+            perturb_factor=cfg.perturb_factor,
+            resample_probability=cfg.resample_probability,
+            time_metric=cfg.time_metric or "batches",
+        )
     if cfg.name == "adaptive_asha":
         return make_adaptive_asha(
             metric=cfg.metric,
@@ -87,6 +110,9 @@ class TrialRecord:
     stopped_by_searcher: bool = False
     exited: bool = False
     metrics: Optional[Dict[str, Any]] = None  # last validation
+    # clone provenance (PBT exploit): initial state comes from this trial's
+    # newest usable checkpoint instead of a fresh init
+    source_trial_id: Optional[RequestID] = None
 
 
 class Searcher:
@@ -116,7 +142,9 @@ class Searcher:
     def _absorb(self, actions: List[Action]) -> List[Action]:
         for a in actions:
             if isinstance(a, Create):
-                self.trials[a.request_id] = TrialRecord(a.request_id, a.hparams)
+                self.trials[a.request_id] = TrialRecord(
+                    a.request_id, a.hparams, source_trial_id=a.source_trial_id
+                )
             elif isinstance(a, Stop):
                 if a.request_id in self.trials:
                     self.trials[a.request_id].stopped_by_searcher = True
@@ -197,6 +225,19 @@ class Searcher:
             rec = self.trials.get(request_id)
             return bool(rec is not None and rec.stopped_by_searcher)
 
+    def clone_source_trials(self) -> List[RequestID]:
+        """Trials whose latest checkpoints are live clone sources: the
+        method's own candidates (PBT's current population) plus the named
+        source of every trial that has not finished cloning from it yet.
+        Checkpoint GC must keep these even when metric-ranked retention
+        would rotate them out."""
+        with self._lock:
+            out = set(self.method.clone_source_trials())
+            for rec in self.trials.values():
+                if rec.source_trial_id is not None and not rec.exited:
+                    out.add(rec.source_trial_id)
+            return sorted(out)
+
     # -- snapshot ----------------------------------------------------------
 
     def state_json(self) -> str:
@@ -254,50 +295,26 @@ def simulate(
     """Run a whole search synchronously against a synthetic trial function.
 
     ``trial_fn(hparams, time_step) -> metric`` models a trial's validation
-    metric at a given step.  Trials validate every ``report_period`` units
-    (default: each rung boundary granularity = max_time / divisor**k).
-    Returns summary: trials created, units spent, best metric.
+    metric at a given step.  Back-compat wrapper over the full harness in
+    ``searcher/simulate.py`` (curve models, clone inheritance,
+    best-vs-budget reports); clone-based methods see ``time_step`` as the
+    trial's EFFECTIVE units including inherited progress.
 
     Reference: ``master/pkg/searcher/simulate.go:65``.
     """
-    scfg = config.searcher
-    method = method_from_config(scfg, config.hyperparameters)
-    searcher = Searcher(method, config.hyperparameters, seed)
-    max_time = scfg.max_time or (scfg.max_length.units if scfg.max_length else 100)
-    period = report_period or max(max_time // (scfg.divisor ** (scfg.num_rungs - 1)), 1)
-    period = int(period)
+    from determined_tpu.searcher.simulate import simulate_method
 
-    searcher.start()
-    total_units = 0
-    best: Optional[float] = None
-    better = (lambda a, b: a < b) if scfg.smaller_is_better else (lambda a, b: a > b)
-    # round-robin: each running trial advances one period per pass
-    trial_steps: Dict[RequestID, int] = {}
-    guard = 0
-    while searcher.shutdown is None and guard < 100_000:
-        guard += 1
-        running = [t for t in searcher.trials.values() if t.running]
-        if not running:
-            break
-        for rec in running:
-            if searcher.shutdown is not None:
-                break
-            step = trial_steps.get(rec.request_id, 0) + period
-            trial_steps[rec.request_id] = step
-            total_units += period
-            metric = trial_fn(rec.hparams, step)
-            if best is None or better(metric, best):
-                best = metric
-            searcher.on_validation(
-                rec.request_id,
-                {scfg.metric: metric, scfg.time_metric or "batches": step},
-            )
-            if rec.stopped_by_searcher or step >= max_time:
-                searcher.on_trial_exited(rec.request_id)
+    class _FnModel:
+        def metric(self, hparams: Dict[str, Any], units: float) -> float:
+            return trial_fn(hparams, int(units))
+
+    report = simulate_method(
+        config, _FnModel(), seed=seed, report_period=report_period
+    )
     return {
-        "trials_created": len(searcher.trials),
-        "total_units": total_units,
-        "best_metric": best,
-        "max_time": max_time,
-        "trial_units": dict(trial_steps),
+        "trials_created": report.trials_created,
+        "total_units": report.total_units,
+        "best_metric": report.best_metric,
+        "max_time": report.max_time,
+        "trial_units": dict(report.trial_units),
     }

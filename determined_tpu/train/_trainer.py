@@ -747,8 +747,20 @@ class Trainer:
                 "rng": self.state.rng,
             }
         )
+        fresh_opt_state = self.state.opt_state
         restored = serialization.restore_arrays(path, abstract)
         self.state = self.state.replace(**restored).reset_metrics()
+        # declared-runtime hyperparameters (compile_cache_runtime_hparams,
+        # e.g. an inject_hyperparams lr) live in opt_state, so a restore
+        # would resurrect the CHECKPOINT's values — correct for a crash
+        # resume (same hparams), wrong for a PBT clone whose explore step
+        # just perturbed them.  The trial's own hparams are authoritative:
+        # graft the freshly-built hyperparams back over the restored tree.
+        self.state = self.state.replace(
+            opt_state=self._reinject_runtime_hparams(
+                fresh_opt_state, self.state.opt_state
+            )
+        )
         tstate = serialization.load_trainer_state(path)
         self.steps_completed = int(tstate["steps_completed"])
         self.train_loader.load_state_dict(tstate["train_loader"])
@@ -757,6 +769,32 @@ class Trainer:
         self.best_validation = tstate.get("best_validation")
         for cb in self.callbacks.values():
             cb.on_checkpoint_load(path)
+
+    def _reinject_runtime_hparams(self, fresh: Any, restored: Any) -> Any:
+        """Replace ``hyperparams`` nodes (optax ``InjectHyperparamsState``)
+        in a restored opt_state with the freshly-initialized ones, which
+        were built from THIS trial's hparams.  No-op unless the trial
+        declares runtime hparams."""
+        runtime = getattr(self.trial, "compile_cache_runtime_hparams", tuple)() or ()
+        if not runtime:
+            return restored
+
+        def graft(f: Any, r: Any) -> Any:
+            if type(f) is not type(r):
+                return r
+            if hasattr(r, "hyperparams") and hasattr(r, "_replace"):
+                out = r._replace(hyperparams=f.hyperparams)
+                if hasattr(r, "inner_state"):
+                    out = out._replace(inner_state=graft(f.inner_state, r.inner_state))
+                return out
+            if isinstance(r, (tuple, list)) and len(f) == len(r):
+                parts = [graft(a, b) for a, b in zip(f, r)]
+                if hasattr(r, "_fields"):  # other namedtuple states
+                    return type(r)(*parts)
+                return type(r)(parts) if isinstance(r, list) else tuple(parts)
+            return r
+
+        return graft(fresh, restored)
 
     # -- validation --------------------------------------------------------
 

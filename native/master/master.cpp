@@ -107,6 +107,11 @@ struct TrialState {
   std::string state = "PENDING";  // PENDING/RUNNING/COMPLETED/ERROR/STOPPED
   int restarts = 0;
   std::string latest_checkpoint;
+  // PBT exploit clone: steps already inside the seeded source checkpoint.
+  // The harness extends its training horizon by this much (the budget is
+  // the generation length BEYOND the inherited state), via
+  // DTPU_WARM_START_STEPS on every allocation of this trial.
+  int64_t warm_start_steps = 0;
   std::string allocation_id;
   int64_t run_id = 0;
   bool stop_requested = false;   // searcher decided to stop it
@@ -623,7 +628,8 @@ class Master {
       do_trial_restarted(ev["trial_id"].as_int());
     } else if (type == "driver_trial") {
       do_driver_create_trial(ev["experiment_id"].as_int(), ev["request_id"].as_int(),
-                             ev["hparams"], ev["trial_id"].as_int());
+                             ev["hparams"], ev["trial_id"].as_int(),
+                             ev["source_checkpoint"].as_string());
     } else if (type == "trial_stop") {
       do_trial_stop(ev["trial_id"].as_int());
     } else if (type == "searcher_shutdown") {
@@ -956,6 +962,7 @@ class Master {
       j.set("state", t.state);
       j.set("restarts", Json(static_cast<int64_t>(t.restarts)));
       j.set("latest_checkpoint", t.latest_checkpoint);
+      j.set("warm_start_steps", Json(t.warm_start_steps));
       j.set("run_id", Json(t.run_id));
       j.set("stop_requested", Json(t.stop_requested));
       Json vals = Json::object();
@@ -1087,6 +1094,7 @@ class Master {
       t.state = tj["state"].as_string();
       t.restarts = static_cast<int>(tj["restarts"].as_int(0));
       t.latest_checkpoint = tj["latest_checkpoint"].as_string();
+      t.warm_start_steps = tj["warm_start_steps"].as_int(0);
       t.run_id = tj["run_id"].as_int(0);
       t.stop_requested = tj["stop_requested"].as_bool(false);
       for (const auto& [step, metric] : tj["val_by_step"].items()) {
@@ -1626,7 +1634,8 @@ class Master {
   // ``forced_tid`` replays the id the live path assigned, keeping
   // checkpoint/metric records attached across a master restart.
   int64_t do_driver_create_trial(int64_t exp_id, int64_t request_id,
-                                 const Json& hparams, int64_t forced_tid = 0) {
+                                 const Json& hparams, int64_t forced_tid = 0,
+                                 const std::string& source_checkpoint = "") {
     auto eit = experiments_.find(exp_id);
     if (eit == experiments_.end()) return 0;
     ExperimentState& exp = eit->second;
@@ -1639,6 +1648,20 @@ class Master {
     t.experiment_id = exp_id;
     t.request_id = request_id;
     t.hparams = hparams;
+    // PBT exploit clone: seed the trial's resume point with the driver-
+    // named source checkpoint, the same way experiment fork/warm-start
+    // seeds trials — the allocation then starts with
+    // DTPU_LATEST_CHECKPOINT and restores THROUGH the shared checkpoint
+    // storage, never a driver-local path.  The inherited step count rides
+    // along so the harness can extend the child's horizon (its budget is
+    // the generation length BEYOND the restored state).
+    if (!source_checkpoint.empty()) {
+      t.latest_checkpoint = source_checkpoint;
+      auto cit = checkpoints_.find(source_checkpoint);
+      if (cit != checkpoints_.end()) {
+        t.warm_start_steps = cit->second["metadata"]["steps_completed"].as_int(0);
+      }
+    }
     trials_[tid] = t;
     exp.rid_to_trial[request_id] = tid;
     auto actions = exp.method->trial_created(*exp.ctx, request_id);
@@ -1958,6 +1981,9 @@ class Master {
                 exp.config["reproducibility"]["experiment_seed"].as_int(0) + tid));
     env.set("DTPU_TRIAL_RUN_ID", std::to_string(t.run_id));
     env.set("DTPU_NUM_SLOTS", std::to_string(exp.slots_per_trial));
+    if (t.warm_start_steps > 0) {
+      env.set("DTPU_WARM_START_STEPS", std::to_string(t.warm_start_steps));
+    }
     if (!t.latest_checkpoint.empty()) {
       env.set("DTPU_LATEST_CHECKPOINT", t.latest_checkpoint);
     }
@@ -2213,6 +2239,9 @@ class Master {
             exp.config["reproducibility"]["experiment_seed"].as_int(0) + tid));
         env.set("DTPU_TRIAL_RUN_ID", std::to_string(t.run_id));
         env.set("DTPU_NUM_SLOTS", std::to_string(slots));
+        if (t.warm_start_steps > 0) {
+          env.set("DTPU_WARM_START_STEPS", std::to_string(t.warm_start_steps));
+        }
         if (!t.latest_checkpoint.empty()) {
           env.set("DTPU_LATEST_CHECKPOINT", t.latest_checkpoint);
         }
@@ -4423,12 +4452,14 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       out.set("existing", Json(true));
       return R::json(out.dump());
     }
-    int64_t tid = m.do_driver_create_trial(eid, rid, body["hparams"]);
+    std::string source_ckpt = body["source_checkpoint"].as_string();
+    int64_t tid = m.do_driver_create_trial(eid, rid, body["hparams"], 0, source_ckpt);
     m.record(Json::object()
                  .set("type", "driver_trial")
                  .set("experiment_id", Json(eid))
                  .set("request_id", Json(rid))
                  .set("hparams", body["hparams"])
+                 .set("source_checkpoint", source_ckpt)
                  .set("trial_id", Json(tid)));
     m.schedule();
     Json out = Json::object();

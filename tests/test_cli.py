@@ -141,6 +141,68 @@ def test_cli_experiment_lifecycle(cluster, tmp_path, capsys):
 
 
 @needs_cluster
+def test_cli_searcher_simulate_all_methods_deterministic(capsys):
+    """`dtpu searcher simulate` exits 0 and prints an identical
+    best-vs-budget table for all four methods on repeat runs (the
+    acceptance gate for the trial-free harness)."""
+    assert run_cli("searcher", "simulate", "--seed", "7") == 0
+    first = capsys.readouterr().out
+    for name in ("random", "asha", "hyperband", "pbt"):
+        assert name in first
+    assert run_cli("searcher", "simulate", "--seed", "7") == 0
+    assert capsys.readouterr().out == first
+
+
+def test_cli_searcher_simulate_config_json_and_journal(tmp_path, capsys):
+    cfg = {
+        "hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -1}},
+        "searcher": {
+            "name": "random",
+            "metric": "loss",
+            "max_trials": 4,
+            "max_length": {"batches": 16},
+            "num_rungs": 2,
+            "divisor": 4,
+        },
+    }
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    rc = run_cli("searcher", "simulate", "-c", str(p), "--methods",
+                 "random,pbt", "--json")
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert [r["method"] for r in out] == ["random", "pbt"]
+    assert all(r["best_metric"] is not None for r in out)
+    # PBT children carry lineage in the report
+    assert out[1]["lineage"]
+
+    # recorded-curve replay: lift curves from a real experiment journal
+    from determined_tpu.experiment import ExperimentJournal, journal_path
+
+    ckdir = tmp_path / "exp"
+    ckdir.mkdir()
+    j = ExperimentJournal(journal_path(str(ckdir))).open(fresh=True)
+    j.append("trial_created", rid=1, hparams={"lr": 0.01})
+    for step in (4, 8, 16):
+        j.append("trial_validated", rid=1,
+                 metrics={"loss": 1.0 / step, "batches": step})
+    j.close()
+    rc = run_cli("searcher", "simulate", "-c", str(p), "--methods", "random",
+                 "--journal", str(ckdir))
+    assert rc == 0
+    assert "random" in capsys.readouterr().out
+
+    # a journal with no validations is a clean error exit, not a traceback
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    j = ExperimentJournal(journal_path(str(empty))).open(fresh=True)
+    j.append("experiment_started", name="x")
+    j.close()
+    rc = run_cli("searcher", "simulate", "-c", str(p), "--journal", str(empty))
+    assert rc == 2
+    assert "error:" in capsys.readouterr().err
+
+
 def test_cli_preview_search(tmp_path, capsys):
     cfg = {
         "hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -1}},

@@ -419,6 +419,60 @@ def test_cluster_driver_crash_resume(tmp_path):
         fake.close()
 
 
+def test_cluster_pbt_clone_submits_source_checkpoint(tmp_path):
+    """PBT through the cluster driver: a generation-2 create names its
+    exploit parent, and the submission carries the parent's master-known
+    checkpoint uuid — the clone resolves through shared checkpoint
+    storage (DTPU_LATEST_CHECKPOINT), never a driver-local path."""
+    config = ExperimentConfig.parse(
+        {
+            "name": "cluster-pbt",
+            "entrypoint": "determined_tpu.models.mnist:MnistTrial",
+            "hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -1}},
+            "searcher": {
+                "name": "pbt",
+                "metric": "validation_loss",
+                "population_size": 3,
+                "num_generations": 2,
+                "truncate_fraction": 0.34,
+                "max_time": 4,
+                "time_metric": "batches",
+            },
+            "resources": {"slots_per_trial": 1},
+        }
+    )
+    fake = FakeMaster(trial_plan=_loss_plan)
+    try:
+        exp = _driver(config, fake.url, tmp_path)
+        summary = exp.run()
+    finally:
+        fake.close()
+
+    assert summary["status"] == "completed"
+    assert summary["trials"] == 6  # 3 members x 2 generations
+    by_rid = {c["request_id"]: c for c in fake.create_calls}
+    gen1 = [c for c in fake.create_calls if "source_checkpoint" not in c]
+    gen2 = [c for c in fake.create_calls if "source_checkpoint" in c]
+    assert len(gen1) == 3 and len(gen2) == 3
+    lineage = exp.searcher.method.lineage
+    for call in gen2:
+        src = lineage[call["request_id"]]
+        src_tid = fake.rid_to_tid[src]
+        # the parent's newest master-known checkpoint
+        n = len(fake.trials[src_tid].revealed)
+        assert call["source_checkpoint"] == f"ckpt-{src_tid}-{n}"
+    # and the journal recorded the clone provenance on the creates
+    replay = read_journal(journal_path(str(tmp_path / "driver")))
+    for call in gen2:
+        rid = call["request_id"]
+        assert rid in by_rid
+        created = [
+            r for r in replay.records
+            if r.get("type") == "trial_created" and r.get("rid") == rid
+        ]
+        assert created and created[0].get("source_trial_id") == lineage[rid]
+
+
 def test_cluster_single_slice_preflight(tmp_path):
     """A single_slice gang bigger than every registered host fails fast,
     driver-side, before anything is submitted or journaled."""

@@ -11,6 +11,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# slow: UNet compiles + a sampling loop (~90s on the 2-core verify box);
+# example-model e2e belongs to the full suite, not the tier-1 window
+pytestmark = pytest.mark.slow
+
 from determined_tpu import core, train
 from determined_tpu.config import Length
 from determined_tpu.models.diffusion import (

@@ -209,6 +209,26 @@ def test_journal_compaction_preserves_state_and_fires_hook(tmp_path):
     assert replay.searcher_state is not None
 
 
+def test_journal_clone_records_survive_compaction(tmp_path):
+    """``trial_cloned`` provenance (PBT exploit) must outlive compaction:
+    a resumed child re-derives its budget horizon from it."""
+    path = str(tmp_path / "experiment.journal")
+    j = ExperimentJournal(path, compact_interval=6).open(fresh=True)
+    j.append("experiment_started", name="x", seed=0)
+    j.append("trial_created", rid=4, hparams={"lr": 0.1}, source_trial_id=1)
+    j.append("trial_cloned", rid=4, source=1, uuid="u-parent", steps=8)
+    for i in range(8):
+        j.append("trial_validated", rid=4, metrics={"loss": float(i)})
+        j.append("searcher_snapshot", state={"i": i})
+    j.close()
+
+    replay = read_journal(path)
+    assert len(replay.records) < 10  # compacted
+    assert replay.clones == {4: {"source": 1, "uuid": "u-parent", "steps": 8}}
+    # the materialized clone counts as the child's first resume point
+    assert replay.checkpoints[4] == "u-parent"
+
+
 def test_journal_reopen_appends_preserve_history(tmp_path):
     path = str(tmp_path / "experiment.journal")
     j = ExperimentJournal(path).open(fresh=True)

@@ -83,6 +83,46 @@ def test_plan_protected_uuids_survive_rotation():
     assert delete == {"a2"}
 
 
+def test_plan_protected_trials_keep_live_clone_sources():
+    """Regression (PBT): a current-generation population member not in the
+    metric top-k used to lose its only checkpoint to top-k retention
+    mid-generation — exactly when the next turnover may exploit-clone it."""
+    cks = [ci("a1", 1, 8), ci("b1", 2, 8), ci("c0", 3, 4), ci("c1", 3, 8)]
+    policy = RetentionPolicy(
+        keep_trial_latest=0, keep_experiment_best=1, smaller_is_better=True
+    )
+    metric = {1: 0.1, 2: 0.5, 3: 0.9}
+    # without protection, only the best trial's checkpoint survives
+    keep, delete = plan_retention(cks, policy, metric_by_trial=metric)
+    assert keep == {"a1"} and delete == {"b1", "c0", "c1"}
+    # trials 2 and 3 are live clone sources: their LATEST survive
+    keep, delete = plan_retention(
+        cks, policy, metric_by_trial=metric, protected_trials={2, 3}
+    )
+    assert keep == {"a1", "b1", "c1"}
+    assert delete == {"c0"}
+
+
+def test_apply_retention_deletes_clone_shared_uuid_everywhere(tmp_path):
+    """A materialized PBT clone shares its uuid across two trial dirs; the
+    pair is kept or deleted as a unit (no half-deleted clone)."""
+    base = str(tmp_path)
+    _write_ckpt(base, 1, "p1", 4)
+    _write_ckpt(base, 2, "p1", 4)           # the clone in the child's dir
+    _write_ckpt(base, 1, "p2", 8, parent="p1")
+    _write_ckpt(base, 2, "c2", 8, parent="p1")
+    # p1 is each trial's older checkpoint but it is p2/c2's lineage parent
+    out = apply_retention(base, RetentionPolicy(keep_trial_latest=1))
+    assert out["deleted"] == []
+    # drop the parent protection by making newer orphan checkpoints
+    _write_ckpt(base, 1, "p3", 12, parent="p2")
+    _write_ckpt(base, 2, "c3", 12, parent="c2")
+    out = apply_retention(base, RetentionPolicy(keep_trial_latest=1))
+    assert sorted(out["deleted"]) == ["p1", "p1"]
+    assert not os.path.exists(os.path.join(base, "trial_1", "p1"))
+    assert not os.path.exists(os.path.join(base, "trial_2", "p1"))
+
+
 def test_plan_zero_keep_rejects_negative():
     with pytest.raises(ValueError):
         RetentionPolicy(keep_trial_latest=-1)

@@ -1,0 +1,620 @@
+"""PBT + Hyperband searchers: method semantics, clone provenance, the
+trial-free simulator, and end-to-end clone-resume over the journal.
+
+Modeled on the reference's searcher unit tests plus our recovery suite's
+crash/resume oracles (``test_experiment_recovery.py``).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.no_thread_leaks, pytest.mark.lock_order]
+
+from determined_tpu.config import ExperimentConfig, parse_hyperparameters
+from determined_tpu.experiment import (
+    LocalExperiment,
+    experiment_status,
+    journal_path,
+    read_journal,
+)
+from determined_tpu.models.mnist import MnistTrial
+from determined_tpu.searcher import (
+    Create,
+    HyperbandSearch,
+    PBTSearch,
+    Searcher,
+    Shutdown,
+    SyntheticCurveModel,
+    compare_methods,
+    hyperband_brackets,
+    method_from_config,
+    perturb_hparams,
+    simulate_method,
+)
+from tests.faults import FaultInjector, SimulatedCrash
+
+HPARAMS = {
+    "lr": {"type": "log", "minval": -4, "maxval": -1},
+    "units": 64,
+    "act": {"type": "categorical", "vals": ["relu", "gelu"]},
+}
+
+
+def space():
+    return parse_hyperparameters(HPARAMS)
+
+
+# ---------------------------------------------------------------------------
+# explore (perturb/resample)
+# ---------------------------------------------------------------------------
+
+
+def test_perturb_is_deterministic_and_clamped():
+    hp = {"lr": 1.1e-4, "units": 64, "act": "relu"}
+    out1 = perturb_hparams(space(), hp, np.random.default_rng(5))
+    out2 = perturb_hparams(space(), hp, np.random.default_rng(5))
+    assert out1 == out2
+    rng = np.random.default_rng(5)
+    for _ in range(200):
+        out = perturb_hparams(space(), hp, rng)
+        assert 1e-4 <= out["lr"] <= 1e-1  # clamped into the log range
+        assert out["units"] == 64         # Const can only resample to itself
+        assert out["act"] in ("relu", "gelu")
+
+
+def test_perturb_moves_numeric_hps_multiplicatively():
+    hp = {"lr": 1e-2, "units": 64, "act": "relu"}
+    rng = np.random.default_rng(0)
+    # with resampling off, lr must move by exactly the factor (or clamp)
+    moved = [
+        perturb_hparams(space(), hp, rng, resample_probability=0.0)["lr"]
+        for _ in range(32)
+    ]
+    for v in moved:
+        assert v == pytest.approx(1e-2 * 1.2) or v == pytest.approx(1e-2 / 1.2)
+    assert len({round(v, 9) for v in moved}) == 2  # both directions happen
+
+
+# ---------------------------------------------------------------------------
+# PBT method semantics
+# ---------------------------------------------------------------------------
+
+
+def _drive_generation(searcher, loss_of, max_time=4, period=2):
+    """Validate + exit every running trial (one generation's worth)."""
+    for rec in sorted(searcher.runnable_trials(), key=lambda t: t.request_id):
+        step = 0
+        while step < max_time:
+            step += period
+            searcher.on_validation(
+                rec.request_id,
+                {"loss": loss_of(rec), "batches": step},
+            )
+        searcher.on_trial_exited(rec.request_id)
+
+
+def test_pbt_generations_exploit_and_lineage():
+    method = PBTSearch(
+        metric="loss", population_size=4, num_generations=3,
+        truncate_fraction=0.25,
+    )
+    searcher = Searcher(method, space(), seed=11)
+    creates = searcher.start()
+    assert len(creates) == 4
+    gen1 = [a.request_id for a in creates]
+
+    _drive_generation(searcher, lambda rec: float(rec.request_id))  # rid 1 best
+    assert method.generation == 1
+    gen2 = [m["rid"] for m in method.members]
+    assert len(gen2) == 4 and set(gen2).isdisjoint(gen1)
+    # k = 1: the worst member (rid 4) was replaced by a clone of the best
+    sources = {rid: searcher.trials[rid].source_trial_id for rid in gen2}
+    assert sorted(sources.values()) == [1, 1, 2, 3]
+    survivors = [rid for rid in gen2 if sources[rid] in (2, 3)]
+    for rid in survivors:
+        # survivors continue with UNCHANGED hparams from their own ckpt
+        assert searcher.trials[rid].hparams == searcher.trials[sources[rid]].hparams
+    exploited = [rid for rid in gen2 if method.lineage[rid] == 1
+                 and searcher.trials[rid].hparams != searcher.trials[1].hparams]
+    assert exploited, "no exploited child explored away from its parent"
+    # every current member and the whole previous generation are live
+    # clone sources for GC
+    assert set(searcher.clone_source_trials()) == set(gen1) | set(gen2)
+
+    _drive_generation(searcher, lambda rec: float(rec.request_id))
+    assert method.generation == 2
+    out = []
+    for rec in sorted(searcher.runnable_trials(), key=lambda t: t.request_id):
+        searcher.on_validation(rec.request_id, {"loss": 1.0, "batches": 4})
+        out.extend(searcher.on_trial_exited(rec.request_id))
+    assert any(isinstance(a, Shutdown) for a in out)
+    assert searcher.progress() == 1.0
+
+
+def test_pbt_errored_member_is_never_an_exploit_source():
+    method = PBTSearch(metric="loss", population_size=3, num_generations=2,
+                       truncate_fraction=0.34)
+    searcher = Searcher(method, space(), seed=2)
+    creates = searcher.start()
+    rids = [a.request_id for a in creates]
+    # rid[0] errors before reporting anything; others report good metrics
+    searcher.on_trial_exited_early(rids[0], "errored")
+    searcher.on_validation(rids[1], {"loss": 0.5, "batches": 4})
+    searcher.on_trial_exited(rids[1])
+    searcher.on_validation(rids[2], {"loss": 0.7, "batches": 4})
+    searcher.on_trial_exited(rids[2])
+    next_sources = {
+        rec.source_trial_id for rec in searcher.runnable_trials()
+    }
+    assert rids[0] not in next_sources  # metric-less member ranks worst
+    assert rids[1] in next_sources      # the best member is the clone source
+
+
+def test_pbt_zero_truncate_fraction_is_pure_continuation():
+    """truncate_fraction=0 must replace NOBODY: every member continues
+    from its own checkpoint with unchanged hparams."""
+    method = PBTSearch(metric="loss", population_size=4, num_generations=2,
+                       truncate_fraction=0.0)
+    searcher = Searcher(method, space(), seed=3)
+    gen1 = {a.request_id for a in searcher.start()}
+    _drive_generation(searcher, lambda rec: float(rec.request_id))
+    sources = [rec.source_trial_id for rec in searcher.runnable_trials()]
+    # every gen-1 member continues exactly once, hparams unchanged
+    assert sorted(sources) == sorted(gen1)
+    for rec in searcher.runnable_trials():
+        assert rec.hparams == searcher.trials[rec.source_trial_id].hparams
+
+
+def test_pbt_exploit_parents_must_have_reported_a_metric():
+    """If nobody reported the searcher metric there is nothing to exploit:
+    replaced slots get fresh independent samples, and a partially-silent
+    generation only ever clones the members that DID report."""
+    # all silent -> the replaced slot is a fresh sample (no clone source);
+    # the surviving slot continues from ITSELF, never from the errored peer
+    method = PBTSearch(metric="loss", population_size=2, num_generations=2,
+                       truncate_fraction=0.5)
+    searcher = Searcher(method, space(), seed=4)
+    for a in searcher.start():
+        searcher.on_trial_exited_early(a.request_id, "errored")
+    recs = list(searcher.runnable_trials())
+    sources = [rec.source_trial_id for rec in recs]
+    assert sources.count(None) == 1  # the exploited slot resampled fresh
+    for rec in recs:
+        if rec.source_trial_id is not None:
+            # continuation, not exploitation: hparams unchanged
+            assert rec.hparams == searcher.trials[rec.source_trial_id].hparams
+
+    # one reporter of four, k=2: the two replaced slots exploit-clone the
+    # reporter; silent members are NEVER named as sources (they may only
+    # self-continue)
+    method = PBTSearch(metric="loss", population_size=4, num_generations=2,
+                       truncate_fraction=0.5)
+    searcher = Searcher(method, space(), seed=5)
+    creates = searcher.start()
+    reporter = creates[0].request_id
+    silent = {a.request_id for a in creates[1:]}
+    searcher.on_validation(reporter, {"loss": 0.5, "batches": 4})
+    for a in creates:
+        searcher.on_trial_exited(a.request_id)
+    recs = list(searcher.runnable_trials())
+    sources = [rec.source_trial_id for rec in recs]
+    assert sources.count(reporter) >= 3  # self-continuation + 2 clones
+    for rec in recs:
+        src = rec.source_trial_id
+        if src in silent:
+            # a silent member may only continue ITS OWN line, unperturbed
+            assert rec.hparams == searcher.trials[src].hparams
+
+
+def test_warm_start_extended_length_env(monkeypatch):
+    """The cluster analog of the local clone budget extension: a master-
+    seeded clone advertises DTPU_WARM_START_STEPS and the harness extends
+    the absolute step horizon."""
+    import logging
+
+    from determined_tpu.config.experiment import Length
+    from determined_tpu.exec.run_trial import _warm_start_extended_length
+
+    log = logging.getLogger("t")
+    assert _warm_start_extended_length(Length.batches(4), log).units == 4
+    monkeypatch.setenv("DTPU_WARM_START_STEPS", "8")
+    out = _warm_start_extended_length(Length.batches(4), log)
+    assert out.units == 12 and out.unit == "batches"
+    # non-batches budgets stay absolute (warned, not mangled)
+    assert _warm_start_extended_length(Length.epochs(2), log).units == 2
+
+
+def test_pbt_nan_metric_ranks_worst_and_is_never_a_parent():
+    """A diverged member (NaN report) must not sort first in the rank and
+    must never be exploit-cloned — and the NaN invalidates its earlier
+    finite reports (its LATEST state is what a clone would inherit)."""
+    method = PBTSearch(metric="loss", population_size=3, num_generations=2,
+                       truncate_fraction=0.34)
+    searcher = Searcher(method, space(), seed=6)
+    rids = [a.request_id for a in searcher.start()]
+    searcher.on_validation(rids[0], {"loss": 0.1, "batches": 2})  # early best
+    searcher.on_validation(rids[0], {"loss": float("nan"), "batches": 4})
+    searcher.on_validation(rids[1], {"loss": 0.5, "batches": 4})
+    searcher.on_validation(rids[2], {"loss": 0.7, "batches": 4})
+    for r in rids:
+        searcher.on_trial_exited(r)
+    sources = {rec.source_trial_id for rec in searcher.runnable_trials()}
+    assert rids[0] not in sources
+    assert rids[1] in sources  # the best FINITE member is the parent
+
+
+def test_curve_model_log_scales_clamped_lr_continuously():
+    """An lr clamped to exactly its upper bound (0.1 for the built-in
+    space) must stay in log coordinates — not jump to raw space and score
+    absurdly far from its neighbors."""
+    from determined_tpu.searcher.simulate import SyntheticCurveModel, _numeric_hps
+
+    assert _numeric_hps({"lr": 0.1})["lr"] == pytest.approx(-1.0)
+    model = SyntheticCurveModel(0, noise=0.0)
+    at_bound = model.metric({"lr": 0.1}, 64)
+    near_bound = model.metric({"lr": 0.0999}, 64)
+    assert at_bound == pytest.approx(near_bound, rel=0.05)
+
+
+def test_pbt_snapshot_restore_mid_generation_resumes_identically():
+    def build():
+        return Searcher(
+            PBTSearch(metric="loss", population_size=3, num_generations=3),
+            space(), seed=9,
+        )
+
+    def finish(searcher, trace):
+        guard = 0
+        while searcher.shutdown is None and guard < 1000:
+            guard += 1
+            running = sorted(searcher.runnable_trials(), key=lambda t: t.request_id)
+            if not running:
+                break
+            for rec in running:
+                searcher.on_validation(
+                    rec.request_id,
+                    {"loss": rec.hparams["lr"], "batches": 4},
+                )
+                searcher.on_trial_exited(rec.request_id)
+                trace.append(("exit", rec.request_id))
+        for rid in sorted(searcher.trials):
+            trace.append((rid, searcher.trials[rid].hparams,
+                          searcher.trials[rid].source_trial_id))
+        return trace
+
+    s1 = build()
+    creates = s1.start()
+    # partway through generation 1: one member exited, two still running
+    s1.on_validation(creates[0].request_id, {"loss": 0.1, "batches": 4})
+    s1.on_trial_exited(creates[0].request_id)
+    snap = s1.state_json()
+    trace1 = finish(s1, [])
+
+    s2 = build()
+    s2.restore_json(snap)
+    assert s2.start() == []
+    trace2 = finish(s2, [])
+    assert trace1 == trace2
+
+
+# ---------------------------------------------------------------------------
+# Hyperband bracket math
+# ---------------------------------------------------------------------------
+
+
+def test_hyperband_canonical_brackets():
+    # the published R=81, eta=3 table: n_s = 81, 34, 15, 8, 5
+    brs = hyperband_brackets(81, 3)
+    assert [b.s for b in brs] == [4, 3, 2, 1, 0]
+    assert [b.n_trials for b in brs] == [81, 34, 15, 8, 5]
+    assert [b.min_resource for b in brs] == [1, 3, 9, 27, 81]
+
+    brs = hyperband_brackets(16, 4)
+    assert [(b.s, b.n_trials, b.min_resource) for b in brs] == [
+        (2, 16, 1), (1, 6, 4), (0, 3, 16),
+    ]
+    # exact powers of eta must not float-round the deepest bracket away
+    assert [b.s for b in hyperband_brackets(1000, 10)] == [3, 2, 1, 0]
+    assert [b.s for b in hyperband_brackets(243, 3)] == [5, 4, 3, 2, 1, 0]
+
+
+def test_hyperband_rungs_match_the_schedule_and_trim():
+    hb = HyperbandSearch(metric="loss", max_time=16, divisor=4)
+    # bracket s=2 runs rungs at 1, 4, 16 units — the ASHA rung ladder
+    assert [r.units_needed for r in hb.subs[0].rungs] == [1, 4, 16]
+    assert [r.units_needed for r in hb.subs[2].rungs] == [16]
+    assert [row["trials"] for row in hb.describe()] == [16, 6, 3]
+
+    capped = HyperbandSearch(metric="loss", max_time=16, divisor=4, max_trials=18)
+    assert [b.n_trials for b in capped.brackets] == [16, 2]
+
+
+def test_hyperband_simulation_early_stops_most_trials():
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": HPARAMS,
+            "searcher": {
+                "name": "hyperband", "metric": "validation_loss",
+                "max_time": 64, "divisor": 4,
+            },
+        }
+    )
+    report = simulate_method(cfg, SyntheticCurveModel(1), seed=1)
+    assert report.trials_created == sum(
+        b.n_trials for b in hyperband_brackets(64, 4)
+    )
+    # the whole point of the bracket schedule: way below uniform training
+    assert report.total_units < report.trials_created * 64 * 0.5
+    assert report.best_metric is not None
+
+
+# ---------------------------------------------------------------------------
+# simulator: clone inheritance + the PBT-beats-random acceptance gate
+# ---------------------------------------------------------------------------
+
+
+def _base_cfg(max_trials=8, max_time=64):
+    return ExperimentConfig.parse(
+        {
+            "hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -1},
+                                "units": 64},
+            "searcher": {
+                "name": "random", "metric": "validation_loss",
+                "max_trials": max_trials, "max_time": max_time,
+                "num_rungs": 3, "divisor": 4, "max_concurrent_trials": 4,
+            },
+        }
+    )
+
+
+def test_simulator_pbt_beats_random_at_equal_budget():
+    reports = {
+        r.method: r for r in compare_methods(_base_cfg(), ["random", "pbt"], seed=3)
+    }
+    assert reports["pbt"].total_units == reports["random"].total_units
+    assert reports["pbt"].best_metric < reports["random"].best_metric
+    # and the winner is a cloned child, not a lucky initial sample
+    assert reports["pbt"].lineage[reports["pbt"].best_trial] is not None
+    # across seeds PBT is never worse: a surviving line retrains the best
+    # initial draw to the same effective units, so explore can only help
+    for seed in range(6):
+        by = {
+            r.method: r
+            for r in compare_methods(_base_cfg(), ["random", "pbt"], seed=seed)
+        }
+        assert by["pbt"].best_metric <= by["random"].best_metric
+
+
+def test_simulator_is_deterministic_across_runs():
+    a = compare_methods(_base_cfg(), seed=7)
+    b = compare_methods(_base_cfg(), seed=7)
+    assert [(r.method, r.best_metric, r.total_units, r.curve) for r in a] == [
+        (r.method, r.best_metric, r.total_units, r.curve) for r in b
+    ]
+
+
+def test_simulator_clone_children_inherit_effective_units():
+    calls = []
+
+    class Probe(SyntheticCurveModel):
+        def metric(self, hparams, units):
+            calls.append(units)
+            probe_units[id(self)] = units
+            return super().metric(hparams, units)
+
+    probe_units = {}
+    cfg = ExperimentConfig.parse(
+        {
+            "hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -1}},
+            "searcher": {
+                "name": "pbt", "metric": "validation_loss", "max_time": 8,
+                "population_size": 3, "num_generations": 2,
+            },
+        }
+    )
+    report = simulate_method(cfg, Probe(0), seed=0)
+    children = [rid for rid, src in report.lineage.items() if src is not None]
+    assert children
+    # a generation-2 child's curve continues past its parent's 8 units
+    assert max(calls) > 8
+    for rid in children:
+        assert report.trial_units[rid] <= 8  # own budget is one generation
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: LocalExperiment clone materialization + journal resume
+# ---------------------------------------------------------------------------
+
+
+def pbt_config(**overrides):
+    raw = {
+        "name": "pbt-e2e",
+        "hyperparameters": {
+            "lr": {"type": "log", "minval": -3, "maxval": -1},
+            "hidden": 8,
+            "global_batch_size": 16,
+            "dataset_size": 64,
+        },
+        "searcher": {
+            "name": "pbt",
+            "metric": "validation_accuracy",
+            "smaller_is_better": False,
+            "population_size": 3,
+            "num_generations": 2,
+            "truncate_fraction": 0.34,
+            "max_length": {"batches": 4},
+        },
+        "resources": {"mesh": {"data": 1}},
+        "min_validation_period": {"batches": 2},
+        "min_checkpoint_period": {"batches": 2},
+        # sync saves: every boundary leaves a durable resume point
+        "optimizations": {"async_checkpointing": False},
+    }
+    raw.update(overrides)
+    return ExperimentConfig.parse(raw)
+
+
+def _ckpt_meta(checkpoint_dir, rid, uuid):
+    with open(
+        os.path.join(checkpoint_dir, f"trial_{rid}", uuid, "metadata.json")
+    ) as f:
+        return json.load(f)
+
+
+def test_pbt_e2e_child_resumes_from_parent_checkpoint(tmp_path):
+    """The acceptance path: a perturbed child demonstrably resumes from its
+    exploit parent's checkpoint — the clone uuid IS the parent's latest
+    checkpoint, it is materialized in the child's namespace, and the
+    child's own checkpoint lineage walks back to it."""
+    from determined_tpu.train._jit_cache import step_cache_stats
+
+    ckdir = str(tmp_path / "ck")
+    exp = LocalExperiment(pbt_config(), MnistTrial, checkpoint_dir=ckdir)
+    hits_before = step_cache_stats()["hits"]
+    summary = exp.run(serial=True)
+    assert summary["status"] == "completed"
+    assert summary["trials"] == 6  # 3 members x 2 generations
+
+    method = exp.searcher.method
+    children = {rid: src for rid, src in method.lineage.items() if src is not None}
+    assert len(children) == 3
+    for rid, src in children.items():
+        parent_ckpt = exp.results[src].checkpoint
+        assert parent_ckpt, "exploit parent finished without a checkpoint"
+        # the clone was materialized under the CHILD's namespace with the
+        # parent's uuid
+        clone_dir = os.path.join(ckdir, f"trial_{rid}", parent_ckpt)
+        assert os.path.isdir(clone_dir), "clone not materialized through storage"
+        # generation budget extends past the inherited steps
+        assert exp.results[rid].steps_completed == 8
+        # manifest lineage: the child's final checkpoint walks back to the
+        # parent's uuid
+        sid = exp.results[rid].checkpoint
+        seen = set()
+        while sid and sid not in seen and sid != parent_ckpt:
+            seen.add(sid)
+            sid = _ckpt_meta(ckdir, rid, sid).get("parent_storage_id")
+        assert sid == parent_ckpt, "child lineage never reached the parent uuid"
+    # the journal carries the clone provenance
+    replay = read_journal(journal_path(ckdir))
+    assert sorted(replay.clones) == sorted(children)
+    for rid, src in children.items():
+        assert replay.clones[rid]["source"] == src
+        assert replay.clones[rid]["steps"] == 4
+    # lr rides in opt_state (inject_hyperparams): same-architecture children
+    # reuse the compiled step instead of retracing
+    assert step_cache_stats()["hits"] > hits_before
+    # at least one exploited child actually explored (perturbed lr)
+    exploited = [
+        rid for rid, src in children.items()
+        if exp.results[rid].hparams["lr"] != exp.results[src].hparams["lr"]
+    ]
+    assert exploited
+
+
+@pytest.mark.slow
+def test_pbt_concurrent_scheduler_clones_resume_from_final_parent_ckpt(tmp_path):
+    """Under the gang scheduler a PBT turnover dispatches children while
+    the parents' results are still inside the scheduler outcome; the
+    clone must still resolve the parent's FINAL checkpoint (not an older
+    validation-boundary save)."""
+    cfg = pbt_config(
+        resources={"mesh": {"data": 2}},
+        searcher={
+            "name": "pbt", "metric": "validation_accuracy",
+            "smaller_is_better": False, "population_size": 4,
+            "num_generations": 2, "truncate_fraction": 0.25,
+            "max_length": {"batches": 4}, "max_concurrent_trials": 4,
+        },
+    )
+    exp = LocalExperiment(cfg, MnistTrial, checkpoint_dir=str(tmp_path / "ck"))
+    summary = exp.run()
+    assert summary["status"] == "completed"
+    assert summary["trials"] == 8
+    assert summary["scheduler"]["peak_concurrency"] >= 2
+    lineage = exp.searcher.method.lineage
+    for rid, src in lineage.items():
+        if src is None:
+            continue
+        # full parent budget inherited: 4 own on top of the parent's 4
+        assert exp.results[rid].steps_completed == 8
+        clone_dir = os.path.join(
+            str(tmp_path / "ck"), f"trial_{rid}", exp.results[src].checkpoint
+        )
+        assert os.path.isdir(clone_dir)
+
+
+def _trial_fingerprint(exp):
+    return sorted(
+        (rid, r.steps_completed, tuple(sorted(r.hparams.items())))
+        for rid, r in exp.results.items()
+    )
+
+
+@pytest.mark.parametrize(
+    "kill_event, occurrence",
+    [
+        ("trial_validated", 8),  # mid-generation 2
+        ("trial_exited", 3),     # exactly at the generation boundary
+    ],
+)
+def test_pbt_sigkill_resume_reproduces_oracle(tmp_path, kill_event, occurrence):
+    """SIGKILL the driver (journal fault site) mid-generation AND at a
+    generation boundary; ``run(resume=True)`` must reproduce the oracle's
+    exact trial set, hparams, and clone lineage — PBT's turnover draws
+    replay from the journaled rng."""
+    cfg = pbt_config()
+    oracle = LocalExperiment(cfg, MnistTrial, checkpoint_dir=str(tmp_path / "oracle"))
+    assert oracle.run(serial=True)["status"] == "completed"
+
+    crash_dir = str(tmp_path / "crash")
+    inj = FaultInjector()
+    inj.kill_driver_at_journal_event(kill_event, occurrence=occurrence)
+    exp = LocalExperiment(cfg, MnistTrial, checkpoint_dir=crash_dir)
+    with inj.installed():
+        with pytest.raises(SimulatedCrash):
+            exp.run(serial=True)
+    assert experiment_status(crash_dir)["resumable"]
+
+    resumed = LocalExperiment(cfg, MnistTrial, checkpoint_dir=crash_dir)
+    summary = resumed.resume(serial=True)
+    assert summary["status"] == "completed"
+    assert _trial_fingerprint(resumed) == _trial_fingerprint(oracle)
+    assert resumed.searcher.method.lineage == oracle.searcher.method.lineage
+    # no request id was ever reused across the crash
+    records = read_journal(journal_path(crash_dir)).records
+    created = [r["rid"] for r in records if r.get("type") == "trial_created"]
+    assert len(created) == len(set(created))
+
+
+def test_gc_protects_live_clone_sources_e2e(tmp_path):
+    """Current-generation members' checkpoints survive aggressive metric
+    retention while they are still candidate exploit parents."""
+    from determined_tpu.exec.gc_checkpoints import apply_retention, RetentionPolicy
+
+    ckdir = str(tmp_path / "ck")
+    exp = LocalExperiment(pbt_config(), MnistTrial, checkpoint_dir=ckdir)
+    summary = exp.run(serial=True)
+    assert summary["status"] == "completed"
+    # aggressive policy that would otherwise keep only the single best
+    # trial's checkpoint
+    outcome = apply_retention(
+        ckdir,
+        RetentionPolicy(keep_trial_latest=0, keep_experiment_best=1,
+                        smaller_is_better=False),
+        metric_by_trial={
+            rid: r.metrics.get("validation_accuracy", 0.0)
+            for rid, r in exp.results.items()
+        },
+        protected_trials=set(exp.searcher.clone_source_trials()),
+    )
+    # every current-generation member's latest checkpoint survived
+    for m in exp.searcher.method.members:
+        rid = m["rid"]
+        sid = exp.results[rid].checkpoint
+        assert os.path.isdir(os.path.join(ckdir, f"trial_{rid}", sid)), (
+            rid, outcome,
+        )

@@ -18,6 +18,11 @@ from determined_tpu.config import ExperimentConfig, Length
 from determined_tpu.models.transformer import LMTrial
 from determined_tpu.parallel.mesh import MeshConfig
 
+# slow: every case pays a multi-stage GPipe compile (~250s total on the
+# 2-core verify box); full-suite/nightly coverage, outside the 870s
+# tier-1 window.  The jax-drift xfails tracked in ROADMAP live here.
+pytestmark = pytest.mark.slow
+
 HPARAMS = {
     "lr": 1e-3,
     "global_batch_size": 16,

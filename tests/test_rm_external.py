@@ -34,10 +34,16 @@ from tests.test_devcluster import (
     free_port,
 )
 
-pytestmark = pytest.mark.skipif(
-    not os.path.exists(AGENT_BIN),
-    reason="native binaries not built (cmake -S native -B native/build && ninja)",
-)
+# slow: devcluster-adjacent — every case drives the native master against
+# fake cloud/k8s APIs with real task subprocesses (~150s on the 2-core
+# verify box); full-suite/nightly coverage (ROADMAP "Tier-1 verify")
+pytestmark = [
+    pytest.mark.skipif(
+        not os.path.exists(AGENT_BIN),
+        reason="native binaries not built (cmake -S native -B native/build && ninja)",
+    ),
+    pytest.mark.slow,
+]
 
 
 class FakeKubeApiserver:

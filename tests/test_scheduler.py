@@ -371,12 +371,43 @@ def test_jit_cache_shares_steps_across_same_architecture_trials():
     stats = train.step_cache_stats()
     assert stats["hits"] >= 1 and stats["entries"] == 1
 
-    # trace-relevant hparam change -> distinct compiled steps
+    # MnistTrial routes lr through opt_state (inject_hyperparams) and
+    # declares it runtime: an lr-ONLY change reuses the compiled step —
+    # the PBT-perturbation fast path
     t3 = _mini_trainer({**BASE_HP, "lr": 0.01})
-    assert t3._train_step is not t1._train_step
+    assert t3._train_step is t1._train_step
+    # trace-relevant hparam change -> distinct compiled steps
     t4 = _mini_trainer({**BASE_HP, "hidden": 12})
     assert t4._train_step is not t1._train_step
-    assert train.step_cache_stats()["entries"] == 3
+    assert train.step_cache_stats()["entries"] == 2
+
+
+def test_jit_cache_shared_step_applies_each_trials_runtime_lr():
+    """Two trials sharing one compiled step must still train with their
+    OWN lr: the rate lives in opt_state, not in the trace."""
+    import jax
+    import numpy as np
+
+    from determined_tpu import train
+    from determined_tpu.data import to_global
+
+    train.clear_step_cache()
+    slow = _mini_trainer({**BASE_HP, "lr": 1e-4}, seed=0)
+    fast = _mini_trainer({**BASE_HP, "lr": 1e-1}, seed=0)
+    assert fast._train_step is slow._train_step  # one compile, two rates
+    deltas = {}
+    for t in (slow, fast):
+        batch = next(iter(t.train_loader.iter_epoch(0)))
+        # the step donates its input state: snapshot params BEFORE stepping
+        before = jax.tree_util.tree_leaves(jax.device_get(t.state.params))
+        with t.mesh:
+            gbatch = to_global(batch, t.mesh)
+            state2 = t._train_step(t.state, gbatch)
+        after = jax.tree_util.tree_leaves(jax.device_get(state2.params))
+        deltas[t] = sum(
+            float(np.abs(a - b).sum()) for a, b in zip(before, after)
+        )
+    assert deltas[fast] > deltas[slow] * 10
 
 
 def test_jit_cache_shared_step_trains_correctly():

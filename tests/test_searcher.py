@@ -217,7 +217,9 @@ def _drive_to_completion(searcher, scfg, trial_fn, trial_steps, period=4, max_ti
     return trace
 
 
-@pytest.mark.parametrize("name", ["random", "asha", "adaptive_asha"])
+@pytest.mark.parametrize(
+    "name", ["random", "asha", "adaptive_asha", "hyperband", "pbt"]
+)
 def test_mid_search_snapshot_restore_is_deterministic(name):
     """A searcher restored from a mid-search snapshot must emit EXACTLY the
     remaining trials (same request ids, same sampled hparams) as the
