@@ -27,71 +27,44 @@ def chip_peak_flops(device) -> float:
     return peak_by_kind(getattr(device, "device_kind", ""), default=197e12)
 
 
-def main() -> None:
+def _bench_hook(env_var: str, script: str) -> None:
+    """Env-gated dispatch to a scripts/bench_*.py with the same one-line
+    JSON contract; exits with the script's status when the var is set."""
     import os
 
-    # A/B hook for the search scheduler (docs/search-scheduler.md):
-    # DTPU_BENCH_SEARCH=1 benchmarks serial vs mesh-packed hyperparameter
-    # search (scripts/bench_search.py) instead of the single-trial step —
-    # same one-line JSON contract, serial execution as the baseline
-    if os.environ.get("DTPU_BENCH_SEARCH", "0") not in ("0", ""):
-        import subprocess
-        import sys
+    if os.environ.get(env_var, "0") in ("0", ""):
+        return
+    import subprocess
+    import sys
 
-        raise SystemExit(
-            subprocess.call(
-                [
-                    sys.executable,
-                    os.path.join(
-                        os.path.dirname(os.path.abspath(__file__)),
-                        "scripts",
-                        "bench_search.py",
-                    ),
-                ]
-            )
+    raise SystemExit(
+        subprocess.call(
+            [
+                sys.executable,
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "scripts", script
+                ),
+            ]
         )
+    )
 
-    # searcher-zoo hook (docs/searchers.md): DTPU_BENCH_SEARCHERS=1 runs
-    # the trial-free simulator comparison of random/ASHA/Hyperband/PBT at
-    # equal budget (scripts/bench_searchers.py) — same one-line JSON
-    # contract; costs milliseconds, no devices
-    if os.environ.get("DTPU_BENCH_SEARCHERS", "0") not in ("0", ""):
-        import subprocess
-        import sys
 
-        raise SystemExit(
-            subprocess.call(
-                [
-                    sys.executable,
-                    os.path.join(
-                        os.path.dirname(os.path.abspath(__file__)),
-                        "scripts",
-                        "bench_searchers.py",
-                    ),
-                ]
-            )
-        )
+def main() -> None:
+    # A/B hook for the search scheduler (docs/search-scheduler.md): serial
+    # vs mesh-packed hyperparameter search, serial as the baseline
+    _bench_hook("DTPU_BENCH_SEARCH", "bench_search.py")
+    # searcher zoo (docs/searchers.md): trial-free simulator comparison of
+    # random/ASHA/Hyperband/PBT at equal budget; milliseconds, no devices
+    _bench_hook("DTPU_BENCH_SEARCHERS", "bench_searchers.py")
+    # sentinel cost (docs/lint.md "SPMD correctness"): the collective-
+    # sequence sentinel's digest+envelope overhead vs a bare 2-rank star,
+    # so hang-to-named-error conversion stays a tracked number
+    _bench_hook("DTPU_BENCH_SENTINEL", "bench_sentinel.py")
+    # serving tier (docs/serving.md): continuous batching vs the naive
+    # static batch over one shared kernel set, static as the baseline
+    _bench_hook("DTPU_BENCH_SERVE", "bench_serve.py")
 
-    # A/B hook for the serving tier (docs/serving.md): DTPU_BENCH_SERVE=1
-    # benchmarks continuous batching vs the naive static batch over one
-    # shared kernel set (scripts/bench_serve.py) — same one-line JSON
-    # contract, the static batch as the baseline
-    if os.environ.get("DTPU_BENCH_SERVE", "0") not in ("0", ""):
-        import subprocess
-        import sys
-
-        raise SystemExit(
-            subprocess.call(
-                [
-                    sys.executable,
-                    os.path.join(
-                        os.path.dirname(os.path.abspath(__file__)),
-                        "scripts",
-                        "bench_serve.py",
-                    ),
-                ]
-            )
-        )
+    import os
 
     import jax
 

@@ -981,6 +981,7 @@ def lint_cmd(args) -> int:
                 lint_mod.analyze_paths(
                     path_targets, rules=args.rule or None,
                     disabled=args.suppress or None,
+                    exclude=args.exclude or None,
                 )
             )
         except Exception as e:  # noqa: BLE001 - unreadable file, bad rule id
@@ -1446,6 +1447,14 @@ def build_parser() -> argparse.ArgumentParser:
     ln.add_argument(
         "--suppress", action="append",
         help="disable specific rule ids (repeatable)",
+    )
+    ln.add_argument(
+        "--exclude", action="append", metavar="GLOB",
+        help="skip files/dirs matching this glob in dir-mode targets "
+             "(repeatable; matched against basenames and target-relative "
+             "paths — excluded directories are pruned, so a live "
+             "experiment's checkpoint/journal/trace artifacts are never "
+             "walked)",
     )
     ln.set_defaults(fn=lint_cmd)
 

@@ -386,15 +386,23 @@ class LintConfig:
     logical step compiles more than once (guards the jit-reuse cache's
     throughput win).  ``thread_sentinel``: run the trial under the
     thread-leak checker (warn mode) so leaked prefetch/scheduler workers
-    surface in logs.  ``suppress``: rule ids disabled for this experiment
-    (the per-line ``# dtpu: lint-ok[rule]`` comment is preferred — it keeps
-    the audit local).
+    surface in logs.  ``collective_sentinel``: wrap the control-plane
+    collective entry points with the collective-sequence sentinel — every
+    rank digests its (op, payload-structure) sequence and the digests ride
+    the collectives themselves, so a rank that takes a divergent code path
+    raises a named ``CollectiveDivergenceError`` at the next exchange
+    instead of hanging the gang to the 600 s collective timeout (must be
+    on for EVERY rank of a gang or none; the ``DTPU_COLLECTIVE_SENTINEL``
+    env is the launch-layer override).  ``suppress``: rule ids disabled
+    for this experiment (the per-line ``# dtpu: lint-ok[rule]`` comment is
+    preferred — it keeps the audit local).
     """
 
     preflight: bool = True
     strict: bool = False
     retrace_sentinel: bool = False
     thread_sentinel: bool = False
+    collective_sentinel: bool = False
     suppress: List[str] = dataclasses.field(default_factory=list)
 
     def __post_init__(self):

@@ -534,6 +534,23 @@ def main() -> int:
         from determined_tpu.lint import get_retrace_sentinel
 
         get_retrace_sentinel().enable()
+    # collective-sequence sentinel: the env is the launch-layer override in
+    # BOTH directions — "1" turns it on for a whole gang without touching
+    # the experiment config (devcluster harness), "0" turns it off even
+    # when the config enables it; unset/empty defers to the config knob
+    cseq_env = os.environ.get("DTPU_COLLECTIVE_SENTINEL")
+    cseq_on = (
+        lint_cfg.collective_sentinel
+        if cseq_env in (None, "")
+        else cseq_env != "0"
+    )
+    if cseq_on:
+        # must be installed BEFORE core.init() builds the
+        # DistributedContext so every collective this rank ever issues is
+        # digested
+        from determined_tpu.lint import get_collective_sentinel
+
+        get_collective_sentinel().install()
     if lint_cfg.preflight:
         from determined_tpu import lint as lint_mod
 

@@ -109,7 +109,9 @@ def run_batch_inference(
             _record_progress(ctx, dist, idx + 1)
             if ctx.preempt.should_preempt():
                 logger.info("preempted at batch %d; progress checkpointed", idx + 1)
-                return done
+                # should_preempt() IS the exchange (allgather of per-rank
+                # flags), so every rank returns from the same batch index
+                return done  # dtpu: lint-ok[conditional-collective-escape]
     # Final marker BEFORE on_finish: progress was only recorded every
     # checkpoint_interval, so a rank preempted after its last batch but
     # before on_finish would replay the whole tail on resume.  Skipped

@@ -40,11 +40,14 @@ from determined_tpu.lint._diag import (
     to_json_payload,
 )
 from determined_tpu.lint._runtime import (
+    CollectiveDivergenceError,
+    CollectiveSequenceSentinel,
     LockOrderSentinel,
     LockOrderViolation,
     RetraceSentinel,
     ThreadLeakChecker,
     ThreadLeakError,
+    get_collective_sentinel,
     get_retrace_sentinel,
 )
 from determined_tpu.lint.rules import all_rules
@@ -65,6 +68,8 @@ def check_trial(
 
 
 __all__ = [
+    "CollectiveDivergenceError",
+    "CollectiveSequenceSentinel",
     "Diagnostic",
     "ERROR",
     "LintError",
@@ -83,6 +88,7 @@ __all__ = [
     "analyze_paths",
     "analyze_source",
     "check_trial",
+    "get_collective_sentinel",
     "get_retrace_sentinel",
     "to_json_payload",
 ]

@@ -463,6 +463,7 @@ def analyze_paths(
     *,
     rules: Optional[Sequence[str]] = None,
     disabled: Optional[Sequence[str]] = None,
+    exclude: Optional[Sequence[str]] = None,
     **file_kwargs: Any,
 ) -> List[Diagnostic]:
     """Lint several files/directories as ONE program.
@@ -477,6 +478,11 @@ def analyze_paths(
     Extra keyword args (``assume_trial_classes`` etc.) are forwarded to
     the per-module ``analyze_source`` pass for every file, keeping
     ``analyze_path``'s directory mode on its historical contract.
+
+    ``exclude``: fnmatch globs (matched against basenames and
+    target-relative paths, pruning whole directories) — dir-mode over a
+    live experiment checkout must skip journal/checkpoint/trace artifacts
+    and shipped context code (``dtpu lint . --exclude 'checkpoints/*'``).
     """
     from determined_tpu.lint._concurrency import (
         analyze_program_sources,
@@ -489,7 +495,7 @@ def analyze_paths(
     files: List[str] = []
     seen_real: Set[str] = set()
     for path in paths:
-        for f in collect_py_files(path):
+        for f in collect_py_files(path, exclude=tuple(exclude or ())):
             # overlapping targets can spell one physical file two ways
             # (`dtpu lint pkg ./pkg/mod.py`); linting it twice doubles
             # every finding and forks its module identity in the index
@@ -516,8 +522,10 @@ def analyze_paths(
 
 def analyze_path(path: str, **kwargs: Any) -> List[Diagnostic]:
     """Lint a .py file or recursively every .py file under a directory
-    (one whole-program concurrency pass across the directory)."""
+    (one whole-program concurrency pass across the directory).  Accepts
+    ``exclude=`` globs in directory mode (see ``analyze_paths``)."""
     if os.path.isfile(path):
+        kwargs.pop("exclude", None)  # a named file is always linted
         return analyze_file(path, **kwargs)
     return analyze_paths([path], **kwargs)
 

@@ -1142,7 +1142,9 @@ def analyze_program_sources(
     *,
     line_offsets: Optional[Dict[str, int]] = None,
 ) -> List[Diagnostic]:
-    """Run the concurrency pass over {filename: source}."""
+    """Run every whole-program pass (concurrency + SPMD) over
+    {filename: source} — ONE shared index, each pass picking up the rule
+    ids it implements."""
     if not rules:
         return []
     index = ProgramIndex()
@@ -1150,18 +1152,52 @@ def analyze_program_sources(
         index.add_source(
             filename, source, line_offset=(line_offsets or {}).get(filename, 0)
         )
-    return run_concurrency_pass(index, rules)
+    from determined_tpu.lint._spmd import run_spmd_pass
+
+    return run_concurrency_pass(index, rules) + run_spmd_pass(index, rules)
 
 
-def collect_py_files(path: str) -> List[str]:
+def collect_py_files(path: str, exclude: Sequence[str] = ()) -> List[str]:
+    """Every ``.py`` under ``path`` (or the file itself).
+
+    ``exclude``: fnmatch globs tested against each candidate's basename
+    AND its path relative to ``path`` — and against DIRECTORY names while
+    walking, so an excluded tree (a live experiment's ``checkpoints/`` or
+    ``traces/`` dir, a generated-code directory) is pruned without
+    touching its contents rather than filtered file by file.  Linting a
+    live checkout must not descend into journal/checkpoint artifacts:
+    they can hold thousands of entries (and context dirs ship user
+    ``.py`` files that are not this program).
+    """
+    import fnmatch
+
+    def excluded(rel: str, name: str) -> bool:
+        return any(
+            fnmatch.fnmatch(name, pat) or fnmatch.fnmatch(rel, pat)
+            for pat in exclude
+        )
+
     if os.path.isfile(path):
+        # an explicitly named file is ALWAYS linted: excludes exist to
+        # prune artifacts discovered while WALKING a directory, not to
+        # silently drop a target the user spelled out (analyze_path makes
+        # the same promise for its file mode)
         return [path]
     out: List[str] = []
     for root, dirs, files in os.walk(path):
+        rel_root = os.path.relpath(root, path)
         dirs[:] = sorted(
-            d for d in dirs if d != "__pycache__" and not d.startswith(".")
+            d
+            for d in dirs
+            if d != "__pycache__"
+            and not d.startswith(".")
+            and not excluded(os.path.normpath(os.path.join(rel_root, d)), d)
         )
         for name in sorted(files):
-            if name.endswith(".py"):
-                out.append(os.path.join(root, name))
+            if not name.endswith(".py"):
+                continue
+            rel = os.path.normpath(os.path.join(rel_root, name))
+            if excluded(rel, name):
+                continue
+            out.append(os.path.join(root, name))
     return out

@@ -409,6 +409,435 @@ class LockOrderSentinel:
             self._violations.clear()
 
 
+# ---------------------------------------------------------------------------
+# collective-sequence sentinel
+# ---------------------------------------------------------------------------
+
+
+class CollectiveDivergenceError(RuntimeError):
+    """Two ranks issued different collective sequences.
+
+    This is the named, located form of the worst debugging experience in
+    distributed training: without the sentinel, the divergence is a
+    silent hang — every healthy rank blocks inside its collective until
+    the 600-second timeout, with no indication of WHICH rank took a
+    different path or WHICH op it skipped.  The error names the first
+    divergent op and carries both ranks' recent traces.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        op_index: int = -1,
+        ranks: Optional[Dict[int, str]] = None,
+        traces: Optional[Dict[int, List[str]]] = None,
+    ) -> None:
+        super().__init__(message)
+        #: absolute index (0-based) of the first divergent collective
+        self.op_index = op_index
+        #: rank -> the op it issued at the divergence point
+        self.ranks = dict(ranks or {})
+        #: rank -> recent (op, detail) signature trace
+        self.traces = dict(traces or {})
+
+
+#: first element of every enveloped payload — lets the receiving side
+#: distinguish "sentinel payload" from "raw payload from a rank that does
+#: not have the sentinel installed" (a misconfiguration worth naming)
+_CSEQ_MAGIC = "__dtpu_cseq__"
+
+
+def _payload_sig(obj: Any) -> str:
+    """Cheap structural signature of a collective operand: the top-level
+    TYPE (plus shape for arrays).  Deliberately shallow and deliberately
+    length-free — per-rank operands legitimately differ in content and
+    size (``allgather(hostname)``), but a type split (one rank sends a
+    tuple, another None) is the wrong-branch signal.  The digest must
+    cost nanoseconds; op identity is what diverges first."""
+    if obj is None:
+        return "none"
+    shape = getattr(obj, "shape", None)
+    if shape is not None:
+        return f"{type(obj).__name__}{tuple(shape)!r}"
+    return type(obj).__name__
+
+
+class _CseqState:
+    """Per-DistributedContext rolling digest of the collective sequence."""
+
+    __slots__ = ("rank", "seq", "xchg", "digest", "trace", "lock")
+
+    def __init__(self, rank: int, trace_depth: int) -> None:
+        import collections
+
+        self.rank = rank
+        self.seq = 0  # collectives recorded so far (exchanged + dispatch-site)
+        self.xchg = 0  # EXCHANGED collectives only (the injection counter)
+        self.digest = 0  # crc32 chain over every recorded signature
+        self.trace = collections.deque(maxlen=trace_depth)
+        self.lock = threading.Lock()
+
+    def record(self, sig: str) -> None:
+        import zlib
+
+        with self.lock:
+            self.seq += 1
+            self.digest = zlib.crc32(sig.encode(), self.digest) & 0xFFFFFFFF
+            self.trace.append(sig)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            return {
+                "rank": self.rank,
+                "seq": self.seq,
+                "digest": self.digest,
+                # only the TAIL rides the wire: the digest covers the full
+                # history, the shipped tail exists to NAME the divergence
+                # point in the error; the deeper local deque stays
+                # available to whoever catches the exception
+                "trace": list(self.trace)[-8:],
+            }
+
+
+class CollectiveSequenceSentinel:
+    """Digest every rank's collective sequence; name divergences.
+
+    ``install()`` patches the control-plane collective entry points on
+    ``DistributedContext`` (``allgather``/``gather``/``broadcast``/
+    ``barrier`` and their ``_local`` variants) so that every call:
+
+    1. records an ``(op, payload-structure)`` signature into a per-rank
+       rolling crc32 digest (``record`` is also public, so un-exchanged
+       dispatch sites — the trainer's jitted step, which carries the
+       tensor-plane psums — feed the same digest);
+    2. piggybacks a tiny envelope ``{rank, seq, digest, op, trace}`` on
+       the payload it was going to exchange anyway;
+    3. verifies, on receipt, that every participating rank agrees on
+       ``(seq, op, digest)`` — raising a deterministic
+       ``CollectiveDivergenceError`` naming the first divergent op and
+       both ranks' traces the moment the sequences disagree, instead of
+       letting the mismatch surface as a 600-second silent hang.
+
+    The exchange rides the collective that was already happening, so the
+    sentinel adds no extra round trips; overhead per collective is one
+    crc32 of a short string plus a small dict (``DTPU_BENCH_SENTINEL=1``
+    in ``bench.py`` tracks the number).  Divergences where one rank calls
+    a DIFFERENT op on a compatible transport (allgather vs barrier, the
+    common wrong-branch case) are caught in-band; a rank that issues NO
+    collective still parks its peers until the control-plane deadline,
+    but the deadline's ``PeerLostError`` then names the silent rank.
+
+    Enablement: ``lint.collective_sentinel: true`` in the experiment
+    config (the trial entrypoint installs it before ``core.init()``), the
+    ``DTPU_COLLECTIVE_SENTINEL=1`` env, or the ``collective_order``
+    pytest marker (``tests/conftest.py``).  Must be installed on EVERY
+    rank of a gang or none — a raw (non-enveloped) payload from a
+    sentinel-less peer raises with a message saying exactly that.
+
+    Fault injection (the devcluster acceptance test): the env
+    ``DTPU_CSEQ_INJECT="<rank>:<seq>:<op>"`` makes the named rank
+    advertise ``<op>`` as its ``<seq>``-th collective — simulating the
+    wrong-branch divergence without hand-writing a divergent trial.
+    """
+
+    def __init__(self, *, trace_depth: int = 64) -> None:
+        self.trace_depth = trace_depth
+        self._installed = False
+        self._orig: Dict[str, Any] = {}
+        self._violations: List[CollectiveDivergenceError] = []
+        self._vlock = threading.Lock()
+        # parsed DTPU_CSEQ_INJECT, or None
+        self._inject: Optional[Tuple[int, int, str]] = None
+        import os
+
+        spec = os.environ.get("DTPU_CSEQ_INJECT", "")
+        if spec:
+            try:
+                r, s, op = spec.split(":", 2)
+                self._inject = (int(r), int(s), op)
+            except ValueError:
+                logger.warning("ignoring malformed DTPU_CSEQ_INJECT=%r", spec)
+
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
+    # -- state -------------------------------------------------------------
+
+    def _state(self, dist: Any) -> _CseqState:
+        st = getattr(dist, "_dtpu_cseq", None)
+        if st is None:
+            st = _CseqState(getattr(dist, "rank", 0), self.trace_depth)
+            dist._dtpu_cseq = st
+        return st
+
+    def record(self, dist: Any, op: str, detail: str = "") -> None:
+        """Public dispatch-site hook: fold an un-exchanged collective
+        (e.g. the jitted train step carrying the gradient psums) into the
+        rolling digest.  The mismatch surfaces at the NEXT exchanged
+        collective, whose envelope carries the digest."""
+        self._state(dist).record(f"{op}({detail})" if detail else op)
+
+    def violations(self) -> List[CollectiveDivergenceError]:
+        with self._vlock:
+            return list(self._violations)
+
+    def reset(self) -> None:
+        with self._vlock:
+            self._violations.clear()
+
+    # -- envelope exchange -------------------------------------------------
+
+    def _sig_for(self, st: _CseqState, op: str, obj: Any) -> str:
+        # broadcast/gather payloads are one-sided BY DESIGN (chief sends,
+        # or each rank contributes local data the chief merges), so only
+        # the op identity is digested for them; symmetric exchanges also
+        # digest the operand's structural signature
+        if op.startswith(("broadcast", "gather")):
+            sig = op
+        else:
+            sig = f"{op}({_payload_sig(obj)})"
+        with st.lock:
+            st.xchg += 1
+            xchg = st.xchg
+        if self._inject is not None:
+            rank, at_xchg, fake_op = self._inject
+            # counted in EXCHANGED collectives (not dispatch-site records),
+            # so the injection point is stable regardless of how many step
+            # segments the trainer folded in between
+            if st.rank == rank and xchg == at_xchg:
+                logger.warning(
+                    "cseq inject: rank %d advertising %r instead of %r at "
+                    "exchanged collective #%d",
+                    rank, fake_op, sig, at_xchg,
+                )
+                return fake_op
+        return sig
+
+    def _divergence(
+        self, envs: List[Dict[str, Any]]
+    ) -> Optional[CollectiveDivergenceError]:
+        """Compare all ranks' envelopes; build the named error or None."""
+        base = envs[0]
+        if all(
+            e["seq"] == base["seq"]
+            and e["op"] == base["op"]
+            and e["digest"] == base["digest"]
+            for e in envs[1:]
+        ):
+            return None
+        # find the first divergent absolute op index from the traces
+        traces = {e["rank"]: list(e["trace"]) + [e["op"]] for e in envs}
+        starts = {e["rank"]: e["seq"] + 1 - len(traces[e["rank"]]) for e in envs}
+        first = min(starts.values())
+        last = max(e["seq"] for e in envs)
+        op_index = -1
+        at: Dict[int, str] = {}
+        for i in range(max(first, 0), last + 1):
+            ops = {
+                r: traces[r][i - starts[r]]
+                for r in traces
+                if 0 <= i - starts[r] < len(traces[r])
+            }
+            if len(set(ops.values())) > 1 or (envs and len(ops) < len(envs)):
+                op_index = i
+                at = {r: ops.get(r, "<nothing>") for r in traces}
+                break
+        if op_index < 0:
+            # identical visible traces but different digests: the split is
+            # older than the rolling window
+            op_index = first
+            at = {e["rank"]: "<diverged before trace window>" for e in envs}
+        who = ", ".join(f"rank {r} issued `{op}`" for r, op in sorted(at.items()))
+        err = CollectiveDivergenceError(
+            f"collective sequence diverged at op #{op_index + 1}: {who}. "
+            "One rank took a different code path; without this sentinel "
+            "every healthy rank would hang in its collective to the "
+            f"timeout. Recent traces: "
+            + "; ".join(
+                f"rank {r}: {tr[-8:]}" for r, tr in sorted(traces.items())
+            ),
+            op_index=op_index,
+            ranks=at,
+            traces=traces,
+        )
+        return err
+
+    def _raise(self, err: CollectiveDivergenceError) -> None:
+        with self._vlock:
+            self._violations.append(err)
+        raise err
+
+    def _unwrap(self, item: Any) -> Tuple[Dict[str, Any], Any]:
+        if (
+            isinstance(item, tuple)
+            and len(item) == 3
+            and item[0] == _CSEQ_MAGIC
+            and isinstance(item[1], dict)
+        ):
+            return item[1], item[2]
+        raise CollectiveDivergenceError(
+            "collective-sequence sentinel received a raw (non-enveloped) "
+            "payload: a peer rank is running WITHOUT the sentinel. Enable "
+            "it on every rank of the gang (DTPU_COLLECTIVE_SENTINEL=1 / "
+            "lint.collective_sentinel) or on none."
+        )
+
+    # -- patched entry points ----------------------------------------------
+
+    def _solo(self, dist: Any, op: str) -> bool:
+        """Single-participant group: record the op (the sequence ledger
+        stays complete) but skip the envelope — there is no peer to
+        verify against, and Dummy contexts sit on every local-experiment
+        hot path."""
+        size = dist.local_size if op.endswith("_local") else dist.size
+        return size <= 1
+
+    def _exchange_allgather(
+        self, dist: Any, obj: Any, op: str, orig: Any
+    ) -> List[Any]:
+        st = self._state(dist)
+        sig = self._sig_for(st, op, obj)
+        env = st.snapshot()
+        env["op"] = sig
+        st.record(sig)
+        if self._solo(dist, op):
+            return orig(dist, obj)
+        result = orig(dist, (_CSEQ_MAGIC, env, obj))
+        pairs = [self._unwrap(r) for r in result]
+        err = self._divergence([p[0] for p in pairs])
+        if err is not None:
+            self._raise(err)
+        return [p[1] for p in pairs]
+
+    def _exchange_gather(
+        self, dist: Any, obj: Any, op: str, orig: Any
+    ) -> Optional[List[Any]]:
+        st = self._state(dist)
+        sig = self._sig_for(st, op, obj)
+        env = st.snapshot()
+        env["op"] = sig
+        st.record(sig)
+        if self._solo(dist, op):
+            return orig(dist, obj)
+        result = orig(dist, (_CSEQ_MAGIC, env, obj))
+        if result is None:
+            return None  # worker side: the chief verifies
+        pairs = [self._unwrap(r) for r in result]
+        err = self._divergence([p[0] for p in pairs])
+        if err is not None:
+            self._raise(err)
+        return [p[1] for p in pairs]
+
+    def _exchange_broadcast(self, dist: Any, obj: Any, op: str, orig: Any) -> Any:
+        st = self._state(dist)
+        sig = self._sig_for(st, op, obj)
+        env = st.snapshot()
+        env["op"] = sig
+        st.record(sig)
+        if self._solo(dist, op):
+            return orig(dist, obj)
+        result = orig(dist, (_CSEQ_MAGIC, env, obj))
+        peer_env, payload = self._unwrap(result)
+        # one-sided verification: each receiver compares the chief's
+        # envelope against its OWN expected position
+        if (
+            peer_env["seq"] != env["seq"]
+            or peer_env["op"] != env["op"]
+            or peer_env["digest"] != env["digest"]
+        ):
+            err = self._divergence([env, peer_env])
+            if err is not None:
+                self._raise(err)
+        return payload
+
+    def install(self) -> "CollectiveSequenceSentinel":
+        if self._installed:
+            return self
+        from determined_tpu.core._distributed import DistributedContext
+
+        sentinel = self
+        orig = {
+            "allgather": DistributedContext.allgather,
+            "allgather_local": DistributedContext.allgather_local,
+            "gather": DistributedContext.gather,
+            "gather_local": DistributedContext.gather_local,
+            "broadcast": DistributedContext.broadcast,
+            "broadcast_local": DistributedContext.broadcast_local,
+            "barrier": DistributedContext.barrier,
+        }
+        self._orig = orig
+
+        def allgather(self, obj):
+            return sentinel._exchange_allgather(self, obj, "allgather", orig["allgather"])
+
+        def allgather_local(self, obj):
+            return sentinel._exchange_allgather(
+                self, obj, "allgather_local", orig["allgather_local"]
+            )
+
+        def gather(self, obj):
+            return sentinel._exchange_gather(self, obj, "gather", orig["gather"])
+
+        def gather_local(self, obj):
+            return sentinel._exchange_gather(
+                self, obj, "gather_local", orig["gather_local"]
+            )
+
+        def broadcast(self, obj=None):
+            return sentinel._exchange_broadcast(
+                self, obj, "broadcast", orig["broadcast"]
+            )
+
+        def broadcast_local(self, obj=None):
+            return sentinel._exchange_broadcast(
+                self, obj, "broadcast_local", orig["broadcast_local"]
+            )
+
+        def barrier(self):
+            # route the barrier through the verified allgather so it gets
+            # the full both-directions check (it IS an allgather(None))
+            sentinel._exchange_allgather(self, None, "barrier", orig["allgather"])
+
+        DistributedContext.allgather = allgather
+        DistributedContext.allgather_local = allgather_local
+        DistributedContext.gather = gather
+        DistributedContext.gather_local = gather_local
+        DistributedContext.broadcast = broadcast
+        DistributedContext.broadcast_local = broadcast_local
+        DistributedContext.barrier = barrier
+        self._installed = True
+        logger.info("collective-sequence sentinel installed")
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        from determined_tpu.core._distributed import DistributedContext
+
+        for name, fn in self._orig.items():
+            setattr(DistributedContext, name, fn)
+        self._installed = False
+
+    def __enter__(self) -> "CollectiveSequenceSentinel":
+        return self.install()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.uninstall()
+
+
+_collective_sentinel: Optional[CollectiveSequenceSentinel] = None
+
+
+def get_collective_sentinel() -> CollectiveSequenceSentinel:
+    """Process-global sentinel (one process = one rank = one sequence)."""
+    global _collective_sentinel
+    if _collective_sentinel is None:
+        _collective_sentinel = CollectiveSequenceSentinel()
+    return _collective_sentinel
+
+
 class ThreadLeakError(RuntimeError):
     """Threads outlived the scope that owned them."""
 

@@ -1,0 +1,1025 @@
+"""Whole-program SPMD correctness analysis: rank-divergence hazards.
+
+This is the third whole-program pass of the preflight analyzer (after the
+per-module walker in ``_ast.py`` and the concurrency pass in
+``_concurrency.py``).  The failure mode it targets is specific to
+multi-host gangs and is the worst one distributed training has: not a
+crash but a **hang** — one rank takes a different code path, issues a
+different (or no) collective, and every healthy rank blocks into the
+600-second collective timeout with zero diagnostics.  We have hit this
+class live twice (the ``_drain_pending_save`` healthy-ranks-hang, the
+gloo checkpoint-thread/psum interleave SIGABRT), both found by humans
+staring at stack dumps.
+
+The pass reuses the concurrency pass's ``ProgramIndex`` (module/class/
+function index, cross-module call resolution, witness chains) and drives
+five rules over it:
+
+- **rank-dependent-collective** — an ``if``/``elif`` conditioned on rank
+  (``jax.process_index()``, ``dist.rank``, ``is_chief``, ``DTPU_RANK``
+  env) whose branches reach DIFFERENT collective sets.  One rank enters
+  a collective the others never issue.
+- **conditional-collective-escape** — a guarded ``raise``/``return``/
+  ``break`` between two collectives (or a rank-dependent loop around
+  one): the path where one rank exits the collective sequence early and
+  the rest block forever.  The blessed fix — exchange the local fact
+  first, then escape on the *exchanged* value so every rank escapes
+  together (``Trainer._drain_pending_save``) — is recognized: a guard
+  that references a value derived from a collective result is
+  rank-uniform and exempt.
+- **unordered-iteration-feeding-collective** — iteration over ``set``/
+  ``frozenset``/``os.listdir``/``glob``/``iterdir`` (genuinely
+  unordered or order-unstable across processes) that issues collectives
+  per element or builds a payload a later collective carries: ranks
+  agree on the elements but not the order, so their collective
+  sequences interleave differently.
+- **rank-guarded-io-missing-barrier** — a chief-only write followed by
+  an unguarded read with no collective between them: non-chief ranks
+  race the chief's filesystem effects.
+- **wall-clock-divergence** — ``time.*``/unseeded ``random``/``uuid``
+  controlling whether a collective runs ("save every 60s"), or riding
+  an operand that must match across ranks.  Clocks and unseeded RNG are
+  the sneakiest rank-divergent inputs because they differ on every host
+  *every run*.  ``broadcast`` of such a value is the fix (one rank's
+  sample, distributed) and is exempt.
+
+Detection is deliberately conservative and syntactic where resolution
+would guess: a collective is a ``jax.lax`` collective by name, a
+``multihost_utils`` entry point, or a ``gather/allgather/broadcast/
+barrier/...`` method on a receiver that is recognizably a distributed
+context (``dist``, ``self._dist``, ``self.core.distributed``, the
+``_global``/``_local`` stars).  An unresolvable call contributes
+nothing, so every finding names a concrete path.  The runtime companion
+is ``lint/_runtime.py``'s ``CollectiveSequenceSentinel``, which checks
+the ACTUAL per-rank collective sequence the same way the lock-order
+sentinel checks actual acquisitions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from determined_tpu.lint._ast import dotted_name
+from determined_tpu.lint._concurrency import (
+    FuncInfo,
+    ProgramIndex,
+    _Reporter,
+    _chain_str,
+    _walk_pruning_defs,
+)
+from determined_tpu.lint._diag import Diagnostic
+
+#: jax.lax tensor-plane collectives (by last name segment; the full name
+#: must look like a lax/jax call so a stray method of the same name on an
+#: unrelated object stays quiet)
+_TENSOR_COLLECTIVES = frozenset(
+    {
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "all_gather",
+        "all_to_all",
+        "ppermute",
+        "pshuffle",
+        "psum_scatter",
+    }
+)
+#: jax.experimental.multihost_utils entry points (unambiguous names:
+#: match on the last segment wherever they appear)
+_MULTIHOST_COLLECTIVES = frozenset(
+    {
+        "sync_global_devices",
+        "process_allgather",
+        "broadcast_one_to_all",
+    }
+)
+#: control-plane collective METHODS (DistributedContext and the _Star
+#: transports under it)
+_DIST_METHODS = frozenset(
+    {
+        "allgather",
+        "gather",
+        "broadcast",
+        "barrier",
+        "allgather_local",
+        "gather_local",
+        "broadcast_local",
+        "scatter_same",
+    }
+)
+#: receiver name tails that identify a distributed context: the final
+#: segment of the receiver's dotted name (``self.core.distributed`` ->
+#: ``distributed``); class-based resolution through the index backs this
+#: up when the attr's ctor is visible
+_DIST_RECEIVER_TAILS = frozenset(
+    {
+        "dist",
+        "_dist",
+        "distributed",
+        "_distributed",
+        "distributed_context",
+        "_global",
+        "_local",
+        "star",
+        "_star",
+    }
+)
+#: one-rank-payload ops: the canonical FIX for divergent inputs (chief
+#: samples, everyone receives the same value) — exempt from the
+#: wall-clock-divergence operand check
+_BROADCAST_OPS = frozenset({"broadcast", "broadcast_local", "broadcast_one_to_all"})
+
+#: attribute reads that carry the process's rank identity
+_RANK_ATTRS = frozenset(
+    {
+        "rank",
+        "group_rank",
+        "local_rank",
+        "cross_rank",
+        "node_rank",
+        "process_rank",
+        "is_chief",
+        "is_local_chief",
+        "process_index",
+    }
+)
+#: attributes that look rank-adjacent but are rank-UNIFORM (same value on
+#: every process) — branching on these is safe and must never be flagged
+_UNIFORM_ATTRS = frozenset({"size", "local_size", "cross_size", "process_count"})
+#: bare names that carry rank identity (parameters, rendezvous locals)
+_RANK_NAMES = frozenset(
+    {
+        "rank",
+        "group_rank",
+        "local_rank",
+        "cross_rank",
+        "node_rank",
+        "process_rank",
+        "is_chief",
+        "is_local_chief",
+    }
+)
+#: call name tails returning the process's rank
+_RANK_CALL_TAILS = frozenset({"process_index"})
+
+#: wall-clock / unseeded-randomness sources (full dotted name prefixes)
+_DIVERGENT_PREFIXES = (
+    "time.",
+    "datetime.",
+    "random.",
+    "np.random.",
+    "numpy.random.",
+    "secrets.",
+)
+_DIVERGENT_CALLS = frozenset(
+    {
+        "time.time",
+        "time.monotonic",
+        "time.perf_counter",
+        "time.time_ns",
+        "time.monotonic_ns",
+        "datetime.now",
+        "datetime.utcnow",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+        "os.urandom",
+        "uuid.uuid1",
+        "uuid.uuid4",
+        "uuid_mod.uuid1",
+        "uuid_mod.uuid4",
+    }
+)
+
+#: unordered (or cross-process order-unstable) iteration sources, by
+#: callable last segment
+_UNORDERED_ITER_TAILS = frozenset(
+    {"listdir", "iterdir", "scandir", "glob", "iglob"}
+)
+
+#: write-effect call tails for the rank-guarded-io rule
+_WRITE_IO_TAILS = frozenset(
+    {
+        "makedirs",
+        "mkdir",
+        "write_text",
+        "write_bytes",
+        "dump",
+        "save",
+        "save_arrays",
+        "save_trainer_state",
+        "rename",
+        "replace",
+        "copyfile",
+        "copytree",
+        "copy2",
+        "move",
+        "symlink",
+    }
+)
+#: read-effect call tails (what non-chief ranks race on)
+_READ_IO_TAILS = frozenset(
+    {
+        "load",
+        "read_text",
+        "read_bytes",
+        "load_arrays",
+        "getsize",
+        "getmtime",
+    }
+)
+
+_MAX_CALL_DEPTH = 8
+
+
+# ---------------------------------------------------------------------------
+# collective detection
+# ---------------------------------------------------------------------------
+
+
+def collective_label(
+    index: ProgramIndex, fn: FuncInfo, node: ast.Call
+) -> Optional[str]:
+    """Op label ("psum", "allgather", ...) when this call is a collective,
+    else None."""
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    tail = parts[-1]
+    if tail in _MULTIHOST_COLLECTIVES:
+        return tail
+    if tail in _TENSOR_COLLECTIVES:
+        # jax.lax.psum / lax.psum / jax.psum — require a jax-ish prefix so
+        # an unrelated object's method of the same name stays quiet
+        # (DistributedContext has no such methods; `all_gather` etc. only
+        # exist on jax modules in this codebase)
+        if len(parts) == 1 or parts[0] in ("jax", "lax", "jnp", "pl", "plgpu"):
+            return tail
+        return None
+    if tail in _DIST_METHODS and isinstance(node.func, ast.Attribute):
+        recv = node.func.value
+        recv_name = dotted_name(recv)
+        if recv_name:
+            recv_tail = recv_name.split(".")[-1]
+            if recv_tail in _DIST_RECEIVER_TAILS:
+                return tail
+            # class-based resolution: `self.comm.allgather(...)` where
+            # __init__ shows `self.comm = DistributedContext(...)`
+            recv_parts = recv_name.split(".")
+            if (
+                recv_parts[0] == "self"
+                and len(recv_parts) == 2
+                and fn.cls is not None
+            ):
+                ctor = fn.cls.attr_ctors.get(recv_parts[1], "")
+                if "Distributed" in ctor.split(".")[-1]:
+                    return tail
+    return None
+
+
+def _is_rank_env_read(node: ast.Call) -> bool:
+    """``os.environ.get("DTPU_RANK")`` / ``os.getenv("...RANK...")``."""
+    name = dotted_name(node.func) or ""
+    if name not in ("os.environ.get", "os.getenv", "environ.get", "getenv"):
+        return False
+    for arg in node.args[:1]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return "RANK" in arg.value.upper()
+    return False
+
+
+def _is_divergent_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if not name:
+        return False
+    if name in _DIVERGENT_CALLS:
+        return True
+    if name.startswith(("np.random.", "numpy.random.", "random.", "secrets.")):
+        # unseeded module-level randomness; an rng OBJECT built from an
+        # explicit seed (`rng = random.Random(seed)`) has a different
+        # receiver and is never matched here
+        return name.split(".")[-1] not in ("Random", "default_rng", "seed")
+    return False
+
+
+class _FnFacts:
+    """Per-function taint facts: which local names carry rank identity,
+    which are rank-uniform (derived from a collective's result), which
+    carry wall-clock/unseeded-random values."""
+
+    __slots__ = ("rank", "uniform", "divergent")
+
+    def __init__(self) -> None:
+        self.rank: Set[str] = set()
+        self.uniform: Set[str] = set()
+        self.divergent: Set[str] = set()
+
+
+def _assigned_names(target: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            out.add(n.id)
+    return out
+
+
+def _expr_calls(expr: ast.AST):
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _expr_has_rank_source(
+    index: ProgramIndex, fn: FuncInfo, expr: ast.AST, facts: Optional[_FnFacts]
+) -> bool:
+    """Does this expression read the process's rank identity?"""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute) and sub.attr in _RANK_ATTRS:
+            # `.process_index` as a method REFERENCE is caught by the call
+            # check; the attribute read form (`dist.rank`) lands here
+            return True
+        if isinstance(sub, ast.Name):
+            if sub.id in _RANK_NAMES:
+                return True
+            if facts is not None and sub.id in facts.rank:
+                return True
+        if isinstance(sub, ast.Call):
+            name = dotted_name(sub.func) or ""
+            if name.split(".")[-1] in _RANK_CALL_TAILS:
+                return True
+            if _is_rank_env_read(sub):
+                return True
+    return False
+
+
+def _expr_has_uniform_source(
+    index: ProgramIndex, fn: FuncInfo, expr: ast.AST, facts: _FnFacts
+) -> bool:
+    """Does this expression reference a value every rank computed
+    identically (a collective's result, or a name derived from one)?"""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and sub.id in facts.uniform:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in _UNIFORM_ATTRS:
+            return True
+        if isinstance(sub, ast.Call) and collective_label(index, fn, sub):
+            return True
+    return False
+
+
+def _expr_has_divergent_source(expr: ast.AST, facts: _FnFacts) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and _is_divergent_call(sub):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in facts.divergent:
+            return True
+    return False
+
+
+def _compute_facts(index: ProgramIndex, fn: FuncInfo) -> _FnFacts:
+    """Two forward passes of name-level taint over the function body
+    (flow-insensitive, like the step-taint in ``_ast.py``): collective
+    results make names rank-UNIFORM; rank/clock sources make them
+    rank-dependent/divergent.  Uniform wins on reassignment from a
+    collective — that ordering is what blesses the exchange-then-escape
+    idiom."""
+    facts = _FnFacts()
+    body = getattr(fn.node, "body", [])
+    for _ in range(2):
+        for stmt in body:
+            for sub in _walk_pruning_defs(stmt):
+                pairs: List[Tuple[ast.AST, ast.AST]] = []
+                if isinstance(sub, ast.Assign) and sub.value is not None:
+                    pairs = [(t, sub.value) for t in sub.targets]
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    pairs = [(sub.target, sub.value)]
+                elif isinstance(sub, ast.AugAssign):
+                    pairs = [(sub.target, sub.value)]
+                for target, value in pairs:
+                    names = _assigned_names(target)
+                    if not names:
+                        continue
+                    has_collective = any(
+                        collective_label(index, fn, c) for c in _expr_calls(value)
+                    )
+                    if has_collective:
+                        # the exchanged value is identical on every rank
+                        facts.uniform |= names
+                        facts.rank -= names
+                        facts.divergent -= names
+                        continue
+                    if _expr_has_rank_source(index, fn, value, facts):
+                        facts.rank |= names
+                    if _expr_has_divergent_source(value, facts):
+                        facts.divergent |= names
+                    if _expr_has_uniform_source(index, fn, value, facts):
+                        facts.uniform |= names
+    return facts
+
+
+# ---------------------------------------------------------------------------
+# transitive collective summaries
+# ---------------------------------------------------------------------------
+
+
+class SpmdAnalyzer:
+    """Memoized per-function facts + transitive collective summaries."""
+
+    def __init__(self, index: ProgramIndex) -> None:
+        self.index = index
+        self._facts: Dict[int, _FnFacts] = {}
+        self._summaries: Dict[int, Dict[str, Tuple[str, ...]]] = {}
+        self._in_progress: Set[int] = set()
+
+    def facts(self, fn: FuncInfo) -> _FnFacts:
+        key = id(fn)
+        if key not in self._facts:
+            self._facts[key] = _compute_facts(self.index, fn)
+        return self._facts[key]
+
+    def summary(self, fn: FuncInfo, depth: int = 0) -> Dict[str, Tuple[str, ...]]:
+        """op label -> witness chain of ``qname:line`` hops, transitively
+        through resolvable calls.  Truncated (depth/recursion) summaries
+        are never cached — same contract as the concurrency analyzer."""
+        return self._summary_impl(fn, depth)[0]
+
+    def _summary_impl(
+        self, fn: FuncInfo, depth: int
+    ) -> Tuple[Dict[str, Tuple[str, ...]], bool]:
+        key = id(fn)
+        cached = self._summaries.get(key)
+        if cached is not None:
+            return cached, True
+        out: Dict[str, Tuple[str, ...]] = {}
+        if depth > _MAX_CALL_DEPTH or key in self._in_progress:
+            return out, False
+        complete = True
+        self._in_progress.add(key)
+        try:
+            for sub in _walk_pruning_defs(fn.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                site = f"{fn.qname}:{getattr(sub, 'lineno', 0)}"
+                label = collective_label(self.index, fn, sub)
+                if label is not None:
+                    out.setdefault(label, (site,))
+                    continue
+                callee = self.index.resolve_call(fn, sub)
+                if callee is not None and callee is not fn:
+                    inner, sub_complete = self._summary_impl(callee, depth + 1)
+                    complete = complete and sub_complete
+                    for op, chain in inner.items():
+                        out.setdefault(op, (site,) + chain)
+        finally:
+            self._in_progress.discard(key)
+        if complete:
+            self._summaries[key] = out
+        return out, complete
+
+    def stmts_collectives(
+        self, fn: FuncInfo, stmts: Sequence[ast.stmt]
+    ) -> Dict[str, Tuple[str, ...]]:
+        """Collective ops reachable from a statement list (direct calls
+        plus transitive through resolvable calls), with witness chains."""
+        out: Dict[str, Tuple[str, ...]] = {}
+        for stmt in stmts:
+            for sub in _walk_pruning_defs(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                site = f"{fn.qname}:{getattr(sub, 'lineno', 0)}"
+                label = collective_label(self.index, fn, sub)
+                if label is not None:
+                    out.setdefault(label, (site,))
+                    continue
+                callee = self.index.resolve_call(fn, sub)
+                if callee is not None and callee is not fn:
+                    for op, chain in self.summary(callee, 1).items():
+                        out.setdefault(op, (site,) + chain)
+        return out
+
+    def all_functions(self) -> List[FuncInfo]:
+        out: List[FuncInfo] = []
+
+        def add(fn: FuncInfo) -> None:
+            out.append(fn)
+            for child in fn.children.values():
+                add(child)
+
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                add(fn)
+            for cls in mod.classes.values():
+                for fn in cls.methods.values():
+                    add(fn)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+
+def _fmt_ops(ops: Dict[str, Tuple[str, ...]]) -> str:
+    return ", ".join(
+        f"`{op}` (via {_chain_str(chain)})" for op, chain in sorted(ops.items())
+    )
+
+
+def _check_rank_dependent_collective(
+    analyzer: SpmdAnalyzer, reporter: _Reporter, rule: Any, fn: FuncInfo
+) -> None:
+    facts = analyzer.facts(fn)
+    for sub in _walk_pruning_defs(fn.node):
+        if not isinstance(sub, ast.If):
+            continue
+        if not _expr_has_rank_source(analyzer.index, fn, sub.test, facts):
+            continue
+        if _expr_has_uniform_source(analyzer.index, fn, sub.test, facts):
+            # the branch decision came out of a collective: every rank
+            # takes the same side
+            continue
+        body_ops = analyzer.stmts_collectives(fn, sub.body)
+        else_ops = analyzer.stmts_collectives(fn, sub.orelse)
+        if not body_ops and not else_ops:
+            continue
+        # compare the SETS of ops: branches that reach the same collective
+        # set through different paths (error vs ok broadcast in
+        # restore_path) stay legal; a set difference means some rank
+        # skips (or adds) a collective entirely
+        missing = set(body_ops) ^ set(else_ops)
+        if not missing:
+            continue
+        one_sided = {
+            op: (body_ops.get(op) or else_ops.get(op) or ())
+            for op in sorted(missing)
+        }
+        reporter.report(
+            rule,
+            fn.module,
+            sub,
+            "collective guarded by a rank-dependent condition: "
+            f"{_fmt_ops(one_sided)} runs on only one side of this branch, "
+            "so ranks on the other side never enter it and the gang hangs "
+            "to the collective timeout; either run the collective on every "
+            "rank (exchange the fact, then branch on the result) or hoist "
+            "it out of the rank test",
+        )
+
+
+class _Escape:
+    __slots__ = ("node", "kind", "guard", "loop")
+
+    def __init__(self, node: ast.stmt, kind: str, guard: Optional[ast.AST],
+                 loop: Optional[ast.AST]) -> None:
+        self.node = node
+        self.kind = kind
+        self.guard = guard
+        self.loop = loop
+
+
+def _collect_escapes(fn: FuncInfo) -> List[_Escape]:
+    """Guarded ``raise``/``return``/``break`` statements.  ``guard`` is
+    the innermost enclosing If's test (None = unconditional: every rank
+    takes it together, not a divergence).  Escapes inside ``except``
+    handlers are excluded: exception paths out of a failed collective are
+    the transport's own error propagation, not a code-path split."""
+    out: List[_Escape] = []
+
+    def walk(node: ast.AST, guard: Optional[ast.AST], loop: Optional[ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.If):
+            for child in node.body:
+                walk(child, node.test, loop)
+            for child in node.orelse:
+                # elif chains nest as If-in-orelse and re-guard themselves
+                walk(child, node.test, loop)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            inner_loop = node
+            for child in node.body:
+                walk(child, guard, inner_loop)
+            for child in node.orelse:
+                walk(child, guard, loop)
+            return
+        if isinstance(node, ast.Try):
+            for child in node.body:
+                walk(child, guard, loop)
+            for child in node.orelse:
+                walk(child, guard, loop)
+            for child in node.finalbody:
+                walk(child, guard, loop)
+            return  # handlers skipped by design
+        if isinstance(node, ast.Raise) and guard is not None:
+            out.append(_Escape(node, "raise", guard, loop))
+        elif isinstance(node, ast.Return) and guard is not None:
+            out.append(_Escape(node, "return", guard, loop))
+        elif isinstance(node, ast.Break) and guard is not None:
+            out.append(_Escape(node, "break", guard, loop))
+        for child in ast.iter_child_nodes(node):
+            walk(child, guard, loop)
+
+    for stmt in getattr(fn.node, "body", []):
+        walk(stmt, None, None)
+    return out
+
+
+def _collective_sites(
+    analyzer: SpmdAnalyzer, fn: FuncInfo
+) -> List[Tuple[int, str, Tuple[str, ...]]]:
+    """(line, op, chain) for every point in this function that reaches a
+    collective — direct calls and resolvable calls whose summaries
+    contain one."""
+    out: List[Tuple[int, str, Tuple[str, ...]]] = []
+    for sub in _walk_pruning_defs(fn.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        line = getattr(sub, "lineno", 0)
+        site = f"{fn.qname}:{line}"
+        label = collective_label(analyzer.index, fn, sub)
+        if label is not None:
+            out.append((line, label, (site,)))
+            continue
+        callee = analyzer.index.resolve_call(fn, sub)
+        if callee is not None and callee is not fn:
+            for op, chain in analyzer.summary(callee, 1).items():
+                out.append((line, op, (site,) + chain))
+    out.sort()
+    return out
+
+
+def _check_conditional_collective_escape(
+    analyzer: SpmdAnalyzer, reporter: _Reporter, rule: Any, fn: FuncInfo
+) -> None:
+    facts = analyzer.facts(fn)
+    # escape analysis covers HOST-side collectives only (control-plane
+    # stars, multihost_utils).  Tensor-plane ops (psum/ppermute/...) live
+    # in traced code where jax itself forbids branching on runtime values:
+    # a Python guard there is resolved ONCE at trace time from config, so
+    # an "escape" is the same trace-time decision on every rank, not a
+    # runtime divergence.  (A rank-DEPENDENT guard in traced code still
+    # traces different programs per rank — the rank-dependent-collective
+    # and loop checks below cover that, tensor ops included.)
+    sites = [
+        s for s in _collective_sites(analyzer, fn)
+        if s[1] not in _TENSOR_COLLECTIVES
+    ]
+
+    # -- rank-dependent loops around collectives ---------------------------
+    for sub in _walk_pruning_defs(fn.node):
+        trip_expr: Optional[ast.AST] = None
+        if isinstance(sub, (ast.For, ast.AsyncFor)):
+            trip_expr = sub.iter
+        elif isinstance(sub, ast.While):
+            trip_expr = sub.test
+        if trip_expr is None:
+            continue
+        if not _expr_has_rank_source(analyzer.index, fn, trip_expr, facts):
+            continue
+        if _expr_has_uniform_source(analyzer.index, fn, trip_expr, facts):
+            continue
+        ops = analyzer.stmts_collectives(fn, sub.body)
+        if ops:
+            reporter.report(
+                rule,
+                fn.module,
+                sub,
+                f"collective inside a loop whose trip count is "
+                f"rank-dependent: {_fmt_ops(ops)} — ranks run DIFFERENT "
+                "numbers of iterations, so one rank's extra collective has "
+                "no partner and the gang hangs; derive the trip count from "
+                "rank-uniform data (exchange it first) or hoist the "
+                "collective out of the loop",
+            )
+
+    if not sites:
+        return
+
+    # -- guarded escapes between collectives -------------------------------
+    for esc in _collect_escapes(fn):
+        guard = esc.guard
+        assert guard is not None
+        if _expr_has_uniform_source(analyzer.index, fn, guard, facts):
+            # exchange-then-escape: the guard came out of a collective, so
+            # every rank escapes together (the _drain_pending_save idiom)
+            continue
+        line = getattr(esc.node, "lineno", 0)
+        if esc.kind == "break":
+            loop = esc.loop
+            if loop is None:
+                continue
+            ops = {
+                op: chain
+                for op, chain in analyzer.stmts_collectives(fn, loop.body).items()
+                if op not in _TENSOR_COLLECTIVES
+            }
+            if ops:
+                reporter.report(
+                    rule,
+                    fn.module,
+                    esc.node,
+                    f"conditional `break` inside a collective loop "
+                    f"({_fmt_ops(ops)}): a rank whose local condition fires "
+                    "stops issuing collectives while its peers keep going; "
+                    "exchange the stop decision (allgather the flag, break "
+                    "on any()) so every rank leaves the loop on the same "
+                    "iteration",
+                )
+            continue
+        before = [s for s in sites if s[0] < line]
+        after = [s for s in sites if s[0] > line]
+        if not before or not after:
+            continue
+        b_line, b_op, b_chain = before[-1]
+        a_line, a_op, a_chain = after[0]
+        reporter.report(
+            rule,
+            fn.module,
+            esc.node,
+            f"conditional `{esc.kind}` between collectives: a rank whose "
+            f"local condition fires leaves after `{b_op}` (line {b_line}) "
+            f"and never reaches `{a_op}` (via {_chain_str(a_chain)}), so "
+            "the remaining ranks block there until the collective timeout; "
+            "exchange the local fact first (allgather it) and escape on "
+            "the exchanged value so every rank escapes together",
+        )
+
+
+def _unordered_iter_reason(node: ast.AST) -> Optional[str]:
+    """Why this iteration source has no cross-process order, or None."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "a set"
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if not name:
+            return None
+        tail = name.split(".")[-1]
+        if tail in ("set", "frozenset") and len(name.split(".")) == 1:
+            return f"`{tail}(...)`"
+        if tail in _UNORDERED_ITER_TAILS:
+            return f"`{name}(...)` (filesystem enumeration order)"
+    if isinstance(node, ast.Attribute) and dotted_name(node) == "os.environ":
+        return "`os.environ` (environment order differs across hosts)"
+    return None
+
+
+def _check_unordered_iteration(
+    analyzer: SpmdAnalyzer, reporter: _Reporter, rule: Any, fn: FuncInfo
+) -> None:
+    # names appended/extended inside unordered loops, to catch payloads a
+    # LATER collective carries
+    deferred: List[Tuple[ast.AST, str, Set[str]]] = []
+    for sub in _walk_pruning_defs(fn.node):
+        if not isinstance(sub, (ast.For, ast.AsyncFor)):
+            continue
+        reason = _unordered_iter_reason(sub.iter)
+        if reason is None:
+            continue
+        ops = analyzer.stmts_collectives(fn, sub.body)
+        if ops:
+            reporter.report(
+                rule,
+                fn.module,
+                sub,
+                f"collective issued while iterating {reason}: "
+                f"{_fmt_ops(ops)} — iteration order is not guaranteed to "
+                "match across ranks, so their collective sequences "
+                "interleave differently and the gang deadlocks or merges "
+                "the wrong pairs; iterate `sorted(...)` instead",
+            )
+            continue
+        grown: Set[str] = set()
+        for inner in sub.body:
+            for call in _walk_pruning_defs(inner):
+                if (
+                    isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in ("append", "extend", "add", "update")
+                    and isinstance(call.func.value, ast.Name)
+                ):
+                    grown.add(call.func.value.id)
+        if grown:
+            deferred.append((sub, reason, grown))
+    if not deferred:
+        return
+    for sub in _walk_pruning_defs(fn.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        label = collective_label(analyzer.index, fn, sub)
+        if label is None:
+            continue
+        arg_names = {
+            n.id
+            for arg in list(sub.args) + [kw.value for kw in sub.keywords]
+            for n in ast.walk(arg)
+            if isinstance(n, ast.Name)
+        }
+        for loop_node, reason, grown in deferred:
+            hit = arg_names & grown
+            if hit and getattr(sub, "lineno", 0) > getattr(loop_node, "lineno", 0):
+                reporter.report(
+                    rule,
+                    fn.module,
+                    loop_node,
+                    f"payload `{sorted(hit)[0]}` is built while iterating "
+                    f"{reason} and later crosses `{label}` (line "
+                    f"{getattr(sub, 'lineno', 0)}): element order differs "
+                    "across ranks, so the exchanged payloads disagree even "
+                    "when their contents match; build it from `sorted(...)`",
+                )
+                break
+
+
+def _open_write_mode(node: ast.Call) -> Optional[bool]:
+    """True write-mode open, False read-mode open, None not an open."""
+    name = dotted_name(node.func)
+    if not name or name.split(".")[-1] != "open":
+        return None
+    mode = None
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if isinstance(mode, str):
+        return any(c in mode for c in "wax+")
+    return False  # bare open(path): read
+
+
+def _io_kind(node: ast.Call) -> Optional[str]:
+    """"write" / "read" classification for the rank-guarded-io rule."""
+    is_write = _open_write_mode(node)
+    if is_write is not None:
+        return "write" if is_write else "read"
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    tail = name.split(".")[-1]
+    if tail in _WRITE_IO_TAILS:
+        return "write"
+    if tail in _READ_IO_TAILS:
+        return "read"
+    if tail in ("exists", "isfile", "isdir", "stat"):
+        # probing for the chief's output is the canonical racy read
+        return "read"
+    return None
+
+
+def _check_rank_guarded_io(
+    analyzer: SpmdAnalyzer, reporter: _Reporter, rule: Any, fn: FuncInfo
+) -> None:
+    facts = analyzer.facts(fn)
+    # ordered event stream: (line, kind, node) where kind is
+    # "guard_write" (rank-guarded If containing a write), "sync"
+    # (collective), or "read" (unguarded read)
+    events: List[Tuple[int, str, ast.AST, str]] = []
+
+    def walk(node: ast.AST, guarded: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.If) and _expr_has_rank_source(
+            analyzer.index, fn, node.test, facts
+        ):
+            writes = [
+                sub
+                for child in node.body
+                for sub in _walk_pruning_defs(child)
+                if isinstance(sub, ast.Call) and _io_kind(sub) == "write"
+            ]
+            if writes:
+                end = getattr(node, "end_lineno", getattr(node, "lineno", 0))
+                events.append((end, "guard_write", node, ""))
+            for child in node.body:
+                walk(child, True)
+            for child in node.orelse:
+                walk(child, True)
+            return
+        if isinstance(node, ast.Call):
+            label = collective_label(analyzer.index, fn, node)
+            if label is not None:
+                events.append((getattr(node, "lineno", 0), "sync", node, label))
+            else:
+                callee = analyzer.index.resolve_call(fn, node)
+                if callee is not None and callee is not fn and analyzer.summary(
+                    callee, 1
+                ):
+                    events.append((getattr(node, "lineno", 0), "sync", node, "call"))
+                elif not guarded and _io_kind(node) == "read":
+                    events.append((getattr(node, "lineno", 0), "read", node, ""))
+        for child in ast.iter_child_nodes(node):
+            walk(child, guarded)
+
+    for stmt in getattr(fn.node, "body", []):
+        walk(stmt, False)
+
+    events.sort(key=lambda e: e[0])
+    pending_guard: Optional[Tuple[int, ast.AST]] = None
+    for line, kind, node, _label in events:
+        if kind == "guard_write":
+            pending_guard = (line, node)
+        elif kind == "sync":
+            pending_guard = None
+        elif kind == "read" and pending_guard is not None:
+            g_line = getattr(pending_guard[1], "lineno", 0)
+            reporter.report(
+                rule,
+                fn.module,
+                node,
+                f"read of filesystem state the rank-guarded write (line "
+                f"{g_line}) produces, with no collective between them: "
+                "non-chief ranks race the chief's write and read a "
+                "missing or half-written file; put a `barrier()` (or any "
+                "collective) between the chief-only write and the "
+                "all-rank read",
+            )
+            pending_guard = None  # one finding per guard/read pair
+
+
+def _check_wall_clock_divergence(
+    analyzer: SpmdAnalyzer, reporter: _Reporter, rule: Any, fn: FuncInfo
+) -> None:
+    facts = analyzer.facts(fn)
+    # (a) clock/rng-guarded collectives: "save every 60 seconds" — each
+    # rank's clock fires on a different step, so their sequences diverge
+    for sub in _walk_pruning_defs(fn.node):
+        test: Optional[ast.AST] = None
+        if isinstance(sub, ast.If):
+            test = sub.test
+        elif isinstance(sub, ast.While):
+            test = sub.test
+        if test is None:
+            continue
+        if not _expr_has_divergent_source(test, facts):
+            continue
+        if _expr_has_uniform_source(analyzer.index, fn, test, facts):
+            continue
+        ops = analyzer.stmts_collectives(fn, sub.body)
+        if ops:
+            reporter.report(
+                rule,
+                fn.module,
+                sub,
+                f"collective guarded by wall-clock/unseeded randomness: "
+                f"{_fmt_ops(ops)} — each rank's clock or RNG fires at a "
+                "different moment, so ranks disagree on WHETHER to enter "
+                "the collective and the gang hangs; decide from a "
+                "rank-uniform quantity (step count) or let the chief "
+                "decide and `broadcast` the decision",
+            )
+    # (b) divergent operand crossing an exchange whose payloads must be
+    # comparable (allgather/tensor collectives); broadcast and gather are
+    # exempt — one-rank payload and chief-consumed diagnostics
+    for sub in _walk_pruning_defs(fn.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        label = collective_label(analyzer.index, fn, sub)
+        if label is None or label in _BROADCAST_OPS or label.startswith("gather"):
+            continue
+        if label in ("barrier",):
+            continue
+        for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+            if _expr_has_divergent_source(arg, facts):
+                reporter.report(
+                    rule,
+                    fn.module,
+                    sub,
+                    f"wall-clock/unseeded-random value crosses `{label}`: "
+                    "every rank contributes a different sample, so "
+                    "downstream decisions made from the merged result "
+                    "diverge run to run and rank to rank; journal a seed, "
+                    "derive the value from rank-uniform state, or have "
+                    "the chief sample once and `broadcast` it",
+                )
+                break
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+
+def run_spmd_pass(index: ProgramIndex, rules: Sequence[Any]) -> List[Diagnostic]:
+    by_id = {r.id: r for r in rules}
+    rank_rule = by_id.get("rank-dependent-collective")
+    escape_rule = by_id.get("conditional-collective-escape")
+    unordered_rule = by_id.get("unordered-iteration-feeding-collective")
+    io_rule = by_id.get("rank-guarded-io-missing-barrier")
+    clock_rule = by_id.get("wall-clock-divergence")
+    if not any((rank_rule, escape_rule, unordered_rule, io_rule, clock_rule)):
+        return []
+    analyzer = SpmdAnalyzer(index)
+    reporter = _Reporter(index)
+    for fn in analyzer.all_functions():
+        if rank_rule is not None:
+            _check_rank_dependent_collective(analyzer, reporter, rank_rule, fn)
+        if escape_rule is not None:
+            _check_conditional_collective_escape(analyzer, reporter, escape_rule, fn)
+        if unordered_rule is not None:
+            _check_unordered_iteration(analyzer, reporter, unordered_rule, fn)
+        if io_rule is not None:
+            _check_rank_guarded_io(analyzer, reporter, io_rule, fn)
+        if clock_rule is not None:
+            _check_wall_clock_divergence(analyzer, reporter, clock_rule, fn)
+    return reporter.diagnostics

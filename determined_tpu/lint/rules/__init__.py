@@ -82,6 +82,7 @@ from determined_tpu.lint.rules import (  # noqa: E402,F401
     host_sync,
     randomness,
     side_effects,
+    spmd,
     threads,
     wall_clock,
 )

@@ -1,0 +1,91 @@
+"""Whole-program SPMD correctness rules: rank-divergence hazards.
+
+Multi-host gangs (experiment/cluster.py + the devcluster harness) turned
+the dominant harness failure mode from "a thread deadlocks" into "a rank
+diverges": one process takes a different code path, issues a different
+(or no) collective, and every healthy rank blocks into the 600-second
+collective timeout with no diagnostics at all.  Both live instances of
+this class (the ``_drain_pending_save`` healthy-ranks-hang, the gloo
+checkpoint-thread/psum SIGABRT) were found by humans reading stack
+dumps.  These five rules find the *code shapes* that produce it; they
+are ``program_level`` and run over the same cross-module
+``ProgramIndex`` the concurrency rules use (``lint/_spmd.py`` drives
+them).
+
+The runtime companion is ``lint/_runtime.py``'s
+``CollectiveSequenceSentinel``, which digests the ACTUAL per-rank
+collective sequence and converts a live divergence into a deterministic
+``CollectiveDivergenceError`` instead of a hang.
+"""
+
+from __future__ import annotations
+
+from determined_tpu.lint._diag import WARNING
+from determined_tpu.lint.rules import Rule, register
+
+
+@register
+class RankDependentCollectiveRule(Rule):
+    id = "rank-dependent-collective"
+    severity = WARNING
+    program_level = True
+    description = (
+        "control flow conditioned on the process rank (jax.process_index(), "
+        "dist.rank/is_chief, DTPU_RANK env) guards a collective on only "
+        "some paths — ranks on the other path never enter it and the gang "
+        "hangs to the collective timeout"
+    )
+
+
+@register
+class ConditionalCollectiveEscapeRule(Rule):
+    id = "conditional-collective-escape"
+    severity = WARNING
+    program_level = True
+    description = (
+        "a guarded raise/return/break between paired collectives, or a "
+        "collective inside a loop with a rank-dependent trip count — the "
+        "path where one rank exits the collective sequence early while its "
+        "peers block; exchange the local fact first and escape on the "
+        "exchanged (rank-uniform) value"
+    )
+
+
+@register
+class UnorderedIterationFeedingCollectiveRule(Rule):
+    id = "unordered-iteration-feeding-collective"
+    severity = WARNING
+    program_level = True
+    description = (
+        "iteration over a set / os.listdir / glob / iterdir issues "
+        "collectives per element or builds a payload a collective carries "
+        "— element order is not guaranteed to match across ranks, so the "
+        "per-rank collective sequences (or payloads) disagree; iterate "
+        "sorted(...)"
+    )
+
+
+@register
+class RankGuardedIoMissingBarrierRule(Rule):
+    id = "rank-guarded-io-missing-barrier"
+    severity = WARNING
+    program_level = True
+    description = (
+        "a chief-only (rank-guarded) filesystem write followed by an "
+        "unguarded read with no collective between them — non-chief ranks "
+        "race the chief's write and read a missing or half-written file"
+    )
+
+
+@register
+class WallClockDivergenceRule(Rule):
+    id = "wall-clock-divergence"
+    severity = WARNING
+    program_level = True
+    description = (
+        "wall-clock time or unseeded randomness decides whether a "
+        "collective runs, or rides an operand that must be comparable "
+        "across ranks — clocks and unseeded RNG differ on every host every "
+        "run; decide from rank-uniform state or broadcast the chief's "
+        "sample"
+    )

@@ -527,6 +527,11 @@ class Trainer:
         # barrier interleaves with the training step's psum and aborts the
         # process (gloo EnforceNotMet preamble.length mismatch).  TPU/GPU
         # runtimes order concurrent collectives, so only CPU downgrades.
+        # This is the collective-SEQUENCE hazard class the lint package's
+        # CollectiveSequenceSentinel polices at runtime: every rank must
+        # issue the same ops in the same order, and a second thread
+        # injecting collectives breaks that contract on transports that
+        # don't serialize them (docs/lint.md, "SPMD correctness").
         if enabled and jax.process_count() > 1 and jax.default_backend() == "cpu":
             return False
         return enabled
@@ -558,8 +563,13 @@ class Trainer:
         background writer on ONE rank therefore fails ALL ranks here, fast
         and together — without the exchange, the healthy ranks would enter
         the finalize collective and hang on the dead rank until the 600s
-        collective timeout.  The ``checkpoint.stall`` span records how
-        long training sat blocked on the drain either way.
+        collective timeout.  This is the canonical exchange-then-escape
+        idiom the ``conditional-collective-escape`` lint rule encodes: the
+        raise below is guarded by ``failed_ranks``, which is derived from
+        the allgather result and therefore rank-uniform — the pass
+        recognizes that and stays quiet, where a raise on the LOCAL flag
+        would be flagged.  The ``checkpoint.stall`` span records how long
+        training sat blocked on the drain either way.
         """
         p = self._pending_save
         if p is None:
@@ -949,6 +959,12 @@ class Trainer:
         checkpoint_policy: str,
         gbs: int,
     ) -> None:
+        # lazy import, same convention as the retrace sentinel in _setup:
+        # the trainer must not pull the lint analyzer package in at module
+        # import time just for the (usually disabled) runtime hook
+        from determined_tpu.lint._runtime import get_collective_sentinel
+
+        cseq = get_collective_sentinel()
         tracer = get_tracer()
         hot_time = 0.0  # train-segment wall time since last report (excludes
         # validation/checkpoint so samples_per_second tracks training only)
@@ -976,6 +992,7 @@ class Trainer:
                 next_stop = min(next_stop, self._trace_stop_step)
             # ---- hot segment: no host syncs ------------------------------
             seg_t0 = time.monotonic()
+            seg_start_step = self.steps_completed
             # the mesh context makes trace-time sharding constraints resolve
             # for models that annotate activations without an explicit mesh
             with self.mesh:
@@ -1008,6 +1025,18 @@ class Trainer:
                         self.steps_completed += 1
                         steps_since_report += 1
             hot_time += time.monotonic() - seg_t0
+            # collective-sequence sentinel: each dispatched step carries the
+            # tensor-plane psums, so the SEGMENT boundary (which steps this
+            # rank dispatched) is the dispatch-site signature — folded into
+            # the rolling digest here, once per boundary (not per step),
+            # and verified at the next control-plane exchange.  One attr
+            # check when the sentinel is not installed.
+            if cseq.installed:
+                cseq.record(
+                    self.core.distributed,
+                    "step.segment",
+                    f"{seg_start_step}-{self.steps_completed}",
+                )
             if self.train_loader.epoch != epoch_seen:
                 for e in range(epoch_seen, self.train_loader.epoch):
                     for cb in self.callbacks.values():
@@ -1091,4 +1120,7 @@ class Trainer:
             if preempted:
                 logger.info("preempted at step %d; exiting cleanly", self.steps_completed)
                 self._stopped_early = True
-                break
+                # should_preempt() IS the exchange: under WorkersAskChief it
+                # allgathers every rank's flag, so `preempted` is identical
+                # on all ranks and the whole gang breaks on the same step
+                break  # dtpu: lint-ok[conditional-collective-escape]

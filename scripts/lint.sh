@@ -24,4 +24,14 @@
 set -euo pipefail
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$REPO"
-exec python -m determined_tpu.cli lint --strict "$@" determined_tpu examples bench.py scripts
+# --exclude: a checkout that has hosted live experiments accumulates
+# checkpoint dirs, experiment journals, exported traces, and shipped
+# context code under the tree; none of that is this program (and context
+# dirs carry user .py files).  The globs prune those directories before
+# the walk instead of parsing whatever they contain.
+exec python -m determined_tpu.cli lint --strict \
+  --exclude 'checkpoints' --exclude 'checkpoints/*' \
+  --exclude 'traces' --exclude 'traces/*' \
+  --exclude '*.egg-info' --exclude 'build' \
+  --exclude 'dtpu-ctx-*' \
+  "$@" determined_tpu examples bench.py scripts

@@ -52,6 +52,21 @@ def pytest_configure(config):
         "DTPU_NATIVE_BUILD_DIR); skipped cleanly when they are not built — "
         "scripts/devcluster.sh builds them",
     )
+    config.addinivalue_line(
+        "markers",
+        "collective_order: run with the control-plane collective entry "
+        "points wrapped by lint.CollectiveSequenceSentinel — every "
+        "DistributedContext created in the test digests its collective "
+        "sequence and a rank-divergent sequence raises a named "
+        "CollectiveDivergenceError instead of hanging (opt in per "
+        "module/test)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "no_collective_order: per-test opt-out from a module-level "
+        "collective_order mark (for tests that drive raw payloads through "
+        "the star transports)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
@@ -121,6 +136,31 @@ def _lock_order_guard(request):
         yield
     violations = sentinel.violations()
     assert not violations, "\n".join(v.format() for v in violations)
+
+
+@pytest.fixture(autouse=True)
+def _collective_order_guard(request):
+    """Autouse, opt-in: tests/modules marked ``collective_order`` run with
+    ``DistributedContext``'s collective methods wrapped by the
+    collective-sequence sentinel — the dynamic form of the static SPMD
+    rules: every rank's (op, payload-structure) sequence is digested and
+    exchanged in-band, so a divergence raises a deterministic named error
+    at the next collective instead of parking the peers until timeout."""
+    if (
+        request.node.get_closest_marker("collective_order") is None
+        or request.node.get_closest_marker("no_collective_order") is not None
+    ):
+        yield
+        return
+    from determined_tpu.lint import CollectiveSequenceSentinel
+
+    sentinel = CollectiveSequenceSentinel()
+    with sentinel:
+        yield
+    # divergences raise inline at the collective; anything recorded but
+    # swallowed by test code still fails the test here
+    violations = sentinel.violations()
+    assert not violations, "\n".join(str(v) for v in violations)
 
 
 @pytest.fixture(autouse=True)

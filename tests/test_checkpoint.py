@@ -13,6 +13,10 @@ from determined_tpu.storage import SharedFSStorageManager
 from determined_tpu.utils.errors import CheckpointNotFoundError, ShardMergeConflictError
 from tests.parallel_utils import Execution
 
+# checkpoint barriers/gathers are the densest collective sequences in the
+# harness; the sentinel digests them on every Execution-driven rank here
+pytestmark = pytest.mark.collective_order
+
 
 def _write(path, content):
     os.makedirs(os.path.dirname(path), exist_ok=True)

@@ -29,6 +29,10 @@ import pytest
 from determined_tpu.config.experiment import ExperimentConfig, InvalidExperimentConfig
 from determined_tpu.experiment import ClusterExperiment, journal_path, read_journal
 
+# the cluster suite drives gangs whose harness-side collectives must stay
+# rank-uniform; the sentinel turns any divergence into a named error
+pytestmark = pytest.mark.collective_order
+
 
 # ---- the fake master -------------------------------------------------------
 

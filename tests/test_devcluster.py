@@ -2373,3 +2373,65 @@ def test_full_lifecycle_over_tls(tmp_path):
             capture_output=True,
         )
         c.stop()
+
+
+def test_collective_sentinel_turns_rank_divergence_into_named_error(tmp_path):
+    """THE acceptance test for the SPMD correctness work: inject a
+    rank-divergent collective into a REAL 2-process gang and prove the
+    collective-sequence sentinel converts what used to be a silent
+    600-second hang into a named CollectiveDivergenceError within seconds.
+
+    DTPU_COLLECTIVE_SENTINEL=1 wraps every rank's control-plane collective
+    entry points; DTPU_CSEQ_INJECT=1:1:phantom-divergent-op makes rank 1
+    advertise a phantom op at its FIRST exchanged collective — exactly what
+    a wrong rank-guarded branch produces.  Every rank must then raise the
+    named error at that exchange (the envelopes ride the collective
+    itself), the gang tears down, and the trial reaches ERROR while a
+    hang-to-timeout would still be sitting in the collective."""
+    c = DevCluster(tmp_path, agents=2, slots=1)
+    c.start()
+    try:
+        cfg = exp_config(c.ckpt_dir, slots=2, max_restarts=0)
+        cfg["environment"]["env"]["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=1"
+        )
+        cfg["environment"]["env"]["DTPU_COLLECTIVE_SENTINEL"] = "1"
+        cfg["environment"]["env"]["DTPU_CSEQ_INJECT"] = "1:1:phantom-divergent-op"
+        # enough steps that an UNDETECTED divergence would leave the gang
+        # running/hung far past our wait window — completion or timeout
+        # here would both mean the sentinel failed
+        cfg["searcher"]["max_length"] = {"batches": 300}
+        submit_t0 = time.time()
+        exp_id = c.submit(cfg)
+
+        # the first exchanged collective happens at the first report
+        # boundary, seconds after the gang finishes compiling; 240s bounds
+        # the whole build/launch/compile pipeline on a slow box while
+        # staying far under the 600s collective timeout the sentinel is
+        # replacing
+        final = c.wait_for_state(
+            exp_id, states=("ERROR", "COMPLETED"), timeout=240
+        )
+        elapsed = time.time() - submit_t0
+        assert final["state"] == "ERROR", (
+            f"divergent gang was not failed by the sentinel: {final['state']}"
+        )
+        trial = final["trials"][0]
+        assert trial["state"] == "ERROR"
+        assert elapsed < 240, f"took {elapsed:.0f}s — hang-like"
+
+        logs = c.http.get(
+            f"{c.url}/api/v1/trials/{trial['id']}/logs"
+        ).json()
+        joined = "\n".join(str(l) for l in logs)
+        # the error is NAMED: exception type, the phantom op, and both
+        # ranks' positions flow into the trial logs
+        assert "CollectiveDivergenceError" in joined, joined[-3000:]
+        assert "phantom-divergent-op" in joined, joined[-3000:]
+        assert "diverged at op #" in joined, joined[-3000:]
+    finally:
+        subprocess.run(
+            ["pkill", "-9", "-f", "determined_tpu.exec.run_trial"],
+            capture_output=True,
+        )
+        c.stop()

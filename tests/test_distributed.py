@@ -5,6 +5,11 @@ import pytest
 
 from tests.parallel_utils import Execution
 
+# every real star collective in this suite runs under the
+# collective-sequence sentinel: rank-divergent op sequences fail as named
+# CollectiveDivergenceErrors here, before they can ship as silent hangs
+pytestmark = pytest.mark.collective_order
+
 
 def test_allgather_orders_by_rank():
     results = Execution(4).run(lambda ctx, rank: ctx.allgather(f"r{rank}"))

@@ -135,6 +135,44 @@ class Guard:
                 self._hits += 1
         signal.signal(signal.SIGTERM, handler)
 """,
+    "rank-dependent-collective": """
+import jax
+class Reporter:
+    def report(self, dist, metrics):
+        if jax.process_index() == 0:
+            dist.allgather(metrics)
+""",
+    "conditional-collective-escape": """
+class Saver:
+    def save(self, dist, ok):
+        dist.barrier()
+        if not ok:
+            raise RuntimeError("local failure")
+        dist.barrier()
+""",
+    "unordered-iteration-feeding-collective": """
+class Merger:
+    def merge(self, dist, shards):
+        for name in set(shards):
+            dist.broadcast(name)
+""",
+    "rank-guarded-io-missing-barrier": """
+import json
+class Publisher:
+    def publish(self, dist, path, manifest):
+        if dist.is_chief:
+            with open(path, "w") as f:
+                json.dump(manifest, f)
+        with open(path) as f:
+            return json.load(f)
+""",
+    "wall-clock-divergence": """
+import time
+class Saver:
+    def maybe_save(self, dist):
+        if time.time() - self.last_save > 60:
+            dist.barrier()
+""",
 }
 
 CLEAN = {
@@ -250,6 +288,49 @@ class Guard:
         def handler(signum, frame):
             self._hit = True  # flag-set pattern: plain attribute write
         signal.signal(signal.SIGTERM, handler)
+""",
+    "rank-dependent-collective": """
+import jax
+class Reporter:
+    def report(self, dist, metrics):
+        flags = dist.allgather(metrics)  # every rank participates
+        if jax.process_index() == 0:
+            summarize(flags)  # chief-only HOST work is fine
+""",
+    "conditional-collective-escape": """
+class Saver:
+    def save(self, dist, ok):
+        dist.barrier()
+        flags = dist.allgather(ok)  # exchange the local fact first...
+        if not all(flags):
+            raise RuntimeError("some rank failed")  # ...all ranks escape together
+        dist.barrier()
+""",
+    "unordered-iteration-feeding-collective": """
+class Merger:
+    def merge(self, dist, shards):
+        for name in sorted(shards):  # every rank iterates the same order
+            dist.broadcast(name)
+""",
+    "rank-guarded-io-missing-barrier": """
+import json
+class Publisher:
+    def publish(self, dist, path, manifest):
+        if dist.is_chief:
+            with open(path, "w") as f:
+                json.dump(manifest, f)
+        dist.barrier()  # non-chief ranks wait for the chief's write
+        with open(path) as f:
+            return json.load(f)
+""",
+    "wall-clock-divergence": """
+import time
+class Saver:
+    def maybe_save(self, dist, step):
+        stamp = dist.broadcast(time.time())  # chief samples, all receive
+        if step % 100 == 0:  # step counter: rank-uniform
+            dist.barrier()
+        return stamp
 """,
 }
 
@@ -1258,3 +1339,503 @@ def test_lock_order_sentinel_uninstall_restores_factories():
         assert threading.Lock is not orig_lock
     assert threading.Lock is orig_lock
     assert threading.RLock is orig_rlock
+
+
+# ---------------------------------------------------------------------------
+# SPMD correctness pass (lint/_spmd.py): rank-divergence rules
+# ---------------------------------------------------------------------------
+
+_SPMD_RULES = (
+    "rank-dependent-collective",
+    "conditional-collective-escape",
+    "unordered-iteration-feeding-collective",
+    "rank-guarded-io-missing-barrier",
+    "wall-clock-divergence",
+)
+
+
+@pytest.mark.parametrize("rule", _SPMD_RULES)
+def test_spmd_bad_fixture_exactly_one_diagnostic(rule):
+    diags = _concurrency_diags(BAD[rule], rule)
+    assert len(diags) == 1, diags
+    assert diags[0].severity == "warning"
+
+
+def test_rank_dependent_collective_names_op_and_witness():
+    (d,) = _concurrency_diags(
+        BAD["rank-dependent-collective"], "rank-dependent-collective"
+    )
+    assert "`allgather`" in d.message
+    assert "Reporter.report" in d.message  # witness chain qname
+
+
+def test_rank_dependent_collective_matching_branches_clean():
+    # the restore_path shape: both sides of a rank test reach the SAME
+    # collective set (error vs ok broadcast) — legal
+    src = """
+class Restorer:
+    def restore(self, dist):
+        if dist.is_local_chief:
+            dist.broadcast_local(("ok", "path"))
+        else:
+            dist.broadcast_local(None)
+"""
+    assert not _concurrency_diags(src, "rank-dependent-collective")
+
+
+def test_rank_dependent_collective_rank_env_read_flagged():
+    src = """
+import os
+class W:
+    def go(self, dist):
+        if os.environ.get("DTPU_RANK") == "0":
+            dist.barrier()
+"""
+    assert len(_concurrency_diags(src, "rank-dependent-collective")) == 1
+
+
+def test_conditional_escape_exchange_then_escape_is_clean():
+    # the _drain_pending_save idiom verbatim: allgather the local flag,
+    # raise on the EXCHANGED value — every rank raises together
+    src = """
+class Drainer:
+    def drain(self, dist, local_failed):
+        flags = dist.allgather(local_failed)
+        failed_ranks = [r for r, f in enumerate(flags) if f]
+        if failed_ranks:
+            raise RuntimeError(f"failed on {failed_ranks}")
+        dist.barrier()
+"""
+    assert not _concurrency_diags(src, "conditional-collective-escape")
+
+
+def test_conditional_escape_tensor_plane_guard_is_clean():
+    # python escapes around TRACED collectives are trace-time decisions
+    # (jax forbids branching on runtime values): not a runtime divergence
+    src = """
+import jax
+def redistribute(x, axis_name, n):
+    if n == 1:
+        return x
+    y = jax.lax.psum(x, axis_name)
+    if y.shape[0] == 1:
+        return y
+    return jax.lax.ppermute(y, axis_name, [(0, 1)])
+"""
+    assert not _concurrency_diags(src, "conditional-collective-escape")
+
+
+def test_conditional_escape_rank_dependent_loop_flagged():
+    src = """
+class W:
+    def go(self, dist, rank):
+        for _ in range(rank):
+            dist.allgather("tick")
+"""
+    diags = _concurrency_diags(src, "conditional-collective-escape")
+    assert len(diags) == 1
+    assert "rank-dependent" in diags[0].message
+
+
+def test_conditional_escape_break_in_collective_loop_flagged():
+    src = """
+class W:
+    def go(self, dist, jobs):
+        for j in jobs:
+            dist.allgather(j)
+            if j is None:
+                break
+"""
+    diags = _concurrency_diags(src, "conditional-collective-escape")
+    assert len(diags) == 1
+    assert "break" in diags[0].message
+
+
+def test_unordered_iteration_payload_crossing_later_collective_flagged():
+    src = """
+class W:
+    def go(self, dist, shards):
+        names = []
+        for s in set(shards):
+            names.append(s)
+        return dist.allgather(names)
+"""
+    diags = _concurrency_diags(
+        src, "unordered-iteration-feeding-collective"
+    )
+    assert len(diags) == 1
+    assert "names" in diags[0].message
+
+
+def test_unordered_iteration_listdir_flagged_sorted_clean():
+    bad = """
+import os
+class W:
+    def go(self, dist, d):
+        for f in os.listdir(d):
+            dist.broadcast(f)
+"""
+    clean = """
+import os
+class W:
+    def go(self, dist, d):
+        for f in sorted(os.listdir(d)):
+            dist.broadcast(f)
+"""
+    assert len(
+        _concurrency_diags(bad, "unordered-iteration-feeding-collective")
+    ) == 1
+    assert not _concurrency_diags(
+        clean, "unordered-iteration-feeding-collective"
+    )
+
+
+def test_rank_guarded_io_any_collective_counts_as_sync():
+    # not just barrier(): ANY collective between write and read orders them
+    src = """
+import json
+class P:
+    def publish(self, dist, path, manifest):
+        if dist.is_chief:
+            with open(path, "w") as f:
+                json.dump(manifest, f)
+        dist.allgather("done")
+        with open(path) as f:
+            return json.load(f)
+"""
+    assert not _concurrency_diags(src, "rank-guarded-io-missing-barrier")
+
+
+def test_rank_guarded_io_read_inside_guard_is_clean():
+    # a read INSIDE the chief guard is chief-only too: no cross-rank race
+    src = """
+import json
+class P:
+    def publish(self, dist, path, manifest):
+        if dist.is_chief:
+            with open(path, "w") as f:
+                json.dump(manifest, f)
+            with open(path) as f:
+                return json.load(f)
+"""
+    assert not _concurrency_diags(src, "rank-guarded-io-missing-barrier")
+
+
+def test_wall_clock_divergence_broadcast_exempt():
+    # broadcasting the chief's clock IS the fix: one sample, distributed
+    src = """
+import time
+class S:
+    def stamp(self, dist):
+        return dist.broadcast(time.time())
+"""
+    assert not _concurrency_diags(src, "wall-clock-divergence")
+
+
+def test_wall_clock_divergence_operand_crossing_allgather_flagged():
+    src = """
+import random
+class S:
+    def shuffle_order(self, dist):
+        return dist.allgather(random.random())
+"""
+    diags = _concurrency_diags(src, "wall-clock-divergence")
+    assert len(diags) == 1
+    assert "allgather" in diags[0].message
+
+
+def test_wall_clock_divergence_seeded_rng_object_clean():
+    src = """
+import random
+class S:
+    def pick(self, dist, seed):
+        rng = random.Random(seed)  # journaled seed: rank-uniform stream
+        if rng.random() > 0.5:
+            dist.barrier()
+"""
+    assert not _concurrency_diags(src, "wall-clock-divergence")
+
+
+def test_spmd_rule_cross_module_witness_chain(tmp_path):
+    # rank guard in one module, the collective reached through a call into
+    # ANOTHER module: only the joint ProgramIndex sees the chain
+    (tmp_path / "transport.py").write_text(
+        textwrap.dedent(
+            """
+            def flush_all(dist):
+                dist.allgather("flush")
+            """
+        )
+    )
+    (tmp_path / "driver.py").write_text(
+        textwrap.dedent(
+            """
+            import jax
+            from transport import flush_all
+
+            def finish(dist):
+                if jax.process_index() == 0:
+                    flush_all(dist)
+            """
+        )
+    )
+    from determined_tpu.lint import analyze_paths
+
+    diags = [
+        d
+        for d in analyze_paths([str(tmp_path)])
+        if d.rule == "rank-dependent-collective"
+    ]
+    assert len(diags) == 1
+    assert "flush_all" in diags[0].message  # the cross-module hop is named
+    # each file alone shows nothing: the guard and the collective only
+    # connect through the cross-module call
+    solo = [
+        d
+        for d in analyze_paths([str(tmp_path / "driver.py")])
+        if d.rule == "rank-dependent-collective"
+    ]
+    assert not solo
+
+
+def test_spmd_rule_suppression_line_above():
+    src = """
+import jax
+class R:
+    def report(self, dist, m):
+        # dtpu: lint-ok[rank-dependent-collective]
+        if jax.process_index() == 0:
+            dist.allgather(m)
+"""
+    assert not _concurrency_diags(src, "rank-dependent-collective")
+
+
+def test_spmd_rules_in_json_payload():
+    diags = analyze_source(
+        textwrap.dedent(BAD["rank-dependent-collective"]), "fixture.py"
+    )
+    payload = to_json_payload(diags)
+    assert payload["counts"]["by_rule"].get("rank-dependent-collective") == 1
+
+
+# ---------------------------------------------------------------------------
+# dir-mode --exclude globs
+# ---------------------------------------------------------------------------
+
+
+def test_collect_py_files_exclude_prunes_directories(tmp_path):
+    from determined_tpu.lint._concurrency import collect_py_files
+
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "ok.py").write_text("x = 1\n")
+    (tmp_path / "checkpoints").mkdir()
+    (tmp_path / "checkpoints" / "shipped_model_def.py").write_text("x = 1\n")
+    (tmp_path / "traces").mkdir()
+    (tmp_path / "traces" / "gen.py").write_text("x = 1\n")
+    files = collect_py_files(
+        str(tmp_path), exclude=("checkpoints", "traces/*")
+    )
+    rels = [os.path.relpath(f, str(tmp_path)) for f in files]
+    assert rels == [os.path.join("pkg", "ok.py")]
+
+
+def test_cli_lint_exclude_glob(tmp_path, capsys):
+    from determined_tpu.cli.main import main as cli_main
+
+    (tmp_path / "good.py").write_text("x = 1\n")
+    bad_dir = tmp_path / "journal_artifacts"
+    bad_dir.mkdir()
+    # a file that WOULD produce a finding if parsed
+    (bad_dir / "snippet.py").write_text(
+        textwrap.dedent(BAD["blocking-under-lock"])
+    )
+    rc = cli_main(
+        ["lint", "--strict", str(tmp_path), "--exclude", "journal_artifacts"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "clean" in out
+    # without the exclude the same target fails strict
+    rc = cli_main(["lint", "--strict", str(tmp_path)])
+    assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# CollectiveSequenceSentinel: the runtime half of the SPMD pass
+# ---------------------------------------------------------------------------
+
+
+def _exec():
+    from tests.parallel_utils import Execution
+
+    return Execution
+
+
+def test_collective_sentinel_matching_ranks_silent():
+    from determined_tpu.lint import CollectiveSequenceSentinel
+
+    sentinel = CollectiveSequenceSentinel()
+    with sentinel:
+        results = _exec()(3).run(
+            lambda ctx, rank: (
+                ctx.allgather(f"r{rank}"),
+                ctx.broadcast("payload" if ctx.is_chief else None),
+                ctx.gather(rank),
+                ctx.barrier(),
+            )
+        )
+    assert [r[0] for r in results] == [["r0", "r1", "r2"]] * 3
+    assert [r[1] for r in results] == ["payload"] * 3
+    assert results[0][2] == [0, 1, 2]
+    assert results[1][2] is None
+    assert sentinel.violations() == []
+
+
+def test_collective_sentinel_wrong_branch_divergence_named():
+    from determined_tpu.lint import (
+        CollectiveDivergenceError,
+        CollectiveSequenceSentinel,
+    )
+
+    sentinel = CollectiveSequenceSentinel()
+
+    def diverge(ctx, rank):
+        ctx.allgather("warm")
+        try:
+            if rank == 1:
+                ctx.allgather(("extra", rank))  # the wrong-branch collective
+            else:
+                ctx.barrier()
+            return None
+        except CollectiveDivergenceError as e:
+            return e
+
+    with sentinel:
+        results = _exec()(2, timeout=20).run(diverge)
+    # BOTH ranks get the deterministic named error (no hang, no timeout)
+    assert all(isinstance(r, CollectiveDivergenceError) for r in results)
+    err = results[0]
+    assert err.op_index == 1  # second collective is the divergent one
+    assert "barrier" in str(err) and "allgather" in str(err)
+    assert set(err.ranks) == {0, 1}  # both ranks' ops are named
+    assert err.traces[0] and err.traces[1]
+    assert len(sentinel.violations()) == 2
+
+
+def test_collective_sentinel_injected_divergence_deterministic(monkeypatch):
+    # the devcluster acceptance path, in-process: DTPU_CSEQ_INJECT makes
+    # rank 1 advertise a phantom op at its 2nd exchange — every run, same
+    # op index, same named error
+    monkeypatch.setenv("DTPU_CSEQ_INJECT", "1:2:phantom-save-barrier")
+    from determined_tpu.lint import (
+        CollectiveDivergenceError,
+        CollectiveSequenceSentinel,
+    )
+
+    for _ in range(2):  # deterministic across repeat runs
+        sentinel = CollectiveSequenceSentinel()
+
+        def body(ctx, rank):
+            ctx.allgather("a")
+            try:
+                ctx.allgather("b")
+                return None
+            except CollectiveDivergenceError as e:
+                return e
+
+        with sentinel:
+            results = _exec()(2, timeout=20).run(body)
+        assert all(isinstance(r, CollectiveDivergenceError) for r in results)
+        assert "phantom-save-barrier" in str(results[0])
+        assert results[0].op_index == 1
+
+
+def test_collective_sentinel_unexchanged_record_verified_at_next_exchange():
+    # a dispatch-site record (the trainer's step segment) on ONE rank only
+    # shifts its digest; the NEXT exchanged collective catches it
+    from determined_tpu.lint import (
+        CollectiveDivergenceError,
+        CollectiveSequenceSentinel,
+    )
+
+    sentinel = CollectiveSequenceSentinel()
+
+    def body(ctx, rank):
+        ctx.allgather("warm")
+        if rank == 1:
+            sentinel.record(ctx, "step.segment", "0-100")  # rank 1 ran extra steps
+        try:
+            ctx.barrier()
+            return None
+        except CollectiveDivergenceError as e:
+            return e
+
+    with sentinel:
+        results = _exec()(2, timeout=20).run(body)
+    assert all(isinstance(r, CollectiveDivergenceError) for r in results)
+    assert "step.segment" in str(results[0])
+
+
+def test_collective_sentinel_raw_peer_named_not_garbled():
+    from determined_tpu.lint import (
+        CollectiveDivergenceError,
+        CollectiveSequenceSentinel,
+    )
+
+    sentinel = CollectiveSequenceSentinel()
+    with pytest.raises(CollectiveDivergenceError, match="WITHOUT the sentinel"):
+        sentinel._unwrap({"raw": "payload"})
+
+
+def test_collective_sentinel_uninstall_restores_methods():
+    from determined_tpu.core import DistributedContext
+    from determined_tpu.lint import CollectiveSequenceSentinel
+
+    orig = DistributedContext.allgather
+    sentinel = CollectiveSequenceSentinel()
+    with sentinel:
+        assert DistributedContext.allgather is not orig
+    assert DistributedContext.allgather is orig
+
+
+def test_collective_sentinel_digest_overhead_bounded():
+    # the record path is one crc32 + deque append; bound it loosely so a
+    # regression to something heavyweight fails (50 us/op on any box)
+    import time as _time
+
+    from determined_tpu.core import DummyDistributedContext
+    from determined_tpu.lint import CollectiveSequenceSentinel
+
+    sentinel = CollectiveSequenceSentinel()
+    dist = DummyDistributedContext()
+    n = 20_000
+    t0 = _time.perf_counter()
+    for i in range(n):
+        sentinel.record(dist, "step.segment", f"{i}-{i + 10}")
+    per_op = (_time.perf_counter() - t0) / n
+    assert per_op < 50e-6, f"digest record cost {per_op * 1e6:.1f} us/op"
+
+
+def test_collective_sentinel_single_rank_passthrough():
+    # DummyDistributedContext under the sentinel: wrapped methods still
+    # return correct values with zero peers
+    from determined_tpu.core import DummyDistributedContext
+    from determined_tpu.lint import CollectiveSequenceSentinel
+
+    with CollectiveSequenceSentinel() as sentinel:
+        dist = DummyDistributedContext()
+        assert dist.allgather("x") == ["x"]
+        assert dist.broadcast("y") == "y"
+        assert dist.gather("z") == ["z"]
+        dist.barrier()
+    assert sentinel.violations() == []
+
+
+def test_collect_py_files_named_file_ignores_exclude(tmp_path):
+    # excludes prune DISCOVERED files; a target the user spelled out is
+    # always linted (same contract as analyze_path's file mode)
+    from determined_tpu.lint._concurrency import collect_py_files
+
+    f = tmp_path / "build.py"
+    f.write_text("x = 1\n")
+    assert collect_py_files(str(f), exclude=("build*",)) == [str(f)]

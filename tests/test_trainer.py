@@ -14,6 +14,11 @@ from determined_tpu.config import ExperimentConfig, Length
 from determined_tpu.models.mnist import MnistTrial
 from determined_tpu.parallel.mesh import MeshConfig
 
+# the trainer's checkpoint drain/save/restore paths issue control-plane
+# collectives; running the suite under the collective-sequence sentinel
+# proves the sequences stay rank-uniform on every path the tests drive
+pytestmark = pytest.mark.collective_order
+
 
 HPARAMS = {"lr": 1e-2, "hidden": 32, "global_batch_size": 32, "dataset_size": 256}
 
