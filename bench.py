@@ -63,6 +63,12 @@ def main() -> None:
     # serving tier (docs/serving.md): continuous batching vs the naive
     # static batch over one shared kernel set, static as the baseline
     _bench_hook("DTPU_BENCH_SERVE", "bench_serve.py")
+    # step-program optimizations (docs/performance.md): overlapped
+    # gradient sync and quantized matmul A/Bs — baseline reduction /
+    # bf16 arithmetic as the respective baselines; on CPU these prove
+    # structure + numerics, the TPU MFU rows land next chip round
+    _bench_hook("DTPU_BENCH_OVERLAP", "bench_step.py")
+    _bench_hook("DTPU_BENCH_QUANT", "bench_step.py")
 
     import os
 

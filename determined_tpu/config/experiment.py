@@ -27,6 +27,11 @@ class InvalidExperimentConfig(ValueError):
     pass
 
 
+#: quantized-matmul modes — the single source of truth shared with
+#: ``train/_quant.py`` (which imports from here; no cycle)
+QUANT_MODES = ("none", "int8", "fp8")
+
+
 _LENGTH_UNITS = ("batches", "epochs", "records")
 
 
@@ -291,6 +296,27 @@ class OptimizationsConfig:
     # re-tracing identical programs.  In-process complement of the
     # persistent cache above (which covers cross-process reuse).
     jit_cache: bool = True
+    # Overlapped gradient synchronization (train/_overlap.py, docs/
+    # performance.md): partition the grad pytree into size-bounded buckets
+    # and stage each bucket's reduce-scatter at its production point in
+    # the backward pass (custom_vjp markers + sharding constraints), with
+    # the optimizer consuming SHARDED grads/state and params all-gathered
+    # after the update — XLA's latency-hiding scheduler then interleaves
+    # the collectives with remaining backward compute instead of exposing
+    # one end-of-backward reduction.  Off by default; numerically
+    # equivalent to the baseline reduction (tests pin allclose after N
+    # steps).  overlap_bucket_mb bounds one bucket's payload.
+    overlap_grad_sync: bool = False
+    overlap_bucket_mb: int = 4
+    # Quantized matmul arithmetic (train/_quant.py): route the
+    # transformer's dense/attention projection matmuls through int8 (or
+    # fp8 where the platform supports it) with per-channel dynamic
+    # scaling.  Master weights and optimizer state stay fp32; backward
+    # runs in full precision (straight-through).  fp8 on an unsupported
+    # platform is rejected at trainer setup with InvalidExperimentConfig.
+    quantized_matmul: str = "none"
+
+    _QUANT_MODES = QUANT_MODES
 
     def __post_init__(self):
         if self.aggregation_frequency < 1:
@@ -300,6 +326,15 @@ class OptimizationsConfig:
         for knob in ("prefetch_depth", "device_prefetch", "fetch_workers"):
             if getattr(self, knob) < 0:
                 raise InvalidExperimentConfig(f"optimizations.{knob} must be >= 0")
+        if self.overlap_bucket_mb < 1:
+            raise InvalidExperimentConfig(
+                "optimizations.overlap_bucket_mb must be >= 1"
+            )
+        if self.quantized_matmul not in self._QUANT_MODES:
+            raise InvalidExperimentConfig(
+                f"optimizations.quantized_matmul {self.quantized_matmul!r} "
+                f"not in {self._QUANT_MODES}"
+            )
 
     @classmethod
     def parse(cls, raw: Dict[str, Any]) -> "OptimizationsConfig":

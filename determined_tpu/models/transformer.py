@@ -57,6 +57,15 @@ class TransformerConfig:
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Quantized matmul arithmetic (train/_quant.py): none|int8|fp8 routes
+    # every dense/attention projection matmul (and the logits-path
+    # lm_head) through per-channel dynamically-scaled reduced-precision
+    # arithmetic with fp32 master weights.  The param tree is untouched
+    # (a flax dot_general injection), so checkpoints and sharding specs
+    # are byte-compatible across modes; composes with pipe (stage blocks
+    # inherit the config).  The fused-CE lm_head contraction keeps its
+    # own bf16 kernel.
+    quantized_matmul: str = "none"
     # False under manual-SPMD pipeline stages: logical param annotations
     # are meaningless (and invalid) inside shard_map, where placement is
     # explicit
@@ -130,12 +139,16 @@ class Attention(nn.Module):
         cfg = self.cfg
         b, s, _ = x.shape
         hd = cfg.head_dim
+        from determined_tpu.train._quant import make_dot_general
+
+        qdg = make_dot_general(cfg.quantized_matmul)
         dense = lambda feats, logical, name: nn.DenseGeneral(  # noqa: E731
             feats,
             axis=-1,
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=jnp.float32,
+            dot_general=qdg,
             kernel_init=_maybe_partition(
                 cfg.partition_params, nn.initializers.lecun_normal(), logical
             ),
@@ -185,6 +198,7 @@ class Attention(nn.Module):
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=jnp.float32,
+            dot_general=qdg,
             kernel_init=_maybe_partition(
                 cfg.partition_params,
                 nn.initializers.lecun_normal(),
@@ -202,11 +216,15 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
+        from determined_tpu.train._quant import make_dot_general
+
+        qdg = make_dot_general(cfg.quantized_matmul)
         dense = lambda feats, logical, name: nn.Dense(  # noqa: E731
             feats,
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=jnp.float32,
+            dot_general=qdg,
             kernel_init=_maybe_partition(
                 cfg.partition_params, nn.initializers.lecun_normal(), logical
             ),
@@ -291,11 +309,14 @@ class TransformerLM(nn.Module):
             x, aux = block_cls(cfg, self.mesh, use_moe, name=f"block_{i}")(x)
             aux_total = aux_total + aux
         x = RMSNorm(partition=cfg.partition_params, name="ln_f")(x)
+        from determined_tpu.train._quant import make_dot_general
+
         lm_head = nn.Dense(
             cfg.vocab_size,
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=jnp.float32,
+            dot_general=make_dot_general(cfg.quantized_matmul),
             kernel_init=_maybe_partition(
                 cfg.partition_params, nn.initializers.lecun_normal(), ("embed", "vocab")
             ),
@@ -436,8 +457,11 @@ def pipeline_forward(
     x = RMSNorm(partition=False).apply({"params": outer["ln_f"]}, x)
     if return_hidden:
         return (x, aux) if return_aux else x
+    from determined_tpu.train._quant import make_dot_general
+
     head = nn.Dense(
-        cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32
+        cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+        dot_general=make_dot_general(cfg.quantized_matmul),
     )
     logits = head.apply({"params": outer["lm_head"]}, x).astype(jnp.float32)
     return (logits, aux) if return_aux else logits
@@ -675,6 +699,21 @@ class LMTrial(JaxTrial):
             m -= 1
         return m
 
+    def _quant_mode(self) -> str:
+        """quantized_matmul resolution: trial hparam override wins, else
+        the experiment's ``optimizations.quantized_matmul`` knob, else
+        off.  Platform-gated here (setup time) so fp8 on an unsupported
+        chip fails with a clear InvalidExperimentConfig, not a lowering
+        error mid-compile."""
+        from determined_tpu.train._quant import require_platform
+
+        mode = self.context.get_hparam("quantized_matmul", None)
+        if mode is None and self.context.exp_config is not None:
+            mode = self.context.exp_config.optimizations.quantized_matmul
+        mode = str(mode) if mode else "none"
+        require_platform(mode)
+        return mode
+
     def _cfg(self) -> TransformerConfig:
         g = self.context.get_hparam
         pipe = self._pipe_stages()
@@ -702,6 +741,7 @@ class LMTrial(JaxTrial):
             moe_every=int(g("moe_every", 2)),
             moe_capacity_factor=float(g("moe_capacity_factor", 1.25)),
             moe_aux_weight=float(g("moe_aux_weight", 0.01)),
+            quantized_matmul=self._quant_mode(),
         )
 
     @property
@@ -787,8 +827,13 @@ class LMTrial(JaxTrial):
     def model_inputs(self, batch: Dict[str, Any]) -> Tuple[Any, ...]:
         return (jnp.asarray(batch["tokens"])[:, :-1],)
 
-    def init_params(self, model: TransformerLM, rng: jax.Array, sample_batch: Dict[str, Any]) -> Any:
-        params = super().init_params(model, rng, sample_batch)
+    def restructure_params(self, params: Any) -> Any:
+        # pipe > 1: restack per-layer blocks into pipeline stages.  Kept
+        # OUT of init_params so the trainer can stage it on jax versions
+        # where a jitted stack into pipe-sharded out_shardings SUMS the
+        # replicated operands (parallel/_compat.py sharded_restack_safe):
+        # pipe>1 trials used to start from doubled block weights — the
+        # whole ~1.5% pipe-parity drift ROADMAP tracked.
         pipe = self._pipe_stages()
         if pipe > 1:
             return split_pipeline_params(params, pipe)

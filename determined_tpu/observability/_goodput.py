@@ -41,6 +41,18 @@ Categories (the ``cat=`` each instrumentation site passes):
 
 ``gang.teardown`` instants (category ``gang``) mark the master tearing
 down and rescheduling a whole gang after one rank died.
+
+``step.comm`` rows: gradient-collective time inside the productive
+``step`` bucket, split into exposed (on the critical path) vs hidden
+(overlapped with backward compute).  Fed from the Trainer's
+``step.comm.{bytes,exposed_us,hidden_us}`` COUNTERS — counters, not
+spans, because a synthetic span overlapping the real hot-loop spans would
+corrupt the self-time nesting.  The split comes from the bucket-schedule
+model in ``train/_overlap.py`` (measured payload bytes over a per-chip
+bandwidth table; labeled a model — the xplane op table stays the ground
+truth on real chips).  ``dtpu experiment profile`` prints it as the
+"exposed comm" line so an overlap win is visible in the profile, not
+just the bench.
 """
 
 from __future__ import annotations
@@ -157,6 +169,28 @@ def _trial_counters(
         else:
             bucket[e["name"]] = bucket.get(e["name"], 0.0) + val
     return out
+
+
+def _comm_entry(
+    counters: Dict[str, float], step_us: float
+) -> Optional[Dict[str, Any]]:
+    """Fold step.comm.* counters into an exposed-vs-hidden comm record
+    (None when no comm accounting rode the trace)."""
+    exposed_us = counters.get("step.comm.exposed_us")
+    if exposed_us is None:
+        return None
+    hidden_us = counters.get("step.comm.hidden_us", 0.0)
+    entry: Dict[str, Any] = {
+        "exposed_s": round(exposed_us / 1e6, 6),
+        "hidden_s": round(hidden_us / 1e6, 6),
+        "exposed_pct_of_step": round(
+            100.0 * exposed_us / max(step_us, 1e-9), 2
+        ),
+        "model": "bucket-schedule-v1",
+    }
+    if "step.comm.bytes" in counters:
+        entry["bytes"] = int(counters["step.comm.bytes"])
+    return entry
 
 
 def _breakdown(cat_us: Dict[str, float], denom_us: float) -> Dict[str, Dict[str, float]]:
@@ -288,6 +322,9 @@ def compute_ledger(
                 entry["mfu_estimate"] = round(
                     (tokens / max(wall_s, 1e-9)) * tfpt / tpeak, 4
                 )
+        comm = _comm_entry(tc, cats.get("step", 0.0))
+        if comm is not None:
+            entry["step.comm"] = comm
         trials[rid] = entry
         total_trial_us += wall
         total_attr_us += attributed
@@ -307,6 +344,9 @@ def compute_ledger(
         "breakdown": _breakdown(dict(agg_cat_us), total_trial_us),
         "trials": len(trials),
     }
+    exp_comm = _comm_entry(counters, agg_cat_us.get("step", 0.0))
+    if exp_comm is not None:
+        experiment["step.comm"] = exp_comm
     tokens_total = sum(t.get("tokens", 0) for t in trials.values())
     if tokens_total and total_trial_us > 0:
         experiment["tokens_per_s"] = round(tokens_total / (total_trial_us / 1e6), 2)
@@ -353,6 +393,18 @@ def load_trace_events(traces_dir: str) -> List[Dict[str, Any]]:
     return []
 
 
+def _comm_line(c: Dict[str, Any]) -> str:
+    """The "exposed comm" profile line (docs/performance.md): how much of
+    the gradient-collective time sits on the critical path vs hides
+    behind backward compute — the number the overlap_grad_sync knob
+    exists to shrink."""
+    return (
+        f"  exposed comm {c['exposed_s']:>10.3f}s "
+        f"({c['exposed_pct_of_step']:.1f}% of step; "
+        f"hidden {c['hidden_s']:.3f}s) [{c['model']}]"
+    )
+
+
 def format_ledger_text(ledger: Dict[str, Any]) -> str:
     """Human-readable ledger (the ``dtpu experiment profile`` text view)."""
     exp = ledger["experiment"]
@@ -368,6 +420,8 @@ def format_ledger_text(ledger: Dict[str, Any]) -> str:
     lines.append("phase breakdown (% of trial-seconds):")
     for cat, row in exp["breakdown"].items():
         lines.append(f"  {cat:<12} {row['seconds']:>10.3f}s  {row['pct']:>6.2f}%")
+    if "step.comm" in exp:
+        lines.append(_comm_line(exp["step.comm"]))
     for rid, t in ledger["trials"].items():
         lines.append("")
         head = (
@@ -388,6 +442,8 @@ def format_ledger_text(ledger: Dict[str, Any]) -> str:
         lines.append(head)
         for cat, row in t["breakdown"].items():
             lines.append(f"  {cat:<12} {row['seconds']:>10.3f}s  {row['pct']:>6.2f}%")
+        if "step.comm" in t:
+            lines.append(_comm_line(t["step.comm"]))
     if ledger.get("dropped_events"):
         lines.append("")
         lines.append(

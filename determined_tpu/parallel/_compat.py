@@ -125,6 +125,57 @@ def _fix_transpose_residual_misalignment() -> bool:
 
 SHARD_MAP_TRANSPOSE_FIXED = _fix_transpose_residual_misalignment()
 
+_sharded_restack_safe: Any = None
+
+
+def sharded_restack_safe() -> bool:
+    """Feature probe: does stack-into-sharded-output preserve values?
+
+    On jax 0.4.37's forced-host CPU platform, a jitted program that
+    ``jnp.stack``s (concatenates) replicated operands into an output whose
+    ``out_shardings`` shard it over a MULTI-axis mesh returns the values
+    multiplied by the size of the unused mesh axes — the SPMD partitioner
+    treats the replicated concatenate operands as partial sums and inserts
+    a reduction over the axes the output is replicated on.  Measured: a
+    ``stack([ones, ones*3])`` sharded over ``pipe`` on a pipe2 x data2
+    mesh returns ``[2., 6.]``; any single-non-trivial-axis mesh, an
+    identity reshard, or constants baked into the trace are all correct.
+
+    This is exactly the pipeline param-restack shape: ``Trainer._setup``
+    initializes the stacked stage blocks sharded over ``pipe``, so pipe>1
+    trials used to start from DOUBLED block weights relative to the
+    pipe=1 comparator — the whole ~1.5% pipe-parity drift ROADMAP
+    tracked.  When this probe reports unsafe, the Trainer stages init:
+    the RNG-bearing phase materializes fully replicated (correct values),
+    the restack runs eagerly, and the reshard goes through
+    ``jax.device_put`` (both measured safe).  The probe is the observed
+    behavior itself, not a version pin, and is cached for the process.
+    """
+    global _sharded_restack_safe
+    if _sharded_restack_safe is not None:
+        return _sharded_restack_safe
+    devs = jax.devices()
+    if len(devs) < 4:
+        # the corruption needs >= 2 non-trivial mesh axes (measured: any
+        # single-axis or size-1-padded mesh is correct), which takes at
+        # least 4 devices — fewer devices cannot hit it
+        _sharded_restack_safe = True
+        return True
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(
+        np.asarray(devs[:4], dtype=object).reshape(2, 2), ("_rsk_a", "_rsk_b")
+    )
+    x = jnp.ones((4, 8), jnp.float32)
+    got = jax.jit(
+        lambda a: jnp.stack([a, a]),
+        out_shardings=NamedSharding(mesh, PartitionSpec("_rsk_a")),
+    )(x)
+    _sharded_restack_safe = bool(np.asarray(got).max() == 1.0)
+    return _sharded_restack_safe
+
 
 def axis_size(axis_name: Any) -> int:
     """Static size of a manual-collective axis.

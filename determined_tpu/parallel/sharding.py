@@ -156,3 +156,59 @@ def batch_sharding(mesh: Mesh, rules: Optional[LogicalAxisRules] = None, extra_d
     """Sharding for an input batch: ('batch', None, ...)."""
     logical: LogicalSpec = ("batch",) + (None,) * extra_dims
     return named_sharding(mesh, logical, rules)
+
+
+def grad_sync_spec(
+    shape: Sequence[int], param_spec: P, mesh: Mesh, sync_axes: Sequence[str]
+) -> Optional[P]:
+    """PartitionSpec for a gradient leaf synced by reduce-scatter.
+
+    The overlapped gradient sync (``train/_overlap.py``) wants each grad
+    leaf SHARDED over the gradient-reduction axes (data x fsdp) instead of
+    replicated-after-all-reduce: XLA then lowers the reduction to a
+    reduce-scatter at the grad's production point, the optimizer update
+    runs on 1/n of the elements per device (ZeRO-style), and the updated
+    params all-gather back to ``param_spec``.
+
+    Dim choice: prefer a dim already carrying one of ``sync_axes`` in the
+    param's own spec (the fsdp-sharded dim — extending it avoids a
+    resharding hop), else the largest dim with no existing assignment.
+    The chosen dim's total shard count must divide its size; a leaf with
+    no such dim returns None (it rides the default all-reduce).
+    """
+    entries = list(param_spec) if param_spec is not None else []
+    entries += [None] * (len(shape) - len(entries))
+    norm = [
+        tuple(e) if isinstance(e, (tuple, list)) else ((e,) if e else ())
+        for e in entries
+    ]
+    used = {a for e in norm for a in e}
+    missing = [a for a in sync_axes if a not in used]
+    if not missing:
+        return None  # already fully sharded over the sync axes
+    missing_n = 1
+    for a in missing:
+        missing_n *= mesh.shape.get(a, 1)
+    if missing_n <= 1:
+        return None
+
+    def dim_ok(d: int, extra: int) -> bool:
+        have = 1
+        for a in norm[d]:
+            have *= mesh.shape.get(a, 1)
+        return shape[d] >= have * extra and shape[d] % (have * extra) == 0
+
+    # a dim already sharded over one of the sync axes, then largest free dim
+    carrier = None
+    for d in range(len(shape)):
+        if any(a in sync_axes for a in norm[d]) and dim_ok(d, missing_n):
+            carrier = d
+            break
+    if carrier is None:
+        free = [d for d in range(len(shape)) if not norm[d] and dim_ok(d, missing_n)]
+        if not free:
+            return None
+        carrier = max(free, key=lambda d: shape[d])
+    out = list(norm)
+    out[carrier] = out[carrier] + tuple(missing)
+    return P(*[e if len(e) > 1 else (e[0] if e else None) for e in out])

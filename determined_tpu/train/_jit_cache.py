@@ -84,6 +84,10 @@ class CachedSteps:
     eval_step: Any
     trial_class: str
     hits: int = 0
+    # the UNwrapped jax.jit object for train_step: tests and benches use
+    # it to lower/inspect the compiled HLO (collective structure) without
+    # tripping the first-call compile-span wrapper
+    train_jit: Any = None
 
 
 class StepCache:
@@ -186,6 +190,8 @@ def step_cache_key(
     sample_batch: Dict[str, Any],
     metric_keys: Tuple[str, ...],
     rules: Optional[Dict[str, Any]] = None,
+    overlap: str = "overlap:none",
+    quant: str = "none",
 ) -> str:
     """Hash of everything that shapes the traced train/eval step.
 
@@ -206,6 +212,11 @@ def step_cache_key(
         "rules": {str(k): _canonical(v) for k, v in (rules or {}).items()},
         "agg": int(agg),
         "average_grads": bool(average_grads),
+        # step-program knobs (ISSUE 12): the overlapped-grad-sync bucket
+        # structure and the quantized-matmul mode both change the traced
+        # program without touching hparams or batch avals
+        "overlap": str(overlap),
+        "quant": str(quant),
         "batch": sorted(
             (k, tuple(int(d) for d in v.shape), str(v.dtype))
             for k, v in sample_batch.items()

@@ -1,0 +1,198 @@
+"""Quantized matmul arithmetic: int8/fp8 projections with fp32 masters.
+
+Motivation (BASELINE.md r3 roofline, docs/performance.md): the dense
+matmul fusions measure 85-88% of the chip's **bf16** peak — micro-tuning
+cannot pass a roofline; only changing the arithmetic moves it.  int8 MXU
+throughput is ~2x bf16 on every TPU generation and fp8 matches it on
+chips that support fp8, so routing the transformer's projection matmuls
+through reduced-precision arithmetic is the one lever that raises the
+ceiling itself.
+
+Recipe (the Q8BERT / SwitchBack shape, expressed as a flax
+``dot_general`` injection so the param tree is untouched):
+
+- **fp32 master weights**: params and optimizer state stay exactly as
+  they are (``param_dtype=f32``, Adam moments unchanged) — quantization
+  happens per-matmul on the fly, so checkpoints, sharding specs, and the
+  fused-AdamW path are byte-compatible with the unquantized model;
+- **per-channel dynamic scaling**: both operands are scaled by their
+  per-output-channel absmax over the contracting dims (activations
+  per-row, weights per-column), quantized to int8 (symmetric, 127) or
+  fp8 e4m3 (448), matmul'd with an int32/f32 accumulator, and rescaled;
+- **straight-through backward**: the custom_vjp backward transposes the
+  REFERENCE matmul via ``jax.linear_transpose`` on the full-precision
+  residuals — gradients never see quantization noise (the standard
+  stability recipe; forward noise alone keeps the loss within tolerance
+  of the bf16 oracle, pinned by tests/test_step_optimizations.py).
+
+Platform gate: int8 ``dot_general`` lowers everywhere (TPU MXU native,
+CPU via XLA).  fp8 needs hardware support (TPU v5p/v6+, Hopper-class
+GPUs) — requesting it elsewhere raises ``InvalidExperimentConfig`` at
+setup, except under ``DTPU_QUANT_EMULATE=1`` which permits the (slow,
+numerics-only) emulated path for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from determined_tpu.config.experiment import QUANT_MODES, InvalidExperimentConfig
+
+#: TPU generations with native fp8 matmul support (prefix match on
+#: device_kind, same convention as the peak-FLOPs table)
+_FP8_TPU_PREFIXES = ("TPU v5p", "TPU v6", "TPU v7")
+_FP8_GPU_MARKERS = ("H100", "H200", "B100", "B200", "GH200")
+
+
+def fp8_supported(
+    backend: Optional[str] = None, device_kind: Optional[str] = None
+) -> bool:
+    if os.environ.get("DTPU_QUANT_EMULATE", "0") == "1":
+        return True
+    backend = backend or jax.default_backend()
+    if device_kind is None:
+        devs = jax.devices()
+        device_kind = getattr(devs[0], "device_kind", "") if devs else ""
+    if backend == "tpu":
+        return any(device_kind.startswith(p) for p in _FP8_TPU_PREFIXES)
+    if backend == "gpu":
+        return any(m in device_kind for m in _FP8_GPU_MARKERS)
+    return False
+
+
+def require_platform(
+    mode: str, backend: Optional[str] = None, device_kind: Optional[str] = None
+) -> None:
+    """Raise ``InvalidExperimentConfig`` when the requested quantized
+    matmul mode cannot run on this platform (clear message, at setup time
+    — not a cryptic lowering error mid-compile)."""
+    if mode not in QUANT_MODES:
+        raise InvalidExperimentConfig(
+            f"quantized_matmul {mode!r} not in {QUANT_MODES}"
+        )
+    if mode != "fp8":
+        return
+    backend = backend or jax.default_backend()
+    if not fp8_supported(backend, device_kind):
+        devs = jax.devices()
+        kind = device_kind or (getattr(devs[0], "device_kind", "") if devs else "")
+        raise InvalidExperimentConfig(
+            f"quantized_matmul: fp8 is not supported on this platform "
+            f"(backend={backend!r}, device_kind={kind!r}); fp8 needs "
+            f"TPU v5p/v6+ or a Hopper-class GPU — use int8 here, or set "
+            f"DTPU_QUANT_EMULATE=1 for the slow emulated path in tests"
+        )
+
+
+def _contract_scale(x: jax.Array, contract_dims: Tuple[int, ...], qmax: float):
+    """Per-channel symmetric scale: absmax over the contracting dims."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=contract_dims, keepdims=True)
+    return jnp.maximum(amax, 1e-12) / qmax
+
+
+def _squeeze_to_out(
+    scale: jax.Array, contract_dims: Tuple[int, ...], lead: int, trail: int
+) -> jax.Array:
+    """Reshape a keepdims per-channel scale to broadcast over the
+    dot_general output: drop contract dims, pad ``lead``/``trail`` size-1
+    dims for the other operand's free dims."""
+    s = lax.squeeze(scale, contract_dims)
+    return s.reshape((1,) * lead + s.shape + (1,) * trail)
+
+
+@functools.lru_cache(maxsize=256)
+def _quant_dot(mode: str, dn: Any) -> Any:
+    """custom_vjp quantized dot for one (mode, dimension_numbers).
+
+    Cached so repeated flax layer calls share one primitive-like callable
+    per signature (keeps trace size and custom_vjp count bounded).
+    """
+    (c_l, c_r), (b_l, b_r) = dn
+    if b_l or b_r:  # flax Dense/DenseGeneral never uses batch dims
+        raise NotImplementedError(
+            "quantized dot_general does not support batch dimensions"
+        )
+    c_l, c_r = tuple(c_l), tuple(c_r)
+
+    def quantized(lhs: jax.Array, rhs: jax.Array) -> jax.Array:
+        out_dtype = lhs.dtype
+        if mode == "int8":
+            s_l = _contract_scale(lhs, c_l, 127.0)
+            s_r = _contract_scale(rhs, c_r, 127.0)
+            q_l = jnp.clip(jnp.round(lhs / s_l), -127, 127).astype(jnp.int8)
+            q_r = jnp.clip(jnp.round(rhs / s_r), -127, 127).astype(jnp.int8)
+            acc = lax.dot_general(
+                q_l, q_r, dn, preferred_element_type=jnp.int32
+            ).astype(jnp.float32)
+        else:  # fp8 (e4m3 values; accumulation in f32)
+            f8 = jnp.float8_e4m3fn
+            s_l = _contract_scale(lhs, c_l, 448.0)
+            s_r = _contract_scale(rhs, c_r, 448.0)
+            q_l = (lhs / s_l).astype(f8)
+            q_r = (rhs / s_r).astype(f8)
+            acc = lax.dot_general(
+                q_l, q_r, dn, preferred_element_type=jnp.float32
+            )
+        n_free_l = lhs.ndim - len(c_l)
+        n_free_r = rhs.ndim - len(c_r)
+        out = (
+            acc
+            * _squeeze_to_out(s_l, c_l, 0, n_free_r)
+            * _squeeze_to_out(s_r, c_r, n_free_l, 0)
+        )
+        return out.astype(out_dtype)
+
+    @jax.custom_vjp
+    def qdot(lhs, rhs):
+        return quantized(lhs, rhs)
+
+    def fwd(lhs, rhs):
+        return quantized(lhs, rhs), (lhs, rhs)
+
+    def bwd(res, g):
+        lhs, rhs = res
+        # straight-through: transpose the REFERENCE (unquantized) matmul,
+        # so gradients are exact for the full-precision linearization
+        g = g.astype(lhs.dtype)
+        d_lhs = jax.linear_transpose(
+            lambda a: lax.dot_general(a, rhs, dn), lhs
+        )(g)[0]
+        d_rhs = jax.linear_transpose(
+            lambda b: lax.dot_general(lhs, b, dn), rhs
+        )(g)[0]
+        return d_lhs, d_rhs
+
+    qdot.defvjp(fwd, bwd)
+    return qdot
+
+
+def _canon_dn(dimension_numbers: Any) -> Any:
+    (c_l, c_r), (b_l, b_r) = dimension_numbers
+    return (tuple(c_l), tuple(c_r)), (tuple(b_l), tuple(b_r))
+
+
+def make_dot_general(mode: str) -> Any:
+    """A ``lax.dot_general``-compatible callable routing through the
+    quantized path — inject into flax ``Dense``/``DenseGeneral`` via
+    their ``dot_general=`` attribute, so the param tree, initializers,
+    and partitioning metadata are untouched."""
+    if mode in (None, "none"):
+        return lax.dot_general
+
+    def dot_general(
+        lhs: jax.Array,
+        rhs: jax.Array,
+        dimension_numbers: Any,
+        precision: Any = None,
+        preferred_element_type: Any = None,
+    ) -> jax.Array:
+        del precision, preferred_element_type  # quantized path fixes both
+        return _quant_dot(mode, _canon_dn(dimension_numbers))(lhs, rhs)
+
+    return dot_general

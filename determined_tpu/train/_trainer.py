@@ -196,6 +196,8 @@ class Trainer:
         self._pending_save: Optional[_PendingSave] = None
         self._snapshot_jit: Any = None
         self._tokens_per_sample: Optional[int] = None  # set by _setup
+        self._overlap_plan: Any = None  # train/_overlap.py GradSyncPlan
+        self._comm_model: Any = None    # its CommModel (step.comm ledger rows)
         # Newest FINALIZED checkpoint (manifest written, master reported).
         # An async save still in flight is deliberately excluded: until its
         # drain-point finalize runs it has no manifest and must never be
@@ -230,8 +232,11 @@ class Trainer:
         self._sample_host_batch = sample
 
         # ---- parameter shapes + logical specs (no real init yet) --------
-        abstract_boxed = jax.eval_shape(
+        abstract_raw_boxed = jax.eval_shape(
             lambda r: self.trial.init_params(self.model, r, sample), init_rng
+        )
+        abstract_boxed = jax.eval_shape(
+            self.trial.restructure_params, abstract_raw_boxed
         )
         specs = self.trial.param_logical_specs(abstract_boxed)
         if specs is None:
@@ -265,25 +270,108 @@ class Trainer:
         # active, and logical names are not mesh axes.  out_shardings carry
         # the mesh explicitly, so init still materializes directly sharded
         # (no single-device materialization at FSDP scale).
-        params = jax.jit(
-            lambda r: flax_meta.unbox(self.trial.init_params(self.model, r, sample)),
-            out_shardings=shardings,
-        )(init_rng)
-        opt_state = jax.jit(self.tx.init)(params)
+        from determined_tpu.parallel._compat import sharded_restack_safe
+
+        # process_count first: the probe itself jits over a 2x2 mesh of
+        # jax.devices()[:4], which on a multi-host gang spans
+        # non-addressable devices and cannot be fetched
+        if jax.process_count() > 1 or sharded_restack_safe():
+            params = jax.jit(
+                lambda r: flax_meta.unbox(
+                    self.trial.restructure_params(
+                        self.trial.init_params(self.model, r, sample)
+                    )
+                ),
+                out_shardings=shardings,
+            )(init_rng)
+        else:
+            # Affected jax (see _compat.sharded_restack_safe): a restack
+            # (jnp.stack) into sharded out_shardings over a multi-axis
+            # mesh SUMS the replicated operands, so a pipe>1 trial would
+            # start from doubled block weights.  Stage the init: the
+            # RNG-bearing phase materializes fully replicated (measured
+            # correct), the restructure runs eagerly, and the reshard
+            # goes through device_put (an honest transfer, not a GSPMD
+            # resharding).  Single-process only — device_put refuses
+            # non-addressable shardings, and the multiprocess CPU gangs
+            # that would care run one device per host (< 4 devices never
+            # hits the bug).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            raw = jax.jit(
+                lambda r: self.trial.init_params(self.model, r, sample),
+                out_shardings=jax.tree.map(lambda _: repl, abstract_raw_boxed),
+            )(init_rng)
+            params = jax.device_put(
+                flax_meta.unbox(self.trial.restructure_params(raw)), shardings
+            )
+
+        # ---- overlapped gradient sync plan (train/_overlap.py) -----------
+        # Built whenever the mesh has gradient-reduction axes: with the
+        # knob ON it carries the bucket markers + sharded layouts the step
+        # uses below; either way it carries the comm model feeding the
+        # goodput ledger's step.comm rows (docs/performance.md).
+        opt = ctx.exp_config.optimizations if ctx.exp_config is not None else None
+        from determined_tpu.train import _overlap
+
+        self._overlap_plan = _overlap.build_plan(
+            abstract,
+            shardings,
+            self.mesh,
+            enabled=bool(opt is not None and opt.overlap_grad_sync),
+            bucket_bytes=(opt.overlap_bucket_mb if opt else 4) * 1024 * 1024,
+        )
+        self._comm_model = (
+            self._overlap_plan.comm if self._overlap_plan is not None else None
+        )
+        sync_on = self._overlap_plan is not None and self._overlap_plan.enabled
+
+        if opt is not None and opt.quantized_matmul != "none":
+            # fail fast with a clear config error on unsupported platforms
+            # (e.g. fp8 off TPU v5p/v6+), before any compile is attempted
+            from determined_tpu.train._quant import require_platform
+
+            dev0 = self.mesh.devices.flat[0]
+            require_platform(
+                opt.quantized_matmul,
+                backend=getattr(dev0, "platform", None),
+                device_kind=getattr(dev0, "device_kind", None),
+            )
+
+        if sync_on:
+            # ZeRO-style memory win: the adam mirror leaves (mu/nu) live
+            # SHARDED over the sync axes, matching the reduce-scattered
+            # grads the update consumes — each device owns 1/n of the
+            # optimizer state instead of a full replica
+            abstract_opt = jax.eval_shape(self.tx.init, params)
+            opt_state = jax.jit(
+                self.tx.init,
+                out_shardings=self._overlap_plan.opt_shardings(abstract_opt),
+            )(params)
+        else:
+            opt_state = jax.jit(self.tx.init)(params)
         self.state = TrainState.create(params, opt_state, state_rng, metric_keys)
         self.state = self._place_on_mesh(self.state)
 
         # ---- jitted steps -------------------------------------------------
         trial, model, tx = self.trial, self.model, self.tx
-        opt = ctx.exp_config.optimizations if ctx.exp_config is not None else None
         agg = opt.aggregation_frequency if opt else 1
         average_grads = opt.average_aggregated_gradients if opt else True
         self.agg = agg
+        overlap = self._overlap_plan if sync_on else None
 
         def train_step(state: TrainState, batch):
             step_rng = jax.random.fold_in(state.rng, state.step)
 
             def loss_fn(p, mb):
+                if overlap is not None and agg == 1:
+                    # bucket markers: identity forward; backward pins each
+                    # bucket's grads to the reduce-scattered layout at its
+                    # production point (train/_overlap.py).  Under grad
+                    # accumulation the sync moves AFTER the scan instead —
+                    # one reduction per OPTIMIZER step, not per microbatch
+                    p = overlap.mark(p)
                 loss, m = trial.loss(model, p, mb, step_rng)
                 return loss, m
 
@@ -318,6 +406,10 @@ class Trainer:
                 metrics = {k: v / agg for k, v in metrics.items()}
                 if average_grads:
                     grads = jax.tree.map(lambda g: g / agg, grads)
+                if overlap is not None:
+                    # sync the ACCUMULATED grads once — inside the scan the
+                    # markers would issue agg collectives per optimizer step
+                    grads = overlap.apply_grad_sync(grads)
             if hasattr(tx, "apply_step"):
                 # fused full-step optimizer (ops/fused_adamw.py): produces
                 # new params directly — materializing an updates tree would
@@ -326,6 +418,12 @@ class Trainer:
             else:
                 updates, new_opt = tx.update(grads, state.opt_state, state.params)
                 new_params = optax.apply_updates(state.params, updates)
+            if overlap is not None:
+                # the closing all-gather: sharded update back to the
+                # params' own layout; opt state pinned so donated buffers
+                # round-trip with stable shardings step over step
+                new_params = overlap.restore_params(new_params)
+                new_opt = overlap.pin_opt_state(new_opt)
             metrics = dict(metrics)
             metrics["loss"] = loss
             # schedule-state surfacing (reference LRScheduler wrapper): a
@@ -399,20 +497,31 @@ class Trainer:
                 sample_batch=sample,
                 metric_keys=metric_keys,
                 rules=ctx.rules,
+                # both knobs reshape the traced program (collective
+                # structure / matmul arithmetic): toggling either must
+                # never serve a stale trace
+                overlap=(
+                    self._overlap_plan.fingerprint()
+                    if self._overlap_plan is not None
+                    else "overlap:none"
+                ),
+                quant=opt.quantized_matmul if opt else "none",
             )
             cache = _jit_cache.get_step_cache()
             entry = cache.lookup(key)
             if entry is None:
+                train_jit = jax.jit(train_step, donate_argnums=0)
                 entry = cache.insert(
                     key,
                     _jit_cache.CachedSteps(
                         train_step=_jit_cache.timed_first_call(
-                            jax.jit(train_step, donate_argnums=0), "jit.compile.train"
+                            train_jit, "jit.compile.train"
                         ),
                         eval_step=_jit_cache.timed_first_call(
                             jax.jit(eval_step, donate_argnums=2), "jit.compile.eval"
                         ),
                         trial_class=f"{type(trial).__module__}:{type(trial).__qualname__}",
+                        train_jit=train_jit,
                     ),
                 )
             else:
@@ -424,9 +533,11 @@ class Trainer:
                 )
             self._train_step = entry.train_step
             self._eval_step = entry.eval_step
+            self._train_step_jit = entry.train_jit
         else:
+            self._train_step_jit = jax.jit(train_step, donate_argnums=0)
             self._train_step = _jit_cache.timed_first_call(
-                jax.jit(train_step, donate_argnums=0), "jit.compile.train"
+                self._train_step_jit, "jit.compile.train"
             )
             self._eval_step = _jit_cache.timed_first_call(
                 jax.jit(eval_step, donate_argnums=2), "jit.compile.eval"
@@ -1069,6 +1180,22 @@ class Trainer:
                             "train.tokens",
                             float(steps_since_report * gbs * self._tokens_per_sample),
                         )
+                    if self._comm_model is not None:
+                        # step.comm ledger rows (observability/_goodput.py):
+                        # measured payload bytes, exposed/hidden split from
+                        # the bucket-schedule model against the segment's
+                        # average step time (counters, not spans — they
+                        # must not perturb the span-nesting attribution)
+                        exposed_s, hidden_s = self._comm_model.split(
+                            hot_time / steps_since_report
+                        )
+                        n = float(steps_since_report)
+                        tracer.counter(
+                            "step.comm.bytes",
+                            float(self._comm_model.bytes_per_step) * n,
+                        )
+                        tracer.counter("step.comm.exposed_us", exposed_s * 1e6 * n)
+                        tracer.counter("step.comm.hidden_us", hidden_s * 1e6 * n)
                 self.state = self.state.reset_metrics()
                 metrics["samples_per_second"] = steps_since_report * gbs / max(hot_time, 1e-9)
                 hot_time = 0.0

@@ -202,6 +202,22 @@ class JaxTrial(abc.ABC):
             return (batch["x"],)
         return (next(iter(batch.values())),)
 
+    def restructure_params(self, params: Any) -> Any:
+        """Value-preserving post-init restructure of the raw param tree
+        (e.g. restacking per-layer blocks into pipeline stages — see
+        ``models/transformer.py`` ``split_pipeline_params``).
+
+        Runs under jit right after ``init_params``.  It is a SEPARATE hook
+        (rather than part of ``init_params``) so the Trainer can stage the
+        two on affected jax versions: a jitted restack into sharded
+        out_shardings over a multi-axis mesh SUMS its replicated operands
+        there, so the RNG-bearing init materializes replicated and only
+        this RNG-free restructure is resharded — see
+        ``parallel/_compat.py`` ``sharded_restack_safe``.  Default:
+        identity.
+        """
+        return params
+
     def compile_cache_runtime_hparams(self) -> Tuple[str, ...]:
         """Hyperparameters that do NOT shape the compiled step.
 

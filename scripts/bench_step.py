@@ -1,0 +1,250 @@
+"""Step-program optimization microbench: overlap + quantized matmul A/B.
+
+The two ``optimizations:`` knobs from the 0.70-MFU plateau attack
+(docs/performance.md) each get a like-for-like A/B on the same machine,
+emitting ONE ``bench.py``-shaped JSON row per requested mode:
+
+- ``DTPU_BENCH_OVERLAP=1`` — baseline end-of-backward gradient reduction
+  vs ``overlap_grad_sync`` (bucketed reduce-scatter / sharded optimizer /
+  all-gather params).  The row carries tokens/s for both arms, the
+  goodput ledger's exposed-vs-hidden comm split for both arms (the
+  ``step.comm`` rows fed by the bucket-schedule model), and the measured
+  max param deviation after N identical steps — the overlap restructure
+  must be numerically a no-op.
+- ``DTPU_BENCH_QUANT=1`` — bf16/f32 oracle vs ``quantized_matmul: int8``
+  (and fp8 where supported/emulated): same seed, same data, N steps; the
+  row carries both loss curves' max relative deviation against the
+  stated tolerance plus tokens/s for both arms.
+
+On CPU the A/B runs on the virtual 8-device mesh (data2 x fsdp4) and
+proves STRUCTURE + NUMERICS (collective layout, sharded opt state, loss
+parity); the TPU MFU row is marked "next chip round" — wall-clock wins
+need real async collectives and an MXU.
+
+    DTPU_BENCH_OVERLAP=1 python bench.py
+    DTPU_BENCH_QUANT=1 python bench.py
+    JAX_PLATFORMS=cpu python scripts/bench_step.py overlap quant
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+_RESPAWN = "DTPU_BENCH_STEP_RESPAWNED"
+
+
+def _maybe_respawn() -> None:
+    """CPU needs the virtual 8-device platform, which must be set before
+    jax initializes — respawn once with the flag if we're short."""
+    import jax
+
+    if (
+        jax.default_backend() == "cpu"
+        and len(jax.devices()) < 8
+        and os.environ.get(_RESPAWN) != "1"
+    ):
+        env = dict(os.environ)
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append("--xla_force_host_platform_device_count=8")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env["JAX_PLATFORMS"] = "cpu"
+        env[_RESPAWN] = "1"
+        raise SystemExit(
+            subprocess.call([sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env=env)
+        )
+
+
+HP = {
+    "lr": 1e-3,
+    "global_batch_size": 16,
+    "seq_len": int(os.environ.get("DTPU_BENCH_STEP_SEQ", 64)),
+    "vocab_size": 512,
+    "d_model": int(os.environ.get("DTPU_BENCH_STEP_D", 128)),
+    "n_layers": 2,
+    "n_heads": 4,
+    "dataset_size": 256,
+    "bf16": False,  # f32 keeps the numerics comparison meaningful on CPU
+    "attention": "reference",
+    "warmup_steps": 1,
+}
+STEPS = int(os.environ.get("DTPU_BENCH_STEP_STEPS", 12))
+
+
+def _run_arm(opts: dict, tag: str, hp: dict, steps: int = STEPS):
+    """One trainer run; returns (trainer, losses, tokens_per_s, ledger)."""
+    import jax
+
+    from determined_tpu import core, train
+    from determined_tpu.config import ExperimentConfig, Length
+    from determined_tpu.models.transformer import LMTrial
+    from determined_tpu.observability import compute_ledger, get_tracer
+    from determined_tpu.parallel.mesh import MeshConfig
+    from determined_tpu.train import _jit_cache
+
+    _jit_cache.clear_step_cache()
+    if jax.default_backend() == "cpu":
+        mesh = MeshConfig(data=2, fsdp=4)
+    else:
+        mesh = MeshConfig(data=-1)
+    exp = ExperimentConfig.parse({"optimizations": opts})
+    ctx = train.init(
+        hparams=dict(hp),
+        mesh_config=mesh,
+        core_context=core._dummy_init(),
+        exp_config=exp,
+        seed=7,
+    )
+    trainer = train.Trainer(LMTrial(ctx))
+    losses = []
+    sps = []
+    orig = ctx.core.train.report_training_metrics
+    ctx.core.train.report_training_metrics = lambda s, m: (
+        losses.append(float(m["loss"])),
+        sps.append(float(m["samples_per_second"])),
+        orig(s, m),
+    )
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.configure(enabled=True)
+    tracer.start()
+    try:
+        with tracer.span("trial.run", cat="trial", trial=tag):
+            trainer.fit(
+                Length.batches(steps),
+                report_period=Length.batches(1),
+                checkpoint_policy="none",
+            )
+    finally:
+        tracer.stop()
+    ledger = compute_ledger(tracer.chrome_events(), dropped=tracer.dropped())
+    # per-report samples/s; the first reports pay compile, so take the
+    # median of the tail as the steady-state number
+    tail = sps[len(sps) // 2:] or sps
+    tokens_per_s = statistics.median(tail) * hp["seq_len"]
+    return trainer, losses, tokens_per_s, ledger
+
+
+def _param_maxdiff(a, b) -> float:
+    import jax
+    import numpy as np
+
+    return max(
+        float(
+            np.abs(
+                np.asarray(x, dtype=np.float64) - np.asarray(y, dtype=np.float64)
+            ).max()
+        )
+        for x, y in zip(
+            jax.tree.leaves(jax.device_get(a)), jax.tree.leaves(jax.device_get(b))
+        )
+    )
+
+
+def _chip() -> str:
+    import jax
+
+    return getattr(jax.devices()[0], "device_kind", "unknown")
+
+
+def bench_overlap() -> dict:
+    import jax
+
+    t_off, _, tps_off, led_off = _run_arm({}, "overlap-off", HP)
+    t_on, _, tps_on, led_on = _run_arm(
+        {"overlap_grad_sync": True, "overlap_bucket_mb": 1}, "overlap-on", HP
+    )
+    comm_off = led_off["experiment"].get("step.comm", {})
+    comm_on = led_on["experiment"].get("step.comm", {})
+    maxdiff = _param_maxdiff(t_off.state.params, t_on.state.params)
+    plan = t_on._overlap_plan
+    row = {
+        "metric": "transformer_lm_overlap_grad_sync_tokens_per_sec",
+        "value": round(tps_on, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps_on / max(tps_off, 1e-9), 3),
+        "baseline_tokens_per_s": round(tps_off, 1),
+        "exposed_comm_s_baseline": comm_off.get("exposed_s"),
+        "exposed_comm_s_overlap": comm_on.get("exposed_s"),
+        "hidden_comm_s_overlap": comm_on.get("hidden_s"),
+        "comm_model": comm_on.get("model"),
+        "buckets": len(plan.buckets) if plan else 0,
+        "synced_leaves": plan.synced_leaves if plan else 0,
+        "numerics_param_maxdiff": maxdiff,
+        "numerically_identical": maxdiff < 1e-5,
+        "chip": _chip(),
+        "steps": STEPS,
+    }
+    if jax.default_backend() != "tpu":
+        row["note"] = (
+            "CPU virtual mesh: structure+numerics A/B; TPU MFU row next chip round"
+        )
+    return row
+
+
+def bench_quant() -> dict:
+    import jax
+
+    from determined_tpu.train import _quant
+
+    _, l_ref, tps_ref, _ = _run_arm({}, "quant-ref", HP)
+    _, l_int8, tps_int8, _ = _run_arm({"quantized_matmul": "int8"}, "quant-int8", HP)
+    rel_dev = max(abs(a - b) / max(abs(a), 1e-9) for a, b in zip(l_ref, l_int8))
+    tol = float(os.environ.get("DTPU_BENCH_QUANT_TOL", 0.02))
+    row = {
+        "metric": "transformer_lm_quantized_matmul_tokens_per_sec",
+        "value": round(tps_int8, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps_int8 / max(tps_ref, 1e-9), 3),
+        "mode": "int8",
+        "baseline_tokens_per_s": round(tps_ref, 1),
+        "loss_final_ref": round(l_ref[-1], 5),
+        "loss_final_int8": round(l_int8[-1], 5),
+        "loss_curve_max_rel_dev": round(rel_dev, 5),
+        "loss_tolerance": tol,
+        "within_tolerance": rel_dev <= tol,
+        "fp8_supported_here": _quant.fp8_supported(),
+        "chip": _chip(),
+        "steps": STEPS,
+    }
+    if jax.default_backend() != "tpu":
+        row["note"] = (
+            "CPU: int8 arithmetic is emulated (no MXU) — numerics-only A/B; "
+            "TPU MFU row next chip round"
+        )
+    return row
+
+
+def main() -> None:
+    modes = [m for m in sys.argv[1:] if m in ("overlap", "quant")]
+    if not modes:
+        if os.environ.get("DTPU_BENCH_OVERLAP", "0") not in ("0", ""):
+            modes.append("overlap")
+        if os.environ.get("DTPU_BENCH_QUANT", "0") not in ("0", ""):
+            modes.append("quant")
+    if not modes:
+        modes = ["overlap", "quant"]
+    _maybe_respawn()
+    ok = True
+    for mode in modes:
+        row = bench_overlap() if mode == "overlap" else bench_quant()
+        print(json.dumps(row))
+        if mode == "overlap":
+            ok = ok and row["numerically_identical"]
+        else:
+            ok = ok and row["within_tolerance"]
+    raise SystemExit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -81,16 +81,16 @@ def test_pipe_trains_through_trainer(tmp_path, mesh_config):
     assert losses[-1] < losses[0]  # it actually learns
 
 
-_PARITY_DRIFT_XFAIL = pytest.mark.xfail(
-    strict=False,
-    reason="known ~1.5% pipe1-vs-pipe2 loss parity drift (ROADMAP.md open "
-    "items); the jax-0.4.37 shard_map transpose _SpecError that used to "
-    "mask this class is fixed by parallel/_compat.py's transpose shim — "
-    "what remains is numeric parity, tracked, not a regression gate",
-)
+# The ~1.5% parity drift these three tests used to xfail on is FIXED: it
+# was never GPipe numerics — jax 0.4.37's SPMD partitioner SUMS replicated
+# operands of a jitted stack whose output is sharded over a multi-axis
+# mesh, so the pipe trial's restacked block params initialized to exactly
+# 2x the pipe=1 comparator's weights.  The Trainer now stages init on
+# affected jax (replicated RNG phase -> eager restack -> device_put
+# reshard; parallel/_compat.py sharded_restack_safe), and parity is
+# bit-exact.
 
 
-@_PARITY_DRIFT_XFAIL
 def test_pipe2_loss_parity_vs_pipe1(tmp_path):
     """Same seed, same data: the pipelined step must reproduce the plain
     step's loss trajectory (GPipe is mathematically exact; init is shared
@@ -137,7 +137,6 @@ def test_pipe_fused_ce_path(tmp_path):
     assert all(np.isfinite(losses))
 
 
-@_PARITY_DRIFT_XFAIL
 def test_pipe_composes_with_seq_axis(tmp_path):
     """pipe2 × seq2 × dp2: ring attention runs INSIDE each pipeline stage
     (the ring is over seq shards, orthogonal to the stage rotation); loss
@@ -166,7 +165,6 @@ MOE_HPARAMS = dict(
 )
 
 
-@_PARITY_DRIFT_XFAIL
 def test_pipe_composes_with_expert_axis(tmp_path):
     """pipe2 × expert2 × dp2: MoE blocks live inside stages with expert
     weights sharded over the expert axis and a psum combine intra-stage;

@@ -1,0 +1,442 @@
+"""Step-program optimizations (ISSUE 12): overlapped gradient sync +
+quantized matmul arithmetic.
+
+The acceptance bars, on the virtual 8-device CPU mesh:
+
+- ``overlap_grad_sync`` is numerically a no-op vs the baseline reduction
+  (params/opt_state allclose after N steps on data2 x fsdp4), the
+  optimizer state comes out SHARDED over the sync axes (the ZeRO memory
+  win), and the compiled HLO carries the reduce-scatter/all-gather
+  structure (XLA:CPU spells the reduce-scatter as all-reduce +
+  dynamic-slice; the closing all-gathers only exist in the overlapped
+  program);
+- ``quantized_matmul: int8`` trains the LM smoke within a stated loss
+  tolerance of the full-precision oracle; fp8 on an unsupported platform
+  is rejected with a clear ``InvalidExperimentConfig``;
+- both knobs key the cross-trial jit cache (toggling never serves a
+  stale trace) and compose with ``aggregation_frequency`` — with overlap
+  on, gradient accumulation reduces ONCE per optimizer step, not per
+  microbatch (the grads sync AFTER the microbatch scan).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from determined_tpu import core, train
+from determined_tpu.config import ExperimentConfig, InvalidExperimentConfig, Length
+from determined_tpu.models.transformer import LMTrial
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+from determined_tpu.train import _jit_cache, _overlap, _quant
+
+HP = {
+    "lr": 1e-3,
+    "global_batch_size": 16,
+    "seq_len": 32,
+    "vocab_size": 128,
+    "d_model": 64,
+    "n_layers": 2,
+    "n_heads": 4,
+    "dataset_size": 64,
+    "bf16": False,
+    "attention": "reference",
+    "warmup_steps": 1,
+}
+
+
+def _run(tmp_path, opts, steps=3, hp=None, tag=""):
+    _jit_cache.clear_step_cache()
+    exp = ExperimentConfig.parse({"optimizations": opts})
+    ctx = train.init(
+        hparams=dict(hp or HP),
+        mesh_config=MeshConfig(data=2, fsdp=4),
+        core_context=core._dummy_init(checkpoint_dir=str(tmp_path / f"ck{tag}")),
+        exp_config=exp,
+        seed=3,
+    )
+    trainer = train.Trainer(LMTrial(ctx))
+    losses = []
+    orig = ctx.core.train.report_training_metrics
+    ctx.core.train.report_training_metrics = lambda s, m: (
+        losses.append(float(m["loss"])),
+        orig(s, m),
+    )
+    trainer.fit(
+        Length.batches(steps),
+        report_period=Length.batches(1),
+        checkpoint_policy="none",
+    )
+    return trainer, losses
+
+
+def _maxdiff(a, b):
+    return max(
+        float(np.abs(np.asarray(x, np.float64) - np.asarray(y, np.float64)).max())
+        for x, y in zip(
+            jax.tree.leaves(jax.device_get(a)), jax.tree.leaves(jax.device_get(b))
+        )
+    )
+
+
+def _compiled_text(trainer):
+    from determined_tpu.data import to_global
+
+    host = next(trainer.train_loader.iter_epoch(0))
+    if trainer.agg > 1:  # the input pipeline feeds stacked [agg, bs, ...]
+        host = {k: np.stack([v] * trainer.agg) for k, v in host.items()}
+    batch = to_global(host, trainer.mesh, micro_dim=trainer.agg > 1)
+    with trainer.mesh:
+        return trainer._train_step_jit.lower(trainer.state, batch).compile().as_text()
+
+
+# ---------------------------------------------------------------------------
+# overlap_grad_sync
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_numerics_sharding_and_hlo(tmp_path):
+    """The tentpole acceptance test: same seed/data on data2 x fsdp4, the
+    overlapped program must match the baseline to float reassociation,
+    shard the optimizer mirror state, and carry the RS/AG structure."""
+    base, _ = _run(tmp_path, {}, tag="a")
+    over, _ = _run(
+        tmp_path, {"overlap_grad_sync": True, "overlap_bucket_mb": 1}, tag="b"
+    )
+    plan = over._overlap_plan
+    assert plan is not None and plan.enabled
+    assert plan.synced_leaves > 0 and len(plan.buckets) >= 1
+
+    # numerics: params AND opt_state allclose after N steps
+    assert _maxdiff(base.state.params, over.state.params) < 1e-5
+    assert _maxdiff(base.state.opt_state, over.state.opt_state) < 1e-5
+
+    # ZeRO memory win: adam mirror leaves sharded over BOTH sync axes
+    sharded = [
+        leaf
+        for leaf in jax.tree.leaves(over.state.opt_state)
+        if getattr(leaf, "ndim", 0) >= 2
+        and any(
+            set(ax if isinstance(ax, tuple) else (ax,)) >= {"data", "fsdp"}
+            for ax in leaf.sharding.spec
+            if ax is not None
+        )
+    ]
+    assert sharded, "no optimizer leaf is sharded over (data, fsdp)"
+
+    # HLO structure: the closing param all-gathers only exist overlapped
+    # (XLA:CPU lowers the reduce-scatter itself as all-reduce + slice)
+    base_hlo = _compiled_text(base)
+    over_hlo = _compiled_text(over)
+    assert "all-gather" not in base_hlo
+    assert over_hlo.count("all-gather") >= len(plan.buckets)
+
+
+def test_overlap_with_grad_accumulation_syncs_once(tmp_path):
+    """agg>1 + overlap: numerics match the agg baseline, and the
+    microbatch scan body carries NO gradient collectives — the sync runs
+    once per OPTIMIZER step on the accumulated grads (the regression this
+    test pins: markers inside the scan would issue agg collectives)."""
+    base, _ = _run(tmp_path, {"aggregation_frequency": 2}, steps=2, tag="a")
+    over, _ = _run(
+        tmp_path,
+        {"aggregation_frequency": 2, "overlap_grad_sync": True},
+        steps=2,
+        tag="b",
+    )
+    assert _maxdiff(base.state.params, over.state.params) < 1e-5
+
+    # the microbatch scan compiles to while-loop body computations
+    # (%region_* / %wide.* in HLO text); the gradient collectives
+    # (all-gathers of the RS/AG pair) must ALL sit in the entry
+    # computation — one sync per optimizer step, not per microbatch
+    hlo = _compiled_text(over)
+    per_comp = {}
+    cur = "TOP"
+    for line in hlo.splitlines():
+        if line and not line[0].isspace() and "{" in line:
+            cur = line.split("(")[0].strip()
+        elif "all-gather" in line and " = " in line:
+            per_comp[cur] = per_comp.get(cur, 0) + 1
+    assert per_comp, "no all-gather anywhere: overlap structure missing"
+    for comp, n in per_comp.items():
+        assert comp.startswith("ENTRY"), (
+            f"{n} gradient collective(s) inside scan computation {comp}: "
+            "overlap must sync once per optimizer step"
+        )
+
+
+def test_overlap_defaults_off_and_plan_accounting():
+    mesh = make_mesh(MeshConfig(data=2, fsdp=4))
+    tree = {
+        "a": jax.ShapeDtypeStruct((256, 64), jnp.float32),
+        "b": jax.ShapeDtypeStruct((256, 64), jnp.float32),
+        "c": jax.ShapeDtypeStruct((256, 64), jnp.float32),
+        "small": jax.ShapeDtypeStruct((8,), jnp.float32),
+    }
+    specs = {k: None for k in tree}
+    from determined_tpu.parallel.sharding import param_shardings
+
+    shardings = param_shardings(specs, mesh)
+    plan = _overlap.build_plan(
+        tree,
+        shardings,
+        mesh,
+        enabled=True,
+        bucket_bytes=256 * 64 * 4,  # one big leaf per bucket
+        min_sync_bytes=1024,
+    )
+    assert plan.enabled
+    assert plan.synced_leaves == 3  # small leaf rides the final all-reduce
+    assert len(plan.buckets) == 3
+    # ring accounting: RS+AG == AR bytes, 2*(n-1)/n of the f32 payload
+    n = 8
+    expect = 3 * int(2 * (n - 1) / n * (256 * 64 * 4)) + int(2 * (n - 1) / n * 8 * 4)
+    assert plan.comm.bytes_per_step == expect
+
+    off = _overlap.build_plan(tree, shardings, mesh, enabled=False)
+    assert off is not None and not off.enabled
+    assert off.comm.n_buckets == 1  # baseline: one exposed reduction
+    exposed, hidden = off.comm.split(0.1)
+    assert hidden == 0.0 and exposed > 0.0
+    # multi-bucket schedule hides (B-1)/B of the comm -> less exposed
+    exposed_on, hidden_on = plan.comm.split(0.1)
+    assert exposed_on < exposed and hidden_on > 0.0
+
+    # no sync axes -> no plan
+    single = make_mesh(MeshConfig(data=1), jax.devices()[:1])
+    assert (
+        _overlap.build_plan(
+            tree, param_shardings(specs, single), single, enabled=True
+        )
+        is None
+    )
+
+
+def test_grad_sync_spec_prefers_existing_fsdp_dim():
+    from jax.sharding import PartitionSpec as P
+
+    from determined_tpu.parallel.sharding import grad_sync_spec
+
+    mesh = make_mesh(MeshConfig(data=2, fsdp=4))
+    # replicated param: largest divisible dim takes both axes
+    spec = grad_sync_spec((64, 256), P(), mesh, ("data", "fsdp"))
+    assert spec == P(None, ("data", "fsdp"))
+    # fsdp-sharded param: the fsdp dim is extended rather than resharded
+    spec = grad_sync_spec((64, 256), P(None, "fsdp"), mesh, ("data", "fsdp"))
+    assert spec == P(None, ("fsdp", "data"))
+    # nothing divisible -> None (leaf rides the default all-reduce)
+    assert grad_sync_spec((3, 5), P(), mesh, ("data", "fsdp")) is None
+    # already fully covered -> None
+    assert (
+        grad_sync_spec((64, 256), P(("data", "fsdp")), mesh, ("data", "fsdp"))
+        is None
+    )
+
+
+# ---------------------------------------------------------------------------
+# quantized matmul
+# ---------------------------------------------------------------------------
+
+
+def test_quant_dot_general_matches_reference():
+    dg = _quant.make_dot_general("int8")
+    dn = (((1,), (0,)), ((), ()))
+    x = jax.random.normal(jax.random.key(0), (8, 32), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (32, 16), jnp.float32) * 0.1
+    ref = jax.lax.dot_general(x, w, dn)
+    out = dg(x, w, dn)
+    assert float(jnp.abs(out - ref).max() / jnp.abs(ref).max()) < 0.02
+
+    # backward is the EXACT transpose of the reference matmul
+    g = jax.random.normal(jax.random.key(2), ref.shape, jnp.float32)
+    f = lambda a, b: (dg(a, b, dn) * g).sum()  # noqa: E731
+    fr = lambda a, b: (jax.lax.dot_general(a, b, dn) * g).sum()  # noqa: E731
+    gx, gw = jax.grad(f, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(fr, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=1e-5, atol=1e-6)
+
+    # DenseGeneral-style multi-dim contraction
+    dn2 = (((2, 3), (0, 1)), ((), ()))
+    x2 = jax.random.normal(jax.random.key(3), (2, 5, 4, 8), jnp.float32)
+    w2 = jax.random.normal(jax.random.key(4), (4, 8, 16), jnp.float32) * 0.1
+    ref2 = jax.lax.dot_general(x2, w2, dn2)
+    out2 = dg(x2, w2, dn2)
+    assert out2.shape == ref2.shape
+    assert float(jnp.abs(out2 - ref2).max() / jnp.abs(ref2).max()) < 0.03
+
+
+def test_quant_fp8_emulated_matches_reference(monkeypatch):
+    monkeypatch.setenv("DTPU_QUANT_EMULATE", "1")
+    dg = _quant.make_dot_general("fp8")
+    dn = (((1,), (0,)), ((), ()))
+    x = jax.random.normal(jax.random.key(0), (8, 32), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (32, 16), jnp.float32) * 0.1
+    ref = jax.lax.dot_general(x, w, dn)
+    out = dg(x, w, dn)
+    # e4m3 has ~2 mantissa decimal digits: coarser than int8-per-channel
+    assert float(jnp.abs(out - ref).max() / jnp.abs(ref).max()) < 0.1
+
+
+def test_quant_int8_trains_within_tolerance(tmp_path):
+    _, l_ref = _run(tmp_path, {}, steps=4, tag="a")
+    _, l_q = _run(tmp_path, {"quantized_matmul": "int8"}, steps=4, tag="b")
+    rel = max(abs(a - b) / max(abs(a), 1e-9) for a, b in zip(l_ref, l_q))
+    assert rel < 0.02, f"int8 loss curve deviates {rel:.4f} from oracle"
+
+
+def test_fp8_rejected_on_unsupported_platform(tmp_path, monkeypatch):
+    monkeypatch.delenv("DTPU_QUANT_EMULATE", raising=False)
+    exp = ExperimentConfig.parse({"optimizations": {"quantized_matmul": "fp8"}})
+    ctx = train.init(
+        hparams=dict(HP),
+        mesh_config=MeshConfig(data=2),
+        core_context=core._dummy_init(checkpoint_dir=str(tmp_path / "ck")),
+        exp_config=exp,
+        seed=0,
+    )
+    with pytest.raises(InvalidExperimentConfig, match="fp8 is not supported"):
+        train.Trainer(LMTrial(ctx))._setup()
+
+
+def test_quant_mode_validated_at_parse():
+    with pytest.raises(InvalidExperimentConfig, match="quantized_matmul"):
+        ExperimentConfig.parse({"optimizations": {"quantized_matmul": "int4"}})
+    with pytest.raises(InvalidExperimentConfig, match="overlap_bucket_mb"):
+        ExperimentConfig.parse({"optimizations": {"overlap_bucket_mb": 0}})
+    # defaults: both knobs off
+    cfg = ExperimentConfig.parse({})
+    assert cfg.optimizations.overlap_grad_sync is False
+    assert cfg.optimizations.quantized_matmul == "none"
+
+
+# ---------------------------------------------------------------------------
+# jit-cache keying + ledger rows
+# ---------------------------------------------------------------------------
+
+
+def test_jit_cache_key_covers_both_knobs():
+    class _T:
+        def compile_cache_runtime_hparams(self):
+            return ()
+
+    mesh = make_mesh(MeshConfig(data=2))
+    kw = dict(
+        trial=_T(),
+        hparams={"lr": 1e-3},
+        mesh=mesh,
+        agg=1,
+        average_grads=True,
+        sample_batch={"tokens": np.zeros((4, 8), np.int32)},
+        metric_keys=("loss",),
+    )
+    base = _jit_cache.step_cache_key(**kw)
+    assert _jit_cache.step_cache_key(**kw) == base  # stable
+    assert _jit_cache.step_cache_key(**kw, overlap="overlap:on:buckets=3:synced=6") != base
+    assert _jit_cache.step_cache_key(**kw, quant="int8") != base
+    assert _jit_cache.step_cache_key(**kw, quant="fp8") != _jit_cache.step_cache_key(
+        **kw, quant="int8"
+    )
+
+
+def test_ledger_folds_step_comm_counters():
+    from determined_tpu.observability import compute_ledger, format_ledger_text
+
+    ev = [
+        {"ph": "X", "name": "trial.run", "cat": "trial", "ts": 0, "dur": 1e6,
+         "pid": 1, "tid": 1, "args": {"trial": "t1"}},
+        {"ph": "X", "name": "step.dispatch", "cat": "step", "ts": 10, "dur": 9e5,
+         "pid": 1, "tid": 1},
+        {"ph": "C", "name": "step.comm.bytes", "ts": 500, "pid": 1, "tid": 1,
+         "args": {"value": 1e9}},
+        {"ph": "C", "name": "step.comm.exposed_us", "ts": 500, "pid": 1,
+         "tid": 1, "args": {"value": 120000.0}},
+        {"ph": "C", "name": "step.comm.hidden_us", "ts": 500, "pid": 1,
+         "tid": 1, "args": {"value": 80000.0}},
+    ]
+    led = compute_ledger(ev)
+    comm = led["trials"]["t1"]["step.comm"]
+    assert comm["exposed_s"] == pytest.approx(0.12)
+    assert comm["hidden_s"] == pytest.approx(0.08)
+    assert comm["bytes"] == int(1e9)
+    assert led["experiment"]["step.comm"]["exposed_pct_of_step"] == pytest.approx(
+        13.33, abs=0.01
+    )
+    text = format_ledger_text(led)
+    assert "exposed comm" in text and "hidden" in text
+
+    # no counters -> no comm rows
+    led2 = compute_ledger(ev[:2])
+    assert "step.comm" not in led2["trials"]["t1"]
+    assert "step.comm" not in led2["experiment"]
+
+
+def test_trainer_emits_comm_counters(tmp_path):
+    """On a multi-device mesh the trainer reports step.comm.* counters at
+    report boundaries (overlap off: everything exposed), and the profile
+    ledger shows the comm line."""
+    from determined_tpu.observability import compute_ledger, get_tracer
+
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.configure(enabled=True)
+    tracer.start()
+    try:
+        with tracer.span("trial.run", cat="trial", trial="comm-test"):
+            _run(tmp_path, {}, steps=2, tag="c")
+    finally:
+        tracer.stop()
+    led = compute_ledger(tracer.chrome_events())
+    comm = led["experiment"].get("step.comm")
+    assert comm is not None
+    assert comm["exposed_s"] > 0.0
+    assert comm["hidden_s"] == 0.0  # baseline: nothing hides
+    tracer.reset()
+
+
+# ---------------------------------------------------------------------------
+# slower composition coverage
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_overlap_composes_with_pipeline(tmp_path):
+    """pipe2 x data2 with overlap on: trains finite and matches the
+    pipe2 baseline numerically (the stacked block grads sync over data)."""
+    hp = dict(HP, n_layers=2)
+    _jit_cache.clear_step_cache()
+
+    def run_pipe(opts, tag):
+        exp = ExperimentConfig.parse({"optimizations": opts})
+        ctx = train.init(
+            hparams=dict(hp),
+            mesh_config=MeshConfig(pipe=2, data=2),
+            core_context=core._dummy_init(checkpoint_dir=str(tmp_path / tag)),
+            exp_config=exp,
+            seed=3,
+        )
+        tr = train.Trainer(LMTrial(ctx))
+        tr.fit(Length.batches(2), checkpoint_policy="none")
+        return tr
+
+    base = run_pipe({}, "a")
+    over = run_pipe({"overlap_grad_sync": True}, "b")
+    assert _maxdiff(base.state.params, over.state.params) < 1e-4
+
+
+@pytest.mark.slow
+def test_quant_composes_with_overlap_and_agg(tmp_path):
+    tr, losses = _run(
+        tmp_path,
+        {
+            "overlap_grad_sync": True,
+            "aggregation_frequency": 2,
+            "quantized_matmul": "int8",
+        },
+        steps=3,
+        tag="x",
+    )
+    assert all(np.isfinite(losses))
+    assert tr._overlap_plan is not None and tr._overlap_plan.enabled
