@@ -137,7 +137,11 @@ class Session:
                     timeout=timeout or self.timeout,
                     stream=stream,
                 )
-            except requests.ConnectionError as e:
+            except (requests.ConnectionError, requests.Timeout) as e:
+                # Timeout rides the same path: a read timeout is the classic
+                # symptom of a master dying mid-response (SIGKILL during a
+                # long-poll), and for idempotent/opted-in requests a retry
+                # is exactly what the restarted master expects.
                 last = e
                 attempt += 1
                 if attempt < attempts:

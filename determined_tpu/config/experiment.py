@@ -362,6 +362,13 @@ class FaultToleranceConfig:
     restart_backoff_jitter: float = 0.25  # +/- fraction applied to the delay
     verify_checkpoints: bool = True       # manifest-verify on resume
     heartbeat_failure_threshold: int = 5  # consecutive misses -> master_unreachable
+    # Cluster-driver outage tolerance: how long a trial watcher retries
+    # master connection failures / 5xx (capped exponential backoff, the
+    # failure-streak pattern) before declaring the trial lost.  Sized to
+    # ride out a master crash + restart + journal replay, not a real
+    # outage — the master WAL makes restarts re-attachable, so watchers
+    # that outwait the restart resume polling as if nothing happened.
+    master_unreachable_grace_s: float = 120.0
     # Experiment-level crash recovery (docs/fault-tolerance.md, "Experiment
     # recovery & preemption"): write-ahead journal of searcher snapshots +
     # trial lifecycle under checkpoint_dir/experiment.journal, enabling
@@ -388,6 +395,10 @@ class FaultToleranceConfig:
         if self.heartbeat_failure_threshold < 1:
             raise InvalidExperimentConfig(
                 "fault_tolerance.heartbeat_failure_threshold must be >= 1"
+            )
+        if self.master_unreachable_grace_s < 0:
+            raise InvalidExperimentConfig(
+                "fault_tolerance.master_unreachable_grace_s must be >= 0"
             )
         if self.journal_compact_interval < 0:
             raise InvalidExperimentConfig(

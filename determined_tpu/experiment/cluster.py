@@ -48,7 +48,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from determined_tpu.api.session import APIError, Session
+import requests
+
+from determined_tpu.api.session import APIError, NotFoundError, Session
 from determined_tpu.api.session import login as api_login
 from determined_tpu.config.experiment import ExperimentConfig, InvalidExperimentConfig
 from determined_tpu.experiment.journal import (
@@ -62,12 +64,28 @@ from determined_tpu.experiment.local import PREEMPTED_EXIT_CODE, TrialResult, _P
 from determined_tpu.observability import export_experiment_trace, get_tracer
 from determined_tpu.searcher import method_from_config
 
-__all__ = ["ClusterExperiment", "PREEMPTED_EXIT_CODE", "run_cluster_experiment"]
+__all__ = [
+    "ClusterExperiment",
+    "MasterUnreachableError",
+    "PREEMPTED_EXIT_CODE",
+    "run_cluster_experiment",
+]
 
 logger = logging.getLogger("determined_tpu.experiment.cluster")
 
 # master trial states
 _TERMINAL = ("COMPLETED", "STOPPED", "ERROR")
+
+
+class MasterUnreachableError(Exception):
+    """The master stayed unreachable past
+    ``fault_tolerance.master_unreachable_grace_s``: the watcher declares its
+    trial lost (the search continues, mirroring trial-ERROR tolerance)."""
+
+
+class _DriverDetached(Exception):
+    """Internal: preemption flipped while a watcher was waiting out a
+    master outage — detach instead of declaring the trial lost."""
 
 
 @dataclasses.dataclass
@@ -251,7 +269,7 @@ class ClusterExperiment:
         if tid is not None:
             try:
                 return self._get_trial(tid).get("latest_checkpoint") or None
-            except APIError:
+            except (APIError, requests.ConnectionError):
                 return None
         return None
 
@@ -332,7 +350,79 @@ class ClusterExperiment:
         else:
             self.searcher.on_trial_exited(rid)
 
+    def _poll_master(self, rid: int, what: str, fn: Any) -> Any:
+        """Run one master call, riding out a master outage.
+
+        Connection failures and 5xx/429 during a master restart are NOT a
+        trial failure: the master WAL makes restarts re-attachable, so the
+        watcher retries with capped exponential backoff (the PR-1
+        failure-streak pattern: the grace clock starts at the first failure
+        of a streak and resets on any success) for up to
+        ``fault_tolerance.master_unreachable_grace_s`` before declaring the
+        trial lost.  Client errors (bad request, 404) still raise
+        immediately — those are contract violations, not outages.
+        """
+        grace = self.config.fault_tolerance.master_unreachable_grace_s
+        deadline: Optional[float] = None
+        delay = max(self.poll_interval, 0.1)
+        while True:
+            try:
+                return fn()
+            except NotFoundError:
+                raise
+            except (APIError, requests.ConnectionError, requests.Timeout) as e:
+                retryable = not isinstance(e, APIError) or (
+                    e.status == 429 or e.status >= 500 or e.status == 0
+                )
+                if not retryable:
+                    raise
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + grace
+                    logger.warning(
+                        "trial %d: master unreachable during %s (%s); "
+                        "retrying for up to %.0fs",
+                        rid, what, e, grace,
+                    )
+                if now >= deadline:
+                    raise MasterUnreachableError(
+                        f"master unreachable for {grace:.0f}s during {what}: {e}"
+                    ) from e
+                if self._preempt.is_set():
+                    raise _DriverDetached() from e
+                time.sleep(min(delay, max(deadline - now, 0.05)))
+                delay = min(delay * 2, 10.0)
+
     def _watch_trial_inner(
+        self, rid: int, hparams: Dict[str, Any], source_rid: Optional[int] = None
+    ) -> Optional[Tuple[TrialResult, str]]:
+        try:
+            return self._watch_trial_poll(rid, hparams, source_rid)
+        except _DriverDetached:
+            # preempted mid-outage: the trial stays in flight on the master
+            return None
+        except MasterUnreachableError as e:
+            # grace exhausted: declare THIS trial lost and let the search
+            # continue — the same tolerance a terminally-errored trial gets
+            logger.error("trial %d: %s; declaring the trial lost", rid, e)
+            rec = self.searcher.trials.get(rid)
+            metrics = dict((rec.metrics if rec is not None else None) or {})
+            steps = int(
+                metrics.get(self.config.searcher.time_metric or "batches", 0) or 0
+            )
+            return (
+                TrialResult(
+                    request_id=rid,
+                    hparams=hparams,
+                    steps_completed=steps,
+                    metrics=metrics,
+                    checkpoint=None,
+                    stopped_early=True,
+                ),
+                "ERROR",
+            )
+
+    def _watch_trial_poll(
         self, rid: int, hparams: Dict[str, Any], source_rid: Optional[int] = None
     ) -> Optional[Tuple[TrialResult, str]]:
         tracer = get_tracer()
@@ -347,7 +437,10 @@ class ClusterExperiment:
                     "trial %d: exploit source trial %d has no master-known "
                     "checkpoint; the child starts from scratch", rid, source_rid,
                 )
-            tid = self._submit_trial(rid, hparams, source_checkpoint=source_ckpt)
+            tid = self._poll_master(
+                rid, "trial submit",
+                lambda: self._submit_trial(rid, hparams, source_checkpoint=source_ckpt),
+            )
             watch.master_trial_id = tid
             if self.journal is not None:
                 # Safe unlocked: append holds the journal's internal lock.
@@ -365,7 +458,7 @@ class ClusterExperiment:
         dispatch_t0 = time.monotonic()
         dispatched = False
         remote_t0: Optional[float] = None
-        trial = self._get_trial(tid)
+        trial = self._poll_master(rid, "state poll", lambda: self._get_trial(tid))
         last_state = trial.get("state")
         latest_ckpt: Optional[str] = None
 
@@ -421,7 +514,10 @@ class ClusterExperiment:
             ):
                 if vcount is not None:
                     watch.last_vcount = int(vcount)
-                for rec in self._get_validations(tid, watch.validations_seen):
+                for rec in self._poll_master(
+                    rid, "validation fetch",
+                    lambda: self._get_validations(tid, watch.validations_seen),
+                ):
                     watch.validations_seen += 1
                     metrics = dict(rec.get("metrics") or {})
                     steps = int(rec.get("steps_completed") or 0)
@@ -444,7 +540,10 @@ class ClusterExperiment:
             if not watch.stop_posted and self.searcher.is_stopped(rid):
                 # ASHA rung cut: ask the master to stop the gang gracefully
                 # (preempt -> checkpoint -> exit 0 -> STOPPED)
-                self.session.post(f"/api/v1/trials/{tid}/stop", retry=True)
+                self._poll_master(
+                    rid, "early-stop request",
+                    lambda: self.session.post(f"/api/v1/trials/{tid}/stop", retry=True),
+                )
                 watch.stop_posted = True
                 logger.info("trial %d (master %d): early stop requested", rid, tid)
 
@@ -457,7 +556,7 @@ class ClusterExperiment:
                 record_remote()
                 return None
             time.sleep(self.poll_interval)
-            trial = self._get_trial(tid)
+            trial = self._poll_master(rid, "state poll", lambda: self._get_trial(tid))
 
         state = str(trial.get("state"))
         rec = self.searcher.trials.get(rid)
@@ -659,7 +758,9 @@ class ClusterExperiment:
                 f"/api/v1/experiments/{self.master_experiment_id}/searcher/shutdown",
                 retry=True,
             )
-        except APIError as e:
+        except (APIError, requests.ConnectionError, requests.Timeout) as e:
+            # a down master must not turn a finished search into a crash:
+            # the searcher-shutdown is re-posted by any future resume()
             logger.warning("master searcher shutdown failed: %s", e)
 
     # -- preemption --------------------------------------------------------

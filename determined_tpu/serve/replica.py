@@ -16,6 +16,8 @@ import logging
 import threading
 from typing import Any, Dict, Optional
 
+import requests
+
 from determined_tpu.api.session import APIError, NotFoundError, Session
 
 logger = logging.getLogger("determined_tpu.serve.replica")
@@ -91,12 +93,33 @@ class ReplicaRegistration:
                     retry=False,
                 )
             except NotFoundError:
-                # master forgot us (restart or prune race): re-register
+                # master forgot us (restart or prune race): re-register.
+                # The catch is deliberately broad — register() uses a
+                # retrying POST whose terminal failure is a ConnectionError
+                # (master still down), and an exception escaping THIS
+                # except-block would kill the heartbeat thread for good;
+                # the worker must keep serving and keep retrying instead.
                 logger.warning("replica %s unknown to master; re-registering", rid)
                 try:
                     self.register()
-                except APIError:
-                    logger.exception("re-registration failed; will retry")
+                except (requests.ConnectionError, requests.Timeout, APIError):
+                    # routine during a master restart window: warn without a
+                    # traceback (this repeats every interval until it lands)
+                    logger.warning(
+                        "re-registration of replica %s failed (master still "
+                        "unreachable?); will retry on the next heartbeat", rid,
+                    )
+                except Exception:  # noqa: BLE001 - survive, but keep the trace
+                    logger.exception(
+                        "re-registration of replica %s failed; will retry", rid
+                    )
+            except (requests.ConnectionError, requests.Timeout):
+                # master down/restarting: a serving replica keeps serving
+                # through a control-plane outage and re-registers when the
+                # master's heartbeat 404 says it forgot us
+                logger.warning(
+                    "master unreachable; heartbeat for replica %s will retry", rid
+                )
             except APIError:
                 # transient master trouble: keep beating, the master-side
                 # TTL is several intervals wide
@@ -118,5 +141,5 @@ class ReplicaRegistration:
         if deregister and rid is not None:
             try:
                 self._session.delete(f"/api/v1/serving/replicas/{rid}")
-            except APIError:
+            except (APIError, requests.ConnectionError, requests.Timeout):
                 logger.warning("deregistration of %s failed (master will prune)", rid)

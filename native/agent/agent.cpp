@@ -140,6 +140,26 @@ class Agent {
     body.set("pool", opts_.pool);
     body.set("slots", Json(opts_.slots));
     body.set("slot_type", opts_.slot_type);
+    // Re-attach handshake (master crash-safe restart): report the
+    // allocations whose processes are STILL running under this agent.  A
+    // restarted master matches these against its journaled placements and
+    // re-adopts the gang in place; allocations it cannot match come back
+    // as kill work (stale processes from before a reschedule).
+    // id + trial_id only: the master takes per-agent slot counts from its
+    // own journaled groups, never from the report (an agent cannot know
+    // the gang-wide layout, and a self-reported count could not be
+    // trusted across restarts anyway)
+    Json allocs = Json::array();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (const auto& [alloc_id, proc] : running_) {
+        if (proc.trial_id < 0) continue;  // aux tasks are ephemeral by design
+        allocs.push_back(Json::object()
+                             .set("id", alloc_id)
+                             .set("trial_id", Json(proc.trial_id)));
+      }
+    }
+    body.set("allocations", allocs);
     auto resp = master_req("POST", "/api/v1/agents", body.dump(), 10);
     return resp.ok();
   }
@@ -280,7 +300,10 @@ class Agent {
     close(out_pipe[1]);
     {
       std::lock_guard<std::mutex> lk(mu_);
-      running_[alloc_id] = pid;
+      RunningProc proc;
+      proc.pid = pid;
+      proc.trial_id = trial_id;
+      running_[alloc_id] = proc;
     }
     {
       std::ofstream pf(pidfile(alloc_id), std::ios::trunc);
@@ -332,7 +355,9 @@ class Agent {
     close(out_pipe[1]);
     {
       std::lock_guard<std::mutex> lk(mu_);
-      running_[task_id] = pid;
+      RunningProc proc;
+      proc.pid = pid;
+      running_[task_id] = proc;
     }
     {
       std::ofstream pf(pidfile(task_id), std::ios::trunc);
@@ -408,7 +433,7 @@ class Agent {
       std::lock_guard<std::mutex> lk(mu_);
       auto it = running_.find(alloc_id);
       if (it == running_.end()) return;
-      pid = it->second;
+      pid = it->second.pid;
     }
     // graceful SIGTERM (harness checkpoints on it), SIGKILL after grace
     ::kill(-pid, SIGTERM);
@@ -419,14 +444,18 @@ class Agent {
       // period, in which case SIGKILL could hit an unrelated process group
       std::lock_guard<std::mutex> lk(mu_);
       auto it = running_.find(alloc_id);
-      if (it != running_.end() && it->second == pid) ::kill(-pid, SIGKILL);
+      if (it != running_.end() && it->second.pid == pid) ::kill(-pid, SIGKILL);
     }).detach();
   }
 
   Options opts_;
   std::mutex mu_;
   std::string token_;
-  std::map<std::string, pid_t> running_;
+  struct RunningProc {
+    pid_t pid = 0;
+    int64_t trial_id = -1;  // -1 = aux task (not re-reported)
+  };
+  std::map<std::string, RunningProc> running_;
 };
 
 }  // namespace dtpu

@@ -46,6 +46,7 @@
 #include "../common/sha256.hpp"
 #include "rm.hpp"
 #include "searcher.hpp"
+#include "wal.hpp"
 
 namespace dtpu {
 
@@ -97,6 +98,16 @@ struct AllocationState {
   std::string external_pool;
   std::string external_ref;
   int external_missing_polls = 0;  // consecutive polls the job was gone
+  // Crash-safe restart (master WAL): an un-ended agent-pool allocation
+  // replayed at boot is *awaiting re-attach* — its processes may still be
+  // training on the agents.  Agents re-report their running allocations
+  // when they re-register; once every group's agent has re-reported, the
+  // gang is re-adopted in place (no kill, no restart burned).  Groups not
+  // fully re-reported by the deadline are declared lost and rescheduled
+  // through the normal gang fault-tolerance path.
+  bool awaiting_reattach = false;
+  int64_t reattach_deadline_ms = 0;
+  std::set<std::string> reattached_agents;
 };
 
 struct TrialState {
@@ -279,6 +290,52 @@ struct ExperimentState {
   std::string owner = "determined";
 };
 
+// Admission backpressure on the ingest hot paths (trial-create, metrics,
+// logs): bound the number of concurrently-executing ingest requests and
+// shed with 429 + Retry-After when the bound is hit or the WAL's fsync
+// latency says the disk is behind.  A recovering master (replaying, agents
+// stampeding back, shippers flushing backlogs) sheds load it cannot absorb
+// instead of queueing every connection until clients time out — shippers
+// and the harness Session already honor Retry-After (PR 1).
+struct AdmissionControl {
+  int max_inflight = 256;       // concurrent ingest handlers; 0 = unlimited
+  int64_t fsync_budget_us = 0;  // shed while WAL append EMA exceeds; 0 = off
+  int retry_after_s = 1;        // advertised client backoff
+  std::atomic<int> inflight{0};
+  std::atomic<int64_t> shed{0};
+};
+
+// RAII in-flight ticket; lock-free so shedding costs nothing under mu_
+class IngestTicket {
+ public:
+  IngestTicket(AdmissionControl& a, const WalWriter& wal) : a_(a) {
+    int cur = a_.inflight.fetch_add(1, std::memory_order_relaxed);
+    ok_ = (a_.max_inflight <= 0 || cur < a_.max_inflight) &&
+          (a_.fsync_budget_us <= 0 || wal.ema_us() <= a_.fsync_budget_us);
+    if (!ok_) {
+      a_.inflight.fetch_sub(1, std::memory_order_relaxed);
+      a_.shed.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+  ~IngestTicket() {
+    if (ok_) a_.inflight.fetch_sub(1, std::memory_order_relaxed);
+  }
+  IngestTicket(const IngestTicket&) = delete;
+  IngestTicket& operator=(const IngestTicket&) = delete;
+  bool admitted() const { return ok_; }
+
+ private:
+  AdmissionControl& a_;
+  bool ok_;
+};
+
+inline HttpResponse shed_response(int retry_after_s) {
+  HttpResponse r = HttpResponse::error(
+      429, "ingest backpressure: the master is shedding load; retry later");
+  r.headers.push_back({"Retry-After", std::to_string(retry_after_s)});
+  return r;
+}
+
 class Master {
  public:
   Master(std::string state_dir, std::string checkpoint_dir,
@@ -291,11 +348,13 @@ class Master {
     snapshot_path_ = state_dir_ + "/snapshot.json";
   }
 
-  // Durability = snapshot + journal tail: compaction (maybe_compact) writes
-  // the full state to snapshot.json and truncates the journal, so boot cost
-  // and disk use stay bounded no matter how long the cluster lives
-  // (reference: Postgres; here event sourcing with compaction).
+  // Durability = snapshot + WAL tail: compaction (record -> compact) writes
+  // the full state to snapshot.json atomically and truncates the journal,
+  // so boot cost and disk use stay bounded no matter how long the cluster
+  // lives (reference: Postgres; here a CRC-framed, fsynced event WAL —
+  // wal.hpp — with snapshot compaction).
   void boot() {
+    int64_t boot_t0 = now_ms();
     replaying_ = true;
     {
       std::ifstream snap(snapshot_path_);
@@ -306,39 +365,130 @@ class Master {
         if (Json::try_parse(data.str(), &s)) restore_snapshot(s);
       }
     }
-    std::ifstream in(journal_path_);
-    std::string line;
+    WalReadResult wal = wal_read(journal_path_);
     // Events whose seq the snapshot already covers are skipped: a crash
     // between the snapshot rename and the journal truncation in compact()
     // would otherwise double-apply every journaled event on the next boot
     // (duplicate trials, double-advanced searcher counters).
     const int64_t covered = seq_;
-    while (std::getline(in, line)) {
-      if (line.empty()) continue;
+    for (const std::string& payload : wal.records) {
       ++journal_lines_;
       Json ev;
-      if (!Json::try_parse(line, &ev)) continue;
+      if (!Json::try_parse(payload, &ev)) continue;
       int64_t evseq = ev.contains("seq") ? ev["seq"].as_int(0) : 0;
       if (evseq != 0 && evseq <= covered) continue;
       if (evseq != 0) seq_ = std::max(seq_, evseq);
       apply_event(ev);
+      ++replay_events_;
     }
     replaying_ = false;
-    journal_out_.open(journal_path_, std::ios::app);
+    if (wal.tail_damaged) {
+      // torn tail from a crash mid-append: truncate to the acknowledged
+      // prefix so new appends never interleave with garbage.  This is the
+      // routine crash outcome, loudly logged but never fatal.
+      wal_truncated_bytes_ = static_cast<int64_t>(wal.file_size - wal.last_good_offset);
+      std::error_code ec;
+      std::filesystem::resize_file(journal_path_, wal.last_good_offset, ec);
+      fprintf(stderr,
+              "master: journal tail %s at byte %llu (%lld bytes dropped%s); "
+              "replayed the valid prefix\n",
+              wal.midlog_corrupt ? "CORRUPT (valid records follow the damage)"
+                                 : "torn",
+              static_cast<unsigned long long>(wal.last_good_offset),
+              static_cast<long long>(wal_truncated_bytes_),
+              ec ? ", truncation FAILED" : "");
+    }
+    if (!journal_.open(journal_path_, journal_fsync_)) {
+      fprintf(stderr, "master: cannot open journal %s for append\n",
+              journal_path_.c_str());
+    }
     // first boot: bootstrap the default users (reference: "determined" and
     // "admin", blank passwords, created by migration)
     if (users_.empty()) {
       set_user("determined", "", true);
       set_user("admin", "", true);
     }
-    // trials that were mid-flight when the master died go back to PENDING
+    // Mid-flight trials: an un-ended journaled allocation means the gang's
+    // processes plausibly survived the master's death — hold the trial
+    // RUNNING and wait for its agents to re-report (re-adoption) instead
+    // of killing work that never stopped.  Trials with no recoverable
+    // allocation fall back to PENDING and reschedule.
+    int64_t grace_deadline = now_ms() + reattach_grace_ms_;
     for (auto& [tid, t] : trials_) {
-      if (t.state == "RUNNING") {
-        t.state = "PENDING";
-        t.allocation_id.clear();
+      if (t.state != "RUNNING") continue;
+      auto ait = allocations_.find(t.allocation_id);
+      if (ait != allocations_.end() && !ait->second.ended &&
+          !ait->second.groups.empty()) {
+        ait->second.awaiting_reattach = true;
+        ait->second.reattach_deadline_ms = grace_deadline;
+        ait->second.reattached_agents.clear();
+        continue;
+      }
+      if (ait != allocations_.end() && !ait->second.ended &&
+          !ait->second.external_kind.empty()) {
+        // external job: the backend poll loop re-resolves it (running jobs
+        // keep running; vanished ones fail the trial after 2 gone polls)
+        continue;
+      }
+      t.state = "PENDING";
+      t.allocation_id.clear();
+    }
+    // coordinator/chief ports of live allocations must stay reserved or a
+    // fresh placement could collide with a surviving gang's rendezvous
+    for (const auto& [aid, alloc] : allocations_) {
+      if (alloc.ended || alloc.coord_port == 0) continue;
+      coord_ports_in_use_[alloc.coord_host].insert(alloc.coord_port);
+      if (alloc.chief_port) {
+        coord_ports_in_use_[alloc.coord_host].insert(alloc.chief_port);
       }
     }
+    replay_duration_ms_ = now_ms() - boot_t0;
     retention_sweep();
+  }
+
+  // Run a deferred snapshot compaction at a consistency point: the caller
+  // holds mu_ with no handler mid-flight, so in-memory state reflects
+  // exactly the journaled seq watermark.
+  void maybe_compact() {
+    if (!compact_pending_) return;
+    compact_pending_ = false;
+    compact();
+  }
+
+  // Agent-pool allocations awaiting re-attach whose grace expired: the
+  // gang was NOT fully re-reported (agents died with the master, or never
+  // came back) — declare it lost and reschedule through the normal gang
+  // fault-tolerance path.  Caller holds mu_.
+  void reap_unattached_allocations() {
+    int64_t now = now_ms();
+    std::vector<std::string> lost;
+    for (auto& [aid, alloc] : allocations_) {
+      if (!alloc.ended && alloc.awaiting_reattach &&
+          now > alloc.reattach_deadline_ms) {
+        lost.push_back(aid);
+      }
+    }
+    for (const auto& aid : lost) {
+      AllocationState& alloc = allocations_[aid];
+      alloc.awaiting_reattach = false;
+      int64_t tid = alloc.trial_id;
+      ++reattach_lost_;
+      append_jsonl_striped(
+          logs_path(tid),
+          Json::object()
+              .set("ts", Json(now))
+              .set("level", "ERROR")
+              .set("line", "gang: allocation " + aid +
+                               " not re-reported within the re-attach grace "
+                               "window after a master restart; declaring it "
+                               "lost and rescheduling"));
+      printf("master: allocation %s (trial %lld) lost after restart; rescheduling\n",
+             aid.c_str(), static_cast<long long>(tid));
+      fflush(stdout);
+      kill_allocation(alloc);  // best-effort: reaches agents that did return
+      on_trial_exit(tid, /*exit_code=*/101);
+    }
+    if (!lost.empty()) schedule();
   }
 
   // delete per-trial log files whose last write predates the retention
@@ -361,6 +511,66 @@ class Master {
   void set_agent_timeout_ms(int64_t ms) { agent_timeout_ms_ = ms; }
   void set_serve_replica_timeout_ms(int64_t ms) { serve_replica_timeout_ms_ = ms; }
   void set_scheduler(const std::string& mode) { scheduler_mode_ = mode; }
+  void set_reattach_grace_ms(int64_t ms) { reattach_grace_ms_ = ms; }
+  void set_journal_fsync(bool on) { journal_fsync_ = on; }
+
+  // Deterministic state digest for the offline `--dump-state` mode: the
+  // torn-tail fuzz harness boots the master at every truncation offset and
+  // asserts the digest equals the valid prefix's.  Deliberately excludes
+  // anything wall-clock- or rng-derived (timestamps, salts, deadlines).
+  Json debug_state() const {
+    Json out = Json::object();
+    out.set("seq", Json(seq_));
+    out.set("next_experiment_id", Json(next_experiment_id_));
+    out.set("next_trial_id", Json(next_trial_id_));
+    out.set("next_allocation_id", Json(next_allocation_id_));
+    Json exps = Json::array();
+    for (const auto& [id, e] : experiments_) {
+      Json j = Json::object();
+      j.set("id", Json(e.id));
+      j.set("state", e.state);
+      j.set("searcher_shutdown", Json(e.searcher_shutdown));
+      Json rids = Json::object();
+      for (const auto& [rid, tid] : e.rid_to_trial) {
+        rids.set(std::to_string(rid), Json(tid));
+      }
+      j.set("rid_to_trial", rids);
+      exps.push_back(j);
+    }
+    out.set("experiments", exps);
+    Json trials = Json::array();
+    for (const auto& [tid, t] : trials_) {
+      Json j = Json::object();
+      j.set("id", Json(t.id));
+      j.set("experiment_id", Json(t.experiment_id));
+      j.set("request_id", Json(t.request_id));
+      j.set("state", t.state);
+      j.set("restarts", Json(static_cast<int64_t>(t.restarts)));
+      j.set("stop_requested", Json(t.stop_requested));
+      j.set("latest_checkpoint", t.latest_checkpoint);
+      j.set("validations", Json(static_cast<int64_t>(t.val_by_step.size())));
+      trials.push_back(j);
+    }
+    out.set("trials", trials);
+    Json allocs = Json::array();
+    for (const auto& [aid, a] : allocations_) {
+      if (a.ended) continue;
+      Json j = Json::object();
+      j.set("id", a.id);
+      j.set("trial_id", Json(a.trial_id));
+      j.set("awaiting_reattach", Json(a.awaiting_reattach));
+      Json groups = Json::array();
+      for (const auto& [gaid, slots] : a.groups) {
+        groups.push_back(Json::object()
+                             .set("agent", gaid)
+                             .set("slots", Json(static_cast<int64_t>(slots))));
+      }
+      j.set("groups", groups);
+      allocs.push_back(j);
+    }
+    out.set("allocations", allocs);
+    return out;
+  }
 
   // Anonymized usage telemetry (reference master/internal/telemetry/
   // telemetry.go:13-40: Segment client posting cluster id, version,
@@ -570,9 +780,23 @@ class Master {
     if (replaying_) return;
     ev.set("ts", Json(now_ms()));
     ev.set("seq", Json(++seq_));
-    journal_out_ << ev.dump() << "\n";
-    journal_out_.flush();
-    if (++journal_lines_ >= journal_limit_) compact();
+    // WAL contract: the framed record is fsynced before the mutation is
+    // acknowledged to any client (wal.hpp; append latency feeds /metrics
+    // and the ingest admission controller)
+    if (!journal_.append(ev.dump())) {
+      fprintf(stderr, "master: JOURNAL APPEND FAILED (seq %lld): state "
+                      "mutations are no longer durable\n",
+              static_cast<long long>(seq_));
+    }
+    // Compaction is DEFERRED to the main tick (maybe_compact), never run
+    // inline here: several call sites journal an event before applying its
+    // mutation (on_trial_exit, trial_stop), so a snapshot taken inside
+    // this record() could claim the event's seq while missing its effect —
+    // the event would be truncated away and the mutation lost at the next
+    // boot.  Between lock holds every journaled event's mutation is fully
+    // applied (handlers complete record+mutate under one mu_ hold), which
+    // is exactly when the tick runs.
+    if (++journal_lines_ >= journal_limit_) compact_pending_ = true;
     // streaming updates: journaled events double as the publish feed
     // (reference master/internal/stream/ websocket deltas w/ sequence
     // numbers, redesigned as a long-polled ring buffer over the journal's
@@ -586,7 +810,9 @@ class Master {
     }
   }
 
-  // snapshot full state atomically, then truncate the journal
+  // snapshot full state atomically (temp + fsync + rename + dir fsync),
+  // then truncate the journal; a crash between the two replays the journal
+  // on top of the fresh snapshot, deduped by seq
   void compact() {
     prune_tokens();
     Json snap = snapshot_state();
@@ -598,12 +824,14 @@ class Master {
       out.close();
       if (!out) return;
     }
-    std::error_code ec;
-    std::filesystem::rename(tmp, snapshot_path_, ec);
-    if (ec) return;
-    journal_out_.close();
-    journal_out_.open(journal_path_, std::ios::trunc);
+    if (!atomic_replace_file(tmp, snapshot_path_)) {
+      std::error_code ec;
+      std::filesystem::remove(tmp, ec);
+      return;
+    }
+    journal_.reset();
     journal_lines_ = 0;
+    ++compactions_;
   }
 
   void apply_event(const Json& ev) {
@@ -689,6 +917,44 @@ class Master {
       auto it = trials_.find(ev["trial_id"].as_int());
       if (it != trials_.end()) {
         it->second.latest_checkpoint = ev["uuid"].as_string();
+      }
+    } else if (type == "alloc_placed") {
+      // gang placement is durable so a restarted master can re-adopt the
+      // still-running processes instead of forgetting them (boot() holds
+      // the trial RUNNING and waits for the agents to re-report)
+      AllocationState alloc;
+      alloc.id = ev["id"].as_string();
+      alloc.trial_id = ev["trial_id"].as_int();
+      alloc.slots = static_cast<int>(ev["slots"].as_int(0));
+      for (const auto& g : ev["groups"].elements()) {
+        alloc.groups.push_back({g["agent"].as_string(),
+                                static_cast<int>(g["slots"].as_int(0))});
+      }
+      alloc.coord_host = ev["coord_host"].as_string();
+      alloc.coord_port = static_cast<int>(ev["coord_port"].as_int(0));
+      alloc.chief_port = static_cast<int>(ev["chief_port"].as_int(0));
+      alloc.session_token = ev["session_token"].as_string();
+      alloc.external_kind = ev["external_kind"].as_string();
+      alloc.external_pool = ev["external_pool"].as_string();
+      {
+        // keep the id allocator ahead of every replayed allocation
+        const std::string& id = alloc.id;
+        size_t dash = id.rfind('-');
+        if (dash != std::string::npos) {
+          int64_t n = atoll(id.c_str() + dash + 1);
+          next_allocation_id_ = std::max(next_allocation_id_, n + 1);
+        }
+      }
+      auto tit = trials_.find(alloc.trial_id);
+      if (tit != trials_.end()) {
+        tit->second.allocation_id = alloc.id;
+        tit->second.state = "RUNNING";
+      }
+      allocations_[alloc.id] = std::move(alloc);
+    } else if (type == "alloc_external_ref") {
+      auto it = allocations_.find(ev["id"].as_string());
+      if (it != allocations_.end()) {
+        it->second.external_ref = ev["ref"].as_string();
       }
     } else if (type == "template_set") {
       templates_[ev["name"].as_string()] = ev["config"];
@@ -980,6 +1246,33 @@ class Master {
       trials.push_back(j);
     }
     snap.set("trials", trials);
+    // un-ended allocations ride the snapshot so compaction never forgets a
+    // live gang (ended ones are pure history; dropping them bounds growth)
+    Json allocs = Json::array();
+    for (const auto& [aid, a] : allocations_) {
+      if (a.ended) continue;
+      Json j = Json::object();
+      j.set("id", a.id);
+      j.set("trial_id", Json(a.trial_id));
+      j.set("task_id", a.task_id);
+      j.set("slots", Json(static_cast<int64_t>(a.slots)));
+      Json groups = Json::array();
+      for (const auto& [gaid, slots] : a.groups) {
+        groups.push_back(Json::object()
+                             .set("agent", gaid)
+                             .set("slots", Json(static_cast<int64_t>(slots))));
+      }
+      j.set("groups", groups);
+      j.set("coord_host", a.coord_host);
+      j.set("coord_port", Json(static_cast<int64_t>(a.coord_port)));
+      j.set("chief_port", Json(static_cast<int64_t>(a.chief_port)));
+      j.set("session_token", a.session_token);
+      j.set("external_kind", a.external_kind);
+      j.set("external_pool", a.external_pool);
+      j.set("external_ref", a.external_ref);
+      allocs.push_back(j);
+    }
+    snap.set("allocations", allocs);
     Json webhooks = Json::array();
     for (const auto& [wid, wh] : webhooks_) {
       Json j = Json::object();
@@ -1112,6 +1405,27 @@ class Master {
         }
       }
       trials_[t.id] = t;
+    }
+    if (s.contains("allocations")) {
+      for (const auto& aj : s["allocations"].elements()) {
+        AllocationState a;
+        a.id = aj["id"].as_string();
+        a.trial_id = aj["trial_id"].as_int();
+        a.task_id = aj["task_id"].as_string();
+        a.slots = static_cast<int>(aj["slots"].as_int(0));
+        for (const auto& g : aj["groups"].elements()) {
+          a.groups.push_back({g["agent"].as_string(),
+                              static_cast<int>(g["slots"].as_int(0))});
+        }
+        a.coord_host = aj["coord_host"].as_string();
+        a.coord_port = static_cast<int>(aj["coord_port"].as_int(0));
+        a.chief_port = static_cast<int>(aj["chief_port"].as_int(0));
+        a.session_token = aj["session_token"].as_string();
+        a.external_kind = aj["external_kind"].as_string();
+        a.external_pool = aj["external_pool"].as_string();
+        a.external_ref = aj["external_ref"].as_string();
+        allocations_[a.id] = std::move(a);
+      }
     }
     if (s.contains("webhooks")) {
       for (const auto& wj : s["webhooks"].elements()) {
@@ -1967,6 +2281,17 @@ class Master {
     allocations_[alloc_id] = alloc;
     t.allocation_id = alloc_id;
     t.state = "RUNNING";
+    // durable placement: a restarted master keeps polling this backend job
+    // (the ref is journaled separately once the submit learns it)
+    record(Json::object()
+               .set("type", "alloc_placed")
+               .set("id", alloc_id)
+               .set("trial_id", Json(tid))
+               .set("slots", Json(static_cast<int64_t>(exp.slots_per_trial)))
+               .set("groups", Json::array())
+               .set("session_token", session_token)
+               .set("external_kind", alloc.external_kind)
+               .set("external_pool", alloc.external_pool));
 
     Json env = Json::object();
     env.set("DTPU_MASTER_URL", advertised_url_);
@@ -2223,6 +2548,28 @@ class Master {
       // revoked in end_allocation
       std::string session_token = issue_token(exp.owner);
       allocations_[alloc_id].session_token = session_token;
+      // durable placement record: lets a restarted master re-adopt this
+      // gang (the token itself is already journaled via token_issued)
+      {
+        Json groups_j = Json::array();
+        for (const auto& [gaid, slots] : groups) {
+          groups_j.push_back(Json::object()
+                                 .set("agent", gaid)
+                                 .set("slots", Json(static_cast<int64_t>(slots))));
+        }
+        record(Json::object()
+                   .set("type", "alloc_placed")
+                   .set("id", alloc_id)
+                   .set("trial_id", Json(tid))
+                   .set("slots", Json(static_cast<int64_t>(exp.slots_per_trial)))
+                   .set("groups", groups_j)
+                   .set("coord_host", allocations_[alloc_id].coord_host)
+                   .set("coord_port",
+                        Json(static_cast<int64_t>(allocations_[alloc_id].coord_port)))
+                   .set("chief_port",
+                        Json(static_cast<int64_t>(allocations_[alloc_id].chief_port)))
+                   .set("session_token", session_token));
+      }
       int node_rank = 0;
       for (auto& [aid, slots] : groups) {
         AgentState& ag = agents_[aid];
@@ -2667,6 +3014,7 @@ class Master {
  public:
   // exposed for routes
   std::mutex mu_;
+  AdmissionControl admission_;
   std::condition_variable work_cv_;
   std::condition_variable preempt_cv_;
   std::condition_variable events_cv_;
@@ -2860,6 +3208,10 @@ class Master {
         return;
       }
       it->second.external_ref = ref;
+      record(Json::object()
+                 .set("type", "alloc_external_ref")
+                 .set("id", op.alloc_id)
+                 .set("ref", ref));
     } else if (op.kind == "kill") {
       if (ref.empty()) return;  // launch failed; nothing to kill
       lk.unlock();
@@ -3189,14 +3541,24 @@ class Master {
   std::string checkpoint_dir_;
   std::string journal_path_;
   std::string snapshot_path_;
-  std::ofstream journal_out_;
+  WalWriter journal_;  // fsynced, CRC-framed WAL (wal.hpp)
   bool replaying_ = false;
   int journal_limit_ = 4096;
   int journal_lines_ = 0;
+  bool compact_pending_ = false;  // set by record(), consumed by maybe_compact()
   int log_retention_days_ = 0;
   int64_t seq_ = 0;  // monotone event sequence (journal + snapshot watermark)
   int64_t agent_timeout_ms_ = 90000;  // reap agents silent for this long
   std::string scheduler_mode_ = "priority";  // priority | fair_share
+  bool journal_fsync_ = true;  // --journal-no-fsync for throwaway clusters
+  // crash-safe restart bookkeeping (boot/reap_unattached_allocations)
+  int64_t reattach_grace_ms_ = 60000;
+  int64_t replay_duration_ms_ = 0;
+  int64_t replay_events_ = 0;
+  int64_t wal_truncated_bytes_ = 0;
+  int64_t compactions_ = 0;
+  int64_t reattach_adopted_ = 0;
+  int64_t reattach_lost_ = 0;
 
   int64_t next_experiment_id_ = 1;
   int64_t next_trial_id_ = 1;
@@ -3371,6 +3733,18 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       return h(req);
     };
   };
+  // Admission backpressure wrapper for the ingest hot paths: the ticket is
+  // taken BEFORE auth (auth takes mu_, which is exactly the resource an
+  // overloaded master must protect), so shedding costs one atomic op and
+  // no lock.  429 + Retry-After; harness clients honor it (PR 1).
+  auto ingest_guarded = [&m](Handler h) -> Handler {
+    return [&m, h](const HttpRequest& req) {
+      IngestTicket ticket(m.admission_, m.journal_);
+      if (!ticket.admitted()) return shed_response(m.admission_.retry_after_s);
+      return h(req);
+    };
+  };
+
   auto admin_only = [&m](Handler h) -> Handler {
     return [&m, h](const HttpRequest& req) {
       {
@@ -3495,6 +3869,42 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         << "# TYPE dtpu_tokens gauge\ndtpu_tokens " << m.tokens_.size() << "\n"
         << "# TYPE dtpu_journal_lines gauge\ndtpu_journal_lines "
         << m.journal_lines_ << "\n";
+    // durability + backpressure gauges (ISSUE 13): journal append/fsync
+    // latency, boot replay cost, re-attach outcomes, and ingest shedding
+    int64_t appends = m.journal_.appends();
+    out << "# HELP dtpu_journal_append_total fsynced WAL appends since boot\n"
+        << "# TYPE dtpu_journal_append_total counter\n"
+        << "dtpu_journal_append_total " << appends << "\n"
+        << "# HELP dtpu_journal_append_us_avg mean WAL append+fsync latency\n"
+        << "# TYPE dtpu_journal_append_us_avg gauge\n"
+        << "dtpu_journal_append_us_avg "
+        << (appends > 0 ? m.journal_.total_us() / appends : 0) << "\n"
+        << "# TYPE dtpu_journal_append_us_max gauge\n"
+        << "dtpu_journal_append_us_max " << m.journal_.max_us() << "\n"
+        << "# TYPE dtpu_journal_append_us_ema gauge\n"
+        << "dtpu_journal_append_us_ema " << m.journal_.ema_us() << "\n"
+        << "# TYPE dtpu_journal_compactions_total counter\n"
+        << "dtpu_journal_compactions_total " << m.compactions_ << "\n"
+        << "# HELP dtpu_replay_duration_ms snapshot+journal replay time at boot\n"
+        << "# TYPE dtpu_replay_duration_ms gauge\n"
+        << "dtpu_replay_duration_ms " << m.replay_duration_ms_ << "\n"
+        << "# TYPE dtpu_replay_events gauge\n"
+        << "dtpu_replay_events " << m.replay_events_ << "\n"
+        << "# HELP dtpu_journal_truncated_bytes torn-tail bytes dropped at boot\n"
+        << "# TYPE dtpu_journal_truncated_bytes gauge\n"
+        << "dtpu_journal_truncated_bytes " << m.wal_truncated_bytes_ << "\n"
+        << "# HELP dtpu_reattach_adopted_total gangs re-adopted after restart\n"
+        << "# TYPE dtpu_reattach_adopted_total counter\n"
+        << "dtpu_reattach_adopted_total " << m.reattach_adopted_ << "\n"
+        << "# TYPE dtpu_reattach_lost_total counter\n"
+        << "dtpu_reattach_lost_total " << m.reattach_lost_ << "\n"
+        << "# HELP dtpu_ingest_shed_total ingest requests answered 429\n"
+        << "# TYPE dtpu_ingest_shed_total counter\n"
+        << "dtpu_ingest_shed_total "
+        << m.admission_.shed.load(std::memory_order_relaxed) << "\n"
+        << "# TYPE dtpu_ingest_inflight gauge\n"
+        << "dtpu_ingest_inflight "
+        << m.admission_.inflight.load(std::memory_order_relaxed) << "\n";
     HttpResponse r;
     r.content_type = "text/plain; version=0.0.4";
     r.body = out.str();
@@ -4419,7 +4829,9 @@ void install_routes_impl(Master& m, HttpServer& srv) {
   // (determined_tpu/experiment/cluster.py, journaled on the driver side);
   // the master owns gang dispatch, restarts, and rendezvous.  Trials
   // arrive one at a time as the driver's searcher creates them.
-  srv.route("POST", "/api/v1/experiments/{id}/trials", authed([&m](const HttpRequest& req) {
+  // trial creates are journaled + schedule(): shed them too when behind
+  // (the driver's idempotent-by-request-id submit retries harmlessly)
+  srv.route("POST", "/api/v1/experiments/{id}/trials", ingest_guarded(authed([&m](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     std::lock_guard<std::mutex> lk(m.mu_);
@@ -4465,7 +4877,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     Json out = Json::object();
     out.set("id", Json(tid));
     return R::json(out.dump(), 201);
-  }));
+  })));
 
   // driver searcher finished creating trials: once every trial is
   // terminal the experiment completes (same maybe_complete path the
@@ -4553,7 +4965,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     return false;
   };
 
-  srv.route("POST", "/api/v1/metrics", authed([&m, ingest_validation](const HttpRequest& req) {
+  srv.route("POST", "/api/v1/metrics", ingest_guarded(authed([&m, ingest_validation](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     m.append_jsonl_striped(m.metrics_path(body["trial_id"].as_int()), body);
@@ -4562,10 +4974,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       if (ingest_validation(body)) m.schedule();
     }
     return R::json("{}");
-  }));
+  })));
 
   // batched form used by the harness metrics shipper (core/_metrics.py)
-  srv.route("POST", "/api/v1/trials/metrics", authed([&m, ingest_validation](const HttpRequest& req) {
+  srv.route("POST", "/api/v1/trials/metrics", ingest_guarded(authed([&m, ingest_validation](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     std::vector<const Json*> validations;
@@ -4580,7 +4992,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       if (any) m.schedule();
     }
     return R::json("{}");
-  }));
+  })));
 
   // trial liveness heartbeat (reference: unmanaged-trial heartbeat,
   // core/_heartbeat.py).  For unmanaged experiments the first heartbeat
@@ -4878,6 +5290,58 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     // every work long-poll, so it can never be the provisioner's idle
     // baseline (a never-used agent would look busy forever)
     if (ag.last_busy_ms == 0) ag.last_busy_ms = now_ms();
+    // Re-attach handshake (crash-safe master restart): the agent reports
+    // the allocations whose processes it is STILL running.  Each report
+    // that matches a journaled allocation awaiting re-attach claims that
+    // agent's group; once every group is claimed the gang is re-adopted in
+    // place — the training processes never notice the master died.  A
+    // report the master cannot match (allocation ended, already declared
+    // lost, or from before a reschedule) is a stale process: kill it.
+    if (body.contains("allocations") && body["allocations"].is_array()) {
+      for (const auto& rep : body["allocations"].elements()) {
+        const std::string alloc_id = rep["id"].as_string();
+        if (alloc_id.empty()) continue;
+        bool matched = false;
+        auto ait = m.allocations_.find(alloc_id);
+        if (ait != m.allocations_.end() && !ait->second.ended) {
+          AllocationState& alloc = ait->second;
+          for (const auto& [gaid, slots] : alloc.groups) {
+            if (gaid != id) continue;
+            matched = true;
+            if (alloc.awaiting_reattach && !alloc.reattached_agents.count(id)) {
+              alloc.reattached_agents.insert(id);
+              ag.used_slots += slots;
+              ag.last_busy_ms = now_ms();
+              if (alloc.reattached_agents.size() == alloc.groups.size()) {
+                alloc.awaiting_reattach = false;
+                ++m.reattach_adopted_;
+                m.append_jsonl_striped(
+                    m.logs_path(alloc.trial_id),
+                    Json::object()
+                        .set("ts", Json(now_ms()))
+                        .set("level", "INFO")
+                        .set("line", "gang: allocation " + alloc_id +
+                                         " re-adopted after master restart "
+                                         "(all ranks re-reported; no restart "
+                                         "burned)"));
+                printf("master: allocation %s (trial %lld) re-adopted\n",
+                       alloc_id.c_str(),
+                       static_cast<long long>(alloc.trial_id));
+                fflush(stdout);
+              }
+            }
+            break;
+          }
+        }
+        if (!matched) {
+          Json work = Json::object();
+          work.set("type", "kill");
+          work.set("allocation_id", alloc_id);
+          ag.work.push_back(work);
+          m.work_cv_.notify_all();
+        }
+      }
+    }
     m.schedule();
     return R::json("{\"registered\":true}");
   }));
@@ -5568,7 +6032,9 @@ void install_routes_impl(Master& m, HttpServer& srv) {
   }
 
   // ---- task logs (per-trial jsonl files, paged like metrics) ----
-  srv.route("POST", "/api/v1/logs", authed([&m](const HttpRequest& req) {
+  // shed log batches under pressure: at-least-once shippers retry with
+  // Retry-After, and a dropped fire-and-forget batch is bounded loss
+  srv.route("POST", "/api/v1/logs", ingest_guarded(authed([&m](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
     std::string agent_id =
@@ -5616,7 +6082,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       }
     }
     return R::json("{}");
-  }));
+  })));
 
   srv.route("GET", "/api/v1/trials/{id}/logs", authed([&m](const HttpRequest& req) {
     int64_t tid = std::stoll(req.params.at("id"));
@@ -5770,6 +6236,52 @@ static int run_simulate(const std::string& config_path, uint64_t seed) {
   return 0;
 }
 
+// Offline WAL verifier (`dtpu-master --journal-fsck <state-dir>`): checks
+// the snapshot parses and every journal record's framing + CRC, prints the
+// last-good LSN (highest durable seq), and distinguishes a routine torn
+// tail (crash mid-append; exit 0 — boot will truncate it) from mid-log
+// corruption (valid records FOLLOW the damage; exit 1 — bytes were lost
+// that no crash explains).  Wired into scripts/native_check.sh.
+static int run_journal_fsck(const std::string& state_dir) {
+  using namespace dtpu;
+  int status = 0;
+  int64_t snap_seq = 0;
+  std::string snapshot = state_dir + "/snapshot.json";
+  if (std::filesystem::exists(snapshot)) {
+    std::ifstream in(snapshot);
+    std::ostringstream data;
+    data << in.rdbuf();
+    Json s;
+    if (!Json::try_parse(data.str(), &s)) {
+      printf("journal-fsck: snapshot.json UNPARSEABLE\n");
+      status = 1;
+    } else {
+      snap_seq = s["last_seq"].as_int(0);
+    }
+  }
+  WalReadResult wal = wal_read(state_dir + "/journal.jsonl");
+  int64_t last_good_lsn = std::max(snap_seq, wal.last_good_seq);
+  if (wal.midlog_corrupt) status = 1;
+  printf("journal-fsck: %s last_good_lsn=%lld records=%zu snapshot_seq=%lld"
+         " tail_truncated=%s midlog_corrupt=%s dropped_bytes=%llu\n",
+         status == 0 ? "OK" : "FAIL", static_cast<long long>(last_good_lsn),
+         wal.records.size(), static_cast<long long>(snap_seq),
+         wal.tail_damaged ? "yes" : "no", wal.midlog_corrupt ? "yes" : "no",
+         static_cast<unsigned long long>(wal.file_size - wal.last_good_offset));
+  return status;
+}
+
+// Offline replay (`dtpu-master --dump-state <state-dir>`): boot (snapshot +
+// journal, torn tail truncated) without serving, print the deterministic
+// state digest, exit.  The torn-write fuzz test diffs this across
+// truncation offsets.
+static int run_dump_state(const std::string& state_dir) {
+  dtpu::Master master(state_dir, state_dir + "/ckpts");
+  master.boot();
+  printf("%s\n", master.debug_state().dump().c_str());
+  return 0;
+}
+
 int main(int argc, char** argv) {
   // TLS writes go through SSL_write (plain write(2), no MSG_NOSIGNAL);
   // a client resetting mid-response must not SIGPIPE the master
@@ -5782,6 +6294,11 @@ int main(int argc, char** argv) {
   int log_retention_days = 0;
   int agent_timeout_sec = 90;
   int serve_replica_timeout_sec = 15;
+  int reattach_grace_sec = 60;
+  bool journal_fsync = true;
+  int ingest_max_inflight = 256;
+  int ingest_fsync_budget_ms = 0;
+  int ingest_retry_after_sec = 1;
   std::string scheduler = "priority";
   std::string pools_file;
   std::string advertised_url;
@@ -5806,6 +6323,19 @@ int main(int argc, char** argv) {
     else if (arg == "--serve-replica-timeout-sec")
       serve_replica_timeout_sec =
           std::atoi(next("--serve-replica-timeout-sec").c_str());
+    else if (arg == "--reattach-grace-sec")
+      reattach_grace_sec = std::atoi(next("--reattach-grace-sec").c_str());
+    else if (arg == "--journal-no-fsync") journal_fsync = false;
+    else if (arg == "--ingest-max-inflight")
+      ingest_max_inflight = std::atoi(next("--ingest-max-inflight").c_str());
+    else if (arg == "--ingest-fsync-budget-ms")
+      ingest_fsync_budget_ms =
+          std::atoi(next("--ingest-fsync-budget-ms").c_str());
+    else if (arg == "--ingest-retry-after-sec")
+      ingest_retry_after_sec =
+          std::atoi(next("--ingest-retry-after-sec").c_str());
+    else if (arg == "--journal-fsck") return run_journal_fsck(next("--journal-fsck"));
+    else if (arg == "--dump-state") return run_dump_state(next("--dump-state"));
     else if (arg == "--scheduler") scheduler = next("--scheduler");
     else if (arg == "--pools") pools_file = next("--pools");
     else if (arg == "--advertised-url") advertised_url = next("--advertised-url");
@@ -5839,6 +6369,12 @@ int main(int argc, char** argv) {
     return 2;
   }
   master.set_scheduler(scheduler);
+  master.set_reattach_grace_ms(static_cast<int64_t>(reattach_grace_sec) * 1000);
+  master.set_journal_fsync(journal_fsync);
+  master.admission_.max_inflight = ingest_max_inflight;
+  master.admission_.fsync_budget_us =
+      static_cast<int64_t>(ingest_fsync_budget_ms) * 1000;
+  master.admission_.retry_after_s = std::max(ingest_retry_after_sec, 1);
   if (!pools_file.empty()) {
     std::ifstream in(pools_file);
     std::ostringstream data;
@@ -5913,6 +6449,8 @@ int main(int argc, char** argv) {
     master.reap_dead_agents();
     master.reap_idle_tasks();
     master.reap_dead_serve_replicas();
+    master.reap_unattached_allocations();
+    master.maybe_compact();
     if (++ticks >= 1800) {
       ticks = 0;
       master.retention_sweep();

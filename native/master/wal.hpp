@@ -1,0 +1,301 @@
+// Write-ahead log for the master's control-plane journal.
+//
+// The driver-side experiment journal (determined_tpu/experiment/journal.py)
+// proved the record discipline this module ports up to the C++ master:
+// append-only, fsynced before the mutation is acknowledged, torn tails
+// truncated at boot instead of failing it, snapshots replaced atomically
+// (temp + fsync + rename + directory fsync).  The master adds per-record
+// framing — the Python journal can lean on JSON parseability alone because
+// a driver crash tears at most the final line, but the master's journal is
+// the *only* copy of cluster state, so every record carries an explicit
+// length and CRC32:
+//
+//   W1 <payload-len> <crc32-lowercase-hex> <payload>\n
+//
+// A record is valid iff the declared length matches the bytes on the line
+// AND the CRC matches.  Readers stop at the first invalid record (prefix
+// semantics, ARIES-style redo: replay exactly the acknowledged prefix);
+// whether bytes after the damage look like valid records distinguishes a
+// routine torn tail (crash mid-append; truncate and continue) from mid-log
+// corruption (bit rot / operator damage; fsck exits nonzero).
+//
+// Legacy compatibility: journals written before this module were plain
+// JSONL.  Unframed lines that parse as JSON are accepted as valid records,
+// so a pre-WAL state dir boots; everything appended afterwards is framed.
+
+#pragma once
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <filesystem>
+#include <string>
+#include <vector>
+
+#include "../common/json.hpp"
+
+namespace dtpu {
+
+// ---- crc32 (IEEE, the zlib polynomial) ------------------------------------
+
+inline uint32_t crc32_update(uint32_t crc, const char* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = [] {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    return true;
+  }();
+  (void)init;
+  crc = ~crc;
+  for (size_t i = 0; i < n; ++i) {
+    crc = table[(crc ^ static_cast<unsigned char>(data[i])) & 0xff] ^ (crc >> 8);
+  }
+  return ~crc;
+}
+
+inline uint32_t crc32_of(const std::string& s) {
+  return crc32_update(0, s.data(), s.size());
+}
+
+// ---- framing ---------------------------------------------------------------
+
+inline std::string wal_frame(const std::string& payload) {
+  char head[32];
+  snprintf(head, sizeof(head), "W1 %zu %08x ", payload.size(), crc32_of(payload));
+  std::string out;
+  out.reserve(payload.size() + 24);
+  out += head;
+  out += payload;
+  out += '\n';
+  return out;
+}
+
+// Parse one line (without its trailing '\n').  Returns true and fills
+// *payload when the line is a valid framed record OR a legacy plain-JSON
+// record; false for anything torn or corrupt.
+inline bool wal_parse_line(const std::string& line, std::string* payload) {
+  if (line.rfind("W1 ", 0) == 0) {
+    size_t sp1 = line.find(' ', 3);
+    if (sp1 == std::string::npos) return false;
+    size_t sp2 = line.find(' ', sp1 + 1);
+    if (sp2 == std::string::npos) return false;
+    char* end = nullptr;
+    unsigned long len = strtoul(line.c_str() + 3, &end, 10);
+    if (end != line.c_str() + sp1) return false;
+    unsigned long crc = strtoul(line.c_str() + sp1 + 1, &end, 16);
+    if (end != line.c_str() + sp2) return false;
+    std::string body = line.substr(sp2 + 1);
+    if (body.size() != len) return false;
+    if (crc32_of(body) != static_cast<uint32_t>(crc)) return false;
+    *payload = std::move(body);
+    return true;
+  }
+  // legacy (pre-WAL) journal line: accept iff it is whole, parseable JSON
+  Json probe;
+  if (!Json::try_parse(line, &probe)) return false;
+  *payload = line;
+  return true;
+}
+
+// ---- durable-file helpers --------------------------------------------------
+
+inline bool fsync_path(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  bool ok = ::fsync(fd) == 0;
+  ::close(fd);
+  return ok;
+}
+
+inline void fsync_parent_dir(const std::string& path) {
+  std::filesystem::path p(path);
+  std::string dir = p.parent_path().string();
+  if (dir.empty()) dir = ".";
+  int fd = ::open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (fd >= 0) {
+    ::fsync(fd);
+    ::close(fd);
+  }
+}
+
+// temp + fsync + rename + parent-dir fsync: the snapshot replace discipline
+// (either the old snapshot or the new one exists after any crash, never a
+// half-written file)
+inline bool atomic_replace_file(const std::string& tmp, const std::string& dst) {
+  if (!fsync_path(tmp)) return false;
+  std::error_code ec;
+  std::filesystem::rename(tmp, dst, ec);
+  if (ec) return false;
+  fsync_parent_dir(dst);
+  return true;
+}
+
+// ---- reader ----------------------------------------------------------------
+
+struct WalReadResult {
+  std::vector<std::string> records;  // valid payloads, in order
+  uint64_t file_size = 0;
+  uint64_t last_good_offset = 0;  // byte offset just past the last valid record
+  bool tail_damaged = false;      // invalid bytes after the valid prefix
+  bool midlog_corrupt = false;    // ...followed by MORE valid records (not a torn tail)
+  int64_t last_good_seq = 0;      // highest "seq" among valid records (fsck's LSN)
+};
+
+inline WalReadResult wal_read(const std::string& path) {
+  WalReadResult out;
+  std::string data;
+  {
+    FILE* f = fopen(path.c_str(), "rb");
+    if (f == nullptr) return out;
+    char buf[1 << 16];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), f)) > 0) data.append(buf, n);
+    fclose(f);
+  }
+  out.file_size = data.size();
+  size_t pos = 0;
+  bool prefix_over = false;
+  while (pos < data.size()) {
+    size_t nl = data.find('\n', pos);
+    bool complete_line = nl != std::string::npos;
+    std::string line = data.substr(pos, complete_line ? nl - pos : std::string::npos);
+    size_t next = complete_line ? nl + 1 : data.size();
+    std::string payload;
+    // a record is only durable once its newline landed: a valid-looking
+    // final line with no terminator is still a torn append
+    bool valid = complete_line && !line.empty() && wal_parse_line(line, &payload);
+    if (!prefix_over) {
+      if (valid) {
+        Json ev;
+        if (Json::try_parse(payload, &ev) && ev.contains("seq")) {
+          out.last_good_seq = std::max(out.last_good_seq, ev["seq"].as_int(0));
+        }
+        out.records.push_back(std::move(payload));
+        out.last_good_offset = next;
+      } else if (!line.empty() || !complete_line) {
+        prefix_over = true;
+        out.tail_damaged = true;
+      } else {
+        out.last_good_offset = next;  // stray blank line: skip, stay in prefix
+      }
+    } else if (valid) {
+      // valid records past the damage: this is not a crash-torn tail
+      out.midlog_corrupt = true;
+    }
+    pos = next;
+  }
+  return out;
+}
+
+// ---- writer ----------------------------------------------------------------
+
+// Appends framed records with an fsync per append (the WAL contract: a
+// mutation is acknowledged only after its record is on disk).  Latency is
+// tracked so /metrics can expose journal.append fsync cost and the
+// admission controller can shed ingest when the disk falls behind.
+class WalWriter {
+ public:
+  ~WalWriter() { close(); }
+
+  bool open(const std::string& path, bool fsync_enabled = true) {
+    close();
+    path_ = path;
+    fsync_enabled_ = fsync_enabled;
+    fd_ = ::open(path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    return fd_ >= 0;
+  }
+
+  bool is_open() const { return fd_ >= 0; }
+
+  void close() {
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  // truncate to empty (journal compaction) — durable before returning
+  bool reset() {
+    if (fd_ < 0) return false;
+    if (::ftruncate(fd_, 0) != 0) return false;
+    if (fsync_enabled_) ::fsync(fd_);
+    return true;
+  }
+
+  bool append(const std::string& payload) {
+    if (fd_ < 0) return false;
+    std::string rec = wal_frame(payload);
+    auto t0 = std::chrono::steady_clock::now();
+    // Remember where this record starts: a partial write (ENOSPC, EIO)
+    // must be truncated away, or the next append would land mid-line and
+    // the merged garbage would read as MID-LOG corruption at the next
+    // boot — silently discarding every later fsynced record.  After the
+    // truncate the file ends at a record boundary and later appends stay
+    // replayable even if this one was lost.
+    // SEEK_END, not SEEK_CUR: under O_APPEND the descriptor's position is
+    // NOT at EOF until the first write, but appends always land at EOF —
+    // truncating to a stale position would wipe earlier records
+    off_t start = ::lseek(fd_, 0, SEEK_END);
+    auto unwind = [&]() {
+      if (start >= 0 && ::ftruncate(fd_, start) != 0) {
+        fprintf(stderr,
+                "wal: failed append AND failed truncate at offset %lld: "
+                "journal tail is no longer trustworthy\n",
+                static_cast<long long>(start));
+      }
+      return false;
+    };
+    size_t off = 0;
+    while (off < rec.size()) {
+      ssize_t w = ::write(fd_, rec.data() + off, rec.size() - off);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        return unwind();
+      }
+      off += static_cast<size_t>(w);
+    }
+    if (fsync_enabled_) {
+      if (::fdatasync(fd_) != 0) return unwind();
+    }
+    int64_t us = std::chrono::duration_cast<std::chrono::microseconds>(
+                     std::chrono::steady_clock::now() - t0)
+                     .count();
+    appends_.fetch_add(1, std::memory_order_relaxed);
+    total_us_.fetch_add(us, std::memory_order_relaxed);
+    int64_t prev_max = max_us_.load(std::memory_order_relaxed);
+    while (us > prev_max &&
+           !max_us_.compare_exchange_weak(prev_max, us, std::memory_order_relaxed)) {
+    }
+    // EMA (alpha = 1/8) readable without any lock: the admission check on
+    // the ingest hot path polls this to decide whether the WAL is behind
+    int64_t prev = ema_us_.load(std::memory_order_relaxed);
+    ema_us_.store(prev == 0 ? us : prev + (us - prev) / 8,
+                  std::memory_order_relaxed);
+    return true;
+  }
+
+  int64_t appends() const { return appends_.load(std::memory_order_relaxed); }
+  int64_t total_us() const { return total_us_.load(std::memory_order_relaxed); }
+  int64_t max_us() const { return max_us_.load(std::memory_order_relaxed); }
+  int64_t ema_us() const { return ema_us_.load(std::memory_order_relaxed); }
+
+ private:
+  std::string path_;
+  int fd_ = -1;
+  bool fsync_enabled_ = true;
+  std::atomic<int64_t> appends_{0};
+  std::atomic<int64_t> total_us_{0};
+  std::atomic<int64_t> max_us_{0};
+  std::atomic<int64_t> ema_us_{0};
+};
+
+}  // namespace dtpu

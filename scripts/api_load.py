@@ -81,6 +81,65 @@ def run(master: str, clients: int, requests: int, thresholds_ms: float):
     return 0 if ok else 1
 
 
+def run_ingest(master: str, clients: int, requests_n: int, thresholds_ms: float):
+    """Ingest-saturation mode (the backpressure acceptance): hammer the
+    metrics ingest route and assert the master answers every request fast —
+    2xx when it can absorb, 429 + Retry-After when it sheds — instead of
+    queueing connections until clients time out.  Run against a master
+    started with a small ``--ingest-max-inflight`` to force shedding."""
+    from determined_tpu.api.authentication import ensure_session
+
+    session = ensure_session(master)
+    url = master.rstrip("/") + "/api/v1/metrics"
+    headers = {"Authorization": f"Bearer {session.token}"}
+    body = {
+        "trial_id": 1,
+        "group": "training",
+        "metrics": {"loss": 0.1},
+        "steps_completed": 1,
+    }
+
+    def one_request(_):
+        t0 = time.perf_counter()
+        try:
+            r = session._http.post(url, json=body, headers=headers, timeout=30)
+            dt = (time.perf_counter() - t0) * 1000
+            if r.status_code == 429:
+                return dt, "shed", r.headers.get("Retry-After")
+            return dt, "ok" if r.status_code < 300 else "error", None
+        except Exception:  # noqa: BLE001 - a hang/timeout is the failure mode
+            return (time.perf_counter() - t0) * 1000, "error", None
+
+    times, sheds, oks, errors = [], 0, 0, 0
+    sheds_with_retry_after = 0
+    with concurrent.futures.ThreadPoolExecutor(clients) as pool:
+        for dt, kind, retry_after in pool.map(one_request, range(requests_n)):
+            times.append(dt)
+            if kind == "ok":
+                oks += 1
+            elif kind == "shed":
+                sheds += 1
+                if retry_after is not None:
+                    sheds_with_retry_after += 1
+            else:
+                errors += 1
+    times.sort()
+    pct = lambda p: times[min(len(times) - 1, int(p / 100 * len(times)))]  # noqa: E731
+    p95 = round(pct(95), 2)
+    ok = (
+        errors == 0
+        and p95 <= thresholds_ms
+        and sheds == sheds_with_retry_after  # every 429 carried Retry-After
+    )
+    print(f"ingest: {oks} ok, {sheds} shed (429), {errors} errors, "
+          f"p50 {round(statistics.median(times), 2)}ms p95 {p95}ms")
+    print(json.dumps({"metric": "ingest_p95_ms", "value": p95,
+                      "threshold_ms": thresholds_ms, "ok": oks, "shed": sheds,
+                      "shed_with_retry_after": sheds_with_retry_after,
+                      "errors": errors, "pass": ok}))
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--master", default=os.environ.get("DTPU_MASTER",
@@ -88,7 +147,13 @@ def main():
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--requests", type=int, default=100)
     ap.add_argument("--threshold-ms", type=float, default=500.0)
+    ap.add_argument("--ingest", action="store_true",
+                    help="saturate the metrics ingest route; asserts bounded "
+                         "p95 with 429/Retry-After shedding, never timeouts")
     args = ap.parse_args()
+    if args.ingest:
+        sys.exit(run_ingest(args.master, args.clients, args.requests,
+                            args.threshold_ms))
     sys.exit(run(args.master, args.clients, args.requests, args.threshold_ms))
 
 

@@ -80,6 +80,67 @@ def free_port() -> int:
     return port
 
 
+# ---- master WAL helpers (native/master/wal.hpp record framing) -------------
+#
+# The master journal is a CRC-framed, fsynced WAL:
+#   W1 <payload-len> <crc32-lowercase-hex> <payload>\n
+# These helpers write byte-identical frames so tests (and the fsck
+# self-test below) can fabricate journals and damage them surgically.
+
+def wal_frame(payload: str) -> bytes:
+    import binascii
+
+    data = payload.encode()
+    crc = binascii.crc32(data) & 0xFFFFFFFF
+    return b"W1 %d %08x " % (len(data), crc) + data + b"\n"
+
+
+def wal_unframe(line: str):
+    """Parse one journal line back to its JSON payload (framed or legacy
+    plain-JSONL); returns None for torn/corrupt lines."""
+    import binascii
+
+    if line.startswith("W1 "):
+        try:
+            _, length, crc, payload = line.split(" ", 3)
+        except ValueError:
+            return None
+        data = payload.encode()
+        if len(data) != int(length) or binascii.crc32(data) & 0xFFFFFFFF != int(crc, 16):
+            return None
+        return json.loads(payload)
+    try:
+        return json.loads(line)
+    except json.JSONDecodeError:
+        return None
+
+
+def read_master_journal(state_dir: str):
+    """All valid event payloads of a master journal, in order."""
+    path = os.path.join(state_dir, "journal.jsonl")
+    out = []
+    with open(path) as f:
+        for line in f:
+            ev = wal_unframe(line.rstrip("\n"))
+            if ev is not None:
+                out.append(ev)
+    return out
+
+
+def write_master_journal(state_dir: str, events) -> str:
+    """Write ``events`` (dicts; 'seq' added when missing) as a framed
+    master journal under ``state_dir``; returns the journal path."""
+    os.makedirs(state_dir, exist_ok=True)
+    path = os.path.join(state_dir, "journal.jsonl")
+    with open(path, "wb") as f:
+        for i, ev in enumerate(events):
+            ev = dict(ev)
+            ev.setdefault("seq", i + 1)
+            ev.setdefault("ts", 0)
+            f.write(wal_frame(json.dumps(ev)))
+    return path
+
+
 class DevCluster:
     """master + agents as subprocesses (reference double.devcluster.yaml)."""
 
@@ -173,6 +234,19 @@ class DevCluster:
             time.sleep(0.2)
         raise RuntimeError("agents did not register")
 
+    def kill_master(self):
+        """SIGKILL the master, keeping its state dir (the crash half of the
+        durability acceptance: journal fsynced -> nothing is lost)."""
+        p = self.procs["master"]
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+
+    def restart_master(self):
+        """Start a fresh master on the SAME port + state dir: it replays
+        snapshot+journal and waits for agents to re-report their gangs."""
+        self.start_master()
+
     def stop(self):
         for name, p in self.procs.items():
             if p.poll() is None:
@@ -253,6 +327,136 @@ def _smoke(cluster: "DevCluster") -> int:
     return 0 if ok else 1
 
 
+def sample_master_events():
+    """A small driver-experiment event sequence for WAL tooling tests: one
+    experiment, two trials, one validation, one stop — every record changes
+    the dump-state digest, so prefix truncation is observable."""
+    cfg = {
+        "name": "wal-fixture",
+        "entrypoint": "determined_tpu.models.mnist:MnistTrial",
+        "hyperparameters": {"lr": 0.1},
+        "searcher": {
+            "name": "driver",
+            "metric": "validation_loss",
+            "max_length": {"batches": 8},
+        },
+        "resources": {"slots_per_trial": 1},
+    }
+    return [
+        {"type": "exp_created", "id": 1, "owner": "determined", "config": cfg},
+        {"type": "driver_trial", "experiment_id": 1, "request_id": 1,
+         "hparams": {"lr": 0.1}, "source_checkpoint": "", "trial_id": 1},
+        {"type": "validation", "trial_id": 1, "metric": 0.5, "step": 2},
+        {"type": "driver_trial", "experiment_id": 1, "request_id": 2,
+         "hparams": {"lr": 0.01}, "source_checkpoint": "", "trial_id": 2},
+        {"type": "trial_stop", "trial_id": 2},
+    ]
+
+
+def _kill_master_smoke(cluster: "DevCluster") -> int:
+    """SIGKILL + restart the master under a live 2-process gang (the
+    durability acceptance): the WAL replays, the agents re-report their
+    running allocation, the gang is re-adopted without losing its training
+    processes (restarts stays 0), and the journal fscks clean."""
+    cfg = exp_config(cluster.ckpt_dir, slots=2)
+    cfg["environment"]["env"]["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    cfg["searcher"]["max_length"] = {"batches": 20}
+    cfg["min_validation_period"] = {"batches": 5}
+    exp_id = cluster.submit(cfg)
+    print(f"kill-master: submitted experiment {exp_id} (2-slot gang over 2 agents)")
+
+    trial_id = None
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        exp = cluster.http.get(
+            f"{cluster.url}/api/v1/experiments/{exp_id}", timeout=5
+        ).json()
+        trials = exp.get("trials") or []
+        if trials and trials[0]["state"] == "RUNNING":
+            trial_id = trials[0]["id"]
+            logs = cluster.http.get(
+                f"{cluster.url}/api/v1/trials/{trial_id}/logs", timeout=5
+            ).json()
+            if any("rendezvous: joined" in str(line) for line in logs):
+                break
+        time.sleep(0.5)
+    if trial_id is None:
+        print("kill-master: gang never started", file=sys.stderr)
+        return 1
+
+    print("kill-master: gang live; SIGKILLing the master")
+    cluster.kill_master()
+    time.sleep(1.0)
+    cluster.restart_master()
+    print("kill-master: master restarted; waiting for re-adoption + completion")
+
+    final = cluster.wait_for_state(exp_id, timeout=420)
+    trial = final["trials"][0]
+    logs = cluster.http.get(
+        f"{cluster.url}/api/v1/trials/{trial_id}/logs", timeout=5
+    ).json()
+    adopted = any("re-adopted" in str(line) for line in logs)
+    fsck = subprocess.run(
+        [MASTER_BIN, "--journal-fsck", cluster.state_dir], capture_output=True
+    )
+    print(f"kill-master: experiment {final['state']}, trial {trial['state']}, "
+          f"restarts={trial['restarts']}, re-adopted={adopted}, "
+          f"fsck rc={fsck.returncode} ({fsck.stdout.decode().strip()})")
+    ok = (
+        final["state"] == "COMPLETED"
+        and trial["state"] == "COMPLETED"
+        and int(trial["restarts"]) == 0
+        and adopted
+        and fsck.returncode == 0
+    )
+    if not ok:
+        for line in logs[-40:]:
+            print(f"  | {line}")
+    return 0 if ok else 1
+
+
+def _fsck_selftest() -> int:
+    """Offline `--journal-fsck` self-test (wired into native_check.sh):
+    clean and torn-tail journals verify (exit 0), mid-log corruption is
+    detected (exit 1)."""
+    import tempfile
+
+    def fsck(d):
+        r = subprocess.run([MASTER_BIN, "--journal-fsck", d], capture_output=True)
+        return r.returncode, r.stdout.decode().strip()
+
+    frames = [wal_frame(json.dumps({**ev, "seq": i + 1, "ts": 0}))
+              for i, ev in enumerate(sample_master_events())]
+    with tempfile.TemporaryDirectory(prefix="dtpu-fsck-") as root:
+        clean = os.path.join(root, "clean")
+        os.makedirs(clean)
+        with open(os.path.join(clean, "journal.jsonl"), "wb") as f:
+            f.write(b"".join(frames))
+        rc_clean, out_clean = fsck(clean)
+
+        torn = os.path.join(root, "torn")
+        os.makedirs(torn)
+        with open(os.path.join(torn, "journal.jsonl"), "wb") as f:
+            f.write(b"".join(frames)[: -len(frames[-1]) // 2])  # tear the tail
+        rc_torn, out_torn = fsck(torn)
+
+        corrupt = os.path.join(root, "corrupt")
+        os.makedirs(corrupt)
+        blob = bytearray(b"".join(frames))
+        mid = len(blob) - len(frames[-1]) - len(frames[-2]) // 2  # inside record -2
+        blob[mid] ^= 0xFF
+        with open(os.path.join(corrupt, "journal.jsonl"), "wb") as f:
+            f.write(bytes(blob))
+        rc_corrupt, out_corrupt = fsck(corrupt)
+
+    ok = rc_clean == 0 and rc_torn == 0 and rc_corrupt == 1 \
+        and "tail_truncated=yes" in out_torn and "midlog_corrupt=yes" in out_corrupt
+    print(f"fsck-selftest: clean rc={rc_clean} | torn rc={rc_torn} "
+          f"({out_torn}) | corrupt rc={rc_corrupt} ({out_corrupt})")
+    print(f"fsck-selftest: {'OK' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     import argparse
     import pathlib
@@ -261,6 +465,10 @@ def main(argv=None) -> int:
     ap.add_argument("--build", action="store_true", help="(re)build the binaries first")
     ap.add_argument("--smoke", action="store_true",
                     help="run the 2-agent gang smoke test and exit")
+    ap.add_argument("--kill-master", action="store_true",
+                    help="run the master SIGKILL+restart gang re-adoption smoke")
+    ap.add_argument("--fsck-selftest", action="store_true",
+                    help="verify `dtpu-master --journal-fsck` on fabricated journals")
     ap.add_argument("--agents", type=int, default=2)
     ap.add_argument("--slots", type=int, default=1)
     ap.add_argument("--dir", default=None, help="state/checkpoint root (default: temp)")
@@ -271,6 +479,9 @@ def main(argv=None) -> int:
     if not binaries_built():
         print("error: native binaries missing and build failed", file=sys.stderr)
         return 2
+
+    if args.fsck_selftest:
+        return _fsck_selftest()
 
     if args.dir:
         root = pathlib.Path(args.dir)
@@ -286,6 +497,8 @@ def main(argv=None) -> int:
     try:
         if args.smoke:
             return _smoke(cluster)
+        if args.kill_master:
+            return _kill_master_smoke(cluster)
         print("Ctrl-C to tear down")
         while all(p.poll() is None for p in cluster.procs.values()):
             time.sleep(1)

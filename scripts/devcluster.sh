@@ -5,8 +5,11 @@
 # cheapest end-to-end proof that gang dispatch, the rendezvous env
 # contract (docs/cluster.md), log shipping, and exit plumbing all hold.
 #
-#   scripts/devcluster.sh            # build + smoke
-#   scripts/devcluster.sh --up       # build + leave a cluster running
+#   scripts/devcluster.sh                # build + smoke
+#   scripts/devcluster.sh --up           # build + leave a cluster running
+#   scripts/devcluster.sh --kill-master  # ASan build + SIGKILL/restart the
+#                                        # master mid-gang: the WAL replays
+#                                        # and the gang is re-adopted
 #
 # The pytest devcluster marker (tests/conftest.py) skips cleanly when the
 # binaries are absent; after this script they run:
@@ -18,6 +21,12 @@ cd "$REPO"
 MODE="--smoke"
 if [[ "${1:-}" == "--up" ]]; then
   MODE=""
+elif [[ "${1:-}" == "--kill-master" ]]; then
+  # durability smoke runs under the ASan/UBSan build so the crash-restart
+  # path (WAL replay, re-adoption bookkeeping) is memory-checked too
+  scripts/native_check.sh --sanitize
+  export DTPU_NATIVE_BUILD_DIR="$REPO/native/build-asan"
+  exec python scripts/devcluster.py --kill-master
 fi
 
 exec python scripts/devcluster.py --build ${MODE}

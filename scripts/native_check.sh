@@ -83,6 +83,24 @@ else
        "performance pass (syntax gate above still ran)"
 fi
 
+# -- 2.5 journal fsck self-test (when a master binary exists) ---------------
+# `dtpu-master --journal-fsck` is the offline WAL verifier operators run on
+# a state dir before/after an incident; the self-test fabricates a clean, a
+# torn-tail, and a mid-log-corrupt journal and pins the exit codes.  Needs
+# a built binary (this gate is compile-free), so it runs only when one is
+# already there — devcluster.sh / CI build first.
+if [ -x "${DTPU_NATIVE_BUILD_DIR:-native/build}/dtpu-master" ]; then
+  if python scripts/devcluster.py --fsck-selftest; then
+    echo "fsck ok: dtpu-master --journal-fsck"
+  else
+    echo "fsck FAIL" >&2
+    status=1
+  fi
+else
+  echo "note: no built dtpu-master; skipping the --journal-fsck self-test" \
+       "(scripts/devcluster.sh builds one)"
+fi
+
 # -- 3. sanitizer build (opt-in) --------------------------------------------
 if [ "$SANITIZE" = 1 ]; then
   ASAN_DIR="$REPO/native/build-asan"

@@ -148,6 +148,21 @@ def test_example_configs_parse():
         assert cfg.entrypoint, p
 
 
+def test_master_unreachable_grace_knob():
+    """The cluster driver's outage-tolerance window parses, defaults, and
+    rejects negatives (ISSUE 13: driver restart tolerance)."""
+    cfg = ExperimentConfig.parse({"name": "x"})
+    assert cfg.fault_tolerance.master_unreachable_grace_s == 120.0
+    cfg = ExperimentConfig.parse(
+        {"name": "x", "fault_tolerance": {"master_unreachable_grace_s": 7.5}}
+    )
+    assert cfg.fault_tolerance.master_unreachable_grace_s == 7.5
+    with pytest.raises(InvalidExperimentConfig):
+        ExperimentConfig.parse(
+            {"name": "x", "fault_tolerance": {"master_unreachable_grace_s": -1}}
+        )
+
+
 def test_config_version_gate():
     """v1 accepted (explicit or implicit); anything else fails loudly —
     both sides of the shared contract (master.cpp validate_config

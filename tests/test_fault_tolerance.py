@@ -536,6 +536,38 @@ def test_session_5xx_retries_only_idempotent(monkeypatch):
     assert len(calls) == Session.RETRIES
 
 
+def test_session_read_timeout_retries_idempotent(monkeypatch):
+    """A read timeout (master SIGKILLed mid-response) retries exactly like
+    a connection failure for idempotent requests — and stays single-attempt
+    for plain POSTs."""
+    import requests as rq
+
+    _no_sleep(monkeypatch)
+    s = Session("http://master")
+    calls = []
+
+    def timeout_then_ok(method, url, **kw):
+        calls.append(method)
+        if len(calls) == 1:
+            raise rq.ReadTimeout("master died mid-response")
+        return _Resp(200)
+
+    monkeypatch.setattr(s._http, "request", timeout_then_ok)
+    assert s.get("/x").status_code == 200
+    assert len(calls) == 2
+
+    calls.clear()
+
+    def always_timeout(method, url, **kw):
+        calls.append(method)
+        raise rq.ReadTimeout("still down")
+
+    monkeypatch.setattr(s._http, "request", always_timeout)
+    with pytest.raises(rq.ReadTimeout):
+        s.post("/x")
+    assert len(calls) == 1  # non-idempotent: never retried
+
+
 def test_session_429_honors_retry_after_for_any_method(monkeypatch):
     sleeps = _no_sleep(monkeypatch)
     s = Session("http://master")

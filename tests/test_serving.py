@@ -644,6 +644,166 @@ def test_sigterm_drain_exits_75(lm_checkpoint):
 
 
 # ---------------------------------------------------------------------------
+# master-outage hardening: the worker serves through a master kill+restart
+# ---------------------------------------------------------------------------
+
+
+class _FakeServeMaster:
+    """Just enough master for the replica contract: register (201),
+    heartbeat (200, or 404 for ids it does not know), delete.  ``kill()``
+    closes the listener (connection-refused, like a dead master);
+    ``restart()`` rebinds the SAME port with the registry EMPTY — exactly
+    what a real master restart looks like to a worker (replicas are
+    ephemeral by design; only the auth token survives the WAL replay)."""
+
+    def __init__(self):
+        self.registrations = []
+        self.known = set()
+        self.heartbeats = 0
+        self.lock = threading.Lock()
+        self.port = 0
+        self.server = None
+        self.thread = None
+        self._serve()
+
+    def _serve(self):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from urllib.parse import urlparse
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = _json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                path = urlparse(self.path).path
+                n = int(self.headers.get("Content-Length") or 0)
+                body = _json.loads(self.rfile.read(n) or b"{}") if n else {}
+                with fake.lock:
+                    if path == "/api/v1/auth/login":
+                        return self._json({"token": "t"})
+                    if path == "/api/v1/serving/replicas":
+                        rid = f"replica-{len(fake.registrations) + 1}"
+                        fake.registrations.append(dict(body))
+                        fake.known.add(rid)
+                        return self._json(
+                            {"id": rid, "heartbeat_ttl_ms": 15000}, 201
+                        )
+                    if path.endswith("/heartbeat"):
+                        rid = path.split("/")[5]
+                        if rid not in fake.known:
+                            return self._json({"error": "no such replica"}, 404)
+                        fake.heartbeats += 1
+                        return self._json({})
+                return self._json({"error": f"no fake route {path}"}, 404)
+
+            def do_DELETE(self):
+                return self._json({})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True, name="fake-serve-master"
+        )
+        self.thread.start()
+
+    def kill(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    def restart(self):
+        with self.lock:
+            self.known.clear()  # a restarted master forgot every replica
+        self._serve()
+
+    def close(self):
+        try:
+            self.kill()
+        except Exception:  # noqa: BLE001 - already down is fine
+            pass
+
+
+class _FastHeartbeatKernels:
+    """Shared-kernel shim with a fast heartbeat interval (no recompile)."""
+
+    def __init__(self, kernels, interval_s=0.1):
+        import dataclasses
+
+        self.serve_cfg = dataclasses.replace(
+            kernels.serve_cfg, heartbeat_interval_s=interval_s
+        )
+        self.model_cfg = kernels.model_cfg
+        self.prefill = kernels.prefill
+        self.decode = kernels.decode
+
+
+def test_worker_survives_master_kill_and_reregisters(kernels):
+    """Regression (ISSUE 13 satellite): kill and restart a fake master
+    under an active ServeWorker.  The heartbeat thread must survive the
+    outage (connection errors logged-and-retried, never crash), the worker
+    must keep serving generations throughout, and on the restarted master
+    the first heartbeat's 404 must trigger a re-registration."""
+    requests = pytest.importorskip("requests")
+    from determined_tpu.api.session import Session
+
+    fake = _FakeServeMaster()
+    worker = ServeWorker(
+        ServeEngine(_FastHeartbeatKernels(kernels)),
+        session=Session(fake.url, token="t"),
+        model="lm",
+    )
+    url = worker.start()
+    try:
+        assert worker.replica is not None
+        assert len(fake.registrations) == 1
+        deadline = time.time() + 10
+        while fake.heartbeats == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert fake.heartbeats > 0, "heartbeat never arrived"
+
+        fake.kill()
+        time.sleep(0.5)  # several heartbeat intervals of dead master
+        # the worker keeps serving through the control-plane outage
+        r = requests.post(
+            url + "/v1/generate",
+            json={"prompt_tokens": [1, 2, 3], "max_new_tokens": 2, "seed": 0},
+            timeout=30,
+        )
+        assert r.status_code == 200, r.text
+        hb_thread = worker.replica._thread
+        assert hb_thread is not None and hb_thread.is_alive(), (
+            "heartbeat thread died during the master outage"
+        )
+
+        fake.restart()
+        deadline = time.time() + 10
+        while len(fake.registrations) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(fake.registrations) >= 2, (
+            "worker never re-registered after the master restart"
+        )
+        hb_before = fake.heartbeats
+        deadline = time.time() + 10
+        while fake.heartbeats == hb_before and time.time() < deadline:
+            time.sleep(0.05)
+        assert fake.heartbeats > hb_before, "heartbeats did not resume"
+    finally:
+        worker.shutdown(deregister=False)
+        fake.close()
+
+
+# ---------------------------------------------------------------------------
 # devcluster e2e: registration, serving under load, heartbeat-loss pruning
 # ---------------------------------------------------------------------------
 
