@@ -64,11 +64,16 @@ def main() -> None:
     # static batch over one shared kernel set, static as the baseline
     _bench_hook("DTPU_BENCH_SERVE", "bench_serve.py")
     # step-program optimizations (docs/performance.md): overlapped
-    # gradient sync and quantized matmul A/Bs — baseline reduction /
-    # bf16 arithmetic as the respective baselines; on CPU these prove
-    # structure + numerics, the TPU MFU rows land next chip round
+    # gradient sync, quantized matmul, and pipeline-schedule A/Bs —
+    # baseline reduction / bf16 arithmetic / gpipe as the respective
+    # baselines; on CPU these prove structure + numerics, the TPU MFU
+    # rows land next chip round
     _bench_hook("DTPU_BENCH_OVERLAP", "bench_step.py")
     _bench_hook("DTPU_BENCH_QUANT", "bench_step.py")
+    # pipeline bubble: gpipe vs 1f1b vs circular-interleaved on the
+    # pipe4 x data2 virtual mesh (tick model, 1f1b live-activation cap,
+    # loss parity) — docs/performance.md "Pipeline schedules"
+    _bench_hook("DTPU_BENCH_PIPE", "bench_step.py")
 
     import os
 

@@ -944,13 +944,45 @@ def lint_cmd(args) -> int:
     """Static preflight analysis of trial code — no master required.
 
     Targets are .py files, directories (recursive), or
-    ``pkg.module:TrialClass`` entrypoints.  Exit status: 0 clean, 1 on
-    error-severity findings (any finding with ``--strict``), 2 on usage /
+    ``pkg.module:TrialClass`` entrypoints.  ``--config`` additionally
+    preflights an experiment YAML: parse-time validation plus the
+    cross-field pipeline checks (schedule vs mesh pipe axis, n_layers
+    divisibility into pipe x virtual_stages chunks, batch vs
+    pipe_microbatches) that otherwise surface at trainer setup or the
+    first step.  Exit status: 0 clean, 1 on error-severity findings (any
+    finding with ``--strict``) or config problems, 2 on usage /
     unloadable target.
     """
     from determined_tpu import lint as lint_mod
 
     sys.path.insert(0, os.getcwd())
+    if not args.target and not args.config:
+        print("error: nothing to lint (pass targets and/or --config)", file=sys.stderr)
+        return 2
+    config_problems = []
+    for cfg_path in args.config or []:
+        import yaml
+
+        from determined_tpu.config.experiment import (
+            ExperimentConfig,
+            InvalidExperimentConfig,
+            preflight_experiment_config,
+        )
+
+        try:
+            with open(cfg_path, encoding="utf-8") as f:
+                raw = yaml.safe_load(f) or {}
+        except (OSError, yaml.YAMLError) as e:
+            print(f"error: cannot read config {cfg_path}: {e}", file=sys.stderr)
+            return 2
+        try:
+            cfg = ExperimentConfig.parse(raw)
+        except InvalidExperimentConfig as e:
+            config_problems.append(f"{cfg_path}: {e}")
+            continue
+        config_problems.extend(
+            f"{cfg_path}: {p}" for p in preflight_experiment_config(cfg)
+        )
     diags = []
     # path targets lint together as ONE program: the concurrency pass
     # builds a single cross-module lock graph spanning every target, so a
@@ -989,21 +1021,28 @@ def lint_cmd(args) -> int:
                   file=sys.stderr)
             return 2
     if args.json:
-        _print_json(lint_mod.to_json_payload(diags))
+        payload = lint_mod.to_json_payload(diags)
+        if args.config:
+            payload["config_findings"] = config_problems
+        _print_json(payload)
     else:
+        for p in config_problems:
+            print(f"config error: {p}")
         for d in diags:
             print(d.format())
-        errors = sum(1 for d in diags if d.severity == lint_mod.ERROR)
-        warnings = len(diags) - errors
+        lint_errors = sum(1 for d in diags if d.severity == lint_mod.ERROR)
+        errors = lint_errors + len(config_problems)
+        warnings = len(diags) - lint_errors
+        total = len(diags) + len(config_problems)
         print(
-            f"{len(diags)} finding(s): {errors} error(s), {warnings} warning(s)"
-            if diags
+            f"{total} finding(s): {errors} error(s), {warnings} warning(s)"
+            if total
             else "clean: no findings"
         )
     failing = [
         d for d in diags if d.severity == lint_mod.ERROR or args.strict
     ]
-    return 1 if failing else 0
+    return 1 if failing or config_problems else 0
 
 
 # ---- search preview + local run -------------------------------------------
@@ -1432,8 +1471,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ln.add_argument(
         "target",
-        nargs="+",
+        nargs="*",
         help=".py file, directory, or pkg.module:TrialClass entrypoint",
+    )
+    ln.add_argument(
+        "--config", action="append", metavar="YAML",
+        help="experiment config to preflight (repeatable): parse "
+             "validation + cross-field pipeline-schedule checks "
+             "(n_layers vs pipe x virtual_stages, batch vs "
+             "pipe_microbatches) before any device work",
     )
     ln.add_argument("--json", action="store_true", help="machine-readable output")
     ln.add_argument(

@@ -31,6 +31,10 @@ class InvalidExperimentConfig(ValueError):
 #: ``train/_quant.py`` (which imports from here; no cycle)
 QUANT_MODES = ("none", "int8", "fp8")
 
+#: pipeline microbatch schedules — shared with ``parallel/pipeline.py``
+#: (which imports from here; no cycle)
+PIPELINE_SCHEDULES = ("gpipe", "1f1b", "interleaved")
+
 
 _LENGTH_UNITS = ("batches", "epochs", "records")
 
@@ -315,6 +319,17 @@ class OptimizationsConfig:
     # runs in full precision (straight-through).  fp8 on an unsupported
     # platform is rejected at trainer setup with InvalidExperimentConfig.
     quantized_matmul: str = "none"
+    # Pipeline microbatch schedule on the ``pipe`` mesh axis
+    # (parallel/pipeline.py, docs/performance.md "Pipeline schedules"):
+    # ``gpipe`` is the plain M+P-1 drain; ``1f1b`` keeps the same bubble
+    # but caps live activations at P microbatches instead of M (custom
+    # combined fwd/bwd schedule — the memory headroom that buys larger M);
+    # ``interleaved`` gives each pipe rank ``virtual_stages`` non-adjacent
+    # layer chunks via a circular rotation, shrinking the bubble fraction
+    # from (P-1)/(M+P-1) toward (P-1)/(V*M+P-1).  Inert when the mesh has
+    # no pipe axis (except interleaved, which requires one).
+    pipeline_schedule: str = "gpipe"
+    virtual_stages: int = 1
 
     _QUANT_MODES = QUANT_MODES
 
@@ -334,6 +349,28 @@ class OptimizationsConfig:
             raise InvalidExperimentConfig(
                 f"optimizations.quantized_matmul {self.quantized_matmul!r} "
                 f"not in {self._QUANT_MODES}"
+            )
+        if self.pipeline_schedule not in PIPELINE_SCHEDULES:
+            raise InvalidExperimentConfig(
+                f"optimizations.pipeline_schedule {self.pipeline_schedule!r} "
+                f"not in {PIPELINE_SCHEDULES}"
+            )
+        if self.virtual_stages < 1:
+            raise InvalidExperimentConfig(
+                f"optimizations.virtual_stages must be >= 1 "
+                f"(got {self.virtual_stages})"
+            )
+        if self.pipeline_schedule == "interleaved" and self.virtual_stages < 2:
+            raise InvalidExperimentConfig(
+                "optimizations.pipeline_schedule: interleaved needs "
+                f"virtual_stages >= 2 (got {self.virtual_stages}); with one "
+                "virtual stage it IS gpipe"
+            )
+        if self.pipeline_schedule != "interleaved" and self.virtual_stages != 1:
+            raise InvalidExperimentConfig(
+                f"optimizations.virtual_stages={self.virtual_stages} only "
+                "applies to pipeline_schedule: interleaved "
+                f"(got {self.pipeline_schedule!r})"
             )
 
     @classmethod
@@ -701,6 +738,51 @@ class ExperimentConfig:
         (what a trial sees after the searcher samples)."""
         const = parse_hyperparameters(hparams)
         return dataclasses.replace(self, hyperparameters=const)
+
+
+def preflight_experiment_config(cfg: "ExperimentConfig") -> List[str]:
+    """Cross-field preflight checks surfaced by ``dtpu lint --config`` —
+    the class of mistake single-field ``__post_init__`` validation cannot
+    see (a knob valid on its own but wrong against the mesh or the
+    hyperparameters) and that otherwise raises at trainer setup or, worse,
+    at the first step.  Returns human-readable problem strings; empty
+    means clean.  Only concrete (Const/int) hyperparameters participate —
+    a searched hparam cannot be checked until the searcher samples it.
+    """
+    problems: List[str] = []
+    opt = cfg.optimizations
+    mesh = cfg.resources.mesh
+    pipe = getattr(mesh, "pipe", 1)
+
+    def hp_int(name: str) -> Optional[int]:
+        v = cfg.hyperparameters.get(name)
+        v = getattr(v, "val", v)
+        return v if isinstance(v, int) and not isinstance(v, bool) else None
+
+    if opt.pipeline_schedule == "interleaved" and 0 <= pipe <= 1:
+        problems.append(
+            "optimizations.pipeline_schedule: interleaved needs a "
+            f"resources.mesh pipe axis > 1 (mesh pipe={pipe})"
+        )
+    if pipe > 1:
+        chunks = pipe * opt.virtual_stages
+        n_layers = hp_int("n_layers")
+        if n_layers is not None and n_layers % chunks:
+            problems.append(
+                f"hyperparameters.n_layers={n_layers} does not divide into "
+                f"{chunks} pipeline chunks (pipe={pipe} x "
+                f"virtual_stages={opt.virtual_stages}) for "
+                f"pipeline_schedule {opt.pipeline_schedule!r}"
+            )
+        gbs = hp_int("global_batch_size")
+        m = hp_int("pipe_microbatches")
+        if gbs is not None and m is not None and m > 0 and gbs % m:
+            problems.append(
+                f"hyperparameters.global_batch_size={gbs} not divisible by "
+                f"pipe_microbatches={m}: the pipeline schedule would reject "
+                "it at the first step"
+            )
+    return problems
 
 
 def merge_configs(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:

@@ -332,43 +332,72 @@ class TransformerLM(nn.Module):
         return (out, aux_total) if return_aux else out
 
 
-def split_pipeline_params(boxed_params: Any, n_stages: int) -> Dict[str, Any]:
+def split_pipeline_params(
+    boxed_params: Any, n_stages: int, virtual_stages: int = 1
+) -> Dict[str, Any]:
     """Restructure a plain ``TransformerLM`` param tree for pipeline stages.
 
     Input: the tree from ``TransformerLM.init`` (possibly flax-``Partitioned``
     boxed).  Output: ``{"outer": <embed/ln_f/lm_head, boxes kept>, "blocks":
-    {"layer_j": <layer j of every stage stacked on a leading [P, ...] dim>}}``
-    for j in [0, layers_per_stage) — the per-layer dict (instead of an extra
-    stacked lps dim) lets DENSE and MOE layers coexist in one stage: layer j
-    must have the same param structure across stages (requiring the MoE
-    period to divide layers-per-stage), but different j's may differ.
+    {"layer_j": <layer j of every chunk stacked on a leading [P, ...] dim>}}``
+    for j in [0, layers_per_chunk) — the per-layer dict (instead of an extra
+    stacked lps dim) lets DENSE and MOE layers coexist in one chunk: layer j
+    must have the same param structure across chunks (requiring the MoE
+    period to divide layers-per-chunk), but different j's may differ.
+
+    ``virtual_stages`` > 1 (the circular-interleaved schedule) splits the
+    stack into P*V chunks and stacks leaves as ``[P, V, ...]`` —
+    ``[p, v]`` holds chunk ``v*P + p``, i.e. pipe rank p's V NON-adjacent
+    layer blocks (``parallel/pipeline.py`` ``stack_chunk_params`` layout).
+
     Because the stacked leaves are built from the SAME initialized values as
     the flat ``block_i`` subtrees, a pipe>1 trial initializes identically to
     pipe=1 — the basis of the loss-parity tests.
     """
     from flax.core import meta as flax_meta
 
+    from determined_tpu.config.experiment import InvalidExperimentConfig
+
     tree = dict(boxed_params["params"])
     block_keys = sorted(
         (k for k in tree if k.startswith("block_")), key=lambda k: int(k.split("_")[1])
     )
     n_layers = len(block_keys)
-    if n_layers == 0 or n_layers % n_stages:
-        raise ValueError(
-            f"n_layers={n_layers} not divisible into {n_stages} pipeline stages"
+    chunks_total = n_stages * virtual_stages
+    if n_layers == 0 or n_layers % chunks_total:
+        raise InvalidExperimentConfig(
+            f"n_layers={n_layers} not divisible into {chunks_total} pipeline "
+            f"chunks (pipe={n_stages} x virtual_stages={virtual_stages})"
         )
-    lps = n_layers // n_stages
+    lpc = n_layers // chunks_total
     blocks = [flax_meta.unbox(tree.pop(k)) for k in block_keys]
     stacked = {}
-    for j in range(lps):
-        layer_j = [blocks[s * lps + j] for s in range(n_stages)]
+    for j in range(lpc):
+        # chunk c covers layers [c*lpc, (c+1)*lpc); chunk order is the
+        # order the microbatch traverses them
+        layer_j = [blocks[c * lpc + j] for c in range(chunks_total)]
         structures = {jax.tree.structure(t) for t in layer_j}
         if len(structures) > 1:
-            raise ValueError(
-                f"layer {j} differs in structure across pipeline stages "
-                "(is the MoE period a divisor of layers-per-stage?)"
+            raise InvalidExperimentConfig(
+                f"layer {j} differs in structure across pipeline chunks "
+                "(is the MoE period a divisor of layers-per-chunk?)"
             )
-        stacked[f"layer_{j}"] = jax.tree.map(lambda *ls: jnp.stack(ls), *layer_j)
+        if virtual_stages == 1:
+            stacked[f"layer_{j}"] = jax.tree.map(
+                lambda *ls: jnp.stack(ls), *layer_j
+            )
+        else:
+            stacked[f"layer_{j}"] = jax.tree.map(
+                lambda *ls: jnp.stack(
+                    [
+                        jnp.stack(
+                            [ls[v * n_stages + p] for v in range(virtual_stages)]
+                        )
+                        for p in range(n_stages)
+                    ]
+                ),
+                *layer_j,
+            )
     outer = {"params": tree}
     extra = {k: v for k, v in boxed_params.items() if k != "params"}
     if extra:
@@ -385,19 +414,23 @@ def pipeline_forward(
     return_hidden: bool = False,
     rules: Any = None,
     return_aux: bool = False,
+    schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> Any:
     """Forward pass with the transformer blocks pipelined over ``pipe``.
 
     ``params`` is the ``split_pipeline_params`` layout.  Embed / final norm /
     lm_head run as ordinary SPMD computation outside the pipeline (sharded by
-    their logical annotations); only the block stack rides the GPipe schedule
-    (``parallel/pipeline.py``).  Stage block params are sharded over ``pipe``
-    (expert weights additionally over ``expert``) inside the schedule's
-    ``shard_map``; the batch stays sharded over data/fsdp and the sequence
-    over ``seq`` — ring attention runs inside each stage over the seq axis,
-    and MoE combine psums over the expert axis intra-stage.  (FSDP sharding
-    of block *params* does not compose yet.)  The reference's DeepSpeed grid
-    composes PP only with DP/TP (``deepspeed/_mpu.py:9-50``).
+    their logical annotations); only the block stack rides the microbatch
+    schedule (``parallel/pipeline.py`` — gpipe, 1f1b, or circular
+    interleaved per ``schedule``/``virtual_stages``).  Stage block params
+    are sharded over ``pipe`` (expert weights additionally over ``expert``)
+    inside the schedule's ``shard_map``; the batch stays sharded over
+    data/fsdp and the sequence over ``seq`` — ring attention runs inside
+    each stage over the seq axis, and MoE combine psums over the expert
+    axis intra-stage.  (FSDP sharding of block *params* does not compose
+    yet.)  The reference's DeepSpeed grid composes PP only with DP/TP
+    (``deepspeed/_mpu.py:9-50``).
     """
     from flax.core import meta as flax_meta
 
@@ -451,7 +484,8 @@ def pipeline_forward(
         return (h, aux) if want_aux else h
 
     out = pipeline_apply(
-        stage_fn, blocks, x, mesh, num_microbatches, with_aux=want_aux
+        stage_fn, blocks, x, mesh, num_microbatches, with_aux=want_aux,
+        schedule=schedule, virtual_stages=virtual_stages,
     )
     x, aux = out if want_aux else (out, jnp.zeros((), jnp.float32))
     x = RMSNorm(partition=False).apply({"params": outer["ln_f"]}, x)
@@ -699,6 +733,43 @@ class LMTrial(JaxTrial):
             m -= 1
         return m
 
+    def _pipe_schedule(self) -> Tuple[str, int]:
+        """(schedule, virtual_stages) resolution: trial hparam override
+        wins, else the experiment's ``optimizations`` knobs, else gpipe —
+        the same precedence as ``_quant_mode``."""
+        g = self.context.get_hparam
+        opt = (
+            self.context.exp_config.optimizations
+            if self.context.exp_config is not None
+            else None
+        )
+        name = g("pipeline_schedule", None)
+        if name is None:
+            name = opt.pipeline_schedule if opt is not None else "gpipe"
+        v = g("virtual_stages", None)
+        if v is None:
+            v = opt.virtual_stages if opt is not None else 1
+        return str(name), int(v)
+
+    def pipeline_schedule_spec(self):
+        """The trial's ``PipelineSchedule`` (None without a pipe axis) —
+        the Trainer reads this for the jit-cache key and the goodput
+        ledger's ``step.bubble`` analytic tick model."""
+        pipe = self._pipe_stages()
+        if pipe <= 1:
+            return None
+        from determined_tpu.parallel.pipeline import PipelineSchedule
+
+        name, v = self._pipe_schedule()
+        return PipelineSchedule(
+            name=name,
+            n_stages=pipe,
+            num_microbatches=self._pipe_microbatches(
+                self.context.get_global_batch_size()
+            ),
+            virtual_stages=v,
+        )
+
     def _quant_mode(self) -> str:
         """quantized_matmul resolution: trial hparam override wins, else
         the experiment's ``optimizations.quantized_matmul`` knob, else
@@ -718,13 +789,14 @@ class LMTrial(JaxTrial):
         g = self.context.get_hparam
         pipe = self._pipe_stages()
         if pipe > 1 and int(g("moe_experts", 0)) > 0:
-            # MoE composes with pipe when every stage sees the same layer
-            # pattern: the MoE period must divide layers-per-stage
-            lps = int(g("n_layers", 2)) // pipe
+            # MoE composes with pipe when every chunk sees the same layer
+            # pattern: the MoE period must divide layers-per-chunk
+            _, vstages = self._pipe_schedule()
+            lps = int(g("n_layers", 2)) // (pipe * vstages)
             if lps == 0 or lps % int(g("moe_every", 2)):
                 raise ValueError(
                     f"pipe={pipe} with MoE needs moe_every ({g('moe_every', 2)}) "
-                    f"to divide layers-per-stage ({lps})"
+                    f"to divide layers-per-chunk ({lps})"
                 )
         return TransformerConfig(
             vocab_size=int(g("vocab_size", 2048)),
@@ -836,7 +908,8 @@ class LMTrial(JaxTrial):
         # whole ~1.5% pipe-parity drift ROADMAP tracked.
         pipe = self._pipe_stages()
         if pipe > 1:
-            return split_pipeline_params(params, pipe)
+            _, vstages = self._pipe_schedule()
+            return split_pipeline_params(params, pipe, vstages)
         return params
 
     def param_logical_specs(self, params: Any) -> Any:
@@ -851,10 +924,15 @@ class LMTrial(JaxTrial):
             outer = jax.tree.map(lambda _: None, flax_meta.unbox(params["outer"]))
         from determined_tpu.parallel.pipeline import _path_has_expert_leaf
 
+        _, vstages = self._pipe_schedule()
+        # interleaved leaves lead [stage, virtual, ...]; the virtual-stage
+        # dim stays unsharded (each rank owns all V of its chunks)
+        head = ("stage", None) if vstages > 1 else ("stage",)
+
         def block_spec(path, a):
             if _path_has_expert_leaf(path):
-                return ("stage", "expert") + (None,) * (a.ndim - 2)
-            return ("stage",) + (None,) * (a.ndim - 1)
+                return head + ("expert",) + (None,) * (a.ndim - len(head) - 1)
+            return head + (None,) * (a.ndim - len(head))
 
         blocks = jax.tree_util.tree_map_with_path(block_spec, params["blocks"])
         return {"outer": outer, "blocks": blocks}
@@ -907,9 +985,11 @@ class LMTrial(JaxTrial):
         targets: jax.Array,
         fused: bool,
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-        """Loss through the GPipe schedule (mesh has a pipe axis > 1)."""
+        """Loss through the configured microbatch schedule (mesh has a
+        pipe axis > 1)."""
         g = self.context.get_hparam
         mb = self._pipe_microbatches(inputs.shape[0])
+        sched, vstages = self._pipe_schedule()
         if fused:
             from flax.core import meta as flax_meta
 
@@ -918,6 +998,7 @@ class LMTrial(JaxTrial):
             hidden, moe_aux = pipeline_forward(
                 model.cfg, self.context.mesh, params, inputs, mb,
                 return_hidden=True, rules=self.context.rules, return_aux=True,
+                schedule=sched, virtual_stages=vstages,
             )
             kernel = flax_meta.unbox(params["outer"]["params"]["lm_head"]["kernel"])
             chunk = g("ce_chunk", None)
@@ -935,6 +1016,7 @@ class LMTrial(JaxTrial):
             logits, moe_aux = pipeline_forward(
                 model.cfg, self.context.mesh, params, inputs, mb,
                 rules=self.context.rules, return_aux=True,
+                schedule=sched, virtual_stages=vstages,
             )
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
         metrics = {"perplexity": jnp.exp(loss)}

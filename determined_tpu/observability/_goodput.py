@@ -53,6 +53,18 @@ bandwidth table; labeled a model — the xplane op table stays the ground
 truth on real chips).  ``dtpu experiment profile`` prints it as the
 "exposed comm" line so an overlap win is visible in the profile, not
 just the bench.
+
+``step.bubble`` rows: pipe-axis idle time inside the productive ``step``
+bucket, from the pipeline schedule's analytic tick model
+(``parallel/pipeline.py`` ``BubbleModel`` — (P-1)/(M+P-1) for
+gpipe/1f1b, (P-1)/(V*M+P-1) for interleaved).  Same counter mechanism as
+``step.comm``: the Trainer reports ``step.bubble.exposed_us`` per report
+segment plus static ``step.bubble.{fraction,ticks_total,ticks_idle}``
+gauges; ``dtpu experiment profile`` prints the "exposed bubble" line so
+a schedule win (interleaved, or 1f1b's memory headroom spent on larger
+M) is visible per trial.  Labeled a model — it applies the schedule's
+idle fraction to the whole measured step, an upper bound since
+embed/head/optimizer time sits outside the schedule.
 """
 
 from __future__ import annotations
@@ -193,6 +205,28 @@ def _comm_entry(
     return entry
 
 
+def _bubble_entry(
+    counters: Dict[str, float], step_us: float
+) -> Optional[Dict[str, Any]]:
+    """Fold step.bubble.* counters into an exposed-bubble record (None
+    when no pipeline schedule rode the trace)."""
+    exposed_us = counters.get("step.bubble.exposed_us")
+    if exposed_us is None:
+        return None
+    entry: Dict[str, Any] = {
+        "exposed_s": round(exposed_us / 1e6, 6),
+        "pct_of_step": round(100.0 * exposed_us / max(step_us, 1e-9), 2),
+        "model": "pipeline-tick-v1",
+    }
+    if "step.bubble.fraction" in counters:
+        entry["fraction_modeled"] = round(counters["step.bubble.fraction"], 4)
+    if "step.bubble.ticks_total" in counters:
+        entry["ticks_total"] = int(counters["step.bubble.ticks_total"])
+    if "step.bubble.ticks_idle" in counters:
+        entry["ticks_idle"] = int(counters["step.bubble.ticks_idle"])
+    return entry
+
+
 def _breakdown(cat_us: Dict[str, float], denom_us: float) -> Dict[str, Dict[str, float]]:
     denom = max(denom_us, 1e-9)
     return {
@@ -325,6 +359,9 @@ def compute_ledger(
         comm = _comm_entry(tc, cats.get("step", 0.0))
         if comm is not None:
             entry["step.comm"] = comm
+        bubble = _bubble_entry(tc, cats.get("step", 0.0))
+        if bubble is not None:
+            entry["step.bubble"] = bubble
         trials[rid] = entry
         total_trial_us += wall
         total_attr_us += attributed
@@ -347,6 +384,9 @@ def compute_ledger(
     exp_comm = _comm_entry(counters, agg_cat_us.get("step", 0.0))
     if exp_comm is not None:
         experiment["step.comm"] = exp_comm
+    exp_bubble = _bubble_entry(counters, agg_cat_us.get("step", 0.0))
+    if exp_bubble is not None:
+        experiment["step.bubble"] = exp_bubble
     tokens_total = sum(t.get("tokens", 0) for t in trials.values())
     if tokens_total and total_trial_us > 0:
         experiment["tokens_per_s"] = round(tokens_total / (total_trial_us / 1e6), 2)
@@ -405,6 +445,24 @@ def _comm_line(c: Dict[str, Any]) -> str:
     )
 
 
+def _bubble_line(b: Dict[str, Any]) -> str:
+    """The "exposed bubble" profile line (docs/performance.md): how much
+    of the step the pipeline schedule's analytic tick model attributes to
+    pipe-axis idle time — the number the 1f1b/interleaved schedules exist
+    to shrink."""
+    frac = b.get("fraction_modeled")
+    ticks = (
+        f"; {b['ticks_idle']}/{b['ticks_total']} ticks idle"
+        if "ticks_total" in b and "ticks_idle" in b
+        else ""
+    )
+    detail = f" (modeled {100.0 * frac:.1f}%{ticks})" if frac is not None else ""
+    return (
+        f"  exposed bubble {b['exposed_s']:>8.3f}s "
+        f"({b['pct_of_step']:.1f}% of step){detail} [{b['model']}]"
+    )
+
+
 def format_ledger_text(ledger: Dict[str, Any]) -> str:
     """Human-readable ledger (the ``dtpu experiment profile`` text view)."""
     exp = ledger["experiment"]
@@ -422,6 +480,8 @@ def format_ledger_text(ledger: Dict[str, Any]) -> str:
         lines.append(f"  {cat:<12} {row['seconds']:>10.3f}s  {row['pct']:>6.2f}%")
     if "step.comm" in exp:
         lines.append(_comm_line(exp["step.comm"]))
+    if "step.bubble" in exp:
+        lines.append(_bubble_line(exp["step.bubble"]))
     for rid, t in ledger["trials"].items():
         lines.append("")
         head = (
@@ -444,6 +504,8 @@ def format_ledger_text(ledger: Dict[str, Any]) -> str:
             lines.append(f"  {cat:<12} {row['seconds']:>10.3f}s  {row['pct']:>6.2f}%")
         if "step.comm" in t:
             lines.append(_comm_line(t["step.comm"]))
+        if "step.bubble" in t:
+            lines.append(_bubble_line(t["step.bubble"]))
     if ledger.get("dropped_events"):
         lines.append("")
         lines.append(

@@ -192,6 +192,7 @@ def step_cache_key(
     rules: Optional[Dict[str, Any]] = None,
     overlap: str = "overlap:none",
     quant: str = "none",
+    pipeline: str = "pipe:none",
 ) -> str:
     """Hash of everything that shapes the traced train/eval step.
 
@@ -212,11 +213,13 @@ def step_cache_key(
         "rules": {str(k): _canonical(v) for k, v in (rules or {}).items()},
         "agg": int(agg),
         "average_grads": bool(average_grads),
-        # step-program knobs (ISSUE 12): the overlapped-grad-sync bucket
-        # structure and the quantized-matmul mode both change the traced
-        # program without touching hparams or batch avals
+        # step-program knobs (ISSUE 12/14): the overlapped-grad-sync
+        # bucket structure, the quantized-matmul mode, and the pipeline
+        # microbatch schedule (name/P/M/virtual stages) all change the
+        # traced program without touching hparams or batch avals
         "overlap": str(overlap),
         "quant": str(quant),
+        "pipeline": str(pipeline),
         "batch": sorted(
             (k, tuple(int(d) for d in v.shape), str(v.dtype))
             for k, v in sample_batch.items()

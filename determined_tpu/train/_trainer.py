@@ -198,6 +198,8 @@ class Trainer:
         self._tokens_per_sample: Optional[int] = None  # set by _setup
         self._overlap_plan: Any = None  # train/_overlap.py GradSyncPlan
         self._comm_model: Any = None    # its CommModel (step.comm ledger rows)
+        self._bubble_model: Any = None  # parallel/pipeline.py BubbleModel
+        #                                 (step.bubble ledger rows)
         # Newest FINALIZED checkpoint (manifest written, master reported).
         # An async save still in flight is deliberately excluded: until its
         # drain-point finalize runs it has no manifest and must never be
@@ -326,6 +328,19 @@ class Trainer:
             self._overlap_plan.comm if self._overlap_plan is not None else None
         )
         sync_on = self._overlap_plan is not None and self._overlap_plan.enabled
+
+        # ---- pipeline schedule selection (parallel/pipeline.py) ----------
+        # The trial declares the microbatch schedule it traces (gpipe /
+        # 1f1b / interleaved); the Trainer folds it into the jit-cache key
+        # below and into the goodput ledger's step.bubble rows — the
+        # analytic tick model that attributes pipe-axis idle time the way
+        # the CommModel attributes gradient-collective exposure.
+        spec_fn = getattr(self.trial, "pipeline_schedule_spec", None)
+        pipe_sched = spec_fn() if spec_fn is not None else None
+        if pipe_sched is not None:
+            from determined_tpu.parallel.pipeline import BubbleModel
+
+            self._bubble_model = BubbleModel(schedule=pipe_sched)
 
         if opt is not None and opt.quantized_matmul != "none":
             # fail fast with a clear config error on unsupported platforms
@@ -506,6 +521,14 @@ class Trainer:
                     else "overlap:none"
                 ),
                 quant=opt.quantized_matmul if opt else "none",
+                # the microbatch schedule + virtual-stage count reshape
+                # the traced program (trip counts, param layout, custom
+                # backward): toggling must never serve a stale trace
+                pipeline=(
+                    pipe_sched.fingerprint()
+                    if pipe_sched is not None
+                    else "pipe:none"
+                ),
             )
             cache = _jit_cache.get_step_cache()
             entry = cache.lookup(key)
@@ -565,6 +588,20 @@ class Trainer:
             fpt = getattr(trial, "flops_per_token", None)
             if fpt:
                 tracer.gauge("train.flops_per_token", float(fpt))
+            if self._bubble_model is not None:
+                # static schedule facts for the ledger: the modeled idle
+                # fraction and the tick counts behind it
+                tracer.gauge(
+                    "step.bubble.fraction", float(self._bubble_model.fraction)
+                )
+                tracer.gauge(
+                    "step.bubble.ticks_total",
+                    float(self._bubble_model.schedule.total_ticks),
+                )
+                tracer.gauge(
+                    "step.bubble.ticks_idle",
+                    float(self._bubble_model.schedule.bubble_ticks),
+                )
 
     def _place_on_mesh(self, tree: Any) -> Any:
         """Replicate any leaf not already sharded over THIS mesh.
@@ -1196,6 +1233,19 @@ class Trainer:
                         )
                         tracer.counter("step.comm.exposed_us", exposed_s * 1e6 * n)
                         tracer.counter("step.comm.hidden_us", hidden_s * 1e6 * n)
+                    if self._bubble_model is not None:
+                        # step.bubble ledger rows: pipe-axis idle time per
+                        # the schedule's analytic tick model applied to
+                        # the segment's average step time (counters, like
+                        # step.comm, so span-nesting attribution stays
+                        # intact)
+                        bubble_s, _ = self._bubble_model.split(
+                            hot_time / steps_since_report
+                        )
+                        tracer.counter(
+                            "step.bubble.exposed_us",
+                            bubble_s * 1e6 * float(steps_since_report),
+                        )
                 self.state = self.state.reset_metrics()
                 metrics["samples_per_second"] = steps_since_report * gbs / max(hot_time, 1e-9)
                 hot_time = 0.0

@@ -242,3 +242,18 @@ class JaxTrial(abc.ABC):
         (largest divisible dim) when the mesh has an fsdp axis.
         """
         return None
+
+    def pipeline_schedule_spec(self) -> Optional[Any]:
+        """The trial's pipeline microbatch schedule, as a
+        ``parallel/pipeline.py`` ``PipelineSchedule`` — or None when the
+        trial does not pipeline (no pipe mesh axis, or a model that does
+        not ride ``pipeline_apply``).
+
+        A trial that pipelines should return the schedule it actually
+        traces: the Trainer folds it into the jit-reuse cache key (the
+        schedule and virtual-stage count reshape the traced program, so
+        toggling must never serve a stale trace) and into the goodput
+        ledger's ``step.bubble`` rows via the schedule's analytic tick
+        model.  Default: no pipeline.
+        """
+        return None

@@ -1,6 +1,7 @@
-"""Step-program optimization microbench: overlap + quantized matmul A/B.
+"""Step-program optimization microbench: overlap / quantized matmul /
+pipeline-schedule A/Bs.
 
-The two ``optimizations:`` knobs from the 0.70-MFU plateau attack
+The structural step-time knobs from the 0.70-MFU plateau attack
 (docs/performance.md) each get a like-for-like A/B on the same machine,
 emitting ONE ``bench.py``-shaped JSON row per requested mode:
 
@@ -15,15 +16,22 @@ emitting ONE ``bench.py``-shaped JSON row per requested mode:
   (and fp8 where supported/emulated): same seed, same data, N steps; the
   row carries both loss curves' max relative deviation against the
   stated tolerance plus tokens/s for both arms.
+- ``DTPU_BENCH_PIPE=1`` — gpipe vs 1f1b vs interleaved (V=2) at fixed
+  global batch on the pipe4 x data2 virtual mesh: per schedule the row
+  carries the analytic tick count, the modeled bubble %, the measured
+  wall-clock step time, the compiled program's max live-activation
+  (temp) bytes, and the loss deviation vs the gpipe arm.
 
-On CPU the A/B runs on the virtual 8-device mesh (data2 x fsdp4) and
-proves STRUCTURE + NUMERICS (collective layout, sharded opt state, loss
-parity); the TPU MFU row is marked "next chip round" — wall-clock wins
-need real async collectives and an MXU.
+On CPU the A/Bs run on the virtual 8-device mesh and prove STRUCTURE +
+NUMERICS (collective layout, sharded opt state, loss parity, the 1f1b
+memory cap, the interleaved tick model); the TPU MFU row is marked
+"next chip round" — wall-clock wins need real async collectives and an
+MXU.
 
     DTPU_BENCH_OVERLAP=1 python bench.py
-    DTPU_BENCH_QUANT=1 python bench.py
-    JAX_PLATFORMS=cpu python scripts/bench_step.py overlap quant
+    DTPU_BENCH_QUANT=1   python bench.py
+    DTPU_BENCH_PIPE=1    python bench.py
+    JAX_PLATFORMS=cpu python scripts/bench_step.py overlap quant pipe
 """
 
 from __future__ import annotations
@@ -81,7 +89,7 @@ HP = {
 STEPS = int(os.environ.get("DTPU_BENCH_STEP_STEPS", 12))
 
 
-def _run_arm(opts: dict, tag: str, hp: dict, steps: int = STEPS):
+def _run_arm(opts: dict, tag: str, hp: dict, steps: int = STEPS, mesh=None):
     """One trainer run; returns (trainer, losses, tokens_per_s, ledger)."""
     import jax
 
@@ -93,10 +101,11 @@ def _run_arm(opts: dict, tag: str, hp: dict, steps: int = STEPS):
     from determined_tpu.train import _jit_cache
 
     _jit_cache.clear_step_cache()
-    if jax.default_backend() == "cpu":
-        mesh = MeshConfig(data=2, fsdp=4)
-    else:
-        mesh = MeshConfig(data=-1)
+    if mesh is None:
+        if jax.default_backend() == "cpu":
+            mesh = MeshConfig(data=2, fsdp=4)
+        else:
+            mesh = MeshConfig(data=-1)
     exp = ExperimentConfig.parse({"optimizations": opts})
     ctx = train.init(
         hparams=dict(hp),
@@ -225,24 +234,127 @@ def bench_quant() -> dict:
     return row
 
 
+def bench_pipe() -> dict:
+    """A/B the three microbatch schedules at fixed global batch on the
+    pipe4 x data2 virtual mesh (M=8): gpipe is the baseline arm; each
+    schedule reports its analytic ticks + modeled bubble, measured step
+    time, compiled max live-activation (temp) bytes, and loss parity."""
+    import jax
+
+    from determined_tpu.data import to_global
+
+    hp = dict(
+        HP,
+        n_layers=8,  # divides into pipe4 stages AND pipe4 x V=2 chunks
+        d_model=64,
+        vocab_size=256,
+        pipe_microbatches=8,
+    )
+    steps = int(os.environ.get("DTPU_BENCH_PIPE_STEPS", 6))
+    arms = {
+        "gpipe": {},
+        "1f1b": {"pipeline_schedule": "1f1b"},
+        "interleaved": {"pipeline_schedule": "interleaved", "virtual_stages": 2},
+    }
+    results = {}
+    losses = {}
+    from determined_tpu.parallel.mesh import MeshConfig
+
+    for name, opts in arms.items():
+        trainer, arm_losses, tps, _ = _run_arm(
+            opts, f"pipe-{name}", hp, steps=steps,
+            mesh=MeshConfig(pipe=4, data=2),
+        )
+        losses[name] = arm_losses
+        bm = trainer._bubble_model
+        sched = bm.schedule
+        # max live-activation bytes: the compiled step's temp allocation
+        # (XLA's buffer assignment), measured — the 1f1b stash-vs-residual
+        # claim in bytes rather than HLO shapes
+        host = next(trainer.train_loader.iter_epoch(0))
+        batch = to_global(host, trainer.mesh)
+        with trainer.mesh:
+            mem = (
+                trainer._train_step_jit.lower(trainer.state, batch)
+                .compile()
+                .memory_analysis()
+            )
+        temp_bytes = getattr(mem, "temp_size_in_bytes", None)
+        gbs = hp["global_batch_size"]
+        step_s = gbs * hp["seq_len"] / max(tps, 1e-9)
+        results[name] = {
+            "ticks": sched.total_ticks,
+            "bubble_ticks": sched.bubble_ticks,
+            "modeled_bubble_pct": round(100.0 * bm.fraction, 2),
+            "step_time_s": round(step_s, 4),
+            "tokens_per_s": round(tps, 1),
+            "max_live_activation_bytes": temp_bytes,
+            "loss_final": round(arm_losses[-1], 6),
+        }
+    for name in ("1f1b", "interleaved"):
+        results[name]["loss_max_dev_vs_gpipe"] = max(
+            abs(a - b) for a, b in zip(losses["gpipe"], losses[name])
+        )
+    row = {
+        "metric": "transformer_lm_pipeline_schedule_tokens_per_sec",
+        "value": results["interleaved"]["tokens_per_s"],
+        "unit": "tokens/s",
+        "vs_baseline": round(
+            results["interleaved"]["tokens_per_s"]
+            / max(results["gpipe"]["tokens_per_s"], 1e-9),
+            3,
+        ),
+        "mesh": "pipe4xdata2",
+        "microbatches": 8,
+        "schedules": results,
+        "parity_ok": (
+            results["1f1b"]["loss_max_dev_vs_gpipe"] < 1e-5
+            and results["interleaved"]["loss_max_dev_vs_gpipe"] < 1e-5
+        ),
+        # None (not False) when the backend's memory_analysis lacks temp
+        # accounting: the exit gate must not fail on an unavailable metric
+        "memory_win_1f1b": (
+            results["1f1b"]["max_live_activation_bytes"]
+            < results["gpipe"]["max_live_activation_bytes"]
+            if results["1f1b"]["max_live_activation_bytes"] is not None
+            and results["gpipe"]["max_live_activation_bytes"] is not None
+            else None
+        ),
+        "chip": _chip(),
+        "steps": steps,
+    }
+    if jax.default_backend() != "tpu":
+        row["note"] = (
+            "CPU virtual mesh: schedule structure + numerics A/B (tick "
+            "model, 1f1b memory cap, parity); TPU MFU row next chip round"
+        )
+    return row
+
+
 def main() -> None:
-    modes = [m for m in sys.argv[1:] if m in ("overlap", "quant")]
+    modes = [m for m in sys.argv[1:] if m in ("overlap", "quant", "pipe")]
     if not modes:
         if os.environ.get("DTPU_BENCH_OVERLAP", "0") not in ("0", ""):
             modes.append("overlap")
         if os.environ.get("DTPU_BENCH_QUANT", "0") not in ("0", ""):
             modes.append("quant")
+        if os.environ.get("DTPU_BENCH_PIPE", "0") not in ("0", ""):
+            modes.append("pipe")
     if not modes:
-        modes = ["overlap", "quant"]
+        modes = ["overlap", "quant", "pipe"]
     _maybe_respawn()
     ok = True
     for mode in modes:
-        row = bench_overlap() if mode == "overlap" else bench_quant()
-        print(json.dumps(row))
         if mode == "overlap":
+            row = bench_overlap()
             ok = ok and row["numerically_identical"]
-        else:
+        elif mode == "quant":
+            row = bench_quant()
             ok = ok and row["within_tolerance"]
+        else:
+            row = bench_pipe()
+            ok = ok and row["parity_ok"] and row["memory_win_1f1b"] is not False
+        print(json.dumps(row))
     raise SystemExit(0 if ok else 1)
 
 

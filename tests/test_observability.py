@@ -200,6 +200,47 @@ def test_goodput_ledger_attributes_wall_clock():
     assert "phase breakdown" in text and "trial 0" in text
 
 
+def test_ledger_folds_step_bubble_counters_into_attribution():
+    """The step.bubble rows (ISSUE 14) ride the same counter mechanism as
+    step.comm: they must surface per trial and experiment-wide WITHOUT
+    perturbing the span-nesting attribution — the breakdown still sums to
+    ~100% and the named share clears the >= 95% bar."""
+    ev = [
+        {"ph": "X", "name": "trial.run", "cat": "trial", "ts": 0, "dur": 1e6,
+         "pid": 1, "tid": 1, "args": {"trial": "t1"}},
+        {"ph": "X", "name": "step.dispatch", "cat": "step", "ts": 10,
+         "dur": 9.8e5, "pid": 1, "tid": 1},
+        {"ph": "C", "name": "step.bubble.exposed_us", "ts": 500, "pid": 1,
+         "tid": 1, "args": {"value": 110000.0}},
+        {"ph": "C", "name": "step.bubble.fraction", "cat": "gauge", "ts": 500,
+         "pid": 1, "tid": 1, "args": {"value": 3 / 19}},
+        {"ph": "C", "name": "step.bubble.ticks_total", "cat": "gauge",
+         "ts": 500, "pid": 1, "tid": 1, "args": {"value": 19.0}},
+        {"ph": "C", "name": "step.bubble.ticks_idle", "cat": "gauge",
+         "ts": 500, "pid": 1, "tid": 1, "args": {"value": 3.0}},
+    ]
+    led = compute_ledger(ev)
+    trial = led["trials"]["t1"]
+    bubble = trial["step.bubble"]
+    assert bubble["exposed_s"] == pytest.approx(0.11)
+    assert bubble["pct_of_step"] == pytest.approx(11.22, abs=0.01)
+    assert bubble["fraction_modeled"] == pytest.approx(3 / 19, abs=1e-4)
+    assert bubble["ticks_total"] == 19 and bubble["ticks_idle"] == 3
+    assert bubble["model"] == "pipeline-tick-v1"
+    assert led["experiment"]["step.bubble"]["exposed_s"] == pytest.approx(0.11)
+    # the counters must not disturb the wall-clock attribution invariant
+    assert trial["attributed_pct"] >= 95.0
+    total_pct = sum(row["pct"] for row in trial["breakdown"].values())
+    assert 99.0 <= total_pct <= 101.0
+    text = format_ledger_text(led)
+    assert "exposed bubble" in text and "ticks idle" in text
+
+    # no bubble counters -> no bubble rows
+    led2 = compute_ledger(ev[:2])
+    assert "step.bubble" not in led2["trials"]["t1"]
+    assert "step.bubble" not in led2["experiment"]
+
+
 def test_ledger_attributes_restart_recovery_on_chaos_run(tmp_path):
     """A supervised chaos run (crash mid-step -> backoff -> restore ->
     finish) must show restart + restore time in the ledger, and still

@@ -1,0 +1,692 @@
+"""Pipeline microbatch schedules (ISSUE 14): 1F1B + circular-interleaved
+vs the GPipe baseline, with the analytic tick model behind the goodput
+ledger's ``step.bubble`` rows.
+
+Acceptance bars, on the virtual 8-device CPU mesh (pipe4 x data2, M=8):
+
+- ``1f1b`` is forward/loss bit-exact vs gpipe (it IS the gpipe forward)
+  and its grads/params match to float reassociation (the custom combined
+  backward accumulates per-stage grads in increasing-microbatch order
+  where the gpipe scan transpose accumulates decreasing);
+- the compiled 1f1b backward holds a live-activation stash of **P**
+  microbatches where gpipe stacks residuals for all M + P - 1 scan ticks
+  (HLO-verified — the memory win that buys larger M);
+- ``interleaved`` (V virtual stages) matches sequential application
+  bit-exactly and its tick model shrinks the bubble fraction from
+  (P-1)/(M+P-1) to (P-1)/(V*M+P-1);
+- all schedules are a single jitted SPMD program: exactly one trace per
+  schedule under the RetraceSentinel;
+- schedule + virtual_stages key the cross-trial jit cache (toggling never
+  serves a stale trace), indivisible microbatch counts raise
+  ``InvalidExperimentConfig`` with the offending values, and the
+  composed variants (overlap_grad_sync / aggregation_frequency / int8)
+  stay loss-parity vs their gpipe twins (slow marks).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from determined_tpu.config import ExperimentConfig, InvalidExperimentConfig
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+from determined_tpu.parallel.pipeline import (
+    BubbleModel,
+    PipelineSchedule,
+    pipeline_apply,
+    stack_chunk_params,
+    stack_stage_params,
+)
+
+
+def _stage_fn(params, x):
+    return jax.nn.gelu(x @ params["w"] + params["b"])
+
+
+def _make_stages(n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "w": jnp.asarray(rng.standard_normal((d, d)) * 0.3, jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((d,)) * 0.1, jnp.float32),
+        }
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# analytic tick model
+# ---------------------------------------------------------------------------
+
+
+def test_tick_model_formulas():
+    g = PipelineSchedule(name="gpipe", n_stages=4, num_microbatches=8)
+    assert g.total_ticks == 11 and g.bubble_ticks == 3
+    assert g.bubble_fraction == pytest.approx(3 / 11)
+
+    f = PipelineSchedule(name="1f1b", n_stages=4, num_microbatches=8)
+    assert f.total_ticks == 2 * 11 and f.bubble_ticks == 2 * 3
+    # 1f1b trades memory, not bubble: same idle fraction as gpipe
+    assert f.bubble_fraction == pytest.approx(g.bubble_fraction)
+    assert f.live_activation_microbatches == 4  # P, not M
+    assert g.live_activation_microbatches == 11  # one residual per tick
+
+    i = PipelineSchedule(
+        name="interleaved", n_stages=4, num_microbatches=8, virtual_stages=2
+    )
+    assert i.total_ticks == 2 * 8 + 4 - 1  # V*M + P - 1 when P | M
+    assert i.bubble_fraction == pytest.approx(3 / 19)
+    assert i.bubble_fraction < g.bubble_fraction
+
+    # partial last group (P does not divide M) still schedules
+    i2 = PipelineSchedule(
+        name="interleaved", n_stages=4, num_microbatches=6, virtual_stages=2
+    )
+    assert i2.work_ticks == 12 and i2.total_ticks >= 12
+
+    bm = BubbleModel(schedule=i)
+    bubble_s, busy_s = bm.split(1.9)
+    assert bubble_s == pytest.approx(1.9 * 3 / 19)
+    assert bubble_s + busy_s == pytest.approx(1.9)
+
+
+def test_schedule_validation_errors():
+    with pytest.raises(InvalidExperimentConfig, match="pipeline_schedule"):
+        PipelineSchedule(name="pipedream", n_stages=2, num_microbatches=2)
+    with pytest.raises(InvalidExperimentConfig, match="virtual_stages >= 2"):
+        PipelineSchedule(name="interleaved", n_stages=2, num_microbatches=2)
+    with pytest.raises(InvalidExperimentConfig, match="only applies"):
+        PipelineSchedule(
+            name="gpipe", n_stages=2, num_microbatches=2, virtual_stages=2
+        )
+    # config-parse surface (the same invariants, at parse time)
+    with pytest.raises(InvalidExperimentConfig, match="pipeline_schedule"):
+        ExperimentConfig.parse(
+            {"optimizations": {"pipeline_schedule": "zigzag"}}
+        )
+    with pytest.raises(InvalidExperimentConfig, match="virtual_stages"):
+        ExperimentConfig.parse(
+            {
+                "optimizations": {
+                    "pipeline_schedule": "interleaved",
+                    "virtual_stages": 1,
+                }
+            }
+        )
+    cfg = ExperimentConfig.parse({})
+    assert cfg.optimizations.pipeline_schedule == "gpipe"
+    assert cfg.optimizations.virtual_stages == 1
+
+
+def test_config_preflight_flags_divisibility():
+    from determined_tpu.config.experiment import preflight_experiment_config
+
+    cfg = ExperimentConfig.parse(
+        {
+            "resources": {"mesh": {"pipe": 4, "data": 2}},
+            "optimizations": {
+                "pipeline_schedule": "interleaved",
+                "virtual_stages": 2,
+            },
+            "hyperparameters": {
+                "n_layers": 6,
+                "global_batch_size": 16,
+                "pipe_microbatches": 3,
+            },
+        }
+    )
+    problems = preflight_experiment_config(cfg)
+    assert any("n_layers=6" in p for p in problems)
+    assert any("pipe_microbatches=3" in p for p in problems)
+    # clean config -> clean preflight
+    ok = ExperimentConfig.parse(
+        {
+            "resources": {"mesh": {"pipe": 4, "data": 2}},
+            "hyperparameters": {"n_layers": 8, "global_batch_size": 16},
+        }
+    )
+    assert preflight_experiment_config(ok) == []
+
+
+def test_indivisible_batch_raises_config_error(devices8):
+    mesh = make_mesh(MeshConfig(pipe=4, data=2), devices8)
+    stacked = stack_stage_params(_make_stages(4, 8))
+    with pytest.raises(InvalidExperimentConfig) as exc:
+        pipeline_apply(_stage_fn, stacked, jnp.ones((6, 8)), mesh, 4)
+    # the error names the offending values, not just "bad config"
+    assert "6" in str(exc.value) and "4" in str(exc.value)
+
+
+# ---------------------------------------------------------------------------
+# 1f1b vs gpipe: numerics + memory
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("microbatches", [2, 8])
+def test_1f1b_matches_gpipe(devices8, microbatches):
+    """Forward bit-exact (shared tick loop), grads equal to float
+    reassociation — pipe4 x data2, the acceptance mesh."""
+    mesh = make_mesh(MeshConfig(pipe=4, data=2), devices8)
+    d, batch = 16, 8
+    stacked = _make_stages(4, d)
+    stacked = stack_stage_params(stacked)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((batch, d)), jnp.float32)
+
+    def loss(p, x, sch):
+        return (
+            pipeline_apply(_stage_fn, p, x, mesh, microbatches, schedule=sch)
+            ** 2
+        ).mean()
+
+    with mesh:
+        out_g = jax.jit(
+            lambda p, x: pipeline_apply(
+                _stage_fn, p, x, mesh, microbatches, schedule="gpipe"
+            )
+        )(stacked, x)
+        out_f = jax.jit(
+            lambda p, x: pipeline_apply(
+                _stage_fn, p, x, mesh, microbatches, schedule="1f1b"
+            )
+        )(stacked, x)
+        gg, gxg = jax.jit(
+            jax.grad(lambda p, x: loss(p, x, "gpipe"), argnums=(0, 1))
+        )(stacked, x)
+        gf, gxf = jax.jit(
+            jax.grad(lambda p, x: loss(p, x, "1f1b"), argnums=(0, 1))
+        )(stacked, x)
+    # forward IS the gpipe drain: bit-exact
+    assert np.array_equal(np.asarray(out_g), np.asarray(out_f))
+    for a, b in zip(jax.tree.leaves(gg), jax.tree.leaves(gf)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-7, rtol=1e-5
+        )
+    np.testing.assert_allclose(
+        np.asarray(gxg), np.asarray(gxf), atol=5e-7, rtol=1e-5
+    )
+
+
+def test_1f1b_live_activation_buffer_is_p_not_m(devices8):
+    """THE memory claim, HLO-verified: the gpipe backward stacks stage
+    residuals for all M + P - 1 scan ticks ([T, mb, d] buffers in the
+    compiled module); 1f1b's combined backward carries only the P-slot
+    activation stash ([P, mb, d]) — and strictly less temp memory."""
+    mesh = make_mesh(MeshConfig(pipe=4, data=2), devices8)
+    d, batch, m, n = 16, 8, 8, 4
+    stacked = stack_stage_params(_make_stages(4, d))
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((batch, d)), jnp.float32)
+
+    def compiled(sch):
+        def loss(p, x):
+            return (
+                pipeline_apply(_stage_fn, p, x, mesh, m, schedule=sch) ** 2
+            ).mean()
+
+        with mesh:
+            return jax.jit(jax.grad(loss)).lower(stacked, x).compile()
+
+    t_dim = m + n - 1  # 11 tick-stacked residuals
+    # per-device microbatch rows: mb = batch/m = 1 (replicated over data)
+    resid_re = re.compile(rf"f32\[{t_dim},\d+,{d}\]")
+    stash_re = re.compile(rf"f32\[{n},\d+,{d}\]")
+
+    gpipe = compiled("gpipe")
+    f1b = compiled("1f1b")
+    gpipe_txt, f1b_txt = gpipe.as_text(), f1b.as_text()
+    assert resid_re.search(gpipe_txt), "gpipe must stack T-tick residuals"
+    assert not resid_re.search(f1b_txt), (
+        "1f1b compiled module still holds an [M+P-1, ...] residual stack — "
+        "the live-activation cap regressed"
+    )
+    assert stash_re.search(f1b_txt), "1f1b must carry the [P, ...] stash"
+
+    mem_g = gpipe.memory_analysis()
+    mem_f = f1b.memory_analysis()
+    if hasattr(mem_g, "temp_size_in_bytes"):
+        assert mem_f.temp_size_in_bytes < mem_g.temp_size_in_bytes
+
+
+# ---------------------------------------------------------------------------
+# interleaved vs sequential
+# ---------------------------------------------------------------------------
+
+
+def test_interleaved_matches_sequential(devices8):
+    """V=2 over pipe4: 8 chunks, each rank holding 2 non-adjacent ones;
+    forward and grads match plain sequential chunk application."""
+    mesh = make_mesh(MeshConfig(pipe=4, data=2), devices8)
+    d, batch, m, n, v = 16, 8, 8, 4, 2
+    chunks = _make_stages(n * v, d, seed=3)
+    stacked = stack_chunk_params(chunks, n)
+    # layout check: [p, v] holds chunk v*P + p
+    assert stacked["w"].shape == (n, v, d, d)
+    assert np.array_equal(np.asarray(stacked["w"][1, 1]), np.asarray(chunks[1 * n + 1]["w"]))
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((batch, d)), jnp.float32)
+    ref = x
+    for c in chunks:
+        ref = _stage_fn(c, ref)
+
+    with mesh:
+        out = jax.jit(
+            lambda p, x: pipeline_apply(
+                _stage_fn, p, x, mesh, m, schedule="interleaved", virtual_stages=v
+            )
+        )(stacked, x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-6, rtol=1e-6
+    )
+
+    def piped_loss(p, x):
+        return (
+            pipeline_apply(
+                _stage_fn, p, x, mesh, m, schedule="interleaved", virtual_stages=v
+            )
+            ** 2
+        ).mean()
+
+    def seq_loss(p, x):
+        y = x
+        for c in range(n * v):
+            pc = jax.tree.map(lambda a: a[c % n, c // n], p)
+            y = _stage_fn(pc, y)
+        return (y ** 2).mean()
+
+    with mesh:
+        gp = jax.jit(jax.grad(piped_loss))(stacked, x)
+    gs = jax.grad(seq_loss)(stacked, x)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5)
+
+
+def test_interleaved_partial_last_group(devices8):
+    """M not divisible by P: the schedule leaves gaps but stays exact."""
+    mesh = make_mesh(MeshConfig(pipe=4, data=2), devices8)
+    d, batch, m = 16, 8, 2  # M=2 < P=4
+    chunks = _make_stages(8, d, seed=5)
+    stacked = stack_chunk_params(chunks, 4)
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.standard_normal((batch, d)), jnp.float32)
+    ref = x
+    for c in chunks:
+        ref = _stage_fn(c, ref)
+    with mesh:
+        out = jax.jit(
+            lambda p, x: pipeline_apply(
+                _stage_fn, p, x, mesh, m, schedule="interleaved", virtual_stages=2
+            )
+        )(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_interleaved_requires_pipe_axis(devices8):
+    mesh = make_mesh(MeshConfig(data=8), devices8)
+    stacked = stack_chunk_params(_make_stages(2, 8), 1)
+    with pytest.raises(InvalidExperimentConfig, match="pipe mesh axis"):
+        pipeline_apply(
+            _stage_fn, stacked, jnp.ones((4, 8)), mesh, 2,
+            schedule="interleaved", virtual_stages=2,
+        )
+
+
+# ---------------------------------------------------------------------------
+# single trace per schedule (RetraceSentinel)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("schedule,v", [("gpipe", 1), ("1f1b", 1), ("interleaved", 2)])
+def test_exactly_one_trace_per_schedule(devices8, schedule, v):
+    from determined_tpu.lint import get_retrace_sentinel
+
+    mesh = make_mesh(MeshConfig(pipe=4, data=2), devices8)
+    d = 8
+    if v == 1:
+        stacked = stack_stage_params(_make_stages(4, d, seed=7))
+    else:
+        stacked = stack_chunk_params(_make_stages(4 * v, d, seed=7), 4)
+    x = jnp.ones((8, d), jnp.float32)
+
+    def loss(p, x):
+        return (
+            pipeline_apply(
+                _stage_fn, p, x, mesh, 4, schedule=schedule, virtual_stages=v
+            )
+            ** 2
+        ).mean()
+
+    sentinel = get_retrace_sentinel()
+    sentinel.reset()
+    label = f"schedule.{schedule}"
+    step = jax.jit(jax.grad(sentinel.wrap(label, loss, allowed=1)))
+    with mesh:
+        step(stacked, x)
+        step(stacked, x)  # same avals: must NOT retrace
+    rec = [r for r in sentinel.records() if r.label == label]
+    assert rec and rec[0].traces == 1
+    assert not sentinel.violations()
+    sentinel.reset()
+
+
+# ---------------------------------------------------------------------------
+# jit-cache keying
+# ---------------------------------------------------------------------------
+
+
+def test_jit_cache_key_covers_schedule():
+    from determined_tpu.train import _jit_cache
+
+    class _T:
+        def compile_cache_runtime_hparams(self):
+            return ()
+
+    mesh = make_mesh(MeshConfig(data=2))
+    kw = dict(
+        trial=_T(),
+        hparams={"lr": 1e-3},
+        mesh=mesh,
+        agg=1,
+        average_grads=True,
+        sample_batch={"tokens": np.zeros((4, 8), np.int32)},
+        metric_keys=("loss",),
+    )
+    base = _jit_cache.step_cache_key(**kw)
+    assert _jit_cache.step_cache_key(**kw) == base  # stable
+    g = PipelineSchedule(name="gpipe", n_stages=4, num_microbatches=8)
+    f = PipelineSchedule(name="1f1b", n_stages=4, num_microbatches=8)
+    i = PipelineSchedule(
+        name="interleaved", n_stages=4, num_microbatches=8, virtual_stages=2
+    )
+    keys = {
+        base,
+        _jit_cache.step_cache_key(**kw, pipeline=g.fingerprint()),
+        _jit_cache.step_cache_key(**kw, pipeline=f.fingerprint()),
+        _jit_cache.step_cache_key(**kw, pipeline=i.fingerprint()),
+        # same schedule, different M: different trip count -> new trace
+        _jit_cache.step_cache_key(
+            **kw,
+            pipeline=PipelineSchedule(
+                name="gpipe", n_stages=4, num_microbatches=4
+            ).fingerprint(),
+        ),
+    }
+    assert len(keys) == 5
+
+
+def test_split_pipeline_params_interleaved_layout():
+    """The [P, V, ...] restack maps chunk v*P + p to [p, v] and reuses the
+    exact initialized layer values (the basis of init parity)."""
+    from determined_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        split_pipeline_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=8, n_heads=4, max_seq_len=8,
+        dtype=jnp.float32, attention_impl="reference",
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    from flax.core import meta as flax_meta
+
+    flat = flax_meta.unbox(params)["params"]
+    split = split_pipeline_params(params, 2, virtual_stages=2)
+    # 8 layers over P=2 x V=2 -> 4 chunks of 2 layers: layer_0/layer_1
+    assert sorted(split["blocks"].keys()) == ["layer_0", "layer_1"]
+    leaf = split["blocks"]["layer_0"]["attn"]["wq"]["kernel"]
+    assert leaf.shape[:2] == (2, 2)
+    # chunk c = v*P + p covers layers [2c, 2c+2): [p=1, v=1] -> chunk 3,
+    # layer_0 of it is block_6
+    np.testing.assert_array_equal(
+        np.asarray(leaf[1, 1]),
+        np.asarray(flax_meta.unbox(flat["block_6"]["attn"]["wq"]["kernel"])),
+    )
+    with pytest.raises(InvalidExperimentConfig, match="chunks"):
+        split_pipeline_params(params, 2, virtual_stages=3)  # 8 % 6
+
+
+# ---------------------------------------------------------------------------
+# trainer-level parity (tier-1 keeps one cheap pipe2 case; the composed
+# overlap/agg/int8 variants pay multi-schedule compiles -> slow)
+# ---------------------------------------------------------------------------
+
+_HP = {
+    "lr": 1e-3,
+    "global_batch_size": 16,
+    "seq_len": 32,
+    "vocab_size": 128,
+    "d_model": 32,
+    "n_layers": 4,
+    "n_heads": 4,
+    "dataset_size": 64,
+    "bf16": False,
+    "attention": "reference",
+    "warmup_steps": 1,
+    "pipe_microbatches": 8,
+}
+
+
+def _run_trainer(tmp_path, opts, tag, steps=3, mesh=None, hp=None):
+    from determined_tpu import core, train
+    from determined_tpu.config import Length
+    from determined_tpu.models.transformer import LMTrial
+    from determined_tpu.train import _jit_cache
+
+    _jit_cache.clear_step_cache()
+    exp = ExperimentConfig.parse({"optimizations": opts})
+    ctx = train.init(
+        hparams=dict(hp or _HP),
+        mesh_config=mesh or MeshConfig(pipe=2, data=2),
+        core_context=core._dummy_init(checkpoint_dir=str(tmp_path / f"ck{tag}")),
+        exp_config=exp,
+        seed=7,
+    )
+    trainer = train.Trainer(LMTrial(ctx))
+    losses = []
+    orig = ctx.core.train.report_training_metrics
+    ctx.core.train.report_training_metrics = lambda s, m: (
+        losses.append(float(m["loss"])),
+        orig(s, m),
+    )
+    trainer.fit(
+        Length.batches(steps),
+        report_period=Length.batches(1),
+        checkpoint_policy="none",
+    )
+    return trainer, losses
+
+
+def _maxdiff(a, b):
+    return max(
+        float(np.abs(np.asarray(x, np.float64) - np.asarray(y, np.float64)).max())
+        for x, y in zip(
+            jax.tree.leaves(jax.device_get(a)), jax.tree.leaves(jax.device_get(b))
+        )
+    )
+
+
+def test_trainer_1f1b_parity_and_bubble_ledger(tmp_path):
+    """pipe2 x data2 through Trainer.fit: 1f1b reproduces the gpipe loss
+    trajectory (first step bit-exact, then reassociation-level), the
+    bubble model rides the trainer, and the ledger prints the line."""
+    from determined_tpu.observability import compute_ledger, format_ledger_text, get_tracer
+
+    base, lg = _run_trainer(tmp_path, {}, "a")
+    assert base._bubble_model is not None
+    assert base._bubble_model.fraction == pytest.approx(1 / 9)  # (P-1)/(M+P-1)
+
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.configure(enabled=True)
+    tracer.start()
+    try:
+        with tracer.span("trial.run", cat="trial", trial="f1b"):
+            f1b, lf = _run_trainer(
+                tmp_path, {"pipeline_schedule": "1f1b"}, "b"
+            )
+    finally:
+        tracer.stop()
+    assert lg[0] == lf[0]  # the forward is bit-exact
+    assert max(abs(a - b) for a, b in zip(lg, lf)) < 1e-5
+    assert _maxdiff(base.state.params, f1b.state.params) < 1e-5
+
+    led = compute_ledger(tracer.chrome_events())
+    bubble = led["trials"]["f1b"].get("step.bubble")
+    assert bubble is not None
+    assert bubble["exposed_s"] > 0.0
+    assert bubble["fraction_modeled"] == pytest.approx(1 / 9, abs=1e-3)
+    assert bubble["model"] == "pipeline-tick-v1"
+    assert "exposed bubble" in format_ledger_text(led)
+    tracer.reset()
+
+
+# ---------------------------------------------------------------------------
+# composed variants — multi-schedule trainer compiles, slow tier
+# ---------------------------------------------------------------------------
+
+
+def _layers_from_blocks(blocks, n_stages, virtual_stages, n_layers):
+    """Reconstruct the flat per-layer param list from either stacked
+    layout ([P, ...] gpipe/1f1b or [P, V, ...] interleaved): layer
+    L = chunk * lpc + j with chunk = v * P + p."""
+    lpc = n_layers // (n_stages * virtual_stages)
+    out = []
+    for layer in range(n_layers):
+        chunk, j = divmod(layer, lpc)
+        v, p = divmod(chunk, n_stages)
+        if virtual_stages == 1:
+            out.append(jax.tree.map(lambda a: a[p], blocks[f"layer_{j}"]))
+        else:
+            out.append(jax.tree.map(lambda a: a[p, v], blocks[f"layer_{j}"]))
+    return out
+
+
+@pytest.mark.slow
+def test_trainer_interleaved_parity_pipe4(tmp_path):
+    """The acceptance mesh: pipe4 x data2 at M=8, interleaved V=2 vs
+    gpipe — bit-exact loss trajectory (same chunk composition order);
+    trained params compared layer-by-layer across the two layouts."""
+    hp = dict(_HP, n_layers=8)
+    mesh = MeshConfig(pipe=4, data=2)
+    base, lg = _run_trainer(tmp_path, {}, "a", mesh=mesh, hp=hp)
+    inter, li = _run_trainer(
+        tmp_path,
+        {"pipeline_schedule": "interleaved", "virtual_stages": 2},
+        "b",
+        mesh=mesh,
+        hp=hp,
+    )
+    assert inter._bubble_model.fraction < base._bubble_model.fraction
+    np.testing.assert_allclose(lg, li, rtol=1e-6, atol=1e-7)
+    assert (
+        _maxdiff(base.state.params["outer"], inter.state.params["outer"])
+        < 1e-5
+    )
+    base_layers = _layers_from_blocks(base.state.params["blocks"], 4, 1, 8)
+    int_layers = _layers_from_blocks(inter.state.params["blocks"], 4, 2, 8)
+    for bl, il in zip(base_layers, int_layers):
+        assert _maxdiff(bl, il) < 1e-5
+
+
+@pytest.mark.slow
+def test_trainer_1f1b_pipe4_m8_parity(tmp_path):
+    """1F1B on the acceptance mesh (pipe4 x data2, M=8): loss bit-exact
+    at step 1, trajectory and params at reassociation level."""
+    mesh = MeshConfig(pipe=4, data=2)
+    base, lg = _run_trainer(tmp_path, {}, "a", mesh=mesh)
+    f1b, lf = _run_trainer(
+        tmp_path, {"pipeline_schedule": "1f1b"}, "b", mesh=mesh
+    )
+    assert lg[0] == lf[0]
+    assert max(abs(a - b) for a, b in zip(lg, lf)) < 1e-5
+    assert _maxdiff(base.state.params, f1b.state.params) < 1e-5
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("schedule,v", [("1f1b", 1), ("interleaved", 2)])
+def test_schedules_compose_with_overlap_hlo_entry(tmp_path, schedule, v):
+    """overlap_grad_sync x schedule: loss parity vs the same-schedule
+    baseline AND the PR-12 structural invariant extended to each
+    schedule — every gradient all-gather lives in the ENTRY computation,
+    none inside a scan body (the schedule's microbatch scan must not
+    multiply the sync collectives)."""
+    from determined_tpu.data import to_global
+
+    # d_model sized so the stacked block leaves cross the overlap plan's
+    # 64KB min-sync floor — otherwise no leaf gets a reduce-scatter
+    # layout and the assertion below would be vacuous
+    hp = dict(_HP, d_model=128, n_layers=4 * v)
+    opts = {"pipeline_schedule": schedule, "virtual_stages": v}
+    base, lb = _run_trainer(tmp_path, dict(opts), "a", hp=hp)
+    over, lo = _run_trainer(
+        tmp_path, dict(opts, overlap_grad_sync=True), "b", hp=hp
+    )
+    assert over._overlap_plan is not None and over._overlap_plan.synced_leaves > 0
+    assert max(abs(a - b) for a, b in zip(lb, lo)) < 1e-4
+    assert _maxdiff(base.state.params, over.state.params) < 1e-4
+
+    host = next(over.train_loader.iter_epoch(0))
+    batch = to_global(host, over.mesh)
+    with over.mesh:
+        hlo = over._train_step_jit.lower(over.state, batch).compile().as_text()
+    per_comp = {}
+    cur = "TOP"
+    for line in hlo.splitlines():
+        if line and not line[0].isspace() and "{" in line:
+            cur = line.split("(")[0].strip()
+        elif "all-gather" in line and " = " in line:
+            per_comp[cur] = per_comp.get(cur, 0) + 1
+    assert per_comp, "no all-gather anywhere: overlap structure missing"
+    for comp, count in per_comp.items():
+        assert comp.startswith("ENTRY"), (
+            f"{count} gradient collective(s) inside computation {comp} "
+            f"under schedule {schedule}: sync must stay outside the scan"
+        )
+
+
+@pytest.mark.slow
+def test_schedules_compose_with_agg(tmp_path):
+    """aggregation_frequency=2 x 1f1b: parity vs the agg gpipe twin."""
+    base, lb = _run_trainer(
+        tmp_path, {"aggregation_frequency": 2}, "a", steps=2
+    )
+    f1b, lf = _run_trainer(
+        tmp_path,
+        {"aggregation_frequency": 2, "pipeline_schedule": "1f1b"},
+        "b",
+        steps=2,
+    )
+    assert lb[0] == lf[0]
+    assert _maxdiff(base.state.params, f1b.state.params) < 1e-5
+
+
+@pytest.mark.slow
+def test_interleaved_composes_with_overlap_and_int8(tmp_path):
+    """The full stack: interleaved V=2 x overlap_grad_sync x int8 trains
+    finite and tracks its int8 gpipe twin."""
+    hp = dict(_HP, n_layers=8)
+    base, lb = _run_trainer(
+        tmp_path, {"quantized_matmul": "int8"}, "a", steps=2, hp=hp,
+        mesh=MeshConfig(pipe=4, data=2),
+    )
+    comp, lc = _run_trainer(
+        tmp_path,
+        {
+            "quantized_matmul": "int8",
+            "overlap_grad_sync": True,
+            "pipeline_schedule": "interleaved",
+            "virtual_stages": 2,
+        },
+        "b",
+        steps=2,
+        hp=hp,
+        mesh=MeshConfig(pipe=4, data=2),
+    )
+    assert all(np.isfinite(lc))
+    assert max(abs(a - b) for a, b in zip(lb, lc)) < 1e-4
