@@ -94,6 +94,15 @@ ROUTES = [
     ("GET", "/api/v1/models/{name}", "token", {"name", "versions"}),
     ("POST", "/api/v1/models/{name}/versions", "token", {"version"}),
     ("GET", "/api/v1/models/{name}/versions", "token", "[]"),
+    ("GET", "/api/v1/models/{name}/versions/{version}", "token",
+     {"version", "checkpoint_uuid", "storage_path", "model"}),
+    ("POST", "/api/v1/models/{name}/promote", "token",
+     {"version", "checkpoint_uuid"}),
+    # serving fleet: rolling deployment of a registry version
+    ("POST", "/api/v1/serving/deploy", "token",
+     {"id", "model", "version", "target", "status"}),
+    ("GET", "/api/v1/serving/deploy", "token",
+     {"id", "model", "version", "target", "status"}),
     # agents + scheduling
     ("POST", "/api/v1/agents", "token", {"registered"}),
     ("GET", "/api/v1/agents", "token", "[]"),

@@ -514,11 +514,135 @@ def model_create(args) -> int:
 
 
 def model_list(args) -> int:
-    _table(
-        [{"name": m.name, "versions": m.get("num_versions", "")} for m in _client(args).get_models()],
-        ["name", "versions"],
-    )
+    rows = []
+    for m in _client(args).get_models():
+        versions = m.get("versions") or []
+        latest = max((int(v.get("version") or 0) for v in versions), default=0)
+        rows.append(
+            {
+                "name": m.name,
+                "versions": len(versions),
+                "latest": f"v{latest}" if latest else "-",
+            }
+        )
+    _table(rows, ["name", "versions", "latest"])
     return 0
+
+
+def model_show(args) -> int:
+    model = _client(args).get_model(args.name).to_dict()
+    if args.json:
+        _print_json(model)
+        return 0
+    print(f"model {model['name']}")
+    if model.get("labels"):
+        print(f"  labels: {', '.join(model['labels'])}")
+    for v in model.get("versions") or []:
+        lineage = []
+        if v.get("source_trial_id"):
+            lineage.append(f"trial {v['source_trial_id']}")
+        if v.get("source_experiment_id"):
+            lineage.append(f"experiment {v['source_experiment_id']}")
+        print(
+            f"  v{v['version']}: checkpoint {v.get('checkpoint_uuid')}"
+            + (f" ({', '.join(lineage)})" if lineage else "")
+        )
+        if v.get("storage_path"):
+            print(f"      path: {v['storage_path']}")
+        if v.get("metrics"):
+            print(f"      metrics: {json.dumps(v['metrics'], sort_keys=True)}")
+    return 0
+
+
+def model_register(args) -> int:
+    from determined_tpu.experiment import registry as registry_mod
+
+    metrics = {}
+    for kv in args.metric or []:
+        key, _, val = kv.partition("=")
+        try:
+            metrics[key] = float(val)
+        except ValueError:
+            metrics[key] = val
+    v = registry_mod.register_version(
+        _client(args).session,
+        args.name,
+        checkpoint_uuid=args.checkpoint_uuid,
+        storage_path=args.storage_path,
+        source_trial_id=args.trial_id,
+        source_experiment_id=args.experiment_id,
+        metrics=metrics or None,
+        labels=args.label or None,
+        version=args.version,
+    )
+    print(f"registered {args.name}@v{v['version']} "
+          f"(checkpoint {v['checkpoint_uuid']})")
+    return 0
+
+
+def model_promote(args) -> int:
+    from determined_tpu.experiment import registry as registry_mod
+
+    session = _client(args).session
+    registry_mod.ensure_model(session, args.name)
+    v = session.post(
+        f"/api/v1/models/{args.name}/promote", json={"trial_id": args.trial_id}
+    ).json()
+    print(f"promoted trial {args.trial_id} -> {args.name}@v{v['version']} "
+          f"(checkpoint {v['checkpoint_uuid']})")
+    return 0
+
+
+def model_pull(args) -> int:
+    """Materialize a registry version's checkpoint locally: copy from its
+    shared-storage path when this host can see it, else download through
+    the master's checkpoint route."""
+    import shutil as _shutil
+
+    from determined_tpu.experiment import registry as registry_mod
+
+    client = _client(args)
+    ver = registry_mod.resolve_version(client.session, args.ref)
+    target = args.output or f"{ver['model']}-v{ver['version']}"
+    src = ver.get("storage_path") or ""
+    if os.path.isdir(src):
+        if os.path.exists(target):
+            print(f"error: {target} already exists", file=sys.stderr)
+            return 2
+        _shutil.copytree(src, target)
+        print(target)
+        return 0
+    path = client.get_checkpoint(ver["checkpoint_uuid"]).download(target)
+    print(path)
+    return 0
+
+
+def model_deploy(args) -> int:
+    """Rolling deploy: walk the serving fleet one replica at a time onto
+    a registry version (drain -> relaunch -> next; docs/registry.md)."""
+    import time as _time
+
+    from determined_tpu.experiment import registry as registry_mod
+
+    session = _client(args).session
+    name, version = registry_mod.parse_model_ref(args.ref)
+    state = session.post(
+        "/api/v1/serving/deploy", json={"model": name, "version": version}
+    ).json()
+    print(f"deploy {state['id']}: rolling {state['target']} "
+          f"over {len(state.get('pending') or [])} replica(s)")
+    if not args.wait:
+        print(state["status"])
+        return 0
+    deadline = _time.time() + args.timeout
+    while _time.time() < deadline:
+        state = session.get("/api/v1/serving/deploy").json()
+        if state["status"] != "rolling":
+            break
+        _time.sleep(1.0)
+    detail = f" ({state['detail']})" if state.get("detail") else ""
+    print(f"deploy {state['id']}: {state['status']}{detail}")
+    return 0 if state["status"] == "completed" else 1
 
 
 def model_register_version(args) -> int:
@@ -875,6 +999,14 @@ def serve_cmd(args) -> int:
     SIGINT drains: new requests are rejected (503), queued + in-flight
     requests finish, and the process exits 75 (EX_TEMPFAIL) so a
     supervisor knows the stop was orderly, not a crash.
+
+    ``--model name[@version|@latest]`` serves a registry version instead
+    of a raw path: the checkpoint is resolved through the master
+    (``docs/registry.md``), the replica's listing label becomes
+    ``name@vN``, and the resolved version rides registration — which is
+    also what lets a rolling deploy (``dtpu model deploy``) find and
+    drain replicas on older versions.  A master-requested drain exits 75
+    exactly like a signal drain.
     """
     import signal as _signal
     import time as _time
@@ -899,15 +1031,56 @@ def serve_cmd(args) -> int:
     session = None
     if args.master or os.environ.get("DTPU_MASTER"):
         session = _client(args).session
-    print(f"loading checkpoint {args.checkpoint} ...", flush=True)
-    engine = ServeEngine.from_checkpoint(args.checkpoint, serve_cfg)
+    checkpoint = args.checkpoint
+    model_name, model_version = "", 0
+    if args.model:
+        from determined_tpu.experiment import registry as registry_mod
+
+        if checkpoint:
+            print("error: pass a checkpoint path OR --model, not both",
+                  file=sys.stderr)
+            return 2
+        if session is None:
+            print("error: --model resolves through the master "
+                  "(pass -m/--master or set DTPU_MASTER)", file=sys.stderr)
+            return 2
+        try:
+            ver = registry_mod.resolve_version(session, args.model)
+        except Exception as e:  # noqa: BLE001 - CLI boundary
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        model_name = ver["model"]
+        model_version = int(ver["version"])
+        checkpoint = ver.get("storage_path") or ""
+        if not checkpoint or not os.path.isdir(checkpoint):
+            print(f"error: {model_name}@v{model_version} resolves to "
+                  f"storage path {checkpoint!r}, which is not a directory "
+                  "on this host (serve replicas load via shared storage)",
+                  file=sys.stderr)
+            return 2
+        print(f"resolved {args.model} -> {model_name}@v{model_version} "
+              f"({checkpoint})", flush=True)
+    elif not checkpoint:
+        print("error: pass a checkpoint path or --model name@version",
+              file=sys.stderr)
+        return 2
+    print(f"loading checkpoint {checkpoint} ...", flush=True)
+    engine = ServeEngine.from_checkpoint(checkpoint, serve_cfg)
+    # listing label precedence: explicit --model-name, then the registry
+    # ref (name@vN), then the trial class name for raw-path launches
+    if model_name:
+        label = f"{model_name}@v{model_version}"
+    else:
+        label = args.model_name or engine.model_label
     worker = ServeWorker(
         engine,
         host=serve_cfg.host,
         port=serve_cfg.port,
         session=session,
-        model=args.model_name or engine.model_label,
-        checkpoint=args.checkpoint,
+        model=args.model_name or label,
+        checkpoint=checkpoint,
+        model_name=model_name,
+        model_version=model_version,
     )
     url = worker.start()
     # the parseable contract scripts/tests rely on: one line, stable prefix
@@ -922,8 +1095,12 @@ def serve_cmd(args) -> int:
     for sig in (_signal.SIGTERM, _signal.SIGINT):
         prev[sig] = _signal.signal(sig, _on_signal)
     try:
-        while not drain_flag.is_set():
+        while not drain_flag.is_set() and not worker.master_drain_requested():
             _time.sleep(0.2)
+        if worker.master_drain_requested() and not drain_flag.is_set():
+            target = worker.master_drain_info.get("target") or "?"
+            print(f"deploy drain requested by master (target {target})",
+                  flush=True)
         print("drain requested: rejecting new requests, finishing in-flight",
               flush=True)
         worker.request_drain()
@@ -1327,12 +1504,56 @@ def build_parser() -> argparse.ArgumentParser:
     cd.add_argument("--output", help="target directory (default: temp dir)")
     cd.set_defaults(fn=checkpoint_download)
 
-    model = sub.add_parser("model").add_subparsers(dest="verb", required=True)
+    model = sub.add_parser(
+        "model", help="model registry: versioned checkpoints promoted from "
+        "trials, served and rolled onto the fleet (docs/registry.md)"
+    ).add_subparsers(dest="verb", required=True)
     mc = model.add_parser("create")
     mc.add_argument("name")
     mc.add_argument("--description")
     mc.set_defaults(fn=model_create)
     model.add_parser("list").set_defaults(fn=model_list)
+    ms = model.add_parser("show", help="model + every version with lineage")
+    ms.add_argument("name")
+    ms.add_argument("--json", action="store_true")
+    ms.set_defaults(fn=model_show)
+    mg = model.add_parser(
+        "register", help="register a checkpoint as the model's next version"
+    )
+    mg.add_argument("name")
+    mg.add_argument("checkpoint_uuid")
+    mg.add_argument("--storage-path",
+                    help="checkpoint directory (required when the master "
+                         "does not track this checkpoint)")
+    mg.add_argument("--trial-id", type=int, help="source trial lineage")
+    mg.add_argument("--experiment-id", type=int, help="source experiment lineage")
+    mg.add_argument("--metric", action="append", metavar="KEY=VALUE",
+                    help="metrics snapshot entry (repeatable)")
+    mg.add_argument("--label", action="append", help="version label (repeatable)")
+    mg.add_argument("--version", type=int,
+                    help="pin an explicit version number (409 if taken)")
+    mg.set_defaults(fn=model_register)
+    mp = model.add_parser(
+        "promote", help="promote a trial's latest checkpoint to the next "
+        "version (the master resolves lineage + metrics)"
+    )
+    mp.add_argument("name")
+    mp.add_argument("trial_id", type=int)
+    mp.set_defaults(fn=model_promote)
+    mpl = model.add_parser("pull", help="materialize a version's checkpoint locally")
+    mpl.add_argument("ref", metavar="NAME[@VERSION]")
+    mpl.add_argument("--output", help="target directory (default: NAME-vN)")
+    mpl.set_defaults(fn=model_pull)
+    md = model.add_parser(
+        "deploy", help="rolling-deploy a version onto the serving fleet "
+        "(drain one replica at a time; supervisors relaunch on the target)"
+    )
+    md.add_argument("ref", metavar="NAME[@VERSION]")
+    md.add_argument("--no-wait", dest="wait", action="store_false",
+                    help="start the roll and return immediately")
+    md.add_argument("--timeout", type=float, default=600.0,
+                    help="seconds to wait for the roll to finish")
+    md.set_defaults(fn=model_deploy, wait=True)
     mr = model.add_parser("register-version")
     mr.add_argument("name")
     mr.add_argument("checkpoint_uuid")
@@ -1445,7 +1666,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="run an online-serving replica from a trial checkpoint "
         "(docs/serving.md)",
     )
-    sv.add_argument("checkpoint", help="trial checkpoint directory to serve")
+    sv.add_argument("checkpoint", nargs="?", default=None,
+                    help="trial checkpoint directory to serve "
+                         "(or use --model to resolve one via the registry)")
+    sv.add_argument("--model", default=None, metavar="NAME[@VERSION]",
+                    help="serve a registry model version resolved through "
+                         "the master, e.g. lm@latest or lm@v3 "
+                         "(docs/registry.md)")
     sv.add_argument("--host", default="127.0.0.1")
     sv.add_argument(
         "--port", type=int, default=0,

@@ -263,6 +263,10 @@ class ModelVersion(_Resource):
     def checkpoint_uuid(self) -> str:
         return self._data.get("checkpoint_uuid", "")
 
+    @property
+    def storage_path(self) -> str:
+        return self._data.get("storage_path", "")
+
 
 class Model(_Resource):
     @property
@@ -397,6 +401,31 @@ class Determined:
     def get_models(self) -> List[Model]:
         rows = self._session.get("/api/v1/models").json()
         return [Model(self._session, r) for r in rows]
+
+    def resolve_model_version(self, ref: str) -> ModelVersion:
+        """Resolve ``name[@version|@latest]`` to its registered version
+        (checkpoint uuid + storage path + lineage)."""
+        from determined_tpu.experiment.registry import resolve_version
+
+        return ModelVersion(self._session, resolve_version(self._session, ref))
+
+    def deploy_model(self, ref: str) -> Dict[str, Any]:
+        """Start a rolling deployment of a registry version onto the
+        serving fleet; returns the deploy state (poll
+        ``get_serving_deploy`` until ``status != "rolling"``)."""
+        from determined_tpu.experiment.registry import parse_model_ref
+
+        name, version = parse_model_ref(ref)
+        return self._session.post(
+            "/api/v1/serving/deploy", json={"model": name, "version": version}
+        ).json()
+
+    def get_serving_deploy(self) -> Dict[str, Any]:
+        return self._session.get("/api/v1/serving/deploy").json()
+
+    def get_serving(self) -> List[Dict[str, Any]]:
+        """The live serving-replica routing table."""
+        return self._session.get("/api/v1/serving").json()
 
     # -- generic tasks (NTSC: tensorboard viewer behind the proxy) --
     def start_tensorboard(

@@ -603,6 +603,57 @@ class LogPolicy:
         return cls(**raw)
 
 
+@dataclasses.dataclass(frozen=True)
+class RegistryConfig:
+    """Model-registry promotion (``docs/registry.md``).
+
+    ``model``: the registry model name this experiment promotes into.
+    ``auto_promote``: when the search completes, register the best trial's
+    final manifest-verified checkpoint as the model's next version
+    (``name@vN``) with lineage back to the trial and experiment — the
+    driver's ``on_search_complete`` hook does the registration, so an
+    ASHA/PBT search ends with its winner in the registry, ready for
+    ``dtpu serve --model name@latest`` and a rolling deploy.  ``labels``
+    ride on every version this experiment registers.  A registered
+    version's checkpoint is pinned against checkpoint GC (both the
+    driver's retention pass and the master's best-k rotation).
+    """
+
+    model: Optional[str] = None
+    auto_promote: bool = False
+    labels: List[str] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        if self.auto_promote and not self.model:
+            raise InvalidExperimentConfig(
+                "registry.auto_promote requires registry.model"
+            )
+        if self.model is not None:
+            if not isinstance(self.model, str) or not self.model:
+                raise InvalidExperimentConfig("registry.model must be a string")
+            # "@" is the name/version separator in model refs; whitespace
+            # and "/" would break the CLI and the master's routes
+            bad = set("@/ \t\n")
+            if set(self.model) & bad:
+                raise InvalidExperimentConfig(
+                    f"registry.model {self.model!r} may not contain "
+                    "'@', '/', or whitespace"
+                )
+        if isinstance(self.labels, str) or not isinstance(self.labels, (list, tuple)):
+            raise InvalidExperimentConfig(
+                f"registry.labels must be a list, got {self.labels!r}"
+            )
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "RegistryConfig":
+        raw = dict(raw or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise InvalidExperimentConfig(f"unknown registry fields: {sorted(unknown)}")
+        return cls(**raw)
+
+
 _CHECKPOINT_POLICIES = ("best", "all", "none")
 
 
@@ -640,6 +691,7 @@ class ExperimentConfig:
     optimizations: OptimizationsConfig = dataclasses.field(
         default_factory=OptimizationsConfig
     )
+    registry: RegistryConfig = dataclasses.field(default_factory=RegistryConfig)
     environment: Dict[str, Any] = dataclasses.field(default_factory=dict)
     data: Dict[str, Any] = dataclasses.field(default_factory=dict)
     profiling: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -702,6 +754,8 @@ class ExperimentConfig:
             kwargs["optimizations"] = OptimizationsConfig.parse(raw.pop("optimizations"))
         if "fault_tolerance" in raw:
             kwargs["fault_tolerance"] = FaultToleranceConfig.parse(raw.pop("fault_tolerance"))
+        if "registry" in raw:
+            kwargs["registry"] = RegistryConfig.parse(raw.pop("registry"))
         if "lint" in raw:
             kwargs["lint"] = LintConfig.parse(raw.pop("lint"))
         if "observability" in raw:

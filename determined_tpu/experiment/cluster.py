@@ -689,7 +689,10 @@ class ClusterExperiment:
                 else:
                     # dtpu: lint-ok[unlocked-shared-state] (same argument)
                     self.journal.append("experiment_completed")
-            return self.summary()
+            summary = self.summary()
+            if self.status == "completed":
+                self.on_search_complete(summary)
+            return summary
         finally:
             if self.journal is not None:
                 # Safe unlocked: watcher threads are joined by this point.
@@ -898,6 +901,62 @@ class ClusterExperiment:
     def resume(self) -> Dict[str, Any]:
         """Replay the driver journal and continue the search."""
         return self.run(resume=True)
+
+    # -- registry promotion (docs/registry.md) -----------------------------
+
+    def on_search_complete(self, summary: Dict[str, Any]) -> None:
+        """End-of-search hook: with ``registry: {model, auto_promote}``
+        configured, register the best trial's final checkpoint as the
+        model's next version through the master we already hold a session
+        to.  The checkpoint uuid is the master-tracked one, so the master
+        fills the rest of the lineage itself (source experiment, storage
+        path, metrics snapshot at the checkpoint's step) and its GC pins
+        the checkpoint.  Promotion failure is reported in the summary
+        (``registry_error``), never raised — it must not fail a finished
+        search."""
+        rcfg = self.config.registry
+        if not (rcfg.model and rcfg.auto_promote):
+            return
+        from determined_tpu.experiment import registry as registry_mod
+
+        def report(msg: str) -> None:
+            summary["registry_error"] = msg
+            logger.warning("registry: %s", msg)
+
+        try:
+            best_rid = summary.get("best_trial")
+            if best_rid is None:
+                return report("search produced no best trial to promote")
+            result = self.results[best_rid]
+            if not result.checkpoint:
+                return report(
+                    f"best trial {best_rid} reported no checkpoint to promote"
+                )
+            with self._state_lock:
+                watch = self._watches.get(best_rid)
+            promoted = registry_mod.promote_search_winner(
+                self.session,
+                model=rcfg.model,
+                labels=rcfg.labels,
+                checkpoint_uuid=result.checkpoint,
+                storage_path=None,  # master derives it from its own record
+                source_trial_id=watch.master_trial_id if watch else None,
+                source_experiment_id=self.master_experiment_id,
+                metrics=dict(result.metrics or {}),
+            )
+            summary["registry"] = promoted
+            if self.journal is not None:
+                # Safe unlocked: watcher threads are joined by this point.
+                # dtpu: lint-ok[unlocked-shared-state]
+                self.journal.append(
+                    "model_registered",
+                    name=promoted["model"],
+                    version=promoted["version"],
+                    uuid=result.checkpoint,
+                )
+        except Exception as e:  # noqa: BLE001 - promotion must not kill the run
+            logger.exception("registry: auto-promotion failed")
+            summary["registry_error"] = str(e)
 
     # -- summary -----------------------------------------------------------
 

@@ -24,6 +24,9 @@ single-host analog — an append-only, fsynced JSONL file at
                            re-derives the same budget horizon)
 - ``trial_result``         rid, the completed TrialResult payload
 - ``trial_exited`` / ``trial_exited_early``   searcher lifecycle events
+- ``model_registered``     registry promotion (name, version, checkpoint
+                           uuid): a resumed experiment keeps pinning the
+                           promoted checkpoint against its GC pass
 - ``experiment_preempted`` / ``experiment_completed``   terminal status
 
 Consistency model: ``JournaledSearcher`` appends each searcher event AND a
@@ -117,6 +120,9 @@ class ExperimentJournal:
         self._checkpoints: Dict[int, Dict[str, Any]] = {}
         self._clones: Dict[int, Dict[str, Any]] = {}
         self._results: Dict[int, Dict[str, Any]] = {}
+        # (model name, version) -> registration record; registry-promoted
+        # checkpoints stay pinned across compaction and resume
+        self._registered: Dict[Any, Dict[str, Any]] = {}
         self._status: Optional[Dict[str, Any]] = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -269,6 +275,8 @@ class ExperimentJournal:
             self._clones[int(rec["rid"])] = rec
         elif t == "trial_result":
             self._results[int(rec["rid"])] = rec
+        elif t == "model_registered":
+            self._registered[(rec.get("name"), rec.get("version"))] = rec
         elif t in ("experiment_preempted", "experiment_completed"):
             self._status = rec
 
@@ -285,6 +293,9 @@ class ExperimentJournal:
         records.extend(self._clones[r] for r in sorted(self._clones))
         records.extend(self._checkpoints[r] for r in sorted(self._checkpoints))
         records.extend(self._results[r] for r in sorted(self._results))
+        records.extend(
+            self._registered[k] for k in sorted(self._registered, key=str)
+        )
         if self._status is not None:
             records.append(self._status)
         tmp = self.path + ".compact"
@@ -345,6 +356,11 @@ class JournalReplay:
     clones: Dict[int, Dict[str, Any]]          # rid -> {source, uuid, steps}
     results: Dict[int, Dict[str, Any]]         # rid -> TrialResult payload
     status: str                                # running|preempted|completed
+    # registry promotions ({name, version, uuid}): resume keeps these
+    # checkpoints pinned against the retention pass
+    registered_models: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
     # cluster-driven searches (experiment/cluster.py): which master owns
     # trial execution, so a resumed driver re-attaches to the same
     # experiment instead of starting a new one
@@ -373,6 +389,7 @@ def read_journal(path: str) -> JournalReplay:
     checkpoints: Dict[int, str] = {}
     clones: Dict[int, Dict[str, Any]] = {}
     results: Dict[int, Dict[str, Any]] = {}
+    registered: List[Dict[str, Any]] = []
     status = "running"
     for rec in records:
         t = rec.get("type")
@@ -399,6 +416,14 @@ def read_journal(path: str) -> JournalReplay:
                 checkpoints[int(rec["rid"])] = rec["uuid"]
         elif t == "trial_result":
             results[int(rec["rid"])] = rec.get("result") or {}
+        elif t == "model_registered":
+            registered.append(
+                {
+                    "name": rec.get("name"),
+                    "version": rec.get("version"),
+                    "uuid": rec.get("uuid"),
+                }
+            )
         elif t == "experiment_preempted":
             status = "preempted"
         elif t == "experiment_completed":
@@ -419,6 +444,7 @@ def read_journal(path: str) -> JournalReplay:
         results=results,
         status=status,
         cluster=cluster,
+        registered_models=registered,
     )
 
 

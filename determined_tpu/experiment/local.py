@@ -30,7 +30,7 @@ import signal
 import tempfile
 import threading
 import time
-from typing import Any, Dict, List, Optional, Type
+from typing import Any, Dict, List, Optional, Set, Type
 
 from determined_tpu import core
 from determined_tpu.config.experiment import (
@@ -107,9 +107,13 @@ class LocalExperiment:
         seed: Optional[int] = None,
         devices: Optional[List[Any]] = None,
         preflight: Optional[bool] = None,
+        session: Optional[Any] = None,
     ) -> None:
         self.config = config
         self.trial_cls = trial_cls
+        # master session for registry promotion (config `registry:`); a
+        # masterless run falls back to $DTPU_MASTER, else skips promotion
+        self._session = session
         # None = follow config.lint.preflight (on by default)
         self.preflight = preflight
         self.checkpoint_dir = checkpoint_dir or os.path.join(
@@ -134,8 +138,12 @@ class LocalExperiment:
         # inherited steps, and a crash-resume must re-derive the same
         # horizon, so the value rides in the journal's trial_cloned record
         self._clone_base_steps: Dict[int, int] = {}
-        # guards the two checkpoint maps above: trial threads write them
-        # mid-run while the GC pass and the drain path iterate them
+        # registry-promoted checkpoint uuids: pinned against the retention
+        # pass for as long as the registry names them (docs/registry.md)
+        self._registry_pinned: Set[str] = set()
+        # guards the checkpoint maps above (incl. the registry pins):
+        # trial threads write them mid-run while the GC pass and the
+        # drain path iterate them
         self._ckpt_lock = threading.Lock()
         self._gc_thread: Optional[threading.Thread] = None
         # rid -> core Context.  COPY-ON-WRITE: writers (trial threads)
@@ -493,7 +501,10 @@ class LocalExperiment:
                     self.journal.append("experiment_preempted", in_flight=in_flight)
                 else:
                     self.journal.append("experiment_completed")
-            return self.summary()
+            summary = self.summary()
+            if self.status == "completed":
+                self.on_search_complete(summary)
+            return summary
         finally:
             gc_thread = self._gc_thread
             if gc_thread is not None:
@@ -657,6 +668,11 @@ class LocalExperiment:
         for rid, clone in replay.clones.items():
             with self._ckpt_lock:
                 self._clone_base_steps[rid] = int(clone.get("steps") or 0)
+        # registry promotions keep pinning their checkpoints after resume
+        with self._ckpt_lock:
+            self._registry_pinned.update(
+                reg["uuid"] for reg in replay.registered_models if reg.get("uuid")
+            )
         # in-flight trials re-queue from their latest VERIFIED checkpoint
         # (manifest check + parent-lineage fallback); with no usable
         # checkpoint they restart from scratch
@@ -939,8 +955,11 @@ class LocalExperiment:
             with self._ckpt_lock:
                 # the journal references these by uuid as resume points; a
                 # crash-resume must find them even when the per-trial
-                # count would rotate them out
+                # count would rotate them out — and a registry-promoted
+                # checkpoint is pinned for as long as the registry names
+                # it (the serve tier may be launched from it at any time)
                 protected = set(self._journaled_ckpts.values())
+                protected |= self._registry_pinned
             outcome = gc_checkpoints.apply_retention(
                 self.checkpoint_dir,
                 policy=gc_checkpoints.RetentionPolicy(
@@ -1036,6 +1055,67 @@ class LocalExperiment:
             # classifying failures must not see a mode-dependent wrapper)
             logger.error("trial %d failed during concurrent search", rid)
             raise exc
+
+    # -- registry promotion (docs/registry.md) -----------------------------
+
+    def on_search_complete(self, summary: Dict[str, Any]) -> None:
+        """End-of-search hook: with ``registry: {model, auto_promote}``
+        configured, register the best trial's final manifest-verified
+        checkpoint as the model's next version (``name@vN``) with lineage
+        back to this trial.  Promotion failure must not fail a finished
+        search — it lands in ``summary["registry_error"]`` and the logs,
+        never as an exception; success lands in ``summary["registry"]``
+        and a ``model_registered`` journal record that pins the promoted
+        checkpoint against the retention pass (also across resume)."""
+        rcfg = self.config.registry
+        if not (rcfg.model and rcfg.auto_promote):
+            return
+        from determined_tpu.experiment import registry as registry_mod
+
+        def report(msg: str) -> None:
+            summary["registry_error"] = msg
+            logger.warning("registry: %s", msg)
+
+        try:
+            session = registry_mod.registry_session(self._session)
+            if session is None:
+                return report(
+                    "registry.auto_promote set but no master configured "
+                    "(pass session= or set DTPU_MASTER)"
+                )
+            best_rid = summary.get("best_trial")
+            if best_rid is None:
+                return report("search produced no best trial to promote")
+            result = self.results[best_rid]
+            sid = self._verified_resume_checkpoint(best_rid, result.checkpoint)
+            if sid is None:
+                return report(
+                    f"best trial {best_rid} has no manifest-verified checkpoint"
+                )
+            promoted = registry_mod.promote_search_winner(
+                session,
+                model=rcfg.model,
+                labels=rcfg.labels,
+                checkpoint_uuid=sid,
+                storage_path=os.path.abspath(
+                    os.path.join(self._trial_checkpoint_dir(best_rid), sid)
+                ),
+                source_trial_id=best_rid,
+                metrics=dict(result.metrics or {}),
+            )
+            summary["registry"] = promoted
+            with self._ckpt_lock:
+                self._registry_pinned.add(sid)
+            if self.journal is not None:
+                self.journal.append(
+                    "model_registered",
+                    name=promoted["model"],
+                    version=promoted["version"],
+                    uuid=sid,
+                )
+        except Exception as e:  # noqa: BLE001 - promotion must not kill the run
+            logger.exception("registry: auto-promotion failed")
+            summary["registry_error"] = str(e)
 
     def summary(self) -> Dict[str, Any]:
         scfg = self.config.searcher

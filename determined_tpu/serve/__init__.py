@@ -85,13 +85,22 @@ class ServeWorker:
         session: Optional[Any] = None,
         model: str = "",
         checkpoint: str = "",
+        model_name: str = "",
+        model_version: int = 0,
     ) -> None:
         self.engine = engine
         self.http = ServeHTTPServer(engine, host=host, port=port)
         self._session = session
         self._model = model
         self._checkpoint = checkpoint
+        self._model_name = model_name
+        self._model_version = model_version
         self.replica: Optional[ReplicaRegistration] = None
+        # set from the heartbeat thread when the master asks this replica
+        # to drain (rolling deploy); plain attribute writes so the serve
+        # main loop can poll it next to its signal flag
+        self._master_drain = False
+        self.master_drain_info: Dict[str, Any] = {}
 
     def start(self) -> str:
         """Start engine + HTTP (+ master registration when a session was
@@ -104,11 +113,25 @@ class ServeWorker:
                 url=self.http.url,
                 model=self._model,
                 checkpoint=self._checkpoint,
+                model_name=self._model_name,
+                model_version=self._model_version,
                 heartbeat_interval_s=self.engine.cfg.heartbeat_interval_s,
                 stats_fn=self.engine.stats,
+                on_drain=self._on_master_drain,
             ).start()
         logger.info("serving replica up at %s", self.http.url)
         return self.http.url
+
+    def _on_master_drain(self, info: Dict[str, Any]) -> None:
+        # heartbeat-thread context: attribute writes only (the main loop
+        # polls master_drain_requested and runs the actual drain)
+        self.master_drain_info = dict(info)
+        self._master_drain = True
+
+    def master_drain_requested(self) -> bool:
+        """True once the master's heartbeat response asked for a drain
+        (rolling deploy walking this replica)."""
+        return self._master_drain
 
     def request_drain(self) -> None:
         """Close admission: /healthz flips to draining, new generations

@@ -8,13 +8,20 @@ replica whose heartbeat goes stale (crash, partition, SIGKILL), so
 ``GET /api/v1/serving`` is always the live routing table.  A heartbeat
 answered 404 means the master forgot us (restart, prune race): the thread
 re-registers with the same payload rather than dying.
+
+The heartbeat response is also the master's only channel TO the worker:
+during a rolling deploy (``POST /api/v1/serving/deploy``) the master
+answers the draining replica's heartbeat with ``{"drain": true, "deploy":
+{...target...}}`` — the worker then runs its normal drain (503-new,
+finish in-flight, deregister, exit 75) and whatever supervises it
+relaunches it on the target version.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import requests
 
@@ -33,8 +40,11 @@ class ReplicaRegistration:
         url: str,
         model: str = "",
         checkpoint: str = "",
+        model_name: str = "",
+        model_version: int = 0,
         heartbeat_interval_s: float = 2.0,
         stats_fn: Optional[Any] = None,
+        on_drain: Optional[Callable[[Dict[str, Any]], None]] = None,
     ) -> None:
         self._session = session
         self._payload: Dict[str, Any] = {
@@ -42,7 +52,17 @@ class ReplicaRegistration:
             "model": model,
             "checkpoint": checkpoint,
         }
+        if model_name:
+            # registry-launched (--model name@version): the resolved
+            # version rides registration so the listing shows it
+            self._payload["model_name"] = model_name
+            self._payload["model_version"] = int(model_version)
         self._interval = heartbeat_interval_s
+        #: called once (from the heartbeat thread) when the master's
+        #: heartbeat response asks this replica to drain (rolling deploy)
+        self._on_drain = on_drain
+        self.drain_requested = threading.Event()
+        self.drain_info: Dict[str, Any] = {}
         #: zero-arg callable whose dict rides each heartbeat, surfacing
         #: queue depth / kv utilization in the master's replica listing
         self._stats_fn = stats_fn
@@ -87,11 +107,12 @@ class ReplicaRegistration:
                 except Exception:  # noqa: BLE001 - stats must not kill liveness
                     logger.exception("stats collection failed; heartbeat without")
             try:
-                self._session.post(
+                resp = self._session.post(
                     f"/api/v1/serving/replicas/{rid}/heartbeat",
                     json=body,
                     retry=False,
                 )
+                self._handle_heartbeat_response(resp)
             except NotFoundError:
                 # master forgot us (restart or prune race): re-register.
                 # The catch is deliberately broad — register() uses a
@@ -126,6 +147,30 @@ class ReplicaRegistration:
                 logger.warning("heartbeat failed for replica %s", rid)
             except Exception:  # noqa: BLE001 - the heartbeat must survive
                 logger.exception("heartbeat error for replica %s", rid)
+
+    def _handle_heartbeat_response(self, resp: Any) -> None:
+        """The master's answer may carry a rolling-deploy drain request."""
+        try:
+            data = resp.json()
+        except ValueError:
+            return
+        if not isinstance(data, dict) or not data.get("drain"):
+            return
+        if not self.drain_requested.is_set():
+            # safe unlocked: published BEFORE drain_requested.set(), and
+            # every reader gates on that Event (release/acquire ordering)
+            # dtpu: lint-ok[unlocked-shared-state]
+            self.drain_info = dict(data.get("deploy") or {})
+            self.drain_requested.set()
+            logger.info(
+                "master requested drain (rolling deploy -> %s)",
+                self.drain_info.get("target") or "?",
+            )
+            if self._on_drain is not None:
+                try:
+                    self._on_drain(self.drain_info)
+                except Exception:  # noqa: BLE001 - must not kill the heartbeat
+                    logger.exception("on_drain callback failed")
 
     # -- shutdown ------------------------------------------------------------
 

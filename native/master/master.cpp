@@ -207,13 +207,60 @@ struct GenericTaskState {
 struct ServeReplicaState {
   std::string id;          // "replica-N"
   std::string url;         // where the worker serves /v1/generate
-  std::string model;       // operator-facing label (trial class / name)
+  std::string model;       // operator-facing label (registry name@vN, or
+                           // the trial class name for raw-path launches)
   std::string checkpoint;  // checkpoint path/uuid the replica loaded
+  std::string model_name;     // registry model when launched via --model
+  int64_t model_version = 0;  // registry version number (0 = raw path)
   std::string owner;
   int64_t registered_ms = 0;
   int64_t last_heartbeat_ms = 0;
   Json stats = Json::object();  // last heartbeat's stats payload, if any
 };
+
+// One rolling deployment of a registry model version onto the serving
+// fleet (POST /api/v1/serving/deploy): the master walks the registered
+// replicas one at a time through the serve worker's existing drain
+// machinery (503-new / finish-in-flight / exit 75) by flagging the
+// draining replica in its heartbeat response; whatever supervises the
+// worker relaunches it on the target version and the roll advances when
+// the replacement registers.  At most one deploy is active.  Ephemeral
+// like ServeReplicaState itself — the replica table it walks is rebuilt
+// from re-registrations after a master restart, so an in-flight deploy
+// is forgotten with it (re-POST to resume the roll; the registry VERSION
+// being deployed is journaled and survives).
+struct DeployState {
+  int64_t id = 0;
+  std::string model;          // registry model name
+  int64_t version = 0;        // target version number
+  std::string target;         // "name@vN" — the label replicas report
+  std::string checkpoint_uuid;
+  std::string storage_path;
+  std::vector<std::string> pending;  // replica ids still to roll, in order
+  std::string draining;              // replica currently asked to drain
+  std::vector<std::string> rolled;   // replicas that completed their drain
+  std::string status = "rolling";    // rolling|completed|failed
+  std::string detail;
+  int64_t started_ms = 0;
+  int64_t updated_ms = 0;
+  int64_t step_deadline_ms = 0;      // per-phase timeout -> status=failed
+};
+
+// registry helpers: a model json holds {"versions": [{version, ...}]}
+inline const Json* find_model_version(const Json& model, int64_t v) {
+  for (const auto& ver : model["versions"].elements()) {
+    if (ver["version"].as_int() == v) return &ver;
+  }
+  return nullptr;
+}
+
+inline int64_t latest_model_version(const Json& model) {
+  int64_t latest = 0;
+  for (const auto& ver : model["versions"].elements()) {
+    latest = std::max(latest, ver["version"].as_int());
+  }
+  return latest;
+}
 
 // First-class workspace entity (reference master/internal/api_project.go +
 // rbac/: workspaces own experiments, carry archival state, and scope role
@@ -510,6 +557,7 @@ class Master {
 
   void set_agent_timeout_ms(int64_t ms) { agent_timeout_ms_ = ms; }
   void set_serve_replica_timeout_ms(int64_t ms) { serve_replica_timeout_ms_ = ms; }
+  void set_deploy_step_timeout_ms(int64_t ms) { deploy_step_timeout_ms_ = ms; }
   void set_scheduler(const std::string& mode) { scheduler_mode_ = mode; }
   void set_reattach_grace_ms(int64_t ms) { reattach_grace_ms_ = ms; }
   void set_journal_fsync(bool on) { journal_fsync_ = on; }
@@ -569,6 +617,11 @@ class Master {
       allocs.push_back(j);
     }
     out.set("allocations", allocs);
+    // model registry: journaled like everything else, so a torn
+    // model_version record must be observable in the replay digest
+    Json models = Json::array();
+    for (const auto& [name, model] : models_) models.push_back(model);
+    out.set("models", models);
     return out;
   }
 
@@ -715,6 +768,239 @@ class Master {
         ++it;
       }
     }
+  }
+
+  // ---- model registry + rolling deploy -----------------------------------
+
+  // every checkpoint uuid some model version references: pinned against
+  // GC for as long as the registry names it (a promoted model must
+  // survive best-k rotation)
+  std::set<std::string> registry_pinned_uuids() const {
+    std::set<std::string> out;
+    for (const auto& [name, model] : models_) {
+      for (const auto& ver : model["versions"].elements()) {
+        const std::string& u = ver["checkpoint_uuid"].as_string();
+        if (!u.empty()) out.insert(u);
+      }
+    }
+    return out;
+  }
+
+  // Register {name}@vN — the shared core of POST /models/{name}/versions
+  // and /models/{name}/promote.  Caller holds mu_.  Lineage the body does
+  // not carry is filled from master-side state when the checkpoint is
+  // known here (source trial/experiment, metrics snapshot at the
+  // checkpoint's step, shared-fs storage path); a driver-local checkpoint
+  // the master never saw must carry its own storage_path.  Idempotent:
+  // re-registering an existing version with the SAME checkpoint is a
+  // 200 no-op (driver retries after a lost response must not mint
+  // duplicates); a taken version number with a DIFFERENT checkpoint, or
+  // a non-contiguous explicit version, is a 409.  Returns the HTTP
+  // status; *out is the version json (or {"error": ...}).
+  int do_register_model_version(const std::string& name, const Json& body,
+                                Json* out) {
+    auto reject = [&](int code, const std::string& msg) {
+      *out = Json::object().set("error", msg);
+      return code;
+    };
+    auto it = models_.find(name);
+    if (it == models_.end()) return reject(404, "no such model");
+    const std::string uuid = body["checkpoint_uuid"].as_string();
+    if (uuid.empty()) return reject(400, "checkpoint_uuid required");
+    std::string storage_path = body["storage_path"].as_string();
+    int64_t source_trial = body["source_trial_id"].as_int(0);
+    int64_t source_exp = body["source_experiment_id"].as_int(0);
+    Json metrics = body.contains("metrics") ? body["metrics"] : Json::object();
+    auto cit = checkpoints_.find(uuid);
+    if (cit == checkpoints_.end() && storage_path.empty()) {
+      return reject(404,
+                    "no such checkpoint (a checkpoint the master never saw "
+                    "must be registered with storage_path)");
+    }
+    if (cit != checkpoints_.end()) {
+      int64_t tid = cit->second["trial_id"].as_int();
+      if (source_trial == 0) source_trial = tid;
+      auto tit = trials_.find(tid);
+      if (tit != trials_.end()) {
+        if (source_exp == 0) source_exp = tit->second.experiment_id;
+        if (metrics.size() == 0) {
+          // metrics snapshot: the validation reported at the checkpoint's
+          // step, when the master has one
+          int64_t step = cit->second["metadata"]["steps_completed"].as_int(0);
+          auto vit = tit->second.val_by_step.find(step);
+          if (vit != tit->second.val_by_step.end()) {
+            metrics.set("validation", Json(vit->second)).set("step", Json(step));
+          }
+        }
+        if (storage_path.empty()) {
+          auto eit = experiments_.find(tit->second.experiment_id);
+          if (eit != experiments_.end()) {
+            const std::string root =
+                eit->second.config["checkpoint_storage"]["host_path"].as_string();
+            if (!root.empty()) storage_path = root + "/" + uuid;
+          }
+        }
+      }
+    }
+    Json& model = it->second;
+    const int64_t next_v = latest_model_version(model) + 1;
+    const int64_t want = body["version"].as_int(0);
+    const Json* existing = nullptr;
+    if (want > 0) {
+      existing = find_model_version(model, want);
+    } else if (next_v > 1) {
+      const Json* latest = find_model_version(model, next_v - 1);
+      if (latest != nullptr &&
+          (*latest)["checkpoint_uuid"].as_string() == uuid) {
+        existing = latest;  // implicit re-register of the latest version
+      }
+    }
+    if (existing != nullptr) {
+      if ((*existing)["checkpoint_uuid"].as_string() == uuid) {
+        *out = *existing;
+        return 200;  // idempotent no-op: nothing journaled
+      }
+      return reject(409, name + "@v" + std::to_string(want) +
+                             " already exists with a different checkpoint");
+    }
+    if (want > 0 && want != next_v) {
+      return reject(409, "next version of " + name + " is v" +
+                             std::to_string(next_v) + " (got v" +
+                             std::to_string(want) + ")");
+    }
+    Json version = Json::object();
+    version.set("version", Json(next_v));
+    version.set("checkpoint_uuid", uuid);
+    version.set("storage_path", storage_path);
+    version.set("source_trial_id", Json(source_trial));
+    version.set("source_experiment_id", Json(source_exp));
+    version.set("metrics", metrics);
+    version.set("labels", body.contains("labels") ? body["labels"] : Json::array());
+    version.set("name", body.contains("name") ? body["name"] : Json(""));
+    version.set("notes", body.contains("notes") ? body["notes"] : Json(""));
+    version.set("creation_time", Json(now_ms()));
+    Json versions = model["versions"];
+    versions.push_back(version);
+    model.set("versions", versions);
+    record(Json::object()
+               .set("type", "model_version")
+               .set("name", name)
+               .set("version", version));
+    printf("master: registered model %s@v%lld (checkpoint %s)\n", name.c_str(),
+           static_cast<long long>(next_v), uuid.c_str());
+    fflush(stdout);
+    *out = version;
+    return 201;
+  }
+
+  Json deploy_json() const {
+    Json j = Json::object();
+    j.set("id", Json(deploy_.id));
+    j.set("model", deploy_.model);
+    j.set("version", Json(deploy_.version));
+    j.set("target", deploy_.target);
+    j.set("checkpoint_uuid", deploy_.checkpoint_uuid);
+    j.set("storage_path", deploy_.storage_path);
+    Json pending = Json::array();
+    for (const auto& r : deploy_.pending) pending.push_back(r);
+    j.set("pending", pending);
+    j.set("draining", deploy_.draining);
+    Json rolled = Json::array();
+    for (const auto& r : deploy_.rolled) rolled.push_back(r);
+    j.set("rolled", rolled);
+    j.set("status", deploy_.status);
+    j.set("detail", deploy_.detail);
+    j.set("started_ms", Json(deploy_.started_ms));
+    j.set("updated_ms", Json(deploy_.updated_ms));
+    return j;
+  }
+
+  // Is this replica serving the active deploy's target version?  Prefer
+  // the structured model_name/model_version fields a --model launch
+  // registers (the display label is operator-overridable via
+  // --model-name); fall back to the canonical label for older workers.
+  bool replica_on_deploy_target(const ServeReplicaState& rep) const {
+    if (!rep.model_name.empty()) {
+      return rep.model_name == deploy_.model &&
+             rep.model_version == deploy_.version;
+    }
+    return rep.model == deploy_.target;
+  }
+
+  // Rolling-deploy state machine; caller holds mu_.  Driven from the 2s
+  // tick plus every replica register/deregister, so the roll advances at
+  // event latency, not poll cadence.  Invariants: at most one replica is
+  // draining at a time, and every drained replica must be ANSWERED by a
+  // replica on the target version that registered AFTER the roll started
+  // (pre-existing on-target replicas are capacity the fleet already had,
+  // not replacements) before the next one drains — one-at-a-time
+  // replacement is the zero-downtime contract.
+  void advance_rolling_deploy() {
+    if (!deploy_active_ || deploy_.status != "rolling") return;
+    const int64_t now = now_ms();
+    int64_t replacements = 0;
+    for (const auto& [rid, rep] : serve_replicas_) {
+      if (replica_on_deploy_target(rep) &&
+          rep.registered_ms > deploy_.started_ms) {
+        ++replacements;
+      }
+    }
+    if (!deploy_.draining.empty()) {
+      if (serve_replicas_.count(deploy_.draining)) {
+        if (now > deploy_.step_deadline_ms) {
+          deploy_.status = "failed";
+          deploy_.detail =
+              "replica " + deploy_.draining + " did not drain in time";
+          deploy_.updated_ms = now;
+          printf("master: rolling deploy %lld FAILED: %s\n",
+                 static_cast<long long>(deploy_.id), deploy_.detail.c_str());
+          fflush(stdout);
+        }
+        return;  // still draining; its heartbeats keep carrying the flag
+      }
+      // gone (deregistered on drain, or pruned): now await its replacement
+      deploy_.rolled.push_back(deploy_.draining);
+      deploy_.draining.clear();
+      deploy_.step_deadline_ms = now + deploy_step_timeout_ms_;
+      deploy_.updated_ms = now;
+    }
+    if (replacements < static_cast<int64_t>(deploy_.rolled.size())) {
+      if (now > deploy_.step_deadline_ms) {
+        deploy_.status = "failed";
+        deploy_.detail = "no replacement replica serving " + deploy_.target +
+                         " registered in time";
+        deploy_.updated_ms = now;
+        printf("master: rolling deploy %lld FAILED: %s\n",
+               static_cast<long long>(deploy_.id), deploy_.detail.c_str());
+        fflush(stdout);
+      }
+      return;  // replacement gate
+    }
+    while (!deploy_.pending.empty()) {
+      const std::string rid = deploy_.pending.front();
+      auto it = serve_replicas_.find(rid);
+      if (it == serve_replicas_.end() ||
+          replica_on_deploy_target(it->second)) {
+        // pruned, relaunched under a new id, or already on target
+        deploy_.pending.erase(deploy_.pending.begin());
+        continue;
+      }
+      deploy_.pending.erase(deploy_.pending.begin());
+      deploy_.draining = rid;
+      deploy_.step_deadline_ms = now + deploy_step_timeout_ms_;
+      deploy_.updated_ms = now;
+      printf("master: rolling deploy %lld: draining replica %s -> %s\n",
+             static_cast<long long>(deploy_.id), rid.c_str(),
+             deploy_.target.c_str());
+      fflush(stdout);
+      return;
+    }
+    deploy_.status = "completed";
+    deploy_.updated_ms = now;
+    printf("master: rolling deploy %lld completed: %zu replica(s) now on %s\n",
+           static_cast<long long>(deploy_.id), deploy_.rolled.size(),
+           deploy_.target.c_str());
+    fflush(stdout);
   }
 
   // Fail agents that stopped polling: their allocations are failed so the
@@ -1767,6 +2053,10 @@ class Master {
         keep.insert(mine_metric[static_cast<size_t>(i)]->uuid);
       }
     }
+    // registry-referenced checkpoints are pinned: promoting a model must
+    // protect its checkpoint against best-k rotation (the serve tier may
+    // be launched from it at any time)
+    for (const auto& uuid : registry_pinned_uuids()) keep.insert(uuid);
     std::vector<std::string> to_delete;
     for (const auto& ck : cks) {
       if (!keep.count(ck.uuid)) to_delete.push_back(ck.uuid);
@@ -3597,6 +3887,11 @@ class Master {
   std::map<std::string, ServeReplicaState> serve_replicas_;
   int64_t next_replica_id_ = 1;
   int64_t serve_replica_timeout_ms_ = 15000;  // reap silent replicas
+  // rolling serve deploy (advance_rolling_deploy): at most one active
+  DeployState deploy_;
+  bool deploy_active_ = false;
+  int64_t next_deploy_id_ = 1;
+  int64_t deploy_step_timeout_ms_ = 180000;
   std::deque<Json> events_;  // recent journal events for /api/v1/events
   std::map<std::string, int64_t> log_batch_seq_;  // trial/allocation -> last seq
   std::map<std::string, std::set<int>> coord_ports_in_use_;  // host -> ports
@@ -5213,25 +5508,35 @@ void install_routes_impl(Master& m, HttpServer& srv) {
   srv.route("POST", "/api/v1/models/{name}/versions", authed([&m](const HttpRequest& req) {
     Json body;
     if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
-    std::string uuid = body["checkpoint_uuid"].as_string();
     std::lock_guard<std::mutex> lk(m.mu_);
-    auto it = m.models_.find(req.params.at("name"));
-    if (it == m.models_.end()) return R::error(404, "no such model");
-    if (!m.checkpoints_.count(uuid)) return R::error(404, "no such checkpoint");
-    Json version = Json::object();
-    version.set("version", Json(static_cast<int64_t>(it->second["versions"].size()) + 1));
-    version.set("checkpoint_uuid", uuid);
-    version.set("name", body.contains("name") ? body["name"] : Json(""));
-    version.set("notes", body.contains("notes") ? body["notes"] : Json(""));
-    version.set("creation_time", Json(now_ms()));
-    Json versions = it->second["versions"];
-    versions.push_back(version);
-    it->second.set("versions", versions);
-    m.record(Json::object()
-                 .set("type", "model_version")
-                 .set("name", req.params.at("name"))
-                 .set("version", version));
-    return R::json(version.dump(), 201);
+    Json out;
+    int code = m.do_register_model_version(req.params.at("name"), body, &out);
+    if (code >= 400) return R::error(code, out["error"].as_string());
+    return R::json(out.dump(), code);
+  }));
+
+  // promote a trial's latest checkpoint to the next version of {name}:
+  // the registry resolves lineage (checkpoint uuid, experiment, metrics
+  // snapshot, storage path) master-side, so the caller only names WHAT
+  // to promote, not where it lives
+  srv.route("POST", "/api/v1/models/{name}/promote", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto tit = m.trials_.find(body["trial_id"].as_int());
+    if (tit == m.trials_.end()) return R::error(404, "no such trial");
+    if (tit->second.latest_checkpoint.empty()) {
+      return R::error(409, "trial has no checkpoint to promote");
+    }
+    Json reg = Json::object();
+    reg.set("checkpoint_uuid", tit->second.latest_checkpoint);
+    if (body.contains("labels")) reg.set("labels", body["labels"]);
+    if (body.contains("metrics")) reg.set("metrics", body["metrics"]);
+    if (body.contains("version")) reg.set("version", body["version"]);
+    Json out;
+    int code = m.do_register_model_version(req.params.at("name"), reg, &out);
+    if (code >= 400) return R::error(code, out["error"].as_string());
+    return R::json(out.dump(), code);
   }));
 
   srv.route("GET", "/api/v1/models/{name}/versions", authed([&m](const HttpRequest& req) {
@@ -5239,6 +5544,23 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     auto it = m.models_.find(req.params.at("name"));
     if (it == m.models_.end()) return R::error(404, "no such model");
     return R::json(it->second["versions"].dump());
+  }));
+
+  // resolve one version ({version} = N or "latest"): what `dtpu serve
+  // --model name@version` and `dtpu model show/pull` load from
+  srv.route("GET", "/api/v1/models/{name}/versions/{version}",
+            authed([&m](const HttpRequest& req) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.models_.find(req.params.at("name"));
+    if (it == m.models_.end()) return R::error(404, "no such model");
+    const std::string& vs = req.params.at("version");
+    int64_t v = vs == "latest" ? latest_model_version(it->second)
+                               : std::atoll(vs.c_str());
+    const Json* ver = find_model_version(it->second, v);
+    if (ver == nullptr) return R::error(404, "no such version");
+    Json out = *ver;
+    out.set("model", req.params.at("name"));
+    return R::json(out.dump());
   }));
 
   // ---- allocations: preemption long-poll + ack ----
@@ -5808,10 +6130,15 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     rep.url = url;
     rep.model = body["model"].as_string();
     rep.checkpoint = body["checkpoint"].as_string();
+    rep.model_name = body["model_name"].as_string();
+    rep.model_version = body["model_version"].as_int(0);
     rep.owner = m.authenticate(req);
     rep.registered_ms = now_ms();
     rep.last_heartbeat_ms = rep.registered_ms;
     m.serve_replicas_[rep.id] = rep;
+    // a replacement replica registering on the target version is what a
+    // rolling deploy waits for between drains
+    m.advance_rolling_deploy();
     Json out = Json::object();
     out.set("id", rep.id);
     out.set("heartbeat_ttl_ms", Json(m.serve_replica_timeout_ms_));
@@ -5829,7 +6156,21 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     if (it == m.serve_replicas_.end()) return R::error(404, "no such replica");
     it->second.last_heartbeat_ms = now_ms();
     if (has_stats) it->second.stats = body["stats"];
-    return R::json("{}");
+    Json out = Json::object();
+    if (m.deploy_active_ && m.deploy_.status == "rolling" &&
+        m.deploy_.draining == it->second.id) {
+      // the rolling deploy's drain signal rides the heartbeat the worker
+      // was already making: no master->worker channel to invent
+      Json dep = Json::object();
+      dep.set("model", m.deploy_.model);
+      dep.set("version", Json(m.deploy_.version));
+      dep.set("target", m.deploy_.target);
+      dep.set("checkpoint_uuid", m.deploy_.checkpoint_uuid);
+      dep.set("storage_path", m.deploy_.storage_path);
+      out.set("drain", Json(true));
+      out.set("deploy", dep);
+    }
+    return R::json(out.dump());
   }));
 
   srv.route("DELETE", "/api/v1/serving/replicas/{id}",
@@ -5838,6 +6179,8 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     auto it = m.serve_replicas_.find(req.params.at("id"));
     if (it == m.serve_replicas_.end()) return R::error(404, "no such replica");
     m.serve_replicas_.erase(it);
+    // a draining replica deregistering is what advances a rolling deploy
+    m.advance_rolling_deploy();
     return R::json("{}");
   }));
 
@@ -5851,6 +6194,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       j.set("url", rep.url);
       j.set("model", rep.model);
       j.set("checkpoint", rep.checkpoint);
+      if (!rep.model_name.empty()) {
+        j.set("model_name", rep.model_name);
+        j.set("model_version", Json(rep.model_version));
+      }
       j.set("owner", rep.owner);
       j.set("registered_ms", Json(rep.registered_ms));
       j.set("heartbeat_age_ms", Json(now - rep.last_heartbeat_ms));
@@ -5858,6 +6205,60 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       out.push_back(j);
     }
     return R::json(out.dump());
+  }));
+
+  // ---- rolling deployment of a registry version onto the fleet ----
+  srv.route("POST", "/api/v1/serving/deploy", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    const std::string name = body["model"].as_string();
+    if (name.empty()) return R::error(400, "model required");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.models_.find(name);
+    if (it == m.models_.end()) return R::error(404, "no such model");
+    const Json& bv = body["version"];
+    int64_t v = (bv.is_null() || (bv.is_string() && bv.as_string() == "latest"))
+                    ? latest_model_version(it->second)
+                    : bv.as_int();
+    const Json* ver = find_model_version(it->second, v);
+    if (ver == nullptr) return R::error(404, "no such version");
+    if (m.deploy_active_ && m.deploy_.status == "rolling") {
+      return R::error(409, "rolling deploy " + std::to_string(m.deploy_.id) +
+                               " (" + m.deploy_.target + ") is in progress");
+    }
+    DeployState d;
+    d.id = m.next_deploy_id_++;
+    d.model = name;
+    d.version = v;
+    d.target = name + "@v" + std::to_string(v);
+    d.checkpoint_uuid = (*ver)["checkpoint_uuid"].as_string();
+    d.storage_path = (*ver)["storage_path"].as_string();
+    d.started_ms = d.updated_ms = now_ms();
+    d.step_deadline_ms = d.started_ms + m.deploy_step_timeout_ms_;
+    // same on-target predicate as advance_rolling_deploy: structured
+    // fields when registered, display label as the fallback
+    auto on_target = [&d](const ServeReplicaState& rep) {
+      if (!rep.model_name.empty()) {
+        return rep.model_name == d.model && rep.model_version == d.version;
+      }
+      return rep.model == d.target;
+    };
+    for (const auto& [rid, rep] : m.serve_replicas_) {
+      if (!on_target(rep)) d.pending.push_back(rid);
+    }
+    m.deploy_ = d;
+    m.deploy_active_ = true;
+    printf("master: rolling deploy %lld started: %s over %zu replica(s)\n",
+           static_cast<long long>(d.id), d.target.c_str(), d.pending.size());
+    fflush(stdout);
+    m.advance_rolling_deploy();
+    return R::json(m.deploy_json().dump(), 202);
+  }));
+
+  srv.route("GET", "/api/v1/serving/deploy", authed([&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    if (!m.deploy_active_) return R::error(404, "no deploy has been started");
+    return R::json(m.deploy_json().dump());
   }));
 
   // ---- reverse proxy to ready tasks (reference internal/proxy/) ----
@@ -6294,6 +6695,7 @@ int main(int argc, char** argv) {
   int log_retention_days = 0;
   int agent_timeout_sec = 90;
   int serve_replica_timeout_sec = 15;
+  int deploy_step_timeout_sec = 180;
   int reattach_grace_sec = 60;
   bool journal_fsync = true;
   int ingest_max_inflight = 256;
@@ -6323,6 +6725,9 @@ int main(int argc, char** argv) {
     else if (arg == "--serve-replica-timeout-sec")
       serve_replica_timeout_sec =
           std::atoi(next("--serve-replica-timeout-sec").c_str());
+    else if (arg == "--deploy-step-timeout-sec")
+      deploy_step_timeout_sec =
+          std::atoi(next("--deploy-step-timeout-sec").c_str());
     else if (arg == "--reattach-grace-sec")
       reattach_grace_sec = std::atoi(next("--reattach-grace-sec").c_str());
     else if (arg == "--journal-no-fsync") journal_fsync = false;
@@ -6364,6 +6769,8 @@ int main(int argc, char** argv) {
   master.set_agent_timeout_ms(static_cast<int64_t>(agent_timeout_sec) * 1000);
   master.set_serve_replica_timeout_ms(
       static_cast<int64_t>(serve_replica_timeout_sec) * 1000);
+  master.set_deploy_step_timeout_ms(
+      static_cast<int64_t>(deploy_step_timeout_sec) * 1000);
   if (scheduler != "priority" && scheduler != "fair_share") {
     fprintf(stderr, "--scheduler must be priority or fair_share\n");
     return 2;
@@ -6449,6 +6856,7 @@ int main(int argc, char** argv) {
     master.reap_dead_agents();
     master.reap_idle_tasks();
     master.reap_dead_serve_replicas();
+    master.advance_rolling_deploy();
     master.reap_unattached_allocations();
     master.maybe_compact();
     if (++ticks >= 1800) {

@@ -420,8 +420,12 @@ async function refresh() {
     $("models").innerHTML = models.map(m =>
       `<details><summary><b>${esc(m.name)}</b> — ${(m.versions || []).length} version(s)</summary>` +
       table((m.versions || []).map(v => ({version: v.version,
-        checkpoint: v.checkpoint_uuid, notes: v.notes || ""})),
-        ["version", "checkpoint", "notes"]) + `</details>`).join("") || "<p>(none)</p>";
+        checkpoint: v.checkpoint_uuid,
+        trial: v.source_trial_id || "", experiment: v.source_experiment_id || "",
+        metrics: v.metrics ? JSON.stringify(v.metrics) : "",
+        notes: v.notes || ""})),
+        ["version", "checkpoint", "trial", "experiment", "metrics", "notes"]) +
+      `</details>`).join("") || "<p>(none)</p>";
     $("ckpts").innerHTML = table(ckpts.slice(-60).reverse().map(c => ({
       uuid: c.uuid, trial: c.trial_id, step: c.steps_completed,
       state: badge(c.state || "COMPLETED"), _raw_state: 1})),

@@ -262,6 +262,49 @@ class DevCluster:
         assert r.status_code == 201, r.text
         return r.json()["id"]
 
+    # -- model registry + rolling deploy (docs/registry.md) ----------------
+
+    def register_model(self, name, checkpoint_uuid, *, storage_path=None,
+                       version=None, **fields):
+        """Create-if-missing + register a version; returns the version
+        json.  Driver-local checkpoints need ``storage_path``."""
+        r = self.http.post(self.url + "/api/v1/models", json={"name": name})
+        assert r.status_code in (201, 409), r.text
+        body = {"checkpoint_uuid": checkpoint_uuid, **fields}
+        if storage_path:
+            body["storage_path"] = storage_path
+        if version is not None:
+            body["version"] = version
+        r = self.http.post(
+            self.url + f"/api/v1/models/{name}/versions", json=body
+        )
+        assert r.status_code in (200, 201), r.text
+        return r.json()
+
+    def deploy(self, model, version="latest", *, wait=False, timeout=120):
+        """POST a rolling deploy; with ``wait`` poll until it leaves
+        'rolling' (the caller must relaunch drained replicas — the master
+        only signals)."""
+        r = self.http.post(
+            self.url + "/api/v1/serving/deploy",
+            json={"model": model, "version": version},
+        )
+        assert r.status_code == 202, r.text
+        state = r.json()
+        deadline = time.time() + timeout
+        while wait and state["status"] == "rolling" and time.time() < deadline:
+            time.sleep(0.5)
+            state = self.deploy_status()
+        return state
+
+    def deploy_status(self):
+        r = self.http.get(self.url + "/api/v1/serving/deploy", timeout=5)
+        assert r.status_code == 200, r.text
+        return r.json()
+
+    def serving(self):
+        return self.http.get(self.url + "/api/v1/serving", timeout=5).json()
+
     def wait_for_state(self, exp_id, states=("COMPLETED",), timeout=180):
         deadline = time.time() + timeout
         last = None
@@ -351,6 +394,158 @@ def sample_master_events():
          "hparams": {"lr": 0.01}, "source_checkpoint": "", "trial_id": 2},
         {"type": "trial_stop", "trial_id": 2},
     ]
+
+
+def sample_registry_events():
+    """Model-registry journal fixture (WAL tooling tests): one model, two
+    versions with full lineage — each record changes the dump-state
+    digest, so registry prefix truncation is observable."""
+    model = {
+        "name": "wal-model", "description": "", "labels": ["prod"],
+        "metadata": {}, "creation_time": 0, "versions": [],
+    }
+    v1 = {
+        "version": 1, "checkpoint_uuid": "uuid-aaa",
+        "storage_path": "/ck/uuid-aaa", "source_trial_id": 7,
+        "source_experiment_id": 3,
+        "metrics": {"validation_loss": 0.42, "step": 64},
+        "labels": ["best"], "name": "", "notes": "", "creation_time": 0,
+    }
+    v2 = dict(v1, version=2, checkpoint_uuid="uuid-bbb",
+              storage_path="/ck/uuid-bbb")
+    return [
+        {"type": "model_created", "name": "wal-model", "model": model},
+        {"type": "model_version", "name": "wal-model", "version": v1},
+        {"type": "model_version", "name": "wal-model", "version": v2},
+    ]
+
+
+def train_tiny_lm_checkpoint(root: str):
+    """Train a 2-step tiny LMTrial and return (checkpoint_dir, uuid) —
+    the smallest servable artifact (shared with the serving tests'
+    lm_checkpoint fixture shape)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if REPO not in sys.path:  # script-mode invocation (python scripts/...)
+        sys.path.insert(0, REPO)
+    from determined_tpu import core, train
+    from determined_tpu.config import Length
+    from determined_tpu.models.transformer import LMTrial
+    from determined_tpu.parallel.mesh import MeshConfig
+
+    ctx = train.init(
+        hparams={
+            "lr": 1e-3, "global_batch_size": 8, "seq_len": 8, "vocab_size": 64,
+            "d_model": 32, "n_layers": 1, "n_heads": 2, "n_kv_heads": 2,
+            "dataset_size": 32, "bf16": False, "attention": "reference",
+            "warmup_steps": 1,
+        },
+        mesh_config=MeshConfig(data=1),
+        core_context=core._dummy_init(checkpoint_dir=str(root)),
+        seed=0,
+    )
+    trainer = train.Trainer(LMTrial(ctx))
+    result = trainer.fit(Length.batches(2))
+    uuid = result["latest_checkpoint"]
+    assert uuid, "tiny LM training produced no checkpoint"
+    return os.path.join(str(root), uuid), uuid
+
+
+def _spawn_serve(cluster: "DevCluster", *serve_args):
+    """Spawn `dtpu serve` against the cluster master; returns (proc, url,
+    lines) once the worker announces its url."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "determined_tpu.cli", "-m", cluster.url,
+         "serve", *serve_args, "--port", "0", "--block-size", "16",
+         "--num-blocks", "64", "--max-batch", "2", "--max-prompt-len", "8",
+         "--max-new-tokens", "32", "--queue-depth", "8"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    import threading
+
+    lines: list = []
+
+    def pump():
+        for line in proc.stdout:
+            # safe unlocked: list.append is atomic under the GIL and the
+            # scanner only reads whole elements (same pattern as the
+            # serving tests' output pump)
+            lines.append(line.rstrip())  # dtpu: lint-ok[unlocked-shared-state]
+
+    threading.Thread(target=pump, daemon=True).start()
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        for line in lines:
+            if line.startswith("serving on "):
+                return proc, line.split("serving on ", 1)[1].strip(), lines
+        if proc.poll() is not None:
+            raise RuntimeError("serve worker exited early:\n" + "\n".join(lines))
+        time.sleep(0.2)
+    raise RuntimeError("serve worker never announced a url:\n" + "\n".join(lines))
+
+
+def _deploy_smoke(cluster: "DevCluster") -> int:
+    """The train->serve loop smoke: register a checkpoint as a model
+    version, serve it BY NAME, register a v2, and roll the fleet onto it
+    through the master's deploy state machine (drain -> relaunch ->
+    complete).  The harness plays the supervisor that relaunches the
+    drained worker — the master only signals."""
+    ckpt_root = os.path.join(cluster.ckpt_dir, "deploy-smoke")
+    os.makedirs(ckpt_root, exist_ok=True)
+    print("deploy: training a tiny LM checkpoint ...")
+    ckpt_dir, uuid = train_tiny_lm_checkpoint(ckpt_root)
+    v = cluster.register_model("smoke-lm", uuid, storage_path=ckpt_dir)
+    print(f"deploy: registered smoke-lm@v{v['version']} ({uuid})")
+
+    proc, url, lines = _spawn_serve(cluster, "--model", "smoke-lm@latest")
+    print(f"deploy: replica up at {url} serving smoke-lm@v1")
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            reps = cluster.serving()
+            if reps and reps[0].get("model") == "smoke-lm@v1":
+                break
+            time.sleep(0.5)
+        else:
+            print("deploy: replica never listed as smoke-lm@v1", file=sys.stderr)
+            return 1
+
+        # v2: same checkpoint re-registered under an explicit version —
+        # content-identical, but a distinct registry version to roll onto
+        cluster.register_model("smoke-lm", uuid, storage_path=ckpt_dir, version=2)
+        state = cluster.deploy("smoke-lm", 2)
+        print(f"deploy: roll started ({state['status']}), waiting for drain")
+        proc.wait(timeout=120)
+        if proc.returncode != 75:
+            print(f"deploy: worker exited {proc.returncode}, want 75 "
+                  "(orderly drain)", file=sys.stderr)
+            return 1
+        print("deploy: worker drained (exit 75); relaunching on smoke-lm@latest")
+        proc, url, lines = _spawn_serve(cluster, "--model", "smoke-lm@latest")
+        state = cluster.deploy_status()
+        deadline = time.time() + 60
+        while state["status"] == "rolling" and time.time() < deadline:
+            time.sleep(0.5)
+            state = cluster.deploy_status()
+        reps = cluster.serving()
+        labels = sorted(r.get("model") for r in reps)
+        print(f"deploy: status={state['status']} fleet={labels}")
+        ok = state["status"] == "completed" and labels == ["smoke-lm@v2"]
+        if not ok:
+            for line in lines[-30:]:
+                print(f"  | {line}")
+        return 0 if ok else 1
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except Exception:
+                proc.kill()
 
 
 def _kill_master_smoke(cluster: "DevCluster") -> int:
@@ -467,6 +662,9 @@ def main(argv=None) -> int:
                     help="run the 2-agent gang smoke test and exit")
     ap.add_argument("--kill-master", action="store_true",
                     help="run the master SIGKILL+restart gang re-adoption smoke")
+    ap.add_argument("--deploy", action="store_true",
+                    help="run the registry + rolling-deploy smoke "
+                         "(register -> serve --model -> roll to v2)")
     ap.add_argument("--fsck-selftest", action="store_true",
                     help="verify `dtpu-master --journal-fsck` on fabricated journals")
     ap.add_argument("--agents", type=int, default=2)
@@ -490,6 +688,15 @@ def main(argv=None) -> int:
         import tempfile
 
         root = pathlib.Path(tempfile.mkdtemp(prefix="dtpu-devcluster-"))
+    if args.deploy:
+        # registry smoke needs no agents — the replica is our subprocess
+        cluster = DevCluster(root, agents=0, slots=args.slots,
+                             master_args=("--deploy-step-timeout-sec", "120"))
+        cluster.start_master()
+        try:
+            return _deploy_smoke(cluster)
+        finally:
+            cluster.stop()
     cluster = DevCluster(root, agents=args.agents, slots=args.slots)
     cluster.start()
     print(f"devcluster up: master {cluster.url}, "

@@ -10,6 +10,10 @@
 #   scripts/devcluster.sh --kill-master  # ASan build + SIGKILL/restart the
 #                                        # master mid-gang: the WAL replays
 #                                        # and the gang is re-adopted
+#   scripts/devcluster.sh --deploy       # registry + rolling-deploy smoke:
+#                                        # register -> serve --model ->
+#                                        # roll the fleet to v2 (exit-75
+#                                        # drain + relaunch; docs/registry.md)
 #
 # The pytest devcluster marker (tests/conftest.py) skips cleanly when the
 # binaries are absent; after this script they run:
@@ -21,6 +25,8 @@ cd "$REPO"
 MODE="--smoke"
 if [[ "${1:-}" == "--up" ]]; then
   MODE=""
+elif [[ "${1:-}" == "--deploy" ]]; then
+  exec python scripts/devcluster.py --build --deploy
 elif [[ "${1:-}" == "--kill-master" ]]; then
   # durability smoke runs under the ASan/UBSan build so the crash-restart
   # path (WAL replay, re-adoption bookkeeping) is memory-checked too

@@ -110,10 +110,17 @@ def test_every_route_conforms(cluster, tmp_path):
         "group": "contract-group",
         "username": "determined",
         "token_id": "tok-none",
+        "version": "latest",
     }
 
     bodies = dict(BODIES)
     bodies[("POST", "/api/v1/models/{name}/versions")] = {"checkpoint_uuid": ckpt}
+    # promoting the seeded trial's checkpoint again is the idempotent
+    # no-op path (same uuid as the version registered above -> 200)
+    bodies[("POST", "/api/v1/models/{name}/promote")] = {"trial_id": trial["id"]}
+    bodies[("POST", "/api/v1/serving/deploy")] = {
+        "model": "contract-model", "version": "latest",
+    }
 
     anon = requests.Session()
     missing, misshapen = [], []

@@ -163,6 +163,29 @@ def test_master_unreachable_grace_knob():
         )
 
 
+def test_registry_config_parses_and_validates():
+    """The `registry:` section (ISSUE 15): promotion target + auto_promote
+    parse; auto_promote without a model, ref-breaking characters in the
+    name, and unknown fields are rejected at parse time."""
+    cfg = ExperimentConfig.parse({"name": "x"})
+    assert cfg.registry.model is None and not cfg.registry.auto_promote
+    cfg = ExperimentConfig.parse(
+        {"name": "x",
+         "registry": {"model": "lm", "auto_promote": True, "labels": ["prod"]}}
+    )
+    assert cfg.registry.model == "lm" and cfg.registry.auto_promote
+    assert cfg.registry.labels == ["prod"]
+    for bad in (
+        {"auto_promote": True},            # promotion needs a target model
+        {"model": "a@b"},                  # "@" is the ref separator
+        {"model": "a b"},                  # whitespace breaks the CLI
+        {"model": "lm", "bogus": True},    # unknown field
+        {"model": "lm", "labels": "prod"},  # not a list
+    ):
+        with pytest.raises(InvalidExperimentConfig):
+            ExperimentConfig.parse({"name": "x", "registry": bad})
+
+
 def test_config_version_gate():
     """v1 accepted (explicit or implicit); anything else fails loudly —
     both sides of the shared contract (master.cpp validate_config

@@ -83,6 +83,28 @@ def test_plan_protected_uuids_survive_rotation():
     assert delete == {"a2"}
 
 
+def test_plan_registry_pinned_uuid_survives_topk_rotation():
+    """Registry pinning (ISSUE 15): a promoted model version references
+    its checkpoint by uuid, and the driver passes those uuids through the
+    same ``protected`` mechanism as journaled resume points — promoting a
+    model must pin its checkpoint against top-k/keep-latest rotation even
+    after the trial trains past it (the serve tier may be launched from
+    ``name@vN`` at any time).  The driver-level half (promote -> compact
+    -> directory survives) lives in tests/test_registry.py."""
+    cks = [ci("promoted", 1, 4), ci("newer", 1, 8), ci("b1", 2, 8)]
+    policy = RetentionPolicy(keep_trial_latest=1, keep_experiment_best=1,
+                             smaller_is_better=True)
+    # without the pin, rotation deletes the promoted (older) checkpoint
+    keep, delete = plan_retention(cks, policy, metric_by_trial={1: 0.1, 2: 0.9})
+    assert "promoted" in delete
+    # with it, the registry reference wins
+    keep, delete = plan_retention(
+        cks, policy, metric_by_trial={1: 0.1, 2: 0.9}, protected={"promoted"}
+    )
+    assert "promoted" in keep and "newer" in keep
+    assert delete == set()
+
+
 def test_plan_protected_trials_keep_live_clone_sources():
     """Regression (PBT): a current-generation population member not in the
     metric top-k used to lose its only checkpoint to top-k retention

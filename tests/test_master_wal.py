@@ -22,6 +22,7 @@ import pytest
 from scripts.devcluster import (
     MASTER_BIN,
     sample_master_events,
+    sample_registry_events,
     wal_frame,
     write_master_journal,
 )
@@ -130,6 +131,55 @@ def test_fsck_clean_journal(tmp_path):
     rc, out = _fsck(tmp_path)
     assert rc == 0, out
     assert "last_good_lsn=5" in out and "tail_truncated=no" in out, out
+
+
+# ---- model registry records (ISSUE 15): same WAL contract -------------------
+
+
+def test_registry_torn_tail_truncated_at_every_byte_offset(tmp_path):
+    """Every-byte truncation fuzz of a ``model_version`` record: the boot
+    must land on exactly the registry state without that version — same
+    ARIES prefix contract as the control-plane records, and the registry
+    rows must be OBSERVABLE in the --dump-state digest."""
+    events = sample_master_events() + sample_registry_events()
+    frames = [
+        wal_frame(json.dumps({**ev, "seq": i + 1, "ts": 0}))
+        for i, ev in enumerate(events)
+    ]
+    blob = b"".join(frames)
+    final_start = len(blob) - len(frames[-1])  # the v2 model_version record
+
+    prefix_dir = tmp_path / "prefix"
+    _write_blob(prefix_dir, blob[:final_start])
+    expected = _dump(prefix_dir)
+    assert [v["version"] for m in expected["models"] for v in m["versions"]] == [1]
+
+    full_dir = tmp_path / "full"
+    _write_blob(full_dir, blob)
+    full = _dump(full_dir)
+    assert full != expected  # the torn version is visible in the digest
+    assert [v["version"] for m in full["models"] for v in m["versions"]] == [1, 2]
+    # lineage round-trips the WAL byte-exactly
+    v1 = full["models"][0]["versions"][0]
+    assert v1["checkpoint_uuid"] == "uuid-aaa"
+    assert v1["storage_path"] == "/ck/uuid-aaa"
+    assert v1["source_trial_id"] == 7 and v1["source_experiment_id"] == 3
+    assert v1["metrics"] == {"validation_loss": 0.42, "step": 64}
+
+    work = tmp_path / "fuzz"
+    for cut in range(final_start, len(blob)):
+        shutil.rmtree(work, ignore_errors=True)
+        _write_blob(work, blob[:cut])
+        got = _dump(work)
+        assert got == expected, f"state diverged at truncation offset {cut}"
+
+
+def test_registry_journal_fscks_clean(tmp_path):
+    events = sample_master_events() + sample_registry_events()
+    write_master_journal(str(tmp_path), events)
+    rc, out = _fsck(tmp_path)
+    assert rc == 0, out
+    assert f"last_good_lsn={len(events)}" in out and "tail_truncated=no" in out
 
 
 # ---- live master (no agents: boots in <1s, no jax) -------------------------
@@ -283,6 +333,74 @@ def test_serving_replica_reregister_contract_across_restart(tmp_path):
         assert r2.status_code == 201
         listing = cluster.http.get(f"{cluster.url}/api/v1/serving", timeout=5).json()
         assert [rep for rep in listing if rep["id"] == r2.json()["id"]]
+    finally:
+        cluster.stop()
+
+
+def test_registry_survives_sigkill_and_reregister_is_idempotent(tmp_path):
+    """Live half of the registry WAL contract: registered versions replay
+    across a master SIGKILL with their lineage intact, and re-registering
+    the same name@version is a no-op (same checkpoint -> 200, different
+    checkpoint -> 409) BOTH before and after the replay — a driver retry
+    must never mint a duplicate version, even against a restarted master."""
+    from scripts.devcluster import DevCluster
+
+    cluster = DevCluster(tmp_path, agents=0)
+    cluster.start_master()
+    try:
+        body = {
+            "checkpoint_uuid": "uuid-live-1",
+            "storage_path": "/ck/uuid-live-1",
+            "source_trial_id": 9,
+            "metrics": {"validation_loss": 0.25},
+        }
+        assert cluster.http.post(
+            f"{cluster.url}/api/v1/models", json={"name": "wal-live-model"},
+            timeout=5,
+        ).status_code == 201
+        r = cluster.http.post(
+            f"{cluster.url}/api/v1/models/wal-live-model/versions",
+            json=body, timeout=5,
+        )
+        assert r.status_code == 201 and r.json()["version"] == 1, r.text
+        # retry (lost response): implicit-latest no-op
+        r = cluster.http.post(
+            f"{cluster.url}/api/v1/models/wal-live-model/versions",
+            json=body, timeout=5,
+        )
+        assert r.status_code == 200 and r.json()["version"] == 1, r.text
+        # explicit taken version with a different checkpoint: conflict
+        r = cluster.http.post(
+            f"{cluster.url}/api/v1/models/wal-live-model/versions",
+            json={**body, "checkpoint_uuid": "uuid-other", "version": 1},
+            timeout=5,
+        )
+        assert r.status_code == 409, r.text
+
+        cluster.kill_master()
+        cluster.restart_master()
+
+        model = cluster.http.get(
+            f"{cluster.url}/api/v1/models/wal-live-model", timeout=5
+        ).json()
+        assert [v["version"] for v in model["versions"]] == [1]
+        v1 = model["versions"][0]
+        assert v1["checkpoint_uuid"] == "uuid-live-1"
+        assert v1["storage_path"] == "/ck/uuid-live-1"
+        assert v1["source_trial_id"] == 9
+        assert v1["metrics"] == {"validation_loss": 0.25}
+        # idempotency survives the replay: still one version after a retry
+        r = cluster.http.post(
+            f"{cluster.url}/api/v1/models/wal-live-model/versions",
+            json=body, timeout=5,
+        )
+        assert r.status_code == 200 and r.json()["version"] == 1, r.text
+        model = cluster.http.get(
+            f"{cluster.url}/api/v1/models/wal-live-model", timeout=5
+        ).json()
+        assert [v["version"] for v in model["versions"]] == [1]
+        rc, out = _fsck(cluster.state_dir)
+        assert rc == 0, out
     finally:
         cluster.stop()
 

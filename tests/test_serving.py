@@ -660,6 +660,9 @@ class _FakeServeMaster:
         self.registrations = []
         self.known = set()
         self.heartbeats = 0
+        # rid -> deploy payload: heartbeat answers {"drain": true, ...}
+        # (the rolling-deploy signal channel)
+        self.drain = {}
         self.lock = threading.Lock()
         self.port = 0
         self.server = None
@@ -704,6 +707,9 @@ class _FakeServeMaster:
                         if rid not in fake.known:
                             return self._json({"error": "no such replica"}, 404)
                         fake.heartbeats += 1
+                        dep = fake.drain.get(rid)
+                        if dep is not None:
+                            return self._json({"drain": True, "deploy": dep})
                         return self._json({})
                 return self._json({"error": f"no fake route {path}"}, 404)
 
@@ -798,6 +804,77 @@ def test_worker_survives_master_kill_and_reregisters(kernels):
         while fake.heartbeats == hb_before and time.time() < deadline:
             time.sleep(0.05)
         assert fake.heartbeats > hb_before, "heartbeats did not resume"
+    finally:
+        worker.shutdown(deregister=False)
+        fake.close()
+
+
+def test_registration_carries_registry_version(kernels):
+    """A replica launched via ``--model`` (ISSUE 15): its listing label is
+    the registry ``name@vN`` and the resolved version rides registration;
+    a raw-path launch falls back to the trial class name with no
+    registry fields at all."""
+    from determined_tpu.api.session import Session
+
+    fake = _FakeServeMaster()
+    worker = ServeWorker(
+        ServeEngine(_FastHeartbeatKernels(kernels)),
+        session=Session(fake.url, token="t"),
+        model="lm@v3",
+        model_name="lm",
+        model_version=3,
+    )
+    worker.start()
+    try:
+        reg = fake.registrations[0]
+        assert reg["model"] == "lm@v3"
+        assert reg["model_name"] == "lm" and reg["model_version"] == 3
+    finally:
+        worker.shutdown(deregister=False)
+
+    raw = ServeWorker(
+        ServeEngine(_FastHeartbeatKernels(kernels)),
+        session=Session(fake.url, token="t"),
+        model="LMTrial",  # class-name fallback (PR 9 review fix)
+    )
+    raw.start()
+    try:
+        reg = fake.registrations[1]
+        assert reg["model"] == "LMTrial"
+        assert "model_name" not in reg and "model_version" not in reg
+    finally:
+        raw.shutdown(deregister=False)
+        fake.close()
+
+
+def test_master_drain_request_reaches_worker(kernels):
+    """Rolling deploy's drain channel: when the master answers a
+    heartbeat with ``{"drain": true, "deploy": {...}}``, the worker's
+    master-drain flag flips (the serve main loop polls it next to the
+    signal flag) and the deploy target is exposed."""
+    from determined_tpu.api.session import Session
+
+    fake = _FakeServeMaster()
+    worker = ServeWorker(
+        ServeEngine(_FastHeartbeatKernels(kernels)),
+        session=Session(fake.url, token="t"),
+        model="lm@v1",
+        model_name="lm",
+        model_version=1,
+    )
+    worker.start()
+    try:
+        assert not worker.master_drain_requested()
+        rid = worker.replica.replica_id
+        with fake.lock:
+            fake.drain[rid] = {"model": "lm", "version": 2, "target": "lm@v2"}
+        deadline = time.time() + 10
+        while not worker.master_drain_requested() and time.time() < deadline:
+            time.sleep(0.05)
+        assert worker.master_drain_requested(), "drain flag never flipped"
+        assert worker.master_drain_info["target"] == "lm@v2"
+        # the flag is drain-once: later heartbeats must not re-fire it
+        assert worker.replica.drain_requested.is_set()
     finally:
         worker.shutdown(deregister=False)
         fake.close()
