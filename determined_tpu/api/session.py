@@ -26,10 +26,16 @@ IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "PUT", "DELETE", "OPTIONS"})
 
 
 class APIError(Exception):
-    def __init__(self, status: int, message: str) -> None:
+    def __init__(
+        self, status: int, message: str, retry_after: Optional[str] = None
+    ) -> None:
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.message = message
+        #: the server's Retry-After header (seconds form), when one came
+        #: back on a 429/503 — callers running their own retry loop (the
+        #: replica heartbeat) honor it over their computed backoff
+        self.retry_after = retry_after
 
 
 class NotFoundError(APIError):
@@ -152,7 +158,7 @@ class Session:
             if resp.status_code == 429:
                 # not executed server-side: safe to retry any method —
                 # unless the caller explicitly opted out of all retries
-                last = APIError(429, resp.text)
+                last = APIError(429, resp.text, resp.headers.get("Retry-After"))
                 if retry is False:
                     raise last
                 rate_limited += 1

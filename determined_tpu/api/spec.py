@@ -103,6 +103,11 @@ ROUTES = [
      {"id", "model", "version", "target", "status"}),
     ("GET", "/api/v1/serving/deploy", "token",
      {"id", "model", "version", "target", "status"}),
+    # supervised fleet spec: master relaunches dead replicas to hold target
+    ("PUT", "/api/v1/serving/fleet", "token",
+     {"model", "version", "target", "status", "slots"}),
+    ("GET", "/api/v1/serving/fleet", "token",
+     {"model", "version", "target", "status", "slots"}),
     # agents + scheduling
     ("POST", "/api/v1/agents", "token", {"registered"}),
     ("GET", "/api/v1/agents", "token", "[]"),

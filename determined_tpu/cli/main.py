@@ -619,30 +619,95 @@ def model_pull(args) -> int:
 
 def model_deploy(args) -> int:
     """Rolling deploy: walk the serving fleet one replica at a time onto
-    a registry version (drain -> relaunch -> next; docs/registry.md)."""
+    a registry version (drain -> relaunch -> next; docs/registry.md).
+    ``--canary F`` rolls only that cohort first and bakes it against the
+    pre-roll error-rate/latency baseline before finishing the roll."""
     import time as _time
 
     from determined_tpu.experiment import registry as registry_mod
 
     session = _client(args).session
     name, version = registry_mod.parse_model_ref(args.ref)
-    state = session.post(
-        "/api/v1/serving/deploy", json={"model": name, "version": version}
-    ).json()
+    body = {"model": name, "version": version}
+    if args.canary is not None:
+        body["canary_fraction"] = args.canary
+        body["bake_seconds"] = args.bake_seconds
+        body["min_requests"] = args.canary_min_requests
+        if args.rollback_on_regression:
+            body["rollback_on_regression"] = True
+    state = session.post("/api/v1/serving/deploy", json=body).json()
+    mode = ""
+    canary = state.get("canary") or {}
+    if canary.get("count"):
+        mode = f" (canary cohort: {canary['count']})"
     print(f"deploy {state['id']}: rolling {state['target']} "
-          f"over {len(state.get('pending') or [])} replica(s)")
+          f"over {len(state.get('pending') or [])} replica(s){mode}")
     if not args.wait:
         print(state["status"])
         return 0
     deadline = _time.time() + args.timeout
+    phase = state.get("phase")
     while _time.time() < deadline:
         state = session.get("/api/v1/serving/deploy").json()
+        if state.get("phase") != phase:
+            phase = state.get("phase")
+            print(f"deploy {state['id']}: phase {phase}")
         if state["status"] != "rolling":
             break
         _time.sleep(1.0)
     detail = f" ({state['detail']})" if state.get("detail") else ""
     print(f"deploy {state['id']}: {state['status']}{detail}")
+    canary = state.get("canary") or {}
+    if canary.get("verdict"):
+        stat = f" — regressed stat: {canary['offending_stat']}" \
+            if canary.get("offending_stat") else ""
+        print(f"canary verdict: {canary['verdict']}{stat}")
     return 0 if state["status"] == "completed" else 1
+
+
+# ---- serving fleet (master-side replica supervisor) -------------------------
+
+
+def fleet_set(args) -> int:
+    """Declare the fleet spec: the master's supervisor launches replicas
+    as agent tasks and relaunches any that die (docs/serving.md)."""
+    config = {}
+    if args.slots is not None:
+        config["resources"] = {"slots": args.slots}
+    for kv in args.env or []:
+        key, _, val = kv.partition("=")
+        config.setdefault("env", {})[key] = val
+    fleet = _client(args).set_serving_fleet(
+        args.ref, args.target, pool=args.pool, config=config or None
+    )
+    print(f"fleet: {fleet['model']}@v{fleet['version']} "
+          f"target {fleet['target']} ({fleet['status']})")
+    return 0
+
+
+def fleet_status(args) -> int:
+    from determined_tpu.api.session import NotFoundError
+
+    try:
+        fleet = _client(args).get_serving_fleet()
+    except NotFoundError:
+        print("no fleet spec declared", file=sys.stderr)
+        return 1
+    if args.json:
+        _print_json(fleet)
+        return 0
+    detail = f" — {fleet['detail']}" if fleet.get("detail") else ""
+    print(f"{fleet['model']}@v{fleet['version']} target {fleet['target']} "
+          f"status {fleet['status']}{detail}")
+    for slot in fleet.get("slots") or []:
+        state = "gave-up" if slot.get("gave_up") else (
+            "live" if slot.get("replica_id") else "launching")
+        err = f" last_error={slot['last_error']!r}" if slot.get("last_error") else ""
+        print(f"  slot {slot['index']}: {state} task={slot.get('task_id') or '-'} "
+              f"replica={slot.get('replica_id') or '-'} "
+              f"launches={slot.get('launches', 0)} "
+              f"failures={slot.get('failures', 0)}{err}")
+    return 0 if fleet["status"] != "degraded" else 1
 
 
 def model_register_version(args) -> int:
@@ -1553,7 +1618,38 @@ def build_parser() -> argparse.ArgumentParser:
                     help="start the roll and return immediately")
     md.add_argument("--timeout", type=float, default=600.0,
                     help="seconds to wait for the roll to finish")
+    md.add_argument("--canary", type=float, metavar="FRACTION",
+                    help="roll this fraction of the fleet first and bake "
+                         "it against the pre-roll error-rate/latency "
+                         "baseline before finishing the roll")
+    md.add_argument("--bake-seconds", type=float, default=30.0,
+                    help="canary bake window (default: 30)")
+    md.add_argument("--canary-min-requests", type=int, default=1,
+                    help="minimum canary-cohort requests before the bake "
+                         "verdict counts (default: 1)")
+    md.add_argument("--rollback-on-regression", action="store_true",
+                    help="on a canary regression, roll the cohort back to "
+                         "the prior version instead of holding")
     md.set_defaults(fn=model_deploy, wait=True)
+
+    fleet = sub.add_parser(
+        "fleet", help="supervised serving fleet: the master relaunches "
+        "replicas that die to hold the declared target (docs/serving.md)"
+    ).add_subparsers(dest="verb", required=True)
+    fs = fleet.add_parser(
+        "set", help="declare the fleet spec (model version + replica count)"
+    )
+    fs.add_argument("ref", metavar="NAME[@VERSION]")
+    fs.add_argument("--target", type=int, required=True,
+                    help="replica count the supervisor holds")
+    fs.add_argument("--pool", help="resource pool for replica tasks")
+    fs.add_argument("--slots", type=int, help="slots per replica task")
+    fs.add_argument("--env", action="append", metavar="KEY=VALUE",
+                    help="environment override for replica tasks (repeatable)")
+    fs.set_defaults(fn=fleet_set)
+    fst = fleet.add_parser("status", help="fleet spec + per-slot health")
+    fst.add_argument("--json", action="store_true")
+    fst.set_defaults(fn=fleet_status)
     mr = model.add_parser("register-version")
     mr.add_argument("name")
     mr.add_argument("checkpoint_uuid")

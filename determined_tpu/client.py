@@ -409,19 +409,73 @@ class Determined:
 
         return ModelVersion(self._session, resolve_version(self._session, ref))
 
-    def deploy_model(self, ref: str) -> Dict[str, Any]:
+    def deploy_model(
+        self,
+        ref: str,
+        *,
+        canary_fraction: Optional[float] = None,
+        rollback_on_regression: bool = False,
+        bake_seconds: Optional[float] = None,
+        min_requests: Optional[int] = None,
+    ) -> Dict[str, Any]:
         """Start a rolling deployment of a registry version onto the
         serving fleet; returns the deploy state (poll
-        ``get_serving_deploy`` until ``status != "rolling"``)."""
+        ``get_serving_deploy`` until ``status != "rolling"``).
+
+        With ``canary_fraction`` the master rolls only that cohort first,
+        bakes it for ``bake_seconds`` comparing error rate and latency
+        against the pre-roll baseline, and either finishes the roll or
+        holds (``rollback_on_regression=True`` rolls the cohort back to
+        the prior version instead of holding)."""
         from determined_tpu.experiment.registry import parse_model_ref
 
         name, version = parse_model_ref(ref)
-        return self._session.post(
-            "/api/v1/serving/deploy", json={"model": name, "version": version}
-        ).json()
+        body: Dict[str, Any] = {"model": name, "version": version}
+        if canary_fraction is not None:
+            body["canary_fraction"] = float(canary_fraction)
+            if bake_seconds is not None:
+                body["bake_seconds"] = float(bake_seconds)
+            if min_requests is not None:
+                body["min_requests"] = int(min_requests)
+            if rollback_on_regression:
+                body["rollback_on_regression"] = True
+        return self._session.post("/api/v1/serving/deploy", json=body).json()
 
     def get_serving_deploy(self) -> Dict[str, Any]:
         return self._session.get("/api/v1/serving/deploy").json()
+
+    def set_serving_fleet(
+        self,
+        ref: str,
+        target: int,
+        *,
+        pool: Optional[str] = None,
+        config: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Declare the serving-fleet spec: ``target`` replicas of registry
+        version ``ref`` (``name@version``).  The master's supervisor
+        launches replicas as agent tasks and relaunches any that die
+        (capped backoff; crash loops mark the fleet degraded).  ``config``
+        merges into each replica's task config (``resources.slots``,
+        ``serve`` overrides, ``env``)."""
+        from determined_tpu.experiment.registry import parse_model_ref
+
+        name, version = parse_model_ref(ref)
+        body: Dict[str, Any] = {
+            "model": name,
+            "version": version,
+            "target": int(target),
+        }
+        if pool:
+            body["pool"] = pool
+        if config:
+            body["config"] = config
+        return self._session.put("/api/v1/serving/fleet", json=body).json()
+
+    def get_serving_fleet(self) -> Dict[str, Any]:
+        """The supervised fleet's spec + per-slot status (404 when no
+        fleet spec has been declared)."""
+        return self._session.get("/api/v1/serving/fleet").json()
 
     def get_serving(self) -> List[Dict[str, Any]]:
         """The live serving-replica routing table."""

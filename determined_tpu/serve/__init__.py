@@ -87,6 +87,7 @@ class ServeWorker:
         checkpoint: str = "",
         model_name: str = "",
         model_version: int = 0,
+        task_id: str = "",
     ) -> None:
         self.engine = engine
         self.http = ServeHTTPServer(engine, host=host, port=port)
@@ -95,6 +96,7 @@ class ServeWorker:
         self._checkpoint = checkpoint
         self._model_name = model_name
         self._model_version = model_version
+        self._task_id = task_id
         self.replica: Optional[ReplicaRegistration] = None
         # set from the heartbeat thread when the master asks this replica
         # to drain (rolling deploy); plain attribute writes so the serve
@@ -115,6 +117,7 @@ class ServeWorker:
                 checkpoint=self._checkpoint,
                 model_name=self._model_name,
                 model_version=self._model_version,
+                task_id=self._task_id,
                 heartbeat_interval_s=self.engine.cfg.heartbeat_interval_s,
                 stats_fn=self.engine.stats,
                 on_drain=self._on_master_drain,

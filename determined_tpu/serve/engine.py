@@ -151,6 +151,14 @@ class _EngineBase:
         self._completed = 0
         self._rejected = 0
         self._tokens_generated = 0
+        #: requests that finished with an error (prefill crash, engine
+        #: stop/crash, drain abandonment) — the error-rate numerator the
+        #: master's canary bake compares against its pre-roll baseline
+        self._errored = 0
+        #: 5xx responses counted by the HTTP layer (note_http_response);
+        #: catches handler-level failures the engine never sees
+        self._http_5xx = 0
+        self._latency_ms_total = 0.0
         self._started_at = time.monotonic()
 
     # -- admission (HTTP threads) -------------------------------------------
@@ -271,12 +279,26 @@ class _EngineBase:
             self._thread.join(timeout=10.0)
         self._fail_outstanding("engine stopped")
 
+    def _finish_error(self, req: GenRequest, reason: str) -> None:
+        """Fail one request AND count it: every error-finish goes through
+        here so the `errored` stat the heartbeat ships stays truthful."""
+        req.finish(error=reason)
+        with self._stats_lock:
+            self._errored += 1
+
+    def note_http_response(self, status: int) -> None:
+        """HTTP layer callback: count 5xx responses (handler failures the
+        engine's own error path never sees)."""
+        if status >= 500:
+            with self._stats_lock:
+                self._http_5xx += 1
+
     def _fail_outstanding(self, reason: str) -> None:
         while True:
             req = self.queue.get()
             if req is None:
                 break
-            req.finish(error=reason)
+            self._finish_error(req, reason)
 
     # -- stats ---------------------------------------------------------------
 
@@ -287,11 +309,22 @@ class _EngineBase:
                 "completed": self._completed,
                 "rejected": self._rejected,
                 "tokens_generated": self._tokens_generated,
+                "errored": self._errored,
+                "http_5xx": self._http_5xx,
+                "latency_ms_avg": round(
+                    self._latency_ms_total / self._completed, 3
+                )
+                if self._completed
+                else 0.0,
             }
         return {
             **counters,
             "queue_depth": self.queue.depth(),
             "draining": self.queue.draining,
+            # truthy once the loop died: the heartbeat ships this and the
+            # master reaps the replica immediately instead of waiting out
+            # the TTL behind a 500 /healthz
+            "failed": self.failed,
             "kv_cache": self.allocator.stats(),
             "uptime_s": round(time.monotonic() - self._started_at, 3),
         }
@@ -345,8 +378,11 @@ class _EngineBase:
         self.allocator.free(seq.blocks)
         self._tracer.gauge("serve.kv_utilization", self.allocator.utilization())
         seq.request.finish()
+        latency = seq.request.latency_s
         with self._stats_lock:
             self._completed += 1
+            if latency is not None:
+                self._latency_ms_total += latency * 1000.0
 
     def _decode_batch(self, lanes: List[Optional[ActiveSeq]]) -> np.ndarray:
         """One jitted decode step over the full (static) lane table."""
@@ -393,7 +429,7 @@ class ServeEngine(_EngineBase):
     def _abort_active(self, reason: str) -> None:
         for i in self.lanes.active():
             seq = self.lanes.retire(i)
-            seq.request.finish(error=reason)
+            self._finish_error(seq.request, reason)
 
     @classmethod
     def from_checkpoint(
@@ -439,7 +475,7 @@ class ServeEngine(_EngineBase):
             return False
         except Exception as e:  # noqa: BLE001 - a poisoned request must not kill the loop
             logger.exception("request %d failed at prefill", req.id)
-            req.finish(error=f"prefill failed: {e}")
+            self._finish_error(req, f"prefill failed: {e}")
             return True
         if seq is not None:
             self.lanes.join(seq)
@@ -479,7 +515,7 @@ class ServeEngine(_EngineBase):
             for i in self.lanes.active():
                 seq = self.lanes.retire(i)
                 self.allocator.free(seq.blocks)
-                seq.request.finish(error="engine stopped")
+                self._finish_error(seq.request, "engine stopped")
         self._finished.set()
 
     def stats(self) -> Dict[str, Any]:
@@ -505,7 +541,7 @@ class StaticBatchEngine(_EngineBase):
     def _abort_active(self, reason: str) -> None:
         for seq in self._current:
             if not seq.request.done.is_set():
-                seq.request.finish(error=reason)
+                self._finish_error(seq.request, reason)
         self._current = []
 
     def _gather_batch(self) -> List[ActiveSeq]:
@@ -520,7 +556,7 @@ class StaticBatchEngine(_EngineBase):
                 self.queue.requeue_head(req)
                 break
             except Exception as e:  # noqa: BLE001
-                req.finish(error=f"prefill failed: {e}")
+                self._finish_error(req, f"prefill failed: {e}")
                 continue
             if seq is not None:
                 batch.append(seq)
@@ -555,6 +591,6 @@ class StaticBatchEngine(_EngineBase):
                 for i, seq in enumerate(lanes):
                     if seq is not None and live[i]:
                         self.allocator.free(seq.blocks)
-                        seq.request.finish(error="engine stopped")
+                        self._finish_error(seq.request, "engine stopped")
             self._current = []
         self._finished.set()

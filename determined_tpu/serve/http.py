@@ -18,6 +18,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from determined_tpu.serve.engine import _EngineBase
 from determined_tpu.serve.scheduler import AdmissionRejected
+from determined_tpu.utils import faults
 
 logger = logging.getLogger("determined_tpu.serve.http")
 
@@ -85,7 +86,15 @@ class ServeHTTPServer:
                 except (ValueError, json.JSONDecodeError):
                     self._reply(400, {"error": "bad json"})
                     return
-                status, payload = server.handle_generate(body)
+                try:
+                    status, payload = server.handle_generate(body)
+                except Exception as e:  # noqa: BLE001 - a failed handler must still answer
+                    logger.exception("/v1/generate handler failed")
+                    status = 500
+                    payload = {"error": f"handler failed: {e}"}
+                    # handler-level 5xx the engine's own error path never
+                    # saw: count it so heartbeat stats stay truthful
+                    engine.note_http_response(status)
                 self._reply(status, payload)
 
         self._httpd = ThreadingHTTPServer(
@@ -133,6 +142,10 @@ class ServeHTTPServer:
     def handle_generate(self, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         if self.draining:
             return 503, {"error": "draining"}
+        # chaos hook: an installed injector raising here surfaces as a
+        # counted 500 — how the selfheal smoke manufactures an error-rate
+        # regression on a canary cohort
+        faults.fire("serve.generate")
         prompt = body.get("prompt_tokens")
         if not isinstance(prompt, list) or not all(
             isinstance(t, int) for t in prompt
@@ -156,8 +169,11 @@ class ServeHTTPServer:
         except (TypeError, ValueError) as e:
             return 400, {"error": f"bad request field: {e}"}
         if not req.done.wait(REQUEST_TIMEOUT_S):
+            self.engine.note_http_response(504)
             return 504, {"error": "generation timed out", "request_id": req.id}
         if req.error:
+            # already counted by the engine's _finish_error; http_5xx only
+            # tracks failures the engine did NOT see
             return 500, {"error": req.error, "request_id": req.id}
         return 200, {
             "request_id": req.id,

@@ -20,6 +20,7 @@ relaunches it on the target version.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from typing import Any, Callable, Dict, Optional
 
@@ -28,6 +29,10 @@ import requests
 from determined_tpu.api.session import APIError, NotFoundError, Session
 
 logger = logging.getLogger("determined_tpu.serve.replica")
+
+#: ceiling on the heartbeat's 429 backoff — stay a couple of TTL windows
+#: under the master's reap horizon while still easing off a shedding master
+MAX_THROTTLE_S = 30.0
 
 
 class ReplicaRegistration:
@@ -42,6 +47,7 @@ class ReplicaRegistration:
         checkpoint: str = "",
         model_name: str = "",
         model_version: int = 0,
+        task_id: str = "",
         heartbeat_interval_s: float = 2.0,
         stats_fn: Optional[Any] = None,
         on_drain: Optional[Callable[[Dict[str, Any]], None]] = None,
@@ -57,6 +63,10 @@ class ReplicaRegistration:
             # version rides registration so the listing shows it
             self._payload["model_name"] = model_name
             self._payload["model_version"] = int(model_version)
+        if task_id:
+            # supervisor-launched: lets the master's fleet supervisor bind
+            # this replica back to the slot whose task is running it
+            self._payload["task_id"] = task_id
         self._interval = heartbeat_interval_s
         #: called once (from the heartbeat thread) when the master's
         #: heartbeat response asks this replica to drain (rolling deploy)
@@ -66,10 +76,31 @@ class ReplicaRegistration:
         #: zero-arg callable whose dict rides each heartbeat, surfacing
         #: queue depth / kv utilization in the master's replica listing
         self._stats_fn = stats_fn
-        self._lock = threading.Lock()  # guards replica_id across threads
+        self._lock = threading.Lock()  # guards replica_id + throttled
         self.replica_id: Optional[str] = None
+        #: consecutive 429s from the master's admission control; each one
+        #: stretches the next heartbeat exponentially (jittered, capped)
+        #: instead of hammering a shedding master on the fixed cadence
+        self.throttled = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _throttle_delay(self, retry_after: Optional[str] = None) -> float:
+        """Next heartbeat delay after ``self.throttled`` consecutive 429s:
+        the master's ``Retry-After`` (seconds form) when given, else
+        capped exponential backoff off the base interval with +/-50%
+        jitter so a throttled fleet doesn't re-stampede in lockstep."""
+        if retry_after:
+            try:
+                return max(float(retry_after), 0.0)
+            except ValueError:
+                pass  # HTTP-date form: fall through to computed backoff
+        with self._lock:
+            throttled = self.throttled
+        return min(
+            MAX_THROTTLE_S,
+            self._interval * (2 ** max(throttled, 1)) * random.uniform(0.5, 1.5),
+        )
 
     # -- registration --------------------------------------------------------
 
@@ -95,7 +126,9 @@ class ReplicaRegistration:
     # -- heartbeat loop ------------------------------------------------------
 
     def _run(self) -> None:
-        while not self._stop.wait(self._interval):
+        delay = self._interval
+        while not self._stop.wait(delay):
+            delay = self._interval  # 429 handling below stretches this
             with self._lock:
                 rid = self.replica_id
             if rid is None:
@@ -112,6 +145,8 @@ class ReplicaRegistration:
                     json=body,
                     retry=False,
                 )
+                with self._lock:
+                    self.throttled = 0
                 self._handle_heartbeat_response(resp)
             except NotFoundError:
                 # master forgot us (restart or prune race): re-register.
@@ -123,7 +158,25 @@ class ReplicaRegistration:
                 logger.warning("replica %s unknown to master; re-registering", rid)
                 try:
                     self.register()
-                except (requests.ConnectionError, requests.Timeout, APIError):
+                    with self._lock:
+                        self.throttled = 0
+                except APIError as e:
+                    if e.status == 429:
+                        # admission control sheds re-registrations too:
+                        # ease off instead of re-stampeding every interval
+                        with self._lock:
+                            self.throttled += 1
+                        delay = self._throttle_delay(e.retry_after)
+                        logger.warning(
+                            "re-registration of replica %s shed (429); "
+                            "retrying in %.1fs", rid, delay,
+                        )
+                    else:
+                        logger.warning(
+                            "re-registration of replica %s failed (HTTP %d); "
+                            "will retry on the next heartbeat", rid, e.status,
+                        )
+                except (requests.ConnectionError, requests.Timeout):
                     # routine during a master restart window: warn without a
                     # traceback (this repeats every interval until it lands)
                     logger.warning(
@@ -141,10 +194,22 @@ class ReplicaRegistration:
                 logger.warning(
                     "master unreachable; heartbeat for replica %s will retry", rid
                 )
-            except APIError:
-                # transient master trouble: keep beating, the master-side
-                # TTL is several intervals wide
-                logger.warning("heartbeat failed for replica %s", rid)
+            except APIError as e:
+                if e.status == 429:
+                    # the master's WAL admission control is shedding load
+                    # (PR-13): back off — the TTL is sized in intervals, so
+                    # the capped delay keeps us alive while easing pressure
+                    with self._lock:
+                        self.throttled += 1
+                    delay = self._throttle_delay(e.retry_after)
+                    logger.warning(
+                        "heartbeat for replica %s shed (429); next in %.1fs",
+                        rid, delay,
+                    )
+                else:
+                    # transient master trouble: keep beating, the master-side
+                    # TTL is several intervals wide
+                    logger.warning("heartbeat failed for replica %s", rid)
             except Exception:  # noqa: BLE001 - the heartbeat must survive
                 logger.exception("heartbeat error for replica %s", rid)
 

@@ -22,6 +22,10 @@ glob-match):
 - ``storage.upload.done`` after a successful upload (same info)
 - ``storage.download``    before a StorageManager download (``manager=, storage_id=, dst=``)
 - ``api.request``         before each master HTTP request (``method=, path=``)
+- ``serve.generate``      in the serving replica's /v1/generate handler,
+                          before admission; a raise answers 500 and bumps
+                          the ``http_5xx`` heartbeat stat — the canary
+                          bake's regression vehicle
 - ``distributed.gather`` / ``distributed.allgather`` / ``distributed.broadcast``
                           before each control-plane collective (``rank=``)
 - ``experiment.journal.append``

@@ -240,7 +240,10 @@ class Agent {
     log.set("lines", lines);
     master_req("POST", "/api/v1/logs", log.dump(), 10);
     if (!task_id.empty()) {
-      master_req("POST", "/api/v1/tasks/" + task_id + "/exit", "{}", 10);
+      Json tbody = Json::object();
+      tbody.set("exit_code", Json(126));
+      tbody.set("detail", std::string(what) + " failed launching the task process");
+      master_req("POST", "/api/v1/tasks/" + task_id + "/exit", tbody.dump(), 10);
       return;
     }
     Json body = Json::object();
@@ -417,7 +420,11 @@ class Agent {
       std::filesystem::remove(pidfile(alloc_id), ec);
     }
     if (!task_id.empty()) {
-      master_req("POST", "/api/v1/tasks/" + task_id + "/exit", "{}", 10);
+      // exit code distinguishes orderly drains (0/75) from crashes for the
+      // master's fleet supervisor
+      Json tbody = Json::object();
+      tbody.set("exit_code", Json(exit_code));
+      master_req("POST", "/api/v1/tasks/" + task_id + "/exit", tbody.dump(), 10);
       return;
     }
     Json body = Json::object();

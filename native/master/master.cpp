@@ -195,6 +195,10 @@ struct GenericTaskState {
   int slots = 0;                  // 0 = aux task (no slot consumption)
   std::string module;             // harness module the agent/pod execs
   std::string allocation_id;      // set for external-pool placements
+  // reported by the agent's exit POST; the fleet supervisor reads it to
+  // tell orderly drains (0/75) from crash-loop failures
+  int exit_code = -1;             // -1 = not reported
+  std::string exit_detail;
 };
 
 // Online serving replica (determined_tpu/serve): an inference worker that
@@ -213,6 +217,7 @@ struct ServeReplicaState {
   std::string model_name;     // registry model when launched via --model
   int64_t model_version = 0;  // registry version number (0 = raw path)
   std::string owner;
+  std::string task_id;     // supervisor-launched: the agent task running us
   int64_t registered_ms = 0;
   int64_t last_heartbeat_ms = 0;
   Json stats = Json::object();  // last heartbeat's stats payload, if any
@@ -223,12 +228,21 @@ struct ServeReplicaState {
 // replicas one at a time through the serve worker's existing drain
 // machinery (503-new / finish-in-flight / exit 75) by flagging the
 // draining replica in its heartbeat response; whatever supervises the
-// worker relaunches it on the target version and the roll advances when
-// the replacement registers.  At most one deploy is active.  Ephemeral
-// like ServeReplicaState itself — the replica table it walks is rebuilt
-// from re-registrations after a master restart, so an in-flight deploy
-// is forgotten with it (re-POST to resume the roll; the registry VERSION
-// being deployed is journaled and survives).
+// worker (the master's own fleet supervisor, or an external one)
+// relaunches it on the target version and the roll advances when the
+// replacement registers.  At most one deploy is active.  DURABLE: the
+// intent is journaled as deploy_started and every walk transition as
+// deploy_advanced, so a master SIGKILLed mid-roll replays the deploy and
+// resumes where it left off (the replica ids themselves are ephemeral —
+// workers re-register under fresh ids — so the first advance after a
+// replay rescans the live table instead of trusting replayed ids).
+//
+// With a canary fraction, the roll stops after the canary cohort and
+// BAKES: heartbeat error-rate/latency stats from the cohort are compared
+// against the pre-roll fleet baseline; a regression auto-holds the roll
+// (status=held, verdict names the offending stat) or — when
+// rollback_on_regression is set — inverts the deploy onto the previous
+// version through the same drain machinery (terminal status=rolled_back).
 struct DeployState {
   int64_t id = 0;
   std::string model;          // registry model name
@@ -239,11 +253,63 @@ struct DeployState {
   std::vector<std::string> pending;  // replica ids still to roll, in order
   std::string draining;              // replica currently asked to drain
   std::vector<std::string> rolled;   // replicas that completed their drain
-  std::string status = "rolling";    // rolling|completed|failed
+  std::string status = "rolling";    // rolling|held|completed|failed|rolled_back
   std::string detail;
   int64_t started_ms = 0;
   int64_t updated_ms = 0;
   int64_t step_deadline_ms = 0;      // per-phase timeout -> status=failed
+  // canary gate (deploy --canary <fraction>)
+  double canary_fraction = 0.0;      // 0 = plain roll, no bake
+  int64_t canary_count = 0;          // replicas rolled before baking
+  bool rollback_on_regression = false;
+  int64_t bake_ms = 0;               // hold window after the canary cohort
+  double error_rate_threshold = 0.05;  // abs regression margin vs baseline
+  double latency_factor = 2.0;       // canary latency > baseline*factor
+  int64_t min_requests = 1;          // cohort samples needed for a verdict
+  int64_t prev_version = 0;          // rollback target (0 = none known)
+  std::string phase = "rolling";     // rolling|canary|baking|finishing|rolling_back
+  std::string verdict;               // ""|pass|regression
+  std::string offending_stat;        // error_rate|latency_ms on regression
+  Json baseline = Json::object();    // pre-roll fleet {error_rate, latency_ms, requests}
+  Json observed = Json::object();    // canary cohort stats at verdict time
+  int64_t bake_deadline_ms = 0;
+};
+
+// One desired-replica slot of the serving fleet: the supervisor's unit of
+// reconciliation.  Slot state is RUNTIME-ONLY (rebuilt by reconciliation
+// after a restart; live replicas are re-adopted, vacancies relaunched) —
+// only the fleet SPEC below is journaled.
+struct FleetSlot {
+  int index = 0;
+  std::string replica_id;      // live replica filling this slot ("" = vacant)
+  std::string task_id;         // agent task last launched for this slot
+  int64_t launch_version = 0;  // registry version that launch targets
+  int64_t launched_ms = 0;
+  int failures = 0;            // consecutive rapid failures (crash loop)
+  int64_t launches = 0;        // lifetime launches (bounded-relaunch proof)
+  int64_t next_launch_ms = 0;  // capped exponential backoff gate
+  std::string last_error;
+  bool gave_up = false;        // crash-loop cap hit; no further launches
+};
+
+// WAL-journaled serving-fleet spec (PUT /api/v1/serving/fleet): model@vN
+// plus a target replica count.  The 2s tick reconciles the spec against
+// live heartbeats — a dead (TTL-reaped), failed, or drained replica gets
+// a replacement launched as an agent task through the generic-task path,
+// with capped exponential backoff per slot; N rapid failures flip the
+// fleet to status=degraded (naming the slot and last error) instead of
+// thrashing agents forever.
+struct FleetState {
+  std::string model;           // registry model name
+  int64_t version = 0;         // base version slots are launched on
+  int64_t target = 0;          // desired replica count (0 = scale to zero)
+  std::string owner = "determined";
+  std::string pool = "default";
+  Json config = Json::object();  // forwarded to the serve task's config
+  std::vector<FleetSlot> slots;  // runtime-only (see FleetSlot)
+  std::string status = "reconciling";  // ok|reconciling|degraded
+  std::string detail;
+  int64_t updated_ms = 0;
 };
 
 // registry helpers: a model json holds {"versions": [{version, ...}]}
@@ -558,6 +624,11 @@ class Master {
   void set_agent_timeout_ms(int64_t ms) { agent_timeout_ms_ = ms; }
   void set_serve_replica_timeout_ms(int64_t ms) { serve_replica_timeout_ms_ = ms; }
   void set_deploy_step_timeout_ms(int64_t ms) { deploy_step_timeout_ms_ = ms; }
+  void set_fleet_backoff_initial_ms(int64_t ms) { fleet_backoff_initial_ms_ = ms; }
+  void set_fleet_backoff_cap_ms(int64_t ms) { fleet_backoff_cap_ms_ = ms; }
+  void set_fleet_crashloop_threshold(int n) { fleet_crashloop_threshold_ = n; }
+  void set_fleet_stable_ms(int64_t ms) { fleet_stable_ms_ = ms; }
+  void set_fleet_launch_grace_ms(int64_t ms) { fleet_launch_grace_ms_ = ms; }
   void set_scheduler(const std::string& mode) { scheduler_mode_ = mode; }
   void set_reattach_grace_ms(int64_t ms) { reattach_grace_ms_ = ms; }
   void set_journal_fsync(bool on) { journal_fsync_ = on; }
@@ -622,6 +693,41 @@ class Master {
     Json models = Json::array();
     for (const auto& [name, model] : models_) models.push_back(model);
     out.set("models", models);
+    // fleet spec + deploy walk state: journaled (fleet_spec,
+    // deploy_started/advanced/completed/failed), so a torn deploy record
+    // must shift this digest exactly like a torn model_version does.
+    // Wall-clock fields (started/updated/deadlines) are excluded.
+    if (fleet_active_) {
+      out.set("fleet", Json::object()
+                           .set("model", fleet_.model)
+                           .set("version", Json(fleet_.version))
+                           .set("target", Json(fleet_.target))
+                           .set("owner", fleet_.owner)
+                           .set("pool", fleet_.pool));
+    }
+    if (deploy_active_) {
+      Json d = Json::object();
+      d.set("id", Json(deploy_.id));
+      d.set("model", deploy_.model);
+      d.set("version", Json(deploy_.version));
+      d.set("target", deploy_.target);
+      d.set("checkpoint_uuid", deploy_.checkpoint_uuid);
+      d.set("status", deploy_.status);
+      d.set("phase", deploy_.phase);
+      d.set("detail", deploy_.detail);
+      Json pending = Json::array();
+      for (const auto& r : deploy_.pending) pending.push_back(r);
+      d.set("pending", pending);
+      d.set("draining", deploy_.draining);
+      Json rolled = Json::array();
+      for (const auto& r : deploy_.rolled) rolled.push_back(r);
+      d.set("rolled", rolled);
+      d.set("canary_count", Json(deploy_.canary_count));
+      d.set("prev_version", Json(deploy_.prev_version));
+      d.set("verdict", deploy_.verdict);
+      d.set("offending_stat", deploy_.offending_stat);
+      out.set("deploy", d);
+    }
     return out;
   }
 
@@ -909,9 +1015,26 @@ class Master {
     for (const auto& r : deploy_.rolled) rolled.push_back(r);
     j.set("rolled", rolled);
     j.set("status", deploy_.status);
+    j.set("phase", deploy_.phase);
     j.set("detail", deploy_.detail);
     j.set("started_ms", Json(deploy_.started_ms));
     j.set("updated_ms", Json(deploy_.updated_ms));
+    if (deploy_.canary_fraction > 0.0) {
+      Json c = Json::object();
+      c.set("fraction", Json(deploy_.canary_fraction));
+      c.set("count", Json(deploy_.canary_count));
+      c.set("bake_ms", Json(deploy_.bake_ms));
+      c.set("rollback_on_regression", Json(deploy_.rollback_on_regression));
+      c.set("error_rate_threshold", Json(deploy_.error_rate_threshold));
+      c.set("latency_factor", Json(deploy_.latency_factor));
+      c.set("min_requests", Json(deploy_.min_requests));
+      c.set("baseline", deploy_.baseline);
+      c.set("observed", deploy_.observed);
+      c.set("verdict", deploy_.verdict);
+      c.set("offending_stat", deploy_.offending_stat);
+      j.set("canary", c);
+    }
+    if (deploy_.prev_version > 0) j.set("prev_version", Json(deploy_.prev_version));
     return j;
   }
 
@@ -927,6 +1050,208 @@ class Master {
     return rep.model == deploy_.target;
   }
 
+  // Journal the deploy walk's full mutable state.  One generic progress
+  // event (instead of per-field deltas) keeps replay trivial: apply_event
+  // overwrites pending/draining/rolled/status/phase/... wholesale, so the
+  // replayed deploy equals the live one field for field.
+  void record_deploy_advanced() {
+    Json ev = Json::object();
+    ev.set("type", "deploy_advanced");
+    ev.set("id", Json(deploy_.id));
+    ev.set("status", deploy_.status);
+    ev.set("phase", deploy_.phase);
+    ev.set("detail", deploy_.detail);
+    Json pending = Json::array();
+    for (const auto& r : deploy_.pending) pending.push_back(r);
+    ev.set("pending", pending);
+    ev.set("draining", deploy_.draining);
+    Json rolled = Json::array();
+    for (const auto& r : deploy_.rolled) rolled.push_back(r);
+    ev.set("rolled", rolled);
+    ev.set("verdict", deploy_.verdict);
+    ev.set("offending_stat", deploy_.offending_stat);
+    ev.set("observed", deploy_.observed);
+    // rollback swaps the roll's target in place; journal it so replay
+    // points the resumed walk at the same version
+    ev.set("version", Json(deploy_.version));
+    ev.set("target", deploy_.target);
+    ev.set("checkpoint_uuid", deploy_.checkpoint_uuid);
+    ev.set("storage_path", deploy_.storage_path);
+    record(ev);
+  }
+
+  void fail_deploy(const std::string& detail) {
+    deploy_.status = "failed";
+    deploy_.detail = detail;
+    deploy_.updated_ms = now_ms();
+    record(Json::object()
+               .set("type", "deploy_failed")
+               .set("id", Json(deploy_.id))
+               .set("detail", detail));
+    printf("master: rolling deploy %lld FAILED: %s\n",
+           static_cast<long long>(deploy_.id), detail.c_str());
+    fflush(stdout);
+  }
+
+  // Terminal success: "completed" (forward roll landed; the fleet spec
+  // follows the deployed version so the supervisor keeps relaunching on
+  // it) or "rolled_back" (regression rollback landed; fleet stays on the
+  // previous version).  The fleet-version sync rides the journaled
+  // deploy_completed event — apply_event mirrors it on replay.
+  void finish_deploy(const std::string& terminal_status) {
+    deploy_.status = terminal_status;
+    deploy_.updated_ms = now_ms();
+    if (terminal_status == "completed" && fleet_active_ &&
+        fleet_.model == deploy_.model) {
+      fleet_.version = deploy_.version;
+    }
+    record(Json::object()
+               .set("type", "deploy_completed")
+               .set("id", Json(deploy_.id))
+               .set("status", terminal_status));
+    printf("master: rolling deploy %lld %s: %zu replica(s) now on %s\n",
+           static_cast<long long>(deploy_.id), terminal_status.c_str(),
+           deploy_.rolled.size(), deploy_.target.c_str());
+    fflush(stdout);
+  }
+
+  // Aggregate error-rate/latency over a set of replica heartbeat stats.
+  // error_rate = (errored + http_5xx) / requests; latency is the
+  // completion-weighted mean of each replica's latency_ms_avg.
+  struct CohortStats {
+    int64_t requests = 0;
+    double error_rate = 0.0;
+    double latency_ms = 0.0;
+  };
+  template <typename Pred>
+  CohortStats cohort_stats(Pred include) const {
+    CohortStats out;
+    int64_t completed = 0, errors = 0;
+    double latency_weighted = 0.0;
+    for (const auto& [rid, rep] : serve_replicas_) {
+      if (!include(rep)) continue;
+      const Json& st = rep.stats;
+      if (!st.is_object()) continue;
+      int64_t c = st["completed"].as_int(0);
+      int64_t e = st["errored"].as_int(0) + st["http_5xx"].as_int(0);
+      completed += c;
+      errors += e;
+      latency_weighted += st["latency_ms_avg"].as_double(0.0) * static_cast<double>(c);
+    }
+    out.requests = completed + errors;
+    if (out.requests > 0) {
+      out.error_rate = static_cast<double>(errors) / static_cast<double>(out.requests);
+    }
+    if (completed > 0) out.latency_ms = latency_weighted / static_cast<double>(completed);
+    return out;
+  }
+
+  Json cohort_json(const CohortStats& s) const {
+    return Json::object()
+        .set("requests", Json(s.requests))
+        .set("error_rate", Json(s.error_rate))
+        .set("latency_ms", Json(s.latency_ms));
+  }
+
+  // canary cohort = live replicas on the deploy target that registered
+  // after the roll started (fresh processes, so their counters reflect
+  // only new-version traffic)
+  CohortStats canary_cohort_stats() const {
+    return cohort_stats([this](const ServeReplicaState& rep) {
+      return replica_on_deploy_target(rep) &&
+             rep.registered_ms > deploy_.started_ms;
+    });
+  }
+
+  // Invert the deploy onto prev_version through the same drain machinery:
+  // every live replica on the regressed version drains and is replaced on
+  // the previous one.  Caller holds mu_; caller journals via
+  // record_deploy_advanced().
+  void begin_rollback() {
+    const Json* model = nullptr;
+    auto mit = models_.find(deploy_.model);
+    if (mit != models_.end()) model = &mit->second;
+    const Json* pv =
+        model != nullptr ? find_model_version(*model, deploy_.prev_version) : nullptr;
+    if (pv == nullptr) {
+      // rollback target vanished: the hold is the best remaining safety
+      deploy_.status = "held";
+      deploy_.detail = "canary regression on " + deploy_.offending_stat +
+                       "; rollback target v" +
+                       std::to_string(deploy_.prev_version) + " not found";
+      return;
+    }
+    deploy_.detail = "canary regression on " + deploy_.offending_stat +
+                     "; rolling back to v" + std::to_string(deploy_.prev_version);
+    deploy_.version = deploy_.prev_version;
+    deploy_.target = deploy_.model + "@v" + std::to_string(deploy_.prev_version);
+    deploy_.checkpoint_uuid = (*pv)["checkpoint_uuid"].as_string();
+    deploy_.storage_path = (*pv)["storage_path"].as_string();
+    deploy_.phase = "rolling_back";
+    deploy_.pending.clear();
+    deploy_.rolled.clear();
+    deploy_.draining.clear();
+    for (const auto& [rid, rep] : serve_replicas_) {
+      if (!replica_on_deploy_target(rep)) deploy_.pending.push_back(rid);
+    }
+    deploy_.step_deadline_ms = now_ms() + deploy_step_timeout_ms_;
+    printf("master: rolling deploy %lld: %s\n",
+           static_cast<long long>(deploy_.id), deploy_.detail.c_str());
+    fflush(stdout);
+  }
+
+  // Canary bake verdict; returns true when the roll may proceed past the
+  // bake (phase moved to finishing), false while still baking or once
+  // held/rolling back.  Caller holds mu_.
+  bool evaluate_canary(int64_t now) {
+    CohortStats canary = canary_cohort_stats();
+    const double base_err = deploy_.baseline["error_rate"].as_double(0.0);
+    const double base_lat = deploy_.baseline["latency_ms"].as_double(0.0);
+    if (canary.requests >= deploy_.min_requests) {
+      std::string offending;
+      if (canary.error_rate > base_err + deploy_.error_rate_threshold) {
+        offending = "error_rate";
+      } else if (base_lat > 0.0 &&
+                 canary.latency_ms > base_lat * deploy_.latency_factor) {
+        offending = "latency_ms";
+      }
+      if (!offending.empty()) {
+        deploy_.verdict = "regression";
+        deploy_.offending_stat = offending;
+        deploy_.observed = cohort_json(canary);
+        deploy_.updated_ms = now;
+        if (deploy_.rollback_on_regression && deploy_.prev_version > 0) {
+          begin_rollback();
+        } else {
+          deploy_.status = "held";
+          deploy_.detail = "canary regression on " + offending +
+                           "; roll held (rollback_on_regression not set)";
+          printf("master: rolling deploy %lld HELD: %s\n",
+                 static_cast<long long>(deploy_.id), deploy_.detail.c_str());
+          fflush(stdout);
+        }
+        record_deploy_advanced();
+        return false;
+      }
+    }
+    if (now < deploy_.bake_deadline_ms) return false;  // keep baking
+    deploy_.verdict = "pass";
+    deploy_.observed = cohort_json(canary);
+    deploy_.detail =
+        canary.requests < deploy_.min_requests
+            ? "canary bake passed (insufficient samples: " +
+                  std::to_string(canary.requests) + " < " +
+                  std::to_string(deploy_.min_requests) + " requests)"
+            : "canary bake passed";
+    deploy_.phase = "finishing";
+    deploy_.updated_ms = now;
+    printf("master: rolling deploy %lld: %s; finishing roll\n",
+           static_cast<long long>(deploy_.id), deploy_.detail.c_str());
+    fflush(stdout);
+    record_deploy_advanced();
+    return true;
+  }
+
   // Rolling-deploy state machine; caller holds mu_.  Driven from the 2s
   // tick plus every replica register/deregister, so the roll advances at
   // event latency, not poll cadence.  Invariants: at most one replica is
@@ -934,10 +1259,66 @@ class Master {
   // replica on the target version that registered AFTER the roll started
   // (pre-existing on-target replicas are capacity the fleet already had,
   // not replacements) before the next one drains — one-at-a-time
-  // replacement is the zero-downtime contract.
+  // replacement is the zero-downtime contract.  Every transition is
+  // journaled (deploy_advanced / deploy_failed / deploy_completed), so a
+  // SIGKILLed master resumes the walk from the replayed phase.
   void advance_rolling_deploy() {
     if (!deploy_active_ || deploy_.status != "rolling") return;
     const int64_t now = now_ms();
+    if (deploy_rescan_) {
+      // First advance after a replay: the journaled replica ids are from
+      // the previous incarnation (workers re-register under fresh ids),
+      // so rebuild the walk list from the live table.  Wait for the fleet
+      // to re-register first — rescanning an empty table would declare
+      // the roll complete with old-version replicas still serving.
+      if (deploy_rescan_deadline_ms_ == 0) {
+        deploy_rescan_deadline_ms_ = now + deploy_step_timeout_ms_;
+      }
+      // Under a supervised fleet, wait for the whole fleet (not just the
+      // first survivor) before rebuilding the walk: draining the lone
+      // re-registered replica while the rest are still coming back would
+      // briefly serve the model from zero replicas.
+      size_t want = 1;
+      if (fleet_active_ && fleet_.model == deploy_.model) {
+        want = static_cast<size_t>(std::max<int64_t>(fleet_.target, 1));
+      }
+      if (serve_replicas_.size() < want && now < deploy_rescan_deadline_ms_) {
+        return;
+      }
+      deploy_.pending.clear();
+      deploy_.draining.clear();  // mid-drain worker either finishes its
+                                 // drain and exits, or re-registers as a
+                                 // pending old-version replica below
+      for (const auto& [rid, rep] : serve_replicas_) {
+        if (!replica_on_deploy_target(rep)) deploy_.pending.push_back(rid);
+      }
+      deploy_.step_deadline_ms = now + deploy_step_timeout_ms_;
+      if (deploy_.phase == "baking") {
+        // bake_deadline_ms is runtime-only: restart the full bake window
+        // so the verdict always observes bake_ms of post-resume traffic
+        deploy_.bake_deadline_ms = now + deploy_.bake_ms;
+      }
+      deploy_.updated_ms = now;
+      deploy_rescan_ = false;
+      printf("master: rolling deploy %lld resumed after restart: phase %s, "
+             "%zu pending replica(s)\n",
+             static_cast<long long>(deploy_.id), deploy_.phase.c_str(),
+             deploy_.pending.size());
+      fflush(stdout);
+      record_deploy_advanced();
+    }
+    // Straggler sweep: an old-version replica that registered AFTER the
+    // walk list was built (slow re-registration behind a rescan, or a
+    // supervisor relaunch racing the roll) joins the walk — pending is
+    // the intent "nobody serves the old version", not a one-shot
+    // snapshot, so a roll never "completes" past a replica it missed.
+    for (const auto& [rid, rep] : serve_replicas_) {
+      if (replica_on_deploy_target(rep) || rid == deploy_.draining) continue;
+      if (std::find(deploy_.pending.begin(), deploy_.pending.end(), rid) ==
+          deploy_.pending.end()) {
+        deploy_.pending.push_back(rid);
+      }
+    }
     int64_t replacements = 0;
     for (const auto& [rid, rep] : serve_replicas_) {
       if (replica_on_deploy_target(rep) &&
@@ -948,13 +1329,7 @@ class Master {
     if (!deploy_.draining.empty()) {
       if (serve_replicas_.count(deploy_.draining)) {
         if (now > deploy_.step_deadline_ms) {
-          deploy_.status = "failed";
-          deploy_.detail =
-              "replica " + deploy_.draining + " did not drain in time";
-          deploy_.updated_ms = now;
-          printf("master: rolling deploy %lld FAILED: %s\n",
-                 static_cast<long long>(deploy_.id), deploy_.detail.c_str());
-          fflush(stdout);
+          fail_deploy("replica " + deploy_.draining + " did not drain in time");
         }
         return;  // still draining; its heartbeats keep carrying the flag
       }
@@ -963,20 +1338,39 @@ class Master {
       deploy_.draining.clear();
       deploy_.step_deadline_ms = now + deploy_step_timeout_ms_;
       deploy_.updated_ms = now;
+      record_deploy_advanced();
     }
     if (replacements < static_cast<int64_t>(deploy_.rolled.size())) {
       if (now > deploy_.step_deadline_ms) {
-        deploy_.status = "failed";
-        deploy_.detail = "no replacement replica serving " + deploy_.target +
-                         " registered in time";
-        deploy_.updated_ms = now;
-        printf("master: rolling deploy %lld FAILED: %s\n",
-               static_cast<long long>(deploy_.id), deploy_.detail.c_str());
-        fflush(stdout);
+        fail_deploy("no replacement replica serving " + deploy_.target +
+                    " registered in time");
       }
       return;  // replacement gate
     }
+    // canary gate: once the cohort has rolled and been replaced, bake
+    // instead of pulling the next pending replica
+    if (deploy_.phase == "canary" &&
+        static_cast<int64_t>(deploy_.rolled.size()) >= deploy_.canary_count) {
+      deploy_.phase = "baking";
+      deploy_.bake_deadline_ms = now + deploy_.bake_ms;
+      deploy_.updated_ms = now;
+      printf("master: rolling deploy %lld: canary cohort (%lld) up; baking "
+             "for %lldms\n",
+             static_cast<long long>(deploy_.id),
+             static_cast<long long>(deploy_.canary_count),
+             static_cast<long long>(deploy_.bake_ms));
+      fflush(stdout);
+      record_deploy_advanced();
+    }
+    if (deploy_.phase == "baking") {
+      if (!evaluate_canary(now)) return;  // still baking, held, or rolling back
+    }
     while (!deploy_.pending.empty()) {
+      // canary phase only drains the cohort; the rest waits for the bake
+      if (deploy_.phase == "canary" &&
+          static_cast<int64_t>(deploy_.rolled.size()) >= deploy_.canary_count) {
+        return;
+      }
       const std::string rid = deploy_.pending.front();
       auto it = serve_replicas_.find(rid);
       if (it == serve_replicas_.end() ||
@@ -993,14 +1387,297 @@ class Master {
              static_cast<long long>(deploy_.id), rid.c_str(),
              deploy_.target.c_str());
       fflush(stdout);
+      record_deploy_advanced();
       return;
     }
-    deploy_.status = "completed";
-    deploy_.updated_ms = now;
-    printf("master: rolling deploy %lld completed: %zu replica(s) now on %s\n",
-           static_cast<long long>(deploy_.id), deploy_.rolled.size(),
-           deploy_.target.c_str());
+    finish_deploy(deploy_.phase == "rolling_back" ? "rolled_back" : "completed");
+  }
+
+  // ---- serving-fleet supervisor ------------------------------------------
+
+  Json fleet_json() const {
+    Json j = Json::object();
+    j.set("model", fleet_.model);
+    j.set("version", Json(fleet_.version));
+    j.set("target", Json(fleet_.target));
+    j.set("owner", fleet_.owner);
+    j.set("pool", fleet_.pool);
+    j.set("status", fleet_.status);
+    j.set("detail", fleet_.detail);
+    j.set("updated_ms", Json(fleet_.updated_ms));
+    Json slots = Json::array();
+    for (const auto& s : fleet_.slots) {
+      Json sj = Json::object();
+      sj.set("index", Json(static_cast<int64_t>(s.index)));
+      sj.set("replica_id", s.replica_id);
+      sj.set("task_id", s.task_id);
+      sj.set("launch_version", Json(s.launch_version));
+      sj.set("failures", Json(static_cast<int64_t>(s.failures)));
+      sj.set("launches", Json(s.launches));
+      sj.set("last_error", s.last_error);
+      sj.set("gave_up", Json(s.gave_up));
+      slots.push_back(sj);
+    }
+    j.set("slots", slots);
+    return j;
+  }
+
+  // Shared by the PUT route and fleet_spec replay: overwrite the spec and
+  // re-key the slot table.  Runtime slot state resets — backoff counters
+  // and crash-loop give-ups belong to the OLD spec (a new PUT is the
+  // operator's explicit retry).  Caller holds mu_.
+  void do_set_fleet(const std::string& model, int64_t version, int64_t target,
+                    const Json& config, const std::string& owner,
+                    const std::string& pool) {
+    // scale-down: kill supervisor-owned tasks of slots beyond the new
+    // target (adopted external replicas are left running — not ours)
+    for (size_t i = static_cast<size_t>(std::max<int64_t>(target, 0));
+         i < fleet_.slots.size(); ++i) {
+      auto tit = tasks_.find(fleet_.slots[i].task_id);
+      if (tit != tasks_.end() && tit->second.state != "TERMINATED") {
+        terminate_task(tit->second, /*send_kill=*/true);
+      }
+    }
+    fleet_.model = model;
+    fleet_.version = version;
+    fleet_.target = std::max<int64_t>(target, 0);
+    fleet_.config = config.is_object() ? config : Json::object();
+    if (!owner.empty()) fleet_.owner = owner;
+    fleet_.pool = pool.empty() ? "default" : pool;
+    fleet_.slots.clear();
+    for (int64_t i = 0; i < fleet_.target; ++i) {
+      FleetSlot s;
+      s.index = static_cast<int>(i);
+      fleet_.slots.push_back(s);
+    }
+    fleet_.status = fleet_.target > 0 ? "reconciling" : "ok";
+    fleet_.detail.clear();
+    fleet_.updated_ms = now_ms();
+    fleet_active_ = true;
+  }
+
+  bool fleet_task_alive(const std::string& task_id) const {
+    if (task_id.empty()) return false;
+    auto it = tasks_.find(task_id);
+    return it != tasks_.end() && it->second.state != "TERMINATED";
+  }
+
+  // Which registry version should a NEW supervisor launch serve?  While a
+  // deploy is mid-roll, drained slots come back on the deploy target (the
+  // supervisor IS the "whatever relaunches the worker" in the drain
+  // contract); otherwise the fleet's base version.  During a rollback the
+  // deploy target already points at the previous version, so the same
+  // rule covers both directions.
+  int64_t fleet_launch_version() const {
+    if (deploy_active_ && deploy_.status == "rolling" &&
+        deploy_.model == fleet_.model) {
+      int64_t on_target = 0;
+      for (const auto& [rid, rep] : serve_replicas_) {
+        if (replica_on_deploy_target(rep) &&
+            rep.registered_ms > deploy_.started_ms) {
+          ++on_target;
+        }
+      }
+      for (const auto& s : fleet_.slots) {
+        if (s.replica_id.empty() && fleet_task_alive(s.task_id) &&
+            s.launch_version == deploy_.version) {
+          ++on_target;  // launch already in flight toward the target
+        }
+      }
+      // Any vacancy during the roll launches on the deploy target: an
+      // old-version launch would only be drained again later, and it can
+      // deadlock the roll by consuming the fleet's one free slot while
+      // the replacement gate waits for a target-version registration
+      // (e.g. a survivor re-registering right after a master restart
+      // steals the drained slot).  The exception is the canary window,
+      // where target-version exposure stays capped at the cohort size.
+      const bool capped =
+          deploy_.phase == "canary" || deploy_.phase == "baking";
+      if (!capped || on_target < deploy_.canary_count) {
+        return deploy_.version;
+      }
+    }
+    return fleet_.version;
+  }
+
+  int64_t fleet_backoff_ms(int failures) const {
+    int64_t d = fleet_backoff_initial_ms_;
+    for (int i = 1; i < failures && d < fleet_backoff_cap_ms_; ++i) d *= 2;
+    return std::min(d, fleet_backoff_cap_ms_);
+  }
+
+  // Launch one replacement replica for a vacant slot as a generic agent
+  // task (determined_tpu.exec.serve_replica through the same launch path
+  // notebooks/commands ride).  Caller holds mu_.
+  void launch_fleet_replica(FleetSlot& slot) {
+    const int64_t version = fleet_launch_version();
+    auto mit = models_.find(fleet_.model);
+    const Json* ver = mit != models_.end()
+                          ? find_model_version(mit->second, version)
+                          : nullptr;
+    if (ver == nullptr) {
+      slot.failures++;
+      slot.last_error = fleet_.model + "@v" + std::to_string(version) +
+                        " not in registry";
+      slot.next_launch_ms = now_ms() + fleet_backoff_ms(slot.failures);
+      return;
+    }
+    GenericTaskState task;
+    task.id = "task-" + std::to_string(next_task_id_++);
+    task.type = "serve";
+    task.module = "determined_tpu.exec.serve_replica";
+    task.owner = fleet_.owner;
+    task.pool = fleet_.pool;
+    task.slots = static_cast<int>(
+        std::max<int64_t>(fleet_.config["resources"]["slots"].as_int(0), 0));
+    Json cfg = fleet_.config.is_object() ? fleet_.config : Json::object();
+    cfg.set("model", fleet_.model);
+    cfg.set("version", Json(version));
+    cfg.set("checkpoint_uuid", (*ver)["checkpoint_uuid"].as_string());
+    cfg.set("storage_path", (*ver)["storage_path"].as_string());
+    cfg.set("fleet_slot", Json(static_cast<int64_t>(slot.index)));
+    task.config = cfg;
+    task.last_used_ms = now_ms();
+    tasks_[task.id] = task;
+    schedule_tasks();
+    slot.task_id = task.id;
+    slot.launch_version = version;
+    slot.launched_ms = now_ms();
+    slot.launches++;
+    printf("master: fleet slot %d: launching %s@v%lld as %s (launch %lld)\n",
+           slot.index, fleet_.model.c_str(), static_cast<long long>(version),
+           task.id.c_str(), static_cast<long long>(slot.launches));
     fflush(stdout);
+  }
+
+  // The supervisor's reconcile pass: adopt live replicas into slots,
+  // account task deaths as slot failures (capped exponential backoff,
+  // crash-loop give-up), and launch replacements for vacancies.  Caller
+  // holds mu_.  Runs every 2s tick plus after replica register/deregister.
+  void reconcile_fleet() {
+    if (!fleet_active_) return;
+    const int64_t now = now_ms();
+    // drop slot->replica links whose replica died (TTL reap, failed
+    // heartbeat, deregistration)
+    std::set<std::string> assigned;
+    for (auto& s : fleet_.slots) {
+      if (!s.replica_id.empty() && !serve_replicas_.count(s.replica_id)) {
+        s.replica_id.clear();
+      }
+      if (!s.replica_id.empty()) assigned.insert(s.replica_id);
+    }
+    // adopt: supervisor-launched replicas bind to their slot via task_id;
+    // externally-launched replicas of the fleet's model fill any vacancy
+    // (a PUT over a hand-launched fleet adopts it instead of doubling it)
+    for (const auto& [rid, rep] : serve_replicas_) {
+      if (assigned.count(rid)) continue;
+      if (rep.model_name != fleet_.model) continue;
+      FleetSlot* vacant = nullptr;
+      FleetSlot* by_task = nullptr;
+      for (auto& s : fleet_.slots) {
+        if (!rep.task_id.empty() && s.task_id == rep.task_id) by_task = &s;
+        if (s.replica_id.empty() && vacant == nullptr &&
+            (s.task_id.empty() || !fleet_task_alive(s.task_id))) {
+          vacant = &s;
+        }
+      }
+      FleetSlot* slot = by_task != nullptr ? by_task : vacant;
+      if (slot == nullptr || !slot->replica_id.empty()) continue;
+      slot->replica_id = rid;
+      assigned.insert(rid);
+    }
+    int64_t filled = 0, gave_up = 0;
+    const FleetSlot* degraded_slot = nullptr;
+    for (auto& s : fleet_.slots) {
+      if (!s.replica_id.empty()) {
+        ++filled;
+        // a replica that stayed up past the stability window clears the
+        // crash-loop counter — only RAPID failures count as a loop
+        auto rit = serve_replicas_.find(s.replica_id);
+        if (rit != serve_replicas_.end() &&
+            now - rit->second.registered_ms > fleet_stable_ms_) {
+          s.failures = 0;
+          s.gave_up = false;
+        }
+        continue;
+      }
+      if (!s.task_id.empty()) {
+        auto tit = tasks_.find(s.task_id);
+        if (tit == tasks_.end() || tit->second.state == "TERMINATED") {
+          // launch died without (or after losing) its replica
+          const int exit_code =
+              tit == tasks_.end() ? -1 : tit->second.exit_code;
+          if (exit_code == 0 || exit_code == 75) {
+            // orderly exit (drain contract): a relaunch, not a failure
+            s.next_launch_ms = now;
+          } else {
+            s.failures++;
+            s.last_error =
+                tit == tasks_.end()
+                    ? "task " + s.task_id + " lost"
+                    : "task " + s.task_id + " exited " +
+                          std::to_string(exit_code) +
+                          (tit->second.exit_detail.empty()
+                               ? ""
+                               : ": " + tit->second.exit_detail);
+            s.next_launch_ms = now + fleet_backoff_ms(s.failures);
+            printf("master: fleet slot %d: launch failed (%s); failure %d, "
+                   "backing off %lldms\n",
+                   s.index, s.last_error.c_str(), s.failures,
+                   static_cast<long long>(fleet_backoff_ms(s.failures)));
+            fflush(stdout);
+          }
+          s.task_id.clear();
+        } else if (now - s.launched_ms > fleet_launch_grace_ms_) {
+          // task claims to run but its replica never registered: hung
+          // startup — kill it and count the failure
+          terminate_task(tit->second, /*send_kill=*/true);
+          s.failures++;
+          s.last_error = "task " + s.task_id + " never registered a replica";
+          s.next_launch_ms = now + fleet_backoff_ms(s.failures);
+          s.task_id.clear();
+        } else {
+          continue;  // launch still in flight
+        }
+      }
+      if (s.failures >= fleet_crashloop_threshold_) {
+        if (!s.gave_up) {
+          s.gave_up = true;
+          printf("master: fleet slot %d: crash loop (%d rapid failures); "
+                 "giving up (%s)\n",
+                 s.index, s.failures, s.last_error.c_str());
+          fflush(stdout);
+        }
+        ++gave_up;
+        if (degraded_slot == nullptr) degraded_slot = &s;
+        continue;
+      }
+      if (s.task_id.empty() && now >= s.next_launch_ms) {
+        launch_fleet_replica(s);
+      }
+    }
+    std::string status, detail;
+    if (gave_up > 0) {
+      status = "degraded";
+      detail = "slot " + std::to_string(degraded_slot->index) + ": " +
+               std::to_string(degraded_slot->failures) +
+               " rapid failures (last: " + degraded_slot->last_error + ")";
+    } else if (filled >= fleet_.target) {
+      status = "ok";
+    } else {
+      status = "reconciling";
+      detail = std::to_string(filled) + "/" + std::to_string(fleet_.target) +
+               " replicas live";
+    }
+    if (status != fleet_.status || detail != fleet_.detail) {
+      fleet_.status = status;
+      fleet_.detail = detail;
+      fleet_.updated_ms = now;
+      if (status == "degraded") {
+        printf("master: serving fleet DEGRADED: %s\n", detail.c_str());
+        fflush(stdout);
+      }
+    }
   }
 
   // Fail agents that stopped polling: their allocations are failed so the
@@ -1327,6 +2004,84 @@ class Master {
         versions.push_back(ev["version"]);
         it->second.set("versions", versions);
       }
+    } else if (type == "fleet_spec") {
+      do_set_fleet(ev["model"].as_string(), ev["version"].as_int(),
+                   ev["target"].as_int(), ev["config"],
+                   ev["owner"].as_string(), ev["pool"].as_string());
+    } else if (type == "deploy_started") {
+      DeployState d;
+      d.id = ev["id"].as_int();
+      d.model = ev["model"].as_string();
+      d.version = ev["version"].as_int();
+      d.prev_version = ev["prev_version"].as_int();
+      d.target = ev["target"].as_string();
+      d.checkpoint_uuid = ev["checkpoint_uuid"].as_string();
+      d.storage_path = ev["storage_path"].as_string();
+      for (const auto& p : ev["pending"].elements()) {
+        d.pending.push_back(p.as_string());
+      }
+      d.canary_fraction = ev["canary_fraction"].as_double(0.0);
+      d.canary_count = ev["canary_count"].as_int(0);
+      d.rollback_on_regression = ev["rollback_on_regression"].as_bool(false);
+      d.bake_ms = ev["bake_ms"].as_int(0);
+      d.error_rate_threshold = ev["error_rate_threshold"].as_double(0.05);
+      d.latency_factor = ev["latency_factor"].as_double(2.0);
+      d.min_requests = ev["min_requests"].as_int(1);
+      d.baseline = ev["baseline"].is_object() ? ev["baseline"] : Json::object();
+      d.phase = ev["phase"].as_string().empty() ? "rolling" : ev["phase"].as_string();
+      d.status = "rolling";
+      d.started_ms = ev["ts"].as_int(now_ms());
+      d.updated_ms = d.started_ms;
+      d.step_deadline_ms = d.started_ms + deploy_step_timeout_ms_;
+      deploy_ = d;
+      deploy_active_ = true;
+      if (d.id >= next_deploy_id_) next_deploy_id_ = d.id + 1;
+      // replayed replica ids are from the previous incarnation: the first
+      // advance after boot rebuilds the walk from live registrations
+      deploy_rescan_ = true;
+      deploy_rescan_deadline_ms_ = 0;
+    } else if (type == "deploy_advanced") {
+      if (deploy_active_ && deploy_.id == ev["id"].as_int()) {
+        deploy_.status = ev["status"].as_string();
+        deploy_.phase = ev["phase"].as_string();
+        deploy_.detail = ev["detail"].as_string();
+        deploy_.pending.clear();
+        for (const auto& p : ev["pending"].elements()) {
+          deploy_.pending.push_back(p.as_string());
+        }
+        deploy_.draining = ev["draining"].as_string();
+        deploy_.rolled.clear();
+        for (const auto& r : ev["rolled"].elements()) {
+          deploy_.rolled.push_back(r.as_string());
+        }
+        deploy_.verdict = ev["verdict"].as_string();
+        deploy_.offending_stat = ev["offending_stat"].as_string();
+        deploy_.observed = ev["observed"].is_object() ? ev["observed"] : Json::object();
+        deploy_.version = ev["version"].as_int(deploy_.version);
+        deploy_.target = ev["target"].as_string();
+        deploy_.checkpoint_uuid = ev["checkpoint_uuid"].as_string();
+        deploy_.storage_path = ev["storage_path"].as_string();
+        deploy_.updated_ms = ev["ts"].as_int(now_ms());
+        deploy_rescan_ = true;
+        deploy_rescan_deadline_ms_ = 0;
+      }
+    } else if (type == "deploy_completed") {
+      if (deploy_active_ && deploy_.id == ev["id"].as_int()) {
+        deploy_.status = ev["status"].as_string();
+        deploy_.updated_ms = ev["ts"].as_int(now_ms());
+        deploy_rescan_ = false;
+        if (deploy_.status == "completed" && fleet_active_ &&
+            fleet_.model == deploy_.model) {
+          fleet_.version = deploy_.version;
+        }
+      }
+    } else if (type == "deploy_failed") {
+      if (deploy_active_ && deploy_.id == ev["id"].as_int()) {
+        deploy_.status = "failed";
+        deploy_.detail = ev["detail"].as_string();
+        deploy_.updated_ms = ev["ts"].as_int(now_ms());
+        deploy_rescan_ = false;
+      }
     }
     // "metrics" events from pre-compaction journals are ignored: metric
     // records now live in per-trial jsonl files, not the journal
@@ -1573,6 +2328,51 @@ class Master {
     }
     snap.set("webhooks", webhooks);
     snap.set("next_webhook_id", Json(next_webhook_id_));
+    if (fleet_active_) {
+      // spec only — slot runtime state (backoff, failures) rebuilds from
+      // live heartbeats after boot
+      snap.set("fleet", Json::object()
+                            .set("model", fleet_.model)
+                            .set("version", Json(fleet_.version))
+                            .set("target", Json(fleet_.target))
+                            .set("config", fleet_.config)
+                            .set("owner", fleet_.owner)
+                            .set("pool", fleet_.pool));
+    }
+    if (deploy_active_) {
+      Json d = Json::object();
+      d.set("id", Json(deploy_.id));
+      d.set("model", deploy_.model);
+      d.set("version", Json(deploy_.version));
+      d.set("prev_version", Json(deploy_.prev_version));
+      d.set("target", deploy_.target);
+      d.set("checkpoint_uuid", deploy_.checkpoint_uuid);
+      d.set("storage_path", deploy_.storage_path);
+      d.set("status", deploy_.status);
+      d.set("phase", deploy_.phase);
+      d.set("detail", deploy_.detail);
+      Json pending = Json::array();
+      for (const auto& r : deploy_.pending) pending.push_back(r);
+      d.set("pending", pending);
+      d.set("draining", deploy_.draining);
+      Json rolled = Json::array();
+      for (const auto& r : deploy_.rolled) rolled.push_back(r);
+      d.set("rolled", rolled);
+      d.set("started_ms", Json(deploy_.started_ms));
+      d.set("canary_fraction", Json(deploy_.canary_fraction));
+      d.set("canary_count", Json(deploy_.canary_count));
+      d.set("rollback_on_regression", Json(deploy_.rollback_on_regression));
+      d.set("bake_ms", Json(deploy_.bake_ms));
+      d.set("error_rate_threshold", Json(deploy_.error_rate_threshold));
+      d.set("latency_factor", Json(deploy_.latency_factor));
+      d.set("min_requests", Json(deploy_.min_requests));
+      d.set("baseline", deploy_.baseline);
+      d.set("observed", deploy_.observed);
+      d.set("verdict", deploy_.verdict);
+      d.set("offending_stat", deploy_.offending_stat);
+      snap.set("deploy", d);
+    }
+    snap.set("next_deploy_id", Json(next_deploy_id_));
     return snap;
   }
 
@@ -1727,6 +2527,57 @@ class Master {
       }
       next_webhook_id_ = s["next_webhook_id"].as_int(1);
     }
+    if (s.contains("fleet")) {
+      const Json& f = s["fleet"];
+      do_set_fleet(f["model"].as_string(), f["version"].as_int(),
+                   f["target"].as_int(), f["config"], f["owner"].as_string(),
+                   f["pool"].as_string());
+    }
+    if (s.contains("deploy")) {
+      const Json& dj = s["deploy"];
+      DeployState d;
+      d.id = dj["id"].as_int();
+      d.model = dj["model"].as_string();
+      d.version = dj["version"].as_int();
+      d.prev_version = dj["prev_version"].as_int();
+      d.target = dj["target"].as_string();
+      d.checkpoint_uuid = dj["checkpoint_uuid"].as_string();
+      d.storage_path = dj["storage_path"].as_string();
+      d.status = dj["status"].as_string();
+      d.phase = dj["phase"].as_string().empty() ? "rolling"
+                                                : dj["phase"].as_string();
+      d.detail = dj["detail"].as_string();
+      for (const auto& p : dj["pending"].elements()) {
+        d.pending.push_back(p.as_string());
+      }
+      d.draining = dj["draining"].as_string();
+      for (const auto& r : dj["rolled"].elements()) {
+        d.rolled.push_back(r.as_string());
+      }
+      d.started_ms = dj["started_ms"].as_int(0);
+      d.canary_fraction = dj["canary_fraction"].as_double(0.0);
+      d.canary_count = dj["canary_count"].as_int(0);
+      d.rollback_on_regression = dj["rollback_on_regression"].as_bool(false);
+      d.bake_ms = dj["bake_ms"].as_int(0);
+      d.error_rate_threshold = dj["error_rate_threshold"].as_double(0.05);
+      d.latency_factor = dj["latency_factor"].as_double(2.0);
+      d.min_requests = dj["min_requests"].as_int(1);
+      d.baseline = dj["baseline"].is_object() ? dj["baseline"] : Json::object();
+      d.observed = dj["observed"].is_object() ? dj["observed"] : Json::object();
+      d.verdict = dj["verdict"].as_string();
+      d.offending_stat = dj["offending_stat"].as_string();
+      d.updated_ms = d.started_ms;
+      d.step_deadline_ms = now_ms() + deploy_step_timeout_ms_;
+      deploy_ = d;
+      deploy_active_ = true;
+      if (d.status == "rolling") {
+        // restored replica ids are stale (the fleet re-registers under
+        // fresh ids); rebuild the walk from live registrations first
+        deploy_rescan_ = true;
+        deploy_rescan_deadline_ms_ = 0;
+      }
+    }
+    next_deploy_id_ = s["next_deploy_id"].as_int(next_deploy_id_);
   }
 
   // ---- users + tokens ----------------------------------------------------
@@ -3892,6 +4743,19 @@ class Master {
   bool deploy_active_ = false;
   int64_t next_deploy_id_ = 1;
   int64_t deploy_step_timeout_ms_ = 180000;
+  // post-replay resume: journaled replica ids belong to the previous
+  // incarnation, so the first advance rebuilds the walk from live
+  // registrations (runtime-only, never persisted)
+  bool deploy_rescan_ = false;
+  int64_t deploy_rescan_deadline_ms_ = 0;
+  // serving-fleet supervisor (reconcile_fleet): at most one fleet spec
+  FleetState fleet_;
+  bool fleet_active_ = false;
+  int64_t fleet_backoff_initial_ms_ = 1000;
+  int64_t fleet_backoff_cap_ms_ = 60000;
+  int fleet_crashloop_threshold_ = 5;   // rapid failures before giving up
+  int64_t fleet_stable_ms_ = 10000;     // uptime that clears the failure count
+  int64_t fleet_launch_grace_ms_ = 180000;  // launch -> replica registration
   std::deque<Json> events_;  // recent journal events for /api/v1/events
   std::map<std::string, int64_t> log_batch_seq_;  // trial/allocation -> last seq
   std::map<std::string, std::set<int>> coord_ports_in_use_;  // host -> ports
@@ -6092,10 +6956,19 @@ void install_routes_impl(Master& m, HttpServer& srv) {
   }));
 
   srv.route("POST", "/api/v1/tasks/{id}/exit", authed([&m](const HttpRequest& req) {
+    Json body;
+    const bool has_body = Json::try_parse(req.body, &body) && body.is_object();
     std::lock_guard<std::mutex> lk(m.mu_);
     auto it = m.tasks_.find(req.params.at("id"));
     if (it == m.tasks_.end()) return R::error(404, "no such task");
+    if (has_body && body.contains("exit_code")) {
+      it->second.exit_code = static_cast<int>(body["exit_code"].as_int(-1));
+      it->second.exit_detail = body["detail"].as_string();
+    }
     m.terminate_task(it->second, /*send_kill=*/false);  // already exited
+    // a fleet launch that died gets accounted (backoff / crash-loop) at
+    // event latency, not the next tick
+    m.reconcile_fleet();
     return R::json("{}");
   }));
 
@@ -6132,13 +7005,25 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     rep.checkpoint = body["checkpoint"].as_string();
     rep.model_name = body["model_name"].as_string();
     rep.model_version = body["model_version"].as_int(0);
+    rep.task_id = body["task_id"].as_string();
     rep.owner = m.authenticate(req);
     rep.registered_ms = now_ms();
     rep.last_heartbeat_ms = rep.registered_ms;
+    {
+      // a replica re-registering after a master restart still holds its
+      // port, but the task-port allocator replays empty — mark the port
+      // used so a relaunched task on the same host never collides with it
+      std::string rhost, rpath;
+      int rport = 0;
+      if (Master::parse_http_url(url, &rhost, &rport, &rpath) && rport > 0)
+        m.coord_ports_in_use_[rhost].insert(rport);
+    }
     m.serve_replicas_[rep.id] = rep;
     // a replacement replica registering on the target version is what a
-    // rolling deploy waits for between drains
+    // rolling deploy waits for between drains; the fleet supervisor binds
+    // the new replica to its slot
     m.advance_rolling_deploy();
+    m.reconcile_fleet();
     Json out = Json::object();
     out.set("id", rep.id);
     out.set("heartbeat_ttl_ms", Json(m.serve_replica_timeout_ms_));
@@ -6156,6 +7041,25 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     if (it == m.serve_replicas_.end()) return R::error(404, "no such replica");
     it->second.last_heartbeat_ms = now_ms();
     if (has_stats) it->second.stats = body["stats"];
+    // A crashed engine loop keeps the HTTP thread (and these heartbeats)
+    // alive behind a 500 /healthz: a truthy `failed` stat means the
+    // replica can no longer serve, so reap NOW instead of waiting out the
+    // TTL.  The worker's next heartbeat 404s -> it re-registers once its
+    // engine is replaced; the supervisor meanwhile launches a substitute.
+    if (has_stats) {
+      const Json& f = body["stats"]["failed"];
+      if (f.as_bool(false) || (f.is_string() && !f.as_string().empty())) {
+        printf("master: serving replica %s reports failed engine (%s); "
+               "reaping\n",
+               it->second.id.c_str(),
+               f.is_string() ? f.as_string().c_str() : "failed=true");
+        fflush(stdout);
+        m.serve_replicas_.erase(it);
+        m.advance_rolling_deploy();
+        m.reconcile_fleet();
+        return R::json(Json::object().set("reaped", Json(true)).dump());
+      }
+    }
     Json out = Json::object();
     if (m.deploy_active_ && m.deploy_.status == "rolling" &&
         m.deploy_.draining == it->second.id) {
@@ -6179,8 +7083,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     auto it = m.serve_replicas_.find(req.params.at("id"));
     if (it == m.serve_replicas_.end()) return R::error(404, "no such replica");
     m.serve_replicas_.erase(it);
-    // a draining replica deregistering is what advances a rolling deploy
+    // a draining replica deregistering is what advances a rolling deploy;
+    // the supervisor sees the vacated slot immediately
     m.advance_rolling_deploy();
+    m.reconcile_fleet();
     return R::json("{}");
   }));
 
@@ -6246,13 +7152,119 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     for (const auto& [rid, rep] : m.serve_replicas_) {
       if (!on_target(rep)) d.pending.push_back(rid);
     }
+    // rollback target: the version the fleet is serving right now — the
+    // fleet spec when one is set, else the highest version live replicas
+    // of this model actually report
+    if (m.fleet_active_ && m.fleet_.model == name && m.fleet_.version != v) {
+      d.prev_version = m.fleet_.version;
+    } else {
+      for (const auto& [rid, rep] : m.serve_replicas_) {
+        if (rep.model_name == name && rep.model_version != v) {
+          d.prev_version = std::max(d.prev_version, rep.model_version);
+        }
+      }
+    }
+    d.canary_fraction = body["canary_fraction"].as_double(0.0);
+    if (d.canary_fraction > 0.0 && !d.pending.empty()) {
+      const int64_t n = static_cast<int64_t>(d.pending.size());
+      d.canary_count = std::max<int64_t>(
+          1, std::min<int64_t>(
+                 n, static_cast<int64_t>(std::lround(d.canary_fraction * static_cast<double>(n)))));
+      d.phase = "canary";
+      d.bake_ms = body["bake_seconds"].as_int(30) * 1000;
+      d.rollback_on_regression = body["rollback_on_regression"].as_bool(false);
+      if (body.contains("error_rate_threshold")) {
+        d.error_rate_threshold = body["error_rate_threshold"].as_double(0.05);
+      }
+      if (body.contains("latency_factor")) {
+        d.latency_factor = body["latency_factor"].as_double(2.0);
+      }
+      d.min_requests = body["min_requests"].as_int(1);
+      // pre-roll fleet baseline the bake verdict compares against,
+      // journaled with the intent so the resumed roll judges against the
+      // same bar
+      Master::CohortStats base = m.cohort_stats(
+          [&on_target](const ServeReplicaState& rep) { return !on_target(rep); });
+      d.baseline = m.cohort_json(base);
+    }
     m.deploy_ = d;
     m.deploy_active_ = true;
-    printf("master: rolling deploy %lld started: %s over %zu replica(s)\n",
-           static_cast<long long>(d.id), d.target.c_str(), d.pending.size());
+    m.deploy_rescan_ = false;
+    {
+      Json ev = Json::object();
+      ev.set("type", "deploy_started");
+      ev.set("id", Json(d.id));
+      ev.set("model", d.model);
+      ev.set("version", Json(d.version));
+      ev.set("prev_version", Json(d.prev_version));
+      ev.set("target", d.target);
+      ev.set("checkpoint_uuid", d.checkpoint_uuid);
+      ev.set("storage_path", d.storage_path);
+      Json pending = Json::array();
+      for (const auto& r : d.pending) pending.push_back(r);
+      ev.set("pending", pending);
+      ev.set("canary_fraction", Json(d.canary_fraction));
+      ev.set("canary_count", Json(d.canary_count));
+      ev.set("rollback_on_regression", Json(d.rollback_on_regression));
+      ev.set("bake_ms", Json(d.bake_ms));
+      ev.set("error_rate_threshold", Json(d.error_rate_threshold));
+      ev.set("latency_factor", Json(d.latency_factor));
+      ev.set("min_requests", Json(d.min_requests));
+      ev.set("baseline", d.baseline);
+      ev.set("phase", d.phase);
+      m.record(ev);
+    }
+    printf("master: rolling deploy %lld started: %s over %zu replica(s)%s\n",
+           static_cast<long long>(d.id), d.target.c_str(), d.pending.size(),
+           d.canary_count > 0
+               ? (" (canary cohort " + std::to_string(d.canary_count) + ")").c_str()
+               : "");
     fflush(stdout);
     m.advance_rolling_deploy();
     return R::json(m.deploy_json().dump(), 202);
+  }));
+
+  // ---- self-healing serving fleet (supervisor spec) ----
+  srv.route("PUT", "/api/v1/serving/fleet", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    const std::string name = body["model"].as_string();
+    if (name.empty()) return R::error(400, "model required");
+    const int64_t target = body["target"].as_int(-1);
+    if (target < 0) return R::error(400, "target replica count required");
+    std::lock_guard<std::mutex> lk(m.mu_);
+    auto it = m.models_.find(name);
+    if (it == m.models_.end()) return R::error(404, "no such model");
+    const Json& bv = body["version"];
+    int64_t v = (bv.is_null() || (bv.is_string() && bv.as_string() == "latest"))
+                    ? latest_model_version(it->second)
+                    : bv.as_int();
+    if (find_model_version(it->second, v) == nullptr) {
+      return R::error(404, "no such version");
+    }
+    const std::string owner = m.authenticate(req);
+    m.do_set_fleet(name, v, target, body["config"], owner,
+                   body["pool"].as_string());
+    m.record(Json::object()
+                 .set("type", "fleet_spec")
+                 .set("model", name)
+                 .set("version", Json(v))
+                 .set("target", Json(target))
+                 .set("config", m.fleet_.config)
+                 .set("owner", owner)
+                 .set("pool", m.fleet_.pool));
+    printf("master: serving fleet spec: %s@v%lld x%lld (pool %s)\n",
+           name.c_str(), static_cast<long long>(v),
+           static_cast<long long>(target), m.fleet_.pool.c_str());
+    fflush(stdout);
+    m.reconcile_fleet();
+    return R::json(m.fleet_json().dump(), 200);
+  }));
+
+  srv.route("GET", "/api/v1/serving/fleet", authed([&m](const HttpRequest&) {
+    std::lock_guard<std::mutex> lk(m.mu_);
+    if (!m.fleet_active_) return R::error(404, "no fleet spec has been set");
+    return R::json(m.fleet_json().dump());
   }));
 
   srv.route("GET", "/api/v1/serving/deploy", authed([&m](const HttpRequest&) {
@@ -6696,6 +7708,11 @@ int main(int argc, char** argv) {
   int agent_timeout_sec = 90;
   int serve_replica_timeout_sec = 15;
   int deploy_step_timeout_sec = 180;
+  int fleet_backoff_initial_ms = 1000;
+  int fleet_backoff_cap_ms = 60000;
+  int fleet_crashloop_threshold = 5;
+  int fleet_stable_sec = 10;
+  int fleet_launch_grace_sec = 180;
   int reattach_grace_sec = 60;
   bool journal_fsync = true;
   int ingest_max_inflight = 256;
@@ -6728,6 +7745,19 @@ int main(int argc, char** argv) {
     else if (arg == "--deploy-step-timeout-sec")
       deploy_step_timeout_sec =
           std::atoi(next("--deploy-step-timeout-sec").c_str());
+    else if (arg == "--fleet-backoff-initial-ms")
+      fleet_backoff_initial_ms =
+          std::atoi(next("--fleet-backoff-initial-ms").c_str());
+    else if (arg == "--fleet-backoff-cap-ms")
+      fleet_backoff_cap_ms = std::atoi(next("--fleet-backoff-cap-ms").c_str());
+    else if (arg == "--fleet-crashloop-threshold")
+      fleet_crashloop_threshold =
+          std::atoi(next("--fleet-crashloop-threshold").c_str());
+    else if (arg == "--fleet-stable-sec")
+      fleet_stable_sec = std::atoi(next("--fleet-stable-sec").c_str());
+    else if (arg == "--fleet-launch-grace-sec")
+      fleet_launch_grace_sec =
+          std::atoi(next("--fleet-launch-grace-sec").c_str());
     else if (arg == "--reattach-grace-sec")
       reattach_grace_sec = std::atoi(next("--reattach-grace-sec").c_str());
     else if (arg == "--journal-no-fsync") journal_fsync = false;
@@ -6771,6 +7801,12 @@ int main(int argc, char** argv) {
       static_cast<int64_t>(serve_replica_timeout_sec) * 1000);
   master.set_deploy_step_timeout_ms(
       static_cast<int64_t>(deploy_step_timeout_sec) * 1000);
+  master.set_fleet_backoff_initial_ms(fleet_backoff_initial_ms);
+  master.set_fleet_backoff_cap_ms(fleet_backoff_cap_ms);
+  master.set_fleet_crashloop_threshold(fleet_crashloop_threshold);
+  master.set_fleet_stable_ms(static_cast<int64_t>(fleet_stable_sec) * 1000);
+  master.set_fleet_launch_grace_ms(
+      static_cast<int64_t>(fleet_launch_grace_sec) * 1000);
   if (scheduler != "priority" && scheduler != "fair_share") {
     fprintf(stderr, "--scheduler must be priority or fair_share\n");
     return 2;
@@ -6857,6 +7893,7 @@ int main(int argc, char** argv) {
     master.reap_idle_tasks();
     master.reap_dead_serve_replicas();
     master.advance_rolling_deploy();
+    master.reconcile_fleet();
     master.reap_unattached_allocations();
     master.maybe_compact();
     if (++ticks >= 1800) {

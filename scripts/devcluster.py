@@ -144,7 +144,8 @@ def write_master_journal(state_dir: str, events) -> str:
 class DevCluster:
     """master + agents as subprocesses (reference double.devcluster.yaml)."""
 
-    def __init__(self, tmp_path, agents=1, slots=2, master_args=()):
+    def __init__(self, tmp_path, agents=1, slots=2, master_args=(),
+                 log_dir=None):
         import requests
 
         self.port = free_port()
@@ -156,10 +157,32 @@ class DevCluster:
         self.agents = agents
         self.slots = slots
         self.master_args = list(master_args)
+        # With log_dir set, process output appends to <log_dir>/<name>.log
+        # instead of an unread PIPE — long chaos smokes otherwise risk
+        # blocking a chatty daemon on a full pipe, and the files survive
+        # for post-mortems.
+        self.log_dir = str(log_dir) if log_dir else None
         # authenticated session (every API call except login/master-info
         # requires a bearer token); filled in by start_master's login
         self.http = requests.Session()
         self.token = None
+
+    def _sink(self, name: str):
+        if self.log_dir is None:
+            return subprocess.PIPE
+        os.makedirs(self.log_dir, exist_ok=True)
+        return open(os.path.join(self.log_dir, name + ".log"), "ab")
+
+    def proc_log_tail(self, name: str, n: int = 40):
+        """Last ``n`` log lines of a process (log_dir mode only)."""
+        if self.log_dir is None:
+            return []
+        path = os.path.join(self.log_dir, name + ".log")
+        if not os.path.exists(path):
+            return []
+        with open(path, "rb") as f:
+            return [ln.decode(errors="replace")
+                    for ln in f.read().splitlines()[-n:]]
 
     def start_master(self):
         self.procs["master"] = subprocess.Popen(
@@ -171,7 +194,7 @@ class DevCluster:
                 "--checkpoint-dir", self.ckpt_dir,
                 *self.master_args,
             ],
-            stdout=subprocess.PIPE,
+            stdout=self._sink("master"),
             stderr=subprocess.STDOUT,
         )
         deadline = time.time() + 10
@@ -219,7 +242,7 @@ class DevCluster:
         self.procs[f"agent-{idx}"] = subprocess.Popen(
             argv,
             env=env,
-            stdout=subprocess.PIPE,
+            stdout=self._sink(f"agent-{idx}"),
             stderr=subprocess.STDOUT,
         )
 
@@ -281,14 +304,23 @@ class DevCluster:
         assert r.status_code in (200, 201), r.text
         return r.json()
 
-    def deploy(self, model, version="latest", *, wait=False, timeout=120):
+    def deploy(self, model, version="latest", *, wait=False, timeout=120,
+               canary_fraction=None, bake_seconds=None, min_requests=None,
+               rollback_on_regression=False):
         """POST a rolling deploy; with ``wait`` poll until it leaves
-        'rolling' (the caller must relaunch drained replicas — the master
-        only signals)."""
-        r = self.http.post(
-            self.url + "/api/v1/serving/deploy",
-            json={"model": model, "version": version},
-        )
+        'rolling'.  Without a fleet spec the caller must relaunch drained
+        replicas (the master only signals); under a supervised fleet the
+        master relaunches them itself.  ``canary_fraction`` rolls a
+        cohort first and bakes it against the pre-roll baseline."""
+        body = {"model": model, "version": version}
+        if canary_fraction is not None:
+            body["canary_fraction"] = canary_fraction
+            body["rollback_on_regression"] = rollback_on_regression
+            if bake_seconds is not None:
+                body["bake_seconds"] = int(bake_seconds)
+            if min_requests is not None:
+                body["min_requests"] = int(min_requests)
+        r = self.http.post(self.url + "/api/v1/serving/deploy", json=body)
         assert r.status_code == 202, r.text
         state = r.json()
         deadline = time.time() + timeout
@@ -299,6 +331,33 @@ class DevCluster:
 
     def deploy_status(self):
         r = self.http.get(self.url + "/api/v1/serving/deploy", timeout=5)
+        assert r.status_code == 200, r.text
+        return r.json()
+
+    # -- supervised serving fleet (docs/serving.md) ------------------------
+
+    def set_fleet(self, model, version, target, *, config=None, pool=None):
+        """PUT the serving-fleet spec: the master's replica supervisor
+        reconciles live replicas toward ``target`` copies of
+        ``model@version``, launching ``exec.serve_replica`` agent tasks
+        for any vacancy."""
+        body = {"model": model, "version": version, "target": target}
+        if config is not None:
+            body["config"] = config
+        if pool is not None:
+            body["pool"] = pool
+        r = self.http.put(
+            self.url + "/api/v1/serving/fleet", json=body, timeout=10
+        )
+        assert r.status_code == 200, r.text
+        return r.json()
+
+    def fleet_status(self):
+        """The fleet spec + per-slot supervisor state, or None before any
+        spec has been PUT."""
+        r = self.http.get(self.url + "/api/v1/serving/fleet", timeout=5)
+        if r.status_code == 404:
+            return None
         assert r.status_code == 200, r.text
         return r.json()
 
@@ -417,6 +476,38 @@ def sample_registry_events():
         {"type": "model_created", "name": "wal-model", "model": model},
         {"type": "model_version", "name": "wal-model", "version": v1},
         {"type": "model_version", "name": "wal-model", "version": v2},
+    ]
+
+
+def sample_serving_events():
+    """Serving-fleet + canary-deploy journal fixture (WAL tooling tests):
+    a fleet spec, then a canary deploy walked through cohort-rolled ->
+    baking -> completed.  Every record changes the dump-state digest
+    (fleet/deploy rows), so prefix truncation of ANY of them is
+    observable.  Follows ``sample_registry_events()`` — the deploy rolls
+    wal-model v1 -> v2."""
+    return [
+        {"type": "fleet_spec", "model": "wal-model", "version": 1,
+         "target": 2, "config": {}, "owner": "determined", "pool": "default"},
+        {"type": "deploy_started", "id": 1, "model": "wal-model",
+         "version": 2, "prev_version": 1, "target": "wal-model@v2",
+         "checkpoint_uuid": "uuid-bbb", "storage_path": "/ck/uuid-bbb",
+         "pending": ["replica-a", "replica-b"], "canary_fraction": 0.5,
+         "canary_count": 1, "rollback_on_regression": True,
+         "bake_ms": 5000, "error_rate_threshold": 0.05,
+         "latency_factor": 2.0, "min_requests": 10,
+         "baseline": {"requests": 100, "error_rate": 0.01,
+                      "latency_ms": 20.0},
+         "phase": "canary"},
+        {"type": "deploy_advanced", "id": 1, "status": "rolling",
+         "phase": "baking", "detail": "canary cohort rolled; baking",
+         "pending": ["replica-b"], "draining": "", "rolled": ["replica-a"],
+         "verdict": "", "offending_stat": "",
+         "observed": {"requests": 40, "error_rate": 0.0,
+                      "latency_ms": 18.0},
+         "version": 2, "target": "wal-model@v2",
+         "checkpoint_uuid": "uuid-bbb", "storage_path": "/ck/uuid-bbb"},
+        {"type": "deploy_completed", "id": 1, "status": "completed"},
     ]
 
 
@@ -548,6 +639,303 @@ def _deploy_smoke(cluster: "DevCluster") -> int:
                 proc.kill()
 
 
+class _OpenLoopLoad:
+    """Open-loop Poisson arrivals against the fleet's live replicas.
+
+    Arrivals are independent of completions (the open-loop property: a
+    stalled fleet does not slow the offered load).  Each arrival retries
+    across every replica it knows until one answers 200 or its window
+    closes — a request is DROPPED only when NO replica answered it at
+    all, which is the chaos acceptance bar: per-replica 503s during a
+    drain and dead sockets during a relaunch just reroute, and the
+    replica set is cached so requests keep flowing while the master
+    itself is down."""
+
+    REQUEST_WINDOW_S = 25.0
+
+    def __init__(self, cluster: "DevCluster", rate_hz: float = 6.0) -> None:
+        import random
+        import threading
+
+        self.cluster = cluster
+        self.rate_hz = rate_hz
+        self.sent = 0
+        self.ok = 0
+        self.dropped = 0
+        self.http_5xx = 0
+        self._rng = random.Random(0x10AD)
+        self._urls: list = []
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._arrival: Any = None
+        self._lock = threading.Lock()  # counters + url cache + thread list
+
+    def _refresh_urls(self) -> None:
+        try:
+            urls = [r["url"] for r in self.cluster.serving() if r.get("url")]
+        except Exception:
+            return  # master down: keep the cached replica set
+        if urls:
+            with self._lock:
+                self._urls = urls
+
+    def _one_request(self, seq: int) -> None:
+        import random
+        import requests
+
+        rng = random.Random(seq)  # per-thread: Random() is not thread-safe
+        deadline = time.time() + self.REQUEST_WINDOW_S
+        while time.time() < deadline:
+            with self._lock:
+                urls = list(self._urls)
+            rng.shuffle(urls)
+            for url in urls:
+                try:
+                    r = requests.post(
+                        url + "/v1/generate",
+                        json={"prompt_tokens": [1, 2, 3], "max_new_tokens": 4},
+                        timeout=10,
+                    )
+                except Exception:
+                    continue  # replica gone mid-relaunch: try the next
+                if r.status_code == 200:
+                    with self._lock:
+                        self.ok += 1
+                    return
+                if r.status_code >= 500:
+                    with self._lock:
+                        self.http_5xx += 1
+            time.sleep(0.25)
+        with self._lock:
+            self.dropped += 1
+
+    def start(self) -> None:
+        import threading
+
+        def arrivals():
+            while not self._stop.is_set():
+                self._refresh_urls()
+                t = threading.Thread(target=self._one_request,
+                                     args=(self.sent,), daemon=True)
+                t.start()
+                with self._lock:
+                    self._threads.append(t)
+                    self.sent += 1
+                self._stop.wait(self._rng.expovariate(self.rate_hz))
+
+        self._refresh_urls()
+        self._arrival = threading.Thread(target=arrivals, daemon=True)
+        self._arrival.start()
+
+    def stop_and_join(self) -> None:
+        """Stop NEW arrivals, then wait for every in-flight request to
+        settle (the zero-dropped count is meaningless mid-flight)."""
+        self._stop.set()
+        if self._arrival is not None:
+            self._arrival.join(timeout=10)
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout=self.REQUEST_WINDOW_S + 5)
+
+    def summary(self) -> str:
+        return (f"sent={self.sent} ok={self.ok} dropped={self.dropped} "
+                f"retried_5xx={self.http_5xx}")
+
+
+def _wait_for(poll, pred, what: str, timeout: float = 90.0):
+    """Poll until pred(state) or raise with the last state attached."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            last = poll()
+        except Exception:
+            last = None
+        if last is not None and pred(last):
+            return last
+        time.sleep(0.5)
+    raise AssertionError(
+        f"timed out waiting for {what}: {json.dumps(last)[:1500]}"
+    )
+
+
+def _selfheal_smoke(root) -> int:
+    """The self-healing acceptance drill (docs/operations.md):
+
+    1. supervised fleet of 2 replicas; SIGKILL one replica process ->
+       the supervisor relaunches it (no harness in the loop);
+    2. canary deploy to v2 under open-loop Poisson load, SIGKILL the
+       master mid-roll -> the restarted master resumes the deploy from
+       the WAL and completes it with ZERO dropped requests;
+    3. canary deploy to v3 with an injected error rate -> the bake
+       verdict auto-holds the roll naming the offending stat;
+    4. a fleet spec pointing at a bad checkpoint path crash-loops ->
+       the supervisor backs off and degrades with a bounded launch count.
+    """
+    agent_state = str(root / "agent-state")
+    cluster = DevCluster(
+        root, agents=0, slots=2, log_dir=root / "logs",
+        master_args=(
+            "--serve-replica-timeout-sec", "5",
+            "--deploy-step-timeout-sec", "120",
+            "--fleet-backoff-initial-ms", "200",
+            "--fleet-backoff-cap-ms", "1000",
+            "--fleet-crashloop-threshold", "3",
+            "--fleet-stable-sec", "2",
+        ),
+    )
+    cluster.start_master()
+    cluster.start_agent(0, extra_args=("--state-dir", agent_state))
+    _wait_for(
+        lambda: cluster.http.get(cluster.url + "/api/v1/agents", timeout=2).json(),
+        lambda agents: len(agents) >= 1, "agent registration", 20)
+
+    fleet_cfg = {
+        "serve": {"block_size": 16, "num_blocks": 64, "max_batch": 2,
+                  "max_prompt_len": 8, "max_new_tokens": 8, "queue_depth": 16,
+                  "heartbeat_interval_s": 0.5, "drain_grace_s": 20.0},
+        "env": {"JAX_PLATFORMS": "cpu"},
+    }
+    load = None
+    try:
+        ckpt_root = os.path.join(cluster.ckpt_dir, "selfheal")
+        os.makedirs(ckpt_root, exist_ok=True)
+        print("selfheal: training a tiny LM checkpoint ...")
+        ckpt_dir, uuid = train_tiny_lm_checkpoint(ckpt_root)
+        cluster.register_model("heal-lm", uuid, storage_path=ckpt_dir)
+        print(f"selfheal: registered heal-lm@v1 ({uuid})")
+
+        # -- phase 1: supervisor fills the fleet, then heals a SIGKILL --
+        cluster.set_fleet("heal-lm", 1, 2, config=fleet_cfg)
+        fleet = _wait_for(
+            cluster.fleet_status,
+            lambda f: f["status"] == "ok"
+            and sum(1 for s in f["slots"] if s["replica_id"]) == 2,
+            "2 supervised replicas live", 120)
+        victim = fleet["slots"][0]
+        with open(os.path.join(agent_state, victim["task_id"] + ".pid")) as f:
+            pid = int(f.read().strip())
+        print(f"selfheal: fleet ok; SIGKILLing replica slot 0 "
+              f"({victim['task_id']}, pid {pid})")
+        os.kill(pid, signal.SIGKILL)
+        fleet = _wait_for(
+            cluster.fleet_status,
+            lambda f: f["status"] == "ok"
+            and sum(1 for s in f["slots"] if s["replica_id"]) == 2
+            and f["slots"][0]["task_id"] != victim["task_id"],
+            "supervisor relaunch after replica SIGKILL", 120)
+        print(f"selfheal: slot 0 relaunched as {fleet['slots'][0]['task_id']} "
+              f"(launches={fleet['slots'][0]['launches']})")
+
+        # -- phase 2: canary deploy + master SIGKILL mid-roll, under load --
+        load = _OpenLoopLoad(cluster)
+        load.start()
+        time.sleep(3.0)  # accumulate a pre-roll baseline with traffic on it
+        cluster.register_model("heal-lm", uuid, storage_path=ckpt_dir, version=2)
+        state = cluster.deploy("heal-lm", 2, canary_fraction=0.5,
+                               bake_seconds=5, min_requests=3)
+        print(f"selfheal: canary deploy started "
+              f"(phase={state['phase']}, cohort={state['canary']['count']})")
+        _wait_for(cluster.deploy_status,
+                  lambda d: d.get("draining") or d.get("rolled"),
+                  "canary drain to start", 60)
+        print("selfheal: canary mid-roll; SIGKILLing the master")
+        cluster.kill_master()
+        time.sleep(1.0)
+        cluster.restart_master()
+        print("selfheal: master restarted; waiting for the WAL-resumed "
+              "deploy to complete")
+        state = _wait_for(cluster.deploy_status,
+                          lambda d: d["status"] != "rolling",
+                          "resumed deploy to finish", 240)
+        models = sorted(r.get("model") for r in cluster.serving())
+        print(f"selfheal: deploy status={state['status']} "
+              f"verdict={state['canary']['verdict']} "
+              f"detail={state['detail']!r} fleet={models}")
+        load.stop_and_join()
+        print(f"selfheal: load {load.summary()}")
+        if not (state["status"] == "completed"
+                and state["canary"]["verdict"] == "pass"
+                and models == ["heal-lm@v2", "heal-lm@v2"]
+                and load.sent > 0 and load.dropped == 0):
+            print("selfheal: FAIL in kill-master-mid-canary phase",
+                  file=sys.stderr)
+            print(f"selfheal: fleet status: {json.dumps(cluster.fleet_status())}",
+                  file=sys.stderr)
+            for line in cluster.proc_log_tail("master", 60):
+                print(f"  master| {line}", file=sys.stderr)
+            for line in cluster.proc_log_tail("agent-0", 30):
+                print(f"  agent | {line}", file=sys.stderr)
+            return 1
+
+        # -- phase 3: injected error-rate regression auto-holds the roll --
+        bad_cfg = dict(fleet_cfg)
+        bad_cfg["env"] = {**fleet_cfg["env"], "DTPU_SERVE_ERROR_RATE": "0.5",
+                          "DTPU_SERVE_ERROR_VERSION": "3"}
+        cluster.set_fleet("heal-lm", 2, 2, config=bad_cfg)
+        _wait_for(cluster.fleet_status, lambda f: f["status"] == "ok",
+                  "fleet re-adoption under chaos env", 60)
+        cluster.register_model("heal-lm", uuid, storage_path=ckpt_dir, version=3)
+        load = _OpenLoopLoad(cluster)
+        load.start()
+        state = cluster.deploy("heal-lm", 3, canary_fraction=0.5,
+                               bake_seconds=5, min_requests=5)
+        state = _wait_for(cluster.deploy_status,
+                          lambda d: d["status"] != "rolling",
+                          "regressed canary verdict", 240)
+        load.stop_and_join()
+        print(f"selfheal: regression drill status={state['status']} "
+              f"verdict={state['canary']['verdict']} "
+              f"offending={state['canary']['offending_stat']!r} "
+              f"detail={state['detail']!r}")
+        if not (state["status"] == "held"
+                and state["canary"]["verdict"] == "regression"
+                and state["canary"]["offending_stat"] == "error_rate"
+                and "error_rate" in state["detail"]):
+            print("selfheal: FAIL in canary-regression phase", file=sys.stderr)
+            return 1
+
+        # -- phase 4: crash-looping checkpoint -> degraded, bounded --
+        cluster.register_model("loop-lm", "uuid-missing",
+                               storage_path=str(root / "no-such-ckpt"))
+        cluster.set_fleet("loop-lm", 1, 1, config=fleet_cfg)
+        fleet = _wait_for(cluster.fleet_status,
+                          lambda f: f["status"] == "degraded",
+                          "crash-loop give-up", 90)
+        launches = fleet["slots"][0]["launches"]
+        time.sleep(4.0)  # a bounded supervisor launches NOTHING after give-up
+        fleet = cluster.fleet_status()
+        print(f"selfheal: crash-loop drill status={fleet['status']} "
+              f"detail={fleet['detail']!r} launches={launches}"
+              f"->{fleet['slots'][0]['launches']} "
+              f"gave_up={fleet['slots'][0]['gave_up']}")
+        if not (fleet["status"] == "degraded"
+                and "rapid failures" in fleet["detail"]
+                and fleet["slots"][0]["gave_up"]
+                and fleet["slots"][0]["launches"] == launches <= 4):
+            print("selfheal: FAIL in crash-loop phase", file=sys.stderr)
+            return 1
+
+        fsck = subprocess.run(
+            [MASTER_BIN, "--journal-fsck", cluster.state_dir],
+            capture_output=True)
+        print(f"selfheal: journal fsck rc={fsck.returncode} "
+              f"({fsck.stdout.decode().strip()})")
+        if fsck.returncode != 0:
+            return 1
+        print("selfheal: OK")
+        return 0
+    finally:
+        if load is not None:
+            load._stop.set()
+        subprocess.run(
+            ["pkill", "-9", "-f", "determined_tpu.exec.serve_replica"],
+            capture_output=True,
+        )
+        cluster.stop()
+
+
 def _kill_master_smoke(cluster: "DevCluster") -> int:
     """SIGKILL + restart the master under a live 2-process gang (the
     durability acceptance): the WAL replays, the agents re-report their
@@ -665,6 +1053,11 @@ def main(argv=None) -> int:
     ap.add_argument("--deploy", action="store_true",
                     help="run the registry + rolling-deploy smoke "
                          "(register -> serve --model -> roll to v2)")
+    ap.add_argument("--selfheal", action="store_true",
+                    help="run the self-healing fleet chaos smoke (replica "
+                         "SIGKILL -> supervisor relaunch; master SIGKILL "
+                         "mid-canary -> WAL resume; injected regression -> "
+                         "auto-hold; crash-loop -> degraded)")
     ap.add_argument("--fsck-selftest", action="store_true",
                     help="verify `dtpu-master --journal-fsck` on fabricated journals")
     ap.add_argument("--agents", type=int, default=2)
@@ -688,6 +1081,10 @@ def main(argv=None) -> int:
         import tempfile
 
         root = pathlib.Path(tempfile.mkdtemp(prefix="dtpu-devcluster-"))
+    if args.selfheal:
+        # builds its own cluster: custom master flags + an agent with a
+        # known --state-dir (the pidfile is the replica-SIGKILL handle)
+        return _selfheal_smoke(root)
     if args.deploy:
         # registry smoke needs no agents — the replica is our subprocess
         cluster = DevCluster(root, agents=0, slots=args.slots,

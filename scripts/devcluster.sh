@@ -14,6 +14,13 @@
 #                                        # register -> serve --model ->
 #                                        # roll the fleet to v2 (exit-75
 #                                        # drain + relaunch; docs/registry.md)
+#   scripts/devcluster.sh --selfheal     # ASan build + self-healing fleet
+#                                        # chaos: replica SIGKILL -> super-
+#                                        # visor relaunch; master SIGKILL
+#                                        # mid-canary -> WAL resume, zero
+#                                        # dropped requests; injected error
+#                                        # rate -> auto-hold; crash-loop ->
+#                                        # degraded (docs/operations.md)
 #
 # The pytest devcluster marker (tests/conftest.py) skips cleanly when the
 # binaries are absent; after this script they run:
@@ -33,6 +40,13 @@ elif [[ "${1:-}" == "--kill-master" ]]; then
   scripts/native_check.sh --sanitize
   export DTPU_NATIVE_BUILD_DIR="$REPO/native/build-asan"
   exec python scripts/devcluster.py --kill-master
+elif [[ "${1:-}" == "--selfheal" ]]; then
+  # chaos smoke runs under the ASan/UBSan build too: the supervisor's
+  # relaunch/backoff bookkeeping and the deploy resume path are exactly
+  # the kind of restart-order code memory bugs hide in
+  scripts/native_check.sh --sanitize
+  export DTPU_NATIVE_BUILD_DIR="$REPO/native/build-asan"
+  exec python scripts/devcluster.py --selfheal
 fi
 
 exec python scripts/devcluster.py --build ${MODE}

@@ -121,6 +121,11 @@ def test_every_route_conforms(cluster, tmp_path):
     bodies[("POST", "/api/v1/serving/deploy")] = {
         "model": "contract-model", "version": "latest",
     }
+    # target 0: asserts the route + shape without the supervisor actually
+    # launching replica tasks into the contract cluster
+    bodies[("PUT", "/api/v1/serving/fleet")] = {
+        "model": "contract-model", "version": "latest", "target": 0,
+    }
 
     anon = requests.Session()
     missing, misshapen = [], []

@@ -23,6 +23,7 @@ from scripts.devcluster import (
     MASTER_BIN,
     sample_master_events,
     sample_registry_events,
+    sample_serving_events,
     wal_frame,
     write_master_journal,
 )
@@ -176,6 +177,70 @@ def test_registry_torn_tail_truncated_at_every_byte_offset(tmp_path):
 
 def test_registry_journal_fscks_clean(tmp_path):
     events = sample_master_events() + sample_registry_events()
+    write_master_journal(str(tmp_path), events)
+    rc, out = _fsck(tmp_path)
+    assert rc == 0, out
+    assert f"last_good_lsn={len(events)}" in out and "tail_truncated=no" in out
+
+
+# ---- fleet spec + canary deploy records (ISSUE 16): same WAL contract -------
+
+
+def test_serving_torn_tail_truncated_at_every_byte_offset(tmp_path):
+    """Every-byte truncation fuzz across ALL FOUR serving records
+    (fleet_spec, deploy_started, deploy_advanced, deploy_completed): a cut
+    anywhere inside the serving suffix boots to exactly the state of the
+    longest whole-record prefix — the ARIES contract for the deploy state
+    machine, so a master SIGKILLed mid-journal-write resumes the roll from
+    the last durable transition instead of inventing one."""
+    events = (sample_master_events() + sample_registry_events()
+              + sample_serving_events())
+    frames = [
+        wal_frame(json.dumps({**ev, "seq": i + 1, "ts": 0}))
+        for i, ev in enumerate(events)
+    ]
+    blob = b"".join(frames)
+    n_serving = len(sample_serving_events())
+    serving_start = sum(len(f) for f in frames[:-n_serving])
+
+    # per-boundary expected digests; adjacent ones must DIFFER (every
+    # serving record is observable in the digest) or the fuzz is vacuous
+    boundaries = [serving_start]
+    for f in frames[-n_serving:]:
+        boundaries.append(boundaries[-1] + len(f))
+    expected = []
+    for i, b in enumerate(boundaries):
+        d = tmp_path / f"boundary-{i}"
+        _write_blob(d, blob[:b])
+        expected.append(_dump(d))
+    for a, b in zip(expected, expected[1:]):
+        assert a != b, "a serving record did not change the dump digest"
+
+    # spot-check semantic content at the boundaries
+    assert "fleet" not in expected[0] and "deploy" not in expected[0]
+    assert expected[1]["fleet"]["version"] == 1  # spec lands
+    dep = expected[2]["deploy"]  # deploy_started lands
+    assert dep["phase"] == "canary" and dep["status"] == "rolling"
+    assert dep["pending"] == ["replica-a", "replica-b"]
+    dep = expected[3]["deploy"]  # deploy_advanced lands
+    assert dep["phase"] == "baking" and dep["rolled"] == ["replica-a"]
+    final = expected[4]  # deploy_completed lands
+    assert final["deploy"]["status"] == "completed"
+    assert final["fleet"]["version"] == 2  # completion syncs the fleet spec
+
+    work = tmp_path / "fuzz"
+    for cut in range(serving_start, len(blob)):
+        shutil.rmtree(work, ignore_errors=True)
+        _write_blob(work, blob[:cut])
+        got = _dump(work)
+        # the longest whole-frame prefix at or below the cut
+        want = expected[max(i for i, b in enumerate(boundaries) if b <= cut)]
+        assert got == want, f"state diverged at truncation offset {cut}"
+
+
+def test_serving_journal_fscks_clean(tmp_path):
+    events = (sample_master_events() + sample_registry_events()
+              + sample_serving_events())
     write_master_journal(str(tmp_path), events)
     rc, out = _fsck(tmp_path)
     assert rc == 0, out

@@ -663,6 +663,10 @@ class _FakeServeMaster:
         # rid -> deploy payload: heartbeat answers {"drain": true, ...}
         # (the rolling-deploy signal channel)
         self.drain = {}
+        # when set (a Retry-After value), heartbeats answer 429 with that
+        # header — the admission-control shedding the backoff test drives
+        self.throttle = None
+        self.throttle_hits = 0
         self.lock = threading.Lock()
         self.port = 0
         self.server = None
@@ -706,6 +710,16 @@ class _FakeServeMaster:
                         rid = path.split("/")[5]
                         if rid not in fake.known:
                             return self._json({"error": "no such replica"}, 404)
+                        if fake.throttle is not None:
+                            fake.throttle_hits += 1
+                            shed = _json.dumps({"error": "shedding"}).encode()
+                            self.send_response(429)
+                            self.send_header("Content-Type", "application/json")
+                            self.send_header("Retry-After", str(fake.throttle))
+                            self.send_header("Content-Length", str(len(shed)))
+                            self.end_headers()
+                            self.wfile.write(shed)
+                            return
                         fake.heartbeats += 1
                         dep = fake.drain.get(rid)
                         if dep is not None:
@@ -880,9 +894,416 @@ def test_master_drain_request_reaches_worker(kernels):
         fake.close()
 
 
+def test_heartbeat_backs_off_on_429_honoring_retry_after():
+    """Admission-control shedding (ISSUE 16 satellite): a master answering
+    heartbeats 429 + Retry-After must slow the replica's cadence to the
+    advertised delay — not hammer on the fixed interval — and recover the
+    normal cadence (throttle counter reset) once the master stops
+    shedding.  Drives ReplicaRegistration directly: no engine needed."""
+    from determined_tpu.serve.replica import ReplicaRegistration
+    from determined_tpu.api.session import Session
+
+    fake = _FakeServeMaster()
+    reg = ReplicaRegistration(
+        Session(fake.url, token="t"),
+        url="http://127.0.0.1:1/x",
+        model="lm",
+        heartbeat_interval_s=0.05,
+    ).start()
+    try:
+        deadline = time.time() + 10
+        while fake.heartbeats == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert fake.heartbeats > 0, "heartbeat never arrived"
+
+        with fake.lock:
+            fake.throttle = "0.6"
+        time.sleep(2.0)
+        with fake.lock:
+            hits = fake.throttle_hits
+            fake.throttle = None
+        # Retry-After 0.6s over 2s allows ~4 attempts; the un-backed-off
+        # 0.05s cadence would have made ~40.  The margin proves the header
+        # was honored, not merely that SOME delay happened.
+        assert 1 <= hits <= 8, f"429 backoff not honored: {hits} hits in 2s"
+        assert reg.throttled >= 1, "throttle counter never grew"
+
+        hb_before = fake.heartbeats
+        deadline = time.time() + 10
+        while fake.heartbeats < hb_before + 3 and time.time() < deadline:
+            time.sleep(0.02)
+        assert fake.heartbeats >= hb_before + 3, "cadence did not recover"
+        assert reg.throttled == 0, "throttle counter not reset on success"
+    finally:
+        reg.close(deregister=False)
+        fake.close()
+
+
+def test_throttle_delay_is_capped_and_prefers_retry_after():
+    """The computed 429 backoff must honor an explicit Retry-After, fall
+    back to capped exponential growth for the HTTP-date form it cannot
+    parse, and never exceed MAX_THROTTLE_S (staying under the master's
+    reap horizon)."""
+    from determined_tpu.serve.replica import MAX_THROTTLE_S, ReplicaRegistration
+
+    reg = ReplicaRegistration.__new__(ReplicaRegistration)
+    reg._interval = 2.0
+    reg._lock = threading.Lock()
+    reg.throttled = 1
+    assert reg._throttle_delay("7") == 7.0
+    assert reg._throttle_delay("0") == 0.0
+    # unparseable HTTP-date form falls back to the computed backoff
+    d = reg._throttle_delay("Wed, 21 Oct 2026 07:28:00 GMT")
+    assert 0 < d <= MAX_THROTTLE_S
+    reg.throttled = 50  # deep throttle: 2*2^50 without the cap
+    for _ in range(10):
+        assert reg._throttle_delay() <= MAX_THROTTLE_S
+
+
 # ---------------------------------------------------------------------------
 # devcluster e2e: registration, serving under load, heartbeat-loss pruning
 # ---------------------------------------------------------------------------
+
+
+@pytest.mark.devcluster
+def test_failed_engine_heartbeat_reaps_immediately(tmp_path):
+    """ISSUE 16 satellite: a replica whose heartbeat stats carry a truthy
+    ``failed`` is reaped NOW — the crashed-engine-behind-a-live-HTTP-thread
+    case must not wait out the TTL.  Registers against the REAL master
+    with a 60s TTL so the immediate disappearance proves the failed-stat
+    path, not the reaper; also proves healthy heartbeats (failed=None,
+    the engine's normal stats shape) are NOT false-positive reaped."""
+    requests = pytest.importorskip("requests")
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from devcluster import DevCluster
+
+    cluster = DevCluster(
+        tmp_path, agents=0, master_args=["--serve-replica-timeout-sec", "60"]
+    )
+    cluster.start_master()
+    try:
+        r = cluster.http.post(
+            cluster.url + "/api/v1/serving/replicas",
+            json={"url": "http://127.0.0.1:1/x", "model": "lm@v1"},
+            timeout=5,
+        )
+        assert r.status_code == 201, r.text
+        rid = r.json()["id"]
+        hb = cluster.url + f"/api/v1/serving/replicas/{rid}/heartbeat"
+
+        # healthy stats — including the engine's literal "failed": None —
+        # keep the replica listed
+        r = cluster.http.post(
+            hb, json={"stats": {"requests": 3, "failed": None}}, timeout=5
+        )
+        assert r.status_code == 200 and "reaped" not in r.json(), r.text
+        assert [x["id"] for x in cluster.serving()] == [rid]
+
+        # a truthy failed stat reaps on the spot
+        r = cluster.http.post(
+            hb,
+            json={"stats": {"requests": 3,
+                            "failed": "RuntimeError: kernel crashed"}},
+            timeout=5,
+        )
+        assert r.status_code == 200 and r.json().get("reaped") is True, r.text
+        assert cluster.serving() == [], "failed replica still listed"
+
+        # the dead replica's next heartbeat 404s -> the worker re-registers
+        r = cluster.http.post(hb, json={}, timeout=5)
+        assert r.status_code == 404
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.devcluster
+def test_fleet_supervisor_adopts_replaces_and_backs_off(tmp_path):
+    """The master-side replica supervisor (ISSUE 16 tentpole), driven at
+    the API level with no agents: a PUT over a hand-launched fleet ADOPTS
+    the live replicas instead of doubling them; a failed replica's slot is
+    refilled by launching a serve task through the generic-task path; and
+    a launch that dies crashing is accounted as a slot failure with
+    backoff, not retried hot."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from devcluster import DevCluster
+
+    cluster = DevCluster(
+        tmp_path, agents=0,
+        master_args=["--serve-replica-timeout-sec", "60",
+                     "--fleet-backoff-initial-ms", "100"],
+    )
+    cluster.start_master()
+    try:
+        cluster.register_model("lm", "uuid-fleet", storage_path="/ck/fleet")
+        rids = []
+        for i in range(2):
+            r = cluster.http.post(
+                cluster.url + "/api/v1/serving/replicas",
+                json={"url": f"http://127.0.0.1:1/{i}", "model": "lm@v1",
+                      "model_name": "lm", "model_version": 1},
+                timeout=5,
+            )
+            assert r.status_code == 201, r.text
+            rids.append(r.json()["id"])
+
+        # adoption: the spec binds the live replicas, launches nothing
+        r = cluster.http.put(
+            cluster.url + "/api/v1/serving/fleet",
+            json={"model": "lm", "version": 1, "target": 2},
+            timeout=5,
+        )
+        assert r.status_code == 200, r.text
+        fleet = r.json()
+        assert fleet["status"] == "ok", fleet
+        assert sorted(s["replica_id"] for s in fleet["slots"]) == sorted(rids)
+        assert all(s["launches"] == 0 for s in fleet["slots"]), fleet
+
+        # a failed replica's reap triggers a replacement launch
+        r = cluster.http.post(
+            cluster.url + f"/api/v1/serving/replicas/{rids[0]}/heartbeat",
+            json={"stats": {"failed": "boom"}}, timeout=5,
+        )
+        assert r.json().get("reaped") is True, r.text
+        fleet = cluster.http.get(
+            cluster.url + "/api/v1/serving/fleet", timeout=5).json()
+        assert fleet["status"] == "reconciling", fleet
+        vacant = [s for s in fleet["slots"] if not s["replica_id"]]
+        assert len(vacant) == 1 and vacant[0]["task_id"], fleet
+        assert vacant[0]["launches"] == 1
+        task = cluster.http.get(
+            cluster.url + f"/api/v1/tasks/{vacant[0]['task_id']}", timeout=5
+        ).json()
+        assert task["type"] == "serve"
+
+        # the launch dying with a crash exit is a failure + backoff ...
+        r = cluster.http.post(
+            cluster.url + f"/api/v1/tasks/{vacant[0]['task_id']}/exit",
+            json={"exit_code": 1, "detail": "bad checkpoint"}, timeout=5,
+        )
+        assert r.status_code == 200, r.text
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            fleet = cluster.http.get(
+                cluster.url + "/api/v1/serving/fleet", timeout=5).json()
+            slot = fleet["slots"][vacant[0]["index"]]
+            if slot["failures"] >= 1:
+                break
+            time.sleep(0.2)
+        assert slot["failures"] == 1, fleet
+        assert "exited 1" in slot["last_error"], fleet
+
+        # ... and the supervisor retries after the backoff (2s tick)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            fleet = cluster.http.get(
+                cluster.url + "/api/v1/serving/fleet", timeout=5).json()
+            slot = fleet["slots"][vacant[0]["index"]]
+            if slot["launches"] >= 2:
+                break
+            time.sleep(0.2)
+        assert slot["launches"] >= 2, fleet
+    finally:
+        cluster.stop()
+
+
+def _fake_replica(cluster, version, stats=None):
+    """Register a fake replica on lm@v{version}; optionally ship stats."""
+    r = cluster.http.post(
+        cluster.url + "/api/v1/serving/replicas",
+        json={"url": f"http://127.0.0.1:1/v{version}", "model": f"lm@v{version}",
+              "model_name": "lm", "model_version": version},
+        timeout=5,
+    )
+    assert r.status_code == 201, r.text
+    rid = r.json()["id"]
+    if stats is not None:
+        r = cluster.http.post(
+            cluster.url + f"/api/v1/serving/replicas/{rid}/heartbeat",
+            json={"stats": stats}, timeout=5,
+        )
+        assert r.status_code == 200, r.text
+    return rid
+
+
+def _canary_cluster(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from devcluster import DevCluster
+
+    cluster = DevCluster(
+        tmp_path, agents=0,
+        master_args=["--serve-replica-timeout-sec", "60",
+                     "--deploy-step-timeout-sec", "60"],
+    )
+    cluster.start_master()
+    cluster.register_model("lm", "uuid-v1", storage_path="/ck/v1")
+    cluster.register_model("lm", "uuid-v2", storage_path="/ck/v2", version=2)
+    return cluster
+
+
+_HEALTHY = {"completed": 100, "errored": 1, "http_5xx": 0,
+            "latency_ms_avg": 10.0}
+# error_rate 10/100 = 0.10 > baseline (2/202 ~ 0.01) + threshold 0.05
+_REGRESSED = {"completed": 90, "errored": 8, "http_5xx": 2,
+              "latency_ms_avg": 11.0}
+
+
+def _walk_one_drain(cluster, replace_version, stats):
+    """Play the supervisor for one deploy step: wait for the master to
+    name a draining replica, take it away, and register the replacement
+    the walk demands (carrying ``stats`` on its first heartbeat)."""
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        state = cluster.deploy_status()
+        if state.get("draining"):
+            break
+        time.sleep(0.2)
+    assert state.get("draining"), state
+    r = cluster.http.delete(
+        cluster.url + f"/api/v1/serving/replicas/{state['draining']}",
+        timeout=5,
+    )
+    assert r.status_code == 200, r.text
+    return _fake_replica(cluster, replace_version, stats=stats)
+
+
+@pytest.mark.devcluster
+def test_canary_regression_holds_naming_the_stat(tmp_path):
+    """The canary gate (ISSUE 16 tentpole): a canary deploy rolls only
+    the cohort, bakes it against the journaled pre-roll baseline, and an
+    error-rate regression HOLDS the roll with the offending stat named —
+    the untouched half of the fleet never drains."""
+    cluster = _canary_cluster(tmp_path)
+    try:
+        _fake_replica(cluster, 1, stats=_HEALTHY)
+        keep = _fake_replica(cluster, 1, stats=_HEALTHY)
+
+        r = cluster.http.post(
+            cluster.url + "/api/v1/serving/deploy",
+            json={"model": "lm", "version": 2, "canary_fraction": 0.5,
+                  "bake_seconds": 2, "min_requests": 10},
+            timeout=5,
+        )
+        assert r.status_code == 202, r.text
+        state = r.json()
+        assert state["phase"] == "canary", state
+        assert state["canary"]["count"] == 1
+        assert state["canary"]["baseline"]["requests"] == 202
+        assert state["prev_version"] == 1
+
+        _walk_one_drain(cluster, 2, stats=_REGRESSED)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            state = cluster.deploy_status()
+            if state["status"] != "rolling":
+                break
+            time.sleep(0.2)
+        assert state["status"] == "held", state
+        assert state["canary"]["verdict"] == "regression"
+        assert state["canary"]["offending_stat"] == "error_rate"
+        assert state["canary"]["observed"]["error_rate"] == pytest.approx(0.1)
+        assert "error_rate" in state["detail"]
+        # the non-canary half of the fleet was never walked
+        assert [x["id"] for x in cluster.serving() if x["id"] == keep] == [keep]
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.devcluster
+def test_canary_regression_rolls_back_to_prev_version(tmp_path):
+    """With --rollback-on-regression the regressed canary cohort is
+    drained BACK onto the previous version through the same walk
+    machinery, terminal status ``rolled_back``."""
+    cluster = _canary_cluster(tmp_path)
+    try:
+        _fake_replica(cluster, 1, stats=_HEALTHY)
+        _fake_replica(cluster, 1, stats=_HEALTHY)
+
+        r = cluster.http.post(
+            cluster.url + "/api/v1/serving/deploy",
+            json={"model": "lm", "version": 2, "canary_fraction": 0.5,
+                  "bake_seconds": 2, "min_requests": 10,
+                  "rollback_on_regression": True},
+            timeout=5,
+        )
+        assert r.status_code == 202, r.text
+
+        _walk_one_drain(cluster, 2, stats=_REGRESSED)
+        # the regression flips the walk into rolling_back: the master now
+        # drains the bad v2 canary and demands a v1 replacement
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            state = cluster.deploy_status()
+            if state.get("phase") == "rolling_back" or state["status"] != "rolling":
+                break
+            time.sleep(0.2)
+        assert state.get("phase") == "rolling_back", state
+        assert state["version"] == 1 and state["target"] == "lm@v1", state
+
+        _walk_one_drain(cluster, 1, stats=_HEALTHY)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            state = cluster.deploy_status()
+            if state["status"] != "rolling":
+                break
+            time.sleep(0.2)
+        assert state["status"] == "rolled_back", state
+        assert state["canary"]["offending_stat"] == "error_rate"
+        labels = sorted(x["model"] for x in cluster.serving())
+        assert labels == ["lm@v1", "lm@v1"], labels
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.devcluster
+def test_canary_deploy_survives_master_sigkill_and_resumes(tmp_path):
+    """WAL-durable deploys (ISSUE 16 tentpole): SIGKILL the master
+    mid-canary-bake; the restarted master replays deploy_started/advanced,
+    waits for re-registrations, restarts the bake window, and the roll
+    completes — no operator re-POST."""
+    cluster = _canary_cluster(tmp_path)
+    try:
+        _fake_replica(cluster, 1, stats=_HEALTHY)
+        _fake_replica(cluster, 1, stats=_HEALTHY)
+        r = cluster.http.post(
+            cluster.url + "/api/v1/serving/deploy",
+            json={"model": "lm", "version": 2, "canary_fraction": 0.5,
+                  "bake_seconds": 2, "min_requests": 5},
+            timeout=5,
+        )
+        assert r.status_code == 202, r.text
+        canary_rid = _walk_one_drain(cluster, 2, stats=_HEALTHY)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            state = cluster.deploy_status()
+            if state.get("phase") == "baking":
+                break
+            time.sleep(0.2)
+        assert state.get("phase") == "baking", state
+
+        cluster.kill_master()
+        cluster.restart_master()
+        # replicas are ephemeral: play each worker's 404 -> re-register.
+        # The canary re-registers on v2 (it IS running v2), the survivor
+        # on v1; the rescan rebuilds the walk from these live rows.
+        _fake_replica(cluster, 2, stats=_HEALTHY)
+        _fake_replica(cluster, 1, stats=_HEALTHY)
+
+        state = cluster.deploy_status()
+        assert state["status"] == "rolling", state  # resumed, not lost
+        # the resumed roll finishes: bake passes (healthy canary stats),
+        # then the remaining v1 replica drains
+        _walk_one_drain(cluster, 2, stats=_HEALTHY)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            state = cluster.deploy_status()
+            if state["status"] != "rolling":
+                break
+            time.sleep(0.2)
+        assert state["status"] == "completed", state
+        assert state["canary"]["verdict"] == "pass", state
+        del canary_rid
+    finally:
+        cluster.stop()
 
 
 @pytest.mark.devcluster
