@@ -1087,6 +1087,8 @@ def serve_cmd(args) -> int:
             max_prompt_len=args.max_prompt_len,
             max_new_tokens=args.max_new_tokens,
             queue_depth=args.queue_depth,
+            prefix_cache=args.prefix_cache,
+            decode_chunk_blocks=args.decode_chunk_blocks,
             host=args.host,
             port=args.port,
         )
@@ -1784,6 +1786,19 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--max-new-tokens", type=int, default=64)
     sv.add_argument("--queue-depth", type=int, default=16,
                     help="admission queue depth (full -> 429)")
+    sv.add_argument("--prefix-cache", dest="prefix_cache",
+                    action="store_true", default=True,
+                    help="share KV blocks across requests with a common "
+                         "prompt prefix (default on; docs/serving.md)")
+    sv.add_argument("--no-prefix-cache", dest="prefix_cache",
+                    action="store_false",
+                    help="disable prefix sharing: every request prefills "
+                         "private blocks")
+    sv.add_argument("--decode-chunk-blocks", type=int, default=1,
+                    help="lazy decode: gather the block table this many "
+                         "columns per attention pass, skipping columns "
+                         "past the longest live sequence (0 = legacy "
+                         "full-table gather; must divide the table width)")
     sv.add_argument("--model-name", default=None,
                     help="label shown in the master's replica listing")
     sv.set_defaults(fn=serve_cmd)

@@ -635,6 +635,69 @@ def transformer_prefill(
     return logits, {"k": k_cache, "v": v_cache}
 
 
+def _paged_attention_chunked(
+    q: jax.Array,
+    k_cache_i: jax.Array,
+    v_cache_i: jax.Array,
+    block_tables: jax.Array,
+    pos: jax.Array,
+    active: jax.Array,
+    n_rep: int,
+    scale: float,
+    chunk_blocks: int,
+    n_chunks: jax.Array,
+) -> jax.Array:
+    """Lazy paged attention for one decode step of one layer.
+
+    Instead of gathering the whole block table (``[b, T*block_size, ...]``
+    per layer even when a lane holds 3 tokens), slide a static-width window
+    of ``chunk_blocks`` table columns and fold each chunk into an online
+    softmax (flash-decoding style: running max / denominator / weighted
+    accumulator, all f32).  ``n_chunks`` — ``ceil((max_pos+1)/chunk)`` — is
+    a traced scalar, so the loop lowers to a single ``while`` and the step
+    keeps exactly one trace no matter how long the active lanes are.
+    Masked positions use the same finite ``NEG_INF`` the full path uses;
+    their ``exp`` underflows to zero, so chunked and full attention agree
+    to f32 reassociation error.  Returns ``[b, n_heads, 1, head_dim]``.
+    """
+    b, t = block_tables.shape
+    block_size = k_cache_i.shape[1]
+    chunk_tokens = chunk_blocks * block_size
+    n_heads, head_dim = q.shape[1], q.shape[3]
+    kv_heads = k_cache_i.shape[2]
+
+    def body(c, carry):
+        m, l, acc = carry
+        tbl = jax.lax.dynamic_slice(block_tables, (0, c * chunk_blocks), (b, chunk_blocks))
+        keys = k_cache_i[tbl].reshape(b, chunk_tokens, kv_heads, head_dim)
+        vals = v_cache_i[tbl].reshape(b, chunk_tokens, kv_heads, head_dim)
+        keys = _repeat_kv(keys.transpose(0, 2, 1, 3), n_rep)
+        vals = _repeat_kv(vals.transpose(0, 2, 1, 3), n_rep)
+        s = (
+            jnp.einsum("bhqd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32)
+            * scale
+        )  # [b, h, 1, chunk_tokens]
+        k_idx = c * chunk_tokens + jnp.arange(chunk_tokens)
+        msk = (k_idx[None, :] <= pos[:, None]) & active[:, None]  # [b, chunk_tokens]
+        s = jnp.where(msk[:, None, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vals.astype(jnp.float32)
+        )
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((b, n_heads, 1, 1), NEG_INF, jnp.float32),
+        jnp.zeros((b, n_heads, 1, 1), jnp.float32),
+        jnp.zeros((b, n_heads, 1, head_dim), jnp.float32),
+    )
+    _, l, acc = jax.lax.fori_loop(0, n_chunks, body, init)
+    return acc / jnp.maximum(l, 1e-30)
+
+
 def transformer_decode(
     cfg: TransformerConfig,
     params: Dict[str, Any],
@@ -642,6 +705,8 @@ def transformer_decode(
     positions: jax.Array,
     block_tables: jax.Array,
     cache: Dict[str, jax.Array],
+    *,
+    chunk_blocks: int = 0,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One decode step over the paged cache for every lane at once.
 
@@ -652,6 +717,14 @@ def transformer_decode(
     static, so a mixed stream of request lengths never retraces — the
     continuous batcher joins and retires sequences by editing lane state,
     not by reshaping the batch.
+
+    ``chunk_blocks`` > 0 selects the lazy paged path: gather the table in
+    static windows of that many columns and only run
+    ``ceil((max_pos+1)/(chunk_blocks*block_size))`` attention passes
+    (:func:`_paged_attention_chunked`), instead of materializing the full
+    ``[b, T*block_size, kv_heads, head_dim]`` gather every step.  0 keeps
+    the original full-table gather.  Both paths share every projection and
+    the cache-write scatter, and agree to f32 tolerance.
     """
     _check_decodable(cfg)
     block_size = cache["k"].shape[2]
@@ -674,6 +747,16 @@ def transformer_decode(
     k_cache, v_cache = cache["k"], cache["v"]
     n_rep = cfg.n_heads // cfg.kv_heads
     scale = cfg.head_dim ** -0.5
+    n_chunks = None
+    if chunk_blocks:
+        if t % chunk_blocks:
+            raise ValueError(
+                f"chunk_blocks={chunk_blocks} must divide the table width {t}"
+            )
+        chunk_tokens = chunk_blocks * block_size
+        n_chunks = jnp.minimum(
+            jnp.max(jnp.where(active, pos, 0)) // chunk_tokens + 1, t // chunk_blocks
+        )
     for i in range(cfg.n_layers):
         blk = params[f"block_{i}"]
         h = _rms_apply(x, blk["ln1"]["scale"])
@@ -684,17 +767,25 @@ def transformer_decode(
         # the step sees its own key (standard causal self-attention)
         k_cache = k_cache.at[i, phys, slot].set(k[:, :, 0, :])
         v_cache = v_cache.at[i, phys, slot].set(v[:, :, 0, :])
-        keys = k_cache[i][block_tables].reshape(b, kv_len, cfg.kv_heads, -1)
-        vals = v_cache[i][block_tables].reshape(b, kv_len, cfg.kv_heads, -1)
-        keys = _repeat_kv(keys.transpose(0, 2, 1, 3), n_rep)
-        vals = _repeat_kv(vals.transpose(0, 2, 1, 3), n_rep)
-        logits = (
-            jnp.einsum("bhqd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32)
-            * scale
-        )
-        logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
-        probs = jax.nn.softmax(logits, axis=-1)
-        att = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vals.dtype), vals)
+        if chunk_blocks:
+            att = _paged_attention_chunked(
+                q, k_cache[i], v_cache[i], block_tables, pos, active,
+                n_rep, scale, chunk_blocks, n_chunks,
+            ).astype(dt)
+        else:
+            keys = k_cache[i][block_tables].reshape(b, kv_len, cfg.kv_heads, -1)
+            vals = v_cache[i][block_tables].reshape(b, kv_len, cfg.kv_heads, -1)
+            keys = _repeat_kv(keys.transpose(0, 2, 1, 3), n_rep)
+            vals = _repeat_kv(vals.transpose(0, 2, 1, 3), n_rep)
+            logits = (
+                jnp.einsum(
+                    "bhqd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32
+                )
+                * scale
+            )
+            logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+            probs = jax.nn.softmax(logits, axis=-1)
+            att = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vals.dtype), vals)
         att = att.transpose(0, 2, 1, 3)  # [b, 1, h, hd]
         x = x + jnp.einsum(
             "bshk,hkD->bsD", att, blk["attn"]["wo"]["kernel"].astype(dt)
@@ -703,6 +794,115 @@ def transformer_decode(
     x = _rms_apply(x, params["ln_f"]["scale"])
     logits = (x[:, 0, :] @ params["lm_head"]["kernel"].astype(dt)).astype(jnp.float32)
     return logits, {"k": k_cache, "v": v_cache}
+
+
+def transformer_prefill_suffix(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    start_lens: jax.Array,
+    prompt_lens: jax.Array,
+    block_tables: jax.Array,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Prefill only the un-cached suffix of each prompt (prefix caching).
+
+    ``tokens`` [B, S] is the FULL prompt padded to a multiple of the block
+    size; ``start_lens`` [B] how many leading tokens already sit in cache
+    blocks mapped into ``block_tables`` (block-aligned by construction —
+    only full blocks are shared); ``prompt_lens`` [B] the real lengths.
+    Returns (last_logits [B, vocab] f32 — the logits at ``prompt_len - 1``
+    each lane samples its first token from — and the updated cache).
+
+    The walk is one block of tokens per iteration of a dynamic-trip-count
+    ``fori_loop`` (``start//block_size .. ceil(len/block_size)``), so the
+    compute and the single compiled trace scale with the SUFFIX, not the
+    padded prompt width: a 70%-shared system prompt pays for its unique
+    tail only.  Queries attend against keys READ FROM THE CACHE (prefix
+    blocks written by whoever prefilled them first, suffix blocks written
+    by this call just before attending), masked ``k_pos <= q_pos``, which
+    makes a warm start and a cold ``start=0`` run of the same prompt
+    bitwise identical — the parity the prefix-cache admission tests pin.
+    Positions outside ``[start, len)`` write to scratch block 0 and their
+    logits are never selected; since keys come from the cache rather than
+    the local projection, garbage padding columns cannot leak into valid
+    ones.
+    """
+    _check_decodable(cfg)
+    block_size = cache["k"].shape[2]
+    b, s = tokens.shape
+    if s % block_size:
+        raise ValueError(
+            f"suffix prefill needs tokens padded to the block size "
+            f"(got S={s}, block_size={block_size})"
+        )
+    t = block_tables.shape[1]
+    kv_len = t * block_size
+    dt = cfg.dtype
+    n_rep = cfg.n_heads // cfg.kv_heads
+    scale = cfg.head_dim ** -0.5
+    c_lo = jnp.min(start_lens) // block_size
+    c_hi = (jnp.max(prompt_lens) + block_size - 1) // block_size
+    k_pos = jnp.arange(kv_len)
+
+    def body(c, carry):
+        k_cache, v_cache, last_logits = carry
+        toks = jax.lax.dynamic_slice(tokens, (0, c * block_size), (b, block_size))
+        p = c * block_size + jnp.arange(block_size)  # absolute positions [bs]
+        valid = (p[None, :] >= start_lens[:, None]) & (
+            p[None, :] < prompt_lens[:, None]
+        )  # [b, bs]
+        tbl_col = jax.lax.dynamic_slice(block_tables, (0, c), (b, 1))  # [b, 1]
+        phys = jnp.where(valid, tbl_col, 0)
+        slots = jnp.broadcast_to(jnp.arange(block_size)[None, :], (b, block_size))
+        att_mask = k_pos[None, :] <= p[:, None]  # [bs, kv_len]
+        x = jnp.take(params["embed"]["embedding"].astype(dt), toks, axis=0)
+        for i in range(cfg.n_layers):
+            blk = params[f"block_{i}"]
+            h = _rms_apply(x, blk["ln1"]["scale"])
+            q, k, v = _attn_proj(blk["attn"], h, dt)  # [b, heads|kv, bs, hd]
+            q = _rope(q, p, cfg.rope_theta)
+            k = _rope(k, p, cfg.rope_theta)
+            # write this block's k/v first, then attend through the cache:
+            # the block's own causal keys and the cached prefix are read
+            # from the same pool, so warm and cold prefills see identical
+            # stored bits
+            k_cache = k_cache.at[i, phys, slots].set(k.transpose(0, 2, 1, 3))
+            v_cache = v_cache.at[i, phys, slots].set(v.transpose(0, 2, 1, 3))
+            keys = k_cache[i][block_tables].reshape(b, kv_len, cfg.kv_heads, -1)
+            vals = v_cache[i][block_tables].reshape(b, kv_len, cfg.kv_heads, -1)
+            keys = _repeat_kv(keys.transpose(0, 2, 1, 3), n_rep)
+            vals = _repeat_kv(vals.transpose(0, 2, 1, 3), n_rep)
+            logits = (
+                jnp.einsum(
+                    "bhqd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32
+                )
+                * scale
+            )
+            logits = jnp.where(att_mask[None, None, :, :], logits, NEG_INF)
+            probs = jax.nn.softmax(logits, axis=-1)
+            att = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vals.dtype), vals)
+            att = att.transpose(0, 2, 1, 3)  # [b, bs, h, hd]
+            x = x + jnp.einsum(
+                "bshk,hkD->bsD", att, blk["attn"]["wo"]["kernel"].astype(dt)
+            )
+            x = x + _mlp_apply(blk["mlp"], _rms_apply(x, blk["ln2"]["scale"]), dt)
+        x = _rms_apply(x, params["ln_f"]["scale"])
+        logits = (x @ params["lm_head"]["kernel"].astype(dt)).astype(jnp.float32)
+        sel = prompt_lens - 1 - c * block_size  # [b]
+        contains = (sel >= 0) & (sel < block_size)
+        idx = jnp.clip(sel, 0, block_size - 1)
+        row = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0, :]
+        last_logits = jnp.where(contains[:, None], row, last_logits)
+        return k_cache, v_cache, last_logits
+
+    init = (
+        cache["k"],
+        cache["v"],
+        jnp.zeros((b, cfg.vocab_size), jnp.float32),
+    )
+    k_cache, v_cache, last_logits = jax.lax.fori_loop(c_lo, c_hi, body, init)
+    return last_logits, {"k": k_cache, "v": v_cache}
 
 
 class LMTrial(JaxTrial):

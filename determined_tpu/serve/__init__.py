@@ -37,7 +37,11 @@ from determined_tpu.serve.engine import (
     sample_token,
 )
 from determined_tpu.serve.http import ServeHTTPServer
-from determined_tpu.serve.kv_cache import BlockAllocator, CacheOOM
+from determined_tpu.serve.kv_cache import (
+    BlockAllocator,
+    CacheOOM,
+    prefix_block_hashes,
+)
 from determined_tpu.serve.replica import ReplicaRegistration
 from determined_tpu.serve.scheduler import (
     AdmissionQueue,
@@ -58,6 +62,7 @@ __all__ = [
     "LaneTable",
     "ReplicaRegistration",
     "ServeConfig",
+    "prefix_block_hashes",
     "ServeEngine",
     "ServeHTTPServer",
     "ServeWorker",

@@ -12,6 +12,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional
 
+from determined_tpu.config.experiment import InvalidExperimentConfig
+
 
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
@@ -31,6 +33,17 @@ class ServeConfig:
     # ---- admission --------------------------------------------------------
     #: bounded request queue depth; a full queue rejects with 429
     queue_depth: int = 16
+    # ---- fast path --------------------------------------------------------
+    #: share full KV blocks across requests with a common prompt prefix
+    #: (content-addressed hash trie in the allocator); admission then only
+    #: prefills the un-cached suffix.  Off restores the PR-9 data path.
+    prefix_cache: bool = True
+    #: lazy paged decode: gather the block table in chunks of this many
+    #: columns per attention pass, running only ceil((pos+1)/chunk) passes
+    #: instead of materializing the whole table every step.  0 = legacy
+    #: full-table gather.  Must divide blocks_per_seq so every chunk is a
+    #: full dynamic slice of the table.
+    decode_chunk_blocks: int = 1
     # ---- http / replica ---------------------------------------------------
     host: str = "127.0.0.1"
     port: int = 8001
@@ -58,6 +71,19 @@ class ServeConfig:
                 f"cache too small: a worst-case request needs {needed} blocks "
                 f"but only {self.usable_blocks} are usable "
                 "(raise num_blocks or lower max_prompt_len/max_new_tokens)"
+            )
+        if self.decode_chunk_blocks < 0:
+            raise InvalidExperimentConfig(
+                f"decode_chunk_blocks must be >= 0, got {self.decode_chunk_blocks}"
+            )
+        if self.decode_chunk_blocks and self.blocks_per_seq % self.decode_chunk_blocks:
+            # the lazy decode slides a fixed-width window over the table;
+            # a chunk that doesn't divide the pool would leave a ragged
+            # final slice the static trace can't express
+            raise InvalidExperimentConfig(
+                f"decode_chunk_blocks={self.decode_chunk_blocks} does not divide "
+                f"the block-table width ({self.blocks_per_seq} blocks per "
+                "sequence); pick a divisor or 0 for the full-table gather"
             )
 
     # -- derived sizes -------------------------------------------------------

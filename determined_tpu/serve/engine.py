@@ -31,7 +31,11 @@ import numpy as np
 from determined_tpu.lint._runtime import get_retrace_sentinel
 from determined_tpu.observability import get_tracer
 from determined_tpu.serve.config import ServeConfig
-from determined_tpu.serve.kv_cache import BlockAllocator, CacheOOM
+from determined_tpu.serve.kv_cache import (
+    BlockAllocator,
+    CacheOOM,
+    prefix_block_hashes,
+)
 from determined_tpu.serve.scheduler import (
     ActiveSeq,
     AdmissionQueue,
@@ -78,6 +82,7 @@ class DecodeKernels:
             init_kv_cache,
             transformer_decode,
             transformer_prefill,
+            transformer_prefill_suffix,
         )
 
         _check_decodable(model_cfg)
@@ -89,18 +94,36 @@ class DecodeKernels:
         self.cache = init_kv_cache(
             model_cfg, serve_cfg.num_blocks, serve_cfg.block_size
         )
+        #: suffix-prefill token width: the prompt padded up to whole blocks
+        #: so the chunked walk slices full blocks only (one trace)
+        self._suffix_pad = (
+            serve_cfg.blocks_for(serve_cfg.max_prompt_len) * serve_cfg.block_size
+        )
         sentinel = get_retrace_sentinel()
         prefill = sentinel.wrap(
             "serve.prefill_step",
             functools.partial(transformer_prefill, model_cfg),
             allowed=1,
         )
+        # the prefix-cache admission path: cold requests run it with
+        # start=0, warm requests from their first un-cached block; either
+        # way it is the SAME trace (dynamic trip count inside the kernel)
+        prefill_suffix = sentinel.wrap(
+            "serve.prefill_suffix_step",
+            functools.partial(transformer_prefill_suffix, model_cfg),
+            allowed=1,
+        )
         decode = sentinel.wrap(
             "serve.decode_step",
-            functools.partial(transformer_decode, model_cfg),
+            functools.partial(
+                transformer_decode,
+                model_cfg,
+                chunk_blocks=serve_cfg.decode_chunk_blocks,
+            ),
             allowed=1,
         )
         self._prefill = jax.jit(prefill, donate_argnums=(4,))
+        self._prefill_suffix = jax.jit(prefill_suffix, donate_argnums=(5,))
         self._decode = jax.jit(decode, donate_argnums=(4,))
 
     # -- kernel entry points (device round trips happen HERE) ---------------
@@ -118,6 +141,22 @@ class DecodeKernels:
         )
         return np.asarray(logits[0, len(prompt) - 1])
 
+    def prefill_suffix(
+        self, prompt: List[int], block_table: List[int], start: int
+    ) -> np.ndarray:
+        """Prefill only ``prompt[start:]`` (the un-cached suffix; ``start``
+        is block-aligned — the cached prefix already sits in the mapped
+        blocks).  Returns the f32 logits at the last prompt token."""
+        tokens = np.zeros((1, self._suffix_pad), np.int32)
+        tokens[0, : len(prompt)] = prompt
+        table = np.asarray(block_table, np.int32)[None, :]
+        starts = np.asarray([start], np.int32)
+        lens = np.asarray([len(prompt)], np.int32)
+        logits, self.cache = self._prefill_suffix(
+            self.params, tokens, starts, lens, table, self.cache
+        )
+        return np.asarray(logits[0])
+
     def decode(
         self, tokens: np.ndarray, positions: np.ndarray, tables: np.ndarray
     ) -> np.ndarray:
@@ -134,7 +173,11 @@ class _EngineBase:
     def __init__(self, kernels: DecodeKernels, thread_name: str) -> None:
         self.kernels = kernels
         self.cfg = kernels.serve_cfg
-        self.allocator = BlockAllocator(self.cfg.num_blocks, self.cfg.block_size)
+        self.allocator = BlockAllocator(
+            self.cfg.num_blocks,
+            self.cfg.block_size,
+            prefix_cache=self.cfg.prefix_cache,
+        )
         self.queue = AdmissionQueue(self.cfg.queue_depth)
         self._tracer = get_tracer()
         self._wake = threading.Event()
@@ -317,15 +360,27 @@ class _EngineBase:
                 if self._completed
                 else 0.0,
             }
+        kv = self.allocator.stats()
         return {
             **counters,
             "queue_depth": self.queue.depth(),
+            # static queue bound: the router's saturation signal — at
+            # queue_depth >= queue_capacity the next submit would 429
+            "queue_capacity": self.cfg.queue_depth,
             "draining": self.queue.draining,
             # truthy once the loop died: the heartbeat ships this and the
             # master reaps the replica immediately instead of waiting out
             # the TTL behind a 500 /healthz
             "failed": self.failed,
-            "kv_cache": self.allocator.stats(),
+            "kv_cache": kv,
+            # live-block fraction, shared (ref>1) blocks counted ONCE so
+            # prefix sharing never inflates the router's load signal
+            "kv_utilization": round(kv["used"] / max(1, kv["capacity"]), 4),
+            "prefix_hits": kv["prefix_hits"],
+            "prefix_tokens_saved": kv["prefix_tokens_saved"],
+            "prefix_hit_rate": round(
+                kv["prefix_hits"] / max(1, kv["prefix_lookups"]), 4
+            ),
             "uptime_s": round(time.monotonic() - self._started_at, 3),
         }
 
@@ -337,18 +392,60 @@ class _EngineBase:
     def _start_sequence(self, req: GenRequest) -> Optional[ActiveSeq]:
         """Allocate + prefill + sample the first token.  Returns the live
         sequence, or None when the request finished at prefill (wanted a
-        single token).  Raises CacheOOM without side effects."""
-        needed = self.allocator.blocks_for(len(req.prompt) + req.max_new_tokens)
-        with self._tracer.span("serve.kv_alloc", cat="serve", blocks=needed):
-            blocks = self.allocator.alloc(needed)
+        single token).  Raises CacheOOM without side effects.
+
+        With the prefix cache on, admission first walks the allocator's
+        hash trie for the longest run of cached full blocks (capped at
+        ``len(prompt) - 1`` tokens, so the block the first decode write
+        lands in is never aliased — the partial tail is copy-on-write by
+        re-prefilling it into a private block), maps the shared physical
+        blocks into this sequence's table with a reference each, and
+        prefills only the un-cached suffix.  Afterwards every full prompt
+        block is registered as cached content for future admissions.
+        """
+        total = self.allocator.blocks_for(len(req.prompt) + req.max_new_tokens)
+        shared: List[int] = []
+        cached_tokens = 0
+        chain: List[Any] = []
+        if self.cfg.prefix_cache:
+            chain = prefix_block_hashes(
+                req.prompt, self.cfg.block_size, limit_tokens=len(req.prompt) - 1
+            )
+            shared = self.allocator.match_prefix(chain)
+            cached_tokens = len(shared) * self.cfg.block_size
+        needed = total - len(shared)
+        try:
+            with self._tracer.span("serve.kv_alloc", cat="serve", blocks=needed):
+                private = self.allocator.alloc(needed)
+        except CacheOOM:
+            if shared:
+                self.allocator.free(shared)
+            raise
+        blocks = shared + private
         self._tracer.gauge("serve.kv_utilization", self.allocator.utilization())
         table = self._padded_table(blocks)
         try:
-            with self._tracer.span("serve.prefill", cat="serve", request=req.id):
-                logits = self.kernels.prefill(req.prompt, table)
+            with self._tracer.span(
+                "serve.prefill", cat="serve", request=req.id,
+                cached_tokens=cached_tokens,
+            ):
+                if cached_tokens:
+                    logits = self.kernels.prefill_suffix(
+                        req.prompt, table, cached_tokens
+                    )
+                else:
+                    # nothing matched: the wide single-pass prefill beats
+                    # the suffix kernel's block-sequential walk (its step
+                    # loop serializes what one pass runs in parallel)
+                    logits = self.kernels.prefill(req.prompt, table)
         except BaseException:
             self.allocator.free(blocks)
             raise
+        if chain:
+            # the suffix just materialized this prompt's remaining full
+            # blocks; make them matchable (shared prefix entries are
+            # already in the trie — first writer wins)
+            self.allocator.register_prefix(chain, blocks[: len(chain)])
         rng = np.random.default_rng(req.seed)
         tok = sample_token(logits, req.temperature, rng)
         req.first_token_at = time.monotonic()

@@ -221,6 +221,11 @@ struct ServeReplicaState {
   int64_t registered_ms = 0;
   int64_t last_heartbeat_ms = 0;
   Json stats = Json::object();  // last heartbeat's stats payload, if any
+  // requests this master is proxying to the replica RIGHT NOW: heartbeat
+  // stats lag an interval, so the router adds its own in-flight count to
+  // the load signal to keep a burst from piling onto one replica.
+  // Runtime-only (not journaled): replicas are ephemeral anyway.
+  int inflight = 0;
 };
 
 // One rolling deployment of a registry model version onto the serving
@@ -447,6 +452,17 @@ inline HttpResponse shed_response(int retry_after_s) {
       429, "ingest backpressure: the master is shedding load; retry later");
   r.headers.push_back({"Retry-After", std::to_string(retry_after_s)});
   return r;
+}
+
+// FNV-1a 64-bit: the stable, dependency-free hash behind the serving
+// router's consistent-hash ring (replica vnodes + affinity keys)
+inline uint64_t fnv1a64(const std::string& s) {
+  uint64_t h = 1469598103934665603ull;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  return h;
 }
 
 class Master {
@@ -7107,10 +7123,151 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       j.set("owner", rep.owner);
       j.set("registered_ms", Json(rep.registered_ms));
       j.set("heartbeat_age_ms", Json(now - rep.last_heartbeat_ms));
+      j.set("inflight", Json(static_cast<int64_t>(rep.inflight)));
       j.set("stats", rep.stats);
       out.push_back(j);
     }
     return R::json(out.dump());
+  }));
+
+  // ---- request routing: one front door for the serving fleet ----
+  // The inference analog of the NTSC proxy path (SURVEY §3.5): POST
+  // /v1/generate on the master reverse-proxies to a healthy registered
+  // replica.  Placement is least-loaded — queue depth + KV utilization
+  // from the last heartbeat, plus the requests this master has in flight
+  // to the replica since that beat — with prefix AFFINITY on top: an
+  // explicit `session` field (or, absent that, a hash of the prompt's
+  // leading tokens) picks a sticky replica on a consistent-hash ring over
+  // the live replica ids, so requests sharing a system prompt land on the
+  // replica already holding its KV blocks.  Draining/failed replicas
+  // leave the candidate set, a saturated sticky pick falls back to
+  // least-loaded, and a fully saturated fleet answers 503 + Retry-After
+  // instead of queueing blind.  Supervisor relaunches re-register under
+  // fresh ids and re-enter the ring automatically; the 40-vnode ring
+  // keeps keys whose replica SURVIVED a death pinned where they were.
+  srv.route("POST", "/v1/generate", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::string affinity;
+    if (body["session"].is_string() && !body["session"].as_string().empty()) {
+      affinity = body["session"].as_string();
+    } else {
+      // shared-prefix signature: the leading tokens cover a shared system
+      // prompt's cached blocks; 32 is plenty and keeps the hash cheap
+      const auto& toks = body["prompt_tokens"].elements();
+      size_t n = std::min<size_t>(toks.size(), 32);
+      for (size_t i = 0; i < n; ++i)
+        affinity += std::to_string(toks[i].as_int()) + ",";
+    }
+    struct Candidate {
+      std::string id, host;
+      int port = 0;
+      double load = 0.0;
+      bool saturated = false;
+    };
+    std::vector<Candidate> cands;
+    {
+      std::lock_guard<std::mutex> lk(m.mu_);
+      for (const auto& [rid, rep] : m.serve_replicas_) {
+        const Json& st = rep.stats;
+        const Json& f = st["failed"];
+        if (f.as_bool(false) || (f.is_string() && !f.as_string().empty()))
+          continue;
+        if (st["draining"].as_bool(false)) continue;
+        std::string host, path;
+        int port = 0;
+        if (!Master::parse_http_url(rep.url, &host, &port, &path)) continue;
+        Candidate c;
+        c.id = rid;
+        c.host = host;
+        c.port = port;
+        int64_t depth = st["queue_depth"].as_int(0);
+        int64_t cap = st["queue_capacity"].as_int(0);
+        c.load = static_cast<double>(depth + rep.inflight) +
+                 st["kv_utilization"].as_double(0.0);
+        // at queue_depth >= queue_capacity the replica's next admission
+        // answers 429 anyway: don't even send it there
+        c.saturated = cap > 0 && depth + rep.inflight >= cap;
+        cands.push_back(c);
+      }
+    }
+    if (cands.empty()) {
+      HttpResponse r = R::error(503, "no serving replicas available");
+      r.headers.push_back({"Retry-After", "1"});
+      return r;
+    }
+    std::stable_sort(cands.begin(), cands.end(),
+                     [](const Candidate& a, const Candidate& b) {
+                       return a.load < b.load;
+                     });
+    if (!affinity.empty() && cands.size() > 1) {
+      // ring successor of the key among 40 vnodes per live replica
+      uint64_t key = fnv1a64(affinity);
+      uint64_t succ_pt = UINT64_MAX, min_pt = UINT64_MAX;
+      size_t succ = cands.size(), min_idx = 0;
+      for (size_t i = 0; i < cands.size(); ++i) {
+        for (int v = 0; v < 40; ++v) {
+          uint64_t p = fnv1a64(cands[i].id + "#" + std::to_string(v));
+          if (p < min_pt) {
+            min_pt = p;
+            min_idx = i;
+          }
+          if (p >= key && p < succ_pt) {
+            succ_pt = p;
+            succ = i;
+          }
+        }
+      }
+      size_t sticky = succ < cands.size() ? succ : min_idx;
+      if (!cands[sticky].saturated) {
+        Candidate c = cands[sticky];
+        cands.erase(cands.begin() + static_cast<long>(sticky));
+        cands.insert(cands.begin(), c);
+      }
+    }
+    bool any_open = false;
+    for (const auto& c : cands) any_open = any_open || !c.saturated;
+    if (!any_open) {
+      HttpResponse r = R::error(503, "serving fleet saturated; retry later");
+      r.headers.push_back({"Retry-After", "1"});
+      return r;
+    }
+    for (const auto& c : cands) {
+      if (c.saturated) continue;
+      {
+        std::lock_guard<std::mutex> lk(m.mu_);
+        auto it = m.serve_replicas_.find(c.id);
+        if (it == m.serve_replicas_.end()) continue;  // reaped meanwhile
+        it->second.inflight++;
+      }
+      // upstream call OUTSIDE mu_ (same discipline as the task proxy):
+      // a slow generation must never stall the control plane
+      auto resp =
+          http_request(c.host, c.port, "POST", "/v1/generate", req.body, 600, {});
+      {
+        std::lock_guard<std::mutex> lk(m.mu_);
+        auto it = m.serve_replicas_.find(c.id);
+        if (it != m.serve_replicas_.end() && it->second.inflight > 0)
+          it->second.inflight--;
+      }
+      if (resp.status == 0 || resp.status == 429 || resp.status == 503) {
+        // unreachable (crash window before the reaper fires) or shedding:
+        // fail over to the next-best replica instead of surfacing a dead
+        // pick to the client
+        continue;
+      }
+      HttpResponse out;
+      out.status = resp.status;
+      out.body = resp.body;
+      out.content_type =
+          resp.content_type.empty() ? "application/json" : resp.content_type;
+      out.headers.push_back({"X-DTPU-Replica", c.id});
+      return out;
+    }
+    HttpResponse r =
+        R::error(503, "no serving replica could take the request; retry later");
+    r.headers.push_back({"Retry-After", "1"});
+    return r;
   }));
 
   // ---- rolling deployment of a registry version onto the fleet ----

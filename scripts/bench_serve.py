@@ -17,6 +17,17 @@ bimodal output-length mix (mostly short completions, a long tail), which
 is exactly the mix static batching handles worst and production traffic
 actually looks like.
 
+Two more A/B sections ride the same JSON line (ISSUE 17 fast path):
+
+- **prefix**: the continuous engine with the prefix cache on vs OFF over a
+  workload where ``--shared-frac`` of requests open with one shared system
+  prompt — warm admissions map the cached blocks and prefill only the
+  unique tail, so TTFT is the number to watch;
+- **lazy_decode**: per-step decode latency, chunked table gather
+  (``decode_chunk_blocks``) vs the legacy full-table gather, at a live
+  context a fraction of the table width (where laziness pays) and at full
+  context (where it must not lose).
+
 Reports requests/s, p50/p95 end-to-end latency, and time-to-first-token
 per arm, plus the requests/s ratio as the headline metric — ONE JSON line,
 the ``bench.py`` schema family (DTPU_BENCH_SERVE=1 hooks it there).
@@ -28,6 +39,7 @@ the ``bench.py`` schema family (DTPU_BENCH_SERVE=1 hooks it there).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -68,12 +80,19 @@ def percentile(xs: List[float], p: float) -> float:
     return float(np.percentile(np.asarray(xs), p)) if xs else float("nan")
 
 
-def run_arm(engine: Any, trace: List[Dict[str, Any]]) -> Dict[str, Any]:
+def run_arm(
+    engine: Any,
+    trace: List[Dict[str, Any]],
+    warmup: List[List[int]] | None = None,
+) -> Dict[str, Any]:
     from determined_tpu.serve import AdmissionRejected
 
     engine.start()
-    # warm both kernels outside the measurement (shared across arms anyway)
-    engine.generate(trace[0]["prompt"], max_new_tokens=2)
+    # warm every kernel outside the measurement (shared across arms
+    # anyway); the prefix arms pass a repeated prompt so the warm-path
+    # suffix kernel compiles here too, not under the first measured hit
+    for prompt in warmup if warmup is not None else [trace[0]["prompt"]]:
+        engine.generate(prompt, max_new_tokens=2)
     rejected = 0
     reqs = []
     t0 = time.monotonic()
@@ -112,6 +131,151 @@ def run_arm(engine: Any, trace: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def _shared_prefix(args: argparse.Namespace) -> List[int]:
+    rng = np.random.default_rng(args.seed + 1)
+    return [int(x) for x in rng.integers(0, 64, size=args.shared_prefix_len)]
+
+
+def make_prefix_trace(args: argparse.Namespace) -> List[Dict[str, Any]]:
+    """``--shared-frac`` of requests open with ONE shared system prompt of
+    ``--shared-prefix-len`` tokens followed by a short unique tail; the
+    rest are fully random prompts of the same total length."""
+    rng = np.random.default_rng(args.seed + 1)
+    shared = _shared_prefix(args)
+    trace = []
+    for i in range(args.prefix_requests):
+        tail = [int(x) for x in rng.integers(0, 64, size=8)]
+        if rng.random() < args.shared_frac:
+            prompt = shared + tail
+        else:
+            prompt = [
+                int(x)
+                for x in rng.integers(0, 64, size=args.shared_prefix_len + 8)
+            ]
+        trace.append(
+            {
+                "arrival": 0.0,  # burst: queue pressure makes TTFT honest
+                "prompt": prompt,
+                "max_new_tokens": 4,
+                "temperature": 0.0,
+                "seed": i,
+            }
+        )
+    return trace
+
+
+def run_prefix_ab(args) -> Dict[str, Any]:
+    """ServeEngine with the prefix cache on vs off, same trace.  Uses a
+    bigger model than the capacity arms (d256/L4): prefill must be
+    compute-bound for the suffix-only path to show its real shape — at toy
+    sizes dispatch overhead drowns the tokens saved."""
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta as flax_meta
+
+    from determined_tpu.models.transformer import TransformerConfig, TransformerLM
+    from determined_tpu.serve import DecodeKernels, ServeConfig, ServeEngine
+
+    model_cfg = TransformerConfig(
+        vocab_size=64, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
+        max_seq_len=512, dtype=jnp.float32, attention_impl="reference",
+    )
+    variables = flax_meta.unbox(
+        TransformerLM(model_cfg).init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))
+    )
+    trace = make_prefix_trace(args)
+    arms = {}
+    for on in (True, False):
+        serve_cfg = ServeConfig(
+            block_size=32,
+            num_blocks=128,
+            max_batch=args.max_batch,
+            max_prompt_len=args.shared_prefix_len + 8,
+            max_new_tokens=4,
+            queue_depth=max(args.prefix_requests, 4),
+            prefix_cache=on,
+        )
+        eng = ServeEngine(DecodeKernels(model_cfg, variables, serve_cfg))
+        # two identical warmup prompts: the repeat compiles the warm-path
+        # suffix kernel (a cold miss compiles the wide prefill)
+        shared = _shared_prefix(args)
+        res = run_arm(eng, trace, warmup=[shared + [0], shared + [0]])
+        st = eng.stats()
+        res["prefix_hit_rate"] = st["prefix_hit_rate"]
+        res["prefix_tokens_saved"] = st["prefix_tokens_saved"]
+        arms["on" if on else "off"] = res
+    speedup = (
+        arms["off"]["mean_ttft_s"] / arms["on"]["mean_ttft_s"]
+        if arms["on"]["mean_ttft_s"]
+        else None
+    )
+    return {
+        "shared_frac": args.shared_frac,
+        "shared_prefix_len": args.shared_prefix_len,
+        "requests": args.prefix_requests,
+        "model": "d256-L4-h8kv4-v64 (CPU test config)",
+        "on": arms["on"],
+        "off": arms["off"],
+        "ttft_speedup": round(speedup, 3) if speedup else None,
+    }
+
+
+def run_decode_ab(model_cfg, variables, args) -> Dict[str, Any]:
+    """Per-step decode latency, chunked vs full-table gather, at a live
+    context 1/8 of the table width and again at full context.  Times the
+    compiled kernel directly: block-table contents do not change the work,
+    so no prefill is needed."""
+    from determined_tpu.serve import DecodeKernels, ServeConfig
+
+    table_tokens = args.decode_table_tokens
+    serve = {}
+    for chunk in (args.decode_chunk_blocks, 0):
+        serve_cfg = ServeConfig(
+            block_size=4,
+            num_blocks=512,
+            max_batch=args.max_batch,
+            max_prompt_len=table_tokens - 8,
+            max_new_tokens=8,
+            queue_depth=4,
+            decode_chunk_blocks=chunk,
+        )
+        serve[chunk] = DecodeKernels(model_cfg, variables, serve_cfg)
+    t_blocks = serve[0].serve_cfg.blocks_per_seq
+
+    def step_ms(kernels, live_tokens: int) -> float:
+        b = args.max_batch
+        tokens = np.ones(b, np.int32)
+        positions = np.full(b, live_tokens - 1, np.int32)
+        tables = np.tile(
+            (1 + np.arange(t_blocks, dtype=np.int32)) % kernels.serve_cfg.num_blocks,
+            (b, 1),
+        )
+        for _ in range(3):  # compile + warm
+            kernels.decode(tokens, positions, tables)
+        t0 = time.monotonic()
+        iters = 20
+        for _ in range(iters):
+            kernels.decode(tokens, positions, tables)
+        return (time.monotonic() - t0) / iters * 1e3
+
+    out: Dict[str, Any] = {
+        "table_tokens": table_tokens,
+        "table_blocks": t_blocks,
+        "chunk_blocks": args.decode_chunk_blocks,
+    }
+    for label, live in (("short_ctx", table_tokens // 8),
+                        ("full_ctx", table_tokens)):
+        lazy = step_ms(serve[args.decode_chunk_blocks], live)
+        full = step_ms(serve[0], live)
+        out[label] = {
+            "live_tokens": live,
+            "lazy_ms": round(lazy, 3),
+            "full_ms": round(full, 3),
+            "speedup": round(full / lazy, 3) if lazy else None,
+        }
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--requests", type=int, default=120)
@@ -124,6 +288,14 @@ def main() -> None:
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-prompt-len", type=int, default=16)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--shared-frac", type=float, default=0.7,
+                   help="fraction of prefix-A/B requests opening with the "
+                        "shared system prompt")
+    p.add_argument("--shared-prefix-len", type=int, default=232)
+    p.add_argument("--prefix-requests", type=int, default=24)
+    p.add_argument("--decode-table-tokens", type=int, default=512,
+                   help="block-table span (tokens) for the lazy-decode A/B")
+    p.add_argument("--decode-chunk-blocks", type=int, default=8)
     args = p.parse_args()
 
     import jax
@@ -163,6 +335,15 @@ def main() -> None:
         if static["requests_per_s"]
         else None
     )
+
+    prefix = run_prefix_ab(args)
+    # the decode A/B spans a longer context than the capacity arms need;
+    # params are max_seq_len-independent (RoPE is computed on the fly)
+    long_cfg = dataclasses.replace(
+        model_cfg, max_seq_len=max(args.decode_table_tokens, model_cfg.max_seq_len)
+    )
+    lazy_decode = run_decode_ab(long_cfg, variables, args)
+
     print(
         json.dumps(
             {
@@ -173,6 +354,8 @@ def main() -> None:
                 "vs_baseline": round(ratio, 3) if ratio else None,
                 "continuous": continuous,
                 "static": static,
+                "prefix": prefix,
+                "lazy_decode": lazy_decode,
                 "requests": args.requests,
                 "rate_per_s": args.rate,
                 "long_frac": args.long_frac,

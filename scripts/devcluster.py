@@ -743,6 +743,67 @@ class _OpenLoopLoad:
                 f"retried_5xx={self.http_5xx}")
 
 
+class _RoutedLoad(_OpenLoopLoad):
+    """Open-loop Poisson arrivals through the MASTER's ``POST
+    /v1/generate`` reverse proxy — never replica-direct, so the drill
+    exercises the router's least-loaded pick, session affinity, and
+    failover instead of the client's.  70% of arrivals share an 8-token
+    system prompt under one sticky ``session`` key (the prefix-cache
+    workload); the rest are one-off users.  A request is DROPPED only
+    when the proxy never answered 200 within its window — per-request
+    503s (fleet briefly saturated, replica mid-relaunch) just retry."""
+
+    #: two FULL blocks at the drill's block_size of 4; the match cap
+    #: (len(prompt)-1) still leaves every request's unique tail private
+    SHARED_PROMPT = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    def __init__(self, cluster: "DevCluster", rate_hz: float = 6.0) -> None:
+        super().__init__(cluster, rate_hz)
+        # X-DTPU-Replica -> 200s served for the shared "sys" session;
+        # the affinity assertion reads this after the load drains
+        self.shared_replicas: Dict[str, int] = {}
+
+    def _refresh_urls(self) -> None:
+        pass  # the proxy is the only url this load ever learns
+
+    def _one_request(self, seq: int) -> None:
+        import random
+        import requests
+
+        rng = random.Random(seq)  # per-thread: Random() is not thread-safe
+        shared = rng.random() < 0.7
+        if shared:
+            body = {"prompt_tokens": self.SHARED_PROMPT + [seq % 64],
+                    "max_new_tokens": 4, "session": "sys"}
+        else:
+            body = {"prompt_tokens":
+                    [rng.randrange(64) for _ in range(rng.randrange(3, 7))],
+                    "max_new_tokens": 4, "session": f"user-{seq}"}
+        headers = {"Authorization": f"Bearer {self.cluster.token}"}
+        deadline = time.time() + self.REQUEST_WINDOW_S
+        while time.time() < deadline:
+            try:
+                r = requests.post(self.cluster.url + "/v1/generate",
+                                  json=body, headers=headers, timeout=30)
+            except Exception:
+                time.sleep(0.25)  # master briefly unreachable: retry
+                continue
+            if r.status_code == 200:
+                rep = r.headers.get("X-DTPU-Replica", "?")
+                with self._lock:
+                    self.ok += 1
+                    if shared:
+                        self.shared_replicas[rep] = \
+                            self.shared_replicas.get(rep, 0) + 1
+                return
+            if r.status_code >= 500:
+                with self._lock:
+                    self.http_5xx += 1
+            time.sleep(0.25)
+        with self._lock:
+            self.dropped += 1
+
+
 def _wait_for(poll, pred, what: str, timeout: float = 90.0):
     """Poll until pred(state) or raise with the last state attached."""
     deadline = time.time() + timeout
@@ -936,6 +997,132 @@ def _selfheal_smoke(root) -> int:
         cluster.stop()
 
 
+def _route_smoke(root) -> int:
+    """The serving fast-path routing drill (docs/serving.md):
+
+    1. a supervised fleet of 2 replicas serves behind the master's
+       ``POST /v1/generate`` reverse proxy — clients never learn a
+       replica url;
+    2. open-loop Poisson load through the proxy, 70% sharing a system
+       prompt under one sticky session key (the prefix-cache workload);
+    3. SIGKILL one replica mid-load -> the router fails the sticky
+       session over to the survivor and the supervisor refills the
+       slot, with ZERO dropped requests (the fleet keeps tracking the
+       offered rate);
+    4. the shared session lands on a handful of replicas (affinity, not
+       round-robin) and the fleet's heartbeat stats show a prefix-cache
+       hit rate above zero on the sticky replica.
+    """
+    agent_state = str(root / "agent-state")
+    cluster = DevCluster(
+        root, agents=0, slots=2, log_dir=root / "logs",
+        master_args=(
+            "--serve-replica-timeout-sec", "5",
+            "--fleet-backoff-initial-ms", "200",
+            "--fleet-backoff-cap-ms", "1000",
+            "--fleet-crashloop-threshold", "3",
+            "--fleet-stable-sec", "2",
+        ),
+    )
+    cluster.start_master()
+    cluster.start_agent(0, extra_args=("--state-dir", agent_state))
+    _wait_for(
+        lambda: cluster.http.get(cluster.url + "/api/v1/agents", timeout=2).json(),
+        lambda agents: len(agents) >= 1, "agent registration", 20)
+
+    fleet_cfg = {
+        # block_size 4 so the load's 8-token shared system prompt spans
+        # two FULL blocks — the prefix cache shares whole blocks only
+        "serve": {"block_size": 4, "num_blocks": 64, "max_batch": 2,
+                  "max_prompt_len": 12, "max_new_tokens": 4,
+                  "queue_depth": 16, "heartbeat_interval_s": 0.5,
+                  "drain_grace_s": 20.0},
+        "env": {"JAX_PLATFORMS": "cpu"},
+    }
+    load = None
+    try:
+        ckpt_root = os.path.join(cluster.ckpt_dir, "route")
+        os.makedirs(ckpt_root, exist_ok=True)
+        print("route: training a tiny LM checkpoint ...")
+        ckpt_dir, uuid = train_tiny_lm_checkpoint(ckpt_root)
+        cluster.register_model("route-lm", uuid, storage_path=ckpt_dir)
+        cluster.set_fleet("route-lm", 1, 2, config=fleet_cfg)
+        _wait_for(
+            cluster.fleet_status,
+            lambda f: f["status"] == "ok"
+            and sum(1 for s in f["slots"] if s["replica_id"]) == 2,
+            "2 supervised replicas live", 120)
+        print("route: fleet of 2 live behind the proxy; starting routed load")
+
+        load = _RoutedLoad(cluster)
+        load.start()
+        time.sleep(5.0)  # accumulate sticky traffic + prefix hits pre-kill
+
+        victim = cluster.fleet_status()["slots"][0]
+        with open(os.path.join(agent_state, victim["task_id"] + ".pid")) as f:
+            pid = int(f.read().strip())
+        print(f"route: SIGKILLing replica slot 0 ({victim['task_id']}, "
+              f"pid {pid}) mid-load")
+        os.kill(pid, signal.SIGKILL)
+        _wait_for(
+            cluster.fleet_status,
+            lambda f: f["status"] == "ok"
+            and sum(1 for s in f["slots"] if s["replica_id"]) == 2
+            and f["slots"][0]["task_id"] != victim["task_id"],
+            "supervisor refill after replica SIGKILL", 120)
+        print("route: slot 0 refilled; letting traffic settle on the "
+              "healed fleet")
+        time.sleep(3.0)
+        load.stop_and_join()
+        print(f"route: load {load.summary()} "
+              f"shared_session={dict(load.shared_replicas)}")
+
+        reps = _wait_for(
+            cluster.serving,
+            lambda rs: any(
+                (r.get("stats") or {}).get("prefix_hits", 0) > 0 for r in rs),
+            "a heartbeat showing prefix hits", 30)
+        hit_rates = {
+            r["id"]: round(
+                float((r.get("stats") or {}).get("prefix_hit_rate", 0.0)), 3)
+            for r in reps
+        }
+        inflight = {r["id"]: r.get("inflight", 0) for r in reps}
+        print(f"route: prefix hit rates {hit_rates} inflight {inflight}")
+
+        ok = (
+            load.sent >= 30
+            and load.ok == load.sent
+            and load.dropped == 0
+            and sum(load.shared_replicas.values()) > 0
+            # affinity, not round-robin: the shared session pins to ONE
+            # replica at a time — a SIGKILL + slot refill may re-pin it
+            # at most twice over the drill
+            and len(load.shared_replicas) <= 3
+            and max(hit_rates.values()) > 0.0
+            and all(v == 0 for v in inflight.values())
+        )
+        if not ok:
+            print("route: FAIL", file=sys.stderr)
+            print(f"route: fleet status: {json.dumps(cluster.fleet_status())}",
+                  file=sys.stderr)
+            for line in cluster.proc_log_tail("master", 60):
+                print(f"  master| {line}", file=sys.stderr)
+            for line in cluster.proc_log_tail("agent-0", 30):
+                print(f"  agent | {line}", file=sys.stderr)
+            return 1
+        print("route: OK")
+        return 0
+    finally:
+        if load is not None:
+            load._stop.set()
+        subprocess.run(
+            ["pkill", "-9", "-f", "determined_tpu.exec.serve_replica"],
+            capture_output=True,
+        )
+        cluster.stop()
+
+
 def _kill_master_smoke(cluster: "DevCluster") -> int:
     """SIGKILL + restart the master under a live 2-process gang (the
     durability acceptance): the WAL replays, the agents re-report their
@@ -1058,6 +1245,12 @@ def main(argv=None) -> int:
                          "SIGKILL -> supervisor relaunch; master SIGKILL "
                          "mid-canary -> WAL resume; injected regression -> "
                          "auto-hold; crash-loop -> degraded)")
+    ap.add_argument("--route", action="store_true",
+                    help="run the routed-serving chaos smoke (2 supervised "
+                         "replicas behind the master's /v1/generate proxy; "
+                         "Poisson load with a 70%% shared system prompt; "
+                         "replica SIGKILL mid-load -> failover + refill, "
+                         "zero drops, prefix hits on the sticky replica)")
     ap.add_argument("--fsck-selftest", action="store_true",
                     help="verify `dtpu-master --journal-fsck` on fabricated journals")
     ap.add_argument("--agents", type=int, default=2)
@@ -1085,6 +1278,10 @@ def main(argv=None) -> int:
         # builds its own cluster: custom master flags + an agent with a
         # known --state-dir (the pidfile is the replica-SIGKILL handle)
         return _selfheal_smoke(root)
+    if args.route:
+        # same shape: own cluster, supervised fleet, pidfile SIGKILL —
+        # but all client traffic rides the master's /v1/generate proxy
+        return _route_smoke(root)
     if args.deploy:
         # registry smoke needs no agents — the replica is our subprocess
         cluster = DevCluster(root, agents=0, slots=args.slots,

@@ -21,6 +21,14 @@
 #                                        # dropped requests; injected error
 #                                        # rate -> auto-hold; crash-loop ->
 #                                        # degraded (docs/operations.md)
+#   scripts/devcluster.sh --route        # ASan build + routed-serving
+#                                        # chaos: Poisson load through the
+#                                        # master's /v1/generate proxy (70%
+#                                        # shared system prompt), replica
+#                                        # SIGKILL mid-load -> failover +
+#                                        # refill with zero drops and
+#                                        # prefix hits on the sticky
+#                                        # replica (docs/serving.md)
 #
 # The pytest devcluster marker (tests/conftest.py) skips cleanly when the
 # binaries are absent; after this script they run:
@@ -40,6 +48,13 @@ elif [[ "${1:-}" == "--kill-master" ]]; then
   scripts/native_check.sh --sanitize
   export DTPU_NATIVE_BUILD_DIR="$REPO/native/build-asan"
   exec python scripts/devcluster.py --kill-master
+elif [[ "${1:-}" == "--route" ]]; then
+  # the router's candidate walk, in-flight accounting, and failover all
+  # run inside the master under concurrent load — exactly the code ASan
+  # and the mutex checks should watch while a replica dies mid-request
+  scripts/native_check.sh --sanitize
+  export DTPU_NATIVE_BUILD_DIR="$REPO/native/build-asan"
+  exec python scripts/devcluster.py --route
 elif [[ "${1:-}" == "--selfheal" ]]; then
   # chaos smoke runs under the ASan/UBSan build too: the supervisor's
   # relaunch/backoff bookkeeping and the deploy resume path are exactly

@@ -107,6 +107,17 @@ def test_cli_experiment_resume_without_journal(tmp_path, capsys):
     assert "no experiment journal" in capsys.readouterr().err
 
 
+def test_cli_serve_rejects_bad_decode_chunk(tmp_path, capsys):
+    """A decode chunk that does not divide the block-table width is an
+    InvalidExperimentConfig at the CLI boundary: exit 2, named knob, no
+    checkpoint touched (the config is validated first)."""
+    # defaults: blocks_for(128 + 64) / 16 = 12 table columns; 5 ∤ 12
+    rc = run_cli("serve", str(tmp_path), "--decode-chunk-blocks", "5")
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "decode_chunk_blocks=5" in err and "divide" in err
+
+
 # ---- devcluster-backed lifecycle -------------------------------------------
 
 

@@ -25,6 +25,7 @@ from determined_tpu.serve import (
     AdmissionRejected,
     BlockAllocator,
     CacheOOM,
+    prefix_block_hashes,
     DecodeKernels,
     LaneTable,
     ServeConfig,
@@ -112,6 +113,140 @@ def test_allocator_utilization_and_stats():
     assert a.utilization() == pytest.approx(0.5)
     st = a.stats()
     assert st["used"] == 5 and st["free"] == 5 and st["peak"] == 5
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: refcounts, CoW-by-recompute boundary, LRU eviction
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_match_shares_and_registered_blocks_park_on_free():
+    a = BlockAllocator(num_blocks=17, block_size=4, prefix_cache=True)
+    chain = prefix_block_hashes(list(range(12)), 4)
+    assert len(chain) == 3
+    blocks = a.alloc(3)
+    a.register_prefix(chain, blocks)
+    # a second sequence matching the chain shares the SAME physical blocks
+    shared = a.match_prefix(chain)
+    assert shared == blocks
+    assert all(a.refcount(b) == 2 for b in blocks)
+    assert a.used_blocks == 3  # shared blocks count once
+    a.free(shared)
+    assert all(a.refcount(b) == 1 for b in blocks)
+    # refcount 0 parks registered blocks in the cache, not the free list
+    a.free(blocks)
+    assert a.used_blocks == 0 and a.cached_blocks == 3
+    again = a.match_prefix(chain)
+    assert again == blocks and a.cached_blocks == 0
+    a.free(again)
+    st = a.stats()
+    assert st["prefix_hits"] == 2 and st["prefix_tokens_saved"] == 24
+
+
+def test_prefix_hash_chain_is_a_trie_not_a_bag():
+    """Matching stops at the first miss: a chain whose FIRST block differs
+    shares nothing even if a later block's tokens coincide, because each
+    hash covers its whole prefix."""
+    a = BlockAllocator(num_blocks=9, block_size=2, prefix_cache=True)
+    chain = prefix_block_hashes([1, 2, 3, 4], 2)
+    blocks = a.alloc(2)
+    a.register_prefix(chain, blocks)
+    other = prefix_block_hashes([9, 9, 3, 4], 2)  # same 2nd block tokens
+    assert a.match_prefix(other) == []
+    # a shorter prompt sharing only the first block matches exactly it
+    head = prefix_block_hashes([1, 2], 2)
+    hit = a.match_prefix(head)
+    assert hit == blocks[:1]
+    a.free(hit)
+    a.free(blocks)
+
+
+def test_prefix_limit_tokens_never_covers_the_tail():
+    """Admission caps the chain at len(prompt)-1, so the block holding the
+    final prompt token is never shared — that is the copy-on-write policy
+    (the tail is re-prefilled privately, shared blocks stay read-only)."""
+    bs = 4
+    # 8 tokens = exactly 2 full blocks, but the cap must drop the last one
+    chain = prefix_block_hashes(list(range(8)), bs, limit_tokens=7)
+    assert len(chain) == 1
+    # partial tails never participate even uncapped
+    assert len(prefix_block_hashes(list(range(7)), bs)) == 1
+    assert prefix_block_hashes([1], bs, limit_tokens=0) == []
+
+
+def test_prefix_shared_double_free_raises():
+    """Over-freeing a shared block raises instead of silently recycling a
+    block another sequence is still reading."""
+    a = BlockAllocator(num_blocks=9, block_size=4, prefix_cache=True)
+    chain = prefix_block_hashes(list(range(8)), 4)
+    mine = a.alloc(2)
+    a.register_prefix(chain, mine)
+    theirs = a.match_prefix(chain)
+    a.free(mine)
+    a.free(theirs)  # the co-owner's single release is fine
+    with pytest.raises(ValueError):
+        a.free(theirs)  # a third free would corrupt the cached content
+
+
+def test_prefix_eviction_is_lru_and_never_touches_live_refs():
+    a = BlockAllocator(num_blocks=5, block_size=2, prefix_cache=True)
+    c1 = prefix_block_hashes([1, 2], 2)
+    c2 = prefix_block_hashes([3, 4], 2)
+    b1 = a.alloc(1)
+    a.register_prefix(c1, b1)
+    b2 = a.alloc(1)
+    a.register_prefix(c2, b2)
+    live = a.alloc(2)  # free list is now empty
+    a.free(b1)  # released first -> evicted first
+    a.free(b2)
+    got = a.alloc(2)  # must reclaim BOTH cached blocks, never `live`
+    assert set(got) == {b1[0], b2[0]}
+    assert all(a.refcount(b) == 1 for b in live)
+    assert a.match_prefix(c1) == [] and a.match_prefix(c2) == []
+    assert a.stats()["evictions"] == 2
+
+
+def test_prefix_eviction_order_is_least_recently_released():
+    a = BlockAllocator(num_blocks=4, block_size=2, prefix_cache=True)
+    c1 = prefix_block_hashes([1, 2], 2)
+    c2 = prefix_block_hashes([3, 4], 2)
+    b1 = a.alloc(1)
+    a.register_prefix(c1, b1)
+    b2 = a.alloc(1)
+    a.register_prefix(c2, b2)
+    a.alloc(1)  # drain the free list
+    a.free(b2)  # release the NEWER registration first
+    a.free(b1)
+    a.alloc(1)  # evicts b2: least recently released, not lowest id
+    assert a.match_prefix(c2) == []
+    assert a.match_prefix(c1) == b1
+
+
+def test_prefix_interleaved_share_release_no_fragmentation():
+    """Random interleaving of prefix-matched admissions and retirements
+    keeps every block exactly one of live / cached / free — capacity is
+    never lost to double-parking or leaked references."""
+    bs = 4
+    a = BlockAllocator(num_blocks=33, block_size=bs, prefix_cache=True)
+    rng = np.random.default_rng(7)
+    prompts = [list(range(100 + p, 112 + p)) for p in range(5)]
+    live = []
+    for _ in range(300):
+        if live and (rng.random() < 0.45 or a.free_blocks + a.cached_blocks < 4):
+            a.free(live.pop(rng.integers(len(live))))
+        else:
+            toks = prompts[rng.integers(len(prompts))]
+            chain = prefix_block_hashes(toks, bs, limit_tokens=len(toks) - 1)
+            shared = a.match_prefix(chain)
+            private = a.alloc(a.blocks_for(len(toks)) - len(shared))
+            a.register_prefix(chain, shared + private)
+            live.append(shared + private)
+        st = a.stats()
+        assert st["used"] + st["free"] + st["cached"] == st["capacity"]
+    for g in live:
+        a.free(g)
+    assert a.used_blocks == 0
+    assert a.free_blocks + a.cached_blocks == a.capacity
 
 
 # ---------------------------------------------------------------------------
@@ -215,25 +350,34 @@ def test_generate_greedy_matches_full_forward(engine, lm_setup):
         seq.append(tok)
 
 
-def test_join_mid_flight_and_retire_immediately(engine):
+def test_join_mid_flight_and_retire_immediately(kernels):
     """A short request submitted while a long one decodes joins the
-    running batch and completes long before the long one finishes."""
-    long_req = engine.submit([1, 2, 3], max_new_tokens=32)
-    # wait until the long request is actually decoding (first token out)
-    deadline = time.monotonic() + 60
-    while long_req.first_token_at is None:
-        assert time.monotonic() < deadline
-        time.sleep(0.01)
-    short_req = engine.submit([4, 5], max_new_tokens=1)
-    assert short_req.done.wait(60)
-    assert short_req.error is None and len(short_req.output) == 1
-    # retire-immediately: the short one finished while the long one runs
-    # (or at worst in the same step its own decode finished)
-    assert long_req.done.wait(60)
-    assert short_req.finished_at <= long_req.finished_at
-    st = engine.stats()
-    assert st["completed"] == 2
-    assert st["lanes"]["joined"] >= 1  # short joined a running batch
+    running batch and completes long before the long one finishes.
+    Step-driven: a threaded engine decodes a 32-token request faster
+    than the wall clock can interleave a second submission."""
+    eng = ServeEngine(kernels)  # not started: the test drives step_once()
+    try:
+        long_req = eng.submit([1, 2, 3], max_new_tokens=32)
+        assert eng.step_once()  # admit + first decode step
+        assert long_req.first_token_at is not None
+        assert not long_req.done.is_set()
+        short_req = eng.submit([4, 5], max_new_tokens=2)
+        steps = 0
+        while not short_req.done.is_set():
+            assert eng.step_once(), "scheduler stalled"
+            steps += 1
+            assert steps < 8, "short request starved behind the long one"
+        assert short_req.error is None and len(short_req.output) == 2
+        # retire-immediately: the short one finished while the long one runs
+        assert not long_req.done.is_set()
+        while not long_req.done.is_set():
+            assert eng.step_once(), "long request starved"
+        assert short_req.finished_at <= long_req.finished_at
+        st = eng.stats()
+        assert st["completed"] == 2
+        assert st["lanes"]["joined"] >= 2  # short joined a running batch
+    finally:
+        eng.stop()
 
 
 def test_fairness_under_mixed_prompt_lengths(kernels):
@@ -311,6 +455,80 @@ def test_cache_oom_delays_admission_not_correctness(lm_setup):
         eng.stop()
 
 
+def test_prefix_cached_generation_matches_cold(kernels):
+    """Warm admission — shared prefix blocks mapped, suffix-only prefill —
+    is token-for-token identical to the cold run under a fixed seed, and
+    the shared blocks inflate neither kv_utilization nor correctness."""
+    prompt = list(range(3, 12))  # 9 tokens: chain covers 2 full blocks
+    eng = ServeEngine(kernels)
+    try:
+        cold = eng.submit(prompt, max_new_tokens=4, temperature=0.7, seed=42)
+        eng.step_once()  # admit + prefill the cold run before warm submit
+        warm = eng.submit(prompt, max_new_tokens=4, temperature=0.7, seed=42)
+        for _ in range(12):
+            eng.step_once()
+            if cold.done.is_set() and warm.done.is_set():
+                break
+        assert cold.error is None and warm.error is None
+        assert cold.output == warm.output and len(cold.output) == 4
+        st = eng.stats()
+        assert st["prefix_hits"] == 1 and st["prefix_tokens_saved"] == 8
+        assert st["prefix_hit_rate"] == pytest.approx(0.5)
+    finally:
+        eng.stop()
+
+
+def test_kv_utilization_counts_shared_blocks_once(kernels):
+    """Regression for the router's load signal: two in-flight sequences
+    sharing 2 prefix blocks occupy 2*total - 2 distinct blocks, and
+    ``kv_utilization`` reports exactly that (shared counted once)."""
+    prompt = list(range(20, 29))  # 9 tokens -> 2 shareable blocks
+    total = SERVE_CFG.blocks_for(len(prompt) + 4)
+    eng = ServeEngine(kernels)
+    try:
+        a = eng.submit(prompt, max_new_tokens=4)
+        eng.step_once()
+        b = eng.submit(prompt, max_new_tokens=4)
+        eng.step_once()  # admits b: both sequences now hold blocks
+        assert not (a.done.is_set() and b.done.is_set())
+        distinct = 2 * total - 2
+        assert eng.allocator.used_blocks == distinct
+        st = eng.stats()
+        assert st["kv_utilization"] == pytest.approx(
+            distinct / SERVE_CFG.usable_blocks, abs=1e-4
+        )
+        assert st["queue_capacity"] == SERVE_CFG.queue_depth
+        while not (a.done.is_set() and b.done.is_set()):
+            eng.step_once()
+    finally:
+        eng.stop()
+
+
+def test_prefix_cache_off_restores_private_blocks(lm_setup):
+    """--no-prefix-cache: identical prompts never share physical blocks
+    and the hit counters stay zero (the PR-9 data path)."""
+    cfg, _model, variables = lm_setup
+    off = ServeConfig(
+        block_size=4, num_blocks=64, max_batch=4, max_prompt_len=16,
+        max_new_tokens=32, queue_depth=4, prefix_cache=False,
+    )
+    eng = ServeEngine(DecodeKernels(cfg, variables, off))
+    try:
+        prompt = list(range(3, 12))
+        a = eng.submit(prompt, max_new_tokens=4)
+        eng.step_once()
+        b = eng.submit(prompt, max_new_tokens=4)
+        eng.step_once()
+        assert eng.allocator.used_blocks == 2 * off.blocks_for(len(prompt) + 4)
+        while not (a.done.is_set() and b.done.is_set()):
+            eng.step_once()
+        st = eng.stats()
+        assert st["prefix_hits"] == 0 and st["prefix_hit_rate"] == 0.0
+        assert a.output == b.output  # greedy: sharing was never load-bearing
+    finally:
+        eng.stop()
+
+
 def test_drain_finishes_inflight_rejects_new(engine):
     long_req = engine.submit([7, 8, 9], max_new_tokens=32)
     deadline = time.monotonic() + 60
@@ -358,6 +576,7 @@ class _CrashingKernels:
         self.serve_cfg = kernels.serve_cfg
         self.model_cfg = kernels.model_cfg
         self.prefill = kernels.prefill
+        self.prefill_suffix = kernels.prefill_suffix
 
     def decode(self, *a, **kw):
         raise RuntimeError("synthetic decode explosion")
@@ -431,13 +650,23 @@ def test_retrace_sentinel_one_decode_trace(lm_setup):
                 _submit_retry(eng, prompt, max_new_tokens=1 + i * 3,
                               temperature=0.5 * (i % 2), seed=i)
             )
+        # a repeated long prompt forces a WARM admission too: the suffix
+        # kernel must also hold one trace across varying (start, len)
+        shared = [int(t) for t in rng.integers(0, 64, size=13)]
+        for i in range(3):
+            reqs.append(
+                _submit_retry(eng, shared + [i], max_new_tokens=2, seed=9 + i)
+            )
         for r in reqs:
             assert r.done.wait(120) and r.error is None
     finally:
         eng.stop()
     by_label = {r.label: r for r in sentinel.records()}
     assert by_label["serve.decode_step"].traces == 1
+    # cold admissions run the wide padded prefill, warm admissions the
+    # chunked suffix kernel — one trace each across every length mix
     assert by_label["serve.prefill_step"].traces == 1
+    assert by_label["serve.prefill_suffix_step"].traces == 1
     assert sentinel.violations() == {}
     sentinel.reset()
 
@@ -765,6 +994,7 @@ class _FastHeartbeatKernels:
         )
         self.model_cfg = kernels.model_cfg
         self.prefill = kernels.prefill
+        self.prefill_suffix = kernels.prefill_suffix
         self.decode = kernels.decode
 
 
@@ -1369,4 +1599,217 @@ def test_replica_lifecycle_against_real_master(lm_checkpoint, tmp_path):
     finally:
         if proc is not None and proc.poll() is None:
             proc.kill()
+        cluster.stop()
+
+# ---------------------------------------------------------------------------
+# master request routing: POST /v1/generate on the master reverse-proxies to
+# the least-loaded healthy replica with prefix/session affinity (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    """A replica's HTTP face only: /v1/generate answers with the replica's
+    own tag, so router tests can see exactly where the master sent each
+    request.  ``status`` flips the replica into shedding (429/503) mode."""
+
+    def __init__(self, tag, status=200):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.tag = tag
+        self.status = status
+        self.hits = 0
+        self.lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                with fake.lock:
+                    fake.hits += 1
+                    code = fake.status
+                body = _json.dumps({"tokens": [7], "replica": fake.tag}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}/"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True,
+            name=f"dtpu-fake-replica-{tag}",
+        )
+        self.thread.start()
+
+    def close(self):
+        try:
+            self.server.shutdown()
+            self.server.server_close()
+        except Exception:  # noqa: BLE001 - already down is fine
+            pass
+
+
+def _route_register(cluster, url, stats):
+    """Register a replica url and push one heartbeat of router-visible
+    stats (queue_depth/queue_capacity/kv_utilization)."""
+    r = cluster.http.post(
+        cluster.url + "/api/v1/serving/replicas",
+        json={"url": url, "model": "lm@v1"}, timeout=5,
+    )
+    assert r.status_code == 201, r.text
+    rid = r.json()["id"]
+    if stats is not None:
+        hb = cluster.http.post(
+            cluster.url + f"/api/v1/serving/replicas/{rid}/heartbeat",
+            json={"stats": stats}, timeout=5,
+        )
+        assert hb.status_code == 200, hb.text
+    return rid
+
+
+def _router_cluster(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from devcluster import DevCluster
+
+    cluster = DevCluster(
+        tmp_path, agents=0, master_args=["--serve-replica-timeout-sec", "60"]
+    )
+    cluster.start_master()
+    return cluster
+
+
+@pytest.mark.devcluster
+def test_route_picks_least_loaded_replica(tmp_path):
+    """With no affinity key the router picks by load — queue depth plus
+    KV utilization from the last heartbeat — and stamps the winning
+    replica id on X-DTPU-Replica."""
+    cluster = _router_cluster(tmp_path)
+    busy, idle = _FakeReplica("busy"), _FakeReplica("idle")
+    try:
+        _route_register(cluster, busy.url, {
+            "queue_depth": 3, "queue_capacity": 8, "kv_utilization": 0.9})
+        rid_idle = _route_register(cluster, idle.url, {
+            "queue_depth": 0, "queue_capacity": 8, "kv_utilization": 0.1})
+        for _ in range(3):
+            r = cluster.http.post(cluster.url + "/v1/generate",
+                                  json={}, timeout=10)
+            assert r.status_code == 200, r.text
+            assert r.json()["replica"] == "idle"
+            assert r.headers["X-DTPU-Replica"] == rid_idle
+        assert busy.hits == 0 and idle.hits == 3
+        # inflight bookkeeping drains back to zero after each response
+        listing = cluster.http.get(cluster.url + "/api/v1/serving",
+                                   timeout=5).json()
+        assert all(x["inflight"] == 0 for x in listing), listing
+    finally:
+        busy.close()
+        idle.close()
+        cluster.stop()
+
+
+@pytest.mark.devcluster
+def test_route_sticky_session_survives_replica_death(tmp_path):
+    """A session key pins to one replica (consistent-hash ring); when that
+    replica dies, ONLY its keys move — a key on a surviving replica stays
+    put, and the moved key lands consistently on one survivor."""
+    cluster = _router_cluster(tmp_path)
+    reps = [_FakeReplica(f"r{i}") for i in range(3)]
+    stats = {"queue_depth": 0, "queue_capacity": 8, "kv_utilization": 0.0}
+    try:
+        rids = [_route_register(cluster, rep.url, stats) for rep in reps]
+
+        def route_of(session):
+            r = cluster.http.post(cluster.url + "/v1/generate",
+                                  json={"session": session}, timeout=10)
+            assert r.status_code == 200, r.text
+            return r.headers["X-DTPU-Replica"]
+
+        # stickiness: the same key routes to the same replica every time
+        first = route_of("user-0")
+        assert all(route_of("user-0") == first for _ in range(4))
+
+        # find a key owned by a DIFFERENT replica (3 replicas x 40 vnodes:
+        # a handful of keys is plenty to land on two distinct owners)
+        other_key, other_rid = None, None
+        for i in range(1, 64):
+            rid = route_of(f"user-{i}")
+            if rid != first:
+                other_key, other_rid = f"user-{i}", rid
+                break
+        assert other_key is not None, "all keys hashed to one replica"
+
+        # kill the first key's replica (failed heartbeat -> immediate reap)
+        hb = cluster.http.post(
+            cluster.url + f"/api/v1/serving/replicas/{first}/heartbeat",
+            json={"stats": {"failed": "SIGKILL"}}, timeout=5,
+        )
+        assert hb.json().get("reaped") is True, hb.text
+        reps[rids.index(first)].close()
+
+        # the surviving key did not move...
+        assert route_of(other_key) == other_rid
+        # ...and the orphaned key re-pins consistently to one survivor
+        new_home = route_of("user-0")
+        assert new_home != first and new_home in rids
+        assert all(route_of("user-0") == new_home for _ in range(4))
+    finally:
+        for rep in reps:
+            rep.close()
+        cluster.stop()
+
+
+@pytest.mark.devcluster
+def test_route_503_when_fleet_saturated_or_empty(tmp_path):
+    """No replicas, or every replica at queue capacity, answers 503 with
+    Retry-After — the client backs off instead of queueing blind."""
+    cluster = _router_cluster(tmp_path)
+    rep = _FakeReplica("full")
+    try:
+        r = cluster.http.post(cluster.url + "/v1/generate", json={},
+                              timeout=10)
+        assert r.status_code == 503 and "Retry-After" in r.headers
+
+        _route_register(cluster, rep.url, {
+            "queue_depth": 8, "queue_capacity": 8, "kv_utilization": 0.5})
+        # saturated even for the sticky path: affinity yields to capacity
+        r = cluster.http.post(cluster.url + "/v1/generate",
+                              json={"session": "s"}, timeout=10)
+        assert r.status_code == 503 and "Retry-After" in r.headers
+        assert rep.hits == 0
+    finally:
+        rep.close()
+        cluster.stop()
+
+
+@pytest.mark.devcluster
+def test_route_fails_over_dead_and_shedding_replicas(tmp_path):
+    """The best-ranked replica being unreachable (crash window before the
+    reaper fires) or shedding 429 does not surface to the client: the
+    router walks down the candidate list and returns the first success."""
+    cluster = _router_cluster(tmp_path)
+    shedding, healthy = _FakeReplica("shed", status=429), _FakeReplica("ok")
+    try:
+        # ranked first (load 0) but the port is dead: connection refused
+        _route_register(cluster, "http://127.0.0.1:1/x", {
+            "queue_depth": 0, "queue_capacity": 8, "kv_utilization": 0.0})
+        # ranked second, answers 429
+        _route_register(cluster, shedding.url, {
+            "queue_depth": 1, "queue_capacity": 8, "kv_utilization": 0.0})
+        rid_ok = _route_register(cluster, healthy.url, {
+            "queue_depth": 2, "queue_capacity": 8, "kv_utilization": 0.0})
+        r = cluster.http.post(cluster.url + "/v1/generate", json={},
+                              timeout=15)
+        assert r.status_code == 200, r.text
+        assert r.json()["replica"] == "ok"
+        assert r.headers["X-DTPU-Replica"] == rid_ok
+        assert shedding.hits == 1 and healthy.hits == 1
+    finally:
+        shedding.close()
+        healthy.close()
         cluster.stop()

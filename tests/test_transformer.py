@@ -335,3 +335,104 @@ def test_decode_inactive_lanes_do_not_disturb_active(devices8):
     solo = run(1)
     mixed = run(4)
     np.testing.assert_allclose(mixed, solo, atol=1e-6, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# serving fast path: lazy chunked decode + suffix prefill (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("chunk_blocks", [1, 2, 4, 8], ids=lambda c: f"chunk{c}")
+def test_chunked_decode_matches_full_gather(chunk_blocks):
+    """The lazy decode (online-softmax over dynamic block-table slices)
+    equals the full-table gather step for step at f32 tolerance; the
+    scratch block is the only cache cell allowed to differ (inactive-lane
+    padding writes land there by design)."""
+    cfg, _model, variables = _tiny_lm(jnp.float32, n_kv_heads=2, seed=9)
+    params = variables["params"]
+    block_size = 4
+    prompt = [11, 4, 93, 7, 55, 21, 8]
+    table = np.arange(1, 9, dtype=np.int32)[None, :]  # 8 blocks = 32 tokens
+
+    def run(chunk):
+        cache = init_kv_cache(cfg, num_blocks=16, block_size=block_size)
+        padded = np.zeros((1, 8), np.int32)
+        padded[0, : len(prompt)] = prompt
+        logits_pf, cache = transformer_prefill(
+            cfg, params, padded, jnp.asarray([len(prompt)]), table, cache
+        )
+        tok = int(np.argmax(np.asarray(logits_pf[0, len(prompt) - 1])))
+        outs = []
+        for step in range(6):
+            pos = len(prompt) + step
+            logits, cache = transformer_decode(
+                cfg, params, jnp.asarray([tok], jnp.int32),
+                jnp.asarray([pos], jnp.int32), table, cache,
+                chunk_blocks=chunk,
+            )
+            outs.append(np.asarray(logits[0]))
+            tok = int(np.argmax(outs[-1]))
+        return outs, cache
+
+    full_outs, full_cache = run(0)
+    lazy_outs, lazy_cache = run(chunk_blocks)
+    for full, lazy in zip(full_outs, lazy_outs):
+        np.testing.assert_allclose(lazy, full, atol=2e-5, rtol=2e-4)
+    for full, lazy in zip(
+        jax.tree_util.tree_leaves(full_cache), jax.tree_util.tree_leaves(lazy_cache)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(lazy)[1:], np.asarray(full)[1:], atol=2e-5, rtol=2e-4
+        )
+
+
+def test_chunked_decode_rejects_nondivisor_chunk():
+    cfg, _model, variables = _tiny_lm(jnp.float32, n_kv_heads=2, seed=9)
+    cache = init_kv_cache(cfg, num_blocks=16, block_size=4)
+    table = np.arange(1, 9, dtype=np.int32)[None, :]
+    with pytest.raises(ValueError, match="chunk_blocks"):
+        transformer_decode(
+            cfg, variables["params"], jnp.asarray([1], jnp.int32),
+            jnp.asarray([0], jnp.int32), table, cache, chunk_blocks=3,
+        )
+
+
+def test_prefill_suffix_matches_wide_prefill():
+    """Cold suffix prefill (start=0) reproduces the wide padded prefill at
+    f32 tolerance, and a warm start over already-written prefix blocks is
+    BITWISE equal to the cold suffix run — both paths attend over the same
+    stored cache bits, so prefix-cached admission cannot drift."""
+    from determined_tpu.models.transformer import transformer_prefill_suffix
+
+    cfg, _model, variables = _tiny_lm(jnp.float32, n_kv_heads=2, seed=11)
+    params = variables["params"]
+    block_size = 4
+    prompt = list(range(30, 41))  # 11 tokens: 2 full blocks + partial tail
+    table = np.arange(1, 9, dtype=np.int32)[None, :]
+
+    padded16 = np.zeros((1, 16), np.int32)
+    padded16[0, : len(prompt)] = prompt
+    cache = init_kv_cache(cfg, num_blocks=16, block_size=block_size)
+    wide_logits, _wide_cache = transformer_prefill(
+        cfg, params, padded16, jnp.asarray([len(prompt)]), table, cache
+    )
+
+    padded12 = np.zeros((1, 12), np.int32)  # whole blocks only
+    padded12[0, : len(prompt)] = prompt
+    cache = init_kv_cache(cfg, num_blocks=16, block_size=block_size)
+    cold_logits, cold_cache = transformer_prefill_suffix(
+        cfg, params, padded12, jnp.asarray([0]), jnp.asarray([len(prompt)]),
+        table, cache,
+    )
+    np.testing.assert_allclose(
+        np.asarray(cold_logits[0]), np.asarray(wide_logits[0, len(prompt) - 1]),
+        atol=2e-5, rtol=2e-4,
+    )
+
+    # warm admission: the first 2 blocks already hold the prefix bits;
+    # re-run only the suffix (start=8) against the cold run's cache
+    warm_logits, _warm_cache = transformer_prefill_suffix(
+        cfg, params, padded12, jnp.asarray([8]), jnp.asarray([len(prompt)]),
+        table, cold_cache,
+    )
+    assert np.array_equal(np.asarray(warm_logits), np.asarray(cold_logits))
