@@ -74,6 +74,10 @@ def main() -> None:
     # pipe4 x data2 virtual mesh (tick model, 1f1b live-activation cap,
     # loss parity) — docs/performance.md "Pipeline schedules"
     _bench_hook("DTPU_BENCH_PIPE", "bench_step.py")
+    # multi-slice: flat all-reduce vs hierarchical ICI/DCN collectives
+    # on the 2-slice x 4-chip virtual mesh (fragment-only dcn payload,
+    # per-hop ledger, parity) — docs/performance.md "Multi-slice"
+    _bench_hook("DTPU_BENCH_MULTISLICE", "bench_step.py")
 
     import os
 

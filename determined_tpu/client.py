@@ -203,10 +203,23 @@ class Trial(_Resource):
             time.sleep(0.5)
 
     def list_checkpoints(self) -> List["Checkpoint"]:
+        # the master's listing iterates a uuid-keyed map (arbitrary order)
+        # and keeps gc'd records as state=DELETED tombstones: drop those
+        # and sort by steps_completed so [-1] is the newest checkpoint,
+        # which gc retention (save_trial_latest) guarantees is on disk
         cps = self._session.get("/api/v1/checkpoints").json()
-        return [
-            Checkpoint(self._session, c) for c in cps if c.get("trial_id") == self.id
+        mine = [
+            c
+            for c in cps
+            if c.get("trial_id") == self.id and c.get("state") != "DELETED"
         ]
+        mine.sort(
+            key=lambda c: (
+                (c.get("metadata") or {}).get("steps_completed") or 0,
+                c.get("uuid") or "",
+            )
+        )
+        return [Checkpoint(self._session, c) for c in mine]
 
 
 class Checkpoint(_Resource):

@@ -312,6 +312,16 @@ class OptimizationsConfig:
     # steps).  overlap_bucket_mb bounds one bucket's payload.
     overlap_grad_sync: bool = False
     overlap_bucket_mb: int = 4
+    # Hierarchical ICI/DCN collectives (train/_overlap.py, docs/
+    # performance.md "Multi-slice"): on a multi-slice mesh
+    # (resources.mesh.num_slices > 1) restructure each bucket's gradient
+    # sync into reduce-scatter over the intra-slice ICI axes, cross-slice
+    # all-reduce over ``dcn`` carrying only the 1/N_ici sharded fragment,
+    # and a closing all-gather within the slice — instead of the flat
+    # treatment that rings full-gradient payload across the slow DCN
+    # links.  Requires overlap_grad_sync (it reshapes the bucket sync
+    # shardings); inert on a single-slice mesh.
+    hierarchical_collectives: bool = False
     # Quantized matmul arithmetic (train/_quant.py): route the
     # transformer's dense/attention projection matmuls through int8 (or
     # fp8 where the platform supports it) with per-channel dynamic
@@ -371,6 +381,12 @@ class OptimizationsConfig:
                 f"optimizations.virtual_stages={self.virtual_stages} only "
                 "applies to pipeline_schedule: interleaved "
                 f"(got {self.pipeline_schedule!r})"
+            )
+        if self.hierarchical_collectives and not self.overlap_grad_sync:
+            raise InvalidExperimentConfig(
+                "optimizations.hierarchical_collectives requires "
+                "overlap_grad_sync: true (the two-level sync is expressed "
+                "through the bucketed sync shardings)"
             )
 
     @classmethod

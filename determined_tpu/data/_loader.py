@@ -172,7 +172,7 @@ class DataLoader:
 
 def batch_spec(mesh: Mesh, ndim: int) -> PartitionSpec:
     """PartitionSpec sharding dim 0 over every batch-carrying mesh axis."""
-    batch_axes = tuple(a for a in (MeshAxes.DATA, MeshAxes.FSDP) if mesh.shape.get(a, 1) > 1)
+    batch_axes = tuple(a for a in MeshAxes.BATCH_AXES if mesh.shape.get(a, 1) > 1)
     first = batch_axes if batch_axes else None
     return PartitionSpec(first, *([None] * (ndim - 1)))
 

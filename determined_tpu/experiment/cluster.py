@@ -302,11 +302,35 @@ class ClusterExperiment:
         if not pool_agents:
             return  # empty pool queues; a provisioner may add capacity
         biggest = max(int(a.get("slots", 0)) for a in pool_agents)
-        if slots > biggest:
+        # Mirror the master's topology-aware gate: hosts sharing a
+        # slice_id label form one ICI domain, so the gang may span hosts
+        # within the largest labeled slice.  Without labels, one host is
+        # the conservative capacity bound.
+        slice_slots: Dict[str, int] = {}
+        for a in pool_agents:
+            label = a.get("slice_id") or ""
+            if label:
+                slice_slots[label] = slice_slots.get(label, 0) + int(
+                    a.get("slots", 0)
+                )
+        if slice_slots:
+            biggest_slice, biggest_slice_slots = max(
+                slice_slots.items(), key=lambda kv: kv[1]
+            )
+            if slots > max(biggest, biggest_slice_slots):
+                raise InvalidExperimentConfig(
+                    f"resources.single_slice: the {slots}-slot gang does not "
+                    f"fit any slice in pool {pool!r} (largest slice "
+                    f"{biggest_slice!r}: {biggest_slice_slots} slots); "
+                    "a DCN-spanning split is forbidden by single_slice"
+                )
+        elif slots > biggest:
             raise InvalidExperimentConfig(
                 f"resources.single_slice: the {slots}-slot gang does not fit "
-                f"any host in pool {pool!r} (largest agent: {biggest} slots); "
-                "a DCN-spanning split is forbidden by single_slice"
+                f"any host in pool {pool!r} (largest agent: {biggest} slots), "
+                "and agents report no topology labels (agent --slice-id), so "
+                "single_slice is enforced per host; a DCN-spanning split is "
+                "forbidden by single_slice"
             )
 
     # -- trial watchers ----------------------------------------------------

@@ -202,6 +202,23 @@ def _comm_entry(
     }
     if "step.comm.bytes" in counters:
         entry["bytes"] = int(counters["step.comm.bytes"])
+    # per-hop (ICI vs DCN) sub-records from the link-aware comm model; a
+    # single-hop (pre-multi-slice) trace simply has no such counters
+    hops: Dict[str, Any] = {}
+    for hop in ("ici", "dcn"):
+        hop_exposed = counters.get(f"step.comm.{hop}.exposed_us")
+        if hop_exposed is None:
+            continue
+        hops[hop] = {
+            "exposed_s": round(hop_exposed / 1e6, 6),
+            "hidden_s": round(
+                counters.get(f"step.comm.{hop}.hidden_us", 0.0) / 1e6, 6
+            ),
+        }
+        if f"step.comm.{hop}.bytes" in counters:
+            hops[hop]["bytes"] = int(counters[f"step.comm.{hop}.bytes"])
+    if hops:
+        entry["hops"] = hops
     return entry
 
 
@@ -437,12 +454,21 @@ def _comm_line(c: Dict[str, Any]) -> str:
     """The "exposed comm" profile line (docs/performance.md): how much of
     the gradient-collective time sits on the critical path vs hides
     behind backward compute — the number the overlap_grad_sync knob
-    exists to shrink."""
-    return (
+    exists to shrink.  On a multi-slice trace the link-aware model adds
+    one sub-line per hop (ICI vs DCN), so a slow cross-slice hop is
+    visible instead of averaged into one number."""
+    line = (
         f"  exposed comm {c['exposed_s']:>10.3f}s "
         f"({c['exposed_pct_of_step']:.1f}% of step; "
         f"hidden {c['hidden_s']:.3f}s) [{c['model']}]"
     )
+    for hop, h in c.get("hops", {}).items():
+        size = f", {h['bytes'] / 1e9:.2f} GB" if "bytes" in h else ""
+        line += (
+            f"\n    {hop:<4} exposed {h['exposed_s']:>8.3f}s "
+            f"(hidden {h['hidden_s']:.3f}s{size})"
+        )
+    return line
 
 
 def _bubble_line(b: Dict[str, Any]) -> str:

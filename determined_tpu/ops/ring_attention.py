@@ -577,7 +577,7 @@ def ring_attention(
         return reference_attention(q, k, v, causal=causal, scale=scale)
 
     batch_axes = tuple(
-        a for a in (MeshAxes.DATA, MeshAxes.FSDP) if mesh.shape.get(a, 1) > 1
+        a for a in MeshAxes.BATCH_AXES if mesh.shape.get(a, 1) > 1
     )
     tensor_size = mesh.shape.get(MeshAxes.TENSOR, 1)
     head_axis = MeshAxes.TENSOR if tensor_size > 1 else None

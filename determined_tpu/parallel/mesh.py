@@ -25,6 +25,7 @@ from jax.sharding import Mesh
 class MeshAxes:
     """Canonical mesh-axis names used across the framework."""
 
+    DCN = "dcn"          # cross-slice data parallelism (slow DCN links)
     DATA = "data"        # pure data parallelism (gradients psum'd)
     FSDP = "fsdp"        # data parallelism with sharded params/opt-state
     TENSOR = "tensor"    # tensor (megatron-style) parallelism
@@ -32,9 +33,13 @@ class MeshAxes:
     EXPERT = "expert"    # MoE expert parallelism
     PIPELINE = "pipe"    # pipeline stages
 
-    ALL = (DATA, FSDP, TENSOR, SEQUENCE, EXPERT, PIPELINE)
+    ALL = (DCN, DATA, FSDP, TENSOR, SEQUENCE, EXPERT, PIPELINE)
     # Axes over which a batch is split (used to compute per-shard batch).
-    BATCH_AXES = (DATA, FSDP)
+    BATCH_AXES = (DCN, DATA, FSDP)
+    # Batch axes that stay within one slice (ICI-reachable); the hierarchical
+    # gradient sync reduce-scatters over these and crosses `dcn` with only
+    # the resulting 1/N_ici fragment.
+    ICI_BATCH_AXES = (DATA, FSDP)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,6 +51,11 @@ class MeshConfig:
     trial says ``MeshConfig(data=2, fsdp=2, tensor=2)``.
 
     A size of -1 for exactly one axis means "absorb all remaining devices".
+
+    ``num_slices`` > 1 adds an outer ``dcn`` mesh axis spanning TPU slices:
+    the batch additionally splits across slices, and the hierarchical
+    gradient sync (``optimizations.hierarchical_collectives``) keeps the
+    heavy reductions on ICI, crossing DCN with only sharded fragments.
     """
 
     data: int = 1
@@ -54,13 +64,15 @@ class MeshConfig:
     seq: int = 1
     expert: int = 1
     pipe: int = 1
+    num_slices: int = 1
 
     def sizes(self) -> Tuple[int, ...]:
+        """Per-slice (ICI) axis sizes; ``num_slices`` multiplies on top."""
         return (self.data, self.fsdp, self.tensor, self.seq, self.expert, self.pipe)
 
     @property
     def num_devices(self) -> int:
-        n = 1
+        n = max(1, self.num_slices)
         for s in self.sizes():
             if s != -1:
                 n *= s
@@ -68,22 +80,24 @@ class MeshConfig:
 
     def resolve(self, total_devices: int) -> "MeshConfig":
         """Fill in a single -1 axis from the total device count."""
+        if self.num_slices < 1:
+            raise ValueError("num_slices must be >= 1 (it is never a wildcard)")
         sizes = list(self.sizes())
         wild = [i for i, s in enumerate(sizes) if s == -1]
         if len(wild) > 1:
             raise ValueError("at most one mesh axis may be -1")
         if wild:
-            fixed = math.prod(s for s in sizes if s != -1)
+            fixed = self.num_slices * math.prod(s for s in sizes if s != -1)
             if total_devices % fixed:
                 raise ValueError(
                     f"{total_devices} devices not divisible by fixed axes {fixed}"
                 )
             sizes[wild[0]] = total_devices // fixed
-        resolved = MeshConfig(*sizes)
+        resolved = MeshConfig(*sizes, num_slices=self.num_slices)
         if resolved.num_devices != total_devices:
             raise ValueError(
-                f"mesh {resolved.sizes()} needs {resolved.num_devices} devices, "
-                f"got {total_devices}"
+                f"mesh {resolved.sizes()} x {resolved.num_slices} slice(s) needs "
+                f"{resolved.num_devices} devices, got {total_devices}"
             )
         return resolved
 
@@ -110,16 +124,52 @@ def _mesh_device_array(devices: Sequence[jax.Device], shape: Tuple[int, ...]) ->
     return devs.reshape(shape)
 
 
+def _slice_major_order(
+    devices: Sequence[jax.Device], num_slices: int, per_slice: int
+) -> list:
+    """Order devices slice-major so the outer ``dcn`` axis maps to slices.
+
+    Real multislice TPU devices carry a ``slice_index`` attribute; group by
+    it so every chip along the dcn axis really sits behind a DCN link.  On
+    CPU (no slice_index) contiguous equal blocks of the default order
+    emulate virtual slices — good enough for numerics/HLO tests, exactly
+    like ``make_virtual_mesh`` emulates a multi-chip slice.
+    """
+    by_slice: dict = {}
+    for d in devices:
+        idx = getattr(d, "slice_index", None)
+        if idx is None:
+            by_slice = {}
+            break
+        by_slice.setdefault(idx, []).append(d)
+    if len(by_slice) >= num_slices:
+        chosen = sorted(by_slice)[:num_slices]
+        if all(len(by_slice[s]) >= per_slice for s in chosen):
+            out: list = []
+            for s in chosen:
+                out.extend(by_slice[s][:per_slice])
+            return out
+        raise ValueError(
+            f"mesh wants {per_slice} devices per slice x {num_slices} slices, "
+            f"but slice sizes are { {s: len(v) for s, v in by_slice.items()} }"
+        )
+    # virtual-slice emulation: contiguous blocks
+    return list(devices[: num_slices * per_slice])
+
+
 def make_mesh(
     config: MeshConfig,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Build a ``jax.sharding.Mesh`` from a MeshConfig.
 
-    Mesh axis order: (pipe, data, fsdp, expert, seq, tensor) — outermost
-    axes communicate least (pipeline p2p, DP gradient psum once per step),
-    innermost communicate most (TP collectives inside every layer), so the
-    innermost axes land on contiguous ICI neighbors.
+    Mesh axis order: (dcn, pipe, data, fsdp, expert, seq, tensor) —
+    outermost axes communicate least (cross-slice DCN hops, pipeline p2p,
+    DP gradient psum once per step), innermost communicate most (TP
+    collectives inside every layer), so the innermost axes land on
+    contiguous ICI neighbors.  ``dcn`` is always present (size 1 on a
+    single slice); size-1 axes are dropped by the sharding rules, so
+    single-slice behavior is unchanged.
     """
     devices = list(devices if devices is not None else jax.devices())
     config = config.resolve(len(devices)) if -1 in config.sizes() else config
@@ -127,8 +177,21 @@ def make_mesh(
         raise ValueError(
             f"MeshConfig wants {config.num_devices} devices, only {len(devices)} present"
         )
-    shape = (config.pipe, config.data, config.fsdp, config.expert, config.seq, config.tensor)
+    num_slices = max(1, config.num_slices)
+    per_slice = config.num_devices // num_slices
+    if num_slices > 1:
+        devices = _slice_major_order(devices, num_slices, per_slice)
+    shape = (
+        num_slices,
+        config.pipe,
+        config.data,
+        config.fsdp,
+        config.expert,
+        config.seq,
+        config.tensor,
+    )
     axis_names = (
+        MeshAxes.DCN,
         MeshAxes.PIPELINE,
         MeshAxes.DATA,
         MeshAxes.FSDP,

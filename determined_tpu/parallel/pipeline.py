@@ -540,7 +540,7 @@ def pipeline_apply(
     mb = batch // num_microbatches
     xm = x.reshape(num_microbatches, mb, *x.shape[1:])
     bshards = 1
-    for a in (MeshAxes.DATA, MeshAxes.FSDP):
+    for a in MeshAxes.BATCH_AXES:
         bshards *= mesh.shape.get(a, 1)
 
     from determined_tpu.parallel._compat import shard_map
@@ -564,7 +564,7 @@ def pipeline_apply(
     # silently all-gathered away by a replicated in_spec; microbatches too
     # small to split fall back to replication (still correct, no speedup)
     batch_axes = tuple(
-        a for a in (MeshAxes.DATA, MeshAxes.FSDP) if mesh.shape.get(a, 1) > 1
+        a for a in MeshAxes.BATCH_AXES if mesh.shape.get(a, 1) > 1
     )
     if mb % bshards:
         batch_axes = ()

@@ -26,7 +26,7 @@ LogicalAxisRules = Dict[str, Union[str, Tuple[str, ...], None]]
 # Default rules: batch over (data, fsdp); params sharded over fsdp on their
 # largest dim; tensor-parallel on heads/mlp; sequence activations over seq.
 DEFAULT_RULES: LogicalAxisRules = {
-    "batch": (MeshAxes.DATA, MeshAxes.FSDP),
+    "batch": (MeshAxes.DCN, MeshAxes.DATA, MeshAxes.FSDP),
     "length": MeshAxes.SEQUENCE,
     "embed": None,
     "mlp": MeshAxes.TENSOR,

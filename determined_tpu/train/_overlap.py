@@ -64,6 +64,10 @@ from determined_tpu.parallel.sharding import grad_sync_spec
 #: axes a gradient reduction runs over: every batch-carrying axis
 SYNC_AXES = MeshAxes.BATCH_AXES
 
+#: batch axes reachable over ICI (within one slice) — the hierarchical
+#: sync reduce-scatters over these and crosses ``dcn`` with the fragment
+ICI_SYNC_AXES = MeshAxes.ICI_BATCH_AXES
+
 #: leaves below this ride the final all-reduce: a reduce-scatter of a few
 #: KiB is pure launch overhead (norm scales, biases)
 _MIN_SYNC_BYTES = 64 * 1024
@@ -82,41 +86,135 @@ ICI_BW_BY_KIND = {
 }
 _DEFAULT_BW = 10e9  # unknown chip (CPU virtual mesh): placeholder, labeled
 
+# Per-chip cross-slice (DCN) bandwidth: host NIC share per chip.  Order of
+# magnitude below ICI — which is the whole point of the hierarchical sync.
+DCN_BW_BY_KIND = {
+    "TPU v4": 6.25e9,       # ~200 Gb/s host NIC / 4 chips
+    "TPU v5 lite": 6.25e9,
+    "TPU v5p": 12.5e9,      # ~400 Gb/s host NIC / 4 chips
+    "TPU v5": 12.5e9,
+    "TPU v6 lite": 12.5e9,
+    "TPU v6e": 12.5e9,
+}
+_DEFAULT_DCN_BW = 1e9  # unknown chip (CPU virtual mesh): placeholder, labeled
+
+
+def _parse_bw_env(raw: str) -> Dict[str, float]:
+    """Parse ``DTPU_COMM_BW_GBPS``: either a single number (every link,
+    back-compat) or the per-link form ``ici:90,dcn:12``.  Values are GB/s;
+    garbage raises at parse time instead of silently mis-modeling comm."""
+    parts = [p.strip() for p in raw.split(",") if p.strip()]
+    if not parts:
+        raise ValueError("DTPU_COMM_BW_GBPS is set but empty")
+    out: Dict[str, float] = {}
+    if len(parts) == 1 and ":" not in parts[0]:
+        try:
+            v = float(parts[0])
+        except ValueError:
+            raise ValueError(
+                f"DTPU_COMM_BW_GBPS={raw!r}: expected a number (GB/s) or "
+                "per-link 'ici:90,dcn:12'"
+            ) from None
+        if v <= 0:
+            raise ValueError(f"DTPU_COMM_BW_GBPS={raw!r}: bandwidth must be > 0")
+        return {"ici": v * 1e9, "dcn": v * 1e9}
+    for part in parts:
+        link, sep, val = part.partition(":")
+        link = link.strip().lower()
+        if not sep or link not in ("ici", "dcn"):
+            raise ValueError(
+                f"DTPU_COMM_BW_GBPS={raw!r}: bad entry {part!r} "
+                "(expected 'ici:<GB/s>' or 'dcn:<GB/s>')"
+            )
+        if link in out:
+            raise ValueError(f"DTPU_COMM_BW_GBPS={raw!r}: duplicate link {link!r}")
+        try:
+            v = float(val)
+        except ValueError:
+            raise ValueError(
+                f"DTPU_COMM_BW_GBPS={raw!r}: {val!r} is not a number (GB/s)"
+            ) from None
+        if v <= 0:
+            raise ValueError(f"DTPU_COMM_BW_GBPS={raw!r}: bandwidth must be > 0")
+        out[link] = v * 1e9
+    return out
+
+
+def _table_bw(device_kind: str, table: Dict[str, float], default: float) -> float:
+    for prefix in sorted(table, key=len, reverse=True):
+        if device_kind.startswith(prefix):
+            return table[prefix]
+    return default
+
+
+def link_bandwidths(device_kind: str) -> Tuple[float, float]:
+    """(ici_bw, dcn_bw) in bytes/s for the comm model, env-overridable."""
+    env = os.environ.get("DTPU_COMM_BW_GBPS")
+    override = _parse_bw_env(env) if env else {}
+    ici = override.get("ici") or _table_bw(device_kind, ICI_BW_BY_KIND, _DEFAULT_BW)
+    dcn = override.get("dcn") or _table_bw(device_kind, DCN_BW_BY_KIND, _DEFAULT_DCN_BW)
+    return ici, dcn
+
 
 def _chip_bw(device_kind: str) -> float:
-    env = os.environ.get("DTPU_COMM_BW_GBPS")
-    if env:
-        return float(env) * 1e9
-    for prefix in sorted(ICI_BW_BY_KIND, key=len, reverse=True):
-        if device_kind.startswith(prefix):
-            return ICI_BW_BY_KIND[prefix]
-    return _DEFAULT_BW
+    """ICI bandwidth only (back-compat shim for older callers)."""
+    return link_bandwidths(device_kind)[0]
 
 
 @dataclasses.dataclass(frozen=True)
 class CommModel:
-    """Bucket-schedule exposure model for the ``step.comm`` ledger rows."""
+    """Bucket-schedule exposure model for the ``step.comm`` ledger rows.
 
-    bytes_per_step: int      # RS+AG (or AR) payload bytes, ring-counted
+    Link-aware since the multi-slice PR: the intra-slice (ICI) and the
+    cross-slice (DCN) hop carry different payloads over bandwidths an
+    order of magnitude apart, so the ledger models them separately.  A
+    single-slice mesh has ``dcn_bytes == 0`` and collapses to the old
+    one-hop model.
+    """
+
+    bytes_per_step: int      # ICI RS+AG (or AR) payload bytes, ring-counted
     n_buckets: int           # 1 = baseline end-of-backward reduction
-    bandwidth: float         # bytes/s
+    bandwidth: float         # ICI bytes/s
     bwd_frac: float = 0.6    # share of a step that is backward compute
+    dcn_bytes_per_step: int = 0   # cross-slice hop payload bytes
+    dcn_bandwidth: float = _DEFAULT_DCN_BW
+
+    def split_hops(self, avg_step_s: float) -> Dict[str, Tuple[float, float]]:
+        """Per-hop ``{hop: (exposed_s, hidden_s)}`` under the bucket
+        schedule.
+
+        Baseline (one bucket): everything is exposed — backward is already
+        finished when the reduction runs.  Overlapped (B buckets): bucket
+        k's collective can hide behind buckets k+1..B's backward compute,
+        so up to (B-1)/B of each hop hides, bounded by the backward time
+        actually available.  The DCN hop is issued earliest in backward
+        (it is the slowest link with the longest tail to hide behind), so
+        it gets first claim on the hiding budget.
+        """
+        ici_s = self.bytes_per_step / max(self.bandwidth, 1.0)
+        dcn_s = self.dcn_bytes_per_step / max(self.dcn_bandwidth, 1.0)
+        if self.n_buckets <= 1:
+            return {"ici": (ici_s, 0.0), "dcn": (dcn_s, 0.0)}
+        frac = (self.n_buckets - 1) / self.n_buckets
+        budget = max(avg_step_s, 0.0) * self.bwd_frac
+        out: Dict[str, Tuple[float, float]] = {}
+        for hop, comm_s in (("dcn", dcn_s), ("ici", ici_s)):
+            hidden = min(comm_s * frac, budget)
+            budget -= hidden
+            out[hop] = (comm_s - hidden, hidden)
+        return out
 
     def split(self, avg_step_s: float) -> Tuple[float, float]:
-        """(exposed_s, hidden_s) per step under the bucket schedule.
+        """(exposed_s, hidden_s) per step, summed over both hops."""
+        hops = self.split_hops(avg_step_s)
+        return (
+            sum(e for e, _ in hops.values()),
+            sum(h for _, h in hops.values()),
+        )
 
-        Baseline (one bucket): the whole reduction is exposed — backward
-        is already finished when it runs.  Overlapped (B buckets): bucket
-        k's collective can hide behind buckets k+1..B's backward compute,
-        so up to (B-1)/B of the comm hides, bounded by the backward time
-        actually available.
-        """
-        comm_s = self.bytes_per_step / max(self.bandwidth, 1.0)
-        if self.n_buckets <= 1:
-            return comm_s, 0.0
-        hideable = comm_s * (self.n_buckets - 1) / self.n_buckets
-        hidden = min(hideable, max(avg_step_s, 0.0) * self.bwd_frac)
-        return comm_s - hidden, hidden
+    @property
+    def total_bytes_per_step(self) -> int:
+        return self.bytes_per_step + self.dcn_bytes_per_step
 
 
 def _make_bucket_marker(shardings: Tuple[Optional[NamedSharding], ...]):
@@ -157,6 +255,9 @@ class GradSyncPlan:
     buckets: List[Tuple[int, ...]]                 # leaf indices per bucket
     comm: CommModel
     synced_leaves: int
+    # hierarchical two-level sync: grads reduce-scatter over ICI axes only
+    # and cross `dcn` as the 1/N_ici fragment (0 = flat treatment)
+    hierarchical_dcn: int = 0
     _markers: List[Any] = dataclasses.field(default_factory=list)
     _shape_map: Dict[Tuple[int, ...], NamedSharding] = dataclasses.field(
         default_factory=dict
@@ -236,10 +337,13 @@ class GradSyncPlan:
     def fingerprint(self) -> str:
         """Key material for the jit-reuse cache: anything that changes the
         traced collective structure."""
+        if not self.enabled:
+            return "overlap:off"
+        hier = (
+            f":hier=dcn{self.hierarchical_dcn}" if self.hierarchical_dcn > 1 else ":flat"
+        )
         return (
-            f"overlap:on:buckets={len(self.buckets)}:synced={self.synced_leaves}"
-            if self.enabled
-            else "overlap:off"
+            f"overlap:on:buckets={len(self.buckets)}:synced={self.synced_leaves}{hier}"
         )
 
 
@@ -258,13 +362,28 @@ def build_plan(
     enabled: bool,
     bucket_bytes: int = 4 * 1024 * 1024,
     min_sync_bytes: int = _MIN_SYNC_BYTES,
+    hierarchical: bool = False,
 ) -> Optional[GradSyncPlan]:
     """Plan the overlapped sync for one param tree; None when the mesh has
     no gradient-reduction axes (nothing to sync — single device or pure
-    model parallelism)."""
+    model parallelism).
+
+    ``hierarchical`` (``optimizations.hierarchical_collectives``) switches
+    a multi-slice mesh to the two-level scheme: per-bucket reduce-scatter
+    over the intra-slice ICI axes only, leaving ``dcn`` replicated — XLA
+    then closes the reduction with a cross-slice all-reduce carrying only
+    the 1/N_ici sharded fragment, and the param restore all-gathers within
+    the slice.  Flat treatment instead shards over every batch axis, which
+    rings full-gradient-scale payload across the slow DCN links.
+    """
     n_sync = sync_axis_size(mesh)
     if n_sync <= 1:
         return None
+
+    n_dcn = mesh.shape.get(MeshAxes.DCN, 1)
+    n_ici = max(1, n_sync // max(1, n_dcn))
+    hier = bool(hierarchical) and n_dcn > 1 and n_ici > 1
+    sync_axes = ICI_SYNC_AXES if hier else SYNC_AXES
 
     leaves, treedef = jax.tree.flatten(abstract_params)
     shard_leaves = jax.tree.leaves(param_shardings)
@@ -277,17 +396,25 @@ def build_plan(
     import math
 
     sync_shardings: List[Optional[NamedSharding]] = []
-    ring_bytes = 0
+    ici_bytes = 0
+    dcn_bytes = 0
     grad_itemsize = 4  # grads reduce in f32
     for aval, psh in zip(leaves, shard_leaves):
         shape = tuple(getattr(aval, "shape", ()))
         nbytes = math.prod(shape) * grad_itemsize
-        # ring all-reduce and RS+AG move the same 2*(n-1)/n of the payload
-        ring_bytes += int(2 * (n_sync - 1) / n_sync * nbytes)
+        # per-hop ring accounting: RS+AG within the slice moves
+        # 2*(n_ici-1)/n_ici of the payload over ICI; the cross-slice hop
+        # rings 2*(n_dcn-1)/n_dcn of the payload over DCN — the FULL
+        # payload under flat treatment, only the 1/n_ici fragment under
+        # the hierarchical scheme.
+        ici_bytes += int(2 * (n_ici - 1) / n_ici * nbytes)
+        if n_dcn > 1:
+            dcn_payload = nbytes // n_ici if hier else nbytes
+            dcn_bytes += int(2 * (n_dcn - 1) / n_dcn * dcn_payload)
         spec = None
         if enabled and nbytes >= min_sync_bytes:
             spec = grad_sync_spec(
-                shape, getattr(psh, "spec", PartitionSpec()), mesh, SYNC_AXES
+                shape, getattr(psh, "spec", PartitionSpec()), mesh, sync_axes
             )
         sync_shardings.append(
             NamedSharding(mesh, spec) if spec is not None else None
@@ -315,10 +442,13 @@ def build_plan(
         buckets.append(tuple(cur))
 
     dev = mesh.devices.flat[0]
+    ici_bw, dcn_bw = link_bandwidths(getattr(dev, "device_kind", ""))
     comm = CommModel(
-        bytes_per_step=ring_bytes,
+        bytes_per_step=ici_bytes,
         n_buckets=len(buckets) if enabled else 1,
-        bandwidth=_chip_bw(getattr(dev, "device_kind", "")),
+        bandwidth=ici_bw,
+        dcn_bytes_per_step=dcn_bytes,
+        dcn_bandwidth=dcn_bw,
     )
     plan = GradSyncPlan(
         mesh=mesh,
@@ -329,6 +459,7 @@ def build_plan(
         buckets=buckets,
         comm=comm,
         synced_leaves=sum(1 for s in sync_shardings if s is not None),
+        hierarchical_dcn=n_dcn if hier else 0,
         _leaf_shapes=[tuple(getattr(l, "shape", ())) for l in leaves],
     )
     return plan

@@ -323,6 +323,7 @@ class Trainer:
             self.mesh,
             enabled=bool(opt is not None and opt.overlap_grad_sync),
             bucket_bytes=(opt.overlap_bucket_mb if opt else 4) * 1024 * 1024,
+            hierarchical=bool(opt is not None and opt.hierarchical_collectives),
         )
         self._comm_model = (
             self._overlap_plan.comm if self._overlap_plan is not None else None
@@ -1223,16 +1224,33 @@ class Trainer:
                         # the bucket-schedule model against the segment's
                         # average step time (counters, not spans — they
                         # must not perturb the span-nesting attribution)
-                        exposed_s, hidden_s = self._comm_model.split(
+                        hops = self._comm_model.split_hops(
                             hot_time / steps_since_report
                         )
                         n = float(steps_since_report)
                         tracer.counter(
                             "step.comm.bytes",
-                            float(self._comm_model.bytes_per_step) * n,
+                            float(self._comm_model.total_bytes_per_step) * n,
                         )
+                        exposed_s = sum(e for e, _ in hops.values())
+                        hidden_s = sum(h for _, h in hops.values())
                         tracer.counter("step.comm.exposed_us", exposed_s * 1e6 * n)
                         tracer.counter("step.comm.hidden_us", hidden_s * 1e6 * n)
+                        # per-hop rows: the DCN hop only exists on a
+                        # multi-slice mesh; zero rows are suppressed so
+                        # single-slice ledgers look exactly as before
+                        hop_bytes = {
+                            "ici": self._comm_model.bytes_per_step,
+                            "dcn": self._comm_model.dcn_bytes_per_step,
+                        }
+                        for hop, (he, hh) in hops.items():
+                            if not hop_bytes[hop]:
+                                continue
+                            tracer.counter(
+                                f"step.comm.{hop}.bytes", float(hop_bytes[hop]) * n
+                            )
+                            tracer.counter(f"step.comm.{hop}.exposed_us", he * 1e6 * n)
+                            tracer.counter(f"step.comm.{hop}.hidden_us", hh * 1e6 * n)
                     if self._bubble_model is not None:
                         # step.bubble ledger rows: pipe-axis idle time per
                         # the schedule's analytic tick model applied to

@@ -41,6 +41,11 @@ struct Options {
   std::string pool = "default";
   int slots = 1;
   std::string slot_type = "cpu";  // tpu when /dev/accel*/vfio chips found
+  // Topology label: agents sharing a slice_id are ICI-reachable; crossing
+  // labels means DCN.  On real TPU VMs this is the multislice slice name
+  // (MEGASCALE_SLICE_ID); empty = unlabeled, master falls back to
+  // one-host-per-slice placement.
+  std::string slice_id;
   std::string python = "python";
   std::string user = "determined";
   std::string password;
@@ -140,6 +145,7 @@ class Agent {
     body.set("pool", opts_.pool);
     body.set("slots", Json(opts_.slots));
     body.set("slot_type", opts_.slot_type);
+    if (!opts_.slice_id.empty()) body.set("slice_id", opts_.slice_id);
     // Re-attach handshake (master crash-safe restart): report the
     // allocations whose processes are STILL running under this agent.  A
     // restarted master matches these against its journaled placements and
@@ -503,6 +509,7 @@ int main(int argc, char** argv) {
     else if (arg == "--id") opts.id = next("--id");
     else if (arg == "--host") opts.advertised_host = next("--host");
     else if (arg == "--pool") opts.pool = next("--pool");
+    else if (arg == "--slice-id") opts.slice_id = next("--slice-id");
     else if (arg == "--slots") opts.slots = std::atoi(next("--slots").c_str());
     else if (arg == "--python") opts.python = next("--python");
     else if (arg == "--user") opts.user = next("--user");

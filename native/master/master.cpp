@@ -63,6 +63,11 @@ struct AgentState {
   std::string host;
   std::string pool = "default";  // resource pool membership
   std::string slot_type = "cpu";  // tpu on real TPU VMs (agent-detected)
+  // topology label: which TPU slice this agent's chips belong to (agent
+  // --slice-id / TPU metadata).  Agents sharing a slice_id are
+  // ICI-reachable; crossing labels means DCN.  Empty = unlabeled (the
+  // pre-multi-slice world: every agent is its own island).
+  std::string slice_id;
   int slots = 0;
   int used_slots = 0;
   int64_t last_seen_ms = 0;
@@ -648,6 +653,12 @@ class Master {
   void set_scheduler(const std::string& mode) { scheduler_mode_ = mode; }
   void set_reattach_grace_ms(int64_t ms) { reattach_grace_ms_ = ms; }
   void set_journal_fsync(bool on) { journal_fsync_ = on; }
+  void set_journal_group_commit(int64_t threshold_us, int max_pending = 32) {
+    journal_.set_group_commit(threshold_us, max_pending);
+  }
+  // Tick-time bound on the group-commit durability window: sync any
+  // deferred appends even when ingest has gone quiet.  Caller holds mu_.
+  void flush_journal() { journal_.flush(); }
 
   // Deterministic state digest for the offline `--dump-state` mode: the
   // torn-tail fuzz harness boots the master at every truncation offset and
@@ -709,6 +720,11 @@ class Master {
     Json models = Json::array();
     for (const auto& [name, model] : models_) models.push_back(model);
     out.set("models", models);
+    // agent topology labels: journaled (agent_topology), so a torn label
+    // record shifts the digest; std::map iteration keeps this deterministic
+    Json topo = Json::object();
+    for (const auto& [agent, slice] : agent_topology_) topo.set(agent, slice);
+    out.set("agent_topology", topo);
     // fleet spec + deploy walk state: journaled (fleet_spec,
     // deploy_started/advanced/completed/failed), so a torn deploy record
     // must shift this digest exactly like a torn model_version does.
@@ -1897,6 +1913,10 @@ class Master {
       if (it != trials_.end()) {
         it->second.latest_checkpoint = ev["uuid"].as_string();
       }
+    } else if (type == "agent_topology") {
+      // Topology labels survive restart separately from live agents_ —
+      // replay must not fabricate schedulable agents out of labels.
+      agent_topology_[ev["agent"].as_string()] = ev["slice"].as_string();
     } else if (type == "alloc_placed") {
       // gang placement is durable so a restarted master can re-adopt the
       // still-running processes instead of forgetting them (boot() holds
@@ -2215,6 +2235,9 @@ class Master {
     Json models = Json::object();
     for (const auto& [name, model] : models_) models.set(name, model);
     snap.set("models", models);
+    Json topo = Json::object();
+    for (const auto& [agent, slice] : agent_topology_) topo.set(agent, slice);
+    snap.set("agent_topology", topo);
     Json templates = Json::object();
     for (const auto& [name, cfg] : templates_) templates.set(name, cfg);
     snap.set("templates", templates);
@@ -2421,6 +2444,11 @@ class Master {
       }
     }
     for (const auto& [name, model] : s["models"].items()) models_[name] = model;
+    if (s.contains("agent_topology")) {
+      for (const auto& [agent, slice] : s["agent_topology"].items()) {
+        agent_topology_[agent] = slice.as_string();
+      }
+    }
     if (s.contains("templates")) {
       for (const auto& [name, cfg] : s["templates"].items()) templates_[name] = cfg;
     }
@@ -3201,11 +3229,14 @@ class Master {
   // ---- scheduler (priority FIFO + gang fitting) --------------------------
 
   // Gang fitting for TPU topology (reference fitting.go, redesigned):
-  // slots on ONE agent are an ICI-connected slice, so a single-agent
-  // best-fit (fewest leftover slots) is always preferred; spanning agents
-  // means the gang's collectives ride DCN, allowed only when the trial
-  // does not require a single slice, splitting over the fewest agents
-  // (largest-free first).  ``extra_free`` overlays hypothetical capacity
+  // slots on ONE agent are ICI-connected, so a single-agent best-fit
+  // (fewest leftover slots) is always preferred.  When agents carry
+  // slice_id topology labels, hosts sharing a label form one ICI domain:
+  // the next preference is the best-fitting single slice (gang spans
+  // hosts but stays on ICI), and only then — and only for trials that do
+  // not require a single slice — does the gang spill across slices onto
+  // DCN, splitting over the fewest agents (largest-free first).
+  // ``extra_free`` overlays hypothetical capacity
   // (slots of preemption victims that have not exited yet) so preemption
   // decisions can test feasibility without mutating agent state.
   std::vector<std::pair<std::string, int>> find_fit(
@@ -3218,6 +3249,26 @@ class Master {
       if (it != extra_free.end()) extra = it->second;
       return ag.slots - ag.used_slots + extra;
     };
+    auto span_largest_free_first =
+        [&](std::vector<AgentState*> pool_agents)
+        -> std::vector<std::pair<std::string, int>> {
+      std::sort(pool_agents.begin(), pool_agents.end(),
+                [&](AgentState* a, AgentState* b) {
+                  return free_of(*a) > free_of(*b);
+                });
+      int remaining = needed;
+      std::vector<std::pair<std::string, int>> groups;
+      for (auto* ag : pool_agents) {
+        int free = free_of(*ag);
+        if (free <= 0) continue;
+        int take = std::min(free, remaining);
+        groups.push_back({ag->id, take});
+        remaining -= take;
+        if (remaining == 0) break;
+      }
+      if (remaining > 0) return {};
+      return groups;
+    };
     AgentState* best = nullptr;
     for (auto& [aid, ag] : agents_) {
       if (ag.pool != pool || excluded.count(aid) || ag.draining) continue;
@@ -3227,27 +3278,41 @@ class Master {
       }
     }
     if (best != nullptr) return {{best->id, needed}};
-    if (single_slice) return {};
-    int remaining = needed;
-    std::vector<AgentState*> by_free;
+    // Slice-aligned fit: agents sharing a slice_id label are ICI-reachable,
+    // so a gang spanning hosts WITHIN one slice still avoids DCN.  Prefer
+    // the slice with the fewest leftover free slots (best fit) before any
+    // cross-slice spill; single_slice gangs may span hosts inside one
+    // labeled slice but never cross labels (unlabeled agents keep the
+    // conservative one-agent-only interpretation).
+    std::map<std::string, std::vector<AgentState*>> by_slice;
     for (auto& [aid, ag] : agents_) {
-      if (ag.pool == pool && !excluded.count(aid) && !ag.draining) {
-        by_free.push_back(&ag);
+      if (ag.pool != pool || excluded.count(aid) || ag.draining) continue;
+      if (!ag.slice_id.empty()) by_slice[ag.slice_id].push_back(&ag);
+    }
+    const std::vector<AgentState*>* best_slice = nullptr;
+    int best_leftover = 0;
+    for (const auto& [slice, members] : by_slice) {
+      int slice_free = 0;
+      for (auto* ag : members) slice_free += std::max(0, free_of(*ag));
+      if (slice_free < needed) continue;
+      int leftover = slice_free - needed;
+      if (best_slice == nullptr || leftover < best_leftover) {
+        best_slice = &members;
+        best_leftover = leftover;
       }
     }
-    std::sort(by_free.begin(), by_free.end(),
-              [&](AgentState* a, AgentState* b) { return free_of(*a) > free_of(*b); });
-    std::vector<std::pair<std::string, int>> groups;
-    for (auto* ag : by_free) {
-      int free = free_of(*ag);
-      if (free <= 0) continue;
-      int take = std::min(free, remaining);
-      groups.push_back({ag->id, take});
-      remaining -= take;
-      if (remaining == 0) break;
+    if (best_slice != nullptr) {
+      auto groups = span_largest_free_first(*best_slice);
+      if (!groups.empty()) return groups;
     }
-    if (remaining > 0) return {};
-    return groups;
+    if (single_slice) return {};
+    std::vector<AgentState*> all;
+    for (auto& [aid, ag] : agents_) {
+      if (ag.pool == pool && !excluded.count(aid) && !ag.draining) {
+        all.push_back(&ag);
+      }
+    }
+    return span_largest_free_first(std::move(all));
   }
 
   // Priority scheduler with preemption (reference priority.go:18-359,
@@ -3982,16 +4047,45 @@ class Master {
     }
     int max_host_slots = 0;
     bool any_agent = false;
+    bool any_labeled = false;
+    std::map<std::string, int> slice_slots;
     for (const auto& [aid, ag] : agents_) {
       if (ag.pool != pool_name || ag.draining) continue;
       any_agent = true;
       max_host_slots = std::max(max_host_slots, ag.slots);
+      if (!ag.slice_id.empty()) {
+        any_labeled = true;
+        slice_slots[ag.slice_id] += ag.slots;
+      }
     }
-    if (any_agent && slots > max_host_slots) {
+    if (!any_agent) return "";
+    if (any_labeled) {
+      // With topology labels a single_slice gang may span hosts that
+      // share a slice_id; capacity is the largest labeled slice.
+      std::string max_slice;
+      int max_slice_slots = 0;
+      for (const auto& [slice, total] : slice_slots) {
+        if (total > max_slice_slots) {
+          max_slice_slots = total;
+          max_slice = slice;
+        }
+      }
+      if (slots > std::max(max_host_slots, max_slice_slots)) {
+        return "resources.single_slice: no slice in pool " + pool_name +
+               " has " + std::to_string(slots) + " slots (largest slice " +
+               max_slice + ": " + std::to_string(max_slice_slots) +
+               "); the gang would need a DCN-spanning split, which "
+               "single_slice forbids";
+      }
+      return "";
+    }
+    if (slots > max_host_slots) {
       return "resources.single_slice: no host in pool " + pool_name +
              " has " + std::to_string(slots) + " slots (largest agent: " +
-             std::to_string(max_host_slots) + "); the gang would need a "
-             "DCN-spanning split, which single_slice forbids";
+             std::to_string(max_host_slots) + "), and agents report no "
+             "topology labels (agent --slice-id), so single_slice is "
+             "enforced per host; the gang would need a DCN-spanning "
+             "split, which single_slice forbids";
     }
     return "";
   }
@@ -4725,6 +4819,12 @@ class Master {
   std::map<int64_t, TrialState> trials_;
   std::map<std::string, AllocationState> allocations_;
   std::map<std::string, AgentState> agents_;
+  // agent id -> slice label, journaled (agent_topology events) and carried
+  // by snapshots: a restarted master keeps its topology picture for gang
+  // fitting even before every agent re-registers.  Kept separate from
+  // agents_ (which is live-only state rebuilt from registration) so replay
+  // never fabricates phantom schedulable agents.
+  std::map<std::string, std::string> agent_topology_;
   std::map<std::string, Json> checkpoints_;
   std::map<std::string, UserState> users_;
   std::map<std::string, TokenInfo> tokens_;
@@ -5058,6 +5158,14 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         << "dtpu_journal_append_us_max " << m.journal_.max_us() << "\n"
         << "# TYPE dtpu_journal_append_us_ema gauge\n"
         << "dtpu_journal_append_us_ema " << m.journal_.ema_us() << "\n"
+        << "# HELP dtpu_journal_group_commit_total batched fsyncs covering "
+           ">1 queued append (group commit engaged under fsync pressure)\n"
+        << "# TYPE dtpu_journal_group_commit_total counter\n"
+        << "dtpu_journal_group_commit_total " << m.journal_.group_commits()
+        << "\n"
+        << "# TYPE dtpu_journal_group_commit_records_total counter\n"
+        << "dtpu_journal_group_commit_records_total "
+        << m.journal_.group_commit_records() << "\n"
         << "# TYPE dtpu_journal_compactions_total counter\n"
         << "dtpu_journal_compactions_total " << m.compactions_ << "\n"
         << "# HELP dtpu_replay_duration_ms snapshot+journal replay time at boot\n"
@@ -6486,6 +6594,26 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     }
     ag.slots = static_cast<int>(body["slots"].as_int(1));
     if (body["slot_type"].is_string()) ag.slot_type = body["slot_type"].as_string();
+    // topology label: reported slice wins; an agent that re-registers
+    // without one (e.g. restarted with an older flagset) keeps the
+    // journaled label.  Changes are WAL round-tripped so a restarted
+    // master still fits gangs slice-aligned before agents re-register.
+    if (body.contains("slice_id") && body["slice_id"].is_string() &&
+        !body["slice_id"].as_string().empty()) {
+      ag.slice_id = body["slice_id"].as_string();
+    } else {
+      auto tit = m.agent_topology_.find(id);
+      if (tit != m.agent_topology_.end()) ag.slice_id = tit->second;
+    }
+    auto known = m.agent_topology_.find(id);
+    if (!ag.slice_id.empty() &&
+        (known == m.agent_topology_.end() || known->second != ag.slice_id)) {
+      m.agent_topology_[id] = ag.slice_id;
+      m.record(Json::object()
+                   .set("type", "agent_topology")
+                   .set("agent", id)
+                   .set("slice", ag.slice_id));
+    }
     if (fresh) ag.used_slots = 0;
     ag.last_seen_ms = now_ms();
     // idle clock starts at registration — last_seen_ms is refreshed by
@@ -6559,6 +6687,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
       j.set("slots", Json(ag.slots));
       j.set("slot_type", ag.slot_type);
       j.set("used_slots", Json(ag.used_slots));
+      j.set("slice_id", ag.slice_id);
       out.push_back(j);
     }
     return R::json(out.dump());
@@ -7872,6 +8001,9 @@ int main(int argc, char** argv) {
   int fleet_launch_grace_sec = 180;
   int reattach_grace_sec = 60;
   bool journal_fsync = true;
+  // -1 auto (half the ingest fsync budget); fractional ms accepted so
+  // tests can pin a sub-fsync threshold that always engages
+  double journal_group_commit_ms = -1;
   int ingest_max_inflight = 256;
   int ingest_fsync_budget_ms = 0;
   int ingest_retry_after_sec = 1;
@@ -7918,6 +8050,9 @@ int main(int argc, char** argv) {
     else if (arg == "--reattach-grace-sec")
       reattach_grace_sec = std::atoi(next("--reattach-grace-sec").c_str());
     else if (arg == "--journal-no-fsync") journal_fsync = false;
+    else if (arg == "--journal-group-commit-ms")
+      journal_group_commit_ms =
+          std::atof(next("--journal-group-commit-ms").c_str());
     else if (arg == "--ingest-max-inflight")
       ingest_max_inflight = std::atoi(next("--ingest-max-inflight").c_str());
     else if (arg == "--ingest-fsync-budget-ms")
@@ -7975,6 +8110,19 @@ int main(int argc, char** argv) {
   master.admission_.fsync_budget_us =
       static_cast<int64_t>(ingest_fsync_budget_ms) * 1000;
   master.admission_.retry_after_s = std::max(ingest_retry_after_sec, 1);
+  // Group commit engages when the fsync EMA exceeds the threshold.  The
+  // default derives it from the ingest fsync budget (half of it): when the
+  // disk is too slow to both fsync-per-append and honor the budget, start
+  // batching before admission control starts shedding 429s.  Explicit
+  // --journal-group-commit-ms overrides; 0 disables.
+  {
+    double gc_ms = journal_group_commit_ms >= 0
+                       ? journal_group_commit_ms
+                       : (ingest_fsync_budget_ms > 0
+                              ? ingest_fsync_budget_ms / 2.0
+                              : 0.0);
+    master.set_journal_group_commit(static_cast<int64_t>(gc_ms * 1000));
+  }
   if (!pools_file.empty()) {
     std::ifstream in(pools_file);
     std::ostringstream data;
@@ -8052,6 +8200,7 @@ int main(int argc, char** argv) {
     master.advance_rolling_deploy();
     master.reconcile_fleet();
     master.reap_unattached_allocations();
+    master.flush_journal();
     master.maybe_compact();
     if (++ticks >= 1800) {
       ticks = 0;

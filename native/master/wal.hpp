@@ -202,6 +202,19 @@ inline WalReadResult wal_read(const std::string& path) {
 // mutation is acknowledged only after its record is on disk).  Latency is
 // tracked so /metrics can expose journal.append fsync cost and the
 // admission controller can shed ingest when the disk falls behind.
+//
+// Group commit (fsync batching under ingest load): when armed via
+// set_group_commit, an append that finds the fsync-latency EMA — the same
+// signal the ingest admission controller sheds on — above the threshold
+// defers its fdatasync instead of paying one per record.  The deferred
+// batch is made durable by the next append that syncs inline (one
+// fdatasync covers every prior write on the fd), by the pending count
+// reaching its cap, or by the owner's periodic flush().  Durability
+// window under group commit: a crash can lose at most the deferred tail —
+// complete framed records that were written but not yet synced; boot
+// replays the valid prefix exactly as for a torn tail, so the journal
+// never reads corrupt, it is just up to `max_pending` records (or one
+// flush interval) short.
 class WalWriter {
  public:
   ~WalWriter() { close(); }
@@ -216,8 +229,15 @@ class WalWriter {
 
   bool is_open() const { return fd_ >= 0; }
 
+  // threshold_us <= 0 disables batching (the default: fsync per append)
+  void set_group_commit(int64_t threshold_us, int max_pending = 32) {
+    group_threshold_us_ = threshold_us;
+    group_max_pending_ = max_pending > 0 ? max_pending : 1;
+  }
+
   void close() {
     if (fd_ >= 0) {
+      flush();
       ::close(fd_);
       fd_ = -1;
     }
@@ -227,7 +247,27 @@ class WalWriter {
   bool reset() {
     if (fd_ < 0) return false;
     if (::ftruncate(fd_, 0) != 0) return false;
+    pending_.store(0, std::memory_order_relaxed);  // truncated with the file
     if (fsync_enabled_) ::fsync(fd_);
+    return true;
+  }
+
+  // make any deferred (group-commit) records durable now; counts one
+  // batched sync when records were actually pending
+  bool flush() {
+    if (fd_ < 0 || !fsync_enabled_) return fd_ >= 0;
+    int64_t batch = pending_.exchange(0, std::memory_order_relaxed);
+    if (batch <= 0) return true;
+    auto t0 = std::chrono::steady_clock::now();
+    if (::fdatasync(fd_) != 0) return false;
+    group_commits_.fetch_add(1, std::memory_order_relaxed);
+    group_commit_records_.fetch_add(batch, std::memory_order_relaxed);
+    // `appends` counts records made durable: the one sync here covers the
+    // whole batch (record_sync_latency contributes the remaining +1)
+    appends_.fetch_add(batch - 1, std::memory_order_relaxed);
+    record_sync_latency(std::chrono::duration_cast<std::chrono::microseconds>(
+                            std::chrono::steady_clock::now() - t0)
+                            .count());
     return true;
   }
 
@@ -264,11 +304,47 @@ class WalWriter {
       off += static_cast<size_t>(w);
     }
     if (fsync_enabled_) {
+      // Group commit: while the fsync EMA says the disk is behind, defer
+      // the sync and let a later inline fdatasync / flush() cover the
+      // batch.  Deferred appends do NOT touch the latency stats — the EMA
+      // stays an honest fsync-latency signal, and `appends` keeps meaning
+      // "records covered by an fdatasync" only once they are.
+      if (group_threshold_us_ > 0 &&
+          ema_us_.load(std::memory_order_relaxed) > group_threshold_us_ &&
+          pending_.load(std::memory_order_relaxed) + 1 < group_max_pending_) {
+        pending_.fetch_add(1, std::memory_order_relaxed);
+        return true;
+      }
       if (::fdatasync(fd_) != 0) return unwind();
+      int64_t batch = pending_.exchange(0, std::memory_order_relaxed);
+      if (batch > 0) {
+        group_commits_.fetch_add(1, std::memory_order_relaxed);
+        group_commit_records_.fetch_add(batch, std::memory_order_relaxed);
+        appends_.fetch_add(batch, std::memory_order_relaxed);
+      }
     }
-    int64_t us = std::chrono::duration_cast<std::chrono::microseconds>(
-                     std::chrono::steady_clock::now() - t0)
-                     .count();
+    record_sync_latency(std::chrono::duration_cast<std::chrono::microseconds>(
+                            std::chrono::steady_clock::now() - t0)
+                            .count());
+    return true;
+  }
+
+  int64_t appends() const { return appends_.load(std::memory_order_relaxed); }
+  int64_t total_us() const { return total_us_.load(std::memory_order_relaxed); }
+  int64_t max_us() const { return max_us_.load(std::memory_order_relaxed); }
+  int64_t ema_us() const { return ema_us_.load(std::memory_order_relaxed); }
+  int64_t group_commits() const {
+    return group_commits_.load(std::memory_order_relaxed);
+  }
+  int64_t group_commit_records() const {
+    return group_commit_records_.load(std::memory_order_relaxed);
+  }
+  int64_t pending_records() const {
+    return pending_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  void record_sync_latency(int64_t us) {
     appends_.fetch_add(1, std::memory_order_relaxed);
     total_us_.fetch_add(us, std::memory_order_relaxed);
     int64_t prev_max = max_us_.load(std::memory_order_relaxed);
@@ -280,18 +356,16 @@ class WalWriter {
     int64_t prev = ema_us_.load(std::memory_order_relaxed);
     ema_us_.store(prev == 0 ? us : prev + (us - prev) / 8,
                   std::memory_order_relaxed);
-    return true;
   }
 
-  int64_t appends() const { return appends_.load(std::memory_order_relaxed); }
-  int64_t total_us() const { return total_us_.load(std::memory_order_relaxed); }
-  int64_t max_us() const { return max_us_.load(std::memory_order_relaxed); }
-  int64_t ema_us() const { return ema_us_.load(std::memory_order_relaxed); }
-
- private:
   std::string path_;
   int fd_ = -1;
   bool fsync_enabled_ = true;
+  int64_t group_threshold_us_ = 0;
+  int group_max_pending_ = 32;
+  std::atomic<int64_t> pending_{0};
+  std::atomic<int64_t> group_commits_{0};
+  std::atomic<int64_t> group_commit_records_{0};
   std::atomic<int64_t> appends_{0};
   std::atomic<int64_t> total_us_{0};
   std::atomic<int64_t> max_us_{0};

@@ -21,6 +21,13 @@ emitting ONE ``bench.py``-shaped JSON row per requested mode:
   carries the analytic tick count, the modeled bubble %, the measured
   wall-clock step time, the compiled program's max live-activation
   (temp) bytes, and the loss deviation vs the gpipe arm.
+- ``DTPU_BENCH_MULTISLICE=1`` — flat all-reduce vs hierarchical
+  ICI/DCN collectives on the 2-slice x 4-chip virtual mesh (slices=2):
+  the row carries tokens/s for both arms, the modeled per-hop bytes
+  (the hierarchical arm must put exactly 1/N_ici of the flat arm's
+  payload on ``dcn``), the goodput ledger's per-hop exposed/hidden
+  split, and the measured param deviation — the two-level sync must be
+  numerically a no-op vs the flat collective.
 
 On CPU the A/Bs run on the virtual 8-device mesh and prove STRUCTURE +
 NUMERICS (collective layout, sharded opt state, loss parity, the 1f1b
@@ -28,10 +35,11 @@ memory cap, the interleaved tick model); the TPU MFU row is marked
 "next chip round" — wall-clock wins need real async collectives and an
 MXU.
 
-    DTPU_BENCH_OVERLAP=1 python bench.py
-    DTPU_BENCH_QUANT=1   python bench.py
-    DTPU_BENCH_PIPE=1    python bench.py
-    JAX_PLATFORMS=cpu python scripts/bench_step.py overlap quant pipe
+    DTPU_BENCH_OVERLAP=1    python bench.py
+    DTPU_BENCH_QUANT=1      python bench.py
+    DTPU_BENCH_PIPE=1       python bench.py
+    DTPU_BENCH_MULTISLICE=1 python bench.py
+    JAX_PLATFORMS=cpu python scripts/bench_step.py overlap quant pipe multislice
 """
 
 from __future__ import annotations
@@ -331,17 +339,80 @@ def bench_pipe() -> dict:
     return row
 
 
+def bench_multislice() -> dict:
+    """A/B flat all-reduce vs hierarchical ICI/DCN collectives on the
+    2-slice x 4-chip virtual mesh: flat shards the gradient sync over
+    every mesh axis including ``dcn``; hierarchical reduce-scatters
+    within each slice first so only the 1/N_ici fragment crosses the
+    slow inter-slice hop.  The row carries both arms' tokens/s, the
+    modeled per-hop bytes (hier dcn must be exactly flat dcn / N_ici),
+    the ledger's per-hop exposed/hidden split, and param parity."""
+    import jax
+
+    from determined_tpu.parallel.mesh import MeshConfig
+
+    mesh = MeshConfig(num_slices=2, data=2, fsdp=2)
+    base = {"overlap_grad_sync": True, "overlap_bucket_mb": 1}
+    t_flat, _, tps_flat, led_flat = _run_arm(
+        dict(base), "ms-flat", HP, mesh=mesh
+    )
+    t_hier, _, tps_hier, led_hier = _run_arm(
+        dict(base, hierarchical_collectives=True), "ms-hier", HP, mesh=mesh
+    )
+    maxdiff = _param_maxdiff(t_flat.state.params, t_hier.state.params)
+    flat_comm = t_flat._overlap_plan.comm
+    hier_comm = t_hier._overlap_plan.comm
+    assert t_hier._overlap_plan.hierarchical_dcn == 2
+    n_ici = mesh.data * mesh.fsdp  # chips per slice
+    hops_flat = led_flat["experiment"].get("step.comm", {}).get("hops", {})
+    hops_hier = led_hier["experiment"].get("step.comm", {}).get("hops", {})
+    row = {
+        "metric": "transformer_lm_hierarchical_collectives_tokens_per_sec",
+        "value": round(tps_hier, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps_hier / max(tps_flat, 1e-9), 3),
+        "baseline_tokens_per_s": round(tps_flat, 1),
+        "mesh": "dcn2x(data2xfsdp2)",
+        "slices": 2,
+        "modeled_dcn_bytes_flat": flat_comm.dcn_bytes_per_step,
+        "modeled_dcn_bytes_hier": hier_comm.dcn_bytes_per_step,
+        "dcn_fragment_ok": (
+            hier_comm.dcn_bytes_per_step
+            == flat_comm.dcn_bytes_per_step // n_ici
+        ),
+        "hops_flat": hops_flat,
+        "hops_hier": hops_hier,
+        "numerics_param_maxdiff": maxdiff,
+        "numerically_identical": maxdiff < 1e-5,
+        "chip": _chip(),
+        "steps": STEPS,
+    }
+    if jax.default_backend() != "tpu":
+        row["note"] = (
+            "CPU virtual slices (contiguous device blocks): structure + "
+            "numerics A/B; the DCN wall-clock win needs real inter-slice "
+            "links — TPU MULTICHIP row next chip round"
+        )
+    return row
+
+
+_MODES = ("overlap", "quant", "pipe", "multislice")
+
+
 def main() -> None:
-    modes = [m for m in sys.argv[1:] if m in ("overlap", "quant", "pipe")]
+    modes = [m for m in sys.argv[1:] if m in _MODES]
     if not modes:
-        if os.environ.get("DTPU_BENCH_OVERLAP", "0") not in ("0", ""):
-            modes.append("overlap")
-        if os.environ.get("DTPU_BENCH_QUANT", "0") not in ("0", ""):
-            modes.append("quant")
-        if os.environ.get("DTPU_BENCH_PIPE", "0") not in ("0", ""):
-            modes.append("pipe")
+        env_by_mode = {
+            "overlap": "DTPU_BENCH_OVERLAP",
+            "quant": "DTPU_BENCH_QUANT",
+            "pipe": "DTPU_BENCH_PIPE",
+            "multislice": "DTPU_BENCH_MULTISLICE",
+        }
+        for mode, var in env_by_mode.items():
+            if os.environ.get(var, "0") not in ("0", ""):
+                modes.append(mode)
     if not modes:
-        modes = ["overlap", "quant", "pipe"]
+        modes = list(_MODES)
     _maybe_respawn()
     ok = True
     for mode in modes:
@@ -351,6 +422,9 @@ def main() -> None:
         elif mode == "quant":
             row = bench_quant()
             ok = ok and row["within_tolerance"]
+        elif mode == "multislice":
+            row = bench_multislice()
+            ok = ok and row["numerically_identical"] and row["dcn_fragment_ok"]
         else:
             row = bench_pipe()
             ok = ok and row["parity_ok"] and row["memory_win_1f1b"] is not False

@@ -1185,6 +1185,128 @@ def _kill_master_smoke(cluster: "DevCluster") -> int:
     return 0 if ok else 1
 
 
+def _multislice_smoke(root) -> int:
+    """Topology-aware gang placement smoke (docs/cluster.md): four 1-slot
+    agents carry two distinct --slice-id labels (two hosts per slice); a
+    2-process gang must land slice-ALIGNED (both ranks on agents sharing
+    one label — the within-slice span the slice-aware fitter adds), and
+    after one rank is SIGKILLed the rescheduled gang must again be
+    slice-aligned.  Runs under the ASan master via devcluster.sh
+    --multislice."""
+    cluster = DevCluster(root, agents=0, slots=1)
+    cluster.start_master()
+    try:
+        for idx, slice_id in enumerate(["slice-a", "slice-a",
+                                        "slice-b", "slice-b"]):
+            cluster.start_agent(idx, extra_args=("--slice-id", slice_id))
+        deadline = time.time() + 10
+        agents = []
+        while time.time() < deadline:
+            agents = cluster.http.get(
+                cluster.url + "/api/v1/agents", timeout=2).json()
+            if len(agents) >= 4:
+                break
+            time.sleep(0.2)
+        labels = {a["id"]: a.get("slice_id") for a in agents}
+        if sorted(set(labels.values())) != ["slice-a", "slice-b"]:
+            print(f"multislice: labels not in listing: {labels}",
+                  file=sys.stderr)
+            return 1
+        print(f"multislice: 4 agents registered with labels {labels}")
+
+        cfg = exp_config(cluster.ckpt_dir, slots=2)
+        cfg["environment"]["env"]["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=1"
+        )
+        cfg["searcher"]["max_length"] = {"batches": 20}
+        cfg["min_validation_period"] = {"batches": 5}
+        exp_id = cluster.submit(cfg)
+        print(f"multislice: submitted experiment {exp_id} "
+              "(2-slot gang, no single agent fits)")
+
+        def busy_slices():
+            listing = cluster.http.get(
+                cluster.url + "/api/v1/agents", timeout=5).json()
+            busy = [a for a in listing if a["used_slots"] > 0]
+            return busy, {a.get("slice_id") for a in busy}
+
+        def wait_for_aligned_gang(timeout=120):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                busy, slices = busy_slices()
+                if len(busy) == 2:
+                    return busy, slices
+                time.sleep(0.5)
+            return [], set()
+
+        busy, slices = wait_for_aligned_gang()
+        if len(busy) != 2 or len(slices) != 1:
+            print(f"multislice: gang not slice-aligned: "
+                  f"{[(a['id'], a.get('slice_id')) for a in busy]}",
+                  file=sys.stderr)
+            return 1
+        first_slice = next(iter(slices))
+        print(f"multislice: gang placed on {first_slice} "
+              f"({[a['id'] for a in busy]})")
+
+        # SIGKILL one rank: the master fails the allocation, burns a
+        # restart, and reschedules the whole gang — which must again be
+        # slice-aligned (either slice is fine; alignment is the contract)
+        pids = subprocess.run(
+            ["pgrep", "-f", "determined_tpu.exec.run_trial"],
+            capture_output=True, text=True,
+        ).stdout.split()
+        if not pids:
+            print("multislice: no rank process to kill", file=sys.stderr)
+            return 1
+        os.kill(int(pids[0]), signal.SIGKILL)
+        print(f"multislice: SIGKILLed rank pid {pids[0]}; "
+              "waiting for reschedule")
+        deadline = time.time() + 180
+        rescheduled = None
+        while time.time() < deadline:
+            exp = cluster.http.get(
+                f"{cluster.url}/api/v1/experiments/{exp_id}", timeout=5
+            ).json()
+            trials = exp.get("trials") or []
+            if trials and int(trials[0].get("restarts", 0)) >= 1:
+                busy, slices = busy_slices()
+                if len(busy) == 2 and len(slices) == 1:
+                    rescheduled = (busy, slices)
+                    break
+            if exp["state"] in ("COMPLETED", "ERROR"):
+                break
+            time.sleep(0.5)
+        if rescheduled is None:
+            print("multislice: gang not rescheduled slice-aligned",
+                  file=sys.stderr)
+            return 1
+        busy, slices = rescheduled
+        print(f"multislice: rescheduled gang on {next(iter(slices))} "
+              f"({[a['id'] for a in busy]})")
+
+        final = cluster.wait_for_state(exp_id, timeout=300)
+        trial = final["trials"][0]
+        ok = (final["state"] == "COMPLETED"
+              and trial["state"] == "COMPLETED"
+              and int(trial["restarts"]) >= 1)
+        print(f"multislice: experiment {final['state']}, "
+              f"trial {trial['state']}, restarts={trial['restarts']}")
+        if not ok:
+            logs = cluster.http.get(
+                f"{cluster.url}/api/v1/trials/{trial['id']}/logs", timeout=5
+            ).json()
+            for line in logs[-40:]:
+                print(f"  | {line}")
+        return 0 if ok else 1
+    finally:
+        subprocess.run(
+            ["pkill", "-9", "-f", "determined_tpu.exec.run_trial"],
+            capture_output=True,
+        )
+        cluster.stop()
+
+
 def _fsck_selftest() -> int:
     """Offline `--journal-fsck` self-test (wired into native_check.sh):
     clean and torn-tail journals verify (exit 0), mid-log corruption is
@@ -1251,6 +1373,11 @@ def main(argv=None) -> int:
                          "Poisson load with a 70%% shared system prompt; "
                          "replica SIGKILL mid-load -> failover + refill, "
                          "zero drops, prefix hits on the sticky replica)")
+    ap.add_argument("--multislice", action="store_true",
+                    help="run the topology-aware placement smoke (4 agents "
+                         "across 2 --slice-id labels; 2-process gang placed "
+                         "slice-aligned; rank SIGKILL -> rescheduled gang "
+                         "still slice-aligned)")
     ap.add_argument("--fsck-selftest", action="store_true",
                     help="verify `dtpu-master --journal-fsck` on fabricated journals")
     ap.add_argument("--agents", type=int, default=2)
@@ -1274,6 +1401,9 @@ def main(argv=None) -> int:
         import tempfile
 
         root = pathlib.Path(tempfile.mkdtemp(prefix="dtpu-devcluster-"))
+    if args.multislice:
+        # builds its own cluster: agents need per-agent --slice-id labels
+        return _multislice_smoke(root)
     if args.selfheal:
         # builds its own cluster: custom master flags + an agent with a
         # known --state-dir (the pidfile is the replica-SIGKILL handle)

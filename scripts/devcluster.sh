@@ -21,6 +21,13 @@
 #                                        # dropped requests; injected error
 #                                        # rate -> auto-hold; crash-loop ->
 #                                        # degraded (docs/operations.md)
+#   scripts/devcluster.sh --multislice   # topology-aware placement smoke,
+#                                        # plain THEN ASan build: 4 agents
+#                                        # across 2 --slice-id labels, a
+#                                        # 2-process gang placed slice-
+#                                        # aligned, one rank SIGKILLed ->
+#                                        # rescheduled still slice-aligned
+#                                        # (docs/cluster.md)
 #   scripts/devcluster.sh --route        # ASan build + routed-serving
 #                                        # chaos: Poisson load through the
 #                                        # master's /v1/generate proxy (70%
@@ -48,6 +55,14 @@ elif [[ "${1:-}" == "--kill-master" ]]; then
   scripts/native_check.sh --sanitize
   export DTPU_NATIVE_BUILD_DIR="$REPO/native/build-asan"
   exec python scripts/devcluster.py --kill-master
+elif [[ "${1:-}" == "--multislice" ]]; then
+  # placement smoke runs twice: the plain build (fast signal), then the
+  # ASan/UBSan build — the slice-grouping walk and reschedule-after-kill
+  # bookkeeping are exactly where lifetime bugs would hide
+  python scripts/devcluster.py --build --multislice
+  scripts/native_check.sh --sanitize
+  export DTPU_NATIVE_BUILD_DIR="$REPO/native/build-asan"
+  exec python scripts/devcluster.py --multislice
 elif [[ "${1:-}" == "--route" ]]; then
   # the router's candidate walk, in-flight accounting, and failover all
   # run inside the master under concurrent load — exactly the code ASan

@@ -134,6 +134,89 @@ def test_fsck_clean_journal(tmp_path):
     assert "last_good_lsn=5" in out and "tail_truncated=no" in out, out
 
 
+# ---- group commit (ISSUE 18): batched fsync under fsync pressure ------------
+
+
+def test_group_commit_crash_keeps_every_complete_frame(tmp_path):
+    """Group commit defers fdatasync when the fsync EMA exceeds the
+    threshold, so a crash can leave COMPLETE framed records past the last
+    synced offset, then a torn one.  Boot must keep every complete frame
+    (records that hit the disk intact are state, synced or not) and drop
+    only the torn bytes — the durability window narrows to what physically
+    never landed."""
+    frames = _frames()
+    blob = b"".join(frames)
+    expect_dir = tmp_path / "complete"
+    _write_blob(expect_dir, blob)
+    expected = _dump(expect_dir)
+
+    torn = wal_frame(json.dumps(
+        {"type": "trial_stop", "trial_id": 1, "seq": 6, "ts": 0}
+    ))
+    work = tmp_path / "torn"
+    _write_blob(work, blob + torn[: len(torn) // 2])
+    rc, out = _fsck(work)  # before boot: boot physically truncates the tail
+    assert rc == 0 and "tail_truncated=yes" in out, out
+    assert _dump(work) == expected
+
+
+def test_group_commit_engages_batches_and_survives_restart(tmp_path):
+    """With a sub-fsync threshold (0.001ms: the EMA always exceeds it)
+    the journal batches appends: the ``dtpu_journal_group_commit_total``
+    counter lands on /metrics, and after the 2s tick flush bounds the
+    window a SIGKILL+restart replays every acknowledged validation — the
+    group-committed journal stays torn-tail-recoverable end to end."""
+    import time
+
+    from scripts.devcluster import DevCluster
+
+    cluster = DevCluster(
+        tmp_path, agents=0,
+        master_args=("--journal-group-commit-ms", "0.001"),
+    )
+    cluster.start_master()
+    try:
+        exp_id = cluster.submit(_driver_exp_config(cluster.ckpt_dir))
+        r = cluster.http.post(
+            f"{cluster.url}/api/v1/experiments/{exp_id}/trials",
+            json={"request_id": 1, "hparams": {"lr": 0.1}}, timeout=5,
+        )
+        assert r.status_code == 201, r.text
+        tid = r.json()["id"]
+        n_validations = 40  # > the 32-record pending cap: forces a batch
+        for i in range(n_validations):
+            assert cluster.http.post(
+                f"{cluster.url}/api/v1/metrics",
+                json={"trial_id": tid, "group": "validation",
+                      "metrics": {"validation_loss": 1.0 / (i + 1)},
+                      "steps_completed": i + 1},
+                timeout=5,
+            ).status_code == 200
+
+        metrics = cluster.http.get(f"{cluster.url}/metrics", timeout=5).text
+        gc_line = [
+            line for line in metrics.splitlines()
+            if line.startswith("dtpu_journal_group_commit_total")
+        ]
+        assert gc_line, "dtpu_journal_group_commit_total missing from /metrics"
+        assert int(gc_line[0].split()[-1]) >= 1, gc_line
+
+        time.sleep(3.0)  # > one 2s tick: the periodic flush bounds the window
+        cluster.kill_master()
+        cluster.restart_master()
+
+        exp = cluster.http.get(
+            f"{cluster.url}/api/v1/experiments/{exp_id}", timeout=5
+        ).json()
+        by_rid = {t["request_id"]: t for t in exp["trials"]}
+        assert by_rid[1]["id"] == tid
+        assert by_rid[1]["validations"] == n_validations
+        rc, out = _fsck(cluster.state_dir)
+        assert rc == 0, out
+    finally:
+        cluster.stop()
+
+
 # ---- model registry records (ISSUE 15): same WAL contract -------------------
 
 

@@ -73,3 +73,36 @@ def test_batch_sharding_matmul_runs(devices8):
 def test_virtual_mesh():
     mesh = make_virtual_mesh(8, MeshConfig(data=2, seq=4))
     assert mesh.shape[MeshAxes.SEQUENCE] == 4
+
+
+def test_mesh_config_num_slices_resolve():
+    cfg = MeshConfig(data=-1, num_slices=2).resolve(8)
+    assert cfg.data == 4 and cfg.num_slices == 2 and cfg.num_devices == 8
+    with pytest.raises(ValueError):
+        MeshConfig(num_slices=0).resolve(8)
+    with pytest.raises(ValueError):  # 2 slices x data=3 never divides 8
+        MeshConfig(data=3, num_slices=2).resolve(8)
+
+
+def test_make_mesh_dcn_axis(devices8):
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, num_slices=2), devices8)
+    assert mesh.shape[MeshAxes.DCN] == 2
+    assert mesh.devices.size == 8
+    # dcn is always present; size 1 on a single slice (dropped by the
+    # sharding rules, so single-slice programs are unchanged)
+    assert make_mesh(MeshConfig(data=8), devices8).shape[MeshAxes.DCN] == 1
+
+
+def test_virtual_slices_are_contiguous_blocks():
+    """CPU devices carry no slice_index: virtual slices are contiguous
+    blocks of the default order, so the outer dcn axis maps to block
+    boundaries (the emulation the parity/HLO tests rely on)."""
+    mesh = make_virtual_mesh(8, MeshConfig(data=4, num_slices=2))
+    ids = [[d.id for d in row.flat] for row in mesh.devices]
+    assert ids == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_batch_rule_carries_dcn(devices8):
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, num_slices=2), devices8)
+    spec = logical_to_mesh_spec(("batch", None), DEFAULT_RULES, mesh)
+    assert spec == P((MeshAxes.DCN, MeshAxes.DATA, MeshAxes.FSDP), None)
