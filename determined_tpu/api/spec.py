@@ -67,6 +67,10 @@ ROUTES = [
     ("POST", "/api/v1/experiments/{id}/fork", "token", {"id", "forked_from"}),
     ("POST", "/api/v1/experiments/{id}/continue", "token",
      {"id", "forked_from", "continued_from_checkpoint"}),
+    # driver-managed searcher surface (harness-side search loop)
+    ("POST", "/api/v1/experiments/{id}/trials", "token", {"id"}),
+    ("POST", "/api/v1/experiments/{id}/searcher/shutdown", "token", {"state"}),
+    ("POST", "/api/v1/trials/{id}/stop", "token", {"state", "stop_requested"}),
     ("POST", "/api/v1/experiments/{id}/pause", "token", {"state"}),
     ("POST", "/api/v1/experiments/{id}/activate", "token", {"state"}),
     ("POST", "/api/v1/experiments/{id}/cancel", "token", {"state"}),
@@ -108,6 +112,12 @@ ROUTES = [
      {"model", "version", "target", "status", "slots"}),
     ("GET", "/api/v1/serving/fleet", "token",
      {"model", "version", "target", "status", "slots"}),
+    # serving data plane: replica registry + master-routed generation
+    ("POST", "/api/v1/serving/replicas", "token", {"id", "heartbeat_ttl_ms"}),
+    ("POST", "/api/v1/serving/replicas/{id}/heartbeat", "token", set()),
+    ("DELETE", "/api/v1/serving/replicas/{id}", "token", set()),
+    ("GET", "/api/v1/serving", "token", "[]"),
+    ("POST", "/v1/generate", "token", None),
     # agents + scheduling
     ("POST", "/api/v1/agents", "token", {"registered"}),
     ("GET", "/api/v1/agents", "token", "[]"),
@@ -153,7 +163,9 @@ def markdown() -> str:
         "# Master REST API (contract v%d)\n" % API_VERSION,
         "Generated from `determined_tpu/api/spec.py`; "
         "`tests/test_api_contract.py` asserts every row against a live "
-        "master.\n",
+        "master, and `dtpu lint --native` cross-references this table "
+        "against the master's actual `srv.route` dispatch "
+        "([docs/lint.md](docs/lint.md#control-plane-contract)).\n",
         "| method | path | auth | response |",
         "|---|---|---|---|",
     ]

@@ -1200,8 +1200,9 @@ def lint_cmd(args) -> int:
     from determined_tpu import lint as lint_mod
 
     sys.path.insert(0, os.getcwd())
-    if not args.target and not args.config:
-        print("error: nothing to lint (pass targets and/or --config)", file=sys.stderr)
+    if not args.target and not args.config and not args.native:
+        print("error: nothing to lint (pass targets, --config, and/or --native)",
+              file=sys.stderr)
         return 2
     config_problems = []
     for cfg_path in args.config or []:
@@ -1264,6 +1265,33 @@ def lint_cmd(args) -> int:
             print(f"error: cannot lint {' '.join(path_targets)}: {e}",
                   file=sys.stderr)
             return 2
+    if args.native:
+        # control-plane contract pass: cross-reference the native
+        # master/agent sources against the Python bindings, docs, and the
+        # test suite's fake masters (docs/lint.md "Control-plane contract")
+        from determined_tpu.lint.rules import build_rules
+
+        root = None
+        for cand in path_targets or [os.getcwd()]:
+            root = lint_mod.find_native_root(os.path.abspath(cand))
+            if root:
+                break
+        if not root:
+            print("error: --native: no native/master/master.cpp above the "
+                  "lint target (run from the repo)", file=sys.stderr)
+            return 2
+        try:
+            diags.extend(
+                lint_mod.lint_native(
+                    root,
+                    build_rules(args.rule or None, args.suppress or None),
+                )
+            )
+        except Exception as e:  # noqa: BLE001 - unreadable source, bad rule id
+            print(f"error: cannot run native pass over {root}: {e}",
+                  file=sys.stderr)
+            return 2
+        diags.sort(key=lambda d: (d.file, d.line, d.col, d.rule))
     if args.json:
         payload = lint_mod.to_json_payload(diags)
         if args.config:
@@ -1831,6 +1859,14 @@ def build_parser() -> argparse.ArgumentParser:
     ln.add_argument(
         "--suppress", action="append",
         help="disable specific rule ids (repeatable)",
+    )
+    ln.add_argument(
+        "--native", action="store_true",
+        help="also run the control-plane contract pass: cross-reference "
+             "native/master + native/agent (routes, WAL record types, "
+             "/metrics names, wire payloads) against api/spec.py, API.md, "
+             "docs/operations.md, the devcluster fuzz fixtures, and the "
+             "test suite's fake masters",
     )
     ln.add_argument(
         "--exclude", action="append", metavar="GLOB",

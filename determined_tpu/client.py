@@ -494,6 +494,26 @@ class Determined:
         """The live serving-replica routing table."""
         return self._session.get("/api/v1/serving").json()
 
+    def generate(
+        self,
+        prompt_tokens: List[int],
+        *,
+        max_new_tokens: Optional[int] = None,
+        session_key: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Generate through the master's router (``POST /v1/generate``):
+        the master picks the least-loaded replica with consistent-hash
+        affinity on ``session_key`` (or the prompt prefix), so repeated
+        calls with the same key land on the replica holding the prefix
+        cache.  Raises on 503 (no live replica / fleet saturated) like
+        every other binding — callers retry with backoff."""
+        body: Dict[str, Any] = {"prompt_tokens": list(prompt_tokens)}
+        if max_new_tokens is not None:
+            body["max_new_tokens"] = int(max_new_tokens)
+        if session_key is not None:
+            body["session"] = session_key
+        return self._session.post("/v1/generate", json=body).json()
+
     # -- generic tasks (NTSC: tensorboard viewer behind the proxy) --
     def start_tensorboard(
         self, experiment_ids: Optional[List[int]] = None

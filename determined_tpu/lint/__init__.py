@@ -39,6 +39,15 @@ from determined_tpu.lint._diag import (
     LintError,
     to_json_payload,
 )
+from determined_tpu.lint._native import (
+    NativeIndex,
+    NativeSources,
+    build_native_index,
+    collect_native_sources,
+    find_native_root,
+    lint_native,
+    run_native_pass,
+)
 from determined_tpu.lint._runtime import (
     CollectiveDivergenceError,
     CollectiveSequenceSentinel,
@@ -75,6 +84,8 @@ __all__ = [
     "LintError",
     "LockOrderSentinel",
     "LockOrderViolation",
+    "NativeIndex",
+    "NativeSources",
     "RetraceSentinel",
     "SCHEMA_VERSION",
     "ThreadLeakChecker",
@@ -87,8 +98,13 @@ __all__ = [
     "analyze_path",
     "analyze_paths",
     "analyze_source",
+    "build_native_index",
     "check_trial",
+    "collect_native_sources",
+    "find_native_root",
     "get_collective_sentinel",
+    "lint_native",
+    "run_native_pass",
     "get_retrace_sentinel",
     "to_json_payload",
 ]

@@ -1,0 +1,1077 @@
+"""Control-plane contract analyzer (``dtpu lint --native``).
+
+The master's API contract lives in three places that can silently drift:
+the ``srv.route(...)`` dispatch table in ``native/master/master.cpp``, the
+Python bindings (``client.py`` / ``api/spec.py`` / generated
+``api/bindings.py``), and the fake masters that pin driver behavior in
+tests.  The durability contract has the same shape: every ``record(...)``
+WAL emit site needs a replay arm in ``apply_event``, snapshot coverage in
+``snapshot_state``/``restore_snapshot``, and a torn-tail fuzz fixture.
+PRs 13/15/16/18 audited all of this by eye; this module makes the audit
+mechanical.
+
+It is a **pattern-anchored structural parser**, not a C++ frontend: it
+leans on the shapes the native sources already keep (and that
+``scripts/native_check.sh`` now guards):
+
+- routes:      ``srv.route("METHOD", "/path", wrapper([...]{...}))``
+               with method + path on the route line; ``authed(`` /
+               ``admin_only(`` / ``ingest_guarded(`` wrappers named on
+               that same line;
+- WAL emits:   ``record(Json::object().set("type", "x")...)`` or
+               ``record(ev)`` where ``ev.set("type", "x")`` appears in
+               the preceding lines of the same function;
+- replay:      ``type == "x"`` arms inside ``apply_event``;
+- snapshot:    member identifiers (trailing ``_``) referenced in
+               ``snapshot_state`` / ``restore_snapshot``;
+- metrics:     ``dtpu_*`` names in string literals of the ``/metrics``
+               handler;
+- wire bodies: ``body.set("k", ...)`` keys POSTed by the agent via
+               ``master_req`` vs ``body["k"]`` / ``contains("k")`` reads
+               in the matching master handler.
+
+Everything lands in a :class:`NativeIndex`, which the ``native = True``
+rules in ``rules/native.py`` cross-reference against the Python side
+(route literals in the package, ``api/spec.py`` ROUTES, ``API.md`` rows,
+``docs/operations.md`` metric names, the devcluster fuzz fixtures, and
+the test suite's fake masters).  Findings flow through the same
+``Diagnostic`` / suppression / JSON machinery as every other pass; the
+C++ sources take ``// dtpu: lint-ok[rule] argument`` comments with the
+same semantics as the Python form (a comment alone on its line also
+covers the next line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from determined_tpu.lint._ast import parse_suppressions
+from determined_tpu.lint._diag import Diagnostic
+
+__all__ = [
+    "NativeIndex",
+    "NativeSources",
+    "Route",
+    "WalSite",
+    "build_native_index",
+    "collect_native_sources",
+    "find_native_root",
+    "lint_native",
+    "run_native_pass",
+]
+
+
+# --------------------------------------------------------------------------
+# index data model
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Route:
+    """One ``srv.route`` dispatch entry."""
+
+    method: str
+    path: str            # as written ("/api/v1/trials/{id}/exit")
+    norm: str            # placeholders collapsed ("/api/v1/trials/{}/exit")
+    auth: str            # "authed", "admin_only", "ingest_guarded+authed", "anon"
+    line: int
+    status_codes: Tuple[int, ...] = ()
+
+
+@dataclass
+class WalSite:
+    """One ``record(...)`` emit site, resolved to its record type."""
+
+    rtype: Optional[str]  # None when the type literal could not be resolved
+    line: int
+
+
+@dataclass
+class WireField:
+    """One key of a JSON body the agent POSTs to the master."""
+
+    key: str             # "slots" or "allocations[].trial_id"
+    line: int
+
+
+@dataclass
+class WirePayload:
+    """One agent->master request body (``master_req`` with a ``.dump()``)."""
+
+    method: str
+    norm: str
+    line: int
+    fields: List[WireField] = field(default_factory=list)
+
+
+@dataclass
+class FakeRoute:
+    """One (method, path-pattern) a fake master's do_* handler answers."""
+
+    method: str
+    kind: str            # "exact" | "prefix" | "suffix" | "prefix+suffix" | "segments"
+    data: Tuple          # kind-specific payload (see _match_fake_route)
+    line: int
+    cls: str
+
+
+@dataclass
+class NativeIndex:
+    """Everything the analyzer extracted from the native control plane."""
+
+    routes: List[Route] = field(default_factory=list)
+    wal_sites: List[WalSite] = field(default_factory=list)
+    replay_arms: Dict[str, int] = field(default_factory=dict)       # type -> line
+    replay_members: Dict[str, Set[str]] = field(default_factory=dict)
+    snapshot_text: str = ""       # snapshot_state + restore_snapshot bodies
+    snapshot_line: int = 0
+    dump_state_keys: List[str] = field(default_factory=list)
+    metrics: List[Tuple[str, int]] = field(default_factory=list)    # (name, line)
+    wire_payloads: List[WirePayload] = field(default_factory=list)
+    wal_symbols: Set[str] = field(default_factory=set)              # wal.hpp API
+
+    def record_types(self) -> Dict[str, List[int]]:
+        out: Dict[str, List[int]] = {}
+        for site in self.wal_sites:
+            if site.rtype is not None:
+                out.setdefault(site.rtype, []).append(site.line)
+        return out
+
+
+@dataclass
+class NativeSources:
+    """The file set one native pass cross-references.
+
+    Each entry is ``(display_path, source_text)`` so fixtures in tests can
+    use tiny synthetic files while the real pass uses repo-relative paths.
+    """
+
+    master: Tuple[str, str]
+    agent: Optional[Tuple[str, str]] = None
+    wal: Optional[Tuple[str, str]] = None
+    spec: Optional[Tuple[str, str]] = None       # api/spec.py
+    api_md: Optional[Tuple[str, str]] = None     # API.md
+    ops_md: Optional[Tuple[str, str]] = None     # docs/operations.md
+    fuzz: Optional[Tuple[str, str]] = None       # scripts/devcluster.py
+    python: Dict[str, str] = field(default_factory=dict)   # route-literal scan set
+    fakes: Dict[str, str] = field(default_factory=dict)    # fake-master test files
+
+
+# --------------------------------------------------------------------------
+# C++ text utilities
+# --------------------------------------------------------------------------
+
+_SUPPRESS_CPP_RE = re.compile(r"//\s*dtpu:\s*lint-ok(?:\[([^\]]+)\])?")
+
+
+def cpp_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """``// dtpu: lint-ok[rule] why`` -> {line: rule ids} (None = all).
+
+    Same contract as the Python ``parse_suppressions``: a comment alone on
+    its line also covers the next line.
+    """
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_CPP_RE.search(text)
+        if not m:
+            continue
+        rules = (
+            {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if m.group(1) is not None
+            else None
+        )
+        targets = [i]
+        if not text[: m.start()].strip():
+            targets.append(i + 1)
+        for t in targets:
+            prev = out.get(t, set())
+            out[t] = None if (prev is None or rules is None) else prev | rules
+    return out
+
+
+def _strip_comments(source: str) -> str:
+    """Blank ``//`` and ``/* */`` comments, preserving newlines and string
+    literals, so pattern anchors never match commentary."""
+    out: List[str] = []
+    i, n = 0, len(source)
+    while i < n:
+        c = source[i]
+        if c == '"':
+            j = i + 1
+            while j < n and source[j] != '"':
+                j += 2 if source[j] == "\\" else 1
+            j = min(j + 1, n)
+            out.append(source[i:j])
+            i = j
+        elif c == "'":
+            j = i + 1
+            while j < n and source[j] != "'":
+                j += 2 if source[j] == "\\" else 1
+            j = min(j + 1, n)
+            out.append(source[i:j])
+            i = j
+        elif source.startswith("//", i):
+            j = source.find("\n", i)
+            j = n if j < 0 else j
+            out.append(" " * (j - i))
+            i = j
+        elif source.startswith("/*", i):
+            j = source.find("*/", i)
+            j = n if j < 0 else j + 2
+            out.append("".join("\n" if ch == "\n" else " " for ch in source[i:j]))
+            i = j
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _line_of(source: str, idx: int) -> int:
+    return source.count("\n", 0, idx) + 1
+
+
+def _balanced_span(source: str, open_idx: int, open_ch: str = "(", close_ch: str = ")") -> int:
+    """Index just past the close matching ``source[open_idx]`` (which must
+    be ``open_ch``); string literals are skipped.  Returns ``len(source)``
+    when unbalanced."""
+    depth = 0
+    i, n = open_idx, len(source)
+    while i < n:
+        c = source[i]
+        if c == '"':
+            i += 1
+            while i < n and source[i] != '"':
+                i += 2 if source[i] == "\\" else 1
+        elif c == "'":
+            i += 1
+            while i < n and source[i] != "'":
+                i += 2 if source[i] == "\\" else 1
+        elif c == open_ch:
+            depth += 1
+        elif c == close_ch:
+            depth -= 1
+            if depth == 0:
+                return i + 1
+        i += 1
+    return n
+
+
+_PLACEHOLDER_RE = re.compile(r"\{[^}]*\}")
+
+
+def norm_path(path: str) -> str:
+    """Collapse every ``{...}`` placeholder so spellings that differ only
+    in parameter names compare equal (``{id}`` vs ``{trial_id}`` vs
+    ``{*rest}``)."""
+    return _PLACEHOLDER_RE.sub("{}", path)
+
+
+# --------------------------------------------------------------------------
+# master.cpp parsers
+# --------------------------------------------------------------------------
+
+_ROUTE_RE = re.compile(
+    r"srv\s*\.\s*route\(\s*(?:\"(?P<method>[A-Z]+)\"|(?P<var>[A-Za-z_]\w*))\s*,\s*\"(?P<path>[^\"]+)\""
+)
+_METHOD_LIST_RE = re.compile(r"\{\s*\"[A-Z]+\"(?:\s*,\s*\"[A-Z]+\")*\s*\}")
+_AUTH_WRAPPERS = ("ingest_guarded", "admin_only", "authed")
+
+
+def _parse_routes(stripped: str) -> List[Route]:
+    routes: List[Route] = []
+    matches = list(_ROUTE_RE.finditer(stripped))
+    for i, m in enumerate(matches):
+        line = _line_of(stripped, m.start())
+        span_end = matches[i + 1].start() if i + 1 < len(matches) else min(len(stripped), m.end() + 20000)
+        handler = stripped[m.start():span_end]
+        # auth wrapper(s): named on the route line itself
+        route_line_end = stripped.find("\n", m.start())
+        route_line = stripped[m.start(): route_line_end if route_line_end > 0 else len(stripped)]
+        wrappers = [w for w in _AUTH_WRAPPERS if w + "(" in route_line]
+        auth = "+".join(wrappers) if wrappers else "anon"
+        codes = tuple(sorted({int(c) for c in re.findall(r"R::error\(\s*(\d{3})", handler)}))
+        methods: List[str]
+        if m.group("method"):
+            methods = [m.group("method")]
+        else:
+            # e.g.  for (const char* method : {"GET", "POST", ...})
+            #         srv.route(method, "/proxy/{id}/{*rest}", proxy_handler);
+            back = stripped[max(0, m.start() - 400): m.start()]
+            lst = None
+            for lst in _METHOD_LIST_RE.finditer(back):
+                pass
+            methods = re.findall(r"\"([A-Z]+)\"", lst.group(0)) if lst else ["*"]
+        path = m.group("path")
+        for meth in methods:
+            routes.append(Route(meth, path, norm_path(path), auth, line, codes))
+    return routes
+
+
+_RECORD_RE = re.compile(r"(?<![\w.])(?:m\s*\.\s*)?record\s*\(")
+_SET_TYPE_RE = re.compile(r"\.set\(\s*\"type\"\s*,\s*\"([^\"]+)\"\s*\)")
+
+
+def _parse_wal_sites(stripped: str) -> List[WalSite]:
+    sites: List[WalSite] = []
+    lines = stripped.splitlines()
+    for m in _RECORD_RE.finditer(stripped):
+        before = stripped[max(0, m.start() - 16): m.start()]
+        if re.search(r"(?:void|auto)\s+$", before):
+            continue  # the record() definition, not a call
+        open_idx = stripped.index("(", m.start())
+        end = _balanced_span(stripped, open_idx)
+        arg = stripped[open_idx + 1: end - 1].strip()
+        line = _line_of(stripped, m.start())
+        tm = _SET_TYPE_RE.search(arg)
+        rtype: Optional[str] = None
+        if tm:
+            rtype = tm.group(1)
+        elif re.fullmatch(r"[A-Za-z_]\w*", arg):
+            # record(ev): the builder set the type in the preceding lines
+            pat = re.compile(r"(?<![\w])" + re.escape(arg) + r"\.set\(\s*\"type\"\s*,\s*\"([^\"]+)\"")
+            for back in range(line - 2, max(-1, line - 82), -1):
+                bm = pat.search(lines[back]) if back < len(lines) else None
+                if bm:
+                    rtype = bm.group(1)
+                    break
+        sites.append(WalSite(rtype, line))
+    return sites
+
+
+_TYPE_ARM_RE = re.compile(r"type\s*==\s*\"([a-z0-9_]+)\"")
+_MEMBER_RE = re.compile(r"(?<![\w.>])([a-z][a-z0-9]*(?:_[a-z0-9]+)*_)(?![\w])")
+_CALL_RE = re.compile(r"(?<![\w.>:])([a-z]\w+)\s*\(")
+
+# members every arm may touch without a durability obligation: the journal
+# machinery itself and the scheduler wakeup plumbing
+_INFRA_MEMBERS = {"mu_", "work_cv_", "journal_", "journal_lines_", "events_"}
+
+
+def _function_body(stripped: str, name_re: str) -> Tuple[str, int]:
+    """Body text + first line of the first function whose signature matches
+    ``name_re`` (a regex for ``<ret> <name>(``).  Empty when absent."""
+    m = re.search(name_re, stripped)
+    if not m:
+        return "", 0
+    brace = stripped.find("{", m.end())
+    if brace < 0:
+        return "", 0
+    end = _balanced_span(stripped, brace, "{", "}")
+    return stripped[brace:end], _line_of(stripped, m.start())
+
+
+def _method_members(stripped: str, name: str, cache: Dict[str, Set[str]]) -> Set[str]:
+    """Member identifiers referenced by the same-file method ``name``
+    (one level: callees are not expanded further)."""
+    if name in cache:
+        return cache[name]
+    cache[name] = set()  # cycle guard
+    body, _ = _function_body(
+        stripped, r"[\w:<>&*]+\s+" + re.escape(name) + r"\s*\([^;{]*\)\s*(?:const\s*)?\{"
+    )
+    cache[name] = set(_MEMBER_RE.findall(body)) if body else set()
+    return cache[name]
+
+
+def _parse_replay(stripped: str) -> Tuple[Dict[str, int], Dict[str, Set[str]]]:
+    body, base_line = _function_body(stripped, r"void\s+apply_event\s*\(")
+    if not body:
+        return {}, {}
+    arms: Dict[str, int] = {}
+    members: Dict[str, Set[str]] = {}
+    marks = list(_TYPE_ARM_RE.finditer(body))
+    cache: Dict[str, Set[str]] = {}
+    for i, m in enumerate(marks):
+        rtype = m.group(1)
+        arm = body[m.end(): marks[i + 1].start() if i + 1 < len(marks) else len(body)]
+        arms.setdefault(rtype, base_line + body.count("\n", 0, m.start()))
+        refs = set(_MEMBER_RE.findall(arm))
+        for callee in set(_CALL_RE.findall(arm)):
+            refs |= _method_members(stripped, callee, cache)
+        members[rtype] = refs - _INFRA_MEMBERS
+    return arms, members
+
+
+def _parse_snapshot(stripped: str) -> Tuple[str, int]:
+    snap, line = _function_body(stripped, r"Json\s+snapshot_state\s*\(")
+    restore, _ = _function_body(stripped, r"void\s+restore_snapshot\s*\(")
+    return snap + "\n" + restore, line
+
+
+def _parse_dump_state(stripped: str) -> List[str]:
+    body, _ = _function_body(stripped, r"Json\s+debug_state\s*\(")
+    return sorted(set(re.findall(r"\.set\(\s*\"([\w.]+)\"", body)))
+
+
+def _parse_metrics(stripped: str) -> List[Tuple[str, int]]:
+    m = re.search(r"srv\s*\.\s*route\(\s*\"GET\"\s*,\s*\"/metrics\"", stripped)
+    if not m:
+        return []
+    nxt = _ROUTE_RE.search(stripped, m.end())
+    body = stripped[m.start(): nxt.start() if nxt else len(stripped)]
+    base = m.start()
+    seen: Dict[str, int] = {}
+    for lit in re.finditer(r"\"([^\"\n]*)\"", body):
+        for name in re.finditer(r"\bdtpu_\w+", lit.group(1)):
+            seen.setdefault(name.group(0), _line_of(stripped, base + lit.start()))
+    return sorted(seen.items(), key=lambda kv: (kv[1], kv[0]))
+
+
+# --------------------------------------------------------------------------
+# agent.cpp parser (wire payloads)
+# --------------------------------------------------------------------------
+
+_MASTER_REQ_RE = re.compile(r"master_req\(\s*\"(POST|PUT|PATCH)\"\s*,")
+
+
+def _concat_to_norm(expr: str) -> Optional[str]:
+    """``"/api/v1/trials/" + std::to_string(id) + "/exit"`` -> normalized
+    path with ``{}`` for every non-literal piece."""
+    pieces = re.findall(r"\"([^\"]*)\"", expr)
+    if not pieces:
+        return None
+    if len(pieces) == 1 and expr.strip() == f'"{pieces[0]}"':
+        return norm_path(pieces[0])
+    return norm_path("{}".join([pieces[0]] + [p.lstrip() for p in pieces[1:]]))
+
+
+def _split_args(text: str) -> List[str]:
+    """Split a C++ argument list on top-level commas."""
+    args: List[str] = []
+    depth = 0
+    cur: List[str] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == '"':
+            j = i + 1
+            while j < n and text[j] != '"':
+                j += 2 if text[j] == "\\" else 1
+            cur.append(text[i: j + 1])
+            i = j + 1
+            continue
+        if c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+        elif c == "," and depth == 0:
+            args.append("".join(cur).strip())
+            cur = []
+            i += 1
+            continue
+        cur.append(c)
+        i += 1
+    if cur:
+        args.append("".join(cur).strip())
+    return args
+
+
+def _parse_wire_payloads(stripped: str) -> List[WirePayload]:
+    payloads: List[WirePayload] = []
+    lines = stripped.splitlines()
+    for m in _MASTER_REQ_RE.finditer(stripped):
+        open_idx = stripped.index("(", m.start())
+        end = _balanced_span(stripped, open_idx)
+        args = _split_args(stripped[open_idx + 1: end - 1])
+        if len(args) < 3:
+            continue
+        path = _concat_to_norm(args[1])
+        dm = re.fullmatch(r"([A-Za-z_]\w*)\s*\.\s*dump\(\)", args[2])
+        if path is None or dm is None:
+            continue
+        var = dm.group(1)
+        line = _line_of(stripped, m.start())
+        # the payload is built in the lines just above the send: the
+        # builder region starts at the LAST `var = Json::object()` before
+        # the send, so an earlier same-named payload in the enclosing
+        # function (or a neighboring one) never leaks its keys in
+        lo = max(0, line - 60)
+        builder_re = re.compile(r"(?<![\w])" + re.escape(var) + r"\s*=\s*Json::object\(\)")
+        for back in range(line - 1, lo, -1):
+            if back - 1 < len(lines) and builder_re.search(lines[back - 1]):
+                lo = back - 1
+                break
+        region = "\n".join(lines[lo: line])
+        fields: List[WireField] = []
+        arrays: Dict[str, str] = {}  # array var -> top-level key
+        for sm in re.finditer(
+            r"(?<![\w])" + re.escape(var) + r"\.set\(\s*\"(\w+)\"\s*,\s*([A-Za-z_]\w*)?", region
+        ):
+            key, valvar = sm.group(1), sm.group(2)
+            fields.append(WireField(key, lo + region.count("\n", 0, sm.start()) + 1))
+            if valvar and re.search(
+                r"(?<![\w])" + re.escape(valvar) + r"\s*=\s*Json::array\(\)", region
+            ):
+                arrays[valvar] = key
+        for arr, key in arrays.items():
+            for pm in re.finditer(r"(?<![\w])" + re.escape(arr) + r"\.push_back\(", region):
+                pend = _balanced_span(region, region.index("(", pm.start()))
+                elem = region[pm.start(): pend]
+                for km in re.finditer(r"\.set\(\s*\"(\w+)\"", elem):
+                    fields.append(
+                        WireField(
+                            f"{key}[].{km.group(1)}",
+                            lo + region.count("\n", 0, pm.start() + km.start()) + 1,
+                        )
+                    )
+                # elements built separately then pushed: el.set("k", ...)
+                em = re.fullmatch(
+                    r".*push_back\(\s*([A-Za-z_]\w*)\s*\)", elem, re.DOTALL
+                )
+                if em:
+                    for km in re.finditer(
+                        r"(?<![\w])" + re.escape(em.group(1)) + r"\.set\(\s*\"(\w+)\"", region
+                    ):
+                        fields.append(
+                            WireField(
+                                f"{key}[].{km.group(1)}",
+                                lo + region.count("\n", 0, km.start()) + 1,
+                            )
+                        )
+        payloads.append(WirePayload(m.group(1), path, line, fields))
+    return payloads
+
+
+# --------------------------------------------------------------------------
+# python-side parsers
+# --------------------------------------------------------------------------
+
+
+def _parse_spec_routes(source: str) -> List[Tuple[str, str]]:
+    """(method, normalized path) rows from api/spec.py's ROUTES list."""
+    out: List[Tuple[str, str]] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return out
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "ROUTES" for t in node.targets
+        )):
+            continue
+        if not isinstance(node.value, (ast.List, ast.Tuple)):
+            continue
+        for row in node.value.elts:
+            if isinstance(row, ast.Tuple) and len(row.elts) >= 2:
+                meth, path = row.elts[0], row.elts[1]
+                if isinstance(meth, ast.Constant) and isinstance(path, ast.Constant):
+                    out.append((str(meth.value), norm_path(str(path.value))))
+    return out
+
+
+_API_ROW_RE = re.compile(r"^\|\s*`?([A-Z]+)`?\s*\|\s*`([^`]+)`", re.MULTILINE)
+
+
+def _parse_api_md(text: str) -> Set[Tuple[str, str]]:
+    return {(m.group(1), norm_path(m.group(2))) for m in _API_ROW_RE.finditer(text)}
+
+
+_METRIC_TOKEN_RE = re.compile(r"dtpu_[\w{},]*[\w}]")
+
+
+def _documented_metric_names(text: str) -> Set[str]:
+    """Metric names mentioned in the operations doc, with ``{a,b}`` brace
+    groups expanded — ``dtpu_reattach_{adopted,lost}_total`` documents
+    both counters."""
+    out: Set[str] = set()
+    for tok in _METRIC_TOKEN_RE.findall(text):
+        variants = [""]
+        for piece in re.split(r"(\{[^{}]*\})", tok):
+            if piece.startswith("{") and piece.endswith("}"):
+                alts = piece[1:-1].split(",")
+                variants = [v + a for v in variants for a in alts]
+            else:
+                variants = [v + piece for v in variants]
+        out.update(variants)
+    return out
+
+
+_PY_ROUTE_LIT_RE = re.compile(r"[\"'](/(?:api|v1|proxy|metrics|debug)[^\"'\s]*)")
+
+
+def _parse_python_route_literals(sources: Dict[str, str]) -> Set[str]:
+    """Normalized route paths referenced anywhere in the Python package
+    (plain strings and f-strings alike: ``{expr}`` already reads as a
+    placeholder)."""
+    out: Set[str] = set()
+    for src in sources.values():
+        for m in _PY_ROUTE_LIT_RE.finditer(src):
+            out.add(norm_path(m.group(1).rstrip("?")))
+    return out
+
+
+def _parse_fuzz_types(source: str) -> Set[str]:
+    """Record types covered by the devcluster ``sample_*_events``
+    fixtures (the torn-tail fuzz corpus in test_master_wal)."""
+    types: Set[str] = set()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return types
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef) and re.fullmatch(r"sample_\w*events", node.name)):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                for k, v in zip(sub.keys, sub.values):
+                    if (
+                        isinstance(k, ast.Constant) and k.value == "type"
+                        and isinstance(v, ast.Constant) and isinstance(v.value, str)
+                    ):
+                        types.add(v.value)
+    return types
+
+
+# ---- fake masters ---------------------------------------------------------
+
+
+def _path_expr(node: ast.AST) -> bool:
+    """Is this expression the request path (``self.path`` or a local
+    derived from it, conventionally named ``path``/``parts``)?"""
+    if isinstance(node, ast.Attribute) and node.attr == "path":
+        return True
+    if isinstance(node, ast.Name) and node.id in ("path", "p"):
+        return True
+    if isinstance(node, ast.Subscript):
+        return _path_expr(node.value)
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("split", "rstrip", "strip"):
+            return _path_expr(f.value)
+    return False
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    return node.value if isinstance(node, ast.Constant) and isinstance(node.value, str) else None
+
+
+def _collect_fake_conditions(test: ast.AST) -> List[Tuple[str, Tuple]]:
+    """Route patterns asserted by one ``if`` test.  Returns a list of
+    (kind, data) — ANDed terms merge (startswith+endswith, len+segments)."""
+    terms: List[ast.AST] = (
+        list(test.values) if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And) else [test]
+    )
+    exact: Optional[str] = None
+    prefix: Optional[str] = None
+    suffix: Optional[str] = None
+    nseg: Optional[int] = None
+    segs: Dict[int, str] = {}
+    for t in terms:
+        if isinstance(t, ast.Compare) and len(t.ops) == 1 and isinstance(t.ops[0], ast.Eq):
+            left, right = t.left, t.comparators[0]
+            # len(parts) == N
+            if (
+                isinstance(left, ast.Call)
+                and isinstance(left.func, ast.Name)
+                and left.func.id == "len"
+                and isinstance(right, ast.Constant)
+                and isinstance(right.value, int)
+            ):
+                nseg = right.value
+                continue
+            # parts[i] == "seg"
+            if (
+                isinstance(left, ast.Subscript)
+                and isinstance(left.value, ast.Name)
+                and left.value.id.startswith("part")
+            ):
+                idx = left.slice
+                s = _const_str(right)
+                if isinstance(idx, ast.Constant) and isinstance(idx.value, int) and s is not None:
+                    segs[idx.value] = s
+                continue
+            # path == "..."
+            s = _const_str(right)
+            if s is not None and _path_expr(left):
+                exact = s
+        elif isinstance(t, ast.Call) and isinstance(t.func, ast.Attribute):
+            s = _const_str(t.args[0]) if t.args else None
+            if s is None or not _path_expr(t.func.value):
+                continue
+            if t.func.attr == "startswith":
+                prefix = s
+            elif t.func.attr == "endswith":
+                suffix = s
+    out: List[Tuple[str, Tuple]] = []
+    if exact is not None:
+        out.append(("exact", (exact,)))
+    if prefix is not None and suffix is not None:
+        out.append(("prefix+suffix", (prefix, suffix)))
+    elif prefix is not None:
+        out.append(("prefix", (prefix,)))
+    elif suffix is not None:
+        out.append(("suffix", (suffix,)))
+    if nseg is not None and segs:
+        out.append(("segments", (nseg, tuple(sorted(segs.items())))))
+    return out
+
+
+def _parse_fake_routes(source: str) -> List[FakeRoute]:
+    """Route patterns each fake master's HTTP handlers answer.
+
+    The handler class is usually an inner ``class Handler(...)`` built
+    inside ``FakeMaster.__init__``, so qualification walks the lexical
+    stack: any ``do_*`` method whose enclosing scopes include a name with
+    both "Fake" and "Master" belongs to that fake.
+    """
+    routes: List[FakeRoute] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return routes
+
+    def visit(node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)):
+                sub = stack + [child.name]
+                joined = ".".join(sub)
+                if (
+                    isinstance(child, ast.FunctionDef)
+                    and child.name.startswith("do_")
+                    and "Fake" in joined
+                    and "Master" in joined
+                ):
+                    owner = next(
+                        (s for s in stack if "Fake" in s and "Master" in s), stack[-1] if stack else "?"
+                    )
+                    method = child.name[3:].upper()
+                    for n in ast.walk(child):
+                        if isinstance(n, ast.If):
+                            for kind, data in _collect_fake_conditions(n.test):
+                                routes.append(FakeRoute(method, kind, data, n.test.lineno, owner))
+                visit(child, sub)
+            else:
+                visit(child, stack)
+
+    visit(tree, [])
+    return routes
+
+
+def _seg_match(master_seg: str, seg: str) -> bool:
+    return master_seg == "{}" or master_seg == seg
+
+
+def _match_fake_route(fr: FakeRoute, master_routes: Sequence[Route]) -> bool:
+    """Does any real master route answer what this fake pattern handles?"""
+    candidates = [r for r in master_routes if r.method in (fr.method, "*")]
+    if fr.kind == "exact":
+        segs = [s for s in fr.data[0].strip("/").split("/") if s != ""]
+        for r in candidates:
+            rsegs = r.norm.strip("/").split("/")
+            if len(rsegs) == len(segs) and all(_seg_match(a, b) for a, b in zip(rsegs, segs)):
+                return True
+        return False
+    if fr.kind == "segments":
+        nseg, pairs = fr.data
+        for r in candidates:
+            rsegs = r.norm.strip("/").split("/")
+            if len(rsegs) == nseg and all(
+                i < len(rsegs) and _seg_match(rsegs[i], s) for i, s in pairs
+            ):
+                return True
+        return False
+
+    def prefix_ok(rsegs: List[str], prefix: str) -> bool:
+        whole = prefix.endswith("/")
+        parts = [s for s in prefix.strip("/").split("/") if s != ""]
+        if len(parts) > len(rsegs):
+            return False
+        for i, p in enumerate(parts):
+            last = i == len(parts) - 1
+            if last and not whole:
+                if not (rsegs[i] == "{}" or rsegs[i].startswith(p)):
+                    return False
+            elif not _seg_match(rsegs[i], p):
+                return False
+        return True
+
+    def suffix_ok(rsegs: List[str], suffix: str) -> bool:
+        whole = suffix.startswith("/")
+        parts = [s for s in suffix.strip("/").split("/") if s != ""]
+        if len(parts) > len(rsegs):
+            return False
+        for j, p in enumerate(reversed(parts)):
+            seg = rsegs[len(rsegs) - 1 - j]
+            first = j == len(parts) - 1
+            if first and not whole:
+                if not (seg == "{}" or seg.endswith(p)):
+                    return False
+            elif not _seg_match(seg, p):
+                return False
+        return True
+
+    for r in candidates:
+        rsegs = r.norm.strip("/").split("/")
+        if fr.kind == "prefix" and prefix_ok(rsegs, fr.data[0]):
+            return True
+        if fr.kind == "suffix" and suffix_ok(rsegs, fr.data[0]):
+            return True
+        if fr.kind == "prefix+suffix" and prefix_ok(rsegs, fr.data[0]) and suffix_ok(rsegs, fr.data[1]):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# index construction
+# --------------------------------------------------------------------------
+
+
+def build_native_index(ns: NativeSources) -> NativeIndex:
+    idx = NativeIndex()
+    stripped = _strip_comments(ns.master[1])
+    idx.routes = _parse_routes(stripped)
+    idx.wal_sites = _parse_wal_sites(stripped)
+    idx.replay_arms, idx.replay_members = _parse_replay(stripped)
+    idx.snapshot_text, idx.snapshot_line = _parse_snapshot(stripped)
+    idx.dump_state_keys = _parse_dump_state(stripped)
+    idx.metrics = _parse_metrics(stripped)
+    if ns.agent:
+        idx.wire_payloads = _parse_wire_payloads(_strip_comments(ns.agent[1]))
+    if ns.wal:
+        wal_stripped = _strip_comments(ns.wal[1])
+        idx.wal_symbols = set(
+            re.findall(r"\b(?:bool|void|int64_t|size_t|std::string)\s+(\w+)\s*\(", wal_stripped)
+        )
+    return idx
+
+
+# --------------------------------------------------------------------------
+# the pass
+# --------------------------------------------------------------------------
+
+
+def run_native_pass(ns: NativeSources, rules: Sequence) -> List[Diagnostic]:
+    """Cross-reference the :class:`NativeIndex` against the Python side and
+    report through the ``native = True`` rules in ``rules``."""
+    by_id = {r.id: r for r in rules if getattr(r, "native", False)}
+    if not by_id:
+        return []
+    idx = build_native_index(ns)
+    master_file = ns.master[0]
+    raw: List[Diagnostic] = []
+
+    def report(rule_id: str, file: str, line: int, message: str) -> None:
+        rule = by_id.get(rule_id)
+        if rule is not None:
+            raw.append(Diagnostic(rule.id, rule.severity, message, file, line, 0))
+
+    # ---- WAL contract ----------------------------------------------------
+    rec_types = idx.record_types()
+    fuzz_types = _parse_fuzz_types(ns.fuzz[1]) if ns.fuzz else None
+    for rtype, sites in sorted(rec_types.items()):
+        witness = f"{master_file}:{sites[0]}" + (
+            f" (+{len(sites) - 1} more site{'s' if len(sites) > 2 else ''})" if len(sites) > 1 else ""
+        )
+        if rtype not in idx.replay_arms:
+            report(
+                "wal-replay-gap", master_file, sites[0],
+                f"WAL record type '{rtype}' is emitted at {witness} but apply_event "
+                f"has no `type == \"{rtype}\"` replay arm — the journaled mutation "
+                "is lost at boot replay",
+            )
+        if fuzz_types is not None and rtype not in fuzz_types:
+            report(
+                "wal-fuzz-gap", master_file, sites[0],
+                f"WAL record type '{rtype}' (emitted at {witness}) is missing from "
+                f"the torn-tail fuzz fixtures in {ns.fuzz[0]} (sample_*_events) — "
+                "truncation mid-record is never exercised for it",
+            )
+    for site in idx.wal_sites:
+        if site.rtype is None:
+            report(
+                "wal-replay-gap", master_file, site.line,
+                "record(...) call whose record type could not be resolved — keep the "
+                '`.set("type", "...")` literal on the builder so replay coverage '
+                "stays checkable",
+            )
+    if idx.snapshot_text:
+        for rtype, arm_line in sorted(idx.replay_arms.items()):
+            missing = sorted(
+                m for m in idx.replay_members.get(rtype, set())
+                if m not in idx.snapshot_text
+            )
+            if missing:
+                report(
+                    "wal-snapshot-gap", master_file, arm_line,
+                    f"replay arm '{rtype}' touches member(s) {', '.join(missing)} that "
+                    "snapshot_state/restore_snapshot never mention — the replayed state "
+                    "is lost once the journal compacts into a snapshot",
+                )
+
+    # ---- route contract --------------------------------------------------
+    spec_paths = {p for _, p in _parse_spec_routes(ns.spec[1])} if ns.spec else None
+    api_rows = _parse_api_md(ns.api_md[1]) if ns.api_md else None
+    py_lits = _parse_python_route_literals(ns.python) if ns.python else set()
+    for r in idx.routes:
+        if r.method == "*":
+            continue
+        if spec_paths is not None and r.norm not in spec_paths and r.norm not in py_lits:
+            report(
+                "route-unbound", master_file, r.line,
+                f"master route {r.method} {r.path} ({r.auth}) has no api/spec.py entry "
+                "and no route literal anywhere in the Python package — unreachable "
+                "from the shipped client",
+            )
+        if api_rows is not None and (r.method, r.norm) not in api_rows:
+            report(
+                "route-undocumented", master_file, r.line,
+                f"master route {r.method} {r.path} is missing from {ns.api_md[0]}'s "
+                "live contract table (API.md is generated from api/spec.py: add a "
+                "ROUTES row and regenerate)",
+            )
+
+    # ---- metrics contract ------------------------------------------------
+    if ns.ops_md:
+        documented = _documented_metric_names(ns.ops_md[1])
+        for name, line in idx.metrics:
+            if name not in documented:
+                report(
+                    "metric-undocumented", master_file, line,
+                    f"/metrics emits '{name}' but {ns.ops_md[0]} never documents it",
+                )
+
+    # ---- fake-master conformance ----------------------------------------
+    for fname, src in sorted(ns.fakes.items()):
+        for fr in _parse_fake_routes(src):
+            if not _match_fake_route(fr, idx.routes):
+                shown = (
+                    fr.data[0] if fr.kind in ("exact", "prefix", "suffix")
+                    else " + ".join(str(d) for d in fr.data)
+                )
+                report(
+                    "fake-master-conformance", fname, fr.line,
+                    f"{fr.cls}.do_{fr.method} handles '{shown}' ({fr.kind}) but no "
+                    f"real master route matches it — the fake pins driver behavior "
+                    "the real control plane does not have",
+                )
+
+    # ---- wire payload symmetry ------------------------------------------
+    if ns.agent:
+        agent_file = ns.agent[0]
+        routes_by_key = {(r.method, r.norm): r for r in idx.routes}
+        stripped_master = _strip_comments(ns.master[1])
+        route_matches = list(_ROUTE_RE.finditer(stripped_master))
+        for wp in idx.wire_payloads:
+            r = routes_by_key.get((wp.method, wp.norm))
+            if r is None:
+                continue  # route-unbound territory, not field symmetry
+            # the handler span: from its route site to the next route
+            start = end = None
+            for i, mm in enumerate(route_matches):
+                if _line_of(stripped_master, mm.start()) == r.line:
+                    start = mm.start()
+                    end = route_matches[i + 1].start() if i + 1 < len(route_matches) else len(stripped_master)
+                    break
+            if start is None:
+                continue
+            handler = stripped_master[start:end]
+            if re.search(r"\brecord\(\s*body\s*\)", handler):
+                continue  # body journaled wholesale; every key is "read"
+            reads = set(re.findall(r"\[\s*\"(\w+)\"\s*\]", handler))
+            reads |= set(re.findall(r"contains\(\s*\"(\w+)\"\s*\)", handler))
+            for f in wp.fields:
+                leaf = f.key.split(".")[-1].split("[")[0]
+                if leaf not in reads:
+                    report(
+                        "wire-field-unread", agent_file, f.line,
+                        f"agent payload for {wp.method} {wp.norm} sets '{f.key}' but "
+                        f"the master handler ({master_file}:{r.line}) never reads it — "
+                        "dead wire field: drop it or read it",
+                    )
+
+    # ---- suppressions ----------------------------------------------------
+    supp: Dict[str, Dict[int, Optional[Set[str]]]] = {}
+
+    def suppressed(d: Diagnostic) -> bool:
+        if d.file not in supp:
+            src = None
+            if d.file == master_file:
+                src = ns.master[1]
+            elif ns.agent and d.file == ns.agent[0]:
+                src = ns.agent[1]
+            if src is not None:
+                supp[d.file] = cpp_suppressions(src)
+            elif d.file in ns.fakes:
+                supp[d.file] = parse_suppressions(ns.fakes[d.file])
+            else:
+                supp[d.file] = {}
+        rules_at = supp[d.file].get(d.line, set())
+        return rules_at is None or d.rule in (rules_at or set())
+
+    return sorted(
+        (d for d in raw if not suppressed(d)),
+        key=lambda d: (d.file, d.line, d.col, d.rule),
+    )
+
+
+# --------------------------------------------------------------------------
+# repo wiring
+# --------------------------------------------------------------------------
+
+_MASTER_REL = os.path.join("native", "master", "master.cpp")
+
+
+def find_native_root(start: str) -> Optional[str]:
+    """Walk up from ``start`` to the directory that holds the native
+    control plane (``native/master/master.cpp``)."""
+    cur = os.path.abspath(start if os.path.isdir(start) else os.path.dirname(start) or ".")
+    while True:
+        if os.path.isfile(os.path.join(cur, _MASTER_REL)):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def _read_rel(root: str, rel: str) -> Optional[Tuple[str, str]]:
+    path = os.path.join(root, rel)
+    if not os.path.isfile(path):
+        return None
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return rel, f.read()
+
+
+def collect_native_sources(root: str) -> NativeSources:
+    """The real repo layout -> one :class:`NativeSources` set."""
+    master = _read_rel(root, _MASTER_REL)
+    if master is None:
+        raise FileNotFoundError(f"no {_MASTER_REL} under {root}")
+    python: Dict[str, str] = {}
+    pkg = os.path.join(root, "determined_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                got = _read_rel(root, rel)
+                if got:
+                    python[got[0]] = got[1]
+    fakes: Dict[str, str] = {}
+    tests = os.path.join(root, "tests")
+    if os.path.isdir(tests):
+        for fn in sorted(os.listdir(tests)):
+            if fn.startswith("test_") and fn.endswith(".py"):
+                got = _read_rel(root, os.path.join("tests", fn))
+                if got and "Master" in got[1] and "Fake" in got[1]:
+                    fakes[got[0]] = got[1]
+    return NativeSources(
+        master=master,
+        agent=_read_rel(root, os.path.join("native", "agent", "agent.cpp")),
+        wal=_read_rel(root, os.path.join("native", "master", "wal.hpp")),
+        spec=_read_rel(root, os.path.join("determined_tpu", "api", "spec.py")),
+        api_md=_read_rel(root, "API.md"),
+        ops_md=_read_rel(root, os.path.join("docs", "operations.md")),
+        fuzz=_read_rel(root, os.path.join("scripts", "devcluster.py")),
+        python=python,
+        fakes=fakes,
+    )
+
+
+def lint_native(root: str, rules: Sequence) -> List[Diagnostic]:
+    """Run the control-plane contract pass over the real repo at ``root``."""
+    return run_native_pass(collect_native_sources(root), rules)

@@ -80,6 +80,7 @@ from determined_tpu.lint.rules import (  # noqa: E402,F401
     control_flow,
     defaults,
     host_sync,
+    native,
     randomness,
     side_effects,
     spmd,

@@ -1,0 +1,108 @@
+"""Control-plane contract rules (``dtpu lint --native``).
+
+These are ``program_level`` rules like the concurrency/SPMD sets, but they
+run over the :class:`~determined_tpu.lint._native.NativeIndex` — the
+pattern-anchored parse of the native master/agent sources — instead of the
+Python ``ProgramIndex``.  The ``native = True`` marker is what
+``lint/_native.py`` dispatches on; the Python program passes select rules
+by id and ignore these.
+
+Suppressions in C++ sources use the same comment form as Python:
+``// dtpu: lint-ok[route-unbound] agent-internal; reached via master_req``.
+"""
+
+from __future__ import annotations
+
+from determined_tpu.lint._diag import ERROR, WARNING
+from determined_tpu.lint.rules import Rule, register
+
+
+class NativeRule(Rule):
+    """Base for rules driven by the native contract pass."""
+
+    program_level = True
+    #: dispatched by lint/_native.py, skipped by the Python program passes
+    native = True
+
+
+@register
+class WalReplayGap(NativeRule):
+    id = "wal-replay-gap"
+    severity = ERROR
+    description = (
+        "a WAL record type is emitted by record(...) but apply_event has no "
+        "replay arm for it — the acknowledged mutation vanishes at boot"
+    )
+
+
+@register
+class WalSnapshotGap(NativeRule):
+    id = "wal-snapshot-gap"
+    severity = WARNING
+    description = (
+        "a replay arm mutates state that snapshot_state/restore_snapshot "
+        "never serialize — replayed fine from the journal, lost after "
+        "compaction folds the journal into a snapshot"
+    )
+
+
+@register
+class WalFuzzGap(NativeRule):
+    id = "wal-fuzz-gap"
+    severity = WARNING
+    description = (
+        "an emitted WAL record type is absent from the devcluster "
+        "sample_*_events fixtures, so the torn-tail fuzz in "
+        "test_master_wal never truncates mid-record for it"
+    )
+
+
+@register
+class RouteUnbound(NativeRule):
+    id = "route-unbound"
+    severity = WARNING
+    description = (
+        "a master route has no api/spec.py entry and no route literal "
+        "anywhere in the Python package — dead dispatch or a missing binding"
+    )
+
+
+@register
+class RouteUndocumented(NativeRule):
+    id = "route-undocumented"
+    severity = ERROR
+    description = (
+        "a master route is missing from API.md's live contract table "
+        "(generated from api/spec.py and replayed against a live master by "
+        "test_api_contract)"
+    )
+
+
+@register
+class MetricUndocumented(NativeRule):
+    id = "metric-undocumented"
+    severity = WARNING
+    description = (
+        "/metrics emits a dtpu_* series that docs/operations.md never "
+        "documents"
+    )
+
+
+@register
+class FakeMasterConformance(NativeRule):
+    id = "fake-master-conformance"
+    severity = WARNING
+    description = (
+        "a test fake master answers a route the real master does not "
+        "dispatch — the fake pins driver behavior the control plane lacks"
+    )
+
+
+@register
+class WireFieldUnread(NativeRule):
+    id = "wire-field-unread"
+    severity = WARNING
+    description = (
+        "an agent->master payload field is emitted but the matching master "
+        "handler never reads it — dead wire weight and a drifted contract"
+    )

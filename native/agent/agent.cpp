@@ -151,18 +151,16 @@ class Agent {
     // restarted master matches these against its journaled placements and
     // re-adopts the gang in place; allocations it cannot match come back
     // as kill work (stale processes from before a reschedule).
-    // id + trial_id only: the master takes per-agent slot counts from its
-    // own journaled groups, never from the report (an agent cannot know
-    // the gang-wide layout, and a self-reported count could not be
+    // id only: the master takes trial ids and per-agent slot counts from
+    // its own journaled groups, never from the report (an agent cannot
+    // know the gang-wide layout, and a self-reported view could not be
     // trusted across restarts anyway)
     Json allocs = Json::array();
     {
       std::lock_guard<std::mutex> lk(mu_);
       for (const auto& [alloc_id, proc] : running_) {
         if (proc.trial_id < 0) continue;  // aux tasks are ephemeral by design
-        allocs.push_back(Json::object()
-                             .set("id", alloc_id)
-                             .set("trial_id", Json(proc.trial_id)));
+        allocs.push_back(Json::object().set("id", alloc_id));
       }
     }
     body.set("allocations", allocs);

@@ -2040,6 +2040,7 @@ class Master {
         versions.push_back(ev["version"]);
         it->second.set("versions", versions);
       }
+      // dtpu: lint-ok[wal-snapshot-gap] tasks_ slots are runtime process state; the supervisor relaunches them from the snapshotted fleet_ spec
     } else if (type == "fleet_spec") {
       do_set_fleet(ev["model"].as_string(), ev["version"].as_int(),
                    ev["target"].as_int(), ev["config"],
@@ -5098,6 +5099,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
   }));
 
   // WebUI: embedded single-page app (reference webui/react; see webui.hpp)
+  // dtpu: lint-ok[route-unbound,route-undocumented] browser landing page, not API surface
   srv.route("GET", "/", [](const HttpRequest&) {
     HttpResponse r;
     r.content_type = "text/html; charset=utf-8";
@@ -5187,7 +5189,14 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         << m.admission_.shed.load(std::memory_order_relaxed) << "\n"
         << "# TYPE dtpu_ingest_inflight gauge\n"
         << "dtpu_ingest_inflight "
-        << m.admission_.inflight.load(std::memory_order_relaxed) << "\n";
+        << m.admission_.inflight.load(std::memory_order_relaxed) << "\n"
+        << "# HELP dtpu_serve_replicas live registered serving replicas\n"
+        << "# TYPE dtpu_serve_replicas gauge\n"
+        << "dtpu_serve_replicas " << m.serve_replicas_.size() << "\n"
+        << "# HELP dtpu_fleet_target supervised fleet replica target\n"
+        << "# TYPE dtpu_fleet_target gauge\n"
+        << "dtpu_fleet_target " << (m.fleet_active_ ? m.fleet_.target : 0)
+        << "\n";
     HttpResponse r;
     r.content_type = "text/plain; version=0.0.4";
     r.body = out.str();
@@ -7727,6 +7736,7 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     return out;
   };
   for (const char* method : {"GET", "POST", "PUT", "DELETE", "PATCH", "HEAD"}) {
+    // dtpu: lint-ok[route-undocumented] one handler serves every verb; the GET row in API.md documents the proxy
     srv.route(method, "/proxy/{id}/{*rest}", proxy_handler);
   }
 

@@ -511,6 +511,116 @@ def sample_serving_events():
     ]
 
 
+def sample_control_events():
+    """Control-plane journal fixture covering every WAL record type the
+    other sample_*_events fixtures do not: identity/tokens, workspace ->
+    project -> group RBAC, templates + config policies, webhooks, agent
+    topology labels, the full driver-trial lifecycle (placement, external
+    refs, log policies, checkpoints, yield/restart/exit), experiment
+    teardown, and a failed canary deploy.  ``dtpu lint --native``'s
+    wal-fuzz-gap rule pins the union of these fixtures against the
+    master's actual ``record(...)`` sites, so a new record type that is
+    never truncation-fuzzed fails lint.  Self-contained (ids avoid the
+    other fixtures') and replay-ordered: every referenced entity is
+    created before use."""
+    cfg = {
+        "name": "wal-control-fixture",
+        "entrypoint": "determined_tpu.models.mnist:MnistTrial",
+        "hyperparameters": {"lr": 0.1},
+        "searcher": {
+            "name": "driver",
+            "metric": "validation_loss",
+            "max_length": {"batches": 8},
+        },
+        "resources": {"slots_per_trial": 1},
+    }
+    return [
+        # identity + named tokens
+        {"type": "user_set", "username": "wal-ops", "salt": "s1",
+         "pwhash": "h1", "admin": True, "role": "admin"},
+        {"type": "token_issued", "token": "tok-secret-1", "id": "tok-1",
+         "username": "wal-ops", "name": "ci", "expires_ms": 0,
+         "created_ms": 1},
+        {"type": "token_revoked", "token": "tok-secret-1"},
+        # workspace -> project hierarchy + user/group role bindings
+        {"type": "workspace_created", "name": "wal-ws", "owner": "wal-ops",
+         "ts": 2},
+        {"type": "workspace_role_set", "name": "wal-ws",
+         "username": "wal-ops", "group": "", "role": "admin"},
+        {"type": "group_created", "name": "wal-group"},
+        {"type": "group_member_added", "name": "wal-group",
+         "username": "wal-ops"},
+        {"type": "workspace_role_set", "name": "wal-ws", "username": "",
+         "group": "wal-group", "role": "editor"},
+        {"type": "project_created", "name": "wal-proj",
+         "workspace": "wal-ws", "description": "d", "owner": "wal-ops",
+         "ts": 3},
+        {"type": "project_patched", "name": "wal-proj",
+         "workspace": "wal-ws", "description": "d2",
+         "notes": [{"name": "n", "contents": "c"}]},
+        {"type": "project_archived", "name": "wal-proj",
+         "workspace": "wal-ws", "archived": True},
+        {"type": "workspace_archived", "name": "wal-ws", "archived": True},
+        # cluster config surfaces + webhooks + topology labels
+        {"type": "template_set", "name": "wal-tpl",
+         "config": {"max_restarts": 2}},
+        {"type": "config_policy_set", "scope": "cluster",
+         "policy": {"constraints": {"max_slots": 8}}},
+        {"type": "webhook_created", "id": 9, "name": "wal-hook",
+         "url": "http://127.0.0.1:1/x", "on_custom": False,
+         "trigger_states": ["ERROR"]},
+        {"type": "agent_topology", "agent": "agent-wal",
+         "slice": "slice-0"},
+        # driver experiment through its full trial lifecycle
+        {"type": "exp_created", "id": 5, "owner": "wal-ops", "config": cfg},
+        {"type": "exp_state", "id": 5, "state": "PAUSED"},
+        {"type": "experiment_moved", "id": 5, "workspace": "wal-ws",
+         "project": "wal-proj"},
+        {"type": "driver_trial", "experiment_id": 5, "request_id": 1,
+         "hparams": {"lr": 0.1}, "source_checkpoint": "", "trial_id": 50},
+        {"type": "alloc_placed", "id": "alloc-50", "trial_id": 50,
+         "slots": 1, "groups": [{"agent": "agent-wal", "slots": 1}],
+         "coord_host": "127.0.0.1", "coord_port": 7777, "chief_port": 7878,
+         "session_token": "sess", "external_kind": "", "external_pool": ""},
+        {"type": "alloc_external_ref", "id": "alloc-50", "ref": "tpu-vm-1"},
+        {"type": "log_policy", "trial_id": 50, "policy": "on-failure",
+         "action": "exclude_node", "agent": "agent-wal"},
+        {"type": "checkpoint", "uuid": "uuid-wal-1", "trial_id": 50,
+         "step": 4, "storage_path": "/ck/uuid-wal-1"},
+        {"type": "trial_seed_checkpoint", "trial_id": 50,
+         "uuid": "uuid-wal-0"},
+        {"type": "trial_yielded", "trial_id": 50},
+        {"type": "trial_restarted", "trial_id": 50},
+        {"type": "trial_exited", "trial_id": 50, "exit_code": 0},
+        {"type": "searcher_shutdown", "id": 5},
+        {"type": "ckpt_deleted", "uuid": "uuid-wal-1"},
+        {"type": "exp_deleted", "id": 5},
+        # a canary deploy that fails its bake and rolls back
+        {"type": "deploy_started", "id": 2, "model": "wal-model",
+         "version": 3, "prev_version": 2, "target": "wal-model@v3",
+         "checkpoint_uuid": "uuid-ccc", "storage_path": "/ck/uuid-ccc",
+         "pending": ["replica-c"], "canary_fraction": 0.5,
+         "canary_count": 1, "rollback_on_regression": True,
+         "bake_ms": 5000, "error_rate_threshold": 0.05,
+         "latency_factor": 2.0, "min_requests": 10,
+         "baseline": {"requests": 100, "error_rate": 0.01,
+                      "latency_ms": 20.0},
+         "phase": "canary"},
+        {"type": "deploy_failed", "id": 2,
+         "detail": "canary regression: error_rate"},
+        # teardown records (each erases durable state the digest shows)
+        {"type": "group_member_removed", "name": "wal-group",
+         "username": "wal-ops"},
+        {"type": "group_deleted", "name": "wal-group"},
+        {"type": "webhook_deleted", "id": 9},
+        {"type": "template_deleted", "name": "wal-tpl"},
+        {"type": "config_policy_deleted", "scope": "cluster"},
+        {"type": "project_deleted", "name": "wal-proj",
+         "workspace": "wal-ws"},
+        {"type": "workspace_deleted", "name": "wal-ws"},
+    ]
+
+
 def train_tiny_lm_checkpoint(root: str):
     """Train a 2-step tiny LMTrial and return (checkpoint_dir, uuid) —
     the smallest servable artifact (shared with the serving tests'

@@ -29,7 +29,12 @@ cd "$REPO"
 # context code under the tree; none of that is this program (and context
 # dirs carry user .py files).  The globs prune those directories before
 # the walk instead of parsing whatever they contain.
-exec python -m determined_tpu.cli lint --strict \
+#
+# --native: the control-plane contract pass (docs/lint.md) — WAL
+# replay/snapshot/fuzz completeness, route/API.md/metrics drift,
+# fake-master conformance, dead agent wire fields.  Same strict gate:
+# drift between master.cpp and the Python side fails CI here.
+exec python -m determined_tpu.cli lint --strict --native \
   --exclude 'checkpoints' --exclude 'checkpoints/*' \
   --exclude 'traces' --exclude 'traces/*' \
   --exclude '*.egg-info' --exclude 'build' \

@@ -62,6 +62,44 @@ for src in "${SOURCES[@]}"; do
   fi
 done
 
+# -- 1.5 contract-analyzer anchor guard -------------------------------------
+# `dtpu lint --native` (determined_tpu/lint/_native.py) is pattern-anchored
+# to the daemons' idioms (srv.route literals, record(...) with a resolvable
+# .set("type", ...), one apply_event dispatch).  A refactor that moves off
+# those shapes would make the analyzer silently index nothing and pass
+# vacuously — so this stage rebuilds the real index and fails when it drops
+# below the repo's known floor.  Raise the floor when the daemons grow; if
+# this trips, the analyzer's parsers need to learn the new idiom.
+if python - <<'EOF'
+import sys
+from determined_tpu.lint import build_native_index, collect_native_sources
+
+idx = build_native_index(collect_native_sources("."))
+unresolved = sum(1 for s in idx.wal_sites if s.rtype is None)
+checks = [
+    ("routes", len(idx.routes), 80),
+    ("wal emit sites", len(idx.wal_sites), 50),
+    ("wal record types", len(idx.record_types()), 40),
+    ("replay arms", len(idx.replay_arms), 40),
+    ("/metrics names", len(idx.metrics), 15),
+    ("--dump-state keys", len(idx.dump_state_keys), 30),
+    ("agent wire payloads", len(idx.wire_payloads), 4),
+]
+bad = [f"{name}: {got} < {floor}" for name, got, floor in checks if got < floor]
+if unresolved:
+    bad.append(f"unresolved record(...) type literals: {unresolved} > 0")
+if bad:
+    print("native contract analyzer lost its anchors:", *bad, sep="\n  ")
+    sys.exit(1)
+print("anchor floor: " + ", ".join(f"{n}={g}" for n, g, _ in checks))
+EOF
+then
+  echo "ok: dtpu lint --native anchor patterns"
+else
+  echo "FAIL: dtpu lint --native anchor patterns" >&2
+  status=1
+fi
+
 # -- 2. clang-tidy (when available) -----------------------------------------
 TIDY="${CLANG_TIDY:-clang-tidy}"
 if command -v "$TIDY" >/dev/null 2>&1; then

@@ -57,6 +57,17 @@ EXEMPT_SUCCESS = {
     ("POST", "/api/v1/allocations/{id}/signals/ack_preemption"),
     # revoke needs the id minted by the POST above; e2e-covered instead
     ("DELETE", "/api/v1/tokens/{token_id}"),
+    # driver-managed searcher surface: the seeded experiment is not
+    # driver-managed (409); success paths e2e-covered by
+    # test_cluster_experiment against both fake and live masters
+    ("POST", "/api/v1/experiments/{id}/trials"),
+    ("POST", "/api/v1/experiments/{id}/searcher/shutdown"),
+    # replica id is minted by the registration POST; heartbeat/deregister
+    # success is e2e-covered by test_serving's live-master paths
+    ("POST", "/api/v1/serving/replicas/{id}/heartbeat"),
+    ("DELETE", "/api/v1/serving/replicas/{id}"),
+    # routing a generation needs a live replica behind the registered URL
+    ("POST", "/v1/generate"),
 }
 
 BODIES = {
@@ -125,6 +136,11 @@ def test_every_route_conforms(cluster, tmp_path):
     # launching replica tasks into the contract cluster
     bodies[("PUT", "/api/v1/serving/fleet")] = {
         "model": "contract-model", "version": "latest", "target": 0,
+    }
+    # a dead URL is fine: registration is just the routing-table insert;
+    # nothing dials the replica until a generate request picks it (exempt)
+    bodies[("POST", "/api/v1/serving/replicas")] = {
+        "url": "http://127.0.0.1:1/x", "model": "contract-model", "version": 1,
     }
 
     anon = requests.Session()

@@ -340,8 +340,14 @@ def _rules_hit(src: str) -> set:
 
 
 def test_rule_catalog_has_at_least_eight_rules():
+    from determined_tpu.lint.rules import build_rules
+
     assert len(all_rules()) >= 8
-    assert set(BAD) == set(CLEAN) == set(all_rules())
+    # native (control-plane contract) rules run over C++ sources, not
+    # Python fixtures — they get their own bad/clean pairs further down
+    native_ids = {r.id for r in build_rules(None, None) if getattr(r, "native", False)}
+    assert len(native_ids) >= 8
+    assert set(BAD) == set(CLEAN) == set(all_rules()) - native_ids
 
 
 @pytest.mark.parametrize("rule", sorted(BAD))
@@ -1839,3 +1845,403 @@ def test_collect_py_files_named_file_ignores_exclude(tmp_path):
     f = tmp_path / "build.py"
     f.write_text("x = 1\n")
     assert collect_py_files(str(f), exclude=("build*",)) == [str(f)]
+
+
+# ---------------------------------------------------------------------------
+# control-plane contract pass (dtpu lint --native): per-rule bad/clean
+# fixture pairs over a synthetic native tree, C++ suppressions, real-repo
+# index conformance, and seeded regressions against the real sources
+# ---------------------------------------------------------------------------
+
+NATIVE_MASTER_CLEAN = r"""
+struct Master {
+  void apply_event(const Json& ev) {
+    const std::string type = ev["type"].as_string();
+    if (type == "exp_created") {
+      experiments_[ev["id"].as_int()] = ev;
+    } else if (type == "exp_deleted") {
+      experiments_.erase(ev["id"].as_int());
+    }
+  }
+  void snapshot_state(Json& out) {
+    out.set("experiments", Json(experiments_));
+  }
+  void restore_snapshot(const Json& snap) {
+    experiments_ = snap["experiments"];
+  }
+  Json debug_state() {
+    Json d = Json::object();
+    d.set("experiments", Json(experiments_));
+    return d;
+  }
+};
+
+void routes(Server& srv, Master& m) {
+  srv.route("GET", "/api/v1/experiments", authed([&m](const HttpRequest& req) {
+    return R::json("[]");
+  }));
+  srv.route("POST", "/api/v1/experiments", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    Json ev = Json::object();
+    ev.set("type", "exp_created");
+    ev.set("id", body["id"]);
+    m.record(ev);
+    return R::json("{}");
+  }));
+  srv.route("DELETE", "/api/v1/experiments/{id}", authed([&m](const HttpRequest& req) {
+    m.record(Json::object().set("type", "exp_deleted"));
+    return R::json("{}");
+  }));
+  srv.route("POST", "/api/v1/agents", authed([&m](const HttpRequest& req) {
+    Json body;
+    if (!Json::try_parse(req.body, &body)) return R::error(400, "bad json");
+    std::string id = body["id"].as_string();
+    return R::json("{}");
+  }));
+  srv.route("GET", "/metrics", [&m](const HttpRequest&) {
+    std::ostringstream out;
+    out << "# TYPE dtpu_experiments gauge\n"
+        << "dtpu_experiments " << m.experiments_.size() << "\n";
+    HttpResponse r;
+    r.body = out.str();
+    return r;
+  });
+}
+"""
+
+NATIVE_AGENT_CLEAN = r"""
+struct Agent {
+  bool register_agent() {
+    Json body = Json::object();
+    body.set("id", opts_.id);
+    auto resp = master_req("POST", "/api/v1/agents", body.dump(), 10);
+    return resp.ok();
+  }
+};
+"""
+
+NATIVE_SPEC_CLEAN = """
+ROUTES = [
+    ("GET", "/api/v1/experiments", "token", "[]"),
+    ("POST", "/api/v1/experiments", "token", set()),
+    ("DELETE", "/api/v1/experiments/{id}", "token", set()),
+    ("POST", "/api/v1/agents", "token", set()),
+    ("GET", "/metrics", "anon", None),
+]
+"""
+
+NATIVE_API_MD_CLEAN = """\
+| method | path | auth | response |
+|---|---|---|---|
+| GET | `/api/v1/experiments` | token | array |
+| POST | `/api/v1/experiments` | token | {} |
+| DELETE | `/api/v1/experiments/{id}` | token | {} |
+| POST | `/api/v1/agents` | token | {} |
+| GET | `/metrics` | anon | raw |
+"""
+
+NATIVE_OPS_MD_CLEAN = "Metrics: `dtpu_experiments`.\n"
+
+NATIVE_FUZZ_CLEAN = """
+def sample_master_events():
+    return [
+        {"type": "exp_created", "id": 1},
+        {"type": "exp_deleted", "id": 1},
+    ]
+"""
+
+NATIVE_FAKE_CLEAN = """
+class FakeMaster:
+    def __init__(self):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/api/v1/experiments":
+                    self._send(200, [])
+            def do_POST(self):
+                if self.path == "/api/v1/agents":
+                    self._send(200, {})
+        self.handler = Handler
+"""
+
+
+def _native_sources(**overrides):
+    from determined_tpu.lint import NativeSources
+
+    base = dict(
+        master=("native/master/master.cpp", NATIVE_MASTER_CLEAN),
+        agent=("native/agent/agent.cpp", NATIVE_AGENT_CLEAN),
+        spec=("determined_tpu/api/spec.py", NATIVE_SPEC_CLEAN),
+        api_md=("API.md", NATIVE_API_MD_CLEAN),
+        ops_md=("docs/operations.md", NATIVE_OPS_MD_CLEAN),
+        fuzz=("scripts/devcluster.py", NATIVE_FUZZ_CLEAN),
+        python={"determined_tpu/api/spec.py": NATIVE_SPEC_CLEAN},
+        fakes={"tests/test_fake.py": NATIVE_FAKE_CLEAN},
+    )
+    base.update(overrides)
+    return NativeSources(**base)
+
+
+def _run_native(ns):
+    from determined_tpu.lint import run_native_pass
+    from determined_tpu.lint.rules import build_rules
+
+    return run_native_pass(ns, build_rules(None, None))
+
+
+def _native_by_rule(diags, rule):
+    return [d for d in diags if d.rule == rule]
+
+
+def test_native_clean_fixture_no_findings():
+    assert _run_native(_native_sources()) == []
+
+
+def test_native_wal_replay_gap_bad_and_witness():
+    # retarget the exp_deleted arm: its emitted type loses replay coverage
+    mutated = NATIVE_MASTER_CLEAN.replace(
+        'type == "exp_deleted"', 'type == "exp_gone"', 1
+    )
+    ns = _native_sources(master=("native/master/master.cpp", mutated))
+    found = _native_by_rule(_run_native(ns), "wal-replay-gap")
+    assert len(found) == 1
+    d = found[0]
+    assert d.severity == ERROR
+    assert "'exp_deleted'" in d.message
+    # the witness is the emit site, not the arm
+    emit_line = next(
+        i + 1 for i, l in enumerate(mutated.splitlines())
+        if '.set("type", "exp_deleted")' in l
+    )
+    assert f"native/master/master.cpp:{emit_line}" in d.message
+    assert d.line == emit_line
+
+
+def test_native_wal_replay_gap_unresolvable_type_literal():
+    # builder variable with no reachable .set("type", ...): must flag, not
+    # silently skip — unresolved sites are how coverage rots invisibly
+    mutated = NATIVE_MASTER_CLEAN.replace(
+        'Json ev = Json::object();\n    ev.set("type", "exp_created");\n'
+        '    ev.set("id", body["id"]);',
+        "Json ev = make_event(body);",
+    )
+    assert mutated != NATIVE_MASTER_CLEAN
+    ns = _native_sources(master=("native/master/master.cpp", mutated))
+    found = _native_by_rule(_run_native(ns), "wal-replay-gap")
+    assert len(found) == 1 and "could not be resolved" in found[0].message
+
+
+def test_native_wal_snapshot_gap_bad_clean_pair():
+    mutated = NATIVE_MASTER_CLEAN.replace(
+        'experiments_.erase(ev["id"].as_int());',
+        'tombstones_[ev["id"].as_int()] = true;',
+    )
+    ns = _native_sources(master=("native/master/master.cpp", mutated))
+    found = _native_by_rule(_run_native(ns), "wal-snapshot-gap")
+    assert len(found) == 1
+    assert "'exp_deleted'" in found[0].message
+    assert "tombstones_" in found[0].message
+
+
+def test_native_wal_fuzz_gap_bad_clean_pair():
+    mutated = NATIVE_FUZZ_CLEAN.replace(
+        '{"type": "exp_deleted", "id": 1},\n', ""
+    )
+    assert mutated != NATIVE_FUZZ_CLEAN
+    ns = _native_sources(fuzz=("scripts/devcluster.py", mutated))
+    found = _native_by_rule(_run_native(ns), "wal-fuzz-gap")
+    assert len(found) == 1 and "'exp_deleted'" in found[0].message
+
+
+def test_native_route_unbound_and_undocumented():
+    mutated = NATIVE_MASTER_CLEAN.replace(
+        'srv.route("GET", "/metrics"',
+        'srv.route("GET", "/api/v1/debugz", authed([&m](const HttpRequest& req) {\n'
+        '    return R::json("{}");\n'
+        "  }));\n"
+        '  srv.route("GET", "/metrics"',
+    )
+    ns = _native_sources(master=("native/master/master.cpp", mutated))
+    diags = _run_native(ns)
+    unbound = _native_by_rule(diags, "route-unbound")
+    undoc = _native_by_rule(diags, "route-undocumented")
+    assert len(unbound) == 1 and "/api/v1/debugz" in unbound[0].message
+    assert len(undoc) == 1 and "/api/v1/debugz" in undoc[0].message
+    assert undoc[0].severity == ERROR
+
+
+def test_native_route_documented_but_undocumented_row_only():
+    # spec keeps the route bound; only the API.md row is missing -> the
+    # doc-drift rule fires alone
+    mutated = NATIVE_API_MD_CLEAN.replace(
+        "| DELETE | `/api/v1/experiments/{id}` | token | {} |\n", ""
+    )
+    assert mutated != NATIVE_API_MD_CLEAN
+    ns = _native_sources(api_md=("API.md", mutated))
+    diags = _run_native(ns)
+    assert _native_by_rule(diags, "route-unbound") == []
+    undoc = _native_by_rule(diags, "route-undocumented")
+    assert len(undoc) == 1
+    assert "DELETE /api/v1/experiments/{id}" in undoc[0].message
+
+
+def test_native_metric_undocumented_bad_and_brace_expansion():
+    mutated = NATIVE_MASTER_CLEAN.replace(
+        '<< "dtpu_experiments " << m.experiments_.size() << "\\n";',
+        '<< "dtpu_experiments " << m.experiments_.size() << "\\n"\n'
+        '        << "dtpu_lat_us_avg 1\\n"\n'
+        '        << "dtpu_lat_us_max 2\\n";',
+    )
+    assert mutated != NATIVE_MASTER_CLEAN
+    ns = _native_sources(master=("native/master/master.cpp", mutated))
+    found = _native_by_rule(_run_native(ns), "metric-undocumented")
+    assert sorted(d.message.split("'")[1] for d in found) == [
+        "dtpu_lat_us_avg", "dtpu_lat_us_max",
+    ]
+    # the {a,b} doc shorthand documents both variants
+    ns = _native_sources(
+        master=("native/master/master.cpp", mutated),
+        ops_md=("docs/operations.md",
+                "`dtpu_experiments`, `dtpu_lat_us_{avg,max}`.\n"),
+    )
+    assert _native_by_rule(_run_native(ns), "metric-undocumented") == []
+
+
+def test_native_fake_master_conformance_bad_clean_pair():
+    mutated = NATIVE_FAKE_CLEAN.replace('"/api/v1/experiments"', '"/api/v1/expz"')
+    ns = _native_sources(fakes={"tests/test_fake.py": mutated})
+    found = _native_by_rule(_run_native(ns), "fake-master-conformance")
+    assert len(found) == 1
+    d = found[0]
+    assert d.file == "tests/test_fake.py" and "/api/v1/expz" in d.message
+    assert "do_GET" in d.message
+
+
+def test_native_wire_field_unread_bad_clean_pair():
+    mutated = NATIVE_AGENT_CLEAN.replace(
+        'body.set("id", opts_.id);',
+        'body.set("id", opts_.id);\n    body.set("hostname", opts_.host);',
+    )
+    ns = _native_sources(agent=("native/agent/agent.cpp", mutated))
+    found = _native_by_rule(_run_native(ns), "wire-field-unread")
+    assert len(found) == 1
+    d = found[0]
+    assert d.file == "native/agent/agent.cpp"
+    assert "'hostname'" in d.message and "POST /api/v1/agents" in d.message
+
+
+def test_native_cpp_suppression_with_argument():
+    mutated = NATIVE_MASTER_CLEAN.replace(
+        'experiments_.erase(ev["id"].as_int());',
+        'tombstones_[ev["id"].as_int()] = true;',
+    ).replace(
+        '} else if (type == "exp_deleted") {',
+        "// dtpu: lint-ok[wal-snapshot-gap] tombstones are rebuilt from the journal\n"
+        '    } else if (type == "exp_deleted") {',
+    )
+    ns = _native_sources(master=("native/master/master.cpp", mutated))
+    assert _native_by_rule(_run_native(ns), "wal-snapshot-gap") == []
+
+
+def test_native_index_real_repo_conformance():
+    """The analyzer is pattern-anchored; this pins its grip on the real
+    daemons so idiom drift collapses loudly (scripts/native_check.sh runs
+    the same floor pre-merge)."""
+    from determined_tpu.lint import build_native_index, collect_native_sources
+    from determined_tpu.lint._native import _parse_fake_routes
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ns = collect_native_sources(repo)
+    idx = build_native_index(ns)
+    assert len(idx.routes) >= 80
+    assert len(idx.wal_sites) >= 50
+    assert sum(1 for s in idx.wal_sites if s.rtype is None) == 0
+    assert len(idx.replay_arms) >= 40
+    # every emitted type has a replay arm in the real master
+    assert set(idx.record_types()) <= set(idx.replay_arms)
+    assert len(idx.metrics) >= 15
+    assert len(idx.dump_state_keys) >= 30
+    assert len(idx.wire_payloads) >= 4
+    fake_patterns = [
+        fr for src in ns.fakes.values() for fr in _parse_fake_routes(src)
+    ]
+    assert len(fake_patterns) >= 15
+
+
+def test_native_real_repo_lints_clean():
+    from determined_tpu.lint import lint_native
+    from determined_tpu.lint.rules import build_rules
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    diags = lint_native(repo, build_rules(None, None))
+    assert diags == [], "\n".join(
+        f"{d.file}:{d.line}: [{d.rule}] {d.message}" for d in diags
+    )
+
+
+def test_native_seeded_replay_arm_deletion_fires():
+    """Acceptance regression: deleting one replay arm from the REAL master
+    source makes wal-replay-gap fire with the exact emit-site witness."""
+    from determined_tpu.lint import build_native_index, collect_native_sources
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ns = collect_native_sources(repo)
+    src = ns.master[1]
+    assert 'type == "ckpt_deleted"' in src
+    mutated = src.replace('type == "ckpt_deleted"', 'type == "ckpt_gone"', 1)
+    import dataclasses as _dc
+
+    ns2 = _dc.replace(ns, master=(ns.master[0], mutated))
+    found = _native_by_rule(_run_native(ns2), "wal-replay-gap")
+    assert len(found) == 1
+    d = found[0]
+    assert "'ckpt_deleted'" in d.message
+    emit_line = next(
+        s.line for s in build_native_index(ns).wal_sites
+        if s.rtype == "ckpt_deleted"
+    )
+    assert d.line == emit_line
+    assert f"{ns.master[0]}:{emit_line}" in d.message
+
+
+def test_native_seeded_api_md_row_deletion_fires():
+    """Acceptance regression: deleting one API.md route row from the REAL
+    contract table makes route-undocumented fire on the dispatch site."""
+    from determined_tpu.lint import collect_native_sources
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ns = collect_native_sources(repo)
+    row_prefix = "| GET | `/api/v1/checkpoints` "
+    lines = ns.api_md[1].splitlines()
+    assert any(l.startswith(row_prefix) for l in lines)
+    mutated = "\n".join(l for l in lines if not l.startswith(row_prefix)) + "\n"
+    import dataclasses as _dc
+
+    ns2 = _dc.replace(ns, api_md=(ns.api_md[0], mutated))
+    found = _native_by_rule(_run_native(ns2), "route-undocumented")
+    assert len(found) == 1
+    assert "GET /api/v1/checkpoints " in found[0].message + " "
+    assert found[0].file == ns.master[0]
+
+
+def test_native_cli_strict_from_repo(tmp_path, capsys):
+    """CLI wiring: --native from inside the repo exits 0 strict (the repo
+    ships clean), and exits 2 when no native tree is above the target."""
+    from determined_tpu.cli.main import main
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cwd = os.getcwd()
+    os.chdir(repo)
+    try:
+        rc = main(["lint", "--native", "--strict"])
+    finally:
+        os.chdir(cwd)
+    capsys.readouterr()
+    assert rc == 0
+
+    outside = tmp_path / "elsewhere"
+    outside.mkdir()
+    (outside / "x.py").write_text("x = 1\n")
+    rc = main(["lint", "--native", str(outside / "x.py")])
+    err = capsys.readouterr().err
+    assert rc == 2 and "no native/master/master.cpp" in err

@@ -21,6 +21,7 @@ import pytest
 
 from scripts.devcluster import (
     MASTER_BIN,
+    sample_control_events,
     sample_master_events,
     sample_registry_events,
     sample_serving_events,
@@ -324,6 +325,64 @@ def test_serving_torn_tail_truncated_at_every_byte_offset(tmp_path):
 def test_serving_journal_fscks_clean(tmp_path):
     events = (sample_master_events() + sample_registry_events()
               + sample_serving_events())
+    write_master_journal(str(tmp_path), events)
+    rc, out = _fsck(tmp_path)
+    assert rc == 0, out
+    assert f"last_good_lsn={len(events)}" in out and "tail_truncated=no" in out
+
+
+# ---- every remaining control-plane record (ISSUE 19): same WAL contract ----
+
+
+def test_control_plane_torn_tail_at_every_record(tmp_path):
+    """Torn-tail coverage for EVERY control-plane record type the other
+    fixtures skip (users/tokens, workspace->project->group RBAC,
+    templates, config policies, webhooks, topology labels, the full
+    driver-trial lifecycle, teardown, failed deploys).  Two properties per
+    record: (a) it is digest-observable — adjacent whole-frame prefixes
+    produce DIFFERENT dump-state digests, so truncation of any record is
+    detectable, and (b) a cut mid-frame boots to exactly the previous
+    whole-frame state (the ARIES prefix contract).  ``dtpu lint
+    --native``'s wal-fuzz-gap rule pins the fixture's type union against
+    the master's actual record(...) sites, so this test cannot silently
+    rot as record types are added."""
+    events = sample_control_events()
+    frames = [
+        wal_frame(json.dumps({**ev, "seq": i + 1, "ts": 0}))
+        for i, ev in enumerate(events)
+    ]
+    blob = b"".join(frames)
+
+    boundaries = [0]
+    for f in frames:
+        boundaries.append(boundaries[-1] + len(f))
+    expected = []
+    for i, b in enumerate(boundaries):
+        d = tmp_path / f"boundary-{i}"
+        _write_blob(d, blob[:b])
+        expected.append(_dump(d))
+    for i, (a, b) in enumerate(zip(expected, expected[1:])):
+        assert a != b, (
+            f"record {i} ({events[i]['type']}) did not change the dump digest"
+        )
+
+    # a torn write inside ANY record's frame must boot to the state of the
+    # longest whole-record prefix — cut each frame at its midpoint
+    work = tmp_path / "fuzz"
+    for i, f in enumerate(frames):
+        cut = boundaries[i] + max(1, len(f) // 2)
+        shutil.rmtree(work, ignore_errors=True)
+        _write_blob(work, blob[:cut])
+        got = _dump(work)
+        assert got == expected[i], (
+            f"state diverged on a mid-frame cut of record {i} "
+            f"({events[i]['type']})"
+        )
+
+
+def test_control_plane_journal_fscks_clean(tmp_path):
+    events = (sample_master_events() + sample_registry_events()
+              + sample_serving_events() + sample_control_events())
     write_master_journal(str(tmp_path), events)
     rc, out = _fsck(tmp_path)
     assert rc == 0, out
