@@ -173,6 +173,58 @@ class SearcherConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic gang policy (``docs/cluster.md`` "Elastic gang training").
+
+    The master may resize the trial's gang at runtime between a floor and
+    the configured full size: slice/agent loss shrinks it (a capacity
+    event — ``max_restarts`` is never spent), and stable returning
+    capacity grows it back, slice-quantum aligned, through WAL-journaled
+    checkpoint-restore-reshard transitions.  ``max_slots`` is the gang's
+    full size — the wildcard mesh axis absorbs whatever width the master
+    actually placed (``DTPU_ELASTIC_SLOTS``).  The floor is ``min_slots``
+    (chips) or ``min_slices`` (topology slices, resolved against the live
+    slice size at schedule time); ``resize_cooldown_s`` + a >= 1 slice
+    minimum-gain gate stop a flapping agent from thrashing the trial
+    through restore loops.  Requires a wildcard (-1) mesh axis so the
+    restored mesh can absorb the new device count.
+    """
+
+    max_slots: int = 1
+    min_slots: Optional[int] = None
+    min_slices: Optional[int] = None
+    resize_cooldown_s: int = 60
+
+    def __post_init__(self):
+        if self.max_slots < 1:
+            raise InvalidExperimentConfig("elastic.max_slots must be >= 1")
+        if self.min_slots is not None and self.min_slots > self.max_slots:
+            raise InvalidExperimentConfig(
+                f"elastic.min_slots={self.min_slots} exceeds "
+                f"max_slots={self.max_slots}"
+            )
+        if self.min_slots is not None and self.min_slots < 1:
+            raise InvalidExperimentConfig("elastic.min_slots must be >= 1")
+        if self.min_slices is not None and self.min_slices < 1:
+            raise InvalidExperimentConfig("elastic.min_slices must be >= 1")
+        if self.resize_cooldown_s < 0:
+            raise InvalidExperimentConfig(
+                "elastic.resize_cooldown_s must be >= 0"
+            )
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "ElasticConfig":
+        raw = dict(raw or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - known
+        if unknown:
+            raise InvalidExperimentConfig(
+                f"unknown elastic fields: {sorted(unknown)}"
+            )
+        return cls(**raw)
+
+
+@dataclasses.dataclass(frozen=True)
 class ResourcesConfig:
     """Resources — replaces reference ``slots_per_trial`` with a mesh.
 
@@ -185,11 +237,23 @@ class ResourcesConfig:
     priority: int = 42                            # reference default priority
     weight: float = 1.0                           # fair-share weight
     single_slice: bool = False                    # refuse DCN-spanning gang splits
+    elastic: Optional[ElasticConfig] = None       # resizable-gang policy
+
+    def __post_init__(self):
+        if self.elastic is not None and -1 not in self.mesh.sizes():
+            raise InvalidExperimentConfig(
+                "resources.elastic requires a wildcard (-1) mesh axis: a "
+                "resize changes the device count, and a fully pinned mesh "
+                "cannot absorb it (e.g. mesh: {data: -1})"
+            )
 
     @classmethod
     def parse(cls, raw: Dict[str, Any]) -> "ResourcesConfig":
         raw = dict(raw or {})
         slots = raw.pop("slots_per_trial", None)
+        elastic_raw = raw.pop("elastic", None)
+        if elastic_raw is not None:
+            raw["elastic"] = ElasticConfig.parse(elastic_raw)
         mesh_raw = raw.pop("mesh", None)
         if mesh_raw is not None and slots is not None:
             raise InvalidExperimentConfig(
@@ -216,6 +280,10 @@ class ResourcesConfig:
 
     @property
     def slots_per_trial(self) -> int:
+        # elastic gangs size by their policy ceiling: the wildcard mesh
+        # axis makes the axis product meaningless as a gang size
+        if self.elastic is not None:
+            return self.elastic.max_slots
         return self.mesh.num_devices
 
 

@@ -87,10 +87,30 @@ class DataLoader:
     # -- resume state ------------------------------------------------------
 
     def state_dict(self) -> Dict[str, int]:
-        return {"epoch": self._state.epoch, "batches_in_epoch": self._state.batches_in_epoch}
+        # global_batch makes the consumed position portable across an elastic
+        # reshard: batch order is shard-count independent (shuffle -> batch
+        # globally -> shard), so with a constant global batch the position
+        # transfers verbatim; if the global batch changed, load_state_dict
+        # rescales sample-for-sample.
+        return {
+            "epoch": self._state.epoch,
+            "batches_in_epoch": self._state.batches_in_epoch,
+            "global_batch": self.sampler.global_batch,
+        }
 
     def load_state_dict(self, state: Dict[str, int]) -> None:
-        self._state = SamplerState(int(state["epoch"]), int(state["batches_in_epoch"]))
+        epoch = int(state["epoch"])
+        batches = int(state["batches_in_epoch"])
+        stored_gb = int(state.get("global_batch", self.sampler.global_batch))
+        if stored_gb != self.sampler.global_batch:
+            # Re-express the consumed position in new-global-batch units.
+            # Round down: a partially-covered batch is re-trained rather than
+            # skipped (never drop a sample; double-training is bounded by one
+            # batch and only occurs when the global batch itself changed).
+            consumed = batches * stored_gb
+            batches = consumed // self.sampler.global_batch
+            batches = min(batches, self.sampler.batches_per_epoch)
+        self._state = SamplerState(epoch, batches)
 
     @property
     def batches_per_epoch(self) -> int:

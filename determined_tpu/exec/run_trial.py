@@ -513,6 +513,31 @@ def main() -> int:
 
     exp_config = ExperimentConfig.parse(cluster.exp_config or {})
 
+    # Elastic reshard: the master stamps every launch with the number of
+    # topology slices the placed gang actually spans.  num_slices is never
+    # a wildcard axis, so the dcn axis is re-shaped here before any mesh is
+    # built; the wildcard data/fsdp axis then absorbs the placed device
+    # count (DTPU_ELASTIC_SLOTS wide) on its own.
+    n_slices_env = os.environ.get("DTPU_NUM_SLICES")
+    if n_slices_env and exp_config.resources.elastic is not None:
+        import dataclasses as _dc
+
+        mesh = exp_config.resources.mesh
+        if mesh.num_slices != int(n_slices_env):
+            logger.info(
+                "elastic: mesh num_slices %d -> %s for this allocation "
+                "(placed width %s slots)",
+                mesh.num_slices, n_slices_env,
+                os.environ.get("DTPU_ELASTIC_SLOTS", "?"),
+            )
+            exp_config = _dc.replace(
+                exp_config,
+                resources=_dc.replace(
+                    exp_config.resources,
+                    mesh=_dc.replace(mesh, num_slices=int(n_slices_env)),
+                ),
+            )
+
     # persistent XLA compilation cache: a supervised restart (or a relaunch
     # after a crash) re-jits from disk instead of paying the full compile;
     # from optimizations.compilation_cache_dir or DTPU_COMPILATION_CACHE

@@ -99,6 +99,9 @@ class _Watch:
     # (an O(metrics-file) scan master-side) only runs when this changes
     last_vcount: int = -1
     restarts_seen: int = 0
+    # elastic reshard counter last seen on the trial JSON: a bump means the
+    # master shrank/grew the gang (capacity event, restart budget untouched)
+    resizes_seen: int = 0
     stop_posted: bool = False
     # resume filter: validation reports at or below this step were already
     # absorbed by the restored searcher and must not be re-fed (journal
@@ -195,6 +198,14 @@ class ClusterExperiment:
                 },
                 "max_restarts": cfg.max_restarts,
             }
+            if cfg.resources.elastic is not None:
+                # master-side elasticity policy: max_slots sizes the gang,
+                # min_* floors the shrink, cooldown gates the hysteresis
+                raw["resources"]["elastic"] = {
+                    k: v
+                    for k, v in dataclasses.asdict(cfg.resources.elastic).items()
+                    if v is not None
+                }
             if cfg.environment:
                 raw["environment"] = dict(cfg.environment)
             if cfg.min_validation_period is not None:
@@ -524,6 +535,30 @@ class ClusterExperiment:
                     rid, tid, restarts, self.config.max_restarts,
                 )
                 watch.restarts_seen = restarts
+
+            # elastic reshard surfaced: the master resized the gang through
+            # checkpoint-restore-reshard.  Journaled so a resumed driver
+            # knows the trial runs on the CURRENT mesh, not the submitted one
+            resizes = int(trial.get("resizes") or 0)
+            if resizes > watch.resizes_seen:
+                cur_slots = int(trial.get("cur_slots") or 0)
+                tracer.instant(
+                    "trial.resize", cat="gang", trial=rid,
+                    master_trial=tid, resizes=resizes, cur_slots=cur_slots,
+                )
+                logger.warning(
+                    "trial %d (master %d): elastic resize #%d -> %d slot(s) "
+                    "(capacity event; restart budget untouched)",
+                    rid, tid, resizes, cur_slots,
+                )
+                if self.journal is not None:
+                    # Safe unlocked: append holds the journal's internal lock.
+                    # dtpu: lint-ok[unlocked-shared-state]
+                    self.journal.append(
+                        "trial_resized", rid=rid,
+                        resizes=resizes, cur_slots=cur_slots,
+                    )
+                watch.resizes_seen = resizes
 
             # feed NEW validation reports to the searcher, oldest first.
             # The /metrics read is an O(file) scan master-side, so it only
@@ -893,9 +928,17 @@ class ClusterExperiment:
         # offsets restart at the count the restored searcher has seen.
         # The journal's trial_validated counts per rid ARE that number.
         seen: Dict[int, int] = {}
+        resized: Dict[int, int] = {}
         for rec_j in replay.records:
             if rec_j.get("type") == "trial_validated":
                 seen[int(rec_j["rid"])] = seen.get(int(rec_j["rid"]), 0) + 1
+            elif rec_j.get("type") == "trial_resized":
+                # highest journaled resize count per rid: the resumed watcher
+                # must not re-announce (or re-journal) resizes it already saw
+                resized[int(rec_j["rid"])] = max(
+                    resized.get(int(rec_j["rid"]), 0),
+                    int(rec_j.get("resizes") or 0),
+                )
         rid_to_tid = {
             int(t["request_id"]): int(t["id"]) for t in exp.get("trials", [])
         }
@@ -909,6 +952,7 @@ class ClusterExperiment:
                 request_id=rid,
                 master_trial_id=rid_to_tid.get(rid),
                 validations_seen=seen.get(rid, 0),
+                resizes_seen=resized.get(rid, 0),
                 min_steps_seen=int(
                     last.get(self.config.searcher.time_metric or "batches", -1) or -1
                 ),

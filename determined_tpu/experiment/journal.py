@@ -19,6 +19,9 @@ single-host analog — an append-only, fsynced JSONL file at
 - ``trial_running``        rid, device ids (slot assignment)
 - ``trial_validated``      rid, steps, metrics
 - ``trial_checkpoint``     rid, latest FINALIZED checkpoint uuid
+- ``trial_resized``        rid, elastic resize count + current gang slots
+                           (capacity event — a resumed driver re-attaches
+                           to the trial on its CURRENT mesh)
 - ``trial_cloned``         rid, source rid, materialized uuid, inherited
                            steps (PBT exploit provenance: a resumed child
                            re-derives the same budget horizon)

@@ -780,6 +780,10 @@ class Trainer:
             "hparams": dict(self.context.hparams),
             "exp_config": self.context.exp_config.raw if self.context.exp_config else None,
             "seed": self.context.seed,
+            # mesh the arrays were sharded over when written — a restore onto
+            # a different mesh (elastic reshard) is detected by comparing this
+            # against the live mesh and recorded as a ``trial.resize`` span
+            "mesh_axes": {k: int(v) for k, v in self.mesh.shape.items()},
         }
         metadata = {
             "steps_completed": self.steps_completed,
@@ -897,7 +901,38 @@ class Trainer:
     def restore_from_path(self, path: str) -> None:
         """Load arrays + trainer state from an already-local checkpoint dir
         (``_restore_checkpoint`` handles storage download; this is the shared
-        tail, also used by ``train.load_trial_from_checkpoint``)."""
+        tail, also used by ``train.load_trial_from_checkpoint``).
+
+        The checkpoint may have been written on a DIFFERENT mesh (elastic
+        reshard): ``abstract_like`` targets the *current* state's shardings,
+        so orbax re-lays every array — params and the sharded optimizer
+        mirrors alike — onto the live mesh, and the loader rescales its
+        consumed-sample position if the global batch changed.  A cross-mesh
+        restore is wrapped in a ``trial.resize`` span so the profile
+        attributes the reshard window."""
+        tstate = serialization.load_trainer_state(path)
+        stored_axes = tstate.get("mesh_axes")
+        cur_axes = {k: int(v) for k, v in self.mesh.shape.items()}
+        resizing = stored_axes is not None and (
+            {k: int(v) for k, v in stored_axes.items()} != cur_axes
+        )
+        if not resizing:
+            self._restore_tail(path, tstate)
+            return
+        fmt = lambda ax: ",".join(f"{k}={v}" for k, v in ax.items())  # noqa: E731
+        logger.info(
+            "elastic reshard: restoring checkpoint written on mesh (%s) "
+            "onto mesh (%s)", fmt(stored_axes), fmt(cur_axes),
+        )
+        with get_tracer().span(
+            "trial.resize",
+            cat="restore",
+            from_mesh=fmt(stored_axes),
+            to_mesh=fmt(cur_axes),
+        ):
+            self._restore_tail(path, tstate)
+
+    def _restore_tail(self, path: str, tstate: Dict[str, Any]) -> None:
         abstract = serialization.abstract_like(
             {
                 "step": self.state.step,
@@ -920,7 +955,6 @@ class Trainer:
                 fresh_opt_state, self.state.opt_state
             )
         )
-        tstate = serialization.load_trainer_state(path)
         self.steps_completed = int(tstate["steps_completed"])
         self.train_loader.load_state_dict(tstate["train_loader"])
         for k, cb in self.callbacks.items():

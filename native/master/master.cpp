@@ -71,6 +71,10 @@ struct AgentState {
   int slots = 0;
   int used_slots = 0;
   int64_t last_seen_ms = 0;
+  // when this incarnation of the agent registered: the elastic grow path
+  // only counts capacity that has been stable past the debounce window,
+  // so a flapping agent cannot thrash trials through resize loops
+  int64_t registered_ms = 0;
   // provisioner bookkeeping: when this agent last held an allocation, and
   // whether a scale-down terminate command has been issued for it
   int64_t last_busy_ms = 0;
@@ -140,6 +144,18 @@ struct TrialState {
   // validation metric per steps_completed, for checkpoint-GC best ranking
   // (one entry per validation report; bounded by validation count)
   std::map<int64_t, double> val_by_step;
+  // Elastic gang state.  cur_slots == 0 means "full size" (slots_per_trial);
+  // any other value is the shrunk/grown gang width the scheduler must fit.
+  // resize_phase walks "" -> "requested" -> "draining" -> "refit" -> "" and
+  // is journaled (elastic_* records) so a master SIGKILL mid-reshard resumes
+  // the resize at the exact phase.  Capacity-driven teardowns route through
+  // this state instead of the restart path: `restarts` is never touched.
+  int cur_slots = 0;
+  int resizes = 0;                 // completed resizes (mirrors the metric)
+  std::string resize_phase;        // "" when no resize is in flight
+  int resize_target = 0;           // slots the pending resize aims for
+  std::string resize_reason;       // "slice_loss" | "capacity_gain"
+  int64_t last_resize_ms = 0;      // hysteresis cooldown anchor (journaled ts)
 };
 
 struct UserState {
@@ -411,6 +427,14 @@ struct ExperimentState {
   bool smaller_is_better = true;
   std::string time_metric = "batches";
   std::string owner = "determined";
+  // resources.elastic policy: a trial may run anywhere in
+  // [elastic_min_slots, slots_per_trial], slice-quantum aligned.  Slice loss
+  // shrinks it (no restart burned); stable returning capacity grows it back,
+  // gated by resize_cooldown_ms and a >= 1 slice minimum-gain rule.
+  bool elastic = false;
+  int elastic_min_slots = 0;   // floor in slots (0 = use min_slices)
+  int elastic_min_slices = 0;  // floor in slices, resolved at schedule time
+  int64_t elastic_cooldown_ms = 60000;
 };
 
 // Admission backpressure on the ingest hot paths (trial-create, metrics,
@@ -649,6 +673,7 @@ class Master {
   void set_fleet_backoff_cap_ms(int64_t ms) { fleet_backoff_cap_ms_ = ms; }
   void set_fleet_crashloop_threshold(int n) { fleet_crashloop_threshold_ = n; }
   void set_fleet_stable_ms(int64_t ms) { fleet_stable_ms_ = ms; }
+  void set_elastic_stable_ms(int64_t ms) { elastic_stable_ms_ = ms; }
   void set_fleet_launch_grace_ms(int64_t ms) { fleet_launch_grace_ms_ = ms; }
   void set_scheduler(const std::string& mode) { scheduler_mode_ = mode; }
   void set_reattach_grace_ms(int64_t ms) { reattach_grace_ms_ = ms; }
@@ -695,6 +720,17 @@ class Master {
       j.set("stop_requested", Json(t.stop_requested));
       j.set("latest_checkpoint", t.latest_checkpoint);
       j.set("validations", Json(static_cast<int64_t>(t.val_by_step.size())));
+      // elastic reshard walk: journaled (elastic_resize_* records), so a
+      // torn resize record must shift this digest — a SIGKILL mid-reshard
+      // that replayed to the wrong phase would be visible here.  The
+      // last_resize_ms cooldown anchor is wall-clock and stays excluded.
+      if (t.resizes > 0 || !t.resize_phase.empty() || t.cur_slots > 0) {
+        j.set("cur_slots", Json(static_cast<int64_t>(t.cur_slots)));
+        j.set("resizes", Json(static_cast<int64_t>(t.resizes)));
+        j.set("resize_phase", t.resize_phase);
+        j.set("resize_target", Json(static_cast<int64_t>(t.resize_target)));
+        j.set("resize_reason", t.resize_reason);
+      }
       trials.push_back(j);
     }
     out.set("trials", trials);
@@ -871,6 +907,20 @@ class Master {
     revoke_token(t.session_token);
     // a task ending may unblock a queued one
     schedule_tasks();
+  }
+
+  // Release quarantined coordinator/chief ports whose old processes have
+  // had the full agent-side kill grace to die.  Caller holds mu_.
+  void release_cooled_ports() {
+    int64_t now = now_ms();
+    for (auto it = cooling_ports_.begin(); it != cooling_ports_.end();) {
+      if (now - it->released_ms >= kPortQuarantineMs) {
+        coord_ports_in_use_[it->host].erase(it->port);
+        it = cooling_ports_.erase(it);
+      } else {
+        ++it;
+      }
+    }
   }
 
   // Kill ready tasks whose proxy has been idle past their declared
@@ -1727,43 +1777,57 @@ class Master {
       }
     }
     if (dead.empty()) return;
+    // Phase 1: remove EVERY timed-out agent before any teardown runs.
+    // The teardown path reschedules immediately, so a still-listed dead
+    // agent would win the fit and swallow the relaunch into a deque
+    // nobody drains — and correlated loss (a whole slice's agents going
+    // silent together, the elastic shrink case) must not let the first
+    // agent's refit place onto a peer reaped later in the same pass.
+    std::vector<std::pair<std::string, std::string>> failed;  // (agent, alloc)
     for (const auto& aid : dead) {
-      std::vector<std::string> failed;  // allocations touching this agent
       for (auto& [alloc_id, alloc] : allocations_) {
         if (alloc.ended) continue;
         for (auto& [gaid, slots] : alloc.groups) {
           if (gaid == aid) {
-            failed.push_back(alloc_id);
+            failed.push_back({aid, alloc_id});
             break;
           }
         }
       }
-      // erase the agent BEFORE failing its allocations: on_trial_exit
-      // reschedules immediately, and a still-listed dead agent would win
-      // the fit and swallow the relaunch into a deque nobody drains
       agents_.erase(aid);
       for (auto& [task_id, task] : tasks_) {
         if (task.agent_id == aid) {
           terminate_task(task, /*send_kill=*/false);  // agent is gone
         }
       }
-      for (const auto& alloc_id : failed) {
-        AllocationState& alloc = allocations_[alloc_id];
-        int64_t tid = alloc.trial_id;
-        // kill the gang's processes on the agents that are still alive
-        kill_allocation(alloc);
-        append_jsonl_striped(logs_path(tid),
-                     Json::object()
-                         .set("ts", Json(now))
-                         .set("level", "ERROR")
-                         .set("line", "agent " + aid +
-                                          " lost (missed polls); failing allocation " +
-                                          alloc_id));
-        on_trial_exit(tid, /*exit_code=*/101);  // restart path (burns one)
-      }
       printf("master: agent %s reaped (no poll in %lldms)\n", aid.c_str(),
              static_cast<long long>(agent_timeout_ms_));
       fflush(stdout);
+    }
+    // Phase 2: fail each touched allocation ONCE — a gang that lost two
+    // agents tears down a single time, and an elastic trial resizes once
+    // for the whole capacity event, not once per lost agent.
+    for (const auto& [aid, alloc_id] : failed) {
+      AllocationState& alloc = allocations_[alloc_id];
+      if (alloc.ended) continue;  // already torn down for a peer agent
+      int64_t tid = alloc.trial_id;
+      // kill the gang's processes on the agents that are still alive
+      // (agent-side SIGTERM-first grace, so in-flight steps checkpoint)
+      kill_allocation(alloc);
+      append_jsonl_striped(logs_path(tid),
+                   Json::object()
+                       .set("ts", Json(now))
+                       .set("level", "ERROR")
+                       .set("line", "agent " + aid +
+                                        " lost (missed polls); failing allocation " +
+                                        alloc_id));
+      // Agent/slice loss on an elastic trial is a capacity event, not a
+      // crash: journal a shrink request first so on_trial_exit routes to
+      // the resize path (restart budget untouched) instead of burning
+      // one of max_restarts on hardware going away; non-elastic trials
+      // fall through to the normal restart path.
+      begin_elastic_shrink(tid, aid);
+      on_trial_exit(tid, /*exit_code=*/101);
     }
     schedule();
   }
@@ -1859,6 +1923,23 @@ class Master {
       do_searcher_shutdown(ev["id"].as_int());
     } else if (type == "trial_yielded") {
       do_trial_yielded(ev["trial_id"].as_int());
+    } else if (type == "elastic_resize_requested") {
+      // resize opened (slice loss or capacity gain): replay parks the trial
+      // in the same phase the live master was in; elastic_tick() re-drives
+      // the teardown/drain from there after boot
+      do_elastic_resize_requested(ev["trial_id"].as_int(),
+                                  ev["reason"].as_string(),
+                                  static_cast<int>(ev["target"].as_int(0)));
+    } else if (type == "elastic_resize_started") {
+      do_elastic_resize_started(ev["trial_id"].as_int());
+    } else if (type == "elastic_resize_completed") {
+      // the journaled ts anchors the resize cooldown across restarts, so
+      // replay cannot forget the hysteresis window
+      do_elastic_resize_completed(ev["trial_id"].as_int(),
+                                  static_cast<int>(ev["slots"].as_int(0)),
+                                  ev["ts"].as_int(now_ms()));
+    } else if (type == "elastic_resize_failed") {
+      do_elastic_resize_failed(ev["trial_id"].as_int());
     } else if (type == "checkpoint") {
       checkpoints_[ev["uuid"].as_string()] = ev;
       auto it = trials_.find(ev["trial_id"].as_int());
@@ -2158,6 +2239,31 @@ class Master {
       exp.resource_pool = res["resource_pool"].as_string();
     }
     exp.single_slice = res["single_slice"].as_bool(false);
+    // resources.elastic: {min_slots|min_slices, resize_cooldown_s}.  Max is
+    // the configured gang size (slots_per_trial): elastic trials launch at
+    // full size when it fits, shrink down to min on capacity loss, and grow
+    // back toward full through the journaled resize path.
+    if (res.contains("elastic") && res["elastic"].is_object()) {
+      const Json& el = res["elastic"];
+      exp.elastic = true;
+      // the policy ceiling IS the gang's full size (the mesh carries a
+      // wildcard axis to absorb resizes, so its product can't size the gang)
+      if (el.contains("max_slots")) {
+        exp.slots_per_trial =
+            std::max(1, static_cast<int>(el["max_slots"].as_int(1)));
+      }
+      // min_slots directly, or min_slices resolved against the live slice
+      // quantum at schedule time (replay has no registered agents, so a
+      // slice-denominated floor cannot be fixed in slots here).
+      exp.elastic_min_slots = static_cast<int>(el["min_slots"].as_int(0));
+      exp.elastic_min_slices = static_cast<int>(el["min_slices"].as_int(0));
+      if (exp.elastic_min_slots <= 0 && exp.elastic_min_slices <= 0) {
+        exp.elastic_min_slots = 1;
+      }
+      exp.elastic_min_slots = std::min(exp.elastic_min_slots, exp.slots_per_trial);
+      exp.elastic_cooldown_ms = el["resize_cooldown_s"].as_int(60) * 1000;
+      if (exp.elastic_cooldown_ms < 0) exp.elastic_cooldown_ms = 0;
+    }
     exp.unmanaged = config["unmanaged"].as_bool(false);
     exp.weight = res["weight"].as_double(1.0);
     if (exp.weight <= 0) exp.weight = 1.0;
@@ -2324,6 +2430,15 @@ class Master {
       Json pols = Json::array();
       for (const auto& p : t.policies_applied) pols.push_back(p);
       j.set("policies_applied", pols);
+      // elastic reshard walk: compaction must not forget a mid-flight
+      // resize (phase/target) or the steady-state width and cooldown
+      // anchor a grown/shrunk trial runs at
+      j.set("cur_slots", Json(static_cast<int64_t>(t.cur_slots)));
+      j.set("resizes", Json(static_cast<int64_t>(t.resizes)));
+      j.set("resize_phase", t.resize_phase);
+      j.set("resize_target", Json(static_cast<int64_t>(t.resize_target)));
+      j.set("resize_reason", t.resize_reason);
+      j.set("last_resize_ms", Json(t.last_resize_ms));
       trials.push_back(j);
     }
     snap.set("trials", trials);
@@ -2535,6 +2650,12 @@ class Master {
           t.policies_applied.insert(p.as_string());
         }
       }
+      t.cur_slots = static_cast<int>(tj["cur_slots"].as_int(0));
+      t.resizes = static_cast<int>(tj["resizes"].as_int(0));
+      t.resize_phase = tj["resize_phase"].as_string();
+      t.resize_target = static_cast<int>(tj["resize_target"].as_int(0));
+      t.resize_reason = tj["resize_reason"].as_string();
+      t.last_resize_ms = tj["last_resize_ms"].as_int(0);
       trials_[t.id] = t;
     }
     if (s.contains("allocations")) {
@@ -3079,7 +3200,19 @@ class Master {
         }
       }
     }
-    if (yielded) {
+    if (!t.resize_phase.empty() && !t.stop_requested &&
+        exp.state != "PAUSED") {
+      // Elastic reshard in flight ("requested" on slice loss, "draining" on
+      // a grow): this exit is the gang coming down for a resize, not a
+      // failure — route to the journaled resize path.  `restarts` is NOT
+      // touched: capacity events never spend the fault-tolerance budget
+      // (satellite: resize-vs-restart taxonomy).
+      record(Json::object()
+                 .set("type", "elastic_resize_started")
+                 .set("trial_id", Json(trial_id))
+                 .set("exit_code", Json(exit_code)));
+      do_elastic_resize_started(trial_id);
+    } else if (yielded) {
       // preempted by the scheduler for a higher-priority gang: the harness
       // checkpointed and exited cleanly; back to PENDING, no restart burned
       record(Json::object()
@@ -3123,6 +3256,90 @@ class Master {
     t.state = "PENDING";
     t.allocation_id.clear();
     t.sched_preempted = false;
+  }
+
+  // ---- elastic reshard transitions ---------------------------------------
+  // The resize walk mirrors the durable-deploy discipline: every phase edge
+  // is a WAL record with a do_* applier shared by the live path and replay,
+  // so a master SIGKILL anywhere mid-reshard resumes at the exact phase.
+  // Phase walk: "" -> requested|draining -> refit -> "" (or -> blocked when
+  // nothing >= the elastic floor fits; the next successful fit clears it).
+
+  // A resize begins: slice loss opens phase "requested" (the gang is being
+  // killed out from under us), a grow opens phase "draining" (the gang was
+  // asked to checkpoint and exit).  Either way the next exit of this
+  // allocation belongs to the resize, not the restart budget.
+  void do_elastic_resize_requested(int64_t trial_id, const std::string& reason,
+                                   int target) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return;
+    TrialState& t = tit->second;
+    t.resize_phase = reason == "capacity_gain" ? "draining" : "requested";
+    t.resize_reason = reason;
+    t.resize_target = target;
+  }
+
+  // Live shrink entry (reap_dead_agents): journal the request before the
+  // exit lands so a master SIGKILL between the kill and the exit replays
+  // into the resize, not into a restart.  Returns false for non-elastic
+  // trials (caller falls through to the restart path).
+  bool begin_elastic_shrink(int64_t trial_id, const std::string& lost_agent) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return false;
+    TrialState& t = tit->second;
+    if (t.state != "RUNNING" || t.stop_requested) return false;
+    auto eit = experiments_.find(t.experiment_id);
+    if (eit == experiments_.end() || !eit->second.elastic) return false;
+    if (!t.resize_phase.empty()) return true;  // already resizing
+    record(Json::object()
+               .set("type", "elastic_resize_requested")
+               .set("trial_id", Json(trial_id))
+               .set("reason", "slice_loss")
+               .set("target", Json(static_cast<int64_t>(0))));
+    do_elastic_resize_requested(trial_id, "slice_loss", 0);
+    append_jsonl_striped(
+        logs_path(trial_id),
+        Json::object()
+            .set("ts", Json(now_ms()))
+            .set("level", "INFO")
+            .set("line", "elastic: agent " + lost_agent +
+                             " loss shrinks trial " + std::to_string(trial_id) +
+                             " (capacity event; restart budget untouched)"));
+    return true;
+  }
+
+  // Gang is down (slice loss kill or drain exit landed): back to PENDING at
+  // the same run discipline as a yield — run_id bumps, restarts does not.
+  void do_elastic_resize_started(int64_t trial_id) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return;
+    TrialState& t = tit->second;
+    end_allocation(t.allocation_id);
+    ++t.run_id;
+    t.state = "PENDING";
+    t.allocation_id.clear();
+    t.sched_preempted = false;
+    t.resize_phase = "refit";
+  }
+
+  // Refit landed: the new gang width is the trial's steady-state size.
+  void do_elastic_resize_completed(int64_t trial_id, int slots, int64_t ts) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return;
+    TrialState& t = tit->second;
+    t.cur_slots = slots;
+    ++t.resizes;
+    t.resize_phase.clear();
+    t.resize_target = 0;
+    t.resize_reason.clear();
+    t.last_resize_ms = ts;  // journaled ts: cooldown survives replay
+  }
+
+  void do_elastic_resize_failed(int64_t trial_id) {
+    auto tit = trials_.find(trial_id);
+    if (tit == trials_.end()) return;
+    TrialState& t = tit->second;
+    t.resize_phase = "blocked";  // pending until >= min slots fit again
   }
 
   // ---- driver-managed experiments (cluster-experiment driver) ------------
@@ -3691,6 +3908,10 @@ class Master {
     for (auto& [pri, tid] : pending) {
       TrialState& t = trials_[tid];
       ExperimentState& exp = experiments_[t.experiment_id];
+      if (exp.elastic) {
+        schedule_elastic(tid, t, exp);
+        continue;
+      }
       int needed = exp.slots_per_trial;
       auto groups =
           find_fit(exp.resource_pool, needed, exp.single_slice, {}, t.excluded_agents);
@@ -3701,6 +3922,159 @@ class Master {
       place_gang(tid, t, exp, groups);
     }
   }
+
+  // Slice quantum of a pool: the smallest labeled slice's slot total (one
+  // slice is the unit a resize adds or removes).  Unlabeled pools fall back
+  // to the largest single host; floor 1 so quantum stepping always moves.
+  int slice_quantum(const std::string& pool) const {
+    std::map<std::string, int> slice_slots;
+    int max_agent = 0;
+    for (const auto& [aid, ag] : agents_) {
+      if (ag.pool != pool || ag.draining) continue;
+      max_agent = std::max(max_agent, ag.slots);
+      if (!ag.slice_id.empty()) slice_slots[ag.slice_id] += ag.slots;
+    }
+    int q = 0;
+    for (const auto& [s, total] : slice_slots) {
+      (void)s;
+      q = q == 0 ? total : std::min(q, total);
+    }
+    if (q == 0) q = max_agent;
+    return std::max(q, 1);
+  }
+
+  // The elastic floor in slots, resolving a slice-denominated minimum
+  // against the live quantum.
+  int elastic_floor(const ExperimentState& exp, int quantum) const {
+    int floor_slots = exp.elastic_min_slots;
+    if (exp.elastic_min_slices > 0) {
+      floor_slots = std::max(floor_slots, exp.elastic_min_slices * quantum);
+    }
+    return std::max(1, std::min(floor_slots, exp.slots_per_trial));
+  }
+
+  // Elastic placement: largest feasible slice-aligned size in
+  // [floor, slots_per_trial], stepping down one slice quantum at a time.
+  // A successful fit at a size other than the trial's current width — or
+  // any fit while a resize is in flight — lands as elastic_resize_completed.
+  void schedule_elastic(int64_t tid, TrialState& t, ExperimentState& exp) {
+    int quantum = slice_quantum(exp.resource_pool);
+    int floor_slots = elastic_floor(exp, quantum);
+    for (int needed = exp.slots_per_trial; needed >= floor_slots;
+         needed -= quantum) {
+      if (needed <= 0) break;
+      auto groups = find_fit(exp.resource_pool, needed, exp.single_slice, {},
+                             t.excluded_agents);
+      if (groups.empty()) continue;
+      place_gang(tid, t, exp, groups, needed);
+      return;
+    }
+    // Nothing >= the floor fits.  Journal the failed resize once (phase
+    // "blocked": --dump-state shows the trial parked on capacity, replay
+    // lands in the same place), then fall back to preemption for the floor.
+    if (t.resize_phase == "refit") {
+      record(Json::object()
+                 .set("type", "elastic_resize_failed")
+                 .set("trial_id", Json(tid))
+                 .set("reason", "no_fit"));
+      do_elastic_resize_failed(tid);
+      append_jsonl_striped(
+          logs_path(tid),
+          Json::object()
+              .set("ts", Json(now_ms()))
+              .set("level", "WARN")
+              .set("line", "elastic: no slice-aligned fit >= " +
+                               std::to_string(floor_slots) +
+                               " slots; trial pending until capacity returns"));
+    }
+    maybe_preempt_for(exp, floor_slots);
+  }
+
+ public:
+  // Elastic driver on the 2s housekeeping tick.  Two jobs: (1) resume a
+  // resize a master SIGKILL interrupted — the journaled phase says what the
+  // pre-crash master decided, so re-drive exactly that step; (2) grow
+  // shrunk trials back toward full size when stable capacity returns,
+  // gated by the resize cooldown and a >= 1 slice minimum-gain rule.
+  void elastic_tick() {
+    int64_t now = now_ms();
+    bool want_schedule = false;
+    for (auto& [tid, t] : trials_) {
+      auto eit = experiments_.find(t.experiment_id);
+      if (eit == experiments_.end() || !eit->second.elastic) continue;
+      ExperimentState& exp = eit->second;
+      if (exp.state != "ACTIVE") continue;
+      if (t.state == "PENDING" &&
+          (t.resize_phase == "refit" || t.resize_phase == "blocked")) {
+        want_schedule = true;  // retry the refit as capacity changes
+        continue;
+      }
+      if (t.state != "RUNNING") continue;
+      auto ait = allocations_.find(t.allocation_id);
+      bool alive = ait != allocations_.end() && !ait->second.ended;
+      if (t.resize_phase == "requested") {
+        // replayed mid-shrink: the shrink decision is journaled — finish
+        // the teardown the pre-crash master started
+        if (alive) kill_allocation(ait->second);
+        on_trial_exit(tid, /*exit_code=*/101);
+        continue;
+      }
+      if (t.resize_phase == "draining") {
+        // the preempt flag is runtime-only state: re-raise it after a
+        // replay so the draining gang actually sees the signal
+        if (alive && !ait->second.awaiting_reattach) {
+          signal_preempt(t.allocation_id);
+        }
+        continue;
+      }
+      if (!t.resize_phase.empty()) continue;
+      int cur = t.cur_slots > 0 ? t.cur_slots : exp.slots_per_trial;
+      if (cur >= exp.slots_per_trial) continue;          // already full
+      if (!alive || ait->second.awaiting_reattach) continue;
+      if (now - t.last_resize_ms < exp.elastic_cooldown_ms) continue;
+      // stability debounce (the fleet supervisor's --fleet-stable-sec
+      // idea): capacity from agents younger than the window does not count
+      std::set<std::string> excluded = t.excluded_agents;
+      for (const auto& [aid, ag] : agents_) {
+        if (ag.registered_ms != 0 && now - ag.registered_ms < elastic_stable_ms_) {
+          excluded.insert(aid);
+        }
+      }
+      // hypothetical fit with the current gang's own slots counted free
+      std::map<std::string, int> extra;
+      for (const auto& [gaid, slots] : ait->second.groups) extra[gaid] += slots;
+      int quantum = slice_quantum(exp.resource_pool);
+      int target = 0;
+      for (int needed = exp.slots_per_trial; needed > cur; needed -= quantum) {
+        if (!find_fit(exp.resource_pool, needed, exp.single_slice, extra,
+                      excluded).empty()) {
+          target = needed;
+          break;
+        }
+      }
+      if (target < cur + quantum) continue;  // minimum gain: one full slice
+      record(Json::object()
+                 .set("type", "elastic_resize_requested")
+                 .set("trial_id", Json(tid))
+                 .set("reason", "capacity_gain")
+                 .set("target", Json(static_cast<int64_t>(target))));
+      do_elastic_resize_requested(tid, "capacity_gain", target);
+      append_jsonl_striped(
+          logs_path(tid),
+          Json::object()
+              .set("ts", Json(now))
+              .set("level", "INFO")
+              .set("line", "elastic: stable capacity for " +
+                               std::to_string(target) + "/" +
+                               std::to_string(exp.slots_per_trial) +
+                               " slots; growing trial " + std::to_string(tid) +
+                               " (checkpoint-and-drain requested)"));
+      signal_preempt(t.allocation_id);
+    }
+    if (want_schedule) schedule();
+  }
+
+ private:
 
   void maybe_preempt_for(ExperimentState& exp, int needed) {
     // victims: running trials in the same pool with strictly lower
@@ -3738,7 +4112,9 @@ class Master {
   }
 
   void place_gang(int64_t tid, TrialState& t, ExperimentState& exp,
-                  const std::vector<std::pair<std::string, int>>& groups) {
+                  const std::vector<std::pair<std::string, int>>& groups,
+                  int placed_slots = 0) {
+      if (placed_slots <= 0) placed_slots = exp.slots_per_trial;
       std::string alloc_id = "alloc-" + std::to_string(next_allocation_id_++);
       AllocationState alloc;
       alloc.id = alloc_id;
@@ -3784,7 +4160,7 @@ class Master {
                    .set("type", "alloc_placed")
                    .set("id", alloc_id)
                    .set("trial_id", Json(tid))
-                   .set("slots", Json(static_cast<int64_t>(exp.slots_per_trial)))
+                   .set("slots", Json(static_cast<int64_t>(placed_slots)))
                    .set("groups", groups_j)
                    .set("coord_host", allocations_[alloc_id].coord_host)
                    .set("coord_port",
@@ -3792,6 +4168,49 @@ class Master {
                    .set("chief_port",
                         Json(static_cast<int64_t>(allocations_[alloc_id].chief_port)))
                    .set("session_token", session_token));
+      }
+      // Elastic reshard lands: the placement above is journaled, so the
+      // completion record right after it replays into the same cur_slots
+      // the live path computed.  Fires when a resize walk is in flight or
+      // whenever an elastic trial's placed width changed (e.g. an initial
+      // launch that only fit below full size).
+      if (exp.elastic) {
+        int prev = t.cur_slots > 0 ? t.cur_slots : exp.slots_per_trial;
+        bool resizing = !t.resize_phase.empty();
+        if (resizing || placed_slots != prev) {
+          int64_t ts = now_ms();
+          record(Json::object()
+                     .set("type", "elastic_resize_completed")
+                     .set("trial_id", Json(tid))
+                     .set("slots", Json(static_cast<int64_t>(placed_slots)))
+                     .set("reason", t.resize_reason));
+          append_jsonl_striped(
+              logs_path(tid),
+              Json::object()
+                  .set("ts", Json(ts))
+                  .set("level", "INFO")
+                  .set("line", "elastic: resize complete, trial " +
+                                   std::to_string(tid) + " now " +
+                                   std::to_string(placed_slots) + "/" +
+                                   std::to_string(exp.slots_per_trial) +
+                                   " slots across " +
+                                   std::to_string(groups.size()) + " host(s)"));
+          do_elastic_resize_completed(tid, placed_slots, ts);
+        }
+      }
+      // distinct topology slices spanned by this gang, so the harness can
+      // shape the dcn mesh axis without guessing (unlabeled agents = 1)
+      int num_slices = 1;
+      {
+        std::set<std::string> spanned;
+        for (const auto& [gaid, slots] : groups) {
+          (void)slots;
+          auto agit = agents_.find(gaid);
+          if (agit != agents_.end() && !agit->second.slice_id.empty()) {
+            spanned.insert(agit->second.slice_id);
+          }
+        }
+        if (!spanned.empty()) num_slices = static_cast<int>(spanned.size());
       }
       int node_rank = 0;
       for (auto& [aid, slots] : groups) {
@@ -3809,6 +4228,13 @@ class Master {
             exp.config["reproducibility"]["experiment_seed"].as_int(0) + tid));
         env.set("DTPU_TRIAL_RUN_ID", std::to_string(t.run_id));
         env.set("DTPU_NUM_SLOTS", std::to_string(slots));
+        env.set("DTPU_NUM_SLICES", std::to_string(num_slices));
+        if (exp.elastic) {
+          // total gang width this launch: the harness resizes its mesh's
+          // wildcard axis to this instead of the configured full size
+          env.set("DTPU_ELASTIC_SLOTS", std::to_string(placed_slots));
+          env.set("DTPU_ELASTIC_RESIZES", std::to_string(t.resizes));
+        }
         if (t.warm_start_steps > 0) {
           env.set("DTPU_WARM_START_STEPS", std::to_string(t.warm_start_steps));
         }
@@ -3887,11 +4313,15 @@ class Master {
         ait->second.last_busy_ms = now_ms();  // idle clock starts now
       }
     }
+    // quarantine instead of free: the old ranks may hold these sockets
+    // for up to the agent-side SIGKILL grace (see cooling_ports_)
     if (it->second.coord_port) {
-      coord_ports_in_use_[it->second.coord_host].erase(it->second.coord_port);
+      cooling_ports_.push_back(
+          {it->second.coord_host, it->second.coord_port, now_ms()});
     }
     if (it->second.chief_port) {
-      coord_ports_in_use_[it->second.coord_host].erase(it->second.chief_port);
+      cooling_ports_.push_back(
+          {it->second.coord_host, it->second.chief_port, now_ms()});
     }
     revoke_token(it->second.session_token);
     // batch-seq watermarks are keyed "tid/alloc/shipper": erase the
@@ -3939,6 +4369,13 @@ class Master {
   // and namespace-quota checks (must agree with build_experiment).
   static int64_t slots_from_config(const Json& config) {
     const Json& res = config["resources"];
+    // elastic gangs size by their policy ceiling: the mesh carries a
+    // wildcard axis (it must absorb resizes), so its axis product is
+    // meaningless as a gang size
+    if (res.contains("elastic") && res["elastic"].is_object() &&
+        res["elastic"].contains("max_slots")) {
+      return std::max<int64_t>(res["elastic"]["max_slots"].as_int(1), 1);
+    }
     if (res.contains("mesh")) {
       int64_t slots = 1;
       for (const auto& [axis, size] : res["mesh"].items()) {
@@ -4228,6 +4665,11 @@ class Master {
     j.set("latest_checkpoint", t.latest_checkpoint);
     j.set("allocation_id", t.allocation_id);
     j.set("progress", Json(t.progress));
+    // elastic reshard status: the driver journals a trial_resized record
+    // and emits a trial.resize span when `resizes` advances
+    j.set("resizes", Json(static_cast<int64_t>(t.resizes)));
+    j.set("cur_slots", Json(static_cast<int64_t>(t.cur_slots)));
+    j.set("resize_phase", t.resize_phase);
     // in-memory validation count: pollers (the cluster-experiment driver)
     // gate their O(metrics-file) /metrics reads on this changing
     j.set("validations", Json(static_cast<int64_t>(t.val_by_step.size())));
@@ -4873,9 +5315,26 @@ class Master {
   int fleet_crashloop_threshold_ = 5;   // rapid failures before giving up
   int64_t fleet_stable_ms_ = 10000;     // uptime that clears the failure count
   int64_t fleet_launch_grace_ms_ = 180000;  // launch -> replica registration
+  // elastic grow debounce: agents must be registered this long before
+  // their capacity can trigger a grow (reuses the fleet-stable idea)
+  int64_t elastic_stable_ms_ = 10000;
   std::deque<Json> events_;  // recent journal events for /api/v1/events
   std::map<std::string, int64_t> log_batch_seq_;  // trial/allocation -> last seq
   std::map<std::string, std::set<int>> coord_ports_in_use_;  // host -> ports
+  // Ports of ended allocations stay reserved here for a quarantine window
+  // before leaving coord_ports_in_use_: an elastic refit (or a restart)
+  // re-places within milliseconds of end_allocation, while the old gang's
+  // SIGTERMed ranks get up to the agent's 15s SIGKILL grace to actually
+  // release their jax coordinator socket — handing the same port to the
+  // new gang aborts its rendezvous ("connected with a different
+  // incarnation").  Drained by release_cooled_ports() on the tick.
+  struct CoolingPort {
+    std::string host;
+    int port = 0;
+    int64_t released_ms = 0;
+  };
+  std::vector<CoolingPort> cooling_ports_;
+  static constexpr int64_t kPortQuarantineMs = 20000;
 
   // metric and log records live in per-trial jsonl files under state_dir,
   // NOT in master memory or the journal: master RSS stays bounded no
@@ -5197,6 +5656,14 @@ void install_routes_impl(Master& m, HttpServer& srv) {
         << "# TYPE dtpu_fleet_target gauge\n"
         << "dtpu_fleet_target " << (m.fleet_active_ ? m.fleet_.target : 0)
         << "\n";
+    // completed elastic reshard count, summed over trials so the counter
+    // is rebuilt exactly by WAL replay (no runtime-only counter to lose)
+    int64_t elastic_resizes = 0;
+    for (const auto& [tid, t] : m.trials_) elastic_resizes += t.resizes;
+    out << "# HELP dtpu_elastic_resizes_total completed elastic trial resizes"
+        << " (shrink + grow)\n"
+        << "# TYPE dtpu_elastic_resizes_total counter\n"
+        << "dtpu_elastic_resizes_total " << elastic_resizes << "\n";
     HttpResponse r;
     r.content_type = "text/plain; version=0.0.4";
     r.body = out.str();
@@ -6623,7 +7090,10 @@ void install_routes_impl(Master& m, HttpServer& srv) {
                    .set("agent", id)
                    .set("slice", ag.slice_id));
     }
-    if (fresh) ag.used_slots = 0;
+    if (fresh) {
+      ag.used_slots = 0;
+      ag.registered_ms = now_ms();  // elastic stability debounce baseline
+    }
     ag.last_seen_ms = now_ms();
     // idle clock starts at registration — last_seen_ms is refreshed by
     // every work long-poll, so it can never be the provisioner's idle
@@ -6774,6 +7244,16 @@ void install_routes_impl(Master& m, HttpServer& srv) {
     auto t = req.query.find("timeout_seconds");
     if (t != req.query.end()) timeout_s = std::max(0, std::atoi(t->second.c_str()));
     std::unique_lock<std::mutex> lk(m.mu_);
+    // The wait loop below refreshes last_seen_ms on every tick wakeup, and
+    // a SIGKILLed agent's socket looks connected until the poll window
+    // expires — so cap the window at half the liveness timeout, or a dead
+    // agent stays "fresh" for up to 30s past its death and slice-loss
+    // detection (reap_dead_agents -> elastic shrink) lags by that much.
+    if (m.agent_timeout_ms_ > 0) {
+      timeout_s = std::min<int>(
+          timeout_s,
+          std::max<int64_t>(1, m.agent_timeout_ms_ / 2000));
+    }
     const std::string& id = req.params.at("id");
     auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(timeout_s);
     while (true) {
@@ -8008,6 +8488,7 @@ int main(int argc, char** argv) {
   int fleet_backoff_cap_ms = 60000;
   int fleet_crashloop_threshold = 5;
   int fleet_stable_sec = 10;
+  int elastic_stable_sec = 10;
   int fleet_launch_grace_sec = 180;
   int reattach_grace_sec = 60;
   bool journal_fsync = true;
@@ -8054,6 +8535,8 @@ int main(int argc, char** argv) {
           std::atoi(next("--fleet-crashloop-threshold").c_str());
     else if (arg == "--fleet-stable-sec")
       fleet_stable_sec = std::atoi(next("--fleet-stable-sec").c_str());
+    else if (arg == "--elastic-stable-sec")
+      elastic_stable_sec = std::atoi(next("--elastic-stable-sec").c_str());
     else if (arg == "--fleet-launch-grace-sec")
       fleet_launch_grace_sec =
           std::atoi(next("--fleet-launch-grace-sec").c_str());
@@ -8107,6 +8590,7 @@ int main(int argc, char** argv) {
   master.set_fleet_backoff_cap_ms(fleet_backoff_cap_ms);
   master.set_fleet_crashloop_threshold(fleet_crashloop_threshold);
   master.set_fleet_stable_ms(static_cast<int64_t>(fleet_stable_sec) * 1000);
+  master.set_elastic_stable_ms(static_cast<int64_t>(elastic_stable_sec) * 1000);
   master.set_fleet_launch_grace_ms(
       static_cast<int64_t>(fleet_launch_grace_sec) * 1000);
   if (scheduler != "priority" && scheduler != "fair_share") {
@@ -8206,9 +8690,11 @@ int main(int argc, char** argv) {
     master.work_cv_.notify_all();
     master.reap_dead_agents();
     master.reap_idle_tasks();
+    master.release_cooled_ports();
     master.reap_dead_serve_replicas();
     master.advance_rolling_deploy();
     master.reconcile_fleet();
+    master.elastic_tick();
     master.reap_unattached_allocations();
     master.flush_journal();
     master.maybe_compact();

@@ -621,6 +621,67 @@ def sample_control_events():
     ]
 
 
+def sample_elastic_events():
+    """Elastic reshard journal fixture (WAL tooling tests): one elastic
+    experiment walked through the full resize state machine — a slice-loss
+    shrink (requested -> started -> refit placement -> completed), then a
+    capacity-gain grow that drains the gang but finds no slice-aligned fit
+    (draining -> started -> failed/blocked).  Every record changes the
+    dump-state digest (the trial row carries cur_slots/resizes/
+    resize_phase/resize_target/resize_reason), so a master SIGKILLed
+    mid-reshard that replayed to the wrong phase is observable.  ``dtpu
+    lint --native``'s wal-fuzz-gap rule pins the four ``elastic_*`` types
+    here against the master's actual record(...) sites.  Self-contained:
+    ids avoid the other fixtures'."""
+    cfg = {
+        "name": "wal-elastic-fixture",
+        "entrypoint": "determined_tpu.models.mnist:MnistTrial",
+        "hyperparameters": {"lr": 0.1},
+        "searcher": {
+            "name": "driver",
+            "metric": "validation_loss",
+            "max_length": {"batches": 8},
+        },
+        "resources": {
+            "mesh": {"data": -1},
+            "elastic": {"max_slots": 4, "min_slots": 2,
+                        "resize_cooldown_s": 1},
+        },
+    }
+    return [
+        {"type": "exp_created", "id": 9, "owner": "determined", "config": cfg},
+        {"type": "agent_topology", "agent": "agent-ela-b1",
+         "slice": "slice-b"},
+        {"type": "driver_trial", "experiment_id": 9, "request_id": 1,
+         "hparams": {"lr": 0.1}, "source_checkpoint": "", "trial_id": 90},
+        {"type": "alloc_placed", "id": "alloc-90a", "trial_id": 90,
+         "slots": 4, "groups": [{"agent": "agent-ela-a1", "slots": 2},
+                                {"agent": "agent-ela-b1", "slots": 2}],
+         "coord_host": "127.0.0.1", "coord_port": 7971, "chief_port": 7972,
+         "session_token": "sess-ela", "external_kind": "",
+         "external_pool": ""},
+        # slice b dies mid-trial: the shrink opens (capacity event — the
+        # trial's restarts counter never moves through this walk)
+        {"type": "elastic_resize_requested", "trial_id": 90,
+         "reason": "slice_loss", "target": 0},
+        {"type": "elastic_resize_started", "trial_id": 90, "exit_code": 101},
+        {"type": "alloc_placed", "id": "alloc-90b", "trial_id": 90,
+         "slots": 2, "groups": [{"agent": "agent-ela-a1", "slots": 2}],
+         "coord_host": "127.0.0.1", "coord_port": 7973, "chief_port": 7974,
+         "session_token": "sess-ela", "external_kind": "",
+         "external_pool": ""},
+        {"type": "elastic_resize_completed", "trial_id": 90, "slots": 2,
+         "reason": "slice_loss"},
+        # capacity returns: the grow drains the gang, but the refit finds
+        # no slice-aligned fit >= the floor -> blocked until one appears
+        {"type": "elastic_resize_requested", "trial_id": 90,
+         "reason": "capacity_gain", "target": 4},
+        {"type": "elastic_resize_started", "trial_id": 90, "exit_code": 0},
+        {"type": "elastic_resize_failed", "trial_id": 90,
+         "reason": "no_fit"},
+    ]
+
+
 def train_tiny_lm_checkpoint(root: str):
     """Train a 2-step tiny LMTrial and return (checkpoint_dir, uuid) —
     the smallest servable artifact (shared with the serving tests'
@@ -1417,6 +1478,209 @@ def _multislice_smoke(root) -> int:
         cluster.stop()
 
 
+def _elastic_smoke(root) -> int:
+    """Elastic gang chaos smoke (docs/cluster.md "Elastic gangs"): four
+    1-slot agents across two --slice-id labels carry a 4-slot elastic gang
+    (2 slots per slice, dcn=2 mesh).  SIGKILLing both slice-b agents loses
+    half the capacity: the master reaps them, journals the shrink as a
+    capacity event, and the trial keeps stepping at 2 slots with ZERO
+    restarts burned (max_restarts is 0, so any mis-routed teardown errors
+    the experiment loudly).  Restarting the agents grows the gang back to
+    4 slots after the stability debounce + cooldown.  The experiment must
+    COMPLETE with restarts==0 and resizes>=2, the "capacity event; restart
+    budget untouched" line must be in the trial log, and the journal must
+    fsck clean.  Runs again under the ASan build via devcluster.sh
+    --elastic."""
+    cluster = DevCluster(root, agents=0, slots=1,
+                         master_args=("--agent-timeout-sec", "5",
+                                      "--elastic-stable-sec", "2"),
+                         log_dir=root / "logs")
+    cluster.start_master()
+    try:
+        for idx, slice_id in enumerate(["slice-a", "slice-a",
+                                        "slice-b", "slice-b"]):
+            cluster.start_agent(idx, extra_args=("--slice-id", slice_id))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if len(cluster.http.get(cluster.url + "/api/v1/agents",
+                                    timeout=2).json()) >= 4:
+                break
+            time.sleep(0.2)
+        else:
+            print("elastic: agents did not register", file=sys.stderr)
+            return 1
+
+        cfg = exp_config(cluster.ckpt_dir, slots=1, max_restarts=0)
+        cfg["resources"] = {
+            # the wildcard axis absorbs whatever width the master places;
+            # num_slices (from DTPU_NUM_SLICES) adds the outer dcn axis
+            "mesh": {"data": -1},
+            # full size 4 (both slices), floor 2 (one slice), short
+            # cooldown so the smoke's grow fires without a long idle
+            "elastic": {"max_slots": 4, "min_slots": 2,
+                        "resize_cooldown_s": 2},
+        }
+        cfg["environment"]["env"]["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=1"
+        )
+        # long enough that the shrink and the grow both land mid-training
+        # (a 4-rank CPU gang clears ~8 batches/s, so this is ~a minute of
+        # full-size runway and more once shrunk); periodic checkpoints are
+        # what each relaunch resumes from
+        cfg["searcher"]["max_length"] = {"batches": 512}
+        cfg["min_validation_period"] = {"batches": 16}
+        cfg["min_checkpoint_period"] = {"batches": 8}
+        exp_id = cluster.submit(cfg)
+        print(f"elastic: submitted experiment {exp_id} "
+              "(4-slot elastic gang over 2 slices, max_restarts=0)")
+
+        def trial_status():
+            exp = cluster.http.get(
+                f"{cluster.url}/api/v1/experiments/{exp_id}", timeout=5
+            ).json()
+            trials = exp.get("trials") or []
+            return exp, (trials[0] if trials else None)
+
+        def trial_logs(tid):
+            return cluster.http.get(
+                f"{cluster.url}/api/v1/trials/{tid}/logs", timeout=5
+            ).json()
+
+        # -- phase 1: the full-size gang is up and training ----------------
+        trial_id = None
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            exp, trial = trial_status()
+            if trial and trial["state"] == "RUNNING":
+                trial_id = trial["id"]
+                if any("rendezvous: joined" in str(line)
+                       for line in trial_logs(trial_id)):
+                    break
+            time.sleep(0.5)
+        else:
+            print("elastic: 4-slot gang never started", file=sys.stderr)
+            return 1
+
+        # -- phase 2: slice loss — SIGKILL both slice-b agents -------------
+        # Only the agents die (a partition, not a crash): their rank
+        # processes keep the gang stepping until the master reaps the
+        # silent agents and begins the journaled shrink.
+        print("elastic: gang live; SIGKILLing both slice-b agents")
+        for idx in (2, 3):
+            p = cluster.procs[f"agent-{idx}"]
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+
+        # -- phase 3: the shrunken gang is RUNNING at 2 slots --------------
+        deadline = time.time() + 240
+        shrunk = None
+        while time.time() < deadline:
+            exp, trial = trial_status()
+            if trial and int(trial.get("resizes") or 0) >= 1 \
+                    and int(trial.get("cur_slots") or 0) == 2 \
+                    and trial["state"] == "RUNNING":
+                shrunk = trial
+                break
+            if exp["state"] in ("COMPLETED", "ERROR"):
+                break
+            time.sleep(0.5)
+        if shrunk is None:
+            print(f"elastic: no shrink observed (experiment {exp['state']})",
+                  file=sys.stderr)
+            for line in trial_logs(trial_id)[-40:]:
+                print(f"  | {line}")
+            return 1
+        if int(shrunk["restarts"]) != 0:
+            print(f"elastic: shrink burned restart budget "
+                  f"(restarts={shrunk['restarts']})", file=sys.stderr)
+            return 1
+        print(f"elastic: shrunk to {shrunk['cur_slots']} slot(s) "
+              f"(resizes={shrunk['resizes']}, restarts=0)")
+
+        # -- phase 4: it keeps stepping at the smaller size ----------------
+        # (a validation past the shrink proves real training progress,
+        # not just a relaunched-but-wedged gang)
+        v0 = int(shrunk.get("validations") or 0)
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            exp, trial = trial_status()
+            if trial and int(trial.get("validations") or 0) > v0:
+                break
+            if exp["state"] in ("COMPLETED", "ERROR"):
+                print(f"elastic: experiment {exp['state']} before the "
+                      "shrunken gang validated", file=sys.stderr)
+                return 1
+            time.sleep(0.5)
+        else:
+            print("elastic: shrunken gang stopped stepping", file=sys.stderr)
+            for line in trial_logs(trial_id)[-40:]:
+                print(f"  | {line}")
+            return 1
+        print("elastic: shrunken gang validated; restarting slice-b agents")
+
+        # -- phase 5: capacity returns — grow back to full size ------------
+        for idx in (2, 3):
+            cluster.start_agent(idx, extra_args=("--slice-id", "slice-b"))
+        deadline = time.time() + 300
+        grown = None
+        while time.time() < deadline:
+            exp, trial = trial_status()
+            if trial and int(trial.get("resizes") or 0) >= 2 \
+                    and int(trial.get("cur_slots") or 0) == 4:
+                grown = trial
+                break
+            if exp["state"] in ("COMPLETED", "ERROR"):
+                break
+            time.sleep(0.5)
+        if grown is None:
+            print(f"elastic: no grow observed (experiment {exp['state']}, "
+                  f"resizes={trial and trial.get('resizes')})",
+                  file=sys.stderr)
+            for line in trial_logs(trial_id)[-40:]:
+                print(f"  | {line}")
+            for line in cluster.proc_log_tail("master"):
+                print(f"  m| {line}")
+            return 1
+        print(f"elastic: grew back to {grown['cur_slots']} slots "
+              f"(resizes={grown['resizes']}, restarts={grown['restarts']})")
+
+        # -- phase 6: completion + the journaled record of it --------------
+        final = cluster.wait_for_state(
+            exp_id, states=("COMPLETED", "ERROR"), timeout=420)
+        trial = final["trials"][0]
+        logs = trial_logs(trial_id)
+        budget_line = any(
+            "capacity event; restart budget untouched" in str(line)
+            for line in logs)
+        fsck = subprocess.run(
+            [MASTER_BIN, "--journal-fsck", cluster.state_dir],
+            capture_output=True)
+        ok = (
+            final["state"] == "COMPLETED"
+            and trial["state"] == "COMPLETED"
+            and int(trial["restarts"]) == 0
+            and int(trial.get("resizes") or 0) >= 2
+            and budget_line
+            and fsck.returncode == 0
+        )
+        print(f"elastic: experiment {final['state']}, trial {trial['state']}, "
+              f"restarts={trial['restarts']}, resizes={trial.get('resizes')}, "
+              f"budget-line={budget_line}, fsck rc={fsck.returncode} "
+              f"({fsck.stdout.decode().strip()})")
+        if not ok:
+            for line in logs[-40:]:
+                print(f"  | {line}")
+            for line in cluster.proc_log_tail("master"):
+                print(f"  m| {line}")
+        return 0 if ok else 1
+    finally:
+        subprocess.run(
+            ["pkill", "-9", "-f", "determined_tpu.exec.run_trial"],
+            capture_output=True,
+        )
+        cluster.stop()
+
+
 def _fsck_selftest() -> int:
     """Offline `--journal-fsck` self-test (wired into native_check.sh):
     clean and torn-tail journals verify (exit 0), mid-log corruption is
@@ -1488,6 +1752,11 @@ def main(argv=None) -> int:
                          "across 2 --slice-id labels; 2-process gang placed "
                          "slice-aligned; rank SIGKILL -> rescheduled gang "
                          "still slice-aligned)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the elastic gang chaos smoke (4 agents across "
+                         "2 slices; SIGKILL both slice-b agents -> journaled "
+                         "shrink keeps stepping with zero restarts burned; "
+                         "agents return -> grow back to full size)")
     ap.add_argument("--fsck-selftest", action="store_true",
                     help="verify `dtpu-master --journal-fsck` on fabricated journals")
     ap.add_argument("--agents", type=int, default=2)
@@ -1514,6 +1783,10 @@ def main(argv=None) -> int:
     if args.multislice:
         # builds its own cluster: agents need per-agent --slice-id labels
         return _multislice_smoke(root)
+    if args.elastic:
+        # own cluster too: per-agent --slice-id labels plus short master
+        # reap/stability timers so the shrink->grow walk fits a smoke
+        return _elastic_smoke(root)
     if args.selfheal:
         # builds its own cluster: custom master flags + an agent with a
         # known --state-dir (the pidfile is the replica-SIGKILL handle)

@@ -28,6 +28,14 @@
 #                                        # aligned, one rank SIGKILLed ->
 #                                        # rescheduled still slice-aligned
 #                                        # (docs/cluster.md)
+#   scripts/devcluster.sh --elastic      # elastic gang chaos smoke, plain
+#                                        # THEN ASan build: a 4-slot gang
+#                                        # over 2 slices; SIGKILL both
+#                                        # slice-b agents -> journaled
+#                                        # shrink keeps stepping with zero
+#                                        # restarts burned; agents return
+#                                        # -> grow back to full size, fsck
+#                                        # clean (docs/cluster.md)
 #   scripts/devcluster.sh --route        # ASan build + routed-serving
 #                                        # chaos: Poisson load through the
 #                                        # master's /v1/generate proxy (70%
@@ -63,6 +71,15 @@ elif [[ "${1:-}" == "--multislice" ]]; then
   scripts/native_check.sh --sanitize
   export DTPU_NATIVE_BUILD_DIR="$REPO/native/build-asan"
   exec python scripts/devcluster.py --multislice
+elif [[ "${1:-}" == "--elastic" ]]; then
+  # elasticity smoke runs twice, like --multislice: the plain build first
+  # (fast signal), then the ASan/UBSan build — the reshard phase walk,
+  # reap-driven teardown, and grow bookkeeping are restart-order code
+  # where lifetime bugs hide
+  python scripts/devcluster.py --build --elastic
+  scripts/native_check.sh --sanitize
+  export DTPU_NATIVE_BUILD_DIR="$REPO/native/build-asan"
+  exec python scripts/devcluster.py --elastic
 elif [[ "${1:-}" == "--route" ]]; then
   # the router's candidate walk, in-flight accounting, and failover all
   # run inside the master under concurrent load — exactly the code ASan

@@ -85,7 +85,7 @@ def test_loader_crosses_epoch_boundary():
     loader = DataLoader(ds, 8, seed=0, shard_rank=0, num_shards=1)
     it = iter(loader)
     seen = [next(it) for _ in range(5)]  # 2 batches/epoch -> epoch 2 reached
-    assert loader.state_dict() == {"epoch": 2, "batches_in_epoch": 1}
+    assert loader.state_dict() == {"epoch": 2, "batches_in_epoch": 1, "global_batch": 8}
     assert all(len(b["x"]) == 8 for b in seen)
 
 

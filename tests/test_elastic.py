@@ -1,0 +1,309 @@
+"""Elastic reshard parity (ISSUE 20): restore mesh-A checkpoints onto mesh B.
+
+The harness half of elastic gang training: when the master shrinks or grows
+a gang, the relaunched ranks restore the pre-resize checkpoint onto a
+DIFFERENT mesh.  These tests pin the contract on the 8-device virtual CPU
+mesh:
+
+- params + opt_state (including the sharded adam mirrors from
+  ``overlap_grad_sync``) survive a cross-mesh restore bit-for-bit in value
+  space — resharding changes layout, never numbers;
+- the sampler's consumed position transfers exactly (same global batch ->
+  same position; changed global batch -> sample-for-sample rescale), so a
+  resize never drops or double-trains a sample;
+- continuing after a cross-mesh restore matches continuing on the source
+  mesh batch-for-batch (the end-to-end "no divergence" bar);
+- a cross-mesh restore is recorded as a ``trial.resize`` span (the profile
+  attribution the acceptance criteria name) and the jit-reuse cache key
+  changes with the mesh, so a resize never serves a stale trace.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from determined_tpu import core, train
+from determined_tpu.config import ExperimentConfig, Length
+from determined_tpu.config.experiment import ElasticConfig, InvalidExperimentConfig
+from determined_tpu.data._dataset import InMemoryDataset
+from determined_tpu.data._loader import DataLoader
+from determined_tpu.models.mnist import MnistTrial
+from determined_tpu.observability import get_tracer
+from determined_tpu.parallel.mesh import MeshConfig
+from determined_tpu.train import _jit_cache
+
+HPARAMS = {"lr": 1e-2, "hidden": 32, "global_batch_size": 32, "dataset_size": 256}
+
+# overlap_grad_sync shards the adam mirrors over the batch axes — the
+# opt_state layout a reshard must re-lay without changing values
+OVERLAP = {"optimizations": {"overlap_grad_sync": True}}
+
+
+def _make_trainer(tmp_path, mesh_config, n_devices=None, opts=None):
+    """Trainer on a (possibly restricted) device subset — the elastic analog
+    of the master handing a shrunk gang fewer chips."""
+    _jit_cache.clear_step_cache()
+    devices = list(jax.devices())[: n_devices or len(jax.devices())]
+    ctx = train.init(
+        hparams=dict(HPARAMS),
+        mesh_config=mesh_config,
+        core_context=core._dummy_init(checkpoint_dir=str(tmp_path / "ckpts")),
+        exp_config=ExperimentConfig.parse(opts) if opts else None,
+        seed=7,
+        devices=devices,
+    )
+    return train.Trainer(MnistTrial(ctx))
+
+
+def _values(tree):
+    return jax.tree.leaves(jax.device_get(tree))
+
+
+def _assert_allclose(a, b, atol=0.0):
+    for x, y in zip(_values(a), _values(b)):
+        np.testing.assert_allclose(
+            np.asarray(x, np.float64), np.asarray(y, np.float64), atol=atol, rtol=0
+        )
+
+
+# ---------------------------------------------------------------------------
+# reshard parity matrix: data2xfsdp4 (and dcn2 variant) -> grown/shrunk
+# ---------------------------------------------------------------------------
+
+MATRIX = [
+    # (source mesh, src devices, target mesh, tgt devices, id)
+    (dict(data=2, fsdp=4), 8, dict(data=1, fsdp=4), 4, "shrink-data2fsdp4-to-fsdp4"),
+    (dict(data=2, fsdp=4), 8, dict(data=2, fsdp=2), 4, "shrink-data2fsdp4-to-data2fsdp2"),
+    (dict(data=1, fsdp=4), 4, dict(data=2, fsdp=4), 8, "grow-fsdp4-to-data2fsdp4"),
+    (
+        dict(num_slices=2, data=2, fsdp=2), 8,
+        dict(data=2, fsdp=2), 4,
+        "shrink-dcn2-to-single-slice",
+    ),
+    (
+        dict(data=2, fsdp=2), 4,
+        dict(num_slices=2, data=2, fsdp=2), 8,
+        "grow-single-slice-to-dcn2",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "src_mesh, src_dev, tgt_mesh, tgt_dev, _id",
+    MATRIX,
+    ids=[m[-1] for m in MATRIX],
+)
+def test_reshard_parity_matrix(tmp_path, src_mesh, src_dev, tgt_mesh, tgt_dev, _id):
+    """Checkpoint on mesh A, restore on mesh B: params + opt_state equal in
+    value space, sampler position transfers exactly, and two more steps on
+    B match two more steps on A batch-for-batch."""
+    t_a = _make_trainer(tmp_path, MeshConfig(**src_mesh), src_dev, opts=OVERLAP)
+    sid = t_a.fit(
+        Length.batches(6),
+        checkpoint_period=Length.batches(6),
+        report_period=Length.batches(6),
+    )["latest_checkpoint"]
+    assert sid
+    params_at_ckpt = jax.device_get(t_a.state.params)
+    opt_at_ckpt = jax.device_get(t_a.state.opt_state)
+    loader_at_ckpt = t_a.train_loader.state_dict()
+
+    # cross-mesh restore: values identical, position identical (fit with
+    # max_length == the restored step restores and runs zero steps)
+    t_b = _make_trainer(tmp_path, MeshConfig(**tgt_mesh), tgt_dev, opts=OVERLAP)
+    t_b.fit(
+        Length.batches(6), latest_checkpoint=sid,
+        report_period=Length.batches(6), checkpoint_policy="none",
+    )
+    assert t_b.steps_completed == 6
+    _assert_allclose(params_at_ckpt, t_b.state.params)
+    _assert_allclose(opt_at_ckpt, t_b.state.opt_state)
+    assert t_b.train_loader.state_dict() == loader_at_ckpt
+
+    # continuation parity: the resized trial must consume exactly the
+    # batches the source-mesh trial would have (global batch order is
+    # shard-independent), so two more steps land on the same params
+    t_b.fit(
+        Length.batches(8), latest_checkpoint=sid,
+        report_period=Length.batches(8), checkpoint_policy="none",
+    )
+    t_c = _make_trainer(tmp_path, MeshConfig(**src_mesh), src_dev, opts=OVERLAP)
+    t_c.fit(
+        Length.batches(8), latest_checkpoint=sid,
+        report_period=Length.batches(8), checkpoint_policy="none",
+    )
+    assert t_b.steps_completed == t_c.steps_completed == 8
+    for x, y in zip(_values(t_b.state.params), _values(t_c.state.params)):
+        np.testing.assert_allclose(x, y, rtol=2e-4, atol=2e-5)
+    assert t_b.train_loader.state_dict() == t_c.train_loader.state_dict()
+
+
+def test_cross_mesh_restore_emits_trial_resize_span(tmp_path):
+    """The profile must attribute the reshard window: a cross-mesh restore
+    lands inside a ``trial.resize`` span; a same-mesh restore does not."""
+    t_a = _make_trainer(tmp_path, MeshConfig(data=2, fsdp=4), 8)
+    sid = t_a.fit(
+        Length.batches(2),
+        checkpoint_period=Length.batches(2),
+        report_period=Length.batches(2),
+    )["latest_checkpoint"]
+
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.configure(enabled=True)
+    tracer.start()
+    try:
+        t_b = _make_trainer(tmp_path, MeshConfig(data=1, fsdp=4), 4)
+        t_b._setup()
+        t_b._restore_checkpoint(sid)
+        t_same = _make_trainer(tmp_path, MeshConfig(data=2, fsdp=4), 8)
+        t_same._setup()
+        t_same._restore_checkpoint(sid)
+    finally:
+        tracer.stop()
+    events = tracer.chrome_events()
+    resize = [e for e in events if e.get("name") == "trial.resize"]
+    tracer.reset()
+    assert len(resize) == 1, resize
+    args = resize[0].get("args") or {}
+    # the mesh stamps every axis (size-1 included); pin the ones that moved
+    assert "data=2" in args.get("from_mesh", "") and "fsdp=4" in args["from_mesh"]
+    assert "data=1" in args.get("to_mesh", "") and "fsdp=4" in args["to_mesh"]
+    assert args["from_mesh"] != args["to_mesh"]
+
+
+def test_jit_cache_key_changes_with_mesh(tmp_path):
+    """A resize must never serve a stale trace: the step cache key covers
+    the mesh axis sizes AND the concrete device set."""
+
+    class _T:
+        pass
+
+    batch = {"x": np.zeros((32, 8), np.float32)}
+    keys = set()
+    for mesh_cfg, n_dev in [
+        (dict(data=2, fsdp=4), 8),
+        (dict(data=1, fsdp=4), 4),
+        (dict(data=2, fsdp=2), 4),
+    ]:
+        from determined_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(MeshConfig(**mesh_cfg), devices=list(jax.devices())[:n_dev])
+        keys.add(
+            _jit_cache.step_cache_key(
+                trial=_T(), hparams={}, mesh=mesh, agg=1, average_grads=True,
+                sample_batch=batch, metric_keys=("loss",),
+            )
+        )
+    assert len(keys) == 3
+
+
+# ---------------------------------------------------------------------------
+# sampler position rescale (global batch changed across the resize)
+# ---------------------------------------------------------------------------
+
+
+def _loader(global_batch, n=64):
+    ds = InMemoryDataset({"x": np.arange(n, dtype=np.float32)})
+    return DataLoader(ds, global_batch, shuffle=False, seed=0, shard_rank=0, num_shards=1)
+
+
+def test_sampler_state_roundtrip_same_global_batch():
+    src = _loader(8)
+    it = iter(src)
+    for _ in range(3):
+        next(it)
+    state = src.state_dict()
+    assert state == {"epoch": 0, "batches_in_epoch": 3, "global_batch": 8}
+    dst = _loader(8)
+    dst.load_state_dict(state)
+    assert dst.state_dict() == state  # exact position continuity
+
+
+def test_sampler_state_rescales_when_global_batch_changes():
+    # 3 batches of 8 consumed = 24 samples; under global batch 4 that is
+    # exactly 6 batches — no sample dropped, none double-trained
+    src = _loader(8)
+    it = iter(src)
+    for _ in range(3):
+        next(it)
+    dst = _loader(4)
+    dst.load_state_dict(src.state_dict())
+    assert dst.state_dict()["batches_in_epoch"] == 6
+    # non-divisible position rounds DOWN (re-train the partial batch,
+    # never skip samples): 24 samples under global batch 16 -> 1 batch
+    dst16 = _loader(16)
+    dst16.load_state_dict(src.state_dict())
+    assert dst16.state_dict()["batches_in_epoch"] == 1
+    # a legacy state without global_batch loads unrescaled
+    legacy = _loader(4)
+    legacy.load_state_dict({"epoch": 1, "batches_in_epoch": 2})
+    assert legacy.state_dict() == {"epoch": 1, "batches_in_epoch": 2, "global_batch": 4}
+
+
+def test_sampler_rescale_clamps_to_epoch_length():
+    # 6 of 8 batches consumed at gb=8 (48 samples); at gb=2 that is 24
+    # batches but the epoch only has 32 — position stays in range
+    src = _loader(8, n=64)
+    it = iter(src)
+    for _ in range(6):
+        next(it)
+    dst = _loader(2, n=64)
+    dst.load_state_dict(src.state_dict())
+    assert dst.state_dict()["batches_in_epoch"] == 24
+    # and a pathological shrink of the dataset view clamps
+    tiny = _loader(32, n=64)  # 2 batches per epoch
+    tiny.load_state_dict(src.state_dict())
+    assert tiny.state_dict()["batches_in_epoch"] <= tiny.batches_per_epoch
+
+
+# ---------------------------------------------------------------------------
+# elastic config surface
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_config_parses_and_sizes_the_gang():
+    cfg = ExperimentConfig.parse(
+        {
+            "resources": {
+                "mesh": {"data": -1},
+                "elastic": {"max_slots": 8, "min_slots": 2, "resize_cooldown_s": 5},
+            }
+        }
+    )
+    el = cfg.resources.elastic
+    assert isinstance(el, ElasticConfig)
+    assert el.max_slots == 8 and el.min_slots == 2 and el.resize_cooldown_s == 5
+    # elastic.max_slots IS the gang size (the wildcard axis absorbs it)
+    assert cfg.resources.slots_per_trial == 8
+
+
+def test_elastic_config_requires_wildcard_mesh_axis():
+    with pytest.raises(InvalidExperimentConfig):
+        ExperimentConfig.parse(
+            {
+                "resources": {
+                    "mesh": {"data": 4},
+                    "elastic": {"max_slots": 4},
+                }
+            }
+        )
+
+
+@pytest.mark.parametrize(
+    "elastic",
+    [
+        {"max_slots": 0},
+        {"max_slots": 4, "min_slots": 0},
+        {"max_slots": 4, "min_slots": 8},
+        {"max_slots": 4, "min_slices": 0},
+        {"max_slots": 4, "resize_cooldown_s": -1},
+        {"max_slots": 4, "bogus": 1},
+    ],
+    ids=["max0", "min0", "min>max", "slices0", "cooldown<0", "unknown-field"],
+)
+def test_elastic_config_rejects_bad_values(elastic):
+    with pytest.raises(InvalidExperimentConfig):
+        ExperimentConfig.parse(
+            {"resources": {"mesh": {"data": -1}, "elastic": elastic}}
+        )

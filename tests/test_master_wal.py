@@ -22,6 +22,7 @@ import pytest
 from scripts.devcluster import (
     MASTER_BIN,
     sample_control_events,
+    sample_elastic_events,
     sample_master_events,
     sample_registry_events,
     sample_serving_events,
@@ -382,11 +383,86 @@ def test_control_plane_torn_tail_at_every_record(tmp_path):
 
 def test_control_plane_journal_fscks_clean(tmp_path):
     events = (sample_master_events() + sample_registry_events()
-              + sample_serving_events() + sample_control_events())
+              + sample_serving_events() + sample_control_events()
+              + sample_elastic_events())
     write_master_journal(str(tmp_path), events)
     rc, out = _fsck(tmp_path)
     assert rc == 0, out
     assert f"last_good_lsn={len(events)}" in out and "tail_truncated=no" in out
+
+
+# ---- elastic reshard records (ISSUE 20) -------------------------------------
+
+
+def test_elastic_torn_tail_truncated_at_every_byte_offset(tmp_path):
+    """Every-byte truncation fuzz across the elastic reshard walk
+    (requested/started/completed/failed): a cut anywhere inside the
+    elastic suffix boots to exactly the state of the longest whole-record
+    prefix, so a master SIGKILLed mid-reshard resumes the resize from the
+    last durable phase instead of inventing one — the PR 16 deploy
+    discipline applied to gang resizing."""
+    events = sample_elastic_events()
+    frames = [
+        wal_frame(json.dumps({**ev, "seq": i + 1, "ts": 0}))
+        for i, ev in enumerate(events)
+    ]
+    blob = b"".join(frames)
+
+    # per-boundary digests; adjacent ones must DIFFER (every elastic
+    # record is digest-observable) or the fuzz is vacuous
+    boundaries = [0]
+    for f in frames:
+        boundaries.append(boundaries[-1] + len(f))
+    expected = []
+    for i, b in enumerate(boundaries):
+        d = tmp_path / f"boundary-{i}"
+        _write_blob(d, blob[:b])
+        expected.append(_dump(d))
+    for i, (a, b) in enumerate(zip(expected, expected[1:])):
+        assert a != b, (
+            f"record {i} ({events[i]['type']}) did not change the dump digest"
+        )
+
+    def trial_row(digest):
+        rows = [t for t in digest.get("trials", []) if t.get("id") == 90]
+        assert len(rows) == 1, digest
+        return rows[0]
+
+    # spot-check the journaled phase walk at its boundaries — and that the
+    # restart budget never moves (shrink is a capacity event, not a crash)
+    t = trial_row(expected[5])   # shrink requested landed
+    assert t["resize_phase"] == "requested" and t["resize_reason"] == "slice_loss"
+    t = trial_row(expected[6])   # gang down -> refit
+    assert t["resize_phase"] == "refit" and t["state"] == "PENDING"
+    t = trial_row(expected[8])   # shrunk placement completed
+    assert t["resize_phase"] == "" and t["cur_slots"] == 2 and t["resizes"] == 1
+    t = trial_row(expected[9])   # grow drains
+    assert t["resize_phase"] == "draining" and t["resize_target"] == 4
+    t = trial_row(expected[11])  # grow refit found nothing -> blocked
+    assert t["resize_phase"] == "blocked" and t["cur_slots"] == 2
+    for d in expected[3:]:
+        assert trial_row(d)["restarts"] == 0
+
+    work = tmp_path / "fuzz"
+    for cut in range(len(blob)):
+        shutil.rmtree(work, ignore_errors=True)
+        _write_blob(work, blob[:cut])
+        got = _dump(work)
+        # the longest whole-frame prefix at or below the cut
+        want = expected[max(i for i, b in enumerate(boundaries) if b <= cut)]
+        assert got == want, f"state diverged at truncation offset {cut}"
+
+
+def test_elastic_journal_fscks_clean_at_every_prefix(tmp_path):
+    """--journal-fsck stays clean over every whole-record prefix of the
+    elastic walk (a replayed resize phase is valid state, not damage)."""
+    events = sample_elastic_events()
+    for n in range(1, len(events) + 1):
+        d = tmp_path / f"prefix-{n}"
+        write_master_journal(str(d), events[:n])
+        rc, out = _fsck(d)
+        assert rc == 0, (n, out)
+        assert f"last_good_lsn={n}" in out and "tail_truncated=no" in out
 
 
 # ---- live master (no agents: boots in <1s, no jax) -------------------------

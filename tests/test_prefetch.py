@@ -126,7 +126,7 @@ def test_fault_injection_kills_worker_and_surfaces_at_consumer():
                 got.append(np.asarray(next(pipe)["x"]).tolist())
         pipe.close()
     assert len(got) == 2  # exactly the batches fetched before the kill
-    assert loader.state_dict() == {"epoch": 0, "batches_in_epoch": 2}
+    assert loader.state_dict() == {"epoch": 0, "batches_in_epoch": 2, "global_batch": 8}
     assert inj.count("data.prefetch.fetch") >= 2
 
 
@@ -148,7 +148,7 @@ def test_pipeline_resume_parity_matches_sync_stream(depth):
     assert first == ref[:7]
     # CONSUMED position, not fetched: with depth 4 the worker ran ahead,
     # but the checkpointed state must say exactly 7 batches taken
-    assert state == {"epoch": 0, "batches_in_epoch": 7}
+    assert state == {"epoch": 0, "batches_in_epoch": 7, "global_batch": 8}
 
     resumed = make_loader()
     resumed.load_state_dict(state)
@@ -164,7 +164,7 @@ def test_pipeline_stacks_microbatches_and_commits_once_per_step():
     pipe = InputPipeline(loader, mesh2(), agg=2, prefetch_depth=2, device_buffer=2)
     batch = next(pipe)
     assert batch["x"].shape == (2, 8)  # [agg, batch]
-    assert loader.state_dict() == {"epoch": 0, "batches_in_epoch": 2}
+    assert loader.state_dict() == {"epoch": 0, "batches_in_epoch": 2, "global_batch": 8}
     pipe.close()
 
 
